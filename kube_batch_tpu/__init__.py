@@ -1,0 +1,37 @@
+"""kube_batch_tpu — a TPU-native batch/gang scheduling framework.
+
+A from-scratch rebuild of the capability contract of kube-batch
+(reference: shivramsrivastava/kube-batch, a Go batch scheduler for
+Kubernetes): gang scheduling (PodGroup/minMember all-or-nothing),
+weighted queues with proportional fair share, DRF ordering, transactional
+preemption and cross-queue reclaim, backfill, and pluggable
+predicates/node-scoring.
+
+The architecture is deliberately NOT a port.  Where the reference runs a
+serial Go task-over-node loop (reference: pkg/scheduler/actions/allocate/
+allocate.go · Execute), this framework lifts the per-cycle scheduling
+problem onto TPU:
+
+* the cluster snapshot becomes dense, padded, statically-shaped tensors
+  (`kube_batch_tpu.api.snapshot.SnapshotTensors`);
+* plugins contribute pure JAX mask / score / order-key transforms
+  (`kube_batch_tpu.framework.session`);
+* allocation is solved as a batched masked-argmax assignment
+  (`kube_batch_tpu.ops.assignment`), shardable over a device mesh
+  (`kube_batch_tpu.parallel`).
+
+Layer map (mirrors SURVEY.md §1):
+
+    api/        domain tensors + resource math   (≙ pkg/scheduler/api)
+    cache/      host cluster cache + backends    (≙ pkg/scheduler/cache)
+    framework/  session, tiers, deltas, conf     (≙ pkg/scheduler/framework)
+    plugins/    policy                           (≙ pkg/scheduler/plugins)
+    actions/    mechanism                        (≙ pkg/scheduler/actions)
+    ops/        TPU kernels (assignment, water-fill, vocab matmuls)
+    parallel/   device-mesh sharding of the cycle
+    models/     synthetic workload models (MPIJob/TFJob-style generators)
+    sim/        simulated cluster backend (the test seam)
+    utils/      small helpers
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,26 @@
+"""Domain model: resource vectors, task/job/node status, snapshot tensors.
+
+Reference counterpart: pkg/scheduler/api (ClusterInfo/JobInfo/TaskInfo/
+NodeInfo/QueueInfo/Resource).  Here the durable representation is a dense
+tensor snapshot (`SnapshotTensors`); the host-side object model lives in
+`kube_batch_tpu.cache`.
+"""
+
+from kube_batch_tpu.api.types import (
+    TaskStatus,
+    PodGroupPhase,
+    ALLOCATED_STATUSES,
+    allocated_status,
+)
+from kube_batch_tpu.api.resource import ResourceSpec, Resource
+from kube_batch_tpu.api.snapshot import SnapshotTensors
+
+__all__ = [
+    "TaskStatus",
+    "PodGroupPhase",
+    "ALLOCATED_STATUSES",
+    "allocated_status",
+    "ResourceSpec",
+    "Resource",
+    "SnapshotTensors",
+]

@@ -1,0 +1,219 @@
+"""Resource vector math.
+
+Reference counterpart: pkg/scheduler/api/resource_info.go · Resource
+(MilliCPU / Memory / ScalarResources with Add/Sub/Multi/Less/LessEqual/
+FitDelta/Diff/SetMaxResource/MinDimensionResource/Clone and min-resource
+epsilons).
+
+TPU-first redesign: instead of a struct with named fields plus a scalar
+map, a resource is a **fixed-order float vector** over a `ResourceSpec`.
+This makes the whole framework's resource algebra identical on host
+(NumPy, float64, oracle-grade) and device (jnp, float32, shape `[R]` /
+`[T, R]` / `[N, R]`), so every plugin/action computes on resources with
+ordinary batched array ops instead of per-field branches.
+
+Units: ``cpu`` is in millicores, ``memory`` in bytes, everything else in
+plain counts — matching the reference's MilliCPU/Memory convention.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterable, Mapping
+
+import numpy as np
+
+#: Per-dimension slack under which a quantity is treated as negligible
+#: (reference: resource_info.go · minMilliCPU=10, minMemory=10Mi,
+#: minMilliScalarResources=10).
+_DEFAULT_EPS = {
+    "cpu": 10.0,            # 10 millicores
+    "memory": float(10 << 20),  # 10 MiB
+}
+_FALLBACK_EPS = 0.1
+
+#: Bookkeeping dimensions that every pod consumes by definition (a pod
+#: always takes one pod slot).  Excluded from best-effort/emptiness
+#: classification: the reference's notion of a best-effort pod is "empty
+#: Resreq", and pod-count is not part of Resreq there.
+COUNTING_RESOURCES = ("pods",)
+
+
+@dataclasses.dataclass(frozen=True)
+class ResourceSpec:
+    """Ordered universe of resource dimensions for one cluster.
+
+    The first two dimensions are conventionally ``cpu`` and ``memory``;
+    further dimensions are scalar/extended resources (accelerators,
+    ``pods`` slots, ...).  All tensors in a snapshot share one spec, so a
+    dimension index means the same thing everywhere.
+    """
+
+    names: tuple[str, ...] = ("cpu", "memory", "pods", "accelerator")
+
+    def __post_init__(self) -> None:
+        if len(set(self.names)) != len(self.names):
+            raise ValueError(f"duplicate resource names: {self.names}")
+
+    @property
+    def num(self) -> int:
+        return len(self.names)
+
+    def index(self, name: str) -> int:
+        return self.names.index(name)
+
+    @property
+    def eps(self) -> np.ndarray:
+        """Per-dimension negligibility thresholds, shape [R]."""
+        return np.array(
+            [_DEFAULT_EPS.get(n, _FALLBACK_EPS) for n in self.names], dtype=np.float64
+        )
+
+    @property
+    def besteffort_eps(self) -> np.ndarray:
+        """Like `eps`, but counting dimensions (pod slots) never disqualify
+        a request from being best-effort.  Used by the backfill action's
+        device-side candidate mask: best-effort ⇔ all(req < besteffort_eps).
+        """
+        return np.array(
+            [
+                np.inf if n in COUNTING_RESOURCES else _DEFAULT_EPS.get(n, _FALLBACK_EPS)
+                for n in self.names
+            ],
+            dtype=np.float64,
+        )
+
+    def vec(self, quantities: Mapping[str, float] | None = None, **kw: float) -> np.ndarray:
+        """Build a dense [R] vector from a name→quantity mapping.
+
+        Unknown names raise — a spec mismatch is a config error, not a
+        silent drop.
+        """
+        out = np.zeros(self.num, dtype=np.float64)
+        merged = dict(quantities or {})
+        merged.update(kw)
+        for name, q in merged.items():
+            out[self.index(name)] = float(q)
+        return out
+
+    def resource(self, quantities: Mapping[str, float] | None = None, **kw: float) -> "Resource":
+        return Resource(self, self.vec(quantities, **kw))
+
+
+@dataclasses.dataclass
+class Resource:
+    """A concrete resource amount over a `ResourceSpec`.
+
+    Thin, host-side convenience wrapper; the hot path uses the raw
+    vectors.  Arithmetic returns new objects (value semantics, like the
+    reference's Clone-then-mutate idiom but immutable).
+    """
+
+    spec: ResourceSpec
+    vec: np.ndarray
+
+    def __post_init__(self) -> None:
+        self.vec = np.asarray(self.vec, dtype=np.float64)
+        if self.vec.shape != (self.spec.num,):
+            raise ValueError(f"vector shape {self.vec.shape} != [{self.spec.num}]")
+
+    # -- constructors ----------------------------------------------------
+    @classmethod
+    def zero(cls, spec: ResourceSpec) -> "Resource":
+        return cls(spec, np.zeros(spec.num, dtype=np.float64))
+
+    def clone(self) -> "Resource":
+        return Resource(self.spec, self.vec.copy())
+
+    # -- accessors -------------------------------------------------------
+    def get(self, name: str) -> float:
+        return float(self.vec[self.spec.index(name)])
+
+    def as_dict(self) -> dict[str, float]:
+        return {n: float(v) for n, v in zip(self.spec.names, self.vec)}
+
+    @property
+    def is_empty(self) -> bool:
+        """All dimensions below their negligibility threshold.
+
+        Reference: resource_info.go · IsEmpty — the predicate that makes a
+        task *best-effort* (eligible for the backfill action).
+        """
+        return bool(np.all(self.vec < self.spec.eps))
+
+    # -- algebra ---------------------------------------------------------
+    def _check(self, other: "Resource") -> None:
+        if other.spec is not self.spec and other.spec != self.spec:
+            raise ValueError("resource spec mismatch")
+
+    def add(self, other: "Resource") -> "Resource":
+        self._check(other)
+        return Resource(self.spec, self.vec + other.vec)
+
+    def sub(self, other: "Resource") -> "Resource":
+        """Subtract, requiring `other` ⊑ self (reference Sub asserts too)."""
+        self._check(other)
+        if not other.less_equal(self):
+            raise ValueError(f"cannot subtract {other.as_dict()} from {self.as_dict()}")
+        return Resource(self.spec, np.maximum(self.vec - other.vec, 0.0))
+
+    def multi(self, ratio: float) -> "Resource":
+        return Resource(self.spec, self.vec * ratio)
+
+    def set_max(self, other: "Resource") -> "Resource":
+        """Per-dimension max (reference: SetMaxResource)."""
+        self._check(other)
+        return Resource(self.spec, np.maximum(self.vec, other.vec))
+
+    def min_dimension(self, other: "Resource") -> "Resource":
+        """Per-dimension min (reference: MinDimensionResource)."""
+        self._check(other)
+        return Resource(self.spec, np.minimum(self.vec, other.vec))
+
+    # -- comparisons -----------------------------------------------------
+    def less(self, other: "Resource") -> bool:
+        """Strictly less in EVERY dimension (reference: Less)."""
+        self._check(other)
+        return bool(np.all(self.vec < other.vec))
+
+    def less_equal(self, other: "Resource") -> bool:
+        """≤ in every dimension, with per-dim slack (reference: LessEqual).
+
+        A dimension below its negligibility threshold always fits — this
+        is what lets a 5-milli-CPU request land on a fully packed node,
+        exactly like the reference's minResource handling.
+        """
+        self._check(other)
+        return less_equal_vec(self.vec, other.vec, self.spec.eps)
+
+    def fit_delta(self, other: "Resource") -> "Resource":
+        """Per-dimension shortfall of fitting `self` into `other`.
+
+        Positive entries are the unsatisfied amount (reference: FitDelta,
+        feeding FitErrors/"why unschedulable" reporting).
+        """
+        self._check(other)
+        return Resource(self.spec, np.maximum(self.vec - other.vec, 0.0))
+
+    def diff(self, other: "Resource") -> tuple["Resource", "Resource"]:
+        """(increment, decrement) per dimension (reference: Diff)."""
+        self._check(other)
+        d = self.vec - other.vec
+        return (
+            Resource(self.spec, np.maximum(d, 0.0)),
+            Resource(self.spec, np.maximum(-d, 0.0)),
+        )
+
+    def __repr__(self) -> str:  # pragma: no cover
+        parts = ", ".join(f"{n}={v:g}" for n, v in self.as_dict().items() if v)
+        return f"Resource({parts or '∅'})"
+
+
+def less_equal_vec(
+    req: np.ndarray, avail: np.ndarray, eps: np.ndarray | float = _FALLBACK_EPS
+) -> bool:
+    """Vector form of LessEqual, broadcastable; shared with the oracle."""
+    req = np.asarray(req)
+    avail = np.asarray(avail)
+    ok = (req <= avail) | (req < eps)
+    return bool(np.all(ok, axis=-1)) if ok.ndim <= 1 else np.all(ok, axis=-1)

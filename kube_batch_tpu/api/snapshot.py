@@ -1,0 +1,222 @@
+"""Dense tensor snapshot of the cluster — the device-side ClusterInfo.
+
+Reference counterpart: pkg/scheduler/api/cluster_info.go · ClusterInfo
+(maps of JobInfo/NodeInfo/QueueInfo) plus the per-object accounting in
+job_info.go / node_info.go.  The TPU-native design replaces those maps
+with one immutable pytree of padded, statically-shaped arrays: every
+plugin and action is a pure function `SnapshotTensors -> tensors`, so the
+whole scheduling cycle jits into a single XLA program.
+
+Shape legend (all padded):
+    T — tasks (pods)        J — jobs (pod groups)
+    N — nodes               Q — queues
+    R — resource dims       L — label vocab     V — taint vocab
+    P — host-port vocab
+
+Label/taint/port *vocabularies* are the TPU answer to the reference's
+string-keyed selector/taint matching (plugins/predicates/predicates.go):
+the packer interns strings into per-snapshot integer vocabularies, and
+matching becomes small matmuls over multi-hot matrices — MXU work instead
+of per-node string comparisons.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from kube_batch_tpu.api.types import (
+    ALLOCATED_STATUSES,
+    READY_STATUSES,
+    VALID_STATUSES,
+    TaskStatus,
+)
+
+# Sentinel index for "no node / no job / no queue".
+NONE_IDX = -1
+
+
+@struct.dataclass
+class SnapshotTensors:
+    """One consistent, immutable view of the cluster as device arrays.
+
+    Produced by `kube_batch_tpu.cache.packer.pack_snapshot`; consumed by
+    every plugin/action.  Padding rows have mask == False and are inert in
+    all kernels (requests 0, capacities 0, job/queue index NONE_IDX).
+    """
+
+    # -- tasks ----------------------------------------------------------
+    task_req: jax.Array        # f32[T, R]  resource request (Resreq)
+    task_state: jax.Array      # i32[T]     TaskStatus value
+    task_job: jax.Array        # i32[T]     owning job index (NONE_IDX if none)
+    task_node: jax.Array       # i32[T]     current node index (NONE_IDX if none)
+    task_prio: jax.Array       # f32[T]     pod priority
+    task_order: jax.Array      # i32[T]     creation-order tiebreak (stable)
+    task_mask: jax.Array       # bool[T]    valid (non-padding) row
+    task_sel: jax.Array        # f32[T, L]  required node-label selector, multi-hot
+    task_tol: jax.Array        # f32[T, V]  tolerated taints, multi-hot
+    task_ports: jax.Array      # f32[T, P]  requested host ports, multi-hot
+
+    # -- jobs -----------------------------------------------------------
+    job_queue: jax.Array       # i32[J]     owning queue index
+    job_min: jax.Array         # i32[J]     minMember / MinAvailable
+    job_prio: jax.Array        # f32[J]     pod-group priority-class value
+    job_order: jax.Array       # i32[J]     creation-order tiebreak
+    job_mask: jax.Array        # bool[J]
+
+    # -- nodes ----------------------------------------------------------
+    node_cap: jax.Array        # f32[N, R]  allocatable capacity
+    node_idle: jax.Array       # f32[N, R]  capacity minus allocated requests
+    node_releasing: jax.Array  # f32[N, R]  requests of Releasing tasks
+    node_labels: jax.Array     # f32[N, L]  node labels, multi-hot
+    node_taints: jax.Array     # f32[N, V]  NoSchedule/NoExecute taints, multi-hot
+    node_ports: jax.Array      # f32[N, P]  occupied host ports, multi-hot
+    node_mask: jax.Array       # bool[N]
+
+    # -- queues ---------------------------------------------------------
+    queue_weight: jax.Array    # f32[Q]     proportional-share weight
+    queue_mask: jax.Array      # bool[Q]
+
+    # -- cluster --------------------------------------------------------
+    cluster_total: jax.Array   # f32[R]     sum of allocatable over real nodes
+
+    # ------------------------------------------------------------------
+    @property
+    def num_tasks(self) -> int:
+        return self.task_req.shape[0]
+
+    @property
+    def num_jobs(self) -> int:
+        return self.job_min.shape[0]
+
+    @property
+    def num_nodes(self) -> int:
+        return self.node_cap.shape[0]
+
+    @property
+    def num_queues(self) -> int:
+        return self.queue_weight.shape[0]
+
+    @property
+    def num_resources(self) -> int:
+        return self.task_req.shape[1]
+
+    @property
+    def shape_key(self) -> tuple[int, ...]:
+        """Compile-cache key: identical keys never trigger a recompile."""
+        return (
+            self.num_tasks,
+            self.num_jobs,
+            self.num_nodes,
+            self.num_queues,
+            self.num_resources,
+            self.task_sel.shape[1],
+            self.task_tol.shape[1],
+            self.task_ports.shape[1],
+        )
+
+
+# ---------------------------------------------------------------------------
+# jit-safe derived quantities (the accounting rules of job_info.go /
+# node_info.go expressed as whole-snapshot reductions)
+# ---------------------------------------------------------------------------
+
+def future_idle(snap: SnapshotTensors) -> jax.Array:
+    """Idle + Releasing per node — what will be free once evictions land.
+
+    Reference: node_info.go · FutureIdle semantics.
+    """
+    return snap.node_idle + snap.node_releasing
+
+
+def status_is(task_state: jax.Array, *statuses: TaskStatus) -> jax.Array:
+    """bool[T] mask of tasks in any of the given statuses."""
+    m = jnp.zeros_like(task_state, dtype=bool)
+    for s in statuses:
+        m = m | (task_state == int(s))
+    return m
+
+
+def allocated_mask(task_state: jax.Array) -> jax.Array:
+    """Tasks occupying node resources (job_info.go · AllocatedStatus)."""
+    return status_is(task_state, *ALLOCATED_STATUSES)
+
+
+def count_per_job(snap: SnapshotTensors, task_mask: jax.Array) -> jax.Array:
+    """i32[J]: number of masked tasks per job (padding-safe segment count)."""
+    seg = jnp.where(task_mask & snap.task_mask, snap.task_job, snap.num_jobs)
+    return jax.ops.segment_sum(
+        jnp.ones_like(seg, dtype=jnp.int32), seg, num_segments=snap.num_jobs + 1
+    )[: snap.num_jobs]
+
+
+def sum_req_per_job(snap: SnapshotTensors, task_mask: jax.Array) -> jax.Array:
+    """f32[J, R]: summed requests of masked tasks per job."""
+    w = (task_mask & snap.task_mask).astype(snap.task_req.dtype)
+    seg = jnp.where(task_mask & snap.task_mask, snap.task_job, snap.num_jobs)
+    return jax.ops.segment_sum(
+        snap.task_req * w[:, None], seg, num_segments=snap.num_jobs + 1
+    )[: snap.num_jobs]
+
+
+def job_ready_counts(snap: SnapshotTensors) -> jax.Array:
+    """i32[J]: tasks per job already holding resources (ReadyTaskNum).
+
+    Reference: job_info.go · ReadyTaskNum = tasks in allocated statuses
+    plus Succeeded.
+    """
+    return count_per_job(snap, status_is(snap.task_state, *READY_STATUSES))
+
+
+def job_valid_counts(snap: SnapshotTensors) -> jax.Array:
+    """i32[J]: tasks that could still become ready (ValidTaskNum).
+
+    Reference: job_info.go · ValidTaskNum — pending, pipelined, and
+    allocated-family tasks all count toward minMember feasibility.
+    """
+    return count_per_job(snap, status_is(snap.task_state, *VALID_STATUSES))
+
+
+def fits(req: jax.Array, avail: jax.Array, eps: jax.Array) -> jax.Array:
+    """Batched LessEqual: does `req` fit into `avail`, with per-dim slack?
+
+    req: f32[..., R], avail: f32[..., R], eps: f32[R] → bool[...].
+    Mirrors resource_info.go · LessEqual (see api.resource.less_equal_vec).
+    """
+    return jnp.all((req <= avail) | (req < eps), axis=-1)
+
+
+def eps_for(spec_eps: np.ndarray) -> jax.Array:
+    """Device copy of the ResourceSpec epsilon vector."""
+    return jnp.asarray(spec_eps, dtype=jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# padding helpers (host side)
+# ---------------------------------------------------------------------------
+
+def bucket(n: int, minimum: int = 8) -> int:
+    """Round `n` up to a padding bucket (next power of two, ≥ minimum).
+
+    Bucketing bounds the number of distinct `shape_key`s, so the jitted
+    cycle recompiles O(log cluster-size) times over a cluster's life —
+    the guard-rail SURVEY.md §7 calls out for dynamic pod/node churn.
+    """
+    b = minimum
+    while b < n:
+        b *= 2
+    return b
+
+
+def pad_rows(arr: np.ndarray, rows: int, fill: Any = 0) -> np.ndarray:
+    """Pad axis 0 of `arr` to `rows` with `fill`."""
+    if arr.shape[0] > rows:
+        raise ValueError(f"cannot pad {arr.shape[0]} rows down to {rows}")
+    if arr.shape[0] == rows:
+        return arr
+    pad_shape = (rows - arr.shape[0],) + arr.shape[1:]
+    return np.concatenate([arr, np.full(pad_shape, fill, dtype=arr.dtype)], axis=0)

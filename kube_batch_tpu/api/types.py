@@ -1,0 +1,73 @@
+"""Status enums for tasks and pod groups.
+
+Reference counterpart: pkg/scheduler/api/types.go · TaskStatus and
+pkg/apis/scheduling/v1alpha1/types.go · PodGroupPhase.  Values are integer
+IntEnums because they are carried in device tensors (`task_state: i32[T]`).
+"""
+
+from __future__ import annotations
+
+import enum
+
+
+class TaskStatus(enum.IntEnum):
+    """Lifecycle of a schedulable task (≙ one pod).
+
+    Semantics follow pkg/scheduler/api/types.go · TaskStatus:
+
+    * PENDING     — waiting for placement.
+    * ALLOCATED   — placed in this session; bind not yet dispatched.
+    * PIPELINED   — placed against resources that are still being released
+                    (fits FutureIdle but not Idle); no bind until release.
+    * BINDING     — bind dispatched to the backend, not yet confirmed.
+    * BOUND       — backend confirmed the bind.
+    * RUNNING     — the workload is executing on its node.
+    * RELEASING   — eviction/termination in flight; resources will free.
+    * SUCCEEDED / FAILED — terminal.
+    * UNKNOWN     — inconsistent backend state.
+    """
+
+    PENDING = 0
+    ALLOCATED = 1
+    PIPELINED = 2
+    BINDING = 3
+    BOUND = 4
+    RUNNING = 5
+    RELEASING = 6
+    SUCCEEDED = 7
+    FAILED = 8
+    UNKNOWN = 9
+
+
+#: Statuses whose resource request is debited from the node's Idle
+#: (reference: pkg/scheduler/api/job_info.go · AllocatedStatus).
+ALLOCATED_STATUSES = frozenset(
+    {TaskStatus.ALLOCATED, TaskStatus.BINDING, TaskStatus.BOUND, TaskStatus.RUNNING}
+)
+
+#: Statuses counting toward the gang-readiness threshold
+#: (job_info.go · ReadyTaskNum).  Single source of truth for host
+#: accounting (cache.info) and device kernels (api.snapshot).
+READY_STATUSES = ALLOCATED_STATUSES | {TaskStatus.SUCCEEDED}
+
+#: Statuses that could still become ready (job_info.go · ValidTaskNum).
+VALID_STATUSES = READY_STATUSES | {TaskStatus.PENDING, TaskStatus.PIPELINED}
+
+
+def allocated_status(status: TaskStatus) -> bool:
+    """True if `status` occupies node resources (debits Idle)."""
+    return status in ALLOCATED_STATUSES
+
+
+class PodGroupPhase(enum.StrEnum):
+    """Phase of a job/pod-group (reference: v1alpha1 · PodGroupPhase)."""
+
+    PENDING = "Pending"
+    RUNNING = "Running"
+    UNKNOWN = "Unknown"
+    INQUEUE = "Inqueue"
+
+
+#: Annotation-equivalent key linking a task to its group
+#: (reference: pkg/apis/scheduling/v1alpha1/types.go · GroupNameAnnotationKey).
+GROUP_NAME_KEY = "scheduling.tpu/group-name"

@@ -1,0 +1,41 @@
+"""Host-side cluster cache: the mutable mirror the snapshots are cut from.
+
+Reference counterpart: pkg/scheduler/cache (SchedulerCache, event
+handlers, Binder/Evictor/StatusUpdater seam).  Here the "cluster" is any
+object implementing the small backend protocols in `backend.py` — the
+simulator in `kube_batch_tpu.sim` for tests/benchmarks, or a real
+cluster adapter.
+"""
+
+from kube_batch_tpu.cache.cluster import Pod, Node, PodGroup, Queue
+from kube_batch_tpu.cache.info import JobInfo, NodeInfo, QueueInfo
+from kube_batch_tpu.cache.cache import SchedulerCache, HostSnapshot
+from kube_batch_tpu.cache.backend import (
+    Binder,
+    Evictor,
+    StatusUpdater,
+    FakeBinder,
+    FakeEvictor,
+    FakeStatusUpdater,
+)
+from kube_batch_tpu.cache.packer import pack_snapshot, SnapshotMeta
+
+__all__ = [
+    "Pod",
+    "Node",
+    "PodGroup",
+    "Queue",
+    "JobInfo",
+    "NodeInfo",
+    "QueueInfo",
+    "SchedulerCache",
+    "HostSnapshot",
+    "Binder",
+    "Evictor",
+    "StatusUpdater",
+    "FakeBinder",
+    "FakeEvictor",
+    "FakeStatusUpdater",
+    "pack_snapshot",
+    "SnapshotMeta",
+]

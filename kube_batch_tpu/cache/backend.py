@@ -1,0 +1,73 @@
+"""Side-effect seam: the four small interfaces all cluster writes funnel
+through.
+
+Reference counterpart: pkg/scheduler/cache/interface.go (Binder, Evictor,
+StatusUpdater) and the fake implementations the reference's action tests
+inject (FakeBinder{Channel}/FakeEvictor).  This seam is the load-bearing
+test design: gang/DRF/preemption semantics are fully testable with no
+cluster, because actions can only touch the world through these calls.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Protocol, runtime_checkable
+
+from kube_batch_tpu.cache.cluster import Pod, PodGroup
+
+
+@runtime_checkable
+class Binder(Protocol):
+    def bind(self, pod: Pod, node_name: str) -> None:
+        """Commit a placement.  Raise to signal a failed bind (the cache
+        re-queues the task, ≙ cache.go · errTasks resync)."""
+
+
+@runtime_checkable
+class Evictor(Protocol):
+    def evict(self, pod: Pod, reason: str) -> None:
+        """Gracefully terminate a running task (≙ pod delete)."""
+
+
+@runtime_checkable
+class StatusUpdater(Protocol):
+    def update_pod_group(self, group: PodGroup) -> None:
+        """Write back job phase/conditions (≙ PodGroup status update)."""
+
+
+class FakeBinder:
+    """Records binds; `wait_for` mirrors the reference tests' channel
+    pattern (assert expected binds arrive)."""
+
+    def __init__(self) -> None:
+        self.binds: list[tuple[str, str]] = []  # (pod name, node name)
+        self._cv = threading.Condition()
+        self.fail_pods: set[str] = set()        # inject bind failures by name
+
+    def bind(self, pod: Pod, node_name: str) -> None:
+        if pod.name in self.fail_pods:
+            raise RuntimeError(f"injected bind failure for {pod.name}")
+        with self._cv:
+            self.binds.append((pod.name, node_name))
+            self._cv.notify_all()
+
+    def wait_for(self, count: int, timeout: float = 5.0) -> list[tuple[str, str]]:
+        with self._cv:
+            self._cv.wait_for(lambda: len(self.binds) >= count, timeout=timeout)
+            return list(self.binds)
+
+
+class FakeEvictor:
+    def __init__(self) -> None:
+        self.evictions: list[tuple[str, str]] = []  # (pod name, reason)
+
+    def evict(self, pod: Pod, reason: str) -> None:
+        self.evictions.append((pod.name, reason))
+
+
+class FakeStatusUpdater:
+    def __init__(self) -> None:
+        self.updates: list[PodGroup] = []
+
+    def update_pod_group(self, group: PodGroup) -> None:
+        self.updates.append(group)

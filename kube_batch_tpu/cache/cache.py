@@ -1,0 +1,252 @@
+"""SchedulerCache: the event-sourced host mirror of the cluster.
+
+Reference counterpart: pkg/scheduler/cache/cache.go (SchedulerCache) and
+cache/event_handlers.go.  The cache ingests add/update/delete events for
+pods, nodes, pod groups and queues (from the simulator or a real-cluster
+adapter), maintains Job/Node/Queue accounting under one lock, and exposes:
+
+* `snapshot()` — a consistent deep copy (≙ cache.go · Snapshot), which the
+  packer turns into `SnapshotTensors`;
+* `bind()` / `evict()` — the only ways scheduling decisions reach the
+  world, funnelling through the `Binder`/`Evictor` seam with failed binds
+  re-queued (≙ cache.go · Bind / Evict / processResyncTask).
+
+Like the reference, the cache is fully reconstructable from the cluster
+(stateless recovery): drop it, replay the backend's current objects, and
+scheduling resumes — there is no scheduler-private durable state.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+
+from kube_batch_tpu.api.resource import ResourceSpec
+from kube_batch_tpu.api.types import TaskStatus
+from kube_batch_tpu.cache.backend import Binder, Evictor, StatusUpdater
+from kube_batch_tpu.cache.cluster import Node, Pod, PodGroup, Queue
+from kube_batch_tpu.cache.info import JobInfo, NodeInfo, QueueInfo
+
+DEFAULT_QUEUE = "default"
+
+
+@dataclasses.dataclass
+class HostSnapshot:
+    """Consistent host-side copy of the cache (≙ api.ClusterInfo)."""
+
+    spec: ResourceSpec
+    jobs: dict[str, JobInfo]          # by group name
+    nodes: dict[str, NodeInfo]        # by node name
+    queues: dict[str, QueueInfo]      # by queue name
+
+
+class SchedulerCache:
+    def __init__(
+        self,
+        spec: ResourceSpec,
+        binder: Binder,
+        evictor: Evictor,
+        status_updater: StatusUpdater | None = None,
+        default_queue: str = DEFAULT_QUEUE,
+    ) -> None:
+        self.spec = spec
+        self.binder = binder
+        self.evictor = evictor
+        self.status_updater = status_updater
+        self.default_queue = default_queue
+
+        self._lock = threading.RLock()
+        self._pods: dict[str, Pod] = {}          # by uid
+        self._jobs: dict[str, JobInfo] = {}      # by group name
+        self._nodes: dict[str, NodeInfo] = {}    # by node name
+        self._queues: dict[str, QueueInfo] = {}  # by queue name
+        self._resync: list[str] = []             # pod uids of failed binds
+        self.events: list[str] = []              # human-readable event log
+
+        self.add_queue(Queue(name=default_queue, weight=1.0))
+
+    # -- event handlers (≙ cache/event_handlers.go) ---------------------
+
+    def add_pod(self, pod: Pod) -> None:
+        with self._lock:
+            if pod.uid in self._pods:
+                raise ValueError(f"pod {pod.uid} already cached")
+            self._pods[pod.uid] = pod
+            if pod.group is not None:
+                job = self._jobs.get(pod.group)
+                if job is None:
+                    # Pod arrived before its PodGroup: create a shell job;
+                    # it stays unschedulable until the group object lands
+                    # (≙ event_handlers.go creating JobInfo on demand).
+                    job = JobInfo(
+                        spec=self.spec,
+                        pod_group=PodGroup(name=pod.group, queue=""),
+                        queue="",
+                    )
+                    self._jobs[pod.group] = job
+                job.add_task(pod)
+            if pod.node is not None:
+                self._node(pod.node).add_task(pod)
+
+    def delete_pod(self, pod_uid: str) -> None:
+        with self._lock:
+            pod = self._pods.pop(pod_uid, None)
+            if pod is None:
+                return
+            if pod.group is not None and pod.group in self._jobs:
+                self._jobs[pod.group].remove_task(pod)
+            if pod.node is not None and pod.node in self._nodes:
+                self._nodes[pod.node].remove_task(pod)
+
+    def update_pod_status(
+        self, pod_uid: str, status: TaskStatus, node: str | None = None
+    ) -> None:
+        """Transition a pod's status (and optionally its node), keeping
+        node accounting consistent (≙ UpdatePod re-accounting).  Tolerant
+        of vanished pods/nodes: events may race deletions."""
+        with self._lock:
+            pod = self._pods.get(pod_uid)
+            if pod is None:
+                return
+            if pod.node is not None and pod.node in self._nodes:
+                self._nodes[pod.node].remove_task(pod)
+            pod.status = status
+            if node is not None:
+                pod.node = node
+            if status == TaskStatus.PENDING:
+                pod.node = None
+            if pod.node is not None:
+                if pod.node in self._nodes:
+                    self._nodes[pod.node].add_task(pod)
+                else:  # node vanished under the pod
+                    pod.node = None
+
+    def add_node(self, node: Node) -> None:
+        with self._lock:
+            if node.name in self._nodes:
+                raise ValueError(f"node {node.name} already cached")
+            self._nodes[node.name] = NodeInfo(spec=self.spec, node=node)
+
+    def delete_node(self, name: str) -> None:
+        with self._lock:
+            info = self._nodes.pop(name, None)
+            if info is not None:
+                # Residents lose their placement; they'll be rescheduled.
+                for pod in info.tasks.values():
+                    pod.node = None
+                    pod.status = TaskStatus.PENDING
+
+    def add_pod_group(self, group: PodGroup) -> None:
+        with self._lock:
+            queue = group.queue or self.default_queue
+            existing = self._jobs.get(group.name)
+            if existing is not None:
+                existing.pod_group = group
+                existing.queue = queue
+            else:
+                self._jobs[group.name] = JobInfo(
+                    spec=self.spec, pod_group=group, queue=queue
+                )
+
+    def delete_pod_group(self, name: str) -> None:
+        with self._lock:
+            self._jobs.pop(name, None)
+
+    def add_queue(self, queue: Queue) -> None:
+        with self._lock:
+            self._queues[queue.name] = QueueInfo(queue=queue)
+
+    def delete_queue(self, name: str) -> None:
+        with self._lock:
+            self._queues.pop(name, None)
+
+    def _node(self, name: str) -> NodeInfo:
+        info = self._nodes.get(name)
+        if info is None:
+            raise KeyError(f"unknown node {name}")
+        return info
+
+    # -- snapshot (≙ cache.go · Snapshot) --------------------------------
+
+    def snapshot(self) -> HostSnapshot:
+        """Deep-copied consistent view.  Jobs without a real PodGroup or
+        with an unknown queue are skipped (≙ Snapshot's same filter) —
+        their pods still occupy nodes via NodeInfo accounting.
+
+        Pod objects are copied (one shared copy per pod across the whole
+        snapshot), so later cache mutations cannot bleed into tensors
+        packed from this view."""
+        with self._lock:
+            pod_map = {
+                uid: dataclasses.replace(pod) for uid, pod in self._pods.items()
+            }
+            jobs = {
+                name: job.clone(pod_map)
+                for name, job in self._jobs.items()
+                if job.queue and job.queue in self._queues
+            }
+            nodes = {
+                name: info.clone(pod_map)
+                for name, info in self._nodes.items()
+                if info.node.ready
+            }
+            queues = {name: QueueInfo(queue=q.queue) for name, q in self._queues.items()}
+            return HostSnapshot(spec=self.spec, jobs=jobs, nodes=nodes, queues=queues)
+
+    # -- commit funnel (≙ cache.go · Bind / Evict) -----------------------
+
+    def bind(self, pod_uid: str, node_name: str) -> bool:
+        """Dispatch a bind through the Binder.  On failure the task is
+        reset to PENDING and queued for resync (≙ errTasks workqueue)."""
+        with self._lock:
+            pod = self._pods.get(pod_uid)
+            if pod is None:
+                return False  # deleted between decision and commit
+            if node_name not in self._nodes:
+                # Stale target (node vanished between snapshot and commit):
+                # treat as a failed bind and resync, don't crash the loop.
+                self._resync.append(pod_uid)
+                self.events.append(f"bind-failed {pod.name}: unknown node {node_name}")
+                return False
+            self.update_pod_status(pod_uid, TaskStatus.BINDING, node=node_name)
+        try:
+            self.binder.bind(pod, node_name)
+        except Exception as exc:  # noqa: BLE001 — any bind failure is retryable
+            with self._lock:
+                self.update_pod_status(pod_uid, TaskStatus.PENDING)
+                self._resync.append(pod_uid)
+                self.events.append(f"bind-failed {pod.name}: {exc}")
+            return False
+        with self._lock:
+            self.update_pod_status(pod_uid, TaskStatus.BOUND)
+            self.events.append(f"bound {pod.name} -> {node_name}")
+        return True
+
+    def evict(self, pod_uid: str, reason: str) -> bool:
+        with self._lock:
+            pod = self._pods.get(pod_uid)
+            if pod is None:
+                return False
+            prev_status = pod.status
+            self.update_pod_status(pod_uid, TaskStatus.RELEASING)
+        try:
+            self.evictor.evict(pod, reason)
+        except Exception as exc:  # noqa: BLE001 — roll back, retry next cycle
+            with self._lock:
+                self.update_pod_status(pod_uid, prev_status)
+                self.events.append(f"evict-failed {pod.name}: {exc}")
+            return False
+        with self._lock:
+            self.events.append(f"evicted {pod.name}: {reason}")
+        return True
+
+    def update_job_status(self, group: PodGroup) -> None:
+        if self.status_updater is not None:
+            self.status_updater.update_pod_group(group)
+
+    def drain_resync(self) -> list[str]:
+        """Pod uids whose binds failed since last drain; the scheduler
+        loop retries them next cycle (≙ processResyncTask)."""
+        with self._lock:
+            out, self._resync = self._resync, []
+            return out

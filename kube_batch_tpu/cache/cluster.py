@@ -1,0 +1,103 @@
+"""Framework-native cluster API objects.
+
+Reference counterparts: core/v1 Pod + Node as consumed by kube-batch,
+and the CRDs in pkg/apis/scheduling/v1alpha1/types.go (PodGroup, Queue).
+These are deliberately *framework-native* — the minimal fields the
+scheduler actually consumes — not a Kubernetes API port.  A real-cluster
+adapter translates its API objects into these.
+
+Simplifications (documented contract):
+* labels are matched as exact ``key=value`` strings (the reference's
+  MatchNodeSelector equality case; set-based operators can be lowered to
+  multiple label terms by the adapter);
+* a taint is a single string ``key=value:effect`` and a toleration
+  matches a taint iff the strings are equal (the reference's
+  tolerates-with-equal-matching case).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from typing import Mapping
+
+from kube_batch_tpu.api.types import PodGroupPhase, TaskStatus
+
+_uid_counter = itertools.count()
+
+
+def _new_uid(prefix: str) -> str:
+    return f"{prefix}-{next(_uid_counter):08d}"
+
+
+@dataclasses.dataclass
+class Pod:
+    """A unit of work to place (≙ one core/v1 Pod).
+
+    `request` maps resource-dimension names (see api.ResourceSpec) to
+    quantities: cpu in millicores, memory in bytes, others in counts.
+    """
+
+    name: str
+    group: str | None = None           # PodGroup name; None → unmanaged ("Others")
+    request: Mapping[str, float] = dataclasses.field(default_factory=dict)
+    priority: int = 0
+    selector: Mapping[str, str] = dataclasses.field(default_factory=dict)
+    tolerations: frozenset[str] = frozenset()
+    ports: frozenset[int] = frozenset()
+    status: TaskStatus = TaskStatus.PENDING
+    node: str | None = None            # assigned node name, if any
+    uid: str = dataclasses.field(default_factory=lambda: _new_uid("pod"))
+    creation: int = dataclasses.field(default_factory=lambda: next(_uid_counter))
+
+    @property
+    def best_effort(self) -> bool:
+        """No meaningful resource request → backfill-eligible.
+
+        Counting dimensions (pod slots) don't count: the reference's
+        best-effort test is "empty Resreq", and pod-count isn't Resreq.
+        """
+        from kube_batch_tpu.api.resource import COUNTING_RESOURCES
+
+        return all(
+            v <= 0 for k, v in self.request.items() if k not in COUNTING_RESOURCES
+        )
+
+
+@dataclasses.dataclass
+class Node:
+    """A schedulable machine (≙ core/v1 Node as seen by the scheduler)."""
+
+    name: str
+    allocatable: Mapping[str, float] = dataclasses.field(default_factory=dict)
+    labels: Mapping[str, str] = dataclasses.field(default_factory=dict)
+    taints: frozenset[str] = frozenset()   # "key=value:effect" strings
+    ready: bool = True
+    uid: str = dataclasses.field(default_factory=lambda: _new_uid("node"))
+
+
+@dataclasses.dataclass
+class PodGroup:
+    """Gang unit (≙ v1alpha1 PodGroup CRD).
+
+    `min_member` is the all-or-nothing threshold: no member is bound
+    until at least `min_member` members hold feasible placements.
+    """
+
+    name: str
+    queue: str = ""                    # empty → scheduler default queue
+    min_member: int = 1
+    priority: int = 0                  # ≙ PriorityClassName resolved value
+    phase: PodGroupPhase = PodGroupPhase.PENDING
+    conditions: list[str] = dataclasses.field(default_factory=list)
+    uid: str = dataclasses.field(default_factory=lambda: _new_uid("pg"))
+    creation: int = dataclasses.field(default_factory=lambda: next(_uid_counter))
+
+
+@dataclasses.dataclass
+class Queue:
+    """Weighted fair-share queue (≙ v1alpha1 Queue CRD)."""
+
+    name: str
+    weight: float = 1.0
+    uid: str = dataclasses.field(default_factory=lambda: _new_uid("queue"))

@@ -1,0 +1,206 @@
+"""Snapshot packer: HostSnapshot → SnapshotTensors (+ decode metadata).
+
+This is the H2D boundary — the analog of the reference handing the
+freshly deep-copied ClusterInfo to OpenSession (framework/framework.go ·
+OpenSession), except here "handing over" means building dense padded
+arrays once per cycle and shipping them to device in one transfer.
+
+Orderings are stable (sorted by name/creation), so identical cluster
+states produce identical tensors, and bucketed padding keeps the set of
+compiled shapes small (api.snapshot.bucket).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+from kube_batch_tpu.api.resource import ResourceSpec
+from kube_batch_tpu.api.snapshot import NONE_IDX, SnapshotTensors, bucket, pad_rows
+from kube_batch_tpu.cache.cache import HostSnapshot
+from kube_batch_tpu.cache.cluster import Pod
+
+
+@dataclasses.dataclass(frozen=True)
+class SnapshotMeta:
+    """Host-side decode table for one packed snapshot: maps tensor row
+    indices back to cache objects, and records the interned vocabularies."""
+
+    spec: ResourceSpec
+    task_uids: tuple[str, ...]
+    task_pods: tuple[Pod, ...]
+    job_names: tuple[str, ...]
+    node_names: tuple[str, ...]
+    queue_names: tuple[str, ...]
+    label_vocab: tuple[str, ...]
+    taint_vocab: tuple[str, ...]
+    port_vocab: tuple[int, ...]
+
+    @property
+    def num_real_tasks(self) -> int:
+        return len(self.task_uids)
+
+    @property
+    def num_real_nodes(self) -> int:
+        return len(self.node_names)
+
+
+def _multi_hot(items_per_row: list[list[int]], rows: int, width: int) -> np.ndarray:
+    out = np.zeros((rows, width), dtype=np.float32)
+    for i, items in enumerate(items_per_row):
+        for j in items:
+            out[i, j] = 1.0
+    return out
+
+
+def pack_snapshot(host: HostSnapshot) -> tuple[SnapshotTensors, SnapshotMeta]:
+    spec = host.spec
+
+    queue_names = sorted(host.queues)
+    queue_idx = {n: i for i, n in enumerate(queue_names)}
+    job_names = sorted(host.jobs)
+    job_idx = {n: i for i, n in enumerate(job_names)}
+    node_names = sorted(host.nodes)
+    node_idx = {n: i for i, n in enumerate(node_names)}
+
+    # Every task of every snapshot job, in stable order.  Running tasks are
+    # included: preempt/reclaim search over them, and gang readiness counts
+    # them.  Unmanaged pods ("Others") are visible only through node_idle.
+    tasks: list[Pod] = []
+    task_job: list[int] = []
+    for jname in job_names:
+        job = host.jobs[jname]
+        for pod in sorted(job.tasks.values(), key=lambda p: p.creation):
+            tasks.append(pod)
+            task_job.append(job_idx[jname])
+
+    # -- intern vocabularies -------------------------------------------
+    labels: set[str] = set()
+    taints: set[str] = set()
+    ports: set[int] = set()
+    for pod in tasks:
+        labels.update(f"{k}={v}" for k, v in pod.selector.items())
+        taints.update(pod.tolerations)
+        ports.update(pod.ports)
+    node_resident_ports: dict[str, set[int]] = {}
+    for nname in node_names:
+        info = host.nodes[nname]
+        labels.update(f"{k}={v}" for k, v in info.node.labels.items())
+        taints.update(info.node.taints)
+        occupied = set()
+        for resident in info.tasks.values():
+            occupied.update(resident.ports)
+        node_resident_ports[nname] = occupied
+        ports.update(occupied)
+
+    label_vocab = tuple(sorted(labels))
+    taint_vocab = tuple(sorted(taints))
+    port_vocab = tuple(sorted(ports))
+    lab_idx = {s: i for i, s in enumerate(label_vocab)}
+    tnt_idx = {s: i for i, s in enumerate(taint_vocab)}
+    prt_idx = {p: i for i, p in enumerate(port_vocab)}
+
+    T, J, N, Q = len(tasks), len(job_names), len(node_names), len(queue_names)
+    Tp, Jp, Np, Qp = bucket(T), bucket(J), bucket(N), bucket(Q)
+    L, V, P = bucket(len(label_vocab)), bucket(len(taint_vocab)), bucket(len(port_vocab))
+
+    # -- task tensors ---------------------------------------------------
+    task_req = np.stack(
+        [spec.vec(p.request) for p in tasks], axis=0
+    ).astype(np.float32) if tasks else np.zeros((0, spec.num), np.float32)
+    task_state = np.array([int(p.status) for p in tasks], dtype=np.int32)
+    task_node = np.array(
+        [node_idx.get(p.node, NONE_IDX) if p.node else NONE_IDX for p in tasks],
+        dtype=np.int32,
+    )
+    task_prio = np.array([p.priority for p in tasks], dtype=np.float32)
+    task_order = np.array([p.creation for p in tasks], dtype=np.int32)
+    task_sel = _multi_hot(
+        [[lab_idx[f"{k}={v}"] for k, v in p.selector.items()] for p in tasks], T, L
+    )
+    task_tol = _multi_hot([[tnt_idx[t] for t in p.tolerations] for p in tasks], T, V)
+    task_ports = _multi_hot([[prt_idx[pt] for pt in p.ports] for p in tasks], T, P)
+
+    # -- job tensors ----------------------------------------------------
+    job_queue = np.array(
+        [queue_idx[host.jobs[n].queue] for n in job_names], dtype=np.int32
+    )
+    job_min = np.array([host.jobs[n].min_available for n in job_names], dtype=np.int32)
+    job_prio = np.array([host.jobs[n].priority for n in job_names], dtype=np.float32)
+    job_order = np.array(
+        [host.jobs[n].pod_group.creation for n in job_names], dtype=np.int32
+    )
+
+    # -- node tensors ---------------------------------------------------
+    if node_names:
+        node_cap = np.stack(
+            [host.nodes[n].allocatable for n in node_names], axis=0
+        ).astype(np.float32)
+        node_idle = np.stack(
+            [host.nodes[n].idle for n in node_names], axis=0
+        ).astype(np.float32)
+        node_rel = np.stack(
+            [host.nodes[n].releasing for n in node_names], axis=0
+        ).astype(np.float32)
+    else:
+        node_cap = node_idle = node_rel = np.zeros((0, spec.num), np.float32)
+    node_labels = _multi_hot(
+        [
+            [lab_idx[f"{k}={v}"] for k, v in host.nodes[n].node.labels.items()]
+            for n in node_names
+        ],
+        N,
+        L,
+    )
+    node_taints = _multi_hot(
+        [[tnt_idx[t] for t in host.nodes[n].node.taints] for n in node_names], N, V
+    )
+    node_ports = _multi_hot(
+        [[prt_idx[p] for p in node_resident_ports[n]] for n in node_names], N, P
+    )
+
+    queue_weight = np.array(
+        [host.queues[n].weight for n in queue_names], dtype=np.float32
+    )
+
+    snap = SnapshotTensors(
+        task_req=jnp.asarray(pad_rows(task_req, Tp)),
+        task_state=jnp.asarray(pad_rows(task_state, Tp)),
+        task_job=jnp.asarray(pad_rows(np.array(task_job, np.int32), Tp, NONE_IDX)),
+        task_node=jnp.asarray(pad_rows(task_node, Tp, NONE_IDX)),
+        task_prio=jnp.asarray(pad_rows(task_prio, Tp)),
+        task_order=jnp.asarray(pad_rows(task_order, Tp)),
+        task_mask=jnp.asarray(pad_rows(np.ones(T, bool), Tp, False)),
+        task_sel=jnp.asarray(pad_rows(task_sel, Tp)),
+        task_tol=jnp.asarray(pad_rows(task_tol, Tp)),
+        task_ports=jnp.asarray(pad_rows(task_ports, Tp)),
+        job_queue=jnp.asarray(pad_rows(job_queue, Jp, NONE_IDX)),
+        job_min=jnp.asarray(pad_rows(job_min, Jp)),
+        job_prio=jnp.asarray(pad_rows(job_prio, Jp)),
+        job_order=jnp.asarray(pad_rows(job_order, Jp)),
+        job_mask=jnp.asarray(pad_rows(np.ones(J, bool), Jp, False)),
+        node_cap=jnp.asarray(pad_rows(node_cap, Np)),
+        node_idle=jnp.asarray(pad_rows(node_idle, Np)),
+        node_releasing=jnp.asarray(pad_rows(node_rel, Np)),
+        node_labels=jnp.asarray(pad_rows(node_labels, Np)),
+        node_taints=jnp.asarray(pad_rows(node_taints, Np)),
+        node_ports=jnp.asarray(pad_rows(node_ports, Np)),
+        node_mask=jnp.asarray(pad_rows(np.ones(N, bool), Np, False)),
+        queue_weight=jnp.asarray(pad_rows(queue_weight, Qp)),
+        queue_mask=jnp.asarray(pad_rows(np.ones(Q, bool), Qp, False)),
+        cluster_total=jnp.asarray(node_cap.sum(axis=0).astype(np.float32)),
+    )
+    meta = SnapshotMeta(
+        spec=spec,
+        task_uids=tuple(p.uid for p in tasks),
+        task_pods=tuple(tasks),
+        job_names=tuple(job_names),
+        node_names=tuple(node_names),
+        queue_names=tuple(queue_names),
+        label_vocab=label_vocab,
+        taint_vocab=taint_vocab,
+        port_vocab=port_vocab,
+    )
+    return snap, meta

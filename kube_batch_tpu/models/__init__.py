@@ -1,0 +1,15 @@
+"""Synthetic workload models: generators for the benchmark/e2e configs.
+
+The "models" of a scheduling framework are workload shapes.  This package
+builds the five BASELINE.md evaluation configs, including the MPIJob- and
+TFJob-style gang topologies of config 5.
+"""
+
+from kube_batch_tpu.models.workloads import (
+    mpi_job,
+    tf_job,
+    build_config,
+    CONFIG_BUILDERS,
+)
+
+__all__ = ["mpi_job", "tf_job", "build_config", "CONFIG_BUILDERS"]

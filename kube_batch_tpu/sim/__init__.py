@@ -1,0 +1,13 @@
+"""Simulated cluster backend — the framework's e2e test seam.
+
+Reference counterpart: the FakeBinder/FakeEvictor pattern of the
+reference's action tests plus its e2e harness (test/e2e/util.go), folded
+into one in-process cluster simulator: binds start pods, evictions pass
+through a Releasing grace period, and controllers recreate evicted pods —
+so gang/preemption/reclaim semantics are exercised end-to-end with no
+real cluster.
+"""
+
+from kube_batch_tpu.sim.simulator import SimulatedCluster
+
+__all__ = ["SimulatedCluster"]

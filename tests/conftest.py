@@ -1,0 +1,16 @@
+"""Test configuration: force an 8-device virtual CPU platform.
+
+Multi-chip TPU hardware is not available in CI; sharding correctness is
+validated on a virtual 8-device CPU mesh exactly as the driver's
+`dryrun_multichip` does.  Environment must be set before jax is imported
+anywhere, which conftest import-order guarantees.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

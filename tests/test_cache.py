@@ -1,0 +1,115 @@
+"""Cache accounting + snapshot/packer tests.
+
+Modeled on the reference's cache tests (pkg/scheduler/cache/cache_test.go):
+feed events directly, assert job/node accounting and snapshot contents.
+"""
+
+import numpy as np
+
+from kube_batch_tpu.api.resource import ResourceSpec
+from kube_batch_tpu.api.snapshot import NONE_IDX
+from kube_batch_tpu.api.types import TaskStatus
+from kube_batch_tpu.cache import pack_snapshot
+from kube_batch_tpu.cache.cluster import Node, Pod, PodGroup
+from kube_batch_tpu.models.workloads import GI, config1_gang_small, config3_predicates
+from kube_batch_tpu.sim.simulator import make_world
+
+SPEC = ResourceSpec(("cpu", "memory", "pods", "accelerator"))
+
+
+def test_node_accounting_through_lifecycle():
+    cache, sim = make_world(SPEC)
+    sim.add_node(Node(name="n0", allocatable={"cpu": 4000, "memory": 8 * GI}))
+    pg = PodGroup(name="g", queue="default", min_member=1)
+    pod = Pod(name="p0", group="g", request={"cpu": 1000, "memory": 2 * GI})
+    sim.submit(pg, [pod])
+
+    ni = cache._nodes["n0"]
+    assert ni.idle[0] == 4000  # pending pod not on node yet
+
+    # bind → BINDING/BOUND debit idle
+    assert cache.bind(pod.uid, "n0")
+    assert ni.idle[0] == 3000
+    assert ni.used[0] == 1000
+
+    sim.tick()  # pod starts running
+    assert cache._pods[pod.uid].status == TaskStatus.RUNNING
+    assert ni.idle[0] == 3000
+
+    # evict → RELEASING: idle still debited, releasing credited (FutureIdle)
+    cache.evict(pod.uid, "test")
+    assert ni.idle[0] == 3000
+    assert ni.releasing[0] == 1000
+    assert ni.future_idle[0] == 4000
+
+    sim.tick()  # pod deleted + recreated pending
+    assert ni.idle[0] == 4000
+    assert ni.releasing[0] == 0
+    # the recreated pod exists and is pending
+    job = cache._jobs["g"]
+    assert len(job.tasks) == 1
+    assert next(iter(job.tasks.values())).status == TaskStatus.PENDING
+
+
+def test_failed_bind_resync():
+    cache, sim = make_world(SPEC)
+    sim.add_node(Node(name="n0", allocatable={"cpu": 4000, "memory": 8 * GI}))
+    pg = PodGroup(name="g", queue="default", min_member=1)
+    pod = Pod(name="p0", group="g", request={"cpu": 1000})
+    sim.submit(pg, [pod])
+
+    original_bind = sim.bind
+    sim.bind = lambda *a, **k: (_ for _ in ()).throw(RuntimeError("apiserver down"))
+    assert not cache.bind(pod.uid, "n0")
+    assert cache._pods[pod.uid].status == TaskStatus.PENDING
+    assert cache._nodes["n0"].idle[0] == 4000
+    assert cache.drain_resync() == [pod.uid]
+    sim.bind = original_bind
+    assert cache.bind(pod.uid, "n0")
+
+
+def test_snapshot_isolation():
+    cache, _sim = config1_gang_small(SPEC)
+    snap = cache.snapshot()
+    # mutating the cache after snapshot must not affect the copy:
+    # neither the cloned accounting vectors nor the copied Pod objects.
+    some_pod = next(iter(cache._pods.values()))
+    cache.bind(some_pod.uid, "n0")
+    assert all(
+        t.status == TaskStatus.PENDING for t in snap.jobs["pg1"].tasks.values()
+    )
+    assert snap.nodes["n0"].idle[0] == 4000
+
+
+def test_best_effort_ignores_pod_slot():
+    assert Pod(name="be", request={"pods": 1}).best_effort
+    assert not Pod(name="real", request={"pods": 1, "cpu": 100}).best_effort
+
+
+def test_pack_config1_shapes_and_values():
+    cache, _ = config1_gang_small(SPEC)
+    snap, meta = pack_snapshot(cache.snapshot())
+    assert meta.num_real_tasks == 8
+    assert meta.num_real_nodes == 4
+    assert snap.num_tasks == 8          # bucket(8) == 8
+    assert snap.num_nodes >= 4
+    assert bool(snap.task_mask[:8].all())
+    assert snap.task_req.shape[1] == SPEC.num
+    np.testing.assert_allclose(np.asarray(snap.task_req)[0, 0], 2000.0)
+    np.testing.assert_allclose(np.asarray(snap.node_idle)[:4, 0], 4000.0)
+    np.testing.assert_allclose(float(snap.cluster_total[0]), 16000.0)
+    assert int(snap.job_min[0]) == 8
+    assert np.all(np.asarray(snap.task_node)[:8] == NONE_IDX)
+
+
+def test_pack_vocabularies_config3():
+    cache, _ = config3_predicates(SPEC)
+    snap, meta = pack_snapshot(cache.snapshot())
+    assert "zone=zone-0" in meta.label_vocab
+    assert "dedicated=batch:NoSchedule" in meta.taint_vocab
+    # tainted nodes: 1 in 5 of 200
+    taints = np.asarray(snap.node_taints)[: meta.num_real_nodes]
+    assert taints.sum() == 40
+    # every real task row maps to a valid job
+    tj = np.asarray(snap.task_job)[: meta.num_real_tasks]
+    assert tj.min() >= 0 and tj.max() < len(meta.job_names)

@@ -1,0 +1,107 @@
+"""Resource algebra unit tests.
+
+Modeled on the reference's table-driven pkg/scheduler/api/
+resource_info_test.go: pure-function cases over Add/Sub/LessEqual/
+FitDelta/Diff/SetMax/MinDimension plus the min-resource epsilon rules.
+"""
+
+import numpy as np
+import pytest
+
+from kube_batch_tpu.api.resource import Resource, ResourceSpec, less_equal_vec
+
+SPEC = ResourceSpec(("cpu", "memory", "pods", "accelerator"))
+
+
+def res(**kw):
+    return SPEC.resource(kw)
+
+
+class TestConstruction:
+    def test_zero(self):
+        z = Resource.zero(SPEC)
+        assert z.is_empty
+        assert z.as_dict() == {"cpu": 0, "memory": 0, "pods": 0, "accelerator": 0}
+
+    def test_vec_unknown_name_raises(self):
+        with pytest.raises(ValueError):
+            SPEC.vec({"nvidia.com/gpu": 1})
+
+    def test_duplicate_spec_names_raise(self):
+        with pytest.raises(ValueError):
+            ResourceSpec(("cpu", "cpu"))
+
+
+class TestAlgebra:
+    def test_add(self):
+        a = res(cpu=1000, memory=1 << 30)
+        b = res(cpu=500, accelerator=2)
+        c = a.add(b)
+        assert c.get("cpu") == 1500
+        assert c.get("memory") == 1 << 30
+        assert c.get("accelerator") == 2
+
+    def test_sub(self):
+        a = res(cpu=1000, memory=1 << 30)
+        b = res(cpu=400)
+        assert a.sub(b).get("cpu") == 600
+
+    def test_sub_insufficient_raises(self):
+        with pytest.raises(ValueError):
+            res(cpu=100).sub(res(cpu=200))
+
+    def test_multi(self):
+        assert res(cpu=100).multi(2.5).get("cpu") == 250
+
+    def test_set_max_and_min_dimension(self):
+        a = res(cpu=100, memory=50)
+        b = res(cpu=40, memory=80)
+        assert a.set_max(b).as_dict()["cpu"] == 100
+        assert a.set_max(b).as_dict()["memory"] == 80
+        assert a.min_dimension(b).as_dict()["cpu"] == 40
+        assert a.min_dimension(b).as_dict()["memory"] == 50
+
+    def test_diff(self):
+        inc, dec = res(cpu=100, memory=10).diff(res(cpu=40, memory=30))
+        assert inc.get("cpu") == 60 and inc.get("memory") == 0
+        assert dec.get("cpu") == 0 and dec.get("memory") == 20
+
+
+class TestComparisons:
+    def test_less_strict_all_dims(self):
+        # Less requires strictly-less in EVERY dimension; an equal dim fails it.
+        assert not res(cpu=1, memory=1).less(res(cpu=2, memory=1, pods=1, accelerator=1))
+        small = Resource(SPEC, np.array([1.0, 1.0, 0.5, 0.5]))
+        big = Resource(SPEC, np.array([2.0, 2.0, 1.0, 1.0]))
+        assert small.less(big)
+
+    def test_less_equal_basic(self):
+        assert res(cpu=1000).less_equal(res(cpu=1000))
+        assert not res(cpu=1001, memory=1 << 30).less_equal(
+            res(cpu=1000, memory=1 << 30)
+        )
+
+    def test_less_equal_epsilon(self):
+        # Requests under the per-dim threshold (10m CPU, 10Mi mem) always fit.
+        assert res(cpu=5).less_equal(res())
+        assert res(memory=float(5 << 20)).less_equal(res())
+        assert not res(cpu=50).less_equal(res())
+
+    def test_fit_delta(self):
+        d = res(cpu=1000, memory=100).fit_delta(res(cpu=600, memory=200))
+        assert d.get("cpu") == 400 and d.get("memory") == 0
+
+    def test_is_empty_epsilon(self):
+        assert res(cpu=9).is_empty            # below 10m threshold
+        assert not res(cpu=11).is_empty
+        assert res(memory=float(9 << 20)).is_empty
+        assert not res(pods=1).is_empty
+
+
+class TestVectorForm:
+    def test_less_equal_vec_batched(self):
+        req = np.array([[100.0, 0, 0, 0], [5.0, 0, 0, 0], [2000.0, 0, 0, 0]])
+        avail = np.array([1000.0, 0, 0, 0])
+        eps = SPEC.eps
+        out = less_equal_vec(req, avail, eps)
+        assert list(out) == [True, True, False]
