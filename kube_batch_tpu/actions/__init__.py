@@ -1,0 +1,10 @@
+"""Scheduling actions (≙ pkg/scheduler/actions).
+
+Importing this package registers every built-in action
+(≙ actions/factory.go registering allocate/backfill/preempt/reclaim).
+"""
+
+from kube_batch_tpu.actions import factory  # noqa: F401
+from kube_batch_tpu.actions.factory import BUILTIN_ACTIONS
+
+__all__ = ["BUILTIN_ACTIONS"]

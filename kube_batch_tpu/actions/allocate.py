@@ -1,0 +1,64 @@
+"""Allocate action: place pending tasks onto idle capacity.
+
+Reference counterpart: actions/allocate/allocate.go · Execute — the
+serial queue→job→task loop with per-task PredicateNodes/PrioritizeNodes
+fan-out.  Here the whole loop is two auction-round solves (see
+ops/assignment.py):
+
+1. against Idle — accepted placements become ALLOCATED;
+2. against FutureIdle — leftover tasks that only fit once releasing
+   resources free become PIPELINED (≙ ssn.Pipeline), consuming no Idle.
+
+Queue fairness (Overused), gang validity (JobValid), and the tiered
+queue>job>task ordering all enter through the policy's eligible/rank
+functions, re-evaluated inside the round loop — the tensor equivalent of
+the reference re-pushing job & queue into the priority queues between
+tasks.
+
+The jitted solver lives on the action instance, so XLA compiles once per
+snapshot shape bucket and replays from cache on every later cycle.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from kube_batch_tpu.framework.plugin import Action, register_action
+from kube_batch_tpu.ops.assignment import allocate_rounds
+
+
+@register_action
+class AllocateAction(Action):
+    name = "allocate"
+
+    def initialize(self, policy) -> None:
+        self.policy = policy
+
+        def _solve(snap, state):
+            pred = policy.predicate_mask(snap)
+            state = allocate_rounds(
+                snap,
+                state,
+                pred,
+                policy.score_fn,
+                policy.rank_fn,
+                policy.eligible_fn,
+                snap.eps,
+                use_future=False,
+            )
+            state = allocate_rounds(
+                snap,
+                state,
+                pred,
+                policy.score_fn,
+                policy.rank_fn,
+                policy.eligible_fn,
+                snap.eps,
+                use_future=True,
+            )
+            return state
+
+        self._solve = jax.jit(_solve)
+
+    def execute(self, ssn) -> None:
+        ssn.state = self._solve(ssn.snap, ssn.state)

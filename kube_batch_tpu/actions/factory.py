@@ -1,0 +1,6 @@
+"""Action factory: importing it registers every built-in action
+(≙ actions/factory.go)."""
+
+from kube_batch_tpu.actions import allocate  # noqa: F401
+
+BUILTIN_ACTIONS = ["allocate"]

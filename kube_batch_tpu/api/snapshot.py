@@ -83,6 +83,8 @@ class SnapshotTensors:
 
     # -- cluster --------------------------------------------------------
     cluster_total: jax.Array   # f32[R]     sum of allocatable over real nodes
+    eps: jax.Array             # f32[R]     per-dim negligibility (LessEqual slack)
+    besteffort_eps: jax.Array  # f32[R]     like eps but ∞ on counting dims
 
     # ------------------------------------------------------------------
     @property
@@ -163,22 +165,29 @@ def sum_req_per_job(snap: SnapshotTensors, task_mask: jax.Array) -> jax.Array:
     )[: snap.num_jobs]
 
 
-def job_ready_counts(snap: SnapshotTensors) -> jax.Array:
+def job_ready_counts(
+    snap: SnapshotTensors, task_state: jax.Array | None = None
+) -> jax.Array:
     """i32[J]: tasks per job already holding resources (ReadyTaskNum).
 
     Reference: job_info.go · ReadyTaskNum = tasks in allocated statuses
-    plus Succeeded.
+    plus Succeeded.  Pass a live `task_state` (e.g. AllocState's) to
+    count against in-cycle placements instead of the snapshot's.
     """
-    return count_per_job(snap, status_is(snap.task_state, *READY_STATUSES))
+    ts = snap.task_state if task_state is None else task_state
+    return count_per_job(snap, status_is(ts, *READY_STATUSES))
 
 
-def job_valid_counts(snap: SnapshotTensors) -> jax.Array:
+def job_valid_counts(
+    snap: SnapshotTensors, task_state: jax.Array | None = None
+) -> jax.Array:
     """i32[J]: tasks that could still become ready (ValidTaskNum).
 
     Reference: job_info.go · ValidTaskNum — pending, pipelined, and
     allocated-family tasks all count toward minMember feasibility.
     """
-    return count_per_job(snap, status_is(snap.task_state, *VALID_STATUSES))
+    ts = snap.task_state if task_state is None else task_state
+    return count_per_job(snap, status_is(ts, *VALID_STATUSES))
 
 
 def fits(req: jax.Array, avail: jax.Array, eps: jax.Array) -> jax.Array:

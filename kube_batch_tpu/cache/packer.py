@@ -191,6 +191,8 @@ def pack_snapshot(host: HostSnapshot) -> tuple[SnapshotTensors, SnapshotMeta]:
         queue_weight=jnp.asarray(pad_rows(queue_weight, Qp)),
         queue_mask=jnp.asarray(pad_rows(np.ones(Q, bool), Qp, False)),
         cluster_total=jnp.asarray(node_cap.sum(axis=0).astype(np.float32)),
+        eps=jnp.asarray(spec.eps.astype(np.float32)),
+        besteffort_eps=jnp.asarray(spec.besteffort_eps.astype(np.float32)),
     )
     meta = SnapshotMeta(
         spec=spec,

@@ -1,0 +1,53 @@
+"""Session framework: the plugin/action runtime.
+
+Reference counterpart: pkg/scheduler/framework (OpenSession/CloseSession,
+Session with its ~14 extension-point registries, Statement transactions,
+plugin/action registries).
+
+TPU-native split: registration is divided into a **compile-time half**
+(`TensorPolicy` — pure jit-safe tensor transforms, registered once per
+config so jitted cycle functions keep stable identity and XLA's compile
+cache works across cycles) and a **runtime half** (`Session` — one
+snapshot's host state, per-cycle open/close hooks, and the commit funnel
+back to the cache).  The reference re-registers everything every cycle
+because closures are free in Go; under XLA, stable function identity IS
+the compile cache key, so the split is load-bearing.
+"""
+
+from kube_batch_tpu.framework.plugin import (
+    Plugin,
+    Action,
+    register_plugin,
+    register_action,
+    get_plugin_builder,
+    get_action,
+    PLUGIN_REGISTRY,
+    ACTION_REGISTRY,
+)
+from kube_batch_tpu.framework.conf import (
+    PluginConf,
+    TierConf,
+    SchedulerConf,
+    default_conf,
+)
+from kube_batch_tpu.framework.policy import TensorPolicy
+from kube_batch_tpu.framework.session import Session, open_session, close_session
+
+__all__ = [
+    "Plugin",
+    "Action",
+    "register_plugin",
+    "register_action",
+    "get_plugin_builder",
+    "get_action",
+    "PLUGIN_REGISTRY",
+    "ACTION_REGISTRY",
+    "PluginConf",
+    "TierConf",
+    "SchedulerConf",
+    "default_conf",
+    "TensorPolicy",
+    "Session",
+    "open_session",
+    "close_session",
+]

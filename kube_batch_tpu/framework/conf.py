@@ -1,0 +1,136 @@
+"""Scheduler policy configuration (`scheduler.conf`).
+
+Reference counterpart: the YAML the reference re-reads every cycle
+(pkg/scheduler/scheduler.go · loadSchedulerConf) with `actions:` (a
+comma-separated string) and `tiers:` of plugins, plus per-plugin
+Arguments and enable flags; default in pkg/scheduler/util.go ·
+defaultSchedulerConf.
+
+Same file format here:
+
+    actions: "allocate, backfill"
+    tiers:
+    - plugins:
+      - name: priority
+      - name: gang
+      - name: conformance
+    - plugins:
+      - name: drf
+      - name: predicates
+      - name: proportion
+      - name: nodeorder
+        arguments:
+          nodeorder.leastrequested.weight: 1
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import yaml
+
+
+@dataclasses.dataclass(frozen=True)
+class PluginConf:
+    """≙ conf.PluginOption: name + Arguments + per-extension enables."""
+
+    name: str
+    arguments: tuple[tuple[str, Any], ...] = ()
+    enabled: tuple[tuple[str, bool], ...] = ()  # e.g. ("jobOrder", False)
+
+    @property
+    def args_dict(self) -> dict[str, Any]:
+        return dict(self.arguments)
+
+    def enabled_for(self, point: str) -> bool:
+        return dict(self.enabled).get(point, True)
+
+
+@dataclasses.dataclass(frozen=True)
+class TierConf:
+    plugins: tuple[PluginConf, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class SchedulerConf:
+    actions: tuple[str, ...]
+    tiers: tuple[TierConf, ...]
+
+    @property
+    def fingerprint(self) -> int:
+        """Stable identity for compiled-policy caching."""
+        return hash(self)
+
+
+def default_conf() -> SchedulerConf:
+    """≙ pkg/scheduler/util.go · defaultSchedulerConf: actions
+    "allocate, backfill"; tiers [priority, gang, conformance] /
+    [drf, predicates, proportion, nodeorder].
+
+    Only plugins/actions actually registered are included, so the default
+    path always runs (the full reference set fills in as plugins land).
+    """
+    from kube_batch_tpu.framework.plugin import ACTION_REGISTRY, PLUGIN_REGISTRY
+
+    tier1 = ("priority", "gang", "conformance")
+    tier2 = ("drf", "predicates", "proportion", "nodeorder")
+    actions = tuple(
+        a for a in ("allocate", "backfill") if a in ACTION_REGISTRY
+    ) or ("allocate",)
+    return SchedulerConf(
+        actions=actions,
+        tiers=(
+            TierConf(
+                plugins=tuple(PluginConf(n) for n in tier1 if n in PLUGIN_REGISTRY)
+            ),
+            TierConf(
+                plugins=tuple(PluginConf(n) for n in tier2 if n in PLUGIN_REGISTRY)
+            ),
+        ),
+    )
+
+
+def parse_conf(text: str) -> SchedulerConf:
+    """Parse the scheduler.conf YAML (hot-reload friendly: pure text in,
+    immutable conf out)."""
+    raw = yaml.safe_load(text)
+    if not raw:
+        return default_conf()
+    raw_actions = raw.get("actions", "allocate, backfill")
+    if isinstance(raw_actions, str):
+        actions = tuple(a.strip() for a in raw_actions.split(",") if a.strip())
+    else:  # YAML list form: actions: [allocate, backfill]
+        actions = tuple(str(a).strip() for a in raw_actions)
+    tiers: list[TierConf] = []
+    for tier_raw in raw.get("tiers", []) or []:
+        plugins: list[PluginConf] = []
+        for p in tier_raw.get("plugins", []) or []:
+            enables = tuple(
+                (k[len("enable"):][0].lower() + k[len("enable") + 1:], bool(v))
+                for k, v in p.items()
+                if k.startswith("enable") and len(k) > len("enable")
+            )
+            plugins.append(
+                PluginConf(
+                    name=p["name"],
+                    arguments=tuple(sorted((p.get("arguments") or {}).items())),
+                    enabled=enables,
+                )
+            )
+        tiers.append(TierConf(plugins=tuple(plugins)))
+    if not tiers:
+        return dataclasses.replace(default_conf(), actions=actions)
+    return SchedulerConf(actions=actions, tiers=tuple(tiers))
+
+
+def load_conf(path: str | None) -> SchedulerConf:
+    """Read + parse a conf file; missing path → defaults (≙ the
+    reference's fallback to defaultSchedulerConf)."""
+    if path is None:
+        return default_conf()
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            return parse_conf(f.read())
+    except FileNotFoundError:
+        return default_conf()

@@ -1,0 +1,218 @@
+"""TensorPolicy: the compile-time half of the session framework.
+
+Reference counterpart: framework/session_plugins.go — the ~14 extension
+point registries (AddJobOrderFn/AddPredicateFn/AddNodeOrderFn/
+AddPreemptableFn/...) and their tiered evaluators.
+
+Every registered fn is a pure jit-safe transform over
+`(SnapshotTensors, AllocState)`.  Tier semantics are preserved exactly:
+order fns stack into lexicographic keys (first decisive tier wins —
+rank_from_keys), veto fns intersect within the first tier that has an
+opinion.  Because fns are registered once per configuration and the
+evaluators are plain compositions, the jitted cycle closures keep stable
+identity and XLA compiles once per shape bucket.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from kube_batch_tpu.api.snapshot import (
+    SnapshotTensors,
+    job_ready_counts,
+    job_valid_counts,
+)
+from kube_batch_tpu.ops.assignment import AllocState, rank_from_keys
+
+# fn signatures (all pure, jit-safe)
+QueueKeyFn = Callable[[SnapshotTensors, AllocState], jax.Array]   # f32[Q]
+JobKeyFn = Callable[[SnapshotTensors, AllocState], jax.Array]     # f32[J]
+TaskKeyFn = Callable[[SnapshotTensors, AllocState], jax.Array]    # f32[T]
+PredicateFn = Callable[[SnapshotTensors], jax.Array]              # bool[T, N]
+NodeScoreFn = Callable[[SnapshotTensors, AllocState], jax.Array]  # f32[T, N]
+JobBoolFn = Callable[[SnapshotTensors, AllocState], jax.Array]    # bool[J]
+QueueBoolFn = Callable[[SnapshotTensors, AllocState], jax.Array]  # bool[Q]
+# Veto fns see (snap, state, preemptor task index) → bool[T] over victims.
+VetoFn = Callable[[SnapshotTensors, AllocState, jax.Array], jax.Array]
+
+
+def task_queue_of(snap: SnapshotTensors) -> jax.Array:
+    """i32[T]: each task's queue index via its job (padding → 0, masked)."""
+    job = jnp.clip(snap.task_job, 0, snap.num_jobs - 1)
+    return jnp.clip(snap.job_queue[job], 0, snap.num_queues - 1)
+
+
+class TensorPolicy:
+    """Aggregated plugin policy for one SchedulerConf."""
+
+    def __init__(self, num_tiers: int) -> None:
+        self.num_tiers = num_tiers
+        self.queue_order: list[list[QueueKeyFn]] = [[] for _ in range(num_tiers)]
+        self.job_order: list[list[JobKeyFn]] = [[] for _ in range(num_tiers)]
+        self.task_order: list[list[TaskKeyFn]] = [[] for _ in range(num_tiers)]
+        self.predicates: list[PredicateFn] = []
+        self.node_scores: list[tuple[float, NodeScoreFn]] = []
+        self.job_valid: list[JobBoolFn] = []
+        self.job_ready: list[JobBoolFn] = []
+        self.job_pipelined: list[JobBoolFn] = []
+        self.overused: list[QueueBoolFn] = []
+        self.preemptable: list[list[VetoFn]] = [[] for _ in range(num_tiers)]
+        self.reclaimable: list[list[VetoFn]] = [[] for _ in range(num_tiers)]
+
+    # -- registration (≙ session_plugins.go Add*Fn) ---------------------
+    def add_queue_order_fn(self, tier: int, fn: QueueKeyFn) -> None:
+        self.queue_order[tier].append(fn)
+
+    def add_job_order_fn(self, tier: int, fn: JobKeyFn) -> None:
+        self.job_order[tier].append(fn)
+
+    def add_task_order_fn(self, tier: int, fn: TaskKeyFn) -> None:
+        self.task_order[tier].append(fn)
+
+    def add_predicate_fn(self, fn: PredicateFn) -> None:
+        self.predicates.append(fn)
+
+    def add_node_order_fn(self, weight: float, fn: NodeScoreFn) -> None:
+        self.node_scores.append((weight, fn))
+
+    def add_job_valid_fn(self, fn: JobBoolFn) -> None:
+        self.job_valid.append(fn)
+
+    def add_job_ready_fn(self, fn: JobBoolFn) -> None:
+        self.job_ready.append(fn)
+
+    def add_job_pipelined_fn(self, fn: JobBoolFn) -> None:
+        self.job_pipelined.append(fn)
+
+    def add_overused_fn(self, fn: QueueBoolFn) -> None:
+        self.overused.append(fn)
+
+    def add_preemptable_fn(self, tier: int, fn: VetoFn) -> None:
+        self.preemptable[tier].append(fn)
+
+    def add_reclaimable_fn(self, tier: int, fn: VetoFn) -> None:
+        self.reclaimable[tier].append(fn)
+
+    # -- evaluators -----------------------------------------------------
+    def predicate_mask(self, snap: SnapshotTensors) -> jax.Array:
+        """bool[T, N]: AND of all plugin predicates (chained like the
+        reference's predicate list — any veto excludes the node)."""
+        m = jnp.ones((snap.num_tasks, snap.num_nodes), bool)
+        for fn in self.predicates:
+            m = m & fn(snap)
+        return m
+
+    def score_fn(self, snap: SnapshotTensors, state: AllocState) -> jax.Array:
+        """f32[T, N]: weighted sum of node-order scores
+        (≙ util.PrioritizeNodes summing weighted priority fns)."""
+        s = jnp.zeros((snap.num_tasks, snap.num_nodes), jnp.float32)
+        for w, fn in self.node_scores:
+            s = s + w * fn(snap, state)
+        return s
+
+    def rank_fn(self, snap: SnapshotTensors, state: AllocState) -> jax.Array:
+        """i32[T]: global scheduling-order ranks from the tiered
+        queue > job > task lexicographic ordering."""
+        tq = task_queue_of(snap)
+        tj = jnp.clip(snap.task_job, 0, snap.num_jobs - 1)
+        keys: list[jax.Array] = [snap.task_order.astype(jnp.float32)]
+        # least-significant-first; within each level, later tiers are
+        # less significant than earlier ones → append reversed.
+        for tier_fns in reversed(self.task_order):
+            for fn in reversed(tier_fns):
+                keys.append(fn(snap, state))
+        for tier_fns in reversed(self.job_order):
+            for fn in reversed(tier_fns):
+                keys.append(fn(snap, state)[tj])
+        for tier_fns in reversed(self.queue_order):
+            for fn in reversed(tier_fns):
+                keys.append(fn(snap, state)[tq])
+        return rank_from_keys(keys, snap.num_tasks)
+
+    def job_rank(self, snap: SnapshotTensors, state: AllocState) -> jax.Array:
+        """i32[J]: job-level ranks (used by preempt's starving-job order)."""
+        keys: list[jax.Array] = [snap.job_order.astype(jnp.float32)]
+        for tier_fns in reversed(self.job_order):
+            for fn in reversed(tier_fns):
+                keys.append(fn(snap, state))
+        return rank_from_keys(keys, snap.num_jobs)
+
+    def job_valid_mask(self, snap: SnapshotTensors, state: AllocState) -> jax.Array:
+        """bool[J] (≙ ssn.JobValid; no fns → all valid)."""
+        m = snap.job_mask
+        for fn in self.job_valid:
+            m = m & fn(snap, state)
+        return m
+
+    def job_ready_mask(self, snap: SnapshotTensors, state: AllocState) -> jax.Array:
+        """bool[J] (≙ ssn.JobReady; no fns → all ready)."""
+        m = snap.job_mask
+        for fn in self.job_ready:
+            m = m & fn(snap, state)
+        return m
+
+    def job_pipelined_mask(self, snap: SnapshotTensors, state: AllocState) -> jax.Array:
+        """bool[J] (≙ ssn.JobPipelined): would the gang gate be met once
+        pipelined placements land?  Consulted by preempt — a job whose
+        minMember is satisfiable by releasing resources shouldn't evict
+        victims for it."""
+        m = snap.job_mask
+        for fn in self.job_pipelined:
+            m = m & fn(snap, state)
+        return m
+
+    def overused_mask(self, snap: SnapshotTensors, state: AllocState) -> jax.Array:
+        """bool[Q] (≙ ssn.Overused; OR — any plugin can declare overuse)."""
+        m = jnp.zeros(snap.num_queues, bool)
+        for fn in self.overused:
+            m = m | fn(snap, state)
+        return m
+
+    def eligible_fn(self, snap: SnapshotTensors, state: AllocState) -> jax.Array:
+        """bool[T]: may this pending task be placed right now — its job
+        valid (gang), its queue not overused (proportion)."""
+        jv = self.job_valid_mask(snap, state)
+        over = self.overused_mask(snap, state)
+        tj = jnp.clip(snap.task_job, 0, snap.num_jobs - 1)
+        tq = task_queue_of(snap)
+        return jv[tj] & ~over[tq] & (snap.task_job >= 0)
+
+    def _veto_intersection(
+        self,
+        tiers: list[list[VetoFn]],
+        snap: SnapshotTensors,
+        state: AllocState,
+        preemptor: jax.Array,
+    ) -> jax.Array:
+        """bool[T] victim permission: within the FIRST tier that has any
+        registered fn, intersect plugin answers; later tiers are ignored
+        (≙ session_plugins.go · Preemptable tier walk)."""
+        for tier_fns in tiers:
+            if tier_fns:
+                m = jnp.ones(snap.num_tasks, bool)
+                for fn in tier_fns:
+                    m = m & fn(snap, state, preemptor)
+                return m
+        return jnp.ones(snap.num_tasks, bool)
+
+    def preemptable_mask(
+        self, snap: SnapshotTensors, state: AllocState, preemptor: jax.Array
+    ) -> jax.Array:
+        return self._veto_intersection(self.preemptable, snap, state, preemptor)
+
+    def reclaimable_mask(
+        self, snap: SnapshotTensors, state: AllocState, preemptor: jax.Array
+    ) -> jax.Array:
+        return self._veto_intersection(self.reclaimable, snap, state, preemptor)
+
+    # -- convenience reductions ----------------------------------------
+    @staticmethod
+    def ready_counts(snap: SnapshotTensors, state: AllocState) -> jax.Array:
+        return job_ready_counts(snap, state.task_state)
+
+    @staticmethod
+    def valid_counts(snap: SnapshotTensors, state: AllocState) -> jax.Array:
+        return job_valid_counts(snap, state.task_state)

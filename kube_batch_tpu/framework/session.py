@@ -1,0 +1,138 @@
+"""Session: one scheduling cycle's runtime state and commit funnel.
+
+Reference counterpart: framework/framework.go (OpenSession/CloseSession)
+and framework/session.go (Session.Allocate/Pipeline/Evict/dispatch).
+
+A Session owns one packed snapshot and threads an `AllocState` through
+the configured actions.  Cluster effects happen only at two funnels:
+
+* `commit_evictions` — preempt/reclaim land their victim evictions
+  (their transactional what-if is pure tensor math; commit-or-drop is
+  simply whether the delta is applied, ≙ Statement.Commit/Discard);
+* `close_session` — binds dispatch for every job passing the JobReady
+  gate (gang all-or-nothing: an unready job's tentative placements are
+  dropped with zero cluster effect, ≙ session.go deferring dispatch
+  until JobReady).
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Sequence
+
+import numpy as np
+
+from kube_batch_tpu.api.types import TaskStatus
+from kube_batch_tpu.cache.cache import SchedulerCache
+from kube_batch_tpu.cache.packer import SnapshotMeta, pack_snapshot
+from kube_batch_tpu.framework.conf import SchedulerConf
+from kube_batch_tpu.framework.plugin import Plugin, get_plugin_builder
+from kube_batch_tpu.framework.policy import TensorPolicy
+from kube_batch_tpu.ops.assignment import AllocState, init_state
+
+_session_counter = itertools.count()
+
+
+def build_policy(conf: SchedulerConf) -> tuple[TensorPolicy, list[Plugin]]:
+    """Instantiate plugins from conf and let them register their tensor
+    fns — once per configuration (≙ every-cycle OnSessionOpen in the
+    reference, hoisted to config time because fn identity is the XLA
+    compile-cache key here)."""
+    policy = TensorPolicy(num_tiers=len(conf.tiers))
+    plugins: list[Plugin] = []
+    for tier_idx, tier in enumerate(conf.tiers):
+        for pconf in tier.plugins:
+            plugin = get_plugin_builder(pconf.name)(pconf.args_dict)
+            plugin.set_enabled(dict(pconf.enabled))
+            plugin.register(policy, tier_idx)
+            plugins.append(plugin)
+    return policy, plugins
+
+
+class Session:
+    """One cycle: snapshot in, bind/evict decisions out."""
+
+    def __init__(
+        self,
+        cache: SchedulerCache,
+        policy: TensorPolicy,
+        plugins: Sequence[Plugin],
+    ) -> None:
+        self.uid = next(_session_counter)
+        self.cache = cache
+        self.policy = policy
+        self.plugins = list(plugins)
+
+        self.host = cache.snapshot()
+        self.snap, self.meta = pack_snapshot(self.host)
+        self.state: AllocState = init_state(self.snap)
+        self.initial_task_state = np.asarray(self.snap.task_state)
+
+        self.bound: list[tuple[str, str]] = []     # (pod name, node) this cycle
+        self.evicted: list[tuple[str, str]] = []   # (pod name, reason)
+
+    # -- commit funnels -------------------------------------------------
+    def commit_evictions(self, victim_idx: Sequence[int], reason: str) -> None:
+        """Land evictions decided by preempt/reclaim (≙ Statement.Commit
+        replaying Evict through the cache)."""
+        for t in victim_idx:
+            pod = self.meta.task_pods[int(t)]
+            if self.cache.evict(pod.uid, reason):
+                self.evicted.append((pod.name, reason))
+
+    def dispatch_binds(self) -> list[tuple[str, str]]:
+        """Bind every newly allocated task of every JobReady job
+        (gang commit; ≙ session.go · Allocate's deferred dispatch)."""
+        snap, state = self.snap, self.state
+        task_state = np.asarray(state.task_state)
+        task_node = np.asarray(state.task_node)
+        ready = np.asarray(self.policy.job_ready_mask(snap, state))
+        task_job = np.asarray(snap.task_job)
+
+        newly_allocated = (
+            (task_state == int(TaskStatus.ALLOCATED))
+            & (self.initial_task_state == int(TaskStatus.PENDING))
+        )
+        for t in np.nonzero(newly_allocated)[0]:
+            if t >= self.meta.num_real_tasks:
+                continue
+            j = task_job[t]
+            if j < 0 or not ready[j]:
+                continue  # gang gate: unready job's placements are dropped
+            pod = self.meta.task_pods[t]
+            node_name = self.meta.node_names[task_node[t]]
+            if self.cache.bind(pod.uid, node_name):
+                self.bound.append((pod.name, node_name))
+        return self.bound
+
+    # -- introspection for plugins' close hooks ------------------------
+    def unready_jobs(self) -> list[str]:
+        """Names of jobs that wanted resources but failed the gang gate."""
+        ready = np.asarray(self.policy.job_ready_mask(self.snap, self.state))
+        out = []
+        for j, name in enumerate(self.meta.job_names):
+            if not ready[j]:
+                out.append(name)
+        return out
+
+
+def open_session(
+    cache: SchedulerCache, policy: TensorPolicy, plugins: Sequence[Plugin]
+) -> Session:
+    """≙ framework.go · OpenSession: snapshot + plugin open hooks."""
+    ssn = Session(cache, policy, plugins)
+    for plugin in ssn.plugins:
+        plugin.on_session_open(ssn)
+    return ssn
+
+
+def close_session(ssn: Session) -> None:
+    """≙ framework.go · CloseSession: dispatch gang-gated binds, run
+    plugin close hooks (events/conditions), write back job status."""
+    ssn.dispatch_binds()
+    for plugin in ssn.plugins:
+        plugin.on_session_close(ssn)
+    for name in ssn.meta.job_names:
+        job = ssn.host.jobs.get(name)
+        if job is not None:
+            ssn.cache.update_job_status(job.pod_group)

@@ -1,0 +1,15 @@
+"""TPU kernels: the tensorized hot ops of the scheduling cycle.
+
+Reference counterpart: the serial loops of pkg/scheduler/actions/ and
+pkg/scheduler/util/scheduler_helper.go (PredicateNodes/PrioritizeNodes
+with a 16-way thread pool).  Here each becomes a whole-matrix op:
+
+* `assignment` — the allocate inner product: masked [T, N] score matrix
+  solved by auction rounds (parallel proposals + per-node prefix-sum
+  conflict resolution), replacing the reference's task-by-task argmax.
+* `ranking` — tiered lexicographic order keys → per-task ranks.
+"""
+
+from kube_batch_tpu.ops.assignment import AllocState, allocate_rounds, init_state
+
+__all__ = ["AllocState", "allocate_rounds", "init_state"]

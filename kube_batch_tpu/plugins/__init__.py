@@ -1,0 +1,10 @@
+"""Policy plugins (≙ pkg/scheduler/plugins).
+
+Importing this package registers every built-in plugin with the
+framework registry (≙ plugins/factory.go).
+"""
+
+from kube_batch_tpu.plugins import factory  # noqa: F401  (registration side effect)
+from kube_batch_tpu.plugins.factory import BUILTIN_PLUGINS
+
+__all__ = ["BUILTIN_PLUGINS"]

@@ -1,0 +1,6 @@
+"""Plugin factory: importing it registers every built-in plugin
+(≙ plugins/factory.go)."""
+
+from kube_batch_tpu.plugins import gang, priority  # noqa: F401
+
+BUILTIN_PLUGINS = ["gang", "priority"]

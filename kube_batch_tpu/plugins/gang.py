@@ -1,0 +1,81 @@
+"""Gang plugin: all-or-nothing minMember scheduling.
+
+Reference counterpart: plugins/gang/gang.go —
+* JobValidFn: a job may only be considered if enough tasks could still
+  become ready (ValidTaskNum ≥ MinAvailable);
+* JobReadyFn: binds dispatch only once ReadyTaskNum ≥ MinAvailable;
+* JobOrderFn: jobs still fighting for their gang come first;
+* PreemptableFn: vetoes victims whose job would drop below MinAvailable;
+* OnSessionClose: surfaces "job cannot reach minMember" to users via
+  events + PodGroup conditions.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from kube_batch_tpu.api.snapshot import job_ready_counts, job_valid_counts
+from kube_batch_tpu.framework.plugin import Plugin, register_plugin
+
+
+@register_plugin
+class GangPlugin(Plugin):
+    name = "gang"
+
+    def register(self, policy, tier: int) -> None:
+        def job_valid(snap, state):
+            return job_valid_counts(snap, state.task_state) >= snap.job_min
+
+        def job_ready(snap, state):
+            return job_ready_counts(snap, state.task_state) >= snap.job_min
+
+        def job_pipelined(snap, state):
+            # ready+pipelined members suffice → job may wait on releasing
+            # resources instead of being preempted-for.
+            from kube_batch_tpu.api.snapshot import count_per_job, status_is
+            from kube_batch_tpu.api.types import READY_STATUSES, TaskStatus
+
+            cnt = count_per_job(
+                snap,
+                status_is(state.task_state, *READY_STATUSES, TaskStatus.PIPELINED),
+            )
+            return cnt >= snap.job_min
+
+        def job_order(snap, state):
+            # unready gangs first (key 0.0), satisfied gangs later (1.0)
+            return job_ready(snap, state).astype(jnp.float32)
+
+        def preemptable(snap, state, preemptor):  # noqa: ARG001
+            # veto evicting a task if its job would fall below minMember
+            ready = job_ready_counts(snap, state.task_state)
+            tj = jnp.clip(snap.task_job, 0, snap.num_jobs - 1)
+            survives = ready[tj] - 1 >= snap.job_min[tj]
+            return survives | (snap.task_job < 0)
+
+        if self.enabled_for("jobValid"):
+            policy.add_job_valid_fn(job_valid)
+        if self.enabled_for("jobReady"):
+            policy.add_job_ready_fn(job_ready)
+            policy.add_job_pipelined_fn(job_pipelined)
+        if self.enabled_for("jobOrder"):
+            policy.add_job_order_fn(tier, job_order)
+        if self.enabled_for("preemptable"):
+            policy.add_preemptable_fn(tier, preemptable)
+        if self.enabled_for("reclaimable"):
+            policy.add_reclaimable_fn(tier, preemptable)
+
+    def on_session_close(self, ssn) -> None:
+        """Emit unschedulable events/conditions for unready gangs
+        (≙ gang.go · OnSessionClose)."""
+        for name in ssn.unready_jobs():
+            job = ssn.host.jobs.get(name)
+            if job is None:
+                continue
+            msg = (
+                f"gang unschedulable: job {name} has {job.ready_task_num} ready, "
+                f"needs minMember {job.min_available}"
+            )
+            ssn.cache.events.append(msg)
+            live = ssn.cache._jobs.get(name)
+            if live is not None and msg not in live.pod_group.conditions:
+                live.pod_group.conditions.append(msg)

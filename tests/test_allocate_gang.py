@@ -1,0 +1,120 @@
+"""End-to-end allocate + gang tests (BASELINE config 1 and variants).
+
+Pattern follows the reference's action tests (actions/allocate/
+allocate_test.go): build a real cache against the fake/simulated
+backend, run a session + action, assert on the binds that arrive.
+"""
+
+import numpy as np
+
+from kube_batch_tpu.actions import BUILTIN_ACTIONS  # noqa: F401 (registration)
+from kube_batch_tpu.api.resource import ResourceSpec
+from kube_batch_tpu.cache.cluster import Node, Pod, PodGroup
+from kube_batch_tpu.framework import (
+    PluginConf,
+    SchedulerConf,
+    TierConf,
+    close_session,
+    open_session,
+)
+from kube_batch_tpu.framework.plugin import get_action
+from kube_batch_tpu.framework.session import build_policy
+from kube_batch_tpu.models.workloads import GI, config1_gang_small
+from kube_batch_tpu.plugins import BUILTIN_PLUGINS  # noqa: F401 (registration)
+from kube_batch_tpu.sim.simulator import make_world
+
+SPEC = ResourceSpec(("cpu", "memory", "pods", "accelerator"))
+
+CONF = SchedulerConf(
+    actions=("allocate",),
+    tiers=(TierConf(plugins=(PluginConf("priority"), PluginConf("gang"))),),
+)
+
+
+def run_one_cycle(cache, conf=CONF):
+    policy, plugins = build_policy(conf)
+    actions = [get_action(name) for name in conf.actions]
+    for a in actions:
+        a.initialize(policy)
+    ssn = open_session(cache, policy, plugins)
+    for a in actions:
+        a.execute(ssn)
+    close_session(ssn)
+    return ssn
+
+
+def test_config1_gang_schedules_all_eight():
+    cache, sim = config1_gang_small(SPEC)
+    ssn = run_one_cycle(cache)
+    assert len(ssn.bound) == 8
+    assert sorted(p for p, _ in sim.binds) == sorted(f"pg1-{i}" for i in range(8))
+    # each node fits exactly 2 of the 2000m tasks
+    per_node = {}
+    for _, node in sim.binds:
+        per_node[node] = per_node.get(node, 0) + 1
+    assert all(v == 2 for v in per_node.values())
+    assert set(per_node) == {"n0", "n1", "n2", "n3"}
+
+
+def test_gang_blocks_when_min_member_unsatisfiable():
+    """minMember > cluster capacity → NO member binds (all-or-nothing)."""
+    cache, sim = make_world(SPEC)
+    for i in range(4):
+        sim.add_node(Node(name=f"n{i}", allocatable={"cpu": 4000, "memory": 8 * GI,
+                                                     "pods": 110}))
+    group = PodGroup(name="big", queue="default", min_member=9)
+    pods = [Pod(name=f"big-{i}", request={"cpu": 2000, "memory": 4 * GI, "pods": 1})
+            for i in range(9)]
+    sim.submit(group, pods)
+
+    ssn = run_one_cycle(cache)
+    assert ssn.bound == []
+    assert sim.binds == []
+    # the gang plugin reported why
+    assert any("gang unschedulable" in e for e in cache.events)
+    assert any("minMember 9" in c for c in cache._jobs["big"].pod_group.conditions)
+
+
+def test_gang_partial_members_all_bind_when_min_met():
+    """8 tasks, minMember=4, room for 8 → all 8 bind (not only 4)."""
+    cache, sim = config1_gang_small(SPEC)
+    cache._jobs["pg1"].pod_group.min_member = 4
+    ssn = run_one_cycle(cache)
+    assert len(ssn.bound) == 8
+
+
+def test_two_jobs_compete_higher_priority_wins():
+    """Capacity for one gang only; the higher-priority job gets it."""
+    cache, sim = make_world(SPEC)
+    for i in range(2):
+        sim.add_node(Node(name=f"n{i}", allocatable={"cpu": 4000, "memory": 8 * GI,
+                                                     "pods": 110}))
+    lo = PodGroup(name="lo", queue="default", min_member=4, priority=1)
+    hi = PodGroup(name="hi", queue="default", min_member=4, priority=100)
+    sim.submit(lo, [Pod(name=f"lo-{i}", priority=1,
+                        request={"cpu": 2000, "memory": 4 * GI, "pods": 1})
+                    for i in range(4)])
+    sim.submit(hi, [Pod(name=f"hi-{i}", priority=100,
+                        request={"cpu": 2000, "memory": 4 * GI, "pods": 1})
+                    for i in range(4)])
+
+    ssn = run_one_cycle(cache)
+    bound_names = sorted(p for p, _ in ssn.bound)
+    assert bound_names == [f"hi-{i}" for i in range(4)]
+
+
+def test_no_oversubscription_under_contention():
+    """Auction conflict resolution must never oversubscribe a node."""
+    cache, sim = make_world(SPEC)
+    sim.add_node(Node(name="only", allocatable={"cpu": 5000, "memory": 100 * GI,
+                                                "pods": 110}))
+    group = PodGroup(name="many", queue="default", min_member=1)
+    pods = [Pod(name=f"p{i}", request={"cpu": 1000, "memory": GI, "pods": 1})
+            for i in range(20)]
+    sim.submit(group, pods)
+
+    ssn = run_one_cycle(cache)
+    assert len(ssn.bound) == 5  # 5000m / 1000m
+    idle = cache._nodes["only"].idle
+    assert idle[0] == 0
+    assert np.all(idle >= 0)
