@@ -27,38 +27,39 @@ from kube_batch_tpu.framework.plugin import Action, register_action
 from kube_batch_tpu.ops.assignment import allocate_rounds
 
 
+def make_allocate_solver(policy):
+    """(snap, state) -> state: the full two-pass allocate solve.
+
+    The single definition of the pipeline — the action jits it for
+    production, and bench.py / __graft_entry__.py reuse it so what they
+    measure/compile-check is exactly what runs.
+    """
+
+    def solve(snap, state):
+        pred = policy.predicate_mask(snap)
+        for use_future in (False, True):
+            state = allocate_rounds(
+                snap,
+                state,
+                pred,
+                policy.score_fn,
+                policy.rank_fn,
+                policy.eligible_fn,
+                snap.eps,
+                use_future=use_future,
+            )
+        return state
+
+    return solve
+
+
 @register_action
 class AllocateAction(Action):
     name = "allocate"
 
     def initialize(self, policy) -> None:
         self.policy = policy
-
-        def _solve(snap, state):
-            pred = policy.predicate_mask(snap)
-            state = allocate_rounds(
-                snap,
-                state,
-                pred,
-                policy.score_fn,
-                policy.rank_fn,
-                policy.eligible_fn,
-                snap.eps,
-                use_future=False,
-            )
-            state = allocate_rounds(
-                snap,
-                state,
-                pred,
-                policy.score_fn,
-                policy.rank_fn,
-                policy.eligible_fn,
-                snap.eps,
-                use_future=True,
-            )
-            return state
-
-        self._solve = jax.jit(_solve)
+        self._solve = jax.jit(make_allocate_solver(policy))
 
     def execute(self, ssn) -> None:
         ssn.state = self._solve(ssn.snap, ssn.state)

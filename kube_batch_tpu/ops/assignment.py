@@ -18,15 +18,18 @@ TPU-native redesign — **auction rounds**.  Each round, entirely as
 3. accepted tasks are allocated (state + capacity updated by scatter),
    everyone else retries next round against updated capacities.
 
-Every proposer fits its proposed node *alone* (feasibility is checked
-against current capacity), so each contended node accepts ≥1 proposer
-per round — the loop provably terminates, and in practice converges in
-~max-contention rounds.  Highest-ranked tasks always win their
-proposals, reproducing the reference's ordering semantics at round
-granularity; DRF/proportion feedback (shares shifting as allocations
-land) enters through `score_fn`/`rank_fn`, which are re-evaluated every
-round from the live `AllocState` — the tensor analog of the reference's
-EventHandler share updates.
+Acceptance preserves the reference's strict rank order via a global
+watermark: no task is accepted in a round where a better-ranked feasible
+task was rejected (the hungry task gets first pick of updated capacities
+next round).  The globally best active task always wins its proposal
+(it fits its proposed node alone and is rank-first there), so ≥1 task
+is accepted per round and the loop provably terminates within T rounds
+— the default bound.  In the common case (scores or the per-pair
+tie-break spreading proposals, capacity > 1 per node) convergence is a
+handful of rounds.  DRF/proportion feedback (shares shifting as
+allocations land) enters through `score_fn`/`rank_fn`, which are
+re-evaluated every round from the live `AllocState` — the tensor analog
+of the reference's EventHandler share updates.
 
 The same kernel runs the pipelining pass (`use_future=True`): placements
 against FutureIdle (resources still releasing) become PIPELINED instead
@@ -46,6 +49,19 @@ from kube_batch_tpu.api.snapshot import SnapshotTensors, fits
 from kube_batch_tpu.api.types import TaskStatus
 
 NEG_INF = -1e30
+
+
+def _tie_hash(T: int, N: int) -> jax.Array:
+    """f32[T, N] in [0, 1): deterministic per-(task, node) tie-break.
+
+    Knuth multiplicative hashing on the pair index — cheap, stateless,
+    and stable across rounds so a task re-proposes consistently.
+    """
+    i = jnp.arange(T, dtype=jnp.uint32)[:, None]
+    j = jnp.arange(N, dtype=jnp.uint32)[None, :]
+    h = (i * jnp.uint32(2654435761) + j * jnp.uint32(2246822519)) ^ (i >> 7)
+    h = (h ^ (h >> 15)) * jnp.uint32(2246822519)
+    return (h & jnp.uint32(0xFFFF)).astype(jnp.float32) / 65536.0
 
 
 @struct.dataclass
@@ -128,7 +144,19 @@ def _resolve_conflicts(
     # (negligible ask always fits), never to the cumulative prefix.
     fits_prefix = jnp.all((within <= node_avail) | (s_req < eps), axis=-1)
     s_accept = active[perm] & fits_prefix
-    return jnp.zeros(T, bool).at[perm].set(s_accept)
+    accept = jnp.zeros(T, bool).at[perm].set(s_accept)
+
+    # Global rank watermark: the reference places tasks strictly in rank
+    # order, so a task may not consume capacity in the same round that a
+    # better-ranked task goes hungry — the hungry task must get first
+    # pick of the updated capacities next round.  Cancel acceptances
+    # ranked above the best-ranked rejected-but-feasible task.  The
+    # globally best active task is always rank-first on its proposed
+    # node (which it fits alone), so >=1 acceptance survives and the
+    # loop still terminates.
+    rejected = active & ~accept
+    watermark = jnp.min(jnp.where(rejected, rank, jnp.iinfo(jnp.int32).max))
+    return accept & (rank < watermark)
 
 
 def allocate_rounds(
@@ -140,10 +168,18 @@ def allocate_rounds(
     eligible_fn: EligibleFn,
     eps: jax.Array,              # f32[R]
     use_future: bool = False,
-    max_rounds: int = 64,
+    max_rounds: int | None = None,
 ) -> AllocState:
-    """Run auction rounds to a fixed point (or `max_rounds`)."""
+    """Run auction rounds to a fixed point.
+
+    `max_rounds` defaults to T — sufficient for any input, since ≥1 task
+    is accepted per round; the loop exits early the first round nothing
+    is accepted, so the bound costs nothing in the common case.
+    """
+    if max_rounds is None:
+        max_rounds = snap.num_tasks
     new_status = int(TaskStatus.PIPELINED if use_future else TaskStatus.ALLOCATED)
+    jitter = _tie_hash(snap.num_tasks, snap.num_nodes)  # loop-invariant
 
     def cond(carry):
         _, progress, rnd = carry
@@ -159,7 +195,16 @@ def allocate_rounds(
         feas = predicate_mask & fit & snap.node_mask[None, :] & eligible[:, None]
 
         score = jnp.where(feas, score_fn(snap, st), NEG_INF)
-        prop_node = jnp.argmax(score, axis=1).astype(jnp.int32)  # ties → low idx
+        # Two-key argmax: primary = plugin score, secondary = a cheap
+        # per-(task, node) hash.  The reference breaks score ties
+        # arbitrarily (util.SelectBestNode); breaking them *differently
+        # per task* is what lets one round spread equally-scored
+        # proposals across nodes instead of stampeding node 0.
+        best = jnp.max(score, axis=1, keepdims=True)
+        tied = feas & (score >= best)
+        prop_node = jnp.argmax(
+            jnp.where(tied, jitter, -1.0), axis=1
+        ).astype(jnp.int32)
         active = jnp.any(feas, axis=1)
 
         rank = rank_fn(snap, st)

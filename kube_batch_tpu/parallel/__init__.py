@@ -1,0 +1,15 @@
+"""Device-mesh sharding of the scheduling cycle.
+
+Reference counterpart: none — the reference's only scale-out is a 16-way
+thread pool (pkg/scheduler/util/scheduler_helper.go · ParallelizeUntil)
+and active/passive HA.  Here the [T, N] score/feasibility matrices shard
+over the node axis of a `jax.sharding.Mesh`, so predicate evaluation,
+scoring and conflict resolution ride ICI collectives emitted by XLA
+(SURVEY.md §2.10/§2.11).
+"""
+
+from kube_batch_tpu.parallel.mesh import (  # noqa: F401
+    NODE_AXIS,
+    make_mesh,
+    shard_cycle_inputs,
+)

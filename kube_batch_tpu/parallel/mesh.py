@@ -1,0 +1,78 @@
+"""Mesh construction and snapshot sharding.
+
+The scheduling cycle's parallel dimension is the NODE axis: every
+per-node tensor (capacities, idle, labels/taints/ports multi-hots) and
+every [T, N] intermediate shards across devices along N, while task/job/
+queue tensors replicate.  This mirrors how the problem actually scales —
+clusters grow in nodes — and keeps the heavy [T, N] feasibility/score
+products local, with XLA inserting all-gathers/reductions only where the
+kernel genuinely needs global views (argmax over nodes, the rank sort
+over tasks).
+
+Cited design: SURVEY.md §2.10 — "the score matrix shards across ICI
+(`NamedSharding` over the node axis)".
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+NODE_AXIS = "node"
+
+
+def make_mesh(n_devices: int | None = None, axis: str = NODE_AXIS) -> Mesh:
+    """A 1-D device mesh over the node axis (ICI within a slice)."""
+    devices = jax.devices()
+    if n_devices is not None:
+        if len(devices) < n_devices:
+            raise ValueError(
+                f"need {n_devices} devices, have {len(devices)} "
+                f"(set XLA_FLAGS=--xla_force_host_platform_device_count=N "
+                f"with JAX_PLATFORMS=cpu for a virtual mesh)"
+            )
+        devices = devices[:n_devices]
+    return Mesh(devices, (axis,))
+
+
+def _node_sharded_fields(obj: Any, num_nodes: int) -> dict[str, bool]:
+    """Which dataclass fields have a leading node dimension?"""
+    out = {}
+    for f in dataclasses.fields(obj):
+        v = getattr(obj, f.name)
+        out[f.name] = (
+            hasattr(v, "shape") and v.ndim >= 1 and v.shape[0] == num_nodes
+        )
+    return out
+
+
+def shard_cycle_inputs(snap, state, mesh: Mesh, axis: str = NODE_AXIS):
+    """device_put snapshot + state with node-axis NamedShardings.
+
+    Node-major arrays get PartitionSpec(axis); everything else replicates.
+    Falls back to full replication when the padded node count doesn't
+    divide the mesh (bucketed padding makes this rare: both are powers
+    of two).
+    """
+    n = snap.num_nodes
+    divisible = n % mesh.shape[axis] == 0
+    node_spec = P(axis) if divisible else P()
+    repl = NamedSharding(mesh, P())
+    node_sh = NamedSharding(mesh, node_spec)
+
+    def place(obj):
+        node_fields = _node_sharded_fields(obj, n)
+        # task_req is [T, R] — T can collide with N on tiny square worlds;
+        # disambiguate by field name prefix.
+        updates = {}
+        for f in dataclasses.fields(obj):
+            v = getattr(obj, f.name)
+            want_node = node_fields[f.name] and f.name.startswith("node_")
+            if hasattr(v, "shape"):
+                updates[f.name] = jax.device_put(v, node_sh if want_node else repl)
+        return dataclasses.replace(obj, **updates)
+
+    return place(snap), place(state)

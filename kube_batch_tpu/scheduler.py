@@ -1,0 +1,107 @@
+"""The scheduler loop: periodic snapshot → session → actions → commit.
+
+Reference counterpart: pkg/scheduler/scheduler.go — `Scheduler{cache,
+schedulePeriod, actions, plugins}` whose `Run` starts the cache and then
+`wait.Until(runOnce, period)`; `runOnce` re-reads `--scheduler-conf`
+every cycle (hot-reloadable policy), opens a session, executes the
+configured actions in order, and closes the session.
+
+The TPU twist: policy is compiled.  Plugins register pure tensor fns
+once per *configuration*, and actions jit their solvers against those
+fns — so conf hot-reload rebuilds the policy (and pays recompilation)
+only when the file actually changes, while steady-state cycles replay
+cached XLA executables.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from kube_batch_tpu.actions import factory as _action_factory  # noqa: F401
+from kube_batch_tpu.cache.cache import SchedulerCache
+from kube_batch_tpu.framework.conf import SchedulerConf, load_conf
+from kube_batch_tpu.framework.plugin import Action, get_action
+from kube_batch_tpu.framework.session import (
+    Session,
+    build_policy,
+    close_session,
+    open_session,
+)
+from kube_batch_tpu.plugins import factory as _plugin_factory  # noqa: F401
+
+DEFAULT_SCHEDULE_PERIOD = 1.0  # ≙ scheduler.go · defaultSchedulePeriod (1s)
+
+
+class Scheduler:
+    """≙ pkg/scheduler/scheduler.go · Scheduler."""
+
+    def __init__(
+        self,
+        cache: SchedulerCache,
+        conf_path: str | None = None,
+        schedule_period: float = DEFAULT_SCHEDULE_PERIOD,
+    ) -> None:
+        self.cache = cache
+        self.conf_path = conf_path
+        self.schedule_period = schedule_period
+        self._conf: SchedulerConf | None = None
+        self._policy = None
+        self._plugins: list = []
+        self._actions: list[Action] = []
+
+    # -- configuration (hot reload) -------------------------------------
+    def _reload_conf(self) -> None:
+        """Re-read scheduler.conf; rebuild compiled policy only on change
+        (≙ scheduler.go · loadSchedulerConf every cycle)."""
+        conf = load_conf(self.conf_path)
+        if conf == self._conf:
+            return
+        # Build everything first; commit (including self._conf) only on
+        # success, so a bad conf leaves the previous policy fully intact
+        # and is retried (and re-reported) every cycle.
+        policy, plugins = build_policy(conf)
+        actions = []
+        for name in conf.actions:
+            action = get_action(name)
+            action.initialize(policy)
+            actions.append(action)
+        for action in self._actions:
+            action.uninitialize()
+        self._conf = conf
+        self._policy, self._plugins = policy, plugins
+        self._actions = actions
+
+    # -- one cycle (≙ scheduler.go · runOnce) ---------------------------
+    def run_once(self) -> Session:
+        self._reload_conf()
+        ssn = open_session(self.cache, self._policy, self._plugins)
+        for action in self._actions:
+            action.execute(ssn)
+        close_session(ssn)
+        return ssn
+
+    # -- the loop (≙ scheduler.go · Run / wait.Until) -------------------
+    def run(
+        self,
+        stop: threading.Event | None = None,
+        max_cycles: int | None = None,
+    ) -> int:
+        """Run cycles every `schedule_period` until `stop` is set or
+        `max_cycles` elapse.  Returns the number of cycles run."""
+        cycles = 0
+        while (stop is None or not stop.is_set()) and (
+            max_cycles is None or cycles < max_cycles
+        ):
+            started = time.monotonic()
+            self.run_once()
+            cycles += 1
+            if stop is None and max_cycles is None:
+                break  # nothing will ever stop us; safety for misuse
+            sleep_for = self.schedule_period - (time.monotonic() - started)
+            if sleep_for > 0 and (max_cycles is None or cycles < max_cycles):
+                if stop is not None:
+                    stop.wait(sleep_for)
+                else:
+                    time.sleep(sleep_for)
+        return cycles

@@ -1,0 +1,45 @@
+"""Scheduler loop: cycles, conf hot-reload, bad-conf resilience.
+
+Reference behaviors covered: pkg/scheduler/scheduler.go · runOnce
+re-reads --scheduler-conf every cycle; a broken conf must not take down
+the running policy.
+"""
+
+from kube_batch_tpu.models.workloads import build_config
+from kube_batch_tpu.scheduler import Scheduler
+
+
+def test_run_once_schedules_config1():
+    cache, sim = build_config(1)
+    ssn = Scheduler(cache).run_once()
+    assert len(ssn.bound) == 8
+    assert len(sim.binds) == 8
+
+
+def test_run_max_cycles_and_steady_state():
+    cache, sim = build_config(1)
+    s = Scheduler(cache, schedule_period=0.0)
+    assert s.run(max_cycles=3) == 3
+    # all pods bound in cycle 1; later cycles are no-ops
+    assert len(sim.binds) == 8
+
+
+def test_bad_conf_keeps_previous_policy(tmp_path):
+    conf = tmp_path / "scheduler.conf"
+    conf.write_text("actions: allocate\n")
+    cache, sim = build_config(1)
+    s = Scheduler(cache, conf_path=str(conf))
+    s.run_once()
+    assert len(sim.binds) == 8
+    good_actions = s._actions
+
+    # hot-swap in a conf naming an unregistered action: reload must fail
+    # without clobbering the working policy
+    conf.write_text("actions: allocate, no_such_action\n")
+    try:
+        s.run_once()
+    except KeyError:
+        pass
+    assert s._actions is good_actions
+    conf.write_text("actions: allocate\n")
+    s.run_once()  # recovers once conf is fixed
