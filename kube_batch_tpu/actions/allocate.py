@@ -27,15 +27,21 @@ from kube_batch_tpu.framework.plugin import Action, register_action
 from kube_batch_tpu.ops.assignment import allocate_rounds
 
 
-def make_allocate_solver(policy):
+def make_allocate_solver(policy, max_rounds: int | None = None):
     """(snap, state) -> state: the full two-pass allocate solve.
 
     The single definition of the pipeline — the action jits it for
     production, and bench.py / __graft_entry__.py reuse it so what they
     measure/compile-check is exactly what runs.
+
+    `max_rounds` bounds auction rounds per pass (None → number of
+    tasks, which always converges; set a smaller cap to trade scheduling
+    completeness within one cycle for bounded cycle latency — leftover
+    tasks simply stay Pending for the next cycle).
     """
 
     def solve(snap, state):
+        state = policy.setup_state(snap, state)
         pred = policy.predicate_mask(snap)
         for use_future in (False, True):
             state = allocate_rounds(
@@ -47,6 +53,7 @@ def make_allocate_solver(policy):
                 policy.eligible_fn,
                 snap.eps,
                 use_future=use_future,
+                max_rounds=max_rounds,
             )
         return state
 

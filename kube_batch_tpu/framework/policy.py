@@ -25,7 +25,49 @@ from kube_batch_tpu.api.snapshot import (
     job_ready_counts,
     job_valid_counts,
 )
-from kube_batch_tpu.ops.assignment import AllocState, rank_from_keys
+from kube_batch_tpu.ops.assignment import (
+    AllocState,
+    _segment_prefix,
+    rank_from_keys,
+)
+
+BIG_VTIME = 1e30
+
+
+def virtual_start_times(
+    seg: jax.Array,        # i32[T] segment id per task (queue or job)
+    base_rank: jax.Array,  # i32[T] within-segment service order
+    req: jax.Array,        # f32[T, R]
+    valid: jax.Array,      # bool[T] tasks contending for placement now
+    alloc_seg: jax.Array,  # f32[S, R] resources the segment already holds
+    denom_seg: jax.Array,  # f32[S, R] fair-share denominator (deserved/total)
+    num_segs: int,
+) -> jax.Array:
+    """f32[T]: weighted-fair-queueing virtual start times.
+
+    The reference reaches fairness serially: after every placement the
+    hungriest queue/job (lowest allocated/denominator share) is served
+    next.  That trajectory is exactly service in order of *virtual start
+    time* — the share the segment will have reached when this task's
+    turn comes: max over resource dims of
+        (alloc_seg + within-segment prefix of earlier tasks) / denom.
+    Ranking tasks by this key reproduces the serial interleaving inside
+    a single auction round (classic WFQ start-time scheduling), which is
+    how DRF/proportion EventHandler feedback
+    (plugins/drf/drf.go · OnSessionOpen handlers) survives batching.
+    """
+    r = jnp.where(valid[:, None], req, 0.0)
+    segk = jnp.where(valid, jnp.clip(seg, 0, num_segs - 1), num_segs)
+    perm, before = _segment_prefix(segk, base_rank, r)
+    s = jnp.clip(segk[perm], 0, num_segs - 1)
+    start = alloc_seg[s] + before                       # f32[T, R]
+    denom = denom_seg[s]
+    ratio = jnp.where(
+        denom > 0.0, start / jnp.maximum(denom, 1e-9),
+        jnp.where(start > 0.0, BIG_VTIME, 0.0),
+    )
+    svt_sorted = jnp.max(ratio, axis=-1)
+    return jnp.zeros(seg.shape[0], jnp.float32).at[perm].set(svt_sorted)
 
 # fn signatures (all pure, jit-safe)
 QueueKeyFn = Callable[[SnapshotTensors, AllocState], jax.Array]   # f32[Q]
@@ -37,6 +79,11 @@ JobBoolFn = Callable[[SnapshotTensors, AllocState], jax.Array]    # bool[J]
 QueueBoolFn = Callable[[SnapshotTensors, AllocState], jax.Array]  # bool[Q]
 # Veto fns see (snap, state, preemptor task index) → bool[T] over victims.
 VetoFn = Callable[[SnapshotTensors, AllocState, jax.Array], jax.Array]
+# Vtime fns see (snap, state, base_rank, valid) → f32[T] virtual start
+# times; they carry share-feedback ordering at per-task granularity.
+VtimeFn = Callable[
+    [SnapshotTensors, AllocState, jax.Array, jax.Array], jax.Array
+]
 
 
 def task_queue_of(snap: SnapshotTensors) -> jax.Array:
@@ -59,6 +106,9 @@ class TensorPolicy:
         self.job_ready: list[JobBoolFn] = []
         self.job_pipelined: list[JobBoolFn] = []
         self.overused: list[QueueBoolFn] = []
+        self.queue_vtime: list[list[VtimeFn]] = [[] for _ in range(num_tiers)]
+        self.job_vtime: list[list[VtimeFn]] = [[] for _ in range(num_tiers)]
+        self.cycle_setup: list[tuple[str, Callable]] = []
         self.preemptable: list[list[VetoFn]] = [[] for _ in range(num_tiers)]
         self.reclaimable: list[list[VetoFn]] = [[] for _ in range(num_tiers)]
 
@@ -90,6 +140,28 @@ class TensorPolicy:
     def add_overused_fn(self, fn: QueueBoolFn) -> None:
         self.overused.append(fn)
 
+    def add_queue_vtime_fn(self, tier: int, fn: VtimeFn) -> None:
+        self.queue_vtime[tier].append(fn)
+
+    def add_job_vtime_fn(self, tier: int, fn: VtimeFn) -> None:
+        self.job_vtime[tier].append(fn)
+
+    def add_cycle_setup_fn(self, name: str, fn) -> None:
+        """Register a snapshot-only tensor computed once per cycle and
+        carried in AllocState.aux[name] (hoists loop-invariant plugin
+        work out of the auction rounds)."""
+        self.cycle_setup.append((name, fn))
+
+    def setup_state(self, snap: SnapshotTensors, state: AllocState) -> AllocState:
+        """Populate AllocState.aux with the registered per-cycle tensors
+        (call at the top of every jitted solve)."""
+        if not self.cycle_setup:
+            return state
+        aux = dict(state.aux)
+        for name, fn in self.cycle_setup:
+            aux[name] = fn(snap)
+        return state.replace(aux=aux)
+
     def add_preemptable_fn(self, tier: int, fn: VetoFn) -> None:
         self.preemptable[tier].append(fn)
 
@@ -113,9 +185,9 @@ class TensorPolicy:
             s = s + w * fn(snap, state)
         return s
 
-    def rank_fn(self, snap: SnapshotTensors, state: AllocState) -> jax.Array:
-        """i32[T]: global scheduling-order ranks from the tiered
-        queue > job > task lexicographic ordering."""
+    def _static_keys(
+        self, snap: SnapshotTensors, state: AllocState
+    ) -> list[jax.Array]:
         tq = task_queue_of(snap)
         tj = jnp.clip(snap.task_job, 0, snap.num_jobs - 1)
         keys: list[jax.Array] = [snap.task_order.astype(jnp.float32)]
@@ -130,6 +202,35 @@ class TensorPolicy:
         for tier_fns in reversed(self.queue_order):
             for fn in reversed(tier_fns):
                 keys.append(fn(snap, state)[tq])
+        return keys
+
+    def rank_fn(self, snap: SnapshotTensors, state: AllocState) -> jax.Array:
+        """i32[T]: global scheduling-order ranks from the tiered
+        queue > job > task lexicographic ordering.
+
+        When vtime fns are registered (drf/proportion), their
+        virtual-start-time keys are layered in at their level — job
+        vtimes above static job keys of the same tier, queue vtimes
+        above everything — so the rank order reproduces the reference's
+        one-pod-at-a-time share-feedback interleaving."""
+        keys = self._static_keys(snap, state)
+        has_vtime = any(map(len, self.queue_vtime)) or any(
+            map(len, self.job_vtime)
+        )
+        if has_vtime:
+            from kube_batch_tpu.api.types import TaskStatus
+
+            base = rank_from_keys(keys, snap.num_tasks)
+            pending = (
+                state.task_state == int(TaskStatus.PENDING)
+            ) & snap.task_mask
+            valid = pending & self.eligible_fn(snap, state)
+            for tier_fns in reversed(self.job_vtime):
+                for fn in reversed(tier_fns):
+                    keys.append(fn(snap, state, base, valid))
+            for tier_fns in reversed(self.queue_vtime):
+                for fn in reversed(tier_fns):
+                    keys.append(fn(snap, state, base, valid))
         return rank_from_keys(keys, snap.num_tasks)
 
     def job_rank(self, snap: SnapshotTensors, state: AllocState) -> jax.Array:

@@ -38,6 +38,7 @@ of ALLOCATED and consume no Idle (≙ ssn.Pipeline).
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Callable
 
 import jax
@@ -71,12 +72,18 @@ class AllocState:
 
     `node_future` shadows FutureIdle (idle + releasing − pipelined
     placements); pipelined tasks consume it without touching `node_idle`.
+
+    `aux` carries plugin tensors that are fixed for the whole cycle
+    (e.g. proportion's water-filled `deserved`), computed once by
+    `TensorPolicy.setup_state` instead of every auction round — XLA
+    cannot hoist a fori_loop out of the round while_loop by itself.
     """
 
     task_state: jax.Array   # i32[T]
     task_node: jax.Array    # i32[T]
     node_idle: jax.Array    # f32[N, R]
     node_future: jax.Array  # f32[N, R]
+    aux: dict[str, jax.Array] = dataclasses.field(default_factory=dict)
 
 
 def init_state(snap: SnapshotTensors) -> AllocState:
@@ -109,6 +116,25 @@ def rank_from_keys(keys: list[jax.Array], num: int) -> jax.Array:
     return jnp.zeros(num, jnp.int32).at[perm].set(jnp.arange(num, dtype=jnp.int32))
 
 
+def _segment_prefix(
+    seg: jax.Array,       # i32[T] sorted-major segment key (num_segs = sentinel)
+    rank: jax.Array,      # i32[T] sort-minor key
+    req: jax.Array,       # f32[T, R] (zeroed where inactive)
+) -> tuple[jax.Array, jax.Array]:
+    """Sort by (seg, rank); return (perm, before) where before[i] is the
+    running request total of *earlier-ranked same-segment* rows, in
+    sorted order."""
+    T = seg.shape[0]
+    perm = jnp.lexsort((rank, seg))
+    s_seg = seg[perm]
+    s_req = req[perm]
+    incl = jnp.cumsum(s_req, axis=0)
+    is_start = jnp.concatenate([jnp.ones((1,), bool), s_seg[1:] != s_seg[:-1]])
+    start_idx = lax.cummax(jnp.where(is_start, jnp.arange(T, dtype=jnp.int32), 0))
+    before = incl - (incl[start_idx] - s_req[start_idx])  # inclusive-of-self
+    return perm, before - s_req                            # exclusive-of-self
+
+
 def _resolve_conflicts(
     prop_node: jax.Array,   # i32[T] proposed node (undefined where ~active)
     active: jax.Array,      # bool[T]
@@ -119,27 +145,23 @@ def _resolve_conflicts(
 ) -> jax.Array:
     """bool[T]: which proposals are accepted this round.
 
-    Sort by (node, rank), per-node running prefix-sum of requests, accept
-    while the prefix fits the node's available capacity.
+    Per-node segmented prefix check over the rank order: within each
+    node, accept the best-ranked prefix whose cumulative request fits
+    the available capacity.  Fairness lives in `rank` itself — the
+    policy's virtual-start-time keys interleave queues/jobs exactly as
+    the reference's share-feedback loop would (see
+    framework/policy.py · virtual_start_times).
     """
     T = prop_node.shape[0]
     N = avail.shape[0]
+
     node_key = jnp.where(active, prop_node, N)           # inactive sort last
-    perm = jnp.lexsort((rank, node_key))                 # primary: node, then rank
-    s_node = node_key[perm]
+    perm, before_n = _segment_prefix(
+        node_key, rank, jnp.where(active[:, None], task_req, 0.0)
+    )
     s_req = jnp.where(active[perm, None], task_req[perm], 0.0)
-
-    incl = jnp.cumsum(s_req, axis=0)                     # f32[T, R]
-    is_start = jnp.concatenate(
-        [jnp.ones((1,), bool), s_node[1:] != s_node[:-1]]
-    )
-    start_idx = lax.cummax(
-        jnp.where(is_start, jnp.arange(T, dtype=jnp.int32), 0)
-    )
-    before_segment = incl[start_idx] - s_req[start_idx]  # exclusive at seg start
-    within = incl - before_segment                       # running usage on node
-
-    node_avail = avail[jnp.clip(s_node, 0, N - 1)]       # f32[T, R]
+    within = before_n + s_req                            # running usage on node
+    node_avail = avail[jnp.clip(node_key[perm], 0, N - 1)]
     # NOT fits(): the LessEqual slack must apply to the task's OWN request
     # (negligible ask always fits), never to the cumulative prefix.
     fits_prefix = jnp.all((within <= node_avail) | (s_req < eps), axis=-1)
@@ -224,7 +246,7 @@ def allocate_rounds(
         node_future = st.node_future - delta
         node_idle = st.node_idle - jnp.where(use_future, 0.0, 1.0) * delta
 
-        new_st = AllocState(
+        new_st = st.replace(
             task_state=task_state,
             task_node=task_node,
             node_idle=node_idle,

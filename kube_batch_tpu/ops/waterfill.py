@@ -1,0 +1,52 @@
+"""Weighted water-filling of cluster capacity into queue `deserved`.
+
+Reference counterpart: plugins/proportion/proportion.go — iterative
+redistribution of the cluster total among queues proportional to weight,
+with each queue clamped at its own total request and its surplus
+redistributed to still-unsatisfied queues.
+
+TPU-native shape: the whole fixed point runs as a `lax.fori_loop` over
+[Q, R] tensors, one resource-independent water level per dimension
+(the reference clamps on the whole resource vector at once; per-dim
+filling distributes surplus per dimension, which is at least as fair
+per-resource and is the natural dense-tensor formulation).  Q+1
+iterations always suffice: every iteration either clamps ≥1 queue-dim
+or distributes all remaining capacity.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def waterfill_deserved(
+    weights: jax.Array,     # f32[Q]
+    request: jax.Array,     # f32[Q, R]  total request per queue
+    total: jax.Array,       # f32[R]     cluster capacity
+    queue_mask: jax.Array,  # bool[Q]
+) -> jax.Array:
+    """f32[Q, R]: each queue's deserved share of the cluster."""
+    Q = weights.shape[0]
+    request = jnp.where(queue_mask[:, None], request, 0.0)
+
+    def body(_, carry):
+        deserved, remaining, unsat = carry
+        w = jnp.where(unsat, weights[:, None], 0.0)          # f32[Q, R]
+        wsum = w.sum(axis=0)                                  # f32[R]
+        inc = jnp.where(
+            wsum > 0.0, remaining[None, :] * w / jnp.maximum(wsum, 1e-9), 0.0
+        )
+        filled = deserved + inc
+        hit = filled >= request
+        filled = jnp.minimum(filled, request)
+        spent = (filled - deserved).sum(axis=0)
+        return filled, jnp.maximum(remaining - spent, 0.0), unsat & ~hit
+
+    deserved0 = jnp.zeros_like(request)
+    unsat0 = queue_mask[:, None] & jnp.ones_like(request, dtype=bool)
+    deserved, _, _ = lax.fori_loop(
+        0, Q + 1, body, (deserved0, total.astype(request.dtype), unsat0)
+    )
+    return deserved

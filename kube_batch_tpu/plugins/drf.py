@@ -1,0 +1,94 @@
+"""DRF plugin: Dominant Resource Fairness job ordering + preemption.
+
+Reference counterpart: plugins/drf/drf.go —
+* per-job share = max over resources of allocated_r / clusterTotal_r;
+* JobOrderFn: lower dominant share scheduled first;
+* PreemptableFn: a victim is allowed only if its job's share after the
+  eviction stays ≥ the preemptor job's share — preemption may narrow
+  the dominance gap but never invert it.
+
+The reference maintains shares incrementally via Allocate/Deallocate
+EventHandlers; here shares are pure reductions over the live AllocState,
+recomputed wherever consulted (each auction round, each veto sweep), so
+the in-cycle feedback loop the reference gets from handlers falls out
+of referential transparency.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from kube_batch_tpu.api.snapshot import (
+    SnapshotTensors,
+    allocated_mask,
+    status_is,
+    sum_req_per_job,
+)
+from kube_batch_tpu.api.types import TaskStatus
+from kube_batch_tpu.framework.plugin import Plugin, register_plugin
+from kube_batch_tpu.ops.assignment import AllocState
+
+
+def job_allocated(snap: SnapshotTensors, state: AllocState) -> jax.Array:
+    """f32[J, R]: resources currently held by each job's tasks
+    (pipelined placements count — the reference fires the same allocate
+    EventHandlers for ssn.Pipeline)."""
+    held = allocated_mask(state.task_state) | status_is(
+        state.task_state, TaskStatus.PIPELINED
+    )
+    return sum_req_per_job(snap, held)
+
+
+def share_of(alloc: jax.Array, total: jax.Array) -> jax.Array:
+    """Dominant share: max over resource dims of alloc/total."""
+    return jnp.max(alloc / jnp.maximum(total, 1e-9), axis=-1)
+
+
+def job_share(snap: SnapshotTensors, state: AllocState) -> jax.Array:
+    """f32[J]: dominant share (drf.go · calculateShare)."""
+    return share_of(job_allocated(snap, state), snap.cluster_total)
+
+
+@register_plugin
+class DrfPlugin(Plugin):
+    name = "drf"
+
+    def register(self, policy, tier: int) -> None:
+        def job_order(snap, state):
+            return job_share(snap, state)
+
+        def preemptable(snap, state, preemptor):
+            alloc = job_allocated(snap, state)                    # f32[J, R]
+            total = snap.cluster_total
+            pj = jnp.clip(snap.task_job[preemptor], 0, snap.num_jobs - 1)
+            preemptor_share = share_of(alloc[pj], total)          # f32[]
+            tj = jnp.clip(snap.task_job, 0, snap.num_jobs - 1)
+            victim_after = alloc[tj] - snap.task_req              # f32[T, R]
+            victim_share_after = share_of(victim_after, total)    # f32[T]
+            return (victim_share_after >= preemptor_share) | (snap.task_job < 0)
+
+        def job_vtime(snap, state, base_rank, valid):
+            """Per-task virtual start times in dominant-share space —
+            the WFQ embedding of drf.go's per-placement share feedback."""
+            from kube_batch_tpu.framework.policy import virtual_start_times
+
+            total = jnp.broadcast_to(
+                jnp.maximum(snap.cluster_total, 1e-9)[None, :],
+                (snap.num_jobs, snap.num_resources),
+            )
+            return virtual_start_times(
+                snap.task_job,
+                base_rank,
+                snap.task_req,
+                valid,
+                job_allocated(snap, state),
+                total,
+                snap.num_jobs,
+            )
+
+        if self.enabled_for("jobOrder"):
+            policy.add_job_order_fn(tier, job_order)
+            policy.add_job_vtime_fn(tier, job_vtime)
+        if self.enabled_for("preemptable"):
+            policy.add_preemptable_fn(tier, preemptable)

@@ -1,6 +1,6 @@
 """Plugin factory: importing it registers every built-in plugin
 (≙ plugins/factory.go)."""
 
-from kube_batch_tpu.plugins import gang, priority  # noqa: F401
+from kube_batch_tpu.plugins import drf, gang, priority, proportion  # noqa: F401
 
-BUILTIN_PLUGINS = ["gang", "priority"]
+BUILTIN_PLUGINS = ["drf", "gang", "priority", "proportion"]

@@ -1,0 +1,146 @@
+"""Proportion plugin: weighted fair queue shares.
+
+Reference counterpart: plugins/proportion/proportion.go —
+* per-queue `deserved` via weighted water-filling of the cluster total,
+  clamped by the queue's own request (ops/waterfill.py);
+* QueueOrderFn: share = allocated/deserved, lower share served first;
+* OverusedFn: a queue at or above its deserved gets no more allocations;
+* ReclaimableFn: a queue only gives up victims while it stays at or
+  above deserved after the eviction (reclaim only taxes surplus).
+
+The reference keeps these up to date with EventHandlers firing after
+every allocation; here every fn recomputes from the live `AllocState`,
+so in-round feedback is automatic.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from kube_batch_tpu.api.snapshot import (
+    SnapshotTensors,
+    allocated_mask,
+    status_is,
+)
+from kube_batch_tpu.api.types import TaskStatus
+from kube_batch_tpu.framework.plugin import Plugin, register_plugin
+from kube_batch_tpu.framework.policy import task_queue_of
+from kube_batch_tpu.ops.assignment import AllocState
+from kube_batch_tpu.ops.waterfill import waterfill_deserved
+
+BIG_SHARE = 1e9
+
+
+def queue_allocated(snap: SnapshotTensors, state: AllocState) -> jax.Array:
+    """f32[Q, R]: requests currently held per queue (live, in-cycle).
+
+    Pipelined placements count: the reference fires the same allocate
+    EventHandlers for ssn.Pipeline, so shares move for them too.
+    """
+    tq = task_queue_of(snap)
+    held = (
+        allocated_mask(state.task_state)
+        | status_is(state.task_state, TaskStatus.PIPELINED)
+    ) & snap.task_mask & (snap.task_job >= 0)
+    seg = jnp.where(held, tq, snap.num_queues)
+    return jax.ops.segment_sum(
+        jnp.where(held[:, None], snap.task_req, 0.0),
+        seg,
+        num_segments=snap.num_queues + 1,
+    )[: snap.num_queues]
+
+
+def queue_request(snap: SnapshotTensors) -> jax.Array:
+    """f32[Q, R]: total request of every task in the queue's jobs
+    (≙ proportion.go summing JobInfo.TotalRequest per queue)."""
+    tq = task_queue_of(snap)
+    valid = snap.task_mask & (snap.task_job >= 0)
+    seg = jnp.where(valid, tq, snap.num_queues)
+    return jax.ops.segment_sum(
+        jnp.where(valid[:, None], snap.task_req, 0.0),
+        seg,
+        num_segments=snap.num_queues + 1,
+    )[: snap.num_queues]
+
+
+DESERVED_AUX = "proportion/deserved"
+
+
+def queue_deserved(snap: SnapshotTensors) -> jax.Array:
+    """f32[Q, R] water-filled deserved (state-independent within a cycle)."""
+    return waterfill_deserved(
+        snap.queue_weight, queue_request(snap), snap.cluster_total, snap.queue_mask
+    )
+
+
+def _deserved(snap: SnapshotTensors, state: AllocState) -> jax.Array:
+    """Per-cycle cached deserved when the solver ran setup_state; fresh
+    computation otherwise (host-side callers like dispatch gating)."""
+    cached = state.aux.get(DESERVED_AUX)
+    return cached if cached is not None else queue_deserved(snap)
+
+
+def queue_share(snap: SnapshotTensors, state: AllocState) -> jax.Array:
+    """f32[Q]: max-dimension allocated/deserved ratio (lower = hungrier)."""
+    alloc = queue_allocated(snap, state)
+    deserved = _deserved(snap, state)
+    ratio = jnp.where(
+        deserved > 0.0, alloc / jnp.maximum(deserved, 1e-9),
+        jnp.where(alloc > 0.0, BIG_SHARE, 0.0),
+    )
+    return jnp.max(ratio, axis=1)
+
+
+@register_plugin
+class ProportionPlugin(Plugin):
+    name = "proportion"
+
+    def register(self, policy, tier: int) -> None:
+        def queue_order(snap, state):
+            return queue_share(snap, state)
+
+        def overused(snap, state):
+            # deserved ⊑ allocated (all meaningful dims) → no more for you
+            alloc = queue_allocated(snap, state)
+            deserved = _deserved(snap, state)
+            return jnp.all(
+                (deserved <= alloc) | (deserved < snap.eps[None, :]), axis=1
+            ) & snap.queue_mask
+
+        def reclaimable(snap, state, preemptor):  # noqa: ARG001
+            # victim allowed only if its queue stays ≥ deserved afterwards
+            alloc = queue_allocated(snap, state)
+            deserved = _deserved(snap, state)
+            tq = task_queue_of(snap)
+            after = alloc[tq] - snap.task_req
+            ok = jnp.all(
+                (deserved[tq] <= after) | (deserved[tq] < snap.eps[None, :]),
+                axis=1,
+            )
+            return ok | (snap.task_job < 0)
+
+        def queue_vtime(snap, state, base_rank, valid):
+            """Per-task virtual start times in allocated/deserved share
+            space — the WFQ embedding of the reference's queue-share
+            feedback (see framework/policy.py · virtual_start_times)."""
+            from kube_batch_tpu.framework.policy import virtual_start_times
+
+            return virtual_start_times(
+                task_queue_of(snap),
+                base_rank,
+                snap.task_req,
+                valid,
+                queue_allocated(snap, state),
+                _deserved(snap, state),
+                snap.num_queues,
+            )
+
+        policy.add_cycle_setup_fn(DESERVED_AUX, queue_deserved)
+        if self.enabled_for("queueOrder"):
+            policy.add_queue_order_fn(tier, queue_order)
+            policy.add_queue_vtime_fn(tier, queue_vtime)
+        if self.enabled_for("overused"):
+            policy.add_overused_fn(overused)
+        if self.enabled_for("reclaimable"):
+            policy.add_reclaimable_fn(tier, reclaimable)

@@ -15,6 +15,7 @@ cached XLA executables.
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 
@@ -54,18 +55,30 @@ class Scheduler:
     def _reload_conf(self) -> None:
         """Re-read scheduler.conf; rebuild compiled policy only on change
         (≙ scheduler.go · loadSchedulerConf every cycle)."""
-        conf = load_conf(self.conf_path)
+        try:
+            conf = load_conf(self.conf_path)
+        except Exception as exc:  # noqa: BLE001 — malformed YAML mid-edit
+            if self._conf is None:
+                raise
+            logging.warning("scheduler.conf unreadable, keeping policy: %s", exc)
+            return
         if conf == self._conf:
             return
         # Build everything first; commit (including self._conf) only on
         # success, so a bad conf leaves the previous policy fully intact
         # and is retried (and re-reported) every cycle.
-        policy, plugins = build_policy(conf)
-        actions = []
-        for name in conf.actions:
-            action = get_action(name)
-            action.initialize(policy)
-            actions.append(action)
+        try:
+            policy, plugins = build_policy(conf)
+            actions = []
+            for name in conf.actions:
+                action = get_action(name)
+                action.initialize(policy)
+                actions.append(action)
+        except Exception as exc:  # noqa: BLE001 — e.g. unknown plugin/action
+            if self._conf is None:
+                raise  # first load must be valid; nothing to fall back to
+            logging.warning("scheduler.conf rejected, keeping policy: %s", exc)
+            return
         for action in self._actions:
             action.uninitialize()
         self._conf = conf
@@ -88,16 +101,21 @@ class Scheduler:
         max_cycles: int | None = None,
     ) -> int:
         """Run cycles every `schedule_period` until `stop` is set or
-        `max_cycles` elapse.  Returns the number of cycles run."""
+        `max_cycles` elapse (both None → run forever, ≙ wait.Until).
+        A failing cycle is logged and the loop keeps going, like the
+        reference daemon.  Returns the number of cycles run."""
         cycles = 0
         while (stop is None or not stop.is_set()) and (
             max_cycles is None or cycles < max_cycles
         ):
             started = time.monotonic()
-            self.run_once()
+            try:
+                self.run_once()
+            except Exception:  # noqa: BLE001
+                if self._conf is None:
+                    raise  # never successfully configured: fail loud
+                logging.exception("scheduling cycle failed; continuing")
             cycles += 1
-            if stop is None and max_cycles is None:
-                break  # nothing will ever stop us; safety for misuse
             sleep_for = self.schedule_period - (time.monotonic() - started)
             if sleep_for > 0 and (max_cycles is None or cycles < max_cycles):
                 if stop is not None:

@@ -1,0 +1,101 @@
+"""DRF + proportion plugin tests.
+
+Reference behaviors covered (plugins/drf/drf.go, plugins/proportion/
+proportion.go): weighted fair split under scarcity, water-filled
+deserved with request clamping + surplus redistribution, DRF job order
+(lower dominant share first).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+from kube_batch_tpu.api.resource import ResourceSpec
+from kube_batch_tpu.api.types import TaskStatus
+from kube_batch_tpu.cache.cluster import Node, Pod, PodGroup, Queue
+from kube_batch_tpu.models.workloads import GI, config2_drf_proportion
+from kube_batch_tpu.ops.waterfill import waterfill_deserved
+from kube_batch_tpu.scheduler import Scheduler
+from kube_batch_tpu.sim.simulator import make_world
+
+SPEC = ResourceSpec(("cpu", "memory", "pods"))
+
+
+def test_waterfill_proportional_split():
+    """Both queues want everything → deserved splits by weight 3:1."""
+    weights = jnp.array([3.0, 1.0])
+    request = jnp.array([[8000.0], [8000.0]])
+    total = jnp.array([4000.0])
+    d = np.asarray(waterfill_deserved(weights, request, total,
+                                      jnp.array([True, True])))
+    np.testing.assert_allclose(d[:, 0], [3000.0, 1000.0], rtol=1e-5)
+
+
+def test_waterfill_clamp_and_redistribute():
+    """A queue's surplus above its own request flows to the other."""
+    weights = jnp.array([3.0, 1.0])
+    request = jnp.array([[500.0], [8000.0]])
+    total = jnp.array([4000.0])
+    d = np.asarray(waterfill_deserved(weights, request, total,
+                                      jnp.array([True, True])))
+    np.testing.assert_allclose(d[:, 0], [500.0, 3500.0], rtol=1e-5)
+
+
+def _scarcity_world():
+    """One 4-slot node; gold (w=3) and silver (w=1) each submit 4 tasks."""
+    cache, sim = make_world(SPEC)
+    sim.add_queue(Queue(name="gold", weight=3.0))
+    sim.add_queue(Queue(name="silver", weight=1.0))
+    sim.add_node(Node(name="n0", allocatable={"cpu": 4000, "memory": 64 * GI,
+                                              "pods": 110}))
+    for qname in ("gold", "silver"):
+        pg = PodGroup(name=f"{qname}-job", queue=qname, min_member=1)
+        sim.submit(pg, [
+            Pod(name=f"{qname}-{i}", request={"cpu": 1000, "memory": 1 * GI,
+                                              "pods": 1})
+            for i in range(4)
+        ])
+    return cache, sim
+
+
+def test_proportion_weighted_split_under_scarcity():
+    """Capacity 4 slots, weights 3:1 → gold gets 3, silver gets 1
+    (the serial reference's share-feedback trajectory end state)."""
+    cache, sim = _scarcity_world()
+    Scheduler(cache).run_once()
+    gold = [p for p, _ in sim.binds if p.startswith("gold")]
+    silver = [p for p, _ in sim.binds if p.startswith("silver")]
+    assert len(gold) == 3, sim.binds
+    assert len(silver) == 1, sim.binds
+
+
+def test_proportion_no_starvation_when_capacity_ample():
+    """Budgets must be inert when everything fits (config 2)."""
+    cache, sim = config2_drf_proportion(SPEC.__class__(("cpu", "memory",
+                                                        "pods", "accelerator")))
+    Scheduler(cache).run_once()
+    assert len(sim.binds) == 100, len(sim.binds)
+
+
+def test_drf_lower_share_first():
+    """Job A holds resources already; job B (share 0) gets the free slots."""
+    cache, sim = make_world(SPEC)
+    sim.add_node(Node(name="n0", allocatable={"cpu": 4000, "memory": 64 * GI,
+                                              "pods": 110}))
+    # job A: 2 running + 2 pending
+    pga = PodGroup(name="a", queue="default", min_member=1)
+    running = [Pod(name=f"a-run-{i}", request={"cpu": 1000, "memory": 1 * GI,
+                                               "pods": 1},
+                   status=TaskStatus.RUNNING, node="n0") for i in range(2)]
+    pending_a = [Pod(name=f"a-pend-{i}", request={"cpu": 1000,
+                                                  "memory": 1 * GI, "pods": 1})
+                 for i in range(2)]
+    sim.submit(pga, running + pending_a)
+    # job B: 2 pending, zero share
+    pgb = PodGroup(name="b", queue="default", min_member=1)
+    pending_b = [Pod(name=f"b-{i}", request={"cpu": 1000, "memory": 1 * GI,
+                                             "pods": 1}) for i in range(2)]
+    sim.submit(pgb, pending_b)
+
+    Scheduler(cache).run_once()
+    bound = {p for p, _ in sim.binds}
+    assert bound == {"b-0", "b-1"}, sim.binds
