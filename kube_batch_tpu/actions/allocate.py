@@ -54,6 +54,7 @@ def make_allocate_solver(policy, max_rounds: int | None = None):
                 snap.eps,
                 use_future=use_future,
                 max_rounds=max_rounds,
+                score_quantum=policy.score_quantum,
             )
         return state
 

@@ -58,8 +58,10 @@ class SnapshotTensors:
     task_order: jax.Array      # i32[T]     creation-order tiebreak (stable)
     task_mask: jax.Array       # bool[T]    valid (non-padding) row
     task_sel: jax.Array        # f32[T, L]  required node-label selector, multi-hot
+    task_pref: jax.Array       # f32[T, L]  preferred node labels, weighted multi-hot
     task_tol: jax.Array        # f32[T, V]  tolerated taints, multi-hot
     task_ports: jax.Array      # f32[T, P]  requested host ports, multi-hot
+    task_critical: jax.Array   # bool[T]    conformance-protected (critical) pod
 
     # -- jobs -----------------------------------------------------------
     job_queue: jax.Array       # i32[J]     owning queue index
@@ -75,6 +77,7 @@ class SnapshotTensors:
     node_labels: jax.Array     # f32[N, L]  node labels, multi-hot
     node_taints: jax.Array     # f32[N, V]  NoSchedule/NoExecute taints, multi-hot
     node_ports: jax.Array      # f32[N, P]  occupied host ports, multi-hot
+    node_ready: jax.Array      # bool[N]    node Ready condition / schedulable
     node_mask: jax.Array       # bool[N]
 
     # -- queues ---------------------------------------------------------

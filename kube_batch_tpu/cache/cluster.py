@@ -25,6 +25,10 @@ from kube_batch_tpu.api.types import PodGroupPhase, TaskStatus
 
 _uid_counter = itertools.count()
 
+# Resolved value of the system-cluster-critical / system-node-critical
+# priority classes (the k8s constant the conformance plugin keys on).
+SYSTEM_CRITICAL_PRIORITY = 2_000_000_000
+
 
 def _new_uid(prefix: str) -> str:
     return f"{prefix}-{next(_uid_counter):08d}"
@@ -42,13 +46,38 @@ class Pod:
     group: str | None = None           # PodGroup name; None → unmanaged ("Others")
     request: Mapping[str, float] = dataclasses.field(default_factory=dict)
     priority: int = 0
+    namespace: str = "default"
     selector: Mapping[str, str] = dataclasses.field(default_factory=dict)
+    # Preferred (soft) node labels with weights — the analog of
+    # preferredDuringScheduling node-affinity terms consumed by the
+    # nodeorder plugin's NodeAffinityPriority score.  Keys are full
+    # "key=value" label strings (validated in __post_init__), matching
+    # how node labels are interned.
+    preferences: Mapping[str, float] = dataclasses.field(default_factory=dict)
     tolerations: frozenset[str] = frozenset()
     ports: frozenset[int] = frozenset()
     status: TaskStatus = TaskStatus.PENDING
     node: str | None = None            # assigned node name, if any
     uid: str = dataclasses.field(default_factory=lambda: _new_uid("pod"))
     creation: int = dataclasses.field(default_factory=lambda: next(_uid_counter))
+
+    def __post_init__(self) -> None:
+        bad = [k for k in self.preferences if "=" not in k]
+        if bad:
+            raise ValueError(
+                f"pod {self.name}: preference keys must be 'key=value' label "
+                f"strings (got {bad!r}); selector-style bare keys never match"
+            )
+
+    @property
+    def critical(self) -> bool:
+        """Cluster-critical pod the conformance plugin refuses to evict
+        (≙ plugins/conformance/conformance.go: kube-system namespace or
+        system-cluster-critical / system-node-critical priority class)."""
+        return (
+            self.namespace == "kube-system"
+            or self.priority >= SYSTEM_CRITICAL_PRIORITY
+        )
 
     @property
     def best_effort(self) -> bool:

@@ -82,6 +82,7 @@ def pack_snapshot(host: HostSnapshot) -> tuple[SnapshotTensors, SnapshotMeta]:
     ports: set[int] = set()
     for pod in tasks:
         labels.update(f"{k}={v}" for k, v in pod.selector.items())
+        labels.update(pod.preferences)
         taints.update(pod.tolerations)
         ports.update(pod.ports)
     node_resident_ports: dict[str, set[int]] = {}
@@ -120,8 +121,13 @@ def pack_snapshot(host: HostSnapshot) -> tuple[SnapshotTensors, SnapshotMeta]:
     task_sel = _multi_hot(
         [[lab_idx[f"{k}={v}"] for k, v in p.selector.items()] for p in tasks], T, L
     )
+    task_pref = np.zeros((T, L), dtype=np.float32)
+    for i, p in enumerate(tasks):
+        for lab, w in p.preferences.items():
+            task_pref[i, lab_idx[lab]] = w
     task_tol = _multi_hot([[tnt_idx[t] for t in p.tolerations] for p in tasks], T, V)
     task_ports = _multi_hot([[prt_idx[pt] for pt in p.ports] for p in tasks], T, P)
+    task_critical = np.array([p.critical for p in tasks], dtype=bool)
 
     # -- job tensors ----------------------------------------------------
     job_queue = np.array(
@@ -174,8 +180,10 @@ def pack_snapshot(host: HostSnapshot) -> tuple[SnapshotTensors, SnapshotMeta]:
         task_order=jnp.asarray(pad_rows(task_order, Tp)),
         task_mask=jnp.asarray(pad_rows(np.ones(T, bool), Tp, False)),
         task_sel=jnp.asarray(pad_rows(task_sel, Tp)),
+        task_pref=jnp.asarray(pad_rows(task_pref, Tp)),
         task_tol=jnp.asarray(pad_rows(task_tol, Tp)),
         task_ports=jnp.asarray(pad_rows(task_ports, Tp)),
+        task_critical=jnp.asarray(pad_rows(task_critical, Tp, False)),
         job_queue=jnp.asarray(pad_rows(job_queue, Jp, NONE_IDX)),
         job_min=jnp.asarray(pad_rows(job_min, Jp)),
         job_prio=jnp.asarray(pad_rows(job_prio, Jp)),
@@ -187,6 +195,15 @@ def pack_snapshot(host: HostSnapshot) -> tuple[SnapshotTensors, SnapshotMeta]:
         node_labels=jnp.asarray(pad_rows(node_labels, Np)),
         node_taints=jnp.asarray(pad_rows(node_taints, Np)),
         node_ports=jnp.asarray(pad_rows(node_ports, Np)),
+        node_ready=jnp.asarray(
+            pad_rows(
+                np.array(
+                    [host.nodes[n].node.ready for n in node_names], dtype=bool
+                ),
+                Np,
+                False,
+            )
+        ),
         node_mask=jnp.asarray(pad_rows(np.ones(N, bool), Np, False)),
         queue_weight=jnp.asarray(pad_rows(queue_weight, Qp)),
         queue_mask=jnp.asarray(pad_rows(np.ones(Q, bool), Qp, False)),

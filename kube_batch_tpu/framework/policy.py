@@ -58,7 +58,7 @@ def virtual_start_times(
     """
     r = jnp.where(valid[:, None], req, 0.0)
     segk = jnp.where(valid, jnp.clip(seg, 0, num_segs - 1), num_segs)
-    perm, before = _segment_prefix(segk, base_rank, r)
+    perm, before, _ = _segment_prefix(segk, base_rank, r)
     s = jnp.clip(segk[perm], 0, num_segs - 1)
     start = alloc_seg[s] + before                       # f32[T, R]
     denom = denom_seg[s]
@@ -111,6 +111,11 @@ class TensorPolicy:
         self.cycle_setup: list[tuple[str, Callable]] = []
         self.preemptable: list[list[VetoFn]] = [[] for _ in range(num_tiers)]
         self.reclaimable: list[list[VetoFn]] = [[] for _ in range(num_tiers)]
+        self._dynamic_scores = False
+        # Score grid for the allocate auction (see ops/assignment.py ·
+        # allocate_rounds score_quantum).  Set when state-dependent
+        # scores register; plugins may override via their Arguments.
+        self.score_quantum = 0.0
 
     # -- registration (≙ session_plugins.go Add*Fn) ---------------------
     def add_queue_order_fn(self, tier: int, fn: QueueKeyFn) -> None:
@@ -125,8 +130,24 @@ class TensorPolicy:
     def add_predicate_fn(self, fn: PredicateFn) -> None:
         self.predicates.append(fn)
 
-    def add_node_order_fn(self, weight: float, fn: NodeScoreFn) -> None:
+    def add_node_order_fn(
+        self, weight: float, fn: NodeScoreFn, state_dependent: bool = True
+    ) -> None:
+        """`state_dependent` marks scores that read the live AllocState
+        (least-requested etc.).  Their presence turns on score
+        quantization in allocate: the serial reference re-scores after
+        every placement; the auction approximates that by flooring
+        scores to a grid so near-equal nodes tie and spread, with
+        divergence bounded by the quantum (see allocate_rounds)."""
         self.node_scores.append((weight, fn))
+        if state_dependent:
+            self._dynamic_scores = True
+            if self.score_quantum == 0.0:
+                self.score_quantum = 0.5
+
+    @property
+    def has_dynamic_scores(self) -> bool:
+        return self._dynamic_scores
 
     def add_job_valid_fn(self, fn: JobBoolFn) -> None:
         self.job_valid.append(fn)

@@ -24,9 +24,9 @@ task was rejected (the hungry task gets first pick of updated capacities
 next round).  The globally best active task always wins its proposal
 (it fits its proposed node alone and is rank-first there), so ≥1 task
 is accepted per round and the loop provably terminates within T rounds
-— the default bound.  In the common case (scores or the per-pair
-tie-break spreading proposals, capacity > 1 per node) convergence is a
-handful of rounds.  DRF/proportion feedback (shares shifting as
+— the default bound.  In the common case (round-robin tie dealing
+spreading proposals, capacity > 1 per node) convergence is a handful
+of rounds.  DRF/proportion feedback (shares shifting as
 allocations land) enters through `score_fn`/`rank_fn`, which are
 re-evaluated every round from the live `AllocState` — the tensor analog
 of the reference's EventHandler share updates.
@@ -52,17 +52,32 @@ from kube_batch_tpu.api.types import TaskStatus
 NEG_INF = -1e30
 
 
-def _tie_hash(T: int, N: int) -> jax.Array:
-    """f32[T, N] in [0, 1): deterministic per-(task, node) tie-break.
+def _round_robin_proposals(
+    tied: jax.Array,    # bool[T, N] nodes sharing this task's max score
+    active: jax.Array,  # bool[T]
+    rank: jax.Array,    # i32[T] global scheduling order
+) -> jax.Array:
+    """i32[T]: each task's proposed node — the (r mod k)-th of its k
+    score-tied best nodes, where r is the task's dense rank among active
+    proposers.
 
-    Knuth multiplicative hashing on the pair index — cheap, stateless,
-    and stable across rounds so a task re-proposes consistently.
+    This reproduces the serial reference's tie behavior: when m equal
+    tasks see the same m-way score tie (the classic empty-cluster
+    stampede), consecutive ranks pick consecutive tied nodes, so one
+    round spreads them exactly as m serial placements would — instead of
+    stampeding node 0 (or colliding at random as jittered ties do).
     """
-    i = jnp.arange(T, dtype=jnp.uint32)[:, None]
-    j = jnp.arange(N, dtype=jnp.uint32)[None, :]
-    h = (i * jnp.uint32(2654435761) + j * jnp.uint32(2246822519)) ^ (i >> 7)
-    h = (h ^ (h >> 15)) * jnp.uint32(2246822519)
-    return (h & jnp.uint32(0xFFFF)).astype(jnp.float32) / 65536.0
+    T = tied.shape[0]
+    big = jnp.iinfo(jnp.int32).max
+    order = jnp.argsort(jnp.where(active, rank, big))
+    active_rank = (
+        jnp.zeros(T, jnp.int32).at[order].set(jnp.arange(T, dtype=jnp.int32))
+    )
+    cnt = jnp.sum(tied, axis=1).astype(jnp.int32)          # i32[T]
+    k = active_rank % jnp.maximum(cnt, 1)                  # i32[T]
+    ordinal = jnp.cumsum(tied.astype(jnp.int32), axis=1)   # i32[T, N], 1-based
+    pick = tied & (ordinal == (k + 1)[:, None])
+    return jnp.argmax(pick, axis=1).astype(jnp.int32)
 
 
 @struct.dataclass
@@ -120,9 +135,10 @@ def _segment_prefix(
     seg: jax.Array,       # i32[T] sorted-major segment key (num_segs = sentinel)
     rank: jax.Array,      # i32[T] sort-minor key
     req: jax.Array,       # f32[T, R] (zeroed where inactive)
-) -> tuple[jax.Array, jax.Array]:
-    """Sort by (seg, rank); return (perm, before) where before[i] is the
-    running request total of *earlier-ranked same-segment* rows, in
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Sort by (seg, rank); return (perm, before, is_start) where
+    before[i] is the running request total of *earlier-ranked
+    same-segment* rows and is_start[i] marks segment boundaries, both in
     sorted order."""
     T = seg.shape[0]
     perm = jnp.lexsort((rank, seg))
@@ -132,7 +148,7 @@ def _segment_prefix(
     is_start = jnp.concatenate([jnp.ones((1,), bool), s_seg[1:] != s_seg[:-1]])
     start_idx = lax.cummax(jnp.where(is_start, jnp.arange(T, dtype=jnp.int32), 0))
     before = incl - (incl[start_idx] - s_req[start_idx])  # inclusive-of-self
-    return perm, before - s_req                            # exclusive-of-self
+    return perm, before - s_req, is_start                  # exclusive-of-self
 
 
 def _resolve_conflicts(
@@ -142,6 +158,7 @@ def _resolve_conflicts(
     task_req: jax.Array,    # f32[T, R]
     avail: jax.Array,       # f32[N, R]
     eps: jax.Array,         # f32[R]
+    one_per_node: bool = False,
 ) -> jax.Array:
     """bool[T]: which proposals are accepted this round.
 
@@ -151,12 +168,20 @@ def _resolve_conflicts(
     policy's virtual-start-time keys interleave queues/jobs exactly as
     the reference's share-feedback loop would (see
     framework/policy.py · virtual_start_times).
+
+    `one_per_node` restricts each node to its rank-first proposer.  The
+    allocate action sets it when state-dependent node scores
+    (least-requested / balanced-allocation) are registered: those scores
+    must refresh between placements on the same node, exactly as the
+    serial reference rescores after every placement — prefix-packing a
+    node in one round would score all of them against the node's
+    pre-round occupancy.
     """
     T = prop_node.shape[0]
     N = avail.shape[0]
 
     node_key = jnp.where(active, prop_node, N)           # inactive sort last
-    perm, before_n = _segment_prefix(
+    perm, before_n, is_start = _segment_prefix(
         node_key, rank, jnp.where(active[:, None], task_req, 0.0)
     )
     s_req = jnp.where(active[perm, None], task_req[perm], 0.0)
@@ -166,6 +191,8 @@ def _resolve_conflicts(
     # (negligible ask always fits), never to the cumulative prefix.
     fits_prefix = jnp.all((within <= node_avail) | (s_req < eps), axis=-1)
     s_accept = active[perm] & fits_prefix
+    if one_per_node:
+        s_accept = s_accept & is_start
     accept = jnp.zeros(T, bool).at[perm].set(s_accept)
 
     # Global rank watermark: the reference places tasks strictly in rank
@@ -191,17 +218,27 @@ def allocate_rounds(
     eps: jax.Array,              # f32[R]
     use_future: bool = False,
     max_rounds: int | None = None,
+    one_per_node: bool = False,
+    score_quantum: float = 0.0,
 ) -> AllocState:
     """Run auction rounds to a fixed point.
 
     `max_rounds` defaults to T — sufficient for any input, since ≥1 task
     is accepted per round; the loop exits early the first round nothing
     is accepted, so the bound costs nothing in the common case.
+
+    `score_quantum` > 0 floors scores to that grid before the argmax, so
+    nodes within one quantum of the best tie explicitly and the
+    round-robin dealer spreads proposals across all of them.  This is
+    the throughput valve for state-dependent scores (least-requested):
+    strict serial fidelity would re-score after every single placement
+    (`one_per_node`, O(T) rounds when one node dominates); quantization
+    instead bounds the per-task divergence from the serial choice to one
+    quantum while keeping prefix acceptance and a handful of rounds.
     """
     if max_rounds is None:
         max_rounds = snap.num_tasks
     new_status = int(TaskStatus.PIPELINED if use_future else TaskStatus.ALLOCATED)
-    jitter = _tie_hash(snap.num_tasks, snap.num_nodes)  # loop-invariant
 
     def cond(carry):
         _, progress, rnd = carry
@@ -217,21 +254,21 @@ def allocate_rounds(
         feas = predicate_mask & fit & snap.node_mask[None, :] & eligible[:, None]
 
         score = jnp.where(feas, score_fn(snap, st), NEG_INF)
-        # Two-key argmax: primary = plugin score, secondary = a cheap
-        # per-(task, node) hash.  The reference breaks score ties
-        # arbitrarily (util.SelectBestNode); breaking them *differently
-        # per task* is what lets one round spread equally-scored
-        # proposals across nodes instead of stampeding node 0.
+        if score_quantum > 0.0:
+            score = jnp.floor(score * (1.0 / score_quantum))
+        # The reference breaks score ties arbitrarily
+        # (util.SelectBestNode); here tied proposals are dealt
+        # round-robin by rank so equal tasks spread across equal nodes
+        # within one round instead of stampeding node 0.
         best = jnp.max(score, axis=1, keepdims=True)
         tied = feas & (score >= best)
-        prop_node = jnp.argmax(
-            jnp.where(tied, jitter, -1.0), axis=1
-        ).astype(jnp.int32)
         active = jnp.any(feas, axis=1)
 
         rank = rank_fn(snap, st)
+        prop_node = _round_robin_proposals(tied, active, rank)
         accept = _resolve_conflicts(
-            prop_node, active, rank, snap.task_req, avail, eps
+            prop_node, active, rank, snap.task_req, avail, eps,
+            one_per_node=one_per_node,
         )
 
         # -- apply accepted placements (pure scatter updates) ----------

@@ -1,0 +1,28 @@
+"""Conformance plugin: never evict cluster-critical pods.
+
+Reference counterpart: plugins/conformance/conformance.go — a
+PreemptableFn/ReclaimableFn that filters candidate victims, excluding
+pods in kube-system and pods whose priority class is
+system-cluster-critical / system-node-critical.
+
+The critical bit is resolved at pack time (cache/cluster.py ·
+Pod.critical → snapshot task_critical), so the veto is a single mask.
+"""
+
+from __future__ import annotations
+
+from kube_batch_tpu.framework.plugin import Plugin, register_plugin
+
+
+@register_plugin
+class ConformancePlugin(Plugin):
+    name = "conformance"
+
+    def register(self, policy, tier: int) -> None:
+        def not_critical(snap, state, preemptor):  # noqa: ARG001
+            return ~snap.task_critical
+
+        if self.enabled_for("preemptable"):
+            policy.add_preemptable_fn(tier, not_critical)
+        if self.enabled_for("reclaimable"):
+            policy.add_reclaimable_fn(tier, not_critical)

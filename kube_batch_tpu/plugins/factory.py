@@ -1,6 +1,22 @@
 """Plugin factory: importing it registers every built-in plugin
 (≙ plugins/factory.go)."""
 
-from kube_batch_tpu.plugins import drf, gang, priority, proportion  # noqa: F401
+from kube_batch_tpu.plugins import (  # noqa: F401
+    conformance,
+    drf,
+    gang,
+    nodeorder,
+    predicates,
+    priority,
+    proportion,
+)
 
-BUILTIN_PLUGINS = ["drf", "gang", "priority", "proportion"]
+BUILTIN_PLUGINS = [
+    "conformance",
+    "drf",
+    "gang",
+    "nodeorder",
+    "predicates",
+    "priority",
+    "proportion",
+]

@@ -1,0 +1,71 @@
+"""Predicates plugin: vectorized node feasibility.
+
+Reference counterpart: plugins/predicates/predicates.go — PredicateFn
+chaining the upstream k8s predicates (MatchNodeSelector,
+PodFitsHostPorts, PodToleratesNodeTaints, node condition/pressure
+checks) per (task, node) pair, fanned out 16-way over nodes.
+
+TPU-native redesign: every string-matching predicate becomes one matmul
+over the snapshot's interned multi-hot vocabularies (see
+api/snapshot.py), producing the whole bool[T, N] feasibility matrix in
+a handful of MXU ops instead of T×N per-pair string comparisons:
+
+* MatchNodeSelector  —  a node matches iff it carries EVERY selected
+  label:      task_sel @ node_labelsᵀ  ==  Σ task_sel
+* PodToleratesNodeTaints — feasible iff every node taint is tolerated:
+  untolerated(t, n) = Σ_v node_taints[n,v] · (1 − task_tol[t,v]) == 0
+* PodFitsHostPorts   —  no requested port already occupied:
+  task_ports @ node_portsᵀ == 0
+* node readiness     —  unready/unschedulable nodes are excluded (the
+  reference's node-condition checks, collapsed to the packed
+  `node_ready` bit; memory/disk/PID pressure arrive from the adapter
+  the same way).
+
+Resource fit is deliberately NOT here, exactly like the reference
+(actions check `Resreq ⊑ Idle` themselves; see ops/assignment.py).
+
+Arguments (≙ predicates.go's `predicate.*Enable` toggles):
+    predicate.NodeSelectorEnable  (default true)
+    predicate.TaintsEnable        (default true)
+    predicate.HostPortsEnable     (default true)
+    predicate.NodeReadyEnable     (default true)
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from kube_batch_tpu.framework.plugin import Plugin, register_plugin
+
+
+@register_plugin
+class PredicatesPlugin(Plugin):
+    name = "predicates"
+
+    def register(self, policy, tier: int) -> None:  # noqa: ARG002
+        if not self.enabled_for("predicate"):
+            return
+        sel_on = self.args.get_bool("predicate.NodeSelectorEnable", True)
+        tnt_on = self.args.get_bool("predicate.TaintsEnable", True)
+        prt_on = self.args.get_bool("predicate.HostPortsEnable", True)
+        rdy_on = self.args.get_bool("predicate.NodeReadyEnable", True)
+
+        def predicate(snap):
+            T, N = snap.num_tasks, snap.num_nodes
+            ok = jnp.ones((T, N), bool)
+            if sel_on:
+                want = jnp.sum(snap.task_sel, axis=1, keepdims=True)  # f32[T,1]
+                have = snap.task_sel @ snap.node_labels.T             # f32[T,N]
+                ok = ok & (have >= want)
+            if tnt_on:
+                total = jnp.sum(snap.node_taints, axis=1)[None, :]    # f32[1,N]
+                tolerated = snap.task_tol @ snap.node_taints.T        # f32[T,N]
+                ok = ok & (total - tolerated <= 0.5)
+            if prt_on:
+                clash = snap.task_ports @ snap.node_ports.T           # f32[T,N]
+                ok = ok & (clash <= 0.5)
+            if rdy_on:
+                ok = ok & snap.node_ready[None, :]
+            return ok
+
+        policy.add_predicate_fn(predicate)

@@ -2,17 +2,27 @@
 
 Multi-chip TPU hardware is not available in CI; sharding correctness is
 validated on a virtual 8-device CPU mesh exactly as the driver's
-`dryrun_multichip` does.  Environment must be set before jax is imported
-anywhere, which conftest import-order guarantees.
+`dryrun_multichip` does.
+
+The env image registers the real-TPU (axon) backend from sitecustomize
+at interpreter startup and pins the platform there, so setting
+JAX_PLATFORMS here is too late — `jax.config.update` after import is
+the override that actually wins.  XLA_FLAGS, by contrast, is only read
+when the CPU backend first initializes, so setting it here still works.
 """
 
 import os
+import re
 
-# Force, don't setdefault: the image pins JAX_PLATFORMS=axon (real TPU
-# tunnel), but unit tests must run on the virtual CPU mesh.
-os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+_flag_re = r"--xla_force_host_platform_device_count=\d+"
+_want = "--xla_force_host_platform_device_count=8"
+if re.search(_flag_re, _flags):
+    _flags = re.sub(_flag_re, _want, _flags)  # replace any smaller count
+else:
+    _flags = f"{_flags} {_want}".strip()
+os.environ["XLA_FLAGS"] = _flags
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
