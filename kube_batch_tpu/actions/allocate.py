@@ -40,6 +40,13 @@ def make_allocate_solver(policy, max_rounds: int | None = None):
     tasks simply stay Pending for the next cycle).
     """
 
+    def eligible(snap, state):
+        # Best-effort (empty-request) tasks are backfill's job, not
+        # allocate's (≙ allocate.go skipping tasks with empty Resreq).
+        from kube_batch_tpu.actions.backfill import besteffort_mask
+
+        return policy.eligible_fn(snap, state) & ~besteffort_mask(snap)
+
     def solve(snap, state):
         state = policy.setup_state(snap, state)
         pred = policy.predicate_mask(snap)
@@ -50,7 +57,7 @@ def make_allocate_solver(policy, max_rounds: int | None = None):
                 pred,
                 policy.score_fn,
                 policy.rank_fn,
-                policy.eligible_fn,
+                eligible,
                 snap.eps,
                 use_future=use_future,
                 max_rounds=max_rounds,

@@ -1,0 +1,65 @@
+"""Backfill action: slot best-effort pods into leftover capacity.
+
+Reference counterpart: actions/backfill/backfill.go · Execute — for
+every pending task with an EMPTY resource request, bind it to any
+predicate-passing node immediately (fills fragmentation holes the
+resource-fit actions can't use).  The allocate action correspondingly
+skips best-effort tasks (allocate.go's empty-Resreq continue).
+
+Here it is one auction solve restricted to the best-effort candidate
+mask (req negligible on every non-counting dimension — see
+api.resource.ResourceSpec.besteffort_eps).  Scores are zero: the
+reference takes the first feasible node, and the auction's round-robin
+tie dealing spreads the zero-score ties across feasible nodes.  Pod-slot
+capacity still binds through the normal fit check, so backfill can never
+oversubscribe a node's pod count.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from kube_batch_tpu.framework.plugin import Action, register_action
+from kube_batch_tpu.ops.assignment import allocate_rounds
+
+
+def besteffort_mask(snap):
+    """bool[T]: empty-request tasks (≙ TaskInfo.Resreq.IsEmpty())."""
+    return jnp.all(snap.task_req < snap.besteffort_eps, axis=1)
+
+
+def make_backfill_solver(policy, max_rounds: int | None = None):
+    def eligible(snap, state):  # noqa: ARG001 — backfill has no queue/job gate
+        return besteffort_mask(snap)
+
+    def zero_score(snap, state):  # noqa: ARG001
+        return jnp.zeros((snap.num_tasks, snap.num_nodes), jnp.float32)
+
+    def solve(snap, state):
+        state = policy.setup_state(snap, state)
+        pred = policy.predicate_mask(snap)
+        return allocate_rounds(
+            snap,
+            state,
+            pred,
+            zero_score,
+            policy.rank_fn,
+            eligible,
+            snap.eps,
+            max_rounds=max_rounds,
+        )
+
+    return solve
+
+
+@register_action
+class BackfillAction(Action):
+    name = "backfill"
+
+    def initialize(self, policy) -> None:
+        self.policy = policy
+        self._solve = jax.jit(make_backfill_solver(policy))
+
+    def execute(self, ssn) -> None:
+        ssn.state = self._solve(ssn.snap, ssn.state)

@@ -2,5 +2,8 @@
 (≙ actions/factory.go)."""
 
 from kube_batch_tpu.actions import allocate  # noqa: F401
+from kube_batch_tpu.actions import backfill  # noqa: F401
+from kube_batch_tpu.actions import preempt   # noqa: F401
+from kube_batch_tpu.actions import reclaim   # noqa: F401
 
-BUILTIN_ACTIONS = ["allocate"]
+BUILTIN_ACTIONS = ["allocate", "backfill", "preempt", "reclaim"]

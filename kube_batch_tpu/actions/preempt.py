@@ -1,0 +1,141 @@
+"""Preempt action: within-queue priority preemption for starving gangs.
+
+Reference counterpart: actions/preempt/preempt.go · Execute — per queue,
+while a starving (not Ready) job exists, evict `Preemptable`-approved
+victims of less-deserving jobs in the SAME queue until the preemptor's
+request fits the node's FutureIdle, then pipeline the preemptor;
+transactional via Statement.Commit/Discard.
+
+Here the whole sweep is one jitted `preemption_rounds` solve
+(ops/preemption.py); the mode-specific pieces are the masks below:
+
+* starving jobs: valid (gang minMember still reachable), not ready, not
+  pipelined-satisfiable, with pending work (≙ preempt.go's
+  "underRequest" set gated by ssn.JobValid / JobPipelined);
+* victims: allocated-in-snapshot tasks of a DIFFERENT job in the SAME
+  queue whose job ranks after the preemptor's (≙ the JobOrderFn gate on
+  preemptee jobs), intersected with the tiered Preemptable veto
+  (policy.preemptable_mask — first decisive tier wins, so under the
+  default config gang ∧ conformance bind and drf's tier-2 share veto
+  does not, exactly as upstream).
+
+Eviction commit happens immediately after the solve through the
+session's funnel (≙ Statement.Commit replaying cache.Evict).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kube_batch_tpu.api.snapshot import (
+    allocated_mask,
+    count_per_job,
+    status_is,
+)
+from kube_batch_tpu.api.types import TaskStatus
+from kube_batch_tpu.framework.plugin import Action, register_action
+from kube_batch_tpu.framework.policy import task_queue_of
+from kube_batch_tpu.ops.preemption import preemption_rounds
+
+
+def starving_jobs_mask(policy):
+    """bool[J]: jobs entitled to trigger evictions right now."""
+
+    def starving(snap, state):
+        pending_cnt = count_per_job(
+            snap, status_is(state.task_state, TaskStatus.PENDING)
+        )
+        ready = policy.job_ready_mask(snap, state)
+        pipelined = policy.job_pipelined_mask(snap, state)
+        valid = policy.job_valid_mask(snap, state)
+        return snap.job_mask & valid & ~ready & ~pipelined & (pending_cnt > 0)
+
+    return starving
+
+
+def snapshot_victims(snap, state):
+    """bool[T]: tasks evictable at all — holding node resources both in
+    the snapshot (really running on the cluster, ≙ preempt.go scanning
+    the Running status index) and still in the live state (not already
+    chosen as a victim this cycle)."""
+    return (
+        allocated_mask(snap.task_state)
+        & allocated_mask(state.task_state)
+        & snap.task_mask
+        & (snap.task_job >= 0)
+    )
+
+
+def make_preempt_solver(policy, max_iters: int | None = None):
+    """(snap, state) -> state with victims RELEASING and preemptors
+    PIPELINED — the pure transactional sweep."""
+
+    def victim_fn(snap, state, p):
+        tq = task_queue_of(snap)
+        tj = jnp.clip(snap.task_job, 0, snap.num_jobs - 1)
+        pj = jnp.clip(snap.task_job[p], 0, snap.num_jobs - 1)
+        jrank = policy.job_rank(snap, state)
+        return (
+            snapshot_victims(snap, state)
+            & (tq == tq[p])                      # same queue
+            & (snap.task_job != snap.task_job[p])  # never cannibalise own job
+            & (jrank[tj] > jrank[pj])            # only less-deserving jobs
+            & policy.preemptable_mask(snap, state, p)
+        )
+
+    def eligible(snap, state):
+        # Within-queue preemption is exempt from the Overused gate (the
+        # reference's preempt never consults ssn.Overused — net queue
+        # usage is roughly conserved); gang validity still applies.
+        # Best-effort tasks never preempt: evicting running work to free
+        # a bare pod slot is senseless (≙ preempt.go skipping empty
+        # Resreq preemptors).
+        from kube_batch_tpu.actions.backfill import besteffort_mask
+
+        jv = policy.job_valid_mask(snap, state)
+        tj = jnp.clip(snap.task_job, 0, snap.num_jobs - 1)
+        return jv[tj] & (snap.task_job >= 0) & ~besteffort_mask(snap)
+
+    def solve(snap, state):
+        state = policy.setup_state(snap, state)
+        pred = policy.predicate_mask(snap)
+        return preemption_rounds(
+            snap,
+            state,
+            pred,
+            victim_fn,
+            starving_jobs_mask(policy),
+            policy.rank_fn,
+            eligible,
+            snap.eps,
+            max_iters=max_iters,
+        )
+
+    return solve
+
+
+def commit_new_evictions(ssn, prev_task_state: np.ndarray, reason: str) -> None:
+    """Land the solve's RELEASING transitions through the session funnel."""
+    new = np.asarray(ssn.state.task_state)
+    victims = np.nonzero(
+        (new == int(TaskStatus.RELEASING))
+        & (prev_task_state != int(TaskStatus.RELEASING))
+    )[0]
+    victims = victims[victims < ssn.meta.num_real_tasks]
+    ssn.commit_evictions(victims.tolist(), reason)
+
+
+@register_action
+class PreemptAction(Action):
+    name = "preempt"
+
+    def initialize(self, policy) -> None:
+        self.policy = policy
+        self._solve = jax.jit(make_preempt_solver(policy))
+
+    def execute(self, ssn) -> None:
+        prev = np.asarray(ssn.state.task_state)
+        ssn.state = self._solve(ssn.snap, ssn.state)
+        commit_new_evictions(ssn, prev, reason="preempted")

@@ -1,0 +1,109 @@
+"""Reclaim action: cross-queue fair-share reclamation.
+
+Reference counterpart: actions/reclaim/reclaim.go · Execute — for
+pending tasks of under-served queues, evict allocated tasks of OTHER,
+over-served queues, gated by the tiered Reclaimable veto (proportion:
+the victim's queue must stay at or above its water-filled `deserved`
+after the eviction; gang: never break a running gang; conformance:
+never touch critical pods).
+
+The sweep is the same jitted `preemption_rounds` kernel as preempt,
+with the cross-queue masks below.  The deserved tensor comes from
+`policy.setup_state` (proportion's cycle-setup aux), so the veto sees
+the same water-filling the allocate pass used.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+from kube_batch_tpu.api.snapshot import count_per_job, status_is
+from kube_batch_tpu.api.types import TaskStatus
+from kube_batch_tpu.framework.plugin import Action, register_action
+from kube_batch_tpu.framework.policy import task_queue_of
+from kube_batch_tpu.ops.preemption import preemption_rounds
+
+from kube_batch_tpu.actions.preempt import (
+    commit_new_evictions,
+    snapshot_victims,
+)
+
+
+def _reclaim_eligible(policy):
+    def eligible(snap, state):
+        from kube_batch_tpu.actions.backfill import besteffort_mask
+
+        return policy.eligible_fn(snap, state) & ~besteffort_mask(snap)
+
+    return eligible
+
+
+def make_reclaim_solver(policy, max_iters: int | None = None):
+    def wanting(snap, state):
+        """bool[J]: any valid job with pending work may reclaim — the
+        stop condition is queue-level (its queue reaching deserved →
+        Overused, via the eligibility gate), NOT job-level gang
+        readiness: reclaim's purpose is pushing each queue up to its
+        fair share (≙ reclaim.go looping every pending task of every
+        non-overused queue)."""
+        pending_cnt = count_per_job(
+            snap, status_is(state.task_state, TaskStatus.PENDING)
+        )
+        valid = policy.job_valid_mask(snap, state)
+        return snap.job_mask & valid & (pending_cnt > 0)
+    def victim_fn(snap, state, p):
+        # Inline stop-at-deserved (≙ reclaim.go's own check on the
+        # victim queue's allocations vs the proportion-computed
+        # deserved).  This lives here, not in the tier walk, because
+        # under the default config tier 1 (gang/conformance) is the
+        # decisive veto tier and proportion's tier-2 ReclaimableFn is
+        # never consulted — same as upstream.  The step loop re-runs
+        # this mask after every single eviction, so the floor holds
+        # cumulatively.
+        from kube_batch_tpu.plugins.proportion import (
+            victim_stays_above_deserved,
+        )
+
+        tq = task_queue_of(snap)
+        return (
+            snapshot_victims(snap, state)
+            & (tq != tq[p])                       # cross-queue only
+            & victim_stays_above_deserved(snap, state)
+            & policy.reclaimable_mask(snap, state, p)
+        )
+
+    def solve(snap, state):
+        state = policy.setup_state(snap, state)
+        pred = policy.predicate_mask(snap)
+        return preemption_rounds(
+            snap,
+            state,
+            pred,
+            victim_fn,
+            wanting,
+            policy.rank_fn,
+            # A queue already at/above deserved may not reclaim from
+            # others (≙ reclaim.go skipping Overused queues) — the
+            # policy-wide eligibility gate; best-effort tasks never
+            # reclaim (≙ reclaim.go skipping empty Resreq).
+            _reclaim_eligible(policy),
+            snap.eps,
+            max_iters=max_iters,
+        )
+
+    return solve
+
+
+@register_action
+class ReclaimAction(Action):
+    name = "reclaim"
+
+    def initialize(self, policy) -> None:
+        self.policy = policy
+        self._solve = jax.jit(make_reclaim_solver(policy))
+
+    def execute(self, ssn) -> None:
+        prev = np.asarray(ssn.state.task_state)
+        ssn.state = self._solve(ssn.snap, ssn.state)
+        commit_new_evictions(ssn, prev, reason="reclaimed")

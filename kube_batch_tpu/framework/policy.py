@@ -311,7 +311,12 @@ class TensorPolicy:
     ) -> jax.Array:
         """bool[T] victim permission: within the FIRST tier that has any
         registered fn, intersect plugin answers; later tiers are ignored
-        (≙ session_plugins.go · Preemptable tier walk)."""
+        (≙ session_plugins.go · Preemptable/Reclaimable tier walk, which
+        returns at the first tier whose plugins produced a decision).
+        Under the default config tier 1 (gang/conformance) is decisive —
+        tier-2 vetoes like proportion's deserved floor never bind here,
+        exactly as upstream; reclaim's stop-at-deserved lives as an
+        inline check in the reclaim action instead (≙ reclaim.go)."""
         for tier_fns in tiers:
             if tier_fns:
                 m = jnp.ones(snap.num_tasks, bool)

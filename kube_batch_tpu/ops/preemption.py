@@ -1,0 +1,268 @@
+"""Batched transactional preemption: the what-if eviction kernel.
+
+Reference counterpart: actions/preempt/preempt.go · Execute and
+actions/reclaim/reclaim.go · Execute — serial loops that, per starving
+pending task, build a `Statement`, evict candidate victims ONE BY ONE
+(plugin counters updating between evictions) until the preemptor fits
+the node's FutureIdle, then pipeline the preemptor and `Commit()` — or
+`Discard()` the statement when the victims run out first.
+
+TPU-native redesign.  The loop structure must stay serial at eviction
+granularity — every veto (gang minMember survival, proportion's
+deserved floor, DRF share ordering) is a function of how many victims
+are ALREADY gone, so evaluating a multi-victim prefix against
+pre-eviction state can jointly violate the very invariant each victim
+individually passes.  What gets batched is everything inside one step:
+
+* preemptor selection: tensor argmin over the policy's global rank;
+* node selection: `_min_victims_per_node` prefix-sums candidate victims
+  per node in sacrifice order, yielding for EVERY node at once the
+  victim count whose release would fit the preemptor — a heuristic
+  ranking (per-victim vetoes, pre-eviction state) used only to pick the
+  target node;
+* the eviction step: the sacrifice-first victim on the chosen node,
+  re-validated against the LIVE state (vetoes recomputed after every
+  eviction — cumulative correctness is automatic);
+* the Statement: provisional evictions accumulate in a `prov` mask; if
+  the victims dry up before the preemptor fits, the whole plan is
+  rolled back by a tensor restore (state ← snapshot values for `prov`
+  rows) — `Commit`/`Discard` as pure array ops, no undo log.
+
+A preemptor whose plan fails is remembered in `tried` and not
+reattempted this cycle (the reference would scan further nodes; the
+heuristic rarely picks a jointly-infeasible node, and the next cycle
+retries from a fresh snapshot).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+from jax import lax
+
+from kube_batch_tpu.api.snapshot import SnapshotTensors, fits
+from kube_batch_tpu.api.types import TaskStatus
+from kube_batch_tpu.ops.assignment import AllocState, _segment_prefix
+
+BIG_K = jnp.iinfo(jnp.int32).max // 4
+INT_MAX = jnp.iinfo(jnp.int32).max
+
+# victim_mask_fn(snap, state, preemptor_idx) -> bool[T] candidate victims
+VictimMaskFn = Callable[[SnapshotTensors, AllocState, jax.Array], jax.Array]
+# starving_fn(snap, state) -> bool[J] jobs allowed to preempt now
+StarvingFn = Callable[[SnapshotTensors, AllocState], jax.Array]
+RankFn = Callable[[SnapshotTensors, AllocState], jax.Array]
+
+
+@struct.dataclass
+class PreemptCarry:
+    state: AllocState
+    tried: jax.Array        # bool[T] preemptors served or given up on
+    prov: jax.Array         # bool[T] provisional victims of the open plan
+    prov_active: jax.Array  # bool[]  a plan is in progress
+    prov_p: jax.Array       # i32[]   its preemptor
+    prov_n: jax.Array       # i32[]   its target node
+    progressed: jax.Array   # bool[]  loop-exit latch
+    iters: jax.Array        # i32[]
+
+
+def _min_victims_per_node(
+    snap: SnapshotTensors,
+    future: jax.Array,          # f32[N, R] FutureIdle as of this step
+    victims: jax.Array,         # bool[T] candidate victims (on their nodes)
+    sacrifice_rank: jax.Array,  # i32[T] smaller = evicted first
+    preemptor_req: jax.Array,   # f32[R]
+    eps: jax.Array,
+) -> jax.Array:
+    """i32[N]: for every node at once, the minimal count of victims
+    (taken in sacrifice order) whose release makes the preemptor fit;
+    BIG_K where no prefix suffices.  Heuristic only — per-victim vetoes
+    against the current state, so a joint (cumulative) veto can still
+    fail the plan later; the step loop handles that with rollback."""
+    T = victims.shape[0]
+    N = future.shape[0]
+    vnode = jnp.where(victims, snap.task_node, N)
+    perm, before, _ = _segment_prefix(
+        vnode, sacrifice_rank, jnp.where(victims[:, None], snap.task_req, 0.0)
+    )
+    s_node = vnode[perm]
+    s_req = jnp.where(victims[perm, None], snap.task_req[perm], 0.0)
+    gain = before + s_req                                  # f32[T, R] released
+    navail = future[jnp.clip(s_node, 0, N - 1)] + gain
+    s_fit = fits(preemptor_req[None, :], navail, eps) & (s_node < N)
+
+    idx = jnp.arange(T, dtype=jnp.int32)
+    is_start = jnp.concatenate([jnp.ones((1,), bool), s_node[1:] != s_node[:-1]])
+    start_idx = lax.cummax(jnp.where(is_start, idx, 0))
+    pos = idx - start_idx                                  # within-node 0-based
+    kcand = jnp.where(s_fit, pos + 1, BIG_K)
+    k_with = jax.ops.segment_min(kcand, s_node, num_segments=N + 1)[:N]
+    fit0 = fits(preemptor_req[None, :], future, eps)       # bool[N]
+    return jnp.where(fit0, 0, k_with)
+
+
+def preemption_rounds(
+    snap: SnapshotTensors,
+    state: AllocState,
+    predicate_mask: jax.Array,       # bool[T, N]
+    victim_mask_fn: VictimMaskFn,
+    starving_fn: StarvingFn,
+    rank_fn: RankFn,
+    eligible_fn: Callable[[SnapshotTensors, AllocState], jax.Array],
+    eps: jax.Array,
+    max_iters: int | None = None,
+) -> AllocState:
+    """Serve starving jobs by evicting less-deserving workloads.
+
+    One `while_loop` iteration = one *eviction-granular* step of the
+    reference's Statement loop: open a plan (pick preemptor + node),
+    evict exactly one re-validated victim, finalize (pipeline the
+    preemptor) the moment it fits, or roll the plan back when victims
+    run out.  `max_iters` bounds total steps (evictions + decisions);
+    leftover starving tasks simply stay Pending for the next cycle.
+    """
+    if max_iters is None:
+        max_iters = 2 * snap.num_tasks + 8
+    T = snap.num_tasks
+
+    def cond(c: PreemptCarry):
+        return c.progressed & (c.iters < max_iters)
+
+    def body(c: PreemptCarry):
+        st = c.state
+        rank = rank_fn(snap, st)
+        tj = jnp.clip(snap.task_job, 0, snap.num_jobs - 1)
+
+        # -- preemptor: the open plan's, else the rank-first starving ---
+        pending = (st.task_state == int(TaskStatus.PENDING)) & snap.task_mask
+        starving_j = starving_fn(snap, st)
+        elig = (
+            pending
+            & starving_j[tj]
+            & (snap.task_job >= 0)
+            & eligible_fn(snap, st)
+            & ~c.tried
+        )
+        any_elig = jnp.any(elig)
+        p_new = jnp.argmin(jnp.where(elig, rank, INT_MAX)).astype(jnp.int32)
+        p = jnp.where(c.prov_active, c.prov_p, p_new)
+        have_p = c.prov_active | any_elig
+        preq = snap.task_req[p]
+        is_p = jnp.arange(T, dtype=jnp.int32) == p
+
+        # -- candidate victims under the LIVE state (fresh vetoes) ------
+        victims = (
+            victim_mask_fn(snap, st, p)
+            & snap.task_mask
+            & (st.task_node >= 0)
+            & ~c.prov
+        )
+        sacrifice = -rank  # least deserving evicted first
+
+        # -- node choice (heuristic; only computed when opening a plan —
+        # mid-plan steps keep prov_n, and lax.cond skips the [T]-sort /
+        # prefix-sum work entirely on those steps) --------------------
+        def choose_node(_):
+            k = _min_victims_per_node(
+                snap, st.node_future, victims, sacrifice, preq, eps
+            )
+            feasible = (
+                (k < BIG_K)
+                & predicate_mask[p]
+                & snap.node_mask
+                & snap.node_ready
+            )
+            kk = jnp.where(feasible, k, BIG_K)
+            n_best = jnp.argmax(feasible & (kk == jnp.min(kk))).astype(
+                jnp.int32
+            )
+            return n_best, jnp.any(feasible)
+
+        def keep_node(_):
+            return c.prov_n, jnp.asarray(True)
+
+        n, node_ok = lax.cond(c.prov_active, keep_node, choose_node, None)
+
+        # -- classify this step -----------------------------------------
+        opening = ~c.prov_active & have_p & node_ok
+        no_node = ~c.prov_active & have_p & ~node_ok   # give up on p
+        active = c.prov_active | opening
+
+        fit_now = fits(preq[None, :], st.node_future[n][None, :], eps)[0]
+        victims_on_n = victims & (st.task_node == n)
+        any_vic = jnp.any(victims_on_n)
+
+        finalize = active & fit_now                     # Commit
+        evict_step = active & ~fit_now & any_vic        # one more victim
+        fail = active & ~fit_now & ~any_vic             # Discard
+
+        # -- the eviction step ------------------------------------------
+        v = jnp.argmin(
+            jnp.where(victims_on_n, sacrifice, INT_MAX)
+        ).astype(jnp.int32)
+        is_v = (jnp.arange(T, dtype=jnp.int32) == v) & evict_step
+        req_v = snap.task_req[v]
+
+        task_state = jnp.where(is_v, int(TaskStatus.RELEASING), st.task_state)
+        task_state = jnp.where(
+            finalize & is_p, int(TaskStatus.PIPELINED), task_state
+        )
+        # Discard: provisional victims return to their snapshot status
+        # (they were untouched before this plan by construction).
+        task_state = jnp.where(fail & c.prov, snap.task_state, task_state)
+        task_node = jnp.where(finalize & is_p, n, st.task_node)
+
+        prov_req_sum = jnp.sum(
+            jnp.where(c.prov[:, None], snap.task_req, 0.0), axis=0
+        )
+        delta = (
+            jnp.where(evict_step, req_v, 0.0)
+            - jnp.where(finalize, preq, 0.0)
+            - jnp.where(fail, prov_req_sum, 0.0)
+        )
+        node_future = st.node_future.at[n].add(delta)
+
+        closed = finalize | fail
+        new_state = st.replace(
+            task_state=task_state, task_node=task_node, node_future=node_future
+        )
+        return PreemptCarry(
+            state=new_state,
+            tried=c.tried | (is_p & (no_node | fail | finalize)),
+            prov=jnp.where(closed, False, c.prov | is_v),
+            prov_active=evict_step,
+            prov_p=p,
+            prov_n=n,
+            progressed=have_p,
+            iters=c.iters + 1,
+        )
+
+    init = PreemptCarry(
+        state=state,
+        tried=jnp.zeros(T, bool),
+        prov=jnp.zeros(T, bool),
+        prov_active=jnp.asarray(False),
+        prov_p=jnp.asarray(0, jnp.int32),
+        prov_n=jnp.asarray(0, jnp.int32),
+        progressed=jnp.asarray(True),
+        iters=jnp.asarray(0, jnp.int32),
+    )
+    out = lax.while_loop(cond, body, init)
+    # If max_iters expired mid-plan, the open plan's provisional victims
+    # are still RELEASING with no pipelined preemptor to show for it —
+    # apply the Discard branch once so truncation can never commit a
+    # half-statement (victims restore to snapshot state, the target
+    # node's future capacity deflates back).
+    st = out.state
+    open_plan = out.prov_active
+    prov_req_sum = jnp.sum(
+        jnp.where(out.prov[:, None], snap.task_req, 0.0), axis=0
+    )
+    task_state = jnp.where(open_plan & out.prov, snap.task_state, st.task_state)
+    node_future = st.node_future.at[out.prov_n].add(
+        jnp.where(open_plan, -prov_req_sum, jnp.zeros_like(prov_req_sum))
+    )
+    return st.replace(task_state=task_state, node_future=node_future)

@@ -81,6 +81,29 @@ def _deserved(snap: SnapshotTensors, state: AllocState) -> jax.Array:
     return cached if cached is not None else queue_deserved(snap)
 
 
+def victim_stays_above_deserved(
+    snap: SnapshotTensors, state: AllocState
+) -> jax.Array:
+    """bool[T]: evicting this task leaves its queue at or above its
+    water-filled deserved share (per meaningful dimension — counting
+    dims like pod slots are excluded via besteffort_eps: upstream's
+    Resource has no pod-count dimension; node pod capacity is
+    MaxTaskNum in predicates, never part of proportion math).
+
+    Single source of truth for the deserved floor — used both by the
+    registered ReclaimableFn below and by the reclaim action's inline
+    victim gate (≙ reclaim.go's own allocations-vs-deserved check).
+    """
+    alloc = queue_allocated(snap, state)
+    deserved = _deserved(snap, state)
+    tq = task_queue_of(snap)
+    after = alloc[tq] - snap.task_req
+    return jnp.all(
+        (deserved[tq] <= after) | (deserved[tq] < snap.besteffort_eps[None, :]),
+        axis=1,
+    )
+
+
 def queue_share(snap: SnapshotTensors, state: AllocState) -> jax.Array:
     """f32[Q]: max-dimension allocated/deserved ratio (lower = hungrier)."""
     alloc = queue_allocated(snap, state)
@@ -101,24 +124,20 @@ class ProportionPlugin(Plugin):
             return queue_share(snap, state)
 
         def overused(snap, state):
-            # deserved ⊑ allocated (all meaningful dims) → no more for you
+            # deserved ⊑ allocated (all meaningful dims; counting dims
+            # excluded via besteffort_eps) → no more for you
             alloc = queue_allocated(snap, state)
             deserved = _deserved(snap, state)
             return jnp.all(
-                (deserved <= alloc) | (deserved < snap.eps[None, :]), axis=1
+                (deserved <= alloc) | (deserved < snap.besteffort_eps[None, :]),
+                axis=1,
             ) & snap.queue_mask
 
         def reclaimable(snap, state, preemptor):  # noqa: ARG001
             # victim allowed only if its queue stays ≥ deserved afterwards
-            alloc = queue_allocated(snap, state)
-            deserved = _deserved(snap, state)
-            tq = task_queue_of(snap)
-            after = alloc[tq] - snap.task_req
-            ok = jnp.all(
-                (deserved[tq] <= after) | (deserved[tq] < snap.eps[None, :]),
-                axis=1,
+            return victim_stays_above_deserved(snap, state) | (
+                snap.task_job < 0
             )
-            return ok | (snap.task_job < 0)
 
         def queue_vtime(snap, state, base_rank, valid):
             """Per-task virtual start times in allocated/deserved share

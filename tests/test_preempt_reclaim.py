@@ -1,0 +1,263 @@
+"""Preempt / reclaim / backfill action tests.
+
+Pattern follows the reference's action tests (actions/preempt/
+preempt_test.go): real cache + simulated backend, run sessions, assert
+on the evictions and the binds that eventually land.
+"""
+
+import dataclasses
+
+from kube_batch_tpu.actions import BUILTIN_ACTIONS  # noqa: F401 (registration)
+from kube_batch_tpu.api.resource import ResourceSpec
+from kube_batch_tpu.cache.cluster import Node, Pod, PodGroup, Queue
+from kube_batch_tpu.framework.conf import default_conf
+from kube_batch_tpu.framework.plugin import get_action
+from kube_batch_tpu.framework.session import (
+    build_policy,
+    close_session,
+    open_session,
+)
+from kube_batch_tpu.models.workloads import GI
+from kube_batch_tpu.plugins import BUILTIN_PLUGINS  # noqa: F401 (registration)
+from kube_batch_tpu.sim.simulator import make_world
+
+SPEC = ResourceSpec(("cpu", "memory", "pods", "accelerator"))
+
+
+def run_cycle(cache, actions):
+    conf = dataclasses.replace(default_conf(), actions=tuple(actions))
+    policy, plugins = build_policy(conf)
+    acts = [get_action(n) for n in conf.actions]
+    for a in acts:
+        a.initialize(policy)
+    ssn = open_session(cache, policy, plugins)
+    for a in acts:
+        a.execute(ssn)
+    close_session(ssn)
+    return ssn
+
+
+def _two_node_world():
+    cache, sim = make_world(SPEC)
+    for i in range(2):
+        sim.add_node(
+            Node(name=f"n{i}", allocatable={"cpu": 4000, "memory": 8 * GI, "pods": 110})
+        )
+    return cache, sim
+
+
+def _pods(prefix, n, cpu, mem, prio=0):
+    return [
+        Pod(
+            name=f"{prefix}-{i}",
+            request={"cpu": cpu, "memory": mem, "pods": 1},
+            priority=prio,
+        )
+        for i in range(n)
+    ]
+
+
+def test_preempt_evicts_lower_priority_within_queue():
+    cache, sim = _two_node_world()
+    # Low-priority job fills the cluster and starts running.
+    sim.submit(
+        PodGroup(name="low", queue="default", min_member=1),
+        _pods("low", 4, cpu=2000, mem=4 * GI, prio=0),
+    )
+    run_cycle(cache, ["allocate"])
+    sim.tick()  # bound -> running
+    assert len(sim.binds) == 4
+
+    # High-priority gang arrives; nothing is idle.
+    sim.submit(
+        PodGroup(name="high", queue="default", min_member=2, priority=1000),
+        _pods("high", 2, cpu=2000, mem=4 * GI, prio=1000),
+    )
+    ssn = run_cycle(cache, ["allocate", "preempt"])
+    # Exactly two victims: one per preemptor, the minimal sets.
+    assert len(ssn.evicted) == 2
+    assert all(name.startswith("low") for name, _ in ssn.evicted)
+    assert all(reason == "preempted" for _, reason in ssn.evicted)
+    # Preemptors are pipelined, not bound, while victims release.
+    assert not any(name.startswith("high") for name, _ in sim.binds)
+
+    # Evictions land; the freed capacity binds the high gang next cycle.
+    sim.tick()
+    run_cycle(cache, ["allocate", "preempt"])
+    bound = [name for name, _ in sim.binds]
+    assert "high-0" in bound and "high-1" in bound
+
+
+def test_preempt_respects_gang_min_member_of_victims():
+    """A running gang at exactly minMember must not be broken."""
+    cache, sim = _two_node_world()
+    sim.submit(
+        PodGroup(name="low", queue="default", min_member=4),  # all 4 essential
+        _pods("low", 4, cpu=2000, mem=4 * GI, prio=0),
+    )
+    run_cycle(cache, ["allocate"])
+    sim.tick()
+
+    sim.submit(
+        PodGroup(name="high", queue="default", min_member=2, priority=1000),
+        _pods("high", 2, cpu=2000, mem=4 * GI, prio=1000),
+    )
+    ssn = run_cycle(cache, ["allocate", "preempt"])
+    assert ssn.evicted == []          # gang veto protects every victim
+    assert not any(name.startswith("high") for name, _ in sim.binds)
+
+
+def test_preempt_never_evicts_critical_pods():
+    cache, sim = _two_node_world()
+    critical = [
+        Pod(
+            name=f"sys-{i}",
+            namespace="kube-system",   # → Pod.critical (conformance)
+            request={"cpu": 2000, "memory": 4 * GI, "pods": 1},
+            priority=0,
+        )
+        for i in range(4)
+    ]
+    sim.submit(PodGroup(name="sys", queue="default", min_member=1), critical)
+    run_cycle(cache, ["allocate"])
+    sim.tick()
+
+    sim.submit(
+        PodGroup(name="high", queue="default", min_member=1, priority=1000),
+        _pods("high", 1, cpu=2000, mem=4 * GI, prio=1000),
+    )
+    ssn = run_cycle(cache, ["allocate", "preempt"])
+    assert ssn.evicted == []          # conformance veto
+
+
+def test_preempt_rolls_back_when_joint_evictions_would_break_gang():
+    """Each victim individually passes gang's veto (4-1 >= 2), but the
+    preemptor needs 3 of them, which would leave 1 < minMember 2.  The
+    statement loop re-validates after every eviction, so the plan must
+    fail and roll back with ZERO evictions committed."""
+    cache, sim = make_world(SPEC)
+    sim.add_node(
+        Node(name="n0", allocatable={"cpu": 8000, "memory": 16 * GI, "pods": 110})
+    )
+    sim.submit(
+        PodGroup(name="low", queue="default", min_member=2),
+        _pods("low", 4, cpu=2000, mem=4 * GI, prio=0),
+    )
+    run_cycle(cache, ["allocate"])
+    sim.tick()
+    assert len(sim.binds) == 4
+
+    sim.submit(
+        PodGroup(name="high", queue="default", min_member=1, priority=1000),
+        _pods("high", 1, cpu=6000, mem=12 * GI, prio=1000),
+    )
+    ssn = run_cycle(cache, ["allocate", "preempt"])
+    assert ssn.evicted == []
+    # and the rollback restored accounting: low's 4 tasks all still held
+    assert all(
+        cache._pods[uid].status.name == "RUNNING"
+        for uid in cache._pods
+        if cache._pods[uid].name.startswith("low")
+    )
+
+
+def test_preempt_priority_beats_drf_share_gap():
+    """Tier-1 (gang/conformance) is the decisive veto tier under the
+    default conf; DRF's tier-2 share veto must NOT bind, or a
+    high-priority job with a larger share could never preempt."""
+    cache, sim = _two_node_world()
+    sim.submit(
+        PodGroup(name="low", queue="default", min_member=1),
+        _pods("low", 4, cpu=2000, mem=4 * GI, prio=0),
+    )
+    run_cycle(cache, ["allocate"])
+    sim.tick()
+
+    # High-priority gang needs BOTH nodes' worth of capacity: its share
+    # once pipelined exceeds any single victim's post-eviction share.
+    sim.submit(
+        PodGroup(name="high", queue="default", min_member=3, priority=1000),
+        _pods("high", 3, cpu=2000, mem=4 * GI, prio=1000),
+    )
+    ssn = run_cycle(cache, ["allocate", "preempt"])
+    assert len(ssn.evicted) == 3
+
+
+def test_reclaim_rebalances_across_queues():
+    cache, sim = _two_node_world()
+    sim.add_queue(Queue(name="gold", weight=3.0))
+    sim.add_queue(Queue(name="silver", weight=1.0))
+    # Silver takes the whole cluster while gold is empty.
+    sim.submit(
+        PodGroup(name="s", queue="silver", min_member=1),
+        _pods("s", 4, cpu=2000, mem=4 * GI),
+    )
+    run_cycle(cache, ["allocate"])
+    sim.tick()
+    assert len(sim.binds) == 4
+
+    # Gold arrives; its deserved share (water-filled by weight) must be
+    # reclaimed from silver's surplus.
+    sim.submit(
+        PodGroup(name="g", queue="gold", min_member=1),
+        _pods("g", 2, cpu=2000, mem=4 * GI),
+    )
+    ssn = run_cycle(cache, ["allocate", "reclaim"])
+    assert len(ssn.evicted) == 2
+    assert all(name.startswith("s") for name, _ in ssn.evicted)
+    assert all(reason == "reclaimed" for _, reason in ssn.evicted)
+
+    sim.tick()
+    run_cycle(cache, ["allocate", "reclaim"])
+    bound = [name for name, _ in sim.binds]
+    assert "g-0" in bound and "g-1" in bound
+
+
+def test_reclaim_stops_at_deserved_share():
+    """Reclaim taxes only the surplus: silver keeps its deserved half."""
+    cache, sim = _two_node_world()
+    sim.add_queue(Queue(name="gold", weight=1.0))
+    sim.add_queue(Queue(name="silver", weight=1.0))
+    sim.submit(
+        PodGroup(name="s", queue="silver", min_member=1),
+        _pods("s", 4, cpu=2000, mem=4 * GI),
+    )
+    run_cycle(cache, ["allocate"])
+    sim.tick()
+
+    # Gold asks for MORE than its deserved half (3 pods = 6000m > 4000m).
+    sim.submit(
+        PodGroup(name="g", queue="gold", min_member=1),
+        _pods("g", 3, cpu=2000, mem=4 * GI),
+    )
+    ssn = run_cycle(cache, ["allocate", "reclaim"])
+    # Only 2 silver victims (down to deserved 4000m), not 3.
+    assert len(ssn.evicted) == 2
+
+
+def test_backfill_places_besteffort_on_full_nodes():
+    cache, sim = make_world(SPEC)
+    sim.add_node(Node(name="n0", allocatable={"cpu": 4000, "memory": 8 * GI, "pods": 110}))
+    sim.submit(
+        PodGroup(name="fill", queue="default", min_member=1),
+        _pods("fill", 1, cpu=4000, mem=8 * GI),
+    )
+    be_pods = [Pod(name=f"be-{i}", request={"pods": 1}) for i in range(3)]
+    sim.submit(PodGroup(name="be", queue="default", min_member=1), be_pods)
+
+    run_cycle(cache, ["allocate", "backfill"])
+    bound = sorted(name for name, _ in sim.binds)
+    # cpu-full node still takes the zero-request pods
+    assert bound == ["be-0", "be-1", "be-2", "fill-0"]
+
+
+def test_allocate_alone_skips_besteffort():
+    """Without the backfill action, empty-request pods stay pending
+    (≙ allocate.go skipping empty Resreq)."""
+    cache, sim = make_world(SPEC)
+    sim.add_node(Node(name="n0", allocatable={"cpu": 4000, "memory": 8 * GI, "pods": 110}))
+    be_pods = [Pod(name=f"be-{i}", request={"pods": 1}) for i in range(2)]
+    sim.submit(PodGroup(name="be", queue="default", min_member=1), be_pods)
+
+    run_cycle(cache, ["allocate"])
+    assert sim.binds == []
