@@ -1,0 +1,7 @@
+"""`python -m kube_batch_tpu` → the CLI (≙ cmd/kube-batch/main.go)."""
+
+import sys
+
+from kube_batch_tpu.cli import main
+
+sys.exit(main())

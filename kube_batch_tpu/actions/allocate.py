@@ -40,12 +40,9 @@ def make_allocate_solver(policy, max_rounds: int | None = None):
     tasks simply stay Pending for the next cycle).
     """
 
-    def eligible(snap, state):
-        # Best-effort (empty-request) tasks are backfill's job, not
-        # allocate's (≙ allocate.go skipping tasks with empty Resreq).
-        from kube_batch_tpu.actions.backfill import besteffort_mask
+    from kube_batch_tpu.actions.backfill import non_besteffort_eligible
 
-        return policy.eligible_fn(snap, state) & ~besteffort_mask(snap)
+    eligible = non_besteffort_eligible(policy)
 
     def solve(snap, state):
         state = policy.setup_state(snap, state)

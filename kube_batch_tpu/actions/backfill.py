@@ -29,6 +29,17 @@ def besteffort_mask(snap):
     return jnp.all(snap.task_req < snap.besteffort_eps, axis=1)
 
 
+def non_besteffort_eligible(policy):
+    """Policy-wide eligibility minus best-effort tasks — the gate
+    allocate and reclaim share (≙ allocate.go/reclaim.go both skipping
+    empty-Resreq tasks; those are exclusively backfill's)."""
+
+    def eligible(snap, state):
+        return policy.eligible_fn(snap, state) & ~besteffort_mask(snap)
+
+    return eligible
+
+
 def make_backfill_solver(policy, max_rounds: int | None = None):
     def eligible(snap, state):  # noqa: ARG001 — backfill has no queue/job gate
         return besteffort_mask(snap)

@@ -24,19 +24,11 @@ from kube_batch_tpu.framework.plugin import Action, register_action
 from kube_batch_tpu.framework.policy import task_queue_of
 from kube_batch_tpu.ops.preemption import preemption_rounds
 
+from kube_batch_tpu.actions.backfill import non_besteffort_eligible
 from kube_batch_tpu.actions.preempt import (
     commit_new_evictions,
     snapshot_victims,
 )
-
-
-def _reclaim_eligible(policy):
-    def eligible(snap, state):
-        from kube_batch_tpu.actions.backfill import besteffort_mask
-
-        return policy.eligible_fn(snap, state) & ~besteffort_mask(snap)
-
-    return eligible
 
 
 def make_reclaim_solver(policy, max_iters: int | None = None):
@@ -87,7 +79,7 @@ def make_reclaim_solver(policy, max_iters: int | None = None):
             # others (≙ reclaim.go skipping Overused queues) — the
             # policy-wide eligibility gate; best-effort tasks never
             # reclaim (≙ reclaim.go skipping empty Resreq).
-            _reclaim_eligible(policy),
+            non_besteffort_eligible(policy),
             snap.eps,
             max_iters=max_iters,
         )

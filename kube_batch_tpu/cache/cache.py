@@ -18,6 +18,7 @@ scheduling resumes — there is no scheduler-private durable state.
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import threading
 
@@ -61,7 +62,11 @@ class SchedulerCache:
         self._nodes: dict[str, NodeInfo] = {}    # by node name
         self._queues: dict[str, QueueInfo] = {}  # by queue name
         self._resync: list[str] = []             # pod uids of failed binds
-        self.events: list[str] = []              # human-readable event log
+        # Human-readable event log, bounded like an apiserver's event TTL
+        # window: a long-running daemon with a persistent unschedulable
+        # backlog appends diagnosis lines every cycle and nothing drains
+        # them — the ring keeps the newest window instead of OOMing.
+        self.events: collections.deque[str] = collections.deque(maxlen=10000)
 
         self.add_queue(Queue(name=default_queue, weight=1.0))
 

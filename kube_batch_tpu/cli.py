@@ -1,0 +1,157 @@
+"""Process entry point: flags, HA gate, metrics listener, the loop.
+
+Reference counterpart: cmd/kube-batch/ — main.go + app/server.go +
+app/options/options.go: the pflag `ServerOption` set, leader election
+(active/passive HA via a lock object), the Prometheus listener on
+`--listen-address`, and handing off to `scheduler.Run`.
+
+Differences by design:
+* the world behind the scheduler is a pluggable backend; out of the box
+  the CLI drives the in-process simulator from a workload spec (a
+  BASELINE config number or a YAML world file) — a real-cluster adapter
+  slots in through the same `SchedulerCache` + Binder/Evictor seam;
+* leader election is a host-local advisory file lock (`fcntl.flock` on
+  `--lock-file`): same active/passive semantics — the standby blocks
+  until the leader dies, then takes over a freshly rebuilt cache
+  (stateless recovery, ≙ informer re-list after failover).
+"""
+
+from __future__ import annotations
+
+import argparse
+import fcntl
+import logging
+import sys
+
+import yaml
+
+from kube_batch_tpu.api.resource import ResourceSpec
+from kube_batch_tpu.cache.cluster import Node, Pod, PodGroup, Queue
+from kube_batch_tpu.scheduler import DEFAULT_SCHEDULE_PERIOD, Scheduler
+from kube_batch_tpu.sim.simulator import make_world
+from kube_batch_tpu.version import version_string
+
+
+def build_parser() -> argparse.ArgumentParser:
+    """≙ options.go · AddFlags (the subset meaningful off-cluster)."""
+    p = argparse.ArgumentParser(
+        prog="kube-batch-tpu",
+        description="TPU-native batch/gang scheduler",
+    )
+    p.add_argument("--scheduler-conf", default=None,
+                   help="policy YAML, hot-reloaded every cycle")
+    p.add_argument("--schedule-period", type=float,
+                   default=DEFAULT_SCHEDULE_PERIOD,
+                   help="seconds between cycles (default 1.0)")
+    p.add_argument("--default-queue", default="default",
+                   help="queue for jobs that name none")
+    p.add_argument("--listen-address", default=":8080",
+                   help="metrics endpoint (host:port; empty disables)")
+    p.add_argument("--leader-elect", action="store_true",
+                   help="block on --lock-file until leadership acquired")
+    p.add_argument("--lock-file", default="/tmp/kube-batch-tpu.lock",
+                   help="leader-election lock file")
+    p.add_argument("--workload", default=None,
+                   help="world spec: a BASELINE config number (1-5) or a "
+                        "YAML file of nodes/queues/jobs")
+    p.add_argument("--cycles", type=int, default=None,
+                   help="stop after N cycles (default: run forever)")
+    p.add_argument("--version", action="store_true")
+    return p
+
+
+def load_world(spec_arg: str | None, default_queue: str):
+    """Build (cache, simulator) from --workload."""
+    if spec_arg is None:
+        spec = ResourceSpec()
+        return make_world(spec, default_queue=default_queue)
+    if spec_arg.isdigit():
+        from kube_batch_tpu.models.workloads import build_config
+
+        return build_config(int(spec_arg))
+    with open(spec_arg, "r", encoding="utf-8") as f:
+        raw = yaml.safe_load(f) or {}
+    names = tuple(raw.get("resources", ("cpu", "memory", "pods", "accelerator")))
+    cache, sim = make_world(ResourceSpec(names), default_queue=default_queue)
+    for q in raw.get("queues", []):
+        sim.add_queue(Queue(name=q["name"], weight=float(q.get("weight", 1.0))))
+    for n in raw.get("nodes", []):
+        sim.add_node(Node(
+            name=n["name"],
+            allocatable=dict(n.get("allocatable", {})),
+            labels=dict(n.get("labels", {})),
+            taints=frozenset(n.get("taints", [])),
+        ))
+    for j in raw.get("jobs", []):
+        group = PodGroup(
+            name=j["name"],
+            queue=j.get("queue", ""),
+            min_member=int(j.get("minMember", 1)),
+            priority=int(j.get("priority", 0)),
+        )
+        pods = [
+            Pod(
+                name=p["name"],
+                request=dict(p.get("request", {})),
+                priority=int(p.get("priority", group.priority)),
+                selector=dict(p.get("selector", {})),
+                tolerations=frozenset(p.get("tolerations", [])),
+            )
+            for p in j.get("pods", [])
+        ]
+        sim.submit(group, pods)
+    return cache, sim
+
+
+def acquire_leadership(lock_file: str):
+    """Block until this process holds the flock (≙ leaderelection.
+    RunOrDie's acquire loop).  Returns the held file object — keep it
+    alive; dropping it releases leadership."""
+    f = open(lock_file, "a+")  # noqa: SIM115 — held for process lifetime
+    logging.info("waiting for leadership on %s", lock_file)
+    fcntl.flock(f, fcntl.LOCK_EX)
+    logging.info("leadership acquired")
+    return f
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.version:
+        print(version_string())
+        return 0
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+    )
+
+    lock = None
+    if args.leader_elect:
+        lock = acquire_leadership(args.lock_file)
+
+    if args.listen_address:
+        from kube_batch_tpu import metrics
+
+        metrics.serve(args.listen_address)
+
+    cache, sim = load_world(args.workload, args.default_queue)
+    scheduler = Scheduler(
+        cache,
+        conf_path=args.scheduler_conf,
+        schedule_period=args.schedule_period,
+    )
+    try:
+        ran = scheduler.run(
+            max_cycles=args.cycles,
+            on_cycle=sim.tick if sim is not None else None,
+        )
+        logging.info("stopped after %d cycles", ran)
+    except KeyboardInterrupt:
+        logging.info("interrupted; shutting down")
+    finally:
+        if lock is not None:
+            lock.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

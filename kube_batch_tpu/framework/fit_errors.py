@@ -1,0 +1,122 @@
+"""Why-is-my-pod-not-scheduled diagnosis.
+
+Reference counterpart: pkg/scheduler/api/unschedule_info.go — the
+`FitErrors` aggregation that collects per-node predicate failures per
+task and renders the familiar "0/4 nodes are available: 3 Insufficient
+cpu, 1 node(s) had taints" events users debug with.
+
+TPU-native shape: the per-(task, node) failure matrix already exists on
+device — it is the complement of the predicate mask and the resource-fit
+matrix the allocate auction computed.  Diagnosis is therefore a handful
+of whole-snapshot reductions (one [T, N] pass per failure class), pulled
+to host once per cycle only for tasks that stayed Pending.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from kube_batch_tpu.api.snapshot import SnapshotTensors, fits
+from kube_batch_tpu.api.types import TaskStatus
+from kube_batch_tpu.ops.assignment import AllocState
+
+
+def failure_counts(
+    snap: SnapshotTensors,
+    state: AllocState,
+    predicate_mask: jnp.ndarray,
+) -> dict[str, jnp.ndarray]:
+    """Per-task failure tallies over real nodes (device-side).
+
+    Returns i32[T] arrays: nodes total, predicate-vetoed nodes, nodes
+    left short on each resource dimension (i32[T, R]), and nodes fully
+    fitting (should be 0 for a still-pending task — nonzero means the
+    task lost the auction to rank order, e.g. queue over fair share).
+    """
+    node_ok = snap.node_mask & snap.node_ready
+    fit = fits(
+        snap.task_req[:, None, :], state.node_idle[None, :, :], snap.eps
+    )
+
+    pred_fail = (~predicate_mask) & node_ok[None, :]
+    unfit = predicate_mask & ~fit & node_ok[None, :]
+    feasible = predicate_mask & fit & node_ok[None, :]
+    # Per-dimension shortfalls, one [T, N] pass per resource dim: a full
+    # [T, N, R] mask would be R× the predicate matrix's footprint, which
+    # at 50k-pod/5k-node scale is gigabytes; R is small, N is not.
+    insufficient = jnp.stack(
+        [
+            jnp.sum(
+                unfit
+                & (snap.task_req[:, None, r] > state.node_idle[None, :, r])
+                & (snap.task_req[:, r] >= snap.eps[r])[:, None],
+                axis=1,
+            )
+            for r in range(snap.num_resources)
+        ],
+        axis=1,
+    ).astype(jnp.int32)                                    # i32[T, R]
+    return {
+        "nodes": jnp.sum(node_ok).astype(jnp.int32),
+        "predicate_failed": jnp.sum(pred_fail, axis=1).astype(jnp.int32),
+        "insufficient": insufficient,
+        "feasible": jnp.sum(feasible, axis=1).astype(jnp.int32),
+    }
+
+
+def render_fit_error(
+    task_name: str,
+    counts: dict[str, np.ndarray],
+    t: int,
+    resource_names: tuple[str, ...],
+) -> str:
+    """One event line per unschedulable task (≙ FitErrors.Error())."""
+    total = int(counts["nodes"])
+    reasons: list[str] = []
+    pf = int(counts["predicate_failed"][t])
+    if pf:
+        reasons.append(f"{pf} node(s) failed predicates")
+    insuff = counts["insufficient"][t]
+    for r, name in enumerate(resource_names):
+        c = int(insuff[r])
+        if c:
+            reasons.append(f"{c} Insufficient {name}")
+    feas = int(counts["feasible"][t])
+    if feas:
+        reasons.append(
+            f"{feas} node(s) feasible but outranked (fair share / gang order)"
+        )
+    if not reasons:
+        reasons.append("no nodes in cluster")
+    return f"0/{total} nodes are available for {task_name}: " + ", ".join(reasons)
+
+
+def diagnose_pending(ssn, max_events: int = 1000) -> list[str]:
+    """Event lines for real tasks still Pending at session end.
+
+    Called from close_session; the [T, N] reductions run once on device,
+    only the small per-task tallies cross to host.  `max_events` bounds
+    per-cycle event volume on huge backlogs (the tail repeats the same
+    few reasons anyway).
+    """
+    snap, state = ssn.snap, ssn.state
+    task_state = np.asarray(state.task_state)
+    pending = np.nonzero(
+        task_state[: ssn.meta.num_real_tasks] == int(TaskStatus.PENDING)
+    )[0]
+    if pending.size == 0:
+        return []
+    pred = ssn.policy.predicate_mask(snap)
+    counts = {
+        k: np.asarray(v) for k, v in failure_counts(snap, state, pred).items()
+    }
+    out: list[str] = []
+    for t in pending[:max_events]:
+        pod = ssn.meta.task_pods[t]
+        out.append(render_fit_error(pod.name, counts, t, ssn.meta.spec.names))
+    if pending.size > max_events:
+        out.append(
+            f"... and {pending.size - max_events} more unschedulable tasks"
+        )
+    return out

@@ -22,6 +22,7 @@ from typing import Sequence
 
 import numpy as np
 
+from kube_batch_tpu import metrics
 from kube_batch_tpu.api.types import TaskStatus
 from kube_batch_tpu.cache.cache import SchedulerCache
 from kube_batch_tpu.cache.packer import SnapshotMeta, pack_snapshot
@@ -64,7 +65,8 @@ class Session:
         self.plugins = list(plugins)
 
         self.host = cache.snapshot()
-        self.snap, self.meta = pack_snapshot(self.host)
+        with metrics.snapshot_pack_latency.time():
+            self.snap, self.meta = pack_snapshot(self.host)
         self.state: AllocState = init_state(self.snap)
         self.initial_task_state = np.asarray(self.snap.task_state)
 
@@ -79,6 +81,7 @@ class Session:
             pod = self.meta.task_pods[int(t)]
             if self.cache.evict(pod.uid, reason):
                 self.evicted.append((pod.name, reason))
+                metrics.pods_evicted.inc(reason)
 
     def dispatch_binds(self) -> list[tuple[str, str]]:
         """Bind every newly allocated task of every JobReady job
@@ -103,6 +106,7 @@ class Session:
             node_name = self.meta.node_names[task_node[t]]
             if self.cache.bind(pod.uid, node_name):
                 self.bound.append((pod.name, node_name))
+                metrics.pods_bound.inc()
         return self.bound
 
     # -- introspection for plugins' close hooks ------------------------
@@ -122,17 +126,33 @@ def open_session(
     """≙ framework.go · OpenSession: snapshot + plugin open hooks."""
     ssn = Session(cache, policy, plugins)
     for plugin in ssn.plugins:
-        plugin.on_session_open(ssn)
+        with metrics.plugin_latency.time(plugin.name, "open"):
+            plugin.on_session_open(ssn)
     return ssn
 
 
-def close_session(ssn: Session) -> None:
-    """≙ framework.go · CloseSession: dispatch gang-gated binds, run
-    plugin close hooks (events/conditions), write back job status."""
+def close_session(ssn: Session, diagnose: bool = True) -> None:
+    """≙ framework.go · CloseSession: dispatch gang-gated binds, emit
+    why-unschedulable events, run plugin close hooks (events/
+    conditions), write back job status."""
+    from kube_batch_tpu.framework.fit_errors import diagnose_pending
+
     ssn.dispatch_binds()
+    if diagnose:
+        for line in diagnose_pending(ssn):
+            ssn.cache.events.append(line)
     for plugin in ssn.plugins:
-        plugin.on_session_close(ssn)
+        with metrics.plugin_latency.time(plugin.name, "close"):
+            plugin.on_session_close(ssn)
     for name in ssn.meta.job_names:
         job = ssn.host.jobs.get(name)
         if job is not None:
             ssn.cache.update_job_status(job.pod_group)
+    metrics.pending_tasks.set(
+        float(
+            np.sum(
+                np.asarray(ssn.state.task_state)[: ssn.meta.num_real_tasks]
+                == int(TaskStatus.PENDING)
+            )
+        )
+    )

@@ -1,0 +1,237 @@
+"""Prometheus-style metrics: the observability surface of the scheduler.
+
+Reference counterpart: pkg/scheduler/metrics/metrics.go — subsystem
+`kube_batch` histograms/counters (e2e scheduling latency, per-action and
+per-plugin latency, schedule attempts by result, preemption attempts and
+victims), registered with the Prometheus client and served on
+`--listen-address`.
+
+Dependency-free reimplementation: the same metric names and types, a
+process-global registry, text exposition in the Prometheus format, and
+an optional stdlib HTTP listener.  Device-side timing note: jitted
+solves are asynchronous — timers that should include device work must
+block on the result (`jax.block_until_ready`), which the scheduler loop
+does once per cycle anyway when decoding placements.
+"""
+
+from __future__ import annotations
+
+import http.server
+import threading
+import time
+from typing import Iterable
+
+SUBSYSTEM = "kube_batch"
+
+# Reference bucket layout: prometheus.DefBuckets-ish, in seconds.
+DEFAULT_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0
+)
+
+
+class _Metric:
+    def __init__(self, name: str, help_: str, labels: tuple[str, ...] = ()):
+        self.name = f"{SUBSYSTEM}_{name}"
+        self.help = help_
+        self.label_names = labels
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def _label_str(values: tuple[str, ...], names: tuple[str, ...]) -> str:
+        if not names:
+            return ""
+        pairs = ",".join(f'{k}="{v}"' for k, v in zip(names, values))
+        return "{" + pairs + "}"
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def __init__(self, name, help_, labels=()):
+        super().__init__(name, help_, labels)
+        self._values: dict[tuple[str, ...], float] = {}
+
+    def inc(self, *labels: str, by: float = 1.0) -> None:
+        with self._lock:
+            self._values[labels] = self._values.get(labels, 0.0) + by
+
+    def value(self, *labels: str) -> float:
+        with self._lock:
+            return self._values.get(labels, 0.0)
+
+    def expose(self) -> Iterable[str]:
+        yield f"# HELP {self.name} {self.help}"
+        yield f"# TYPE {self.name} {self.kind}"
+        with self._lock:
+            for labels, v in sorted(self._values.items()):
+                yield f"{self.name}{self._label_str(labels, self.label_names)} {v}"
+
+
+class Gauge(Counter):
+    kind = "gauge"
+
+    def set(self, value: float, *labels: str) -> None:
+        with self._lock:
+            self._values[labels] = value
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(self, name, help_, labels=(), buckets=DEFAULT_BUCKETS):
+        super().__init__(name, help_, labels)
+        self.buckets = tuple(buckets)
+        self._counts: dict[tuple[str, ...], list[int]] = {}
+        self._sums: dict[tuple[str, ...], float] = {}
+
+    def observe(self, value: float, *labels: str) -> None:
+        with self._lock:
+            counts = self._counts.setdefault(labels, [0] * (len(self.buckets) + 1))
+            self._sums[labels] = self._sums.get(labels, 0.0) + value
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    counts[i] += 1
+            counts[-1] += 1  # +Inf
+
+    def time(self, *labels: str):
+        """Context manager: observe the wall time of a block."""
+        hist = self
+
+        class _Timer:
+            def __enter__(self):
+                self.t0 = time.perf_counter()
+                return self
+
+            def __exit__(self, *exc):
+                hist.observe(time.perf_counter() - self.t0, *labels)
+                return False
+
+        return _Timer()
+
+    def count(self, *labels: str) -> int:
+        with self._lock:
+            c = self._counts.get(labels)
+            return c[-1] if c else 0
+
+    def sum(self, *labels: str) -> float:
+        with self._lock:
+            return self._sums.get(labels, 0.0)
+
+    def quantile(self, q: float, *labels: str) -> float:
+        """Approximate quantile from bucket boundaries (upper bound of
+        the bucket containing the q-th observation)."""
+        with self._lock:
+            c = self._counts.get(labels)
+            if not c or c[-1] == 0:
+                return 0.0
+            target = q * c[-1]
+            for i, b in enumerate(self.buckets):
+                if c[i] >= target:
+                    return b
+            return float("inf")
+
+    def expose(self) -> Iterable[str]:
+        yield f"# HELP {self.name} {self.help}"
+        yield f"# TYPE {self.name} {self.kind}"
+        with self._lock:
+            for labels, counts in sorted(self._counts.items()):
+                base = self._label_str(labels, self.label_names)
+                for i, b in enumerate(self.buckets):
+                    le = self._label_str(
+                        labels + (str(b),), self.label_names + ("le",)
+                    )
+                    yield f"{self.name}_bucket{le} {counts[i]}"
+                inf = self._label_str(
+                    labels + ("+Inf",), self.label_names + ("le",)
+                )
+                yield f"{self.name}_bucket{inf} {counts[-1]}"
+                yield f"{self.name}_sum{base} {self._sums[labels]}"
+                yield f"{self.name}_count{base} {counts[-1]}"
+
+
+class Registry:
+    def __init__(self) -> None:
+        self._metrics: list[_Metric] = []
+        self._lock = threading.Lock()
+
+    def register(self, metric: _Metric) -> _Metric:
+        with self._lock:
+            self._metrics.append(metric)
+        return metric
+
+    def expose(self) -> str:
+        lines: list[str] = []
+        with self._lock:
+            metrics = list(self._metrics)
+        for m in metrics:
+            lines.extend(m.expose())
+        return "\n".join(lines) + "\n"
+
+
+REGISTRY = Registry()
+
+# -- the reference's metric set (metrics.go) --------------------------------
+e2e_latency = REGISTRY.register(Histogram(
+    "e2e_scheduling_latency_seconds",
+    "End-to-end scheduling cycle latency (snapshot to commit).",
+))
+action_latency = REGISTRY.register(Histogram(
+    "action_scheduling_latency_seconds",
+    "Per-action execution latency.",
+    labels=("action",),
+))
+plugin_latency = REGISTRY.register(Histogram(
+    "plugin_scheduling_latency_seconds",
+    "Per-plugin session-hook latency.",
+    labels=("plugin", "hook"),
+))
+schedule_attempts = REGISTRY.register(Counter(
+    "schedule_attempts_total",
+    "Scheduling cycles by result (scheduled|unschedulable|error).",
+    labels=("result",),
+))
+pods_bound = REGISTRY.register(Counter(
+    "pod_bind_total", "Pods bound to nodes.",
+))
+pods_evicted = REGISTRY.register(Counter(
+    "pod_evict_total", "Pods evicted, by action (preempted|reclaimed).",
+    labels=("reason",),
+))
+preemption_attempts = REGISTRY.register(Counter(
+    "preemption_attempts_total", "Preempt/reclaim sweeps executed.",
+))
+snapshot_pack_latency = REGISTRY.register(Histogram(
+    "snapshot_pack_latency_seconds",
+    "HostSnapshot to device-tensor packing latency (H2D boundary).",
+))
+pending_tasks = REGISTRY.register(Gauge(
+    "pending_tasks", "Tasks still pending at session close.",
+))
+
+
+def serve(address: str = ":8080") -> threading.Thread:
+    """Serve /metrics on `address` (≙ --listen-address), daemon thread."""
+    host, _, port = address.rpartition(":")
+
+    registry = REGISTRY
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802 (stdlib API)
+            if self.path != "/metrics":
+                self.send_error(404)
+                return
+            body = registry.expose().encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args):  # silence per-request stderr noise
+            return
+
+    server = http.server.ThreadingHTTPServer((host or "", int(port)), Handler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.server = server  # type: ignore[attr-defined] — for tests/shutdown
+    thread.start()
+    return thread

@@ -19,6 +19,7 @@ import logging
 import threading
 import time
 
+from kube_batch_tpu import metrics
 from kube_batch_tpu.actions import factory as _action_factory  # noqa: F401
 from kube_batch_tpu.cache.cache import SchedulerCache
 from kube_batch_tpu.framework.conf import SchedulerConf, load_conf
@@ -87,11 +88,18 @@ class Scheduler:
 
     # -- one cycle (≙ scheduler.go · runOnce) ---------------------------
     def run_once(self) -> Session:
-        self._reload_conf()
-        ssn = open_session(self.cache, self._policy, self._plugins)
-        for action in self._actions:
-            action.execute(ssn)
-        close_session(ssn)
+        with metrics.e2e_latency.time():
+            self._reload_conf()
+            ssn = open_session(self.cache, self._policy, self._plugins)
+            for action in self._actions:
+                with metrics.action_latency.time(action.name):
+                    action.execute(ssn)
+                if action.name in ("preempt", "reclaim"):
+                    metrics.preemption_attempts.inc()
+            close_session(ssn)
+        metrics.schedule_attempts.inc(
+            "scheduled" if (ssn.bound or ssn.evicted) else "unschedulable"
+        )
         return ssn
 
     # -- the loop (≙ scheduler.go · Run / wait.Until) -------------------
@@ -99,11 +107,16 @@ class Scheduler:
         self,
         stop: threading.Event | None = None,
         max_cycles: int | None = None,
+        on_cycle=None,
     ) -> int:
         """Run cycles every `schedule_period` until `stop` is set or
         `max_cycles` elapse (both None → run forever, ≙ wait.Until).
         A failing cycle is logged and the loop keeps going, like the
-        reference daemon.  Returns the number of cycles run."""
+        reference daemon.  `on_cycle()` fires after every cycle, failed
+        or not — the CLI hooks the simulator's tick here (the role
+        kubelet/controllers play against the reference; the world
+        advances regardless of scheduler hiccups).  Returns the number
+        of cycles run."""
         cycles = 0
         while (stop is None or not stop.is_set()) and (
             max_cycles is None or cycles < max_cycles
@@ -114,7 +127,10 @@ class Scheduler:
             except Exception:  # noqa: BLE001
                 if self._conf is None:
                     raise  # never successfully configured: fail loud
+                metrics.schedule_attempts.inc("error")
                 logging.exception("scheduling cycle failed; continuing")
+            if on_cycle is not None:
+                on_cycle()
             cycles += 1
             sleep_for = self.schedule_period - (time.monotonic() - started)
             if sleep_for > 0 and (max_cycles is None or cycles < max_cycles):

@@ -1,0 +1,13 @@
+"""Version info (≙ pkg/version · PrintVersionAndExit)."""
+
+VERSION = "0.1.0"
+FRAMEWORK = "kube-batch-tpu"
+
+
+def version_string() -> str:
+    import jax
+
+    return (
+        f"{FRAMEWORK} {VERSION} "
+        f"(jax {jax.__version__}, backend {jax.default_backend()})"
+    )

@@ -1,0 +1,83 @@
+"""CLI entry tests (≙ cmd/kube-batch/app: options, HA gate, serve loop)."""
+
+import subprocess
+import sys
+
+import yaml
+
+from kube_batch_tpu.cli import acquire_leadership, build_parser, load_world, main
+
+
+def test_version_flag(capsys):
+    assert main(["--version"]) == 0
+    assert "kube-batch-tpu" in capsys.readouterr().out
+
+
+def test_defaults_mirror_reference():
+    args = build_parser().parse_args([])
+    assert args.schedule_period == 1.0
+    assert args.default_queue == "default"
+    assert args.listen_address == ":8080"
+
+
+def test_workload_yaml_world(tmp_path):
+    world = {
+        "queues": [{"name": "gold", "weight": 2}],
+        "nodes": [
+            {"name": "n0", "allocatable": {"cpu": 4000, "memory": 8 << 30, "pods": 110}}
+        ],
+        "jobs": [
+            {
+                "name": "j1",
+                "queue": "gold",
+                "minMember": 2,
+                "pods": [
+                    {"name": "j1-0", "request": {"cpu": 1000, "pods": 1}},
+                    {"name": "j1-1", "request": {"cpu": 1000, "pods": 1}},
+                ],
+            }
+        ],
+    }
+    path = tmp_path / "world.yaml"
+    path.write_text(yaml.safe_dump(world))
+    cache, sim = load_world(str(path), "default")
+    snap = cache.snapshot()
+    assert set(snap.queues) == {"default", "gold"}
+    assert set(snap.nodes) == {"n0"}
+    assert snap.jobs["j1"].min_available == 2
+
+
+def test_main_runs_cycles_on_config1(tmp_path):
+    # full in-process run: 2 cycles over BASELINE config 1, no listener
+    rc = main(
+        ["--workload", "1", "--cycles", "2", "--schedule-period", "0",
+         "--listen-address", ""]
+    )
+    assert rc == 0
+
+
+def test_leader_election_blocks_second_acquirer(tmp_path):
+    lock_path = str(tmp_path / "leader.lock")
+    holder = acquire_leadership(lock_path)
+    # a second process must NOT get the lock while we hold it
+    probe = subprocess.run(
+        [
+            sys.executable,
+            "-c",
+            (
+                "import fcntl,sys\n"
+                f"f=open({lock_path!r},'a+')\n"
+                "try:\n"
+                "    fcntl.flock(f, fcntl.LOCK_EX | fcntl.LOCK_NB)\n"
+                "    sys.exit(1)\n"
+                "except BlockingIOError:\n"
+                "    sys.exit(0)\n"
+            ),
+        ],
+        timeout=30,
+    )
+    assert probe.returncode == 0
+    holder.close()
+    # released → immediate acquisition succeeds
+    again = acquire_leadership(lock_path)
+    again.close()

@@ -1,0 +1,82 @@
+"""Metrics + unschedulable-diagnosis tests.
+
+Reference behaviors: pkg/scheduler/metrics/metrics.go (latency
+histograms, attempt counters, Prometheus exposition) and
+api/unschedule_info.go (FitErrors "0/N nodes are available" events).
+"""
+
+import urllib.request
+
+from kube_batch_tpu import metrics
+from kube_batch_tpu.api.resource import ResourceSpec
+from kube_batch_tpu.cache.cluster import Node, Pod, PodGroup
+from kube_batch_tpu.models.workloads import GI, build_config
+from kube_batch_tpu.scheduler import Scheduler
+from kube_batch_tpu.sim.simulator import make_world
+
+SPEC = ResourceSpec(("cpu", "memory", "pods", "accelerator"))
+
+
+def test_cycle_records_latency_and_binds():
+    before = metrics.pods_bound.value()
+    cache, sim = build_config(1)
+    Scheduler(cache).run_once()
+    assert metrics.pods_bound.value() - before == 8
+    assert metrics.e2e_latency.count() >= 1
+    assert metrics.action_latency.count("allocate") >= 1
+    assert metrics.schedule_attempts.value("scheduled") >= 1
+
+
+def test_exposition_is_prometheus_text():
+    text = metrics.REGISTRY.expose()
+    assert "# TYPE kube_batch_e2e_scheduling_latency_seconds histogram" in text
+    assert "kube_batch_e2e_scheduling_latency_seconds_bucket" in text
+    assert 'le="+Inf"' in text
+
+
+def test_metrics_http_endpoint():
+    thread = metrics.serve(":0")
+    try:
+        port = thread.server.server_address[1]
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5
+        ).read().decode()
+        assert "kube_batch_schedule_attempts_total" in body
+    finally:
+        thread.server.shutdown()
+
+
+def test_unschedulable_event_names_the_shortfall():
+    cache, sim = make_world(SPEC)
+    sim.add_node(
+        Node(name="n0", allocatable={"cpu": 1000, "memory": 2 * GI, "pods": 110})
+    )
+    sim.submit(
+        PodGroup(name="big", queue="default", min_member=1),
+        [Pod(name="big-0", request={"cpu": 64000, "memory": 4 * GI, "pods": 1})],
+    )
+    Scheduler(cache).run_once()
+    diag = [e for e in cache.events if "0/1 nodes are available" in e]
+    assert diag, cache.events
+    assert "Insufficient cpu" in diag[0]
+    assert "big-0" in diag[0]
+
+
+def test_feasible_but_outranked_is_reported():
+    """A pod with room that lost to gang all-or-nothing shows as
+    feasible-but-outranked, not as a resource shortfall."""
+    cache, sim = make_world(SPEC)
+    sim.add_node(
+        Node(name="n0", allocatable={"cpu": 4000, "memory": 8 * GI, "pods": 110})
+    )
+    # Gang of 3 where only 2 fit: nothing binds, but nodes WERE feasible.
+    sim.submit(
+        PodGroup(name="g", queue="default", min_member=3),
+        [
+            Pod(name=f"g-{i}", request={"cpu": 2000, "memory": 4 * GI, "pods": 1})
+            for i in range(3)
+        ],
+    )
+    Scheduler(cache).run_once()
+    diag = [e for e in cache.events if "nodes are available" in e]
+    assert any("outranked" in e or "Insufficient" in e for e in diag)
