@@ -1,0 +1,30 @@
+# ≙ the reference's Makefile targets (unit-test / e2e / verify), adapted.
+
+PY ?= python
+
+.PHONY: test unit-test e2e bench run-example verify clean
+
+test: unit-test
+
+unit-test:
+	$(PY) -m pytest tests/ -q
+
+e2e:
+	$(PY) -m pytest tests/test_e2e_pipeline.py tests/test_scheduler.py -q
+
+bench:
+	$(PY) bench.py
+
+run-example:
+	$(PY) -m kube_batch_tpu --workload examples/world.yaml \
+	    --scheduler-conf examples/scheduler.conf \
+	    --cycles 3 --schedule-period 0 --listen-address ""
+
+verify:
+	$(PY) -m pytest tests/ -q
+	$(PY) -c "import __graft_entry__ as g; g.entry()"
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	    $(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+clean:
+	find . -name __pycache__ -type d -exec rm -rf {} +

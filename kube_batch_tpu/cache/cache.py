@@ -249,6 +249,17 @@ class SchedulerCache:
         if self.status_updater is not None:
             self.status_updater.update_pod_group(group)
 
+    def refresh_job_statuses(self, names) -> None:
+        """Recompute + write back PodGroup statuses for `names`, under
+        the cache lock (event handlers may be mutating job.tasks from an
+        adapter thread; ≙ job_updater.go running against live informers)."""
+        with self._lock:
+            groups = [
+                self._jobs[n].refresh_status() for n in names if n in self._jobs
+            ]
+        for group in groups:
+            self.update_job_status(group)
+
     def drain_resync(self) -> list[str]:
         """Pod uids whose binds failed since last drain; the scheduler
         loop retries them next cycle (≙ processResyncTask)."""

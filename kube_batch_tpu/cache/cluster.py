@@ -117,7 +117,11 @@ class PodGroup:
     queue: str = ""                    # empty → scheduler default queue
     min_member: int = 1
     priority: int = 0                  # ≙ PriorityClassName resolved value
+    # -- status subresource (≙ v1alpha1 PodGroupStatus) -----------------
     phase: PodGroupPhase = PodGroupPhase.PENDING
+    running: int = 0
+    succeeded: int = 0
+    failed: int = 0
     conditions: list[str] = dataclasses.field(default_factory=list)
     uid: str = dataclasses.field(default_factory=lambda: _new_uid("pg"))
     creation: int = dataclasses.field(default_factory=lambda: next(_uid_counter))

@@ -183,6 +183,28 @@ class JobInfo:
                 out += self.spec.vec(t.request)
         return out
 
+    def refresh_status(self) -> PodGroup:
+        """Recompute the PodGroup status subresource from member tasks
+        (≙ framework/job_updater.go batching PodGroup status updates at
+        session close): running/succeeded/failed counts, and phase —
+        Running once the gang holds minMember running-or-done members,
+        Unknown for a broken gang (some members running but below the
+        threshold), Pending otherwise."""
+        from kube_batch_tpu.api.types import PodGroupPhase
+
+        pg = self.pod_group
+        pg.running = self._count({TaskStatus.RUNNING, TaskStatus.BOUND,
+                                  TaskStatus.BINDING})
+        pg.succeeded = self._count({TaskStatus.SUCCEEDED})
+        pg.failed = self._count({TaskStatus.FAILED})
+        if pg.running + pg.succeeded >= self.min_available and self.tasks:
+            pg.phase = PodGroupPhase.RUNNING
+        elif pg.running > 0:
+            pg.phase = PodGroupPhase.UNKNOWN   # gang degraded below minMember
+        else:
+            pg.phase = PodGroupPhase.PENDING
+        return pg
+
     def clone(self, pod_map: dict[str, Pod] | None = None) -> "JobInfo":
         """Deep copy (see NodeInfo.clone for `pod_map`)."""
         tasks = (

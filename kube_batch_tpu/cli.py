@@ -66,9 +66,20 @@ def load_world(spec_arg: str | None, default_queue: str):
         spec = ResourceSpec()
         return make_world(spec, default_queue=default_queue)
     if spec_arg.isdigit():
-        from kube_batch_tpu.models.workloads import build_config
+        from kube_batch_tpu.models.workloads import CONFIG_BUILDERS, build_config
 
-        return build_config(int(spec_arg))
+        n = int(spec_arg)
+        if n not in CONFIG_BUILDERS:
+            raise SystemExit(
+                f"--workload {n}: built-in configs are "
+                f"{sorted(CONFIG_BUILDERS)} (or pass a YAML world file)"
+            )
+        if default_queue != "default":
+            logging.warning(
+                "--default-queue %r ignored: built-in config %d defines "
+                "its own queues", default_queue, n,
+            )
+        return build_config(n)
     with open(spec_arg, "r", encoding="utf-8") as f:
         raw = yaml.safe_load(f) or {}
     names = tuple(raw.get("resources", ("cpu", "memory", "pods", "accelerator")))

@@ -107,10 +107,19 @@ def diagnose_pending(ssn, max_events: int = 1000) -> list[str]:
     )[0]
     if pending.size == 0:
         return []
-    pred = ssn.policy.predicate_mask(snap)
-    counts = {
-        k: np.asarray(v) for k, v in failure_counts(snap, state, pred).items()
-    }
+    # One jitted dispatch for the whole diagnosis (predicate mask
+    # included): eager per-reduction dispatches would each pay the
+    # tunneled backend's fixed per-dispatch RTT (see bench.py notes).
+    policy = ssn.policy
+    diag = getattr(policy, "_diagnose_jit", None)
+    if diag is None:
+        import jax
+
+        diag = jax.jit(
+            lambda s, st: failure_counts(s, st, policy.predicate_mask(s))
+        )
+        policy._diagnose_jit = diag
+    counts = {k: np.asarray(v) for k, v in diag(snap, state).items()}
     out: list[str] = []
     for t in pending[:max_events]:
         pod = ssn.meta.task_pods[t]

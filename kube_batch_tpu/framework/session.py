@@ -144,10 +144,10 @@ def close_session(ssn: Session, diagnose: bool = True) -> None:
     for plugin in ssn.plugins:
         with metrics.plugin_latency.time(plugin.name, "close"):
             plugin.on_session_close(ssn)
-    for name in ssn.meta.job_names:
-        job = ssn.host.jobs.get(name)
-        if job is not None:
-            ssn.cache.update_job_status(job.pod_group)
+    # Status writeback against the LIVE cache jobs, so phases reflect
+    # this cycle's binds/evictions (≙ job_updater.go batching PodGroup
+    # status updates at CloseSession).
+    ssn.cache.refresh_job_statuses(ssn.meta.job_names)
     metrics.pending_tasks.set(
         float(
             np.sum(

@@ -97,9 +97,13 @@ class Scheduler:
                 if action.name in ("preempt", "reclaim"):
                     metrics.preemption_attempts.inc()
             close_session(ssn)
-        metrics.schedule_attempts.inc(
-            "scheduled" if (ssn.bound or ssn.evicted) else "unschedulable"
-        )
+        if ssn.bound or ssn.evicted:
+            result = "scheduled"
+        elif metrics.pending_tasks.value() > 0:
+            result = "unschedulable"   # pending work, nothing placeable
+        else:
+            result = "idle"            # nothing pending — not a failure
+        metrics.schedule_attempts.inc(result)
         return ssn
 
     # -- the loop (≙ scheduler.go · Run / wait.Until) -------------------

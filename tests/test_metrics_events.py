@@ -62,6 +62,24 @@ def test_unschedulable_event_names_the_shortfall():
     assert "big-0" in diag[0]
 
 
+def test_pod_group_phase_transitions():
+    """PodGroup status subresource tracks the gang lifecycle
+    (≙ job_updater.go): Pending → Running once minMember members run."""
+    from kube_batch_tpu.api.types import PodGroupPhase
+
+    cache, sim = build_config(1)
+    s = Scheduler(cache)
+    pg = cache._jobs["pg1"].pod_group
+    assert pg.phase == PodGroupPhase.PENDING
+
+    s.run_once()          # binds all 8
+    assert pg.running == 8
+    assert pg.phase == PodGroupPhase.RUNNING
+    sim.tick()
+    s.run_once()
+    assert pg.phase == PodGroupPhase.RUNNING
+
+
 def test_feasible_but_outranked_is_reported():
     """A pod with room that lost to gang all-or-nothing shows as
     feasible-but-outranked, not as a resource shortfall."""
