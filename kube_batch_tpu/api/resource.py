@@ -62,6 +62,19 @@ class ResourceSpec:
     def index(self, name: str) -> int:
         return self.names.index(name)
 
+    def pod_vec(self, pod) -> np.ndarray:
+        """Memoizing `vec` over a Pod's request (see cluster.Pod.req_vec):
+        computed once per pod lifetime, shared by host accounting and the
+        per-cycle snapshot packer.  The memo is keyed on this spec's
+        dimension order, so a pod crossing into a differently-ordered
+        spec recomputes instead of silently returning swapped dims."""
+        memo = pod.req_vec
+        if memo is not None and memo[0] is self.names:
+            return memo[1]
+        v = self.vec(pod.request)
+        pod.req_vec = (self.names, v)
+        return v
+
     @property
     def eps(self) -> np.ndarray:
         """Per-dimension negligibility thresholds, shape [R]."""

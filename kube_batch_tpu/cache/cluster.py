@@ -60,6 +60,12 @@ class Pod:
     node: str | None = None            # assigned node name, if any
     uid: str = dataclasses.field(default_factory=lambda: _new_uid("pod"))
     creation: int = dataclasses.field(default_factory=lambda: next(_uid_counter))
+    # Memoized (spec.names, vector) for the request (filled on first
+    # use; requests are immutable once submitted).  Shared by reference
+    # through the snapshot's __copy__ fast path, so the per-cycle
+    # packer never re-walks 50k request dicts — measured 35% of pack
+    # time at config-5 scale.
+    req_vec: object = dataclasses.field(default=None, repr=False, compare=False)
 
     def __post_init__(self) -> None:
         bad = [k for k in self.preferences if "=" not in k]
@@ -68,6 +74,14 @@ class Pod:
                 f"pod {self.name}: preference keys must be 'key=value' label "
                 f"strings (got {bad!r}); selector-style bare keys never match"
             )
+
+    def __copy__(self) -> "Pod":
+        """Fast shallow copy: the snapshot path copies every pod every
+        cycle (50k/cycle at config-5 scale), and the default dataclass
+        copy machinery measurably dominates that path."""
+        new = object.__new__(type(self))
+        new.__dict__.update(self.__dict__)
+        return new
 
     @property
     def critical(self) -> bool:

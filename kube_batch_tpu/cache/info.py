@@ -67,7 +67,7 @@ class NodeInfo:
         """Account a task landing on this node (node_info.go · AddTask)."""
         if pod.uid in self.tasks:
             raise ValueError(f"task {pod.uid} already on node {self.name}")
-        req = self.spec.vec(pod.request)
+        req = self.spec.pod_vec(pod)
         if self._occupies(pod.status):
             self.idle = self.idle - req
             self.used = self.used + req
@@ -79,7 +79,7 @@ class NodeInfo:
         """Reverse add_task (node_info.go · RemoveTask)."""
         if pod.uid not in self.tasks:
             raise ValueError(f"task {pod.uid} not on node {self.name}")
-        req = self.spec.vec(pod.request)
+        req = self.spec.pod_vec(pod)
         if self._occupies(pod.status):
             self.idle = self.idle + req
             self.used = self.used - req
@@ -180,7 +180,7 @@ class JobInfo:
         out = np.zeros(self.spec.num)
         for t in self.tasks.values():
             if t.status not in (TaskStatus.SUCCEEDED, TaskStatus.FAILED):
-                out += self.spec.vec(t.request)
+                out += self.spec.pod_vec(t)
         return out
 
     def refresh_status(self) -> PodGroup:
