@@ -59,6 +59,8 @@ def make_allocate_solver(policy, max_rounds: int | None = None):
                 use_future=use_future,
                 max_rounds=max_rounds,
                 score_quantum=policy.score_quantum,
+                dyn_predicate_fn=policy.dyn_predicate,
+                global_serialize_fn=policy.global_serialize_fn,
             )
         return state
 

@@ -59,6 +59,8 @@ def make_backfill_solver(policy, max_rounds: int | None = None):
             eligible,
             snap.eps,
             max_rounds=max_rounds,
+            dyn_predicate_fn=policy.dyn_predicate,
+            global_serialize_fn=policy.global_serialize_fn,
         )
 
     return solve

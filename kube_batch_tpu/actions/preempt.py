@@ -111,6 +111,7 @@ def make_preempt_solver(policy, max_iters: int | None = None):
             eligible,
             snap.eps,
             max_iters=max_iters,
+            dyn_predicate_row_fn=policy.dyn_predicate_row,
         )
 
     return solve

@@ -82,6 +82,7 @@ def make_reclaim_solver(policy, max_iters: int | None = None):
             non_besteffort_eligible(policy),
             snap.eps,
             max_iters=max_iters,
+            dyn_predicate_row_fn=policy.dyn_predicate_row,
         )
 
     return solve

@@ -62,6 +62,11 @@ class SnapshotTensors:
     task_tol: jax.Array        # f32[T, V]  tolerated taints, multi-hot
     task_ports: jax.Array      # f32[T, P]  requested host ports, multi-hot
     task_critical: jax.Array   # bool[T]    conformance-protected (critical) pod
+    # inter-pod affinity over the pod-label vocab (K = pod-label vocab)
+    task_podlabels: jax.Array  # f32[T, K]  this pod's own labels, multi-hot
+    task_aff: jax.Array        # f32[T, K]  required co-location terms
+    task_anti: jax.Array       # f32[T, K]  required anti-affinity terms
+    task_podpref: jax.Array    # f32[T, K]  preferred co-location, weighted
 
     # -- jobs -----------------------------------------------------------
     job_queue: jax.Array       # i32[J]     owning queue index
@@ -122,6 +127,7 @@ class SnapshotTensors:
             self.task_sel.shape[1],
             self.task_tol.shape[1],
             self.task_ports.shape[1],
+            self.task_podlabels.shape[1],
         )
 
 

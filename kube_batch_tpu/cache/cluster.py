@@ -17,6 +17,7 @@ Simplifications (documented contract):
 
 from __future__ import annotations
 
+import copy
 import dataclasses
 import itertools
 from typing import Mapping
@@ -48,6 +49,18 @@ class Pod:
     priority: int = 0
     namespace: str = "default"
     selector: Mapping[str, str] = dataclasses.field(default_factory=dict)
+    # -- inter-pod affinity (topologyKey = node) ------------------------
+    # `labels` are this pod's own matchable labels; `affinity` terms
+    # require ≥1 resident pod carrying the label on the target node;
+    # `anti_affinity` terms forbid any such resident (and symmetrically,
+    # a resident's anti term blocks newcomers matching it); `pod_prefs`
+    # are soft co-location terms with weights (the
+    # InterPodAffinityPriority analog).  All terms are "key=value"
+    # strings, matching the node-label simplification above.
+    labels: Mapping[str, str] = dataclasses.field(default_factory=dict)
+    affinity: frozenset[str] = frozenset()
+    anti_affinity: frozenset[str] = frozenset()
+    pod_prefs: Mapping[str, float] = dataclasses.field(default_factory=dict)
     # Preferred (soft) node labels with weights — the analog of
     # preferredDuringScheduling node-affinity terms consumed by the
     # nodeorder plugin's NodeAffinityPriority score.  Keys are full
@@ -74,6 +87,18 @@ class Pod:
                 f"pod {self.name}: preference keys must be 'key=value' label "
                 f"strings (got {bad!r}); selector-style bare keys never match"
             )
+
+    def respawn(self) -> "Pod":
+        """A fresh Pending pod from this pod's template — what a
+        workload controller creates after its pod is deleted.  Copies
+        EVERY spec field (a hand-written field list here silently drops
+        newly added ones); only identity and runtime state are reset."""
+        new = copy.copy(self)
+        new.uid = _new_uid("pod")
+        new.creation = next(_uid_counter)
+        new.status = TaskStatus.PENDING
+        new.node = None
+        return new
 
     def __copy__(self) -> "Pod":
         """Fast shallow copy: the snapshot path copies every pod every

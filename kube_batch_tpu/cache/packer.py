@@ -37,6 +37,7 @@ class SnapshotMeta:
     label_vocab: tuple[str, ...]
     taint_vocab: tuple[str, ...]
     port_vocab: tuple[int, ...]
+    podlabel_vocab: tuple[str, ...] = ()
 
     @property
     def num_real_tasks(self) -> int:
@@ -80,6 +81,7 @@ def pack_snapshot(host: HostSnapshot) -> tuple[SnapshotTensors, SnapshotMeta]:
     labels: set[str] = set()
     taints: set[str] = set()
     ports: set[int] = set()
+    podlabels: set[str] = set()
     for pod in tasks:
         # empty-attribute guards: most pods carry no selector/taints/
         # ports, and skipping the no-op set.update calls removes ~200k
@@ -92,6 +94,14 @@ def pack_snapshot(host: HostSnapshot) -> tuple[SnapshotTensors, SnapshotMeta]:
             taints.update(pod.tolerations)
         if pod.ports:
             ports.update(pod.ports)
+        if pod.labels:
+            podlabels.update(f"{k}={v}" for k, v in pod.labels.items())
+        if pod.affinity:
+            podlabels.update(pod.affinity)
+        if pod.anti_affinity:
+            podlabels.update(pod.anti_affinity)
+        if pod.pod_prefs:
+            podlabels.update(pod.pod_prefs)
     node_resident_ports: dict[str, set[int]] = {}
     for nname in node_names:
         info = host.nodes[nname]
@@ -106,13 +116,16 @@ def pack_snapshot(host: HostSnapshot) -> tuple[SnapshotTensors, SnapshotMeta]:
     label_vocab = tuple(sorted(labels))
     taint_vocab = tuple(sorted(taints))
     port_vocab = tuple(sorted(ports))
+    podlabel_vocab = tuple(sorted(podlabels))
     lab_idx = {s: i for i, s in enumerate(label_vocab)}
     tnt_idx = {s: i for i, s in enumerate(taint_vocab)}
     prt_idx = {p: i for i, p in enumerate(port_vocab)}
+    pl_idx = {s: i for i, s in enumerate(podlabel_vocab)}
 
     T, J, N, Q = len(tasks), len(job_names), len(node_names), len(queue_names)
     Tp, Jp, Np, Qp = bucket(T), bucket(J), bucket(N), bucket(Q)
     L, V, P = bucket(len(label_vocab)), bucket(len(taint_vocab)), bucket(len(port_vocab))
+    K = bucket(len(podlabel_vocab))
 
     # -- task tensors ---------------------------------------------------
     task_req = np.stack(
@@ -147,6 +160,23 @@ def pack_snapshot(host: HostSnapshot) -> tuple[SnapshotTensors, SnapshotMeta]:
          for p in tasks], T, P,
     )
     task_critical = np.array([p.critical for p in tasks], dtype=bool)
+    task_podlabels = _multi_hot(
+        [[pl_idx[f"{k}={v}"] for k, v in p.labels.items()] if p.labels else _empty
+         for p in tasks], T, K,
+    )
+    task_aff = _multi_hot(
+        [[pl_idx[a] for a in p.affinity] if p.affinity else _empty
+         for p in tasks], T, K,
+    )
+    task_anti = _multi_hot(
+        [[pl_idx[a] for a in p.anti_affinity] if p.anti_affinity else _empty
+         for p in tasks], T, K,
+    )
+    task_podpref = np.zeros((T, K), dtype=np.float32)
+    for i, p in enumerate(tasks):
+        if p.pod_prefs:
+            for term, w in p.pod_prefs.items():
+                task_podpref[i, pl_idx[term]] = w
 
     # -- job tensors ----------------------------------------------------
     job_queue = np.array(
@@ -203,6 +233,10 @@ def pack_snapshot(host: HostSnapshot) -> tuple[SnapshotTensors, SnapshotMeta]:
         task_tol=jnp.asarray(pad_rows(task_tol, Tp)),
         task_ports=jnp.asarray(pad_rows(task_ports, Tp)),
         task_critical=jnp.asarray(pad_rows(task_critical, Tp, False)),
+        task_podlabels=jnp.asarray(pad_rows(task_podlabels, Tp)),
+        task_aff=jnp.asarray(pad_rows(task_aff, Tp)),
+        task_anti=jnp.asarray(pad_rows(task_anti, Tp)),
+        task_podpref=jnp.asarray(pad_rows(task_podpref, Tp)),
         job_queue=jnp.asarray(pad_rows(job_queue, Jp, NONE_IDX)),
         job_min=jnp.asarray(pad_rows(job_min, Jp)),
         job_prio=jnp.asarray(pad_rows(job_prio, Jp)),
@@ -240,5 +274,6 @@ def pack_snapshot(host: HostSnapshot) -> tuple[SnapshotTensors, SnapshotMeta]:
         label_vocab=label_vocab,
         taint_vocab=taint_vocab,
         port_vocab=port_vocab,
+        podlabel_vocab=podlabel_vocab,
     )
     return snap, meta

@@ -107,6 +107,10 @@ def load_world(spec_arg: str | None, default_queue: str):
                 priority=int(p.get("priority", group.priority)),
                 selector=dict(p.get("selector", {})),
                 tolerations=frozenset(p.get("tolerations", [])),
+                labels=dict(p.get("labels", {})),
+                affinity=frozenset(p.get("affinity", [])),
+                anti_affinity=frozenset(p.get("antiAffinity", [])),
+                pod_prefs=dict(p.get("podPrefs", {})),
             )
             for p in j.get("pods", [])
         ]

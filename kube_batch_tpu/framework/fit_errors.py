@@ -115,9 +115,12 @@ def diagnose_pending(ssn, max_events: int = 1000) -> list[str]:
     if diag is None:
         import jax
 
-        diag = jax.jit(
-            lambda s, st: failure_counts(s, st, policy.predicate_mask(s))
-        )
+        def full_mask(s, st):
+            m = policy.predicate_mask(s)
+            dyn = policy.dynamic_predicate_fn(s, st)
+            return m if dyn is None else m & dyn
+
+        diag = jax.jit(lambda s, st: failure_counts(s, st, full_mask(s, st)))
         policy._diagnose_jit = diag
     counts = {k: np.asarray(v) for k, v in diag(snap, state).items()}
     out: list[str] = []

@@ -101,6 +101,18 @@ class TensorPolicy:
         self.job_order: list[list[JobKeyFn]] = [[] for _ in range(num_tiers)]
         self.task_order: list[list[TaskKeyFn]] = [[] for _ in range(num_tiers)]
         self.predicates: list[PredicateFn] = []
+        # State-dependent predicates ((snap, state) -> bool[T, N]),
+        # re-evaluated inside every auction round / preemption step —
+        # inter-pod affinity lives here, because feasibility depends on
+        # placements made earlier in the same cycle (the reference gets
+        # this for free from its serial per-task PredicateNodes calls).
+        # Each entry is (full_fn, row_fn|None); row_fn(snap, state, p)
+        # -> bool[N] lets the preemption kernel evaluate one task
+        # without materializing [T, N] every step.
+        self.dynamic_predicates: list[tuple[NodeScoreFn, object]] = []
+        # bool[T] masks of tasks that must be accepted at most one per
+        # auction round globally (affinity bootstrap claimants).
+        self.global_serialize: list = []
         self.node_scores: list[tuple[float, NodeScoreFn]] = []
         self.job_valid: list[JobBoolFn] = []
         self.job_ready: list[JobBoolFn] = []
@@ -129,6 +141,12 @@ class TensorPolicy:
 
     def add_predicate_fn(self, fn: PredicateFn) -> None:
         self.predicates.append(fn)
+
+    def add_dynamic_predicate_fn(self, fn: NodeScoreFn, row_fn=None) -> None:
+        self.dynamic_predicates.append((fn, row_fn))
+
+    def add_global_serialize_fn(self, fn) -> None:
+        self.global_serialize.append(fn)
 
     def add_node_order_fn(
         self, weight: float, fn: NodeScoreFn, state_dependent: bool = True
@@ -197,6 +215,60 @@ class TensorPolicy:
         for fn in self.predicates:
             m = m & fn(snap)
         return m
+
+    def dynamic_predicate_fn(self, snap: SnapshotTensors, state: AllocState):
+        """bool[T, N] AND of the registered state-dependent predicates,
+        or None when none are registered (kernels skip the per-round
+        evaluation entirely)."""
+        if not self.dynamic_predicates:
+            return None
+        m = jnp.ones((snap.num_tasks, snap.num_nodes), bool)
+        for fn, _row in self.dynamic_predicates:
+            m = m & fn(snap, state)
+        return m
+
+    @property
+    def dyn_predicate(self):
+        """The callable to hand kernels (None when unused)."""
+        if not self.dynamic_predicates:
+            return None
+        return self.dynamic_predicate_fn
+
+    @property
+    def dyn_predicate_row(self):
+        """(snap, state, p) -> bool[N] single-task variant (None when no
+        dynamic predicates are registered)."""
+        if not self.dynamic_predicates:
+            return None
+        entries = list(self.dynamic_predicates)
+
+        def row(snap, state, p):
+            m = jnp.ones(snap.num_nodes, bool)
+            for fn, row_fn in entries:
+                m = m & (
+                    row_fn(snap, state, p)
+                    if row_fn is not None
+                    else fn(snap, state)[p]
+                )
+            return m
+
+        return row
+
+    @property
+    def global_serialize_fn(self):
+        """(snap, state) -> bool[T] of tasks limited to one acceptance
+        per auction round across the whole cluster (None when unused)."""
+        if not self.global_serialize:
+            return None
+        fns = list(self.global_serialize)
+
+        def mask(snap, state):
+            m = jnp.zeros(snap.num_tasks, bool)
+            for fn in fns:
+                m = m | fn(snap, state)
+            return m
+
+        return mask
 
     def score_fn(self, snap: SnapshotTensors, state: AllocState) -> jax.Array:
         """f32[T, N]: weighted sum of node-order scores

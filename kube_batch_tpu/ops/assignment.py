@@ -159,6 +159,7 @@ def _resolve_conflicts(
     avail: jax.Array,       # f32[N, R]
     eps: jax.Array,         # f32[R]
     one_per_node: bool = False,
+    serialize_mask: jax.Array | None = None,  # bool[T]
 ) -> jax.Array:
     """bool[T]: which proposals are accepted this round.
 
@@ -193,6 +194,20 @@ def _resolve_conflicts(
     s_accept = active[perm] & fits_prefix
     if one_per_node:
         s_accept = s_accept & is_start
+    elif serialize_mask is not None:
+        # At most ONE anti-affinity-involved task lands per node per
+        # round: same-round co-acceptances never see each other in the
+        # residents tensor, so tasks that could violate (or be violated
+        # by) an anti term must serialize; everyone else packs freely.
+        s_part = serialize_mask[perm] & s_accept
+        idx = jnp.arange(s_part.shape[0], dtype=jnp.int32)
+        start_idx = lax.cummax(jnp.where(is_start, idx, 0))
+        incl = jnp.cumsum(s_part.astype(jnp.int32))
+        # exclusive per-segment running count of accepted participants
+        seg_before = incl - s_part.astype(jnp.int32) - jnp.where(
+            start_idx > 0, incl[jnp.maximum(start_idx - 1, 0)], 0
+        )
+        s_accept = s_accept & (~s_part | (seg_before == 0))
     accept = jnp.zeros(T, bool).at[perm].set(s_accept)
 
     # Global rank watermark: the reference places tasks strictly in rank
@@ -220,6 +235,8 @@ def allocate_rounds(
     max_rounds: int | None = None,
     one_per_node: bool = False,
     score_quantum: float = 0.0,
+    dyn_predicate_fn=None,     # (snap, state) -> bool[T, N], or None
+    global_serialize_fn=None,  # (snap, state) -> bool[T], or None
 ) -> AllocState:
     """Run auction rounds to a fixed point.
 
@@ -240,6 +257,16 @@ def allocate_rounds(
         max_rounds = snap.num_tasks
     new_status = int(TaskStatus.PIPELINED if use_future else TaskStatus.ALLOCATED)
 
+    # Anti-affinity serialization (see _resolve_conflicts): a task
+    # "participates" if it declares anti terms or carries a label that
+    # appears in ANY task's anti terms — snapshot-static, computed once.
+    serialize_mask = None
+    if dyn_predicate_fn is not None:
+        anti_union = jnp.any(snap.task_anti > 0, axis=0)       # bool[K]
+        serialize_mask = jnp.any(snap.task_anti > 0, axis=1) | jnp.any(
+            (snap.task_podlabels > 0) & anti_union[None, :], axis=1
+        )
+
     def cond(carry):
         _, progress, rnd = carry
         return progress & (rnd < max_rounds)
@@ -252,6 +279,8 @@ def allocate_rounds(
 
         fit = fits(snap.task_req[:, None, :], avail[None, :, :], eps)  # bool[T, N]
         feas = predicate_mask & fit & snap.node_mask[None, :] & eligible[:, None]
+        if dyn_predicate_fn is not None:
+            feas = feas & dyn_predicate_fn(snap, st)
 
         score = jnp.where(feas, score_fn(snap, st), NEG_INF)
         if score_quantum > 0.0:
@@ -269,7 +298,18 @@ def allocate_rounds(
         accept = _resolve_conflicts(
             prop_node, active, rank, snap.task_req, avail, eps,
             one_per_node=one_per_node,
+            serialize_mask=serialize_mask,
         )
+        if global_serialize_fn is not None:
+            # At most ONE globally-serialized task (affinity bootstrap
+            # claimant) lands per round: same-round claimants can't see
+            # each other, so a whole self-affine gang would otherwise
+            # scatter.  Keeping the rank-first ACCEPTED claimant (not
+            # the rank-first claimant overall) means an unschedulable
+            # claimant can never deadlock the others.
+            gmask = global_serialize_fn(snap, st) & accept
+            best_g = jnp.min(jnp.where(gmask, rank, jnp.iinfo(jnp.int32).max))
+            accept = accept & (~gmask | (rank == best_g))
 
         # -- apply accepted placements (pure scatter updates) ----------
         task_state = jnp.where(accept, new_status, st.task_state)

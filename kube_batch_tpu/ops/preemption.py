@@ -114,6 +114,7 @@ def preemption_rounds(
     eligible_fn: Callable[[SnapshotTensors, AllocState], jax.Array],
     eps: jax.Array,
     max_iters: int | None = None,
+    dyn_predicate_row_fn=None,  # (snap, state, p) -> bool[N], or None
 ) -> AllocState:
     """Serve starving jobs by evicting less-deserving workloads.
 
@@ -162,6 +163,15 @@ def preemption_rounds(
         )
         sacrifice = -rank  # least deserving evicted first
 
+        # Preemptor's state-dependent feasibility (inter-pod affinity
+        # against current residents), re-evaluated EVERY step: evicting
+        # the resident that anchors the preemptor's required affinity
+        # must fail the plan, not finalize onto an anchor-less node.
+        if dyn_predicate_row_fn is not None:
+            dyn_row = dyn_predicate_row_fn(snap, st, p)    # bool[N]
+        else:
+            dyn_row = jnp.ones(snap.num_nodes, bool)
+
         # -- node choice (heuristic; only computed when opening a plan —
         # mid-plan steps keep prov_n, and lax.cond skips the [T]-sort /
         # prefix-sum work entirely on those steps) --------------------
@@ -174,6 +184,7 @@ def preemption_rounds(
                 & predicate_mask[p]
                 & snap.node_mask
                 & snap.node_ready
+                & dyn_row
             )
             kk = jnp.where(feasible, k, BIG_K)
             n_best = jnp.argmax(feasible & (kk == jnp.min(kk))).astype(
@@ -192,12 +203,13 @@ def preemption_rounds(
         active = c.prov_active | opening
 
         fit_now = fits(preq[None, :], st.node_future[n][None, :], eps)[0]
+        viable = dyn_row[n]                             # plan still legal?
         victims_on_n = victims & (st.task_node == n)
         any_vic = jnp.any(victims_on_n)
 
-        finalize = active & fit_now                     # Commit
-        evict_step = active & ~fit_now & any_vic        # one more victim
-        fail = active & ~fit_now & ~any_vic             # Discard
+        finalize = active & viable & fit_now            # Commit
+        evict_step = active & viable & ~fit_now & any_vic  # one more victim
+        fail = active & (~viable | (~fit_now & ~any_vic))  # Discard
 
         # -- the eviction step ------------------------------------------
         v = jnp.argmin(

@@ -85,12 +85,27 @@ class NodeOrderPlugin(Plugin):
             denom = jnp.maximum(jnp.sum(snap.task_pref, axis=1), 1e-9)
             return raw / denom[:, None] * MAX_SCORE
 
+        w_podaff = self.args.get_float("nodeorder.podaffinity.weight", 1.0)
+
+        def pod_affinity_score(snap, state):
+            """Preferred co-location (≙ InterPodAffinityPriority):
+            weighted sum of soft terms matched by the node's residents,
+            normalized to MAX_SCORE."""
+            from kube_batch_tpu.plugins.predicates import resident_podlabels
+
+            Hb, _ = resident_podlabels(snap, state)
+            raw = snap.task_podpref @ Hb.astype(jnp.float32).T  # f32[T,N]
+            denom = jnp.maximum(jnp.sum(snap.task_podpref, axis=1), 1e-9)
+            return raw / denom[:, None] * MAX_SCORE
+
         if w_least:
             policy.add_node_order_fn(w_least, least_requested)
         if w_bal:
             policy.add_node_order_fn(w_bal, balanced)
         if w_aff:
             policy.add_node_order_fn(w_aff, node_affinity, state_dependent=False)
+        if w_podaff:
+            policy.add_node_order_fn(w_podaff, pod_affinity_score)
         quantum = self.args.get_float("nodeorder.quantum", 0.0)
         if quantum > 0.0:
             policy.score_quantum = quantum

@@ -24,17 +24,26 @@ a handful of MXU ops instead of T×N per-pair string comparisons:
 Resource fit is deliberately NOT here, exactly like the reference
 (actions check `Resreq ⊑ Idle` themselves; see ops/assignment.py).
 
+Inter-pod affinity (the vendored k8s inter-pod affinity predicate in the
+reference) is registered as a DYNAMIC predicate — placements made
+earlier in the same cycle change feasibility, so it re-evaluates inside
+every auction round / preemption step; see `pod_affinity_predicate`.
+
 Arguments (≙ predicates.go's `predicate.*Enable` toggles):
     predicate.NodeSelectorEnable  (default true)
     predicate.TaintsEnable        (default true)
     predicate.HostPortsEnable     (default true)
     predicate.NodeReadyEnable     (default true)
+    predicate.PodAffinityEnable   (default true)
 """
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
+from kube_batch_tpu.api.snapshot import allocated_mask, status_is
+from kube_batch_tpu.api.types import TaskStatus
 from kube_batch_tpu.framework.plugin import Plugin, register_plugin
 
 
@@ -69,3 +78,99 @@ class PredicatesPlugin(Plugin):
             return ok
 
         policy.add_predicate_fn(predicate)
+
+        if self.args.get_bool("predicate.PodAffinityEnable", True):
+            policy.add_dynamic_predicate_fn(
+                pod_affinity_predicate, row_fn=pod_affinity_row
+            )
+            policy.add_global_serialize_fn(bootstrap_mask)
+
+
+def resident_podlabels(snap, state):
+    """(Hb, Ab): bool[N, K] label/anti-term presence among each node's
+    residents.  "Resident" = allocated statuses or pipelined with a node
+    — future-oriented, so a RELEASING victim no longer anchors affinity
+    or blocks anti-affinity for placements that land after it leaves
+    (consistent with FutureIdle reasoning)."""
+    held = (
+        (
+            allocated_mask(state.task_state)
+            | status_is(state.task_state, TaskStatus.PIPELINED)
+        )
+        & (state.task_node >= 0)
+        & snap.task_mask
+    )
+    seg = jnp.where(held, state.task_node, snap.num_nodes)
+    w = held.astype(snap.task_podlabels.dtype)[:, None]
+    Hb = jax.ops.segment_sum(
+        snap.task_podlabels * w, seg, num_segments=snap.num_nodes + 1
+    )[: snap.num_nodes] > 0
+    Ab = jax.ops.segment_sum(
+        snap.task_anti * w, seg, num_segments=snap.num_nodes + 1
+    )[: snap.num_nodes] > 0
+    return Hb, Ab
+
+
+def pod_affinity_predicate(snap, state):
+    """bool[T, N] inter-pod affinity/anti-affinity feasibility
+    (≙ the vendored k8s inter-pod affinity predicate in
+    plugins/predicates/predicates.go, topologyKey = node):
+
+    * required affinity: every term names a label some resident of the
+      node carries — with the k8s bootstrap rule (a term no pod in the
+      whole cluster matches is waived when the task itself carries the
+      label, so the first gang member can land);
+    * anti-affinity: no resident carries any of the task's anti terms;
+    * symmetry: no resident's anti term matches the task's own labels.
+    """
+    Hb, Ab = resident_podlabels(snap, state)
+    Hf = Hb.astype(snap.task_aff.dtype)
+
+    need = jnp.sum(snap.task_aff, axis=1, keepdims=True)       # f32[T,1]
+    have = snap.task_aff @ Hf.T                                # f32[T,N]
+    term_exists = jnp.any(Hb, axis=0)                          # bool[K]
+    # Bootstrap waiver (k8s rule): a term NO pod in the cluster matches
+    # is waived for ANY task that itself carries the label.  The auction
+    # keeps this sound in a batched round by accepting at most ONE
+    # bootstrap-dependent placement per round (see bootstrap_mask below
+    # and ops/assignment.py's global-serialize step) — after it lands,
+    # the term exists and the rest must genuinely co-locate.
+    bootstrap = jnp.sum(
+        snap.task_aff * (snap.task_podlabels > 0) * (~term_exists)[None, :],
+        axis=1,
+        keepdims=True,
+    )                                                          # f32[T,1]
+    aff_ok = have + bootstrap >= need
+
+    anti_hit = snap.task_anti @ Hf.T                           # f32[T,N]
+    sym_hit = snap.task_podlabels @ Ab.astype(Hf.dtype).T      # f32[T,N]
+    return aff_ok & (anti_hit <= 0.5) & (sym_hit <= 0.5)
+
+
+def pod_affinity_row(snap, state, p):
+    """bool[N]: pod_affinity_predicate for ONE task — O(N·K) instead of
+    the full [T, N] matrix; used per preemption step."""
+    Hb, Ab = resident_podlabels(snap, state)
+    Hf = Hb.astype(snap.task_aff.dtype)
+    aff = snap.task_aff[p]                                     # f32[K]
+    own = snap.task_podlabels[p]
+    term_exists = jnp.any(Hb, axis=0)
+    need = jnp.sum(aff)
+    have = Hf @ aff                                            # f32[N]
+    bootstrap = jnp.sum(aff * (own > 0) * ~term_exists)
+    aff_ok = have + bootstrap >= need
+    anti_hit = Hf @ snap.task_anti[p]
+    sym_hit = Ab.astype(Hf.dtype) @ own
+    return aff_ok & (anti_hit <= 0.5) & (sym_hit <= 0.5)
+
+
+def bootstrap_mask(snap, state):
+    """bool[T]: pending tasks whose required affinity currently relies
+    on the bootstrap waiver — at most one of these may be accepted per
+    auction round (all of them placing at once would scatter a
+    self-affine gang across nodes)."""
+    Hb, _ = resident_podlabels(snap, state)
+    term_exists = jnp.any(Hb, axis=0)
+    return jnp.any(
+        (snap.task_aff > 0) & (~term_exists)[None, :], axis=1
+    ) & snap.task_mask

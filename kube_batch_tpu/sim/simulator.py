@@ -70,16 +70,7 @@ class SimulatedCluster:
             pod = self.cache._pods.get(uid)
             if pod is None:
                 continue
-            group = pod.group
-            template = Pod(
-                name=pod.name,
-                group=group,
-                request=dict(pod.request),
-                priority=pod.priority,
-                selector=dict(pod.selector),
-                tolerations=pod.tolerations,
-                ports=pod.ports,
-            )
+            template = pod.respawn()
             self.cache.delete_pod(uid)
             self.cache.add_pod(template)
 

@@ -1,0 +1,227 @@
+"""Inter-pod affinity / anti-affinity tests (topologyKey = node).
+
+Reference behaviors: the vendored k8s inter-pod affinity predicate
+consumed by plugins/predicates/predicates.go and the
+InterPodAffinityPriority score in plugins/nodeorder/nodeorder.go.
+"""
+
+from kube_batch_tpu.actions import BUILTIN_ACTIONS  # noqa: F401
+from kube_batch_tpu.api.resource import ResourceSpec
+from kube_batch_tpu.cache.cluster import Node, Pod, PodGroup
+from kube_batch_tpu.models.workloads import GI
+from kube_batch_tpu.plugins import BUILTIN_PLUGINS  # noqa: F401
+from kube_batch_tpu.scheduler import Scheduler
+from kube_batch_tpu.sim.simulator import make_world
+
+SPEC = ResourceSpec(("cpu", "memory", "pods", "accelerator"))
+
+
+def _world(n_nodes=4, cpu=4000):
+    cache, sim = make_world(SPEC)
+    for i in range(n_nodes):
+        sim.add_node(
+            Node(name=f"n{i}",
+                 allocatable={"cpu": cpu, "memory": 8 * GI, "pods": 110})
+        )
+    return cache, sim
+
+
+def _pod(name, cpu=500, **kw):
+    return Pod(name=name, request={"cpu": cpu, "memory": 1 * GI, "pods": 1}, **kw)
+
+
+def node_of(sim, pod_name):
+    return dict(sim.binds).get(pod_name)
+
+
+def test_required_affinity_colocates():
+    cache, sim = _world()
+    sim.submit(
+        PodGroup(name="svc", queue="default", min_member=1),
+        [_pod("svc-0", labels={"app": "db"})],
+    )
+    Scheduler(cache).run_once()
+    sim.tick()
+
+    sim.submit(
+        PodGroup(name="web", queue="default", min_member=1),
+        [_pod("web-0", affinity=frozenset({"app=db"}))],
+    )
+    Scheduler(cache).run_once()
+    assert node_of(sim, "web-0") == node_of(sim, "svc-0")
+
+
+def test_anti_affinity_spreads_replicas_one_cycle():
+    """The classic spread: each replica labels app=x and anti-affines
+    app=x.  All four must land on DISTINCT nodes within one cycle —
+    same-round co-acceptance is prevented by the serialization guard."""
+    cache, sim = _world(n_nodes=4)
+    sim.submit(
+        PodGroup(name="rep", queue="default", min_member=4),
+        [
+            _pod(f"rep-{i}", labels={"app": "x"},
+                 anti_affinity=frozenset({"app=x"}))
+            for i in range(4)
+        ],
+    )
+    Scheduler(cache).run_once()
+    nodes = [node_of(sim, f"rep-{i}") for i in range(4)]
+    assert None not in nodes, nodes
+    assert len(set(nodes)) == 4, nodes
+
+
+def test_anti_affinity_unsatisfiable_blocks():
+    """5 mutually anti-affine replicas on 4 nodes: gang of 5 can't land."""
+    cache, sim = _world(n_nodes=4)
+    sim.submit(
+        PodGroup(name="rep", queue="default", min_member=5),
+        [
+            _pod(f"rep-{i}", labels={"app": "x"},
+                 anti_affinity=frozenset({"app=x"}))
+            for i in range(5)
+        ],
+    )
+    ssn = Scheduler(cache).run_once()
+    assert ssn.bound == []   # gang all-or-nothing holds
+
+
+def test_symmetric_anti_affinity_blocks_newcomer():
+    """A resident whose anti term matches the newcomer's labels keeps
+    the newcomer off its node (k8s anti-affinity symmetry)."""
+    cache, sim = _world(n_nodes=2)
+    sim.submit(
+        PodGroup(name="lonely", queue="default", min_member=1),
+        [_pod("lonely-0", labels={"team": "a"},
+              anti_affinity=frozenset({"team=b"}))],
+    )
+    Scheduler(cache).run_once()
+    sim.tick()
+    lonely_node = node_of(sim, "lonely-0")
+
+    sim.submit(
+        PodGroup(name="newb", queue="default", min_member=1),
+        [_pod("newb-0", labels={"team": "b"})],
+    )
+    Scheduler(cache).run_once()
+    assert node_of(sim, "newb-0") is not None
+    assert node_of(sim, "newb-0") != lonely_node
+
+
+def test_gang_self_affinity_bootstraps_same_cycle():
+    """A gang whose members all require co-location with their own label
+    must still schedule from an empty cluster (k8s bootstrap rule), and
+    end up together."""
+    cache, sim = _world(n_nodes=3)
+    sim.submit(
+        PodGroup(name="ring", queue="default", min_member=3),
+        [
+            _pod(f"ring-{i}", labels={"job": "ring"},
+                 affinity=frozenset({"job=ring"}))
+            for i in range(3)
+        ],
+    )
+    Scheduler(cache).run_once()
+    nodes = [node_of(sim, f"ring-{i}") for i in range(3)]
+    assert None not in nodes, nodes
+    assert len(set(nodes)) == 1, nodes   # co-located
+
+
+def test_bootstrap_survives_unschedulable_first_claimant():
+    """The oldest carrier of a nonexistent term is unschedulable (wants
+    64 cores); the waiver must pass to the next claimant instead of
+    deadlocking the group (k8s waives for ANY carrier)."""
+    cache, sim = _world(n_nodes=2)
+    sim.submit(
+        PodGroup(name="ring", queue="default", min_member=2),
+        [
+            _pod("ring-huge", cpu=64000, labels={"job": "ring"},
+                 affinity=frozenset({"job=ring"})),
+            _pod("ring-1", labels={"job": "ring"},
+                 affinity=frozenset({"job=ring"})),
+            _pod("ring-2", labels={"job": "ring"},
+                 affinity=frozenset({"job=ring"})),
+        ],
+    )
+    Scheduler(cache).run_once()
+    assert node_of(sim, "ring-1") is not None
+    assert node_of(sim, "ring-1") == node_of(sim, "ring-2")
+    assert node_of(sim, "ring-huge") is None
+
+
+def test_preempt_never_evicts_its_own_affinity_anchor():
+    """If fitting the preemptor would require evicting the resident
+    that satisfies its required affinity, the plan must roll back —
+    never finalize onto an anchor-less node."""
+    cache, sim = _world(n_nodes=2, cpu=4000)
+    sim.submit(
+        PodGroup(name="db", queue="default", min_member=1),
+        [_pod("db-0", cpu=1000, labels={"app": "db"})],
+    )
+    Scheduler(cache).run_once()
+    sim.tick()
+    db_node = node_of(sim, "db-0")
+    # fill BOTH nodes completely: the 4000 pod (scheduled first) only
+    # fits the empty node, then the 3000 pod only fits next to db
+    sim.submit(
+        PodGroup(name="fill", queue="default", min_member=1),
+        [_pod("fill-0", cpu=4000), _pod("fill-1", cpu=3000)],
+    )
+    Scheduler(cache).run_once()
+    sim.tick()
+    assert len(sim.binds) == 3   # cluster full
+    assert node_of(sim, "fill-1") == db_node
+
+    # Preemptor needs the WHOLE of db's node (4000) AND app=db resident.
+    sim.submit(
+        PodGroup(name="big", queue="default", min_member=1, priority=1000),
+        [_pod("big-0", cpu=4000, affinity=frozenset({"app=db"}),
+              priority=1000)],
+    )
+    import dataclasses
+    from kube_batch_tpu.framework.conf import default_conf
+    from kube_batch_tpu.framework.plugin import get_action
+    from kube_batch_tpu.framework.session import (
+        build_policy, close_session, open_session,
+    )
+
+    conf = dataclasses.replace(default_conf(), actions=("allocate", "preempt"))
+    policy, plugins = build_policy(conf)
+    acts = [get_action(n) for n in conf.actions]
+    for a in acts:
+        a.initialize(policy)
+    ssn = open_session(cache, policy, plugins)
+    for a in acts:
+        a.execute(ssn)
+    close_session(ssn)
+    # db-0 (the anchor) must never be a committed victim
+    assert all(not v.startswith("db") for v, _ in ssn.evicted), ssn.evicted
+
+
+def test_preferred_pod_affinity_steers_scoring():
+    cache, sim = _world(n_nodes=3)
+    sim.submit(
+        PodGroup(name="svc", queue="default", min_member=1),
+        [_pod("svc-0", labels={"app": "cache"})],
+    )
+    Scheduler(cache).run_once()
+    sim.tick()
+
+    sim.submit(
+        PodGroup(name="web", queue="default", min_member=1),
+        [_pod("web-0", pod_prefs={"app=cache": 10.0})],
+    )
+    Scheduler(cache).run_once()
+    # soft preference: same node wins on score (plenty of room there)
+    assert node_of(sim, "web-0") == node_of(sim, "svc-0")
+
+
+def test_required_affinity_with_no_match_stays_pending():
+    cache, sim = _world(n_nodes=2)
+    sim.submit(
+        PodGroup(name="orphan", queue="default", min_member=1),
+        [_pod("orphan-0", affinity=frozenset({"app=nothere"}))],
+    )
+    ssn = Scheduler(cache).run_once()
+    assert ssn.bound == []
+    # and diagnosis says predicates failed
+    assert any("failed predicates" in e for e in cache.events)
