@@ -1,0 +1,246 @@
+"""Serial CPU oracle: an independent reimplementation of the reference
+allocate loop, for differential testing against the TPU auction kernel.
+
+Reference shape (actions/allocate/allocate.go · Execute with the default
+plugin set): strictly one task at a time —
+
+    while work remains:
+        queue = hungriest non-overused queue   (proportion: alloc/deserved)
+        job   = that queue's neediest valid job (drf share, priority, creation)
+        task  = that job's next pending task    (priority, creation)
+        nodes = predicate-feasible & resource-fitting
+        place on the best-scored node (least-requested + balanced + affinities)
+        update idle/shares; re-evaluate everything
+
+Gang all-or-nothing is applied at the end exactly like the session's
+bind dispatch: jobs that failed to reach minMember contribute no binds.
+
+Deliberately NumPy + Python loops, sharing NO kernel code with
+ops/assignment.py — divergence between the two is a bug in one of them.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _predicate_ok(snap, t, n) -> bool:
+    """Static predicates for task t on node n (selector/taints/ports)."""
+    sel = snap["task_sel"][t]
+    if sel.sum() > 0 and (sel * snap["node_labels"][n]).sum() < sel.sum():
+        return False
+    taints = snap["node_taints"][n]
+    if taints.sum() > 0:
+        untolerated = taints * (1.0 - snap["task_tol"][t])
+        if untolerated.sum() > 0:
+            return False
+    if (snap["task_ports"][t] * snap["node_ports"][n]).sum() > 0:
+        return False
+    return bool(snap["node_ready"][n])
+
+
+def _pod_affinity_ok(snap, t, n, placed_node, resident_labels) -> bool:
+    """Inter-pod affinity for t on n given current placements.
+    resident_labels[n] = bool[K] labels present among node n's residents;
+    the bootstrap waiver applies per term when NO node carries it."""
+    aff = snap["task_aff"][t]
+    if aff.sum() > 0:
+        exists_somewhere = resident_labels.any(axis=0)
+        for k in np.nonzero(aff)[0]:
+            if resident_labels[n, k]:
+                continue
+            if not exists_somewhere[k] and snap["task_podlabels"][t, k] > 0:
+                continue  # bootstrap
+            return False
+    anti = snap["task_anti"][t]
+    if anti.sum() > 0 and (anti * resident_labels[n]).sum() > 0:
+        return False
+    # symmetry: residents' anti terms vs t's labels
+    if (snap["task_podlabels"][t] * snap["node_anti"][n]).sum() > 0:
+        return False
+    return True
+
+
+def serial_allocate(snap) -> dict:
+    """Run the serial reference loop over a numpy-ified snapshot.
+
+    `snap` is a dict of numpy arrays with the same keys/shapes as
+    SnapshotTensors fields (unpadded).  Returns {"assigned": i32[T] node
+    or -1, "bound": bool[T] after the gang gate}.
+    """
+    T = snap["task_req"].shape[0]
+    N = snap["node_idle"].shape[0]
+    J = snap["job_min"].shape[0]
+    Q = snap["queue_weight"].shape[0]
+    R = snap["task_req"].shape[1]
+    K = snap["task_podlabels"].shape[1]
+    eps = snap["eps"]
+    beps = snap["besteffort_eps"]
+
+    idle = snap["node_idle"].copy()
+    assigned = np.full(T, -1, np.int32)
+    pending = snap["task_state"] == 0  # PENDING
+    task_queue = np.array(
+        [snap["job_queue"][j] if j >= 0 else -1 for j in snap["task_job"]]
+    )
+
+    # residents from the snapshot (already-running pods)
+    resident_labels = np.zeros((N, K), bool)
+    node_anti = np.zeros((N, K), bool)
+    held0 = np.isin(snap["task_state"], (1, 3, 4, 5)) & (snap["task_node"] >= 0)
+    for t in np.nonzero(held0)[0]:
+        n = snap["task_node"][t]
+        resident_labels[n] |= snap["task_podlabels"][t] > 0
+        node_anti[n] |= snap["task_anti"][t] > 0
+    snap = dict(snap)
+    snap["node_anti"] = node_anti
+
+    # queue deserved via the same waterfill contract (independent impl)
+    requests = np.zeros((Q, R))
+    for t in range(T):
+        q = task_queue[t]
+        if q >= 0:
+            requests[q] += snap["task_req"][t]
+    deserved = _waterfill(snap["queue_weight"], requests, snap["node_cap"].sum(0))
+
+    # live per-queue / per-job allocations (include snapshot residents)
+    q_alloc = np.zeros((Q, R))
+    j_alloc = np.zeros((J, R))
+    for t in np.nonzero(held0)[0]:
+        q = task_queue[t]
+        if q >= 0:
+            q_alloc[q] += snap["task_req"][t]
+        j = snap["task_job"][t]
+        if j >= 0:
+            j_alloc[j] += snap["task_req"][t]
+    total = np.maximum(snap["node_cap"].sum(0), 1e-9)
+
+    placed_count = np.zeros(J, np.int32)
+    besteffort = np.all(snap["task_req"] < beps, axis=1)
+
+    def ready_count(j):
+        base = np.sum(
+            np.isin(snap["task_state"], (1, 3, 4, 5, 7)) & (snap["task_job"] == j)
+        )
+        return base + placed_count[j]
+
+    def valid_count(j):
+        return np.sum(
+            np.isin(snap["task_state"], (0, 1, 2, 3, 4, 5, 7))
+            & (snap["task_job"] == j)
+        )
+
+    while True:
+        # candidate tasks: pending, not best-effort, job valid, queue not overused
+        cands = []
+        for t in np.nonzero(pending)[0]:
+            j = snap["task_job"][t]
+            if j < 0 or besteffort[t]:
+                continue
+            if valid_count(j) < snap["job_min"][j]:
+                continue
+            q = task_queue[t]
+            meaningful = deserved[q] >= beps
+            if np.all(~meaningful | (deserved[q] <= q_alloc[q])):
+                continue  # overused
+            cands.append(t)
+        if not cands:
+            break
+
+        def rank_key(t):
+            j = snap["task_job"][t]
+            q = task_queue[t]
+            d = np.where(deserved[q] > 0, q_alloc[q] / np.maximum(deserved[q], 1e-9),
+                         np.where(q_alloc[q] > 0, 1e9, 0.0))
+            qshare = d.max()
+            jshare = (j_alloc[j] / total).max()
+            gang_unready = 0.0 if ready_count(j) < snap["job_min"][j] else 1.0
+            return (
+                qshare,
+                snap["job_prio"][j] * -1.0,
+                gang_unready,
+                jshare,
+                -snap["task_prio"][t],
+                snap["task_order"][t],
+            )
+
+        t = min(cands, key=rank_key)
+        r = snap["task_req"][t]
+        best_n, best_score = -1, -np.inf
+        for n in range(N):
+            if not np.all((r <= idle[n]) | (r < eps)):
+                continue
+            if not _predicate_ok(snap, t, n):
+                continue
+            if not _pod_affinity_ok(snap, t, n, assigned, resident_labels):
+                continue
+            cap = np.maximum(snap["node_cap"][n], 1e-9)
+            frac = np.clip(idle[n] - r, 0, None) / cap
+            w = (r > 0).astype(float)
+            least = (frac * w).sum() / max(w.sum(), 1.0) * 10.0
+            used_after = (snap["node_cap"][n] - idle[n]) + r
+            fr = np.clip(used_after / cap, 0, 1)
+            bal = (1.0 - abs(fr[0] - fr[1])) * 10.0
+            score = least + bal
+            if score > best_score + 1e-12:
+                best_n, best_score = n, score
+        if best_n < 0:
+            pending[t] = False  # unschedulable now; park it
+            continue
+
+        assigned[t] = best_n
+        pending[t] = False
+        idle[best_n] -= r
+        q = task_queue[t]
+        q_alloc[q] += r
+        j_alloc[snap["task_job"][t]] += r
+        placed_count[snap["task_job"][t]] += 1
+        resident_labels[best_n] |= snap["task_podlabels"][t] > 0
+        node_anti[best_n] |= snap["task_anti"][t] > 0
+
+    # gang gate at dispatch
+    bound = np.zeros(T, bool)
+    for t in np.nonzero(assigned >= 0)[0]:
+        j = snap["task_job"][t]
+        if ready_count(j) >= snap["job_min"][j]:
+            bound[t] = True
+    return {"assigned": assigned, "bound": bound}
+
+
+def _waterfill(weights, requests, cap):
+    Q, R = requests.shape
+    deserved = np.zeros_like(requests)
+    remaining = cap.astype(float).copy()
+    unsat = np.ones_like(requests, bool)
+    for _ in range(Q + 1):
+        w = np.where(unsat, weights[:, None], 0.0)
+        wsum = w.sum(axis=0)
+        inc = np.where(wsum > 0, remaining[None, :] * w / np.maximum(wsum, 1e-9), 0.0)
+        filled = deserved + inc
+        hit = filled >= requests
+        filled = np.minimum(filled, requests)
+        remaining = np.maximum(remaining - (filled - deserved).sum(axis=0), 0.0)
+        deserved, unsat = filled, unsat & ~hit
+    return deserved
+
+
+def snapshot_to_numpy(snap, meta) -> dict:
+    """SnapshotTensors → unpadded numpy dict for the oracle."""
+    Tn = meta.num_real_tasks
+    Nn = meta.num_real_nodes
+    out = {}
+    for name in (
+        "task_req", "task_state", "task_job", "task_node", "task_prio",
+        "task_order", "task_sel", "task_tol", "task_ports",
+        "task_podlabels", "task_aff", "task_anti",
+    ):
+        out[name] = np.asarray(getattr(snap, name))[:Tn]
+    for name in ("node_cap", "node_idle", "node_labels", "node_taints",
+                 "node_ports", "node_ready"):
+        out[name] = np.asarray(getattr(snap, name))[:Nn]
+    for name in ("job_queue", "job_min", "job_prio"):
+        out[name] = np.asarray(getattr(snap, name))[: len(meta.job_names)]
+    out["queue_weight"] = np.asarray(snap.queue_weight)[: len(meta.queue_names)]
+    out["eps"] = np.asarray(snap.eps)
+    out["besteffort_eps"] = np.asarray(snap.besteffort_eps)
+    return out

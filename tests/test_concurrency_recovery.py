@@ -1,0 +1,145 @@
+"""Concurrency + recovery properties (SURVEY §5 aux subsystems).
+
+* Race safety: the reference leans on one SchedulerCache mutex + an
+  immutable snapshot (Go's -race validates it).  Here a writer thread
+  hammers pod/node churn while cycles run; the invariant is no
+  exceptions and internally consistent snapshots.
+* Stateless recovery: the reference rebuilds its cache entirely from
+  informer list/watch after failover.  Here: rebuild a fresh cache from
+  the live world's objects and scheduling must resume equivalently.
+* Failed-bind resync: binds that fail are re-queued and retried
+  (≙ errTasks workqueue → processResyncTask).
+"""
+
+import copy
+import threading
+
+import numpy as np
+
+from kube_batch_tpu.actions import BUILTIN_ACTIONS  # noqa: F401
+from kube_batch_tpu.api.resource import ResourceSpec
+from kube_batch_tpu.cache.backend import FakeBinder, FakeEvictor
+from kube_batch_tpu.cache.cache import SchedulerCache
+from kube_batch_tpu.cache.cluster import Node, Pod, PodGroup
+from kube_batch_tpu.models.workloads import GI
+from kube_batch_tpu.plugins import BUILTIN_PLUGINS  # noqa: F401
+from kube_batch_tpu.scheduler import Scheduler
+from kube_batch_tpu.sim.simulator import make_world
+
+SPEC = ResourceSpec(("cpu", "memory", "pods", "accelerator"))
+
+
+def test_concurrent_churn_vs_cycles():
+    cache, sim = make_world(SPEC)
+    for i in range(8):
+        sim.add_node(
+            Node(name=f"n{i}", allocatable={"cpu": 8000, "memory": 32 * GI, "pods": 110})
+        )
+    stop = threading.Event()
+    errors: list[BaseException] = []
+
+    def churn():
+        j = 0
+        try:
+            while not stop.is_set():
+                group = PodGroup(name=f"churn{j}", queue="default", min_member=1)
+                pods = [
+                    Pod(name=f"churn{j}-{i}",
+                        request={"cpu": 500, "memory": GI, "pods": 1})
+                    for i in range(4)
+                ]
+                sim.submit(group, pods)
+                if j >= 3:  # delete an older job's pods mid-flight
+                    old = [u for u, p in list(cache._pods.items())
+                           if p.group == f"churn{j-3}"]
+                    for uid in old:
+                        cache.delete_pod(uid)
+                    cache.delete_pod_group(f"churn{j-3}")
+                j += 1
+        except BaseException as exc:  # noqa: BLE001
+            errors.append(exc)
+
+    writer = threading.Thread(target=churn)
+    writer.start()
+    try:
+        s = Scheduler(cache, schedule_period=0.0)
+        for _ in range(8):
+            s.run_once()
+            sim.tick()
+    finally:
+        stop.set()
+        writer.join(timeout=10)
+    assert not errors, errors
+    # snapshot self-consistency: every job task accounted exactly once
+    host = cache.snapshot()
+    for job in host.jobs.values():
+        uids = list(job.tasks)
+        assert len(set(uids)) == len(uids)
+    for info in host.nodes.values():
+        assert np.all(info.idle + info.used == info.allocatable)
+
+
+def test_stateless_recovery_rebuild():
+    """Drop the cache; rebuild from the world's current objects; the new
+    scheduler must see the same cluster and keep scheduling."""
+    cache, sim = make_world(SPEC)
+    for i in range(2):
+        sim.add_node(
+            Node(name=f"n{i}", allocatable={"cpu": 4000, "memory": 8 * GI, "pods": 110})
+        )
+    sim.submit(
+        PodGroup(name="a", queue="default", min_member=2),
+        [Pod(name=f"a-{i}", request={"cpu": 2000, "memory": 4 * GI, "pods": 1})
+         for i in range(2)],
+    )
+    Scheduler(cache).run_once()
+    sim.tick()
+    assert len(sim.binds) == 2
+
+    # --- failover: rebuild a brand-new cache from live objects --------
+    cache2 = SchedulerCache(
+        spec=SPEC, binder=sim, evictor=sim, status_updater=sim
+    )
+    with cache._lock:
+        for info in cache._nodes.values():
+            cache2.add_node(info.node)
+        for job in cache._jobs.values():
+            cache2.add_pod_group(job.pod_group)
+        for pod in cache._pods.values():
+            cache2.add_pod(copy.copy(pod))  # ≙ re-listing live objects
+    sim.cache = cache2
+
+    # accounting equivalence after rebuild
+    h1, h2 = cache.snapshot(), cache2.snapshot()
+    for name in h1.nodes:
+        np.testing.assert_allclose(h1.nodes[name].idle, h2.nodes[name].idle)
+
+    # new work schedules through the rebuilt cache
+    sim.submit(
+        PodGroup(name="b", queue="default", min_member=1),
+        [Pod(name="b-0", request={"cpu": 2000, "memory": 4 * GI, "pods": 1})],
+    )
+    Scheduler(cache2).run_once()
+    assert any(n == "b-0" for n, _ in sim.binds)
+
+
+def test_failed_bind_resyncs_and_retries():
+    cache = SchedulerCache(spec=SPEC, binder=FakeBinder(), evictor=FakeEvictor())
+    cache.add_node(
+        Node(name="n0", allocatable={"cpu": 4000, "memory": 8 * GI, "pods": 110})
+    )
+    cache.add_pod_group(PodGroup(name="j", queue="default", min_member=1))
+    pod = Pod(name="j-0", group="j",
+              request={"cpu": 1000, "memory": GI, "pods": 1})
+    cache.add_pod(pod)
+
+    cache.binder.fail_pods.add("j-0")       # inject bind failure
+    s = Scheduler(cache, schedule_period=0.0)
+    s.run_once()
+    assert cache.binder.binds == []
+    assert pod.status.name == "PENDING"     # reset for retry
+    assert cache.drain_resync() == [pod.uid]
+
+    cache.binder.fail_pods.clear()          # backend recovers
+    s.run_once()
+    assert ("j-0", "n0") in cache.binder.binds

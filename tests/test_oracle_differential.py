@@ -1,0 +1,154 @@
+"""Differential tests: TPU auction kernel vs the serial CPU oracle.
+
+SURVEY §7's hard-part proof obligation: the batched assignment must
+reproduce the reference's serial semantics.  The oracle
+(sim/oracle.py) shares no kernel code; on each BASELINE config the two
+must agree on the outcomes that are tie-independent:
+
+* WHICH tasks get placed (the placed-set),
+* per-job placement counts (gang/fairness trajectories),
+* per-queue allocated totals (weighted fair share),
+* feasibility of every individual auction placement (predicates + fit).
+
+Exact node identity is NOT compared: the serial loop breaks score ties
+by first-index while the auction deals them round-robin (documented in
+ops/assignment.py) — both are valid members of the reference's
+"arbitrary tie-break" family.
+"""
+
+import numpy as np
+import jax
+
+from kube_batch_tpu.actions import BUILTIN_ACTIONS  # noqa: F401
+from kube_batch_tpu.actions.allocate import make_allocate_solver
+from kube_batch_tpu.api.types import TaskStatus
+from kube_batch_tpu.cache.packer import pack_snapshot
+from kube_batch_tpu.framework.conf import default_conf
+from kube_batch_tpu.framework.session import build_policy
+from kube_batch_tpu.models.workloads import build_config
+from kube_batch_tpu.ops.assignment import init_state
+from kube_batch_tpu.plugins import BUILTIN_PLUGINS  # noqa: F401
+from kube_batch_tpu.sim.oracle import serial_allocate, snapshot_to_numpy
+
+
+def _run_both(config_n, **kw):
+    cache, _sim = build_config(config_n, **kw) if kw else build_config(config_n)
+    snap, meta = pack_snapshot(cache.snapshot())
+    policy, _ = build_policy(default_conf())
+    solver = jax.jit(make_allocate_solver(policy))
+    out = solver(snap, init_state(snap))
+
+    Tn = meta.num_real_tasks
+    auction_node = np.asarray(out.task_state)[:Tn]
+    placed_auction = np.isin(
+        auction_node, (int(TaskStatus.ALLOCATED), int(TaskStatus.PIPELINED))
+    ) & (np.asarray(snap.task_state)[:Tn] == int(TaskStatus.PENDING))
+    auction_assign = np.asarray(out.task_node)[:Tn]
+
+    oracle = serial_allocate(snapshot_to_numpy(snap, meta))
+    placed_oracle = oracle["assigned"] >= 0
+    return {
+        "snap": snap,
+        "meta": meta,
+        "placed_auction": placed_auction,
+        "assign_auction": auction_assign,
+        "placed_oracle": placed_oracle,
+        "assign_oracle": oracle["assigned"],
+    }
+
+
+def _per_job_counts(meta, snap, placed):
+    tj = np.asarray(snap.task_job)[: meta.num_real_tasks]
+    J = len(meta.job_names)
+    return np.bincount(tj[placed & (tj >= 0)], minlength=J)
+
+
+def _per_queue_alloc(meta, snap, placed, assign):
+    tj = np.asarray(snap.task_job)[: meta.num_real_tasks]
+    jq = np.asarray(snap.job_queue)[: len(meta.job_names)]
+    req = np.asarray(snap.task_req)[: meta.num_real_tasks]
+    Q = len(meta.queue_names)
+    out = np.zeros((Q, req.shape[1]))
+    for t in np.nonzero(placed)[0]:
+        out[jq[tj[t]]] += req[t]
+    return out
+
+
+def _check_parity(r, check_placed_set=True):
+    meta, snap = r["meta"], r["snap"]
+    a, o = r["placed_auction"], r["placed_oracle"]
+    assert a.sum() == o.sum(), (a.sum(), o.sum())
+    if check_placed_set:
+        np.testing.assert_array_equal(a, o)
+    np.testing.assert_array_equal(
+        _per_job_counts(meta, snap, a), _per_job_counts(meta, snap, o)
+    )
+    np.testing.assert_allclose(
+        _per_queue_alloc(meta, snap, a, r["assign_auction"]),
+        _per_queue_alloc(meta, snap, o, r["assign_oracle"]),
+        rtol=1e-5,
+    )
+
+
+def test_config1_gang_parity():
+    _check_parity(_run_both(1))
+
+
+def test_config2_fair_share_parity():
+    _check_parity(_run_both(2))
+
+
+def test_config3_predicates_parity():
+    r = _run_both(3)
+    _check_parity(r)
+    # every auction placement individually satisfies predicates + fit
+    snap, meta = r["snap"], r["meta"]
+    from kube_batch_tpu.framework.session import build_policy as _bp
+    policy, _ = _bp(default_conf())
+    pred = np.asarray(policy.predicate_mask(snap))
+    for t in np.nonzero(r["placed_auction"])[0]:
+        n = r["assign_auction"][t]
+        assert pred[t, n], (meta.task_pods[t].name, meta.node_names[n])
+
+
+def test_oversubscribed_fairness_parity():
+    """Capacity-constrained variant: ordering decides WHO schedules, so
+    agreement here is the real serial-semantics proof."""
+    from kube_batch_tpu.cache.cluster import PodGroup, Queue
+    from kube_batch_tpu.models.workloads import DEFAULT_SPEC, GI, _node, _pod
+    from kube_batch_tpu.sim.simulator import make_world
+    import random
+
+    rng = random.Random(7)
+    cache, sim = make_world(DEFAULT_SPEC)
+    sim.add_queue(Queue(name="gold", weight=3.0))
+    sim.add_queue(Queue(name="silver", weight=1.0))
+    for i in range(4):   # 64000m total — far less than demand
+        sim.add_node(_node(f"n{i}", cpu_milli=16000, mem=64 * GI))
+    for j in range(12):
+        queue = "gold" if j % 2 == 0 else "silver"
+        group = PodGroup(name=f"job{j}", queue=queue, min_member=1)
+        pods = [
+            _pod(f"job{j}-{i}", cpu=rng.choice([1000, 2000]), mem=2 * GI)
+            for i in range(10)
+        ]
+        sim.submit(group, pods)
+
+    snap, meta = pack_snapshot(cache.snapshot())
+    policy, _ = build_policy(default_conf())
+    solver = jax.jit(make_allocate_solver(policy))
+    out = solver(snap, init_state(snap))
+    Tn = meta.num_real_tasks
+    placed_a = (
+        np.asarray(out.task_state)[:Tn] != int(TaskStatus.PENDING)
+    ) & (np.asarray(snap.task_state)[:Tn] == int(TaskStatus.PENDING))
+    oracle = serial_allocate(snapshot_to_numpy(snap, meta))
+    placed_o = oracle["assigned"] >= 0
+
+    # per-queue cpu totals must match closely (weighted fair share is
+    # the invariant; individual task identity may differ on equal-req
+    # ties within a job)
+    qa = _per_queue_alloc(meta, snap, placed_a, np.asarray(out.task_node)[:Tn])
+    qo = _per_queue_alloc(meta, snap, placed_o, oracle["assigned"])
+    np.testing.assert_allclose(qa[:, 0], qo[:, 0], rtol=0.05)
+    assert abs(int(placed_a.sum()) - int(placed_o.sum())) <= 2
