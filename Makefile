@@ -20,6 +20,11 @@ run-example:
 	    --scheduler-conf examples/scheduler.conf \
 	    --cycles 3 --schedule-period 0 --listen-address ""
 
+profile:
+	$(PY) -m kube_batch_tpu --workload 2 --cycles 3 --schedule-period 0 \
+	    --listen-address "" --profile-dir /tmp/kube-batch-tpu-trace
+	@echo "trace in /tmp/kube-batch-tpu-trace (open with TensorBoard)"
+
 verify:
 	$(PY) -m pytest tests/ -q
 	$(PY) -c "import __graft_entry__ as g; g.entry()"

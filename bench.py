@@ -1,24 +1,21 @@
-"""Headline benchmark: pods scheduled/sec @ 10k pods x 1k nodes (gang).
+"""Benchmark suite: headline metric + the five BASELINE.md configs.
 
 Driver metric (BASELINE.json): "pods scheduled/sec + p99 cycle latency
 @ 10k pods x 1k nodes"; north-star <100 ms/cycle on TPU, >=10x over the
 CPU allocate loop.
 
-Prints ONE JSON line:
-    {"metric": ..., "value": pods/s, "unit": "pods/s", "vs_baseline": x}
+Prints ONE JSON line.  Always — device-init failures, per-config OOMs,
+and timeouts degrade the line (an `error` field, a per-config `error`
+entry, `"skipped"`), they never erase it.  Round 1's lesson: a benchmark
+that can emit nothing is not a benchmark.
 
-Methodology notes (measured, not assumed):
-* Synchronisation: on the axon-tunneled TPU backend, `block_until_ready`
-  returns before execution completes; only a device->host transfer
-  (np.asarray) reliably fences.  Every timed iteration therefore ends
-  with a small D2H read of the result (verified to force a fresh
-  execution per call - repeated identical inputs time the same as
-  distinct inputs under this sync).
-* Environment floor: each dispatch through the tunnel pays a fixed
-  round-trip (~70 ms measured on trivial kernels, no pipelining across
-  dispatches), so cycle latency here is RTT-dominated; on-device compute
-  for this shape is ~1 ms.  The cycle numbers below are end-to-end
-  including that floor.
+Methodology notes (measured on the axon-tunneled v5e chip, 2026-07-29):
+* Each dispatch through the tunnel pays a fixed ~68 ms round trip
+  (measured on trivial kernels), so cycle latency is RTT-dominated.
+  That floor is exactly why the production path fuses the whole action
+  pipeline into ONE jitted dispatch (kube_batch_tpu/actions/fused.py).
+* Timed iterations fence with a small D2H read of the result
+  (np.asarray), which both synchronizes and verifies output liveness.
 * `vs_baseline` compares against an in-process CPU reference that
   mirrors the reference's allocate loop faithfully (serial over tasks,
   per task: predicate chain + LeastRequested/BalancedAllocation scoring
@@ -30,10 +27,67 @@ Methodology notes (measured, not assumed):
 
 from __future__ import annotations
 
+import argparse
 import json
+import sys
 import time
+import traceback
 
 import numpy as np
+
+# Global wall-clock budget: past this, remaining configs are skipped so
+# the driver's capture always completes.
+TIME_BUDGET_S = 480.0
+_T_START = time.monotonic()
+
+
+def _budget_left() -> float:
+    return TIME_BUDGET_S - (time.monotonic() - _T_START)
+
+
+def _log(msg: str) -> None:
+    """Progress to stderr (stdout carries exactly one JSON line)."""
+    print(f"[bench +{time.monotonic() - _T_START:.0f}s] {msg}", file=sys.stderr)
+    sys.stderr.flush()
+
+
+def _init_jax():
+    """Import jax with retry + auto/cpu fallback; never raises.
+
+    Returns (jax module | None, platform str | None, error str | None).
+    Round 1 died on a transient `Unable to initialize backend 'axon'`
+    during the first device transfer; the error message itself advises
+    JAX_PLATFORMS='' — so retry the preferred backend with backoff, then
+    fall back to auto-selection, then to CPU explicitly.
+    """
+    import jax  # imports never fail; only backend init does
+
+    last = None
+    for attempt in range(3):
+        try:
+            return jax, jax.devices()[0].platform, None
+        except RuntimeError as exc:
+            last = exc
+            time.sleep(2.0 * (attempt + 1))
+    for platforms in ("", "cpu"):
+        try:
+            jax.config.update("jax_platforms", platforms or None)
+            return (
+                jax,
+                jax.devices()[0].platform,
+                f"fell back to JAX_PLATFORMS={platforms!r}: {last}",
+            )
+        except RuntimeError as exc:
+            last = exc
+    return None, None, f"no backend available: {last}"
+
+
+def _device_peak_bytes(jax) -> int | None:
+    try:
+        stats = jax.local_devices()[0].memory_stats()
+        return int(stats.get("peak_bytes_in_use", stats.get("bytes_in_use", 0)))
+    except Exception:  # noqa: BLE001 — memory_stats unsupported on some backends
+        return None
 
 
 def build_world(n_nodes: int = 1000, n_pods: int = 10000):
@@ -54,7 +108,7 @@ def build_world(n_nodes: int = 1000, n_pods: int = 10000):
     return cache
 
 
-def serial_cpu_baseline(snap_np) -> tuple[float, int]:
+def serial_cpu_baseline(snap_np, max_tasks: int | None = None) -> tuple[float, int]:
     """Reference-shaped serial allocate (allocate.go · Execute):
     tasks strictly in rank order; per task, over all nodes: the
     predicate chain, then PrioritizeNodes = weighted LeastRequested +
@@ -66,6 +120,10 @@ def serial_cpu_baseline(snap_np) -> tuple[float, int]:
     req, idle0, eps = snap_np["task_req"], snap_np["node_idle"], snap_np["eps"]
     cap = snap_np["node_cap"]
     order = np.lexsort((snap_np["task_order"], -snap_np["task_prio"]))
+    if max_tasks is not None:
+        # Sampled run: the loop is strictly linear in tasks, so a prefix
+        # yields an honest pods/s throughput without a 5-minute run.
+        order = order[:max_tasks]
     t0 = time.perf_counter()
     idle = idle0.copy()
     meaningful = cap > 0  # [N, R] dims the node exposes
@@ -80,9 +138,7 @@ def serial_cpu_baseline(snap_np) -> tuple[float, int]:
         with np.errstate(divide="ignore", invalid="ignore"):
             frac = np.where(meaningful, (idle - r) / np.maximum(cap, 1e-9), 0.0)
             least_requested = frac.mean(axis=1) * 10.0
-            spread = np.where(
-                meaningful, frac, np.nan
-            )
+            spread = np.where(meaningful, frac, np.nan)
             balanced = (1.0 - np.nanstd(spread, axis=1)) * 10.0
         score = np.where(fit, least_requested + balanced, -np.inf)
         # -- SelectBestNode + commit -----------------------------------
@@ -92,9 +148,19 @@ def serial_cpu_baseline(snap_np) -> tuple[float, int]:
     return time.perf_counter() - t0, placed
 
 
-def main() -> None:
-    import jax
+def _snap_np(snap, meta) -> dict:
+    """The serial baseline's inputs (shared by headline + configs)."""
+    return {
+        "task_req": np.asarray(snap.task_req)[: meta.num_real_tasks],
+        "node_idle": np.asarray(snap.node_idle)[: meta.num_real_nodes],
+        "node_cap": np.asarray(snap.node_cap)[: meta.num_real_nodes],
+        "eps": np.asarray(snap.eps),
+        "task_order": np.asarray(snap.task_order)[: meta.num_real_tasks],
+        "task_prio": np.asarray(snap.task_prio)[: meta.num_real_tasks],
+    }
 
+
+def run_headline(jax) -> dict:
     from kube_batch_tpu.actions.allocate import make_allocate_solver
     from kube_batch_tpu.cache.packer import pack_snapshot
     from kube_batch_tpu.framework.conf import default_conf
@@ -116,7 +182,7 @@ def main() -> None:
     )
 
     times = []
-    for _ in range(20):
+    for _ in range(30):
         t0 = time.perf_counter()
         r = solve_jit(snap, state0)
         np.asarray(r.task_state[:8])        # real sync: small D2H read
@@ -124,21 +190,23 @@ def main() -> None:
     cycle = float(np.median(times))
     p99 = float(np.quantile(times, 0.99))
 
-    snap_np = {
-        "task_req": np.asarray(snap.task_req)[: meta.num_real_tasks],
-        "node_idle": np.asarray(snap.node_idle)[: meta.num_real_nodes],
-        "node_cap": np.asarray(snap.node_cap)[: meta.num_real_nodes],
-        "eps": np.asarray(snap.eps),
-        "task_order": np.asarray(snap.task_order)[: meta.num_real_tasks],
-        "task_prio": np.asarray(snap.task_prio)[: meta.num_real_tasks],
-    }
-    cpu_time, cpu_placed = min(
-        (serial_cpu_baseline(snap_np) for _ in range(3)), key=lambda x: x[0]
-    )
+    snap_np = _snap_np(snap, meta)
+    # One probe run decides whether this host can afford full baselines
+    # (same budget discipline as run_config's CPU pass).
+    probe = serial_cpu_baseline(snap_np, max_tasks=1000)
+    per_task = probe[0] / max(probe[1], 1)
+    full_cost = per_task * meta.num_real_tasks
+    if full_cost * 3 < min(60.0, _budget_left() / 3):
+        cpu_time, cpu_placed = min(
+            (serial_cpu_baseline(snap_np) for _ in range(3)),
+            key=lambda x: x[0],
+        )
+    else:  # slow host: one sampled run keeps the JSON line alive
+        cpu_time, cpu_placed = serial_cpu_baseline(snap_np, max_tasks=2000)
 
     pods_per_sec = placed / cycle if cycle > 0 else 0.0
     cpu_pods_per_sec = cpu_placed / cpu_time if cpu_time > 0 else 1.0
-    print(json.dumps({
+    return {
         "metric": "pods_scheduled_per_sec_10kpod_1knode_gang",
         "value": round(pods_per_sec, 1),
         "unit": "pods/s",
@@ -147,8 +215,227 @@ def main() -> None:
         "cycle_ms_p99": round(p99 * 1e3, 2),
         "pods_placed": placed,
         "cpu_baseline_pods_per_sec": round(cpu_pods_per_sec, 1),
-        "device": str(jax.devices()[0].platform),
-    }))
+    }
+
+
+# Per-config action pipelines: what the config exercises (BASELINE.md).
+CONFIG_ACTIONS = {
+    1: ("allocate",),
+    2: ("allocate", "backfill"),
+    3: ("allocate", "backfill"),
+    4: ("allocate", "backfill", "preempt", "reclaim"),
+    5: ("allocate", "backfill", "preempt", "reclaim"),
+}
+
+
+def run_config(jax, n: int, timed_iters: int = 8) -> dict:
+    """One BASELINE config: pack + fused-pipeline solve, timed.
+
+    The fused cycle (actions/fused.py) is the production path: ONE
+    device dispatch for the whole action pipeline.
+    """
+    from kube_batch_tpu.actions.fused import make_cycle_solver
+    from kube_batch_tpu.api.types import TaskStatus
+    from kube_batch_tpu.cache.packer import pack_snapshot
+    from kube_batch_tpu.framework.conf import default_conf
+    from kube_batch_tpu.framework.session import build_policy
+    from kube_batch_tpu.models.workloads import build_config
+    from kube_batch_tpu.ops.assignment import init_state
+
+    cache, _sim = build_config(n)
+    _log(f"  config {n}: world built")
+    host = cache.snapshot()
+    t0 = time.perf_counter()
+    snap, meta = pack_snapshot(host)
+    jax.block_until_ready(snap.task_req)
+    pack_s = time.perf_counter() - t0
+    _log(f"  config {n}: packed in {pack_s:.1f}s "
+         f"({meta.num_real_tasks}x{meta.num_real_nodes})")
+
+    policy, _ = build_policy(default_conf())
+    cycle_fn = jax.jit(make_cycle_solver(policy, CONFIG_ACTIONS[n]))
+    state0 = init_state(snap)
+
+    t0 = time.perf_counter()
+    state, evict_masks, job_ready = cycle_fn(snap, state0)
+    final = np.asarray(state.task_state)
+    compile_s = time.perf_counter() - t0
+    _log(f"  config {n}: first solve (incl compile) {compile_s:.1f}s")
+
+    pend = int(TaskStatus.PENDING)
+    init_np = np.asarray(state0.task_state)[: meta.num_real_tasks]
+    fin_np = final[: meta.num_real_tasks]
+    placed = int(np.sum((init_np == pend) & (fin_np != pend)))
+    evicted = int(
+        sum(
+            np.sum(np.asarray(m)[: meta.num_real_tasks])
+            for m in evict_masks.values()
+        )
+    )
+
+    times = []
+    for _ in range(timed_iters):
+        t0 = time.perf_counter()
+        st, _, _ = cycle_fn(snap, state0)
+        np.asarray(st.task_state[:8])  # D2H fence
+        times.append(time.perf_counter() - t0)
+    solve_s = float(np.median(times)) if times else compile_s
+    _log(f"  config {n}: timed {timed_iters} iters, median {solve_s*1e3:.0f}ms")
+
+    # CPU reference point: the serial allocate loop on the same world
+    # (allocate semantics only — the reference has no batched preempt
+    # sweep to compare against; see serial_cpu_baseline docstring).
+    # Skipped when the global budget is nearly spent: the measured TPU
+    # numbers above must survive even if the CPU pass can't run.
+    cpu_s, cpu_placed = None, None
+    if _budget_left() > 150.0:
+        snap_np = _snap_np(snap, meta)
+        big = meta.num_real_tasks > 10000
+        sample = 5000 if big else None
+        cpu_s, cpu_placed = min(
+            (serial_cpu_baseline(snap_np, max_tasks=sample)
+             for _ in range(1 if big else 2)),
+            key=lambda x: x[0],
+        )
+
+    return {
+        "tasks": meta.num_real_tasks,
+        "nodes": meta.num_real_nodes,
+        "actions": len(CONFIG_ACTIONS[n]),
+        "pack_ms": round(pack_s * 1e3, 1),
+        "compile_ms": round(compile_s * 1e3, 1),
+        "solve_ms": round(solve_s * 1e3, 2),
+        "pods_placed": placed,
+        "pods_evicted": evicted,
+        "pods_per_sec": round(placed / solve_s, 1) if solve_s > 0 else 0.0,
+        "cpu_allocate_ms": round(cpu_s * 1e3, 2) if cpu_s else None,
+        "cpu_allocate_pods_per_sec": (
+            round(cpu_placed / cpu_s, 1) if cpu_s else None
+        ),
+        "peak_hbm_mb": (
+            round(peak / 1e6, 1)
+            if (peak := _device_peak_bytes(jax)) is not None else None
+        ),
+    }
+
+
+def _run_config_subprocess(n: int, timeout_s: float) -> dict:
+    """One config in a fresh interpreter.
+
+    Isolation is load-bearing, not hygiene: compiling a second LARGE
+    program through the axon tunnel in one process hangs indefinitely
+    (config 5 after config 4 reproduces it; either alone is fine), and a
+    per-config device OOM must not take the whole sweep down.  The child
+    prints one JSON dict; crash/timeout degrade to an error entry.
+    """
+    import subprocess
+
+    try:
+        proc = subprocess.run(
+            [
+                sys.executable, __file__, "--_one-config", str(n),
+                # Child inherits the PARENT'S remaining budget (its own
+                # _T_START resets at import), so its CPU-baseline gate
+                # skips rather than running the parent into the timeout.
+                "--_budget", f"{max(timeout_s - 45.0, 30.0):.0f}",
+            ],
+            capture_output=True, text=True, timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        return {"error": f"timed out after {timeout_s:.0f}s"}
+    line = proc.stdout.strip().splitlines()[-1] if proc.stdout.strip() else ""
+    try:
+        return json.loads(line)
+    except json.JSONDecodeError:
+        tail = (proc.stderr or "")[-300:]
+        return {"error": f"rc={proc.returncode}: {tail}"}
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--headline-only", action="store_true")
+    parser.add_argument(
+        "--configs", type=str, default="1,2,3,4,5",
+        help="comma-separated BASELINE config numbers to sweep",
+    )
+    parser.add_argument(
+        "--_one-config", type=int, default=None, dest="one_config",
+        help=argparse.SUPPRESS,  # internal: child-process mode
+    )
+    parser.add_argument(
+        "--_budget", type=float, default=None, dest="budget",
+        help=argparse.SUPPRESS,  # internal: parent's remaining budget
+    )
+    args = parser.parse_args()
+    if args.budget is not None:
+        global TIME_BUDGET_S
+        TIME_BUDGET_S = args.budget
+
+    if args.one_config is not None:
+        jax, platform, err = _init_jax()
+        if jax is None:
+            print(json.dumps({"error": err}))
+            return
+        try:
+            out = {"device": platform, **run_config(jax, args.one_config)}
+            if err:
+                out["device_init_warning"] = err
+            print(json.dumps(out))
+        except Exception as exc:  # noqa: BLE001
+            print(json.dumps({"device": platform, "error": str(exc)[:400]}))
+        return
+
+    result: dict = {
+        "metric": "pods_scheduled_per_sec_10kpod_1knode_gang",
+        "value": 0.0,
+        "unit": "pods/s",
+        "vs_baseline": 0.0,
+        "device": "none",
+    }
+
+    jax, platform, init_err = _init_jax()
+    if init_err:
+        result["device_init_warning"] = init_err
+    if jax is None:
+        result["error"] = init_err
+        print(json.dumps(result))
+        return
+
+    result["device"] = platform
+    _log(f"device={platform}")
+    try:
+        result.update(run_headline(jax))
+        _log(f"headline done: {result.get('cycle_ms_median')}ms median")
+    except Exception as exc:  # noqa: BLE001 — degrade, never die
+        result["error"] = f"headline failed: {exc}"
+        result["traceback"] = traceback.format_exc(limit=3)
+        _log(f"headline FAILED: {exc}")
+
+    if not args.headline_only:
+        configs: dict[str, dict] = {}
+        wanted = []
+        for c in args.configs.split(","):
+            c = c.strip()
+            if not c:
+                continue
+            try:
+                wanted.append(int(c))
+            except ValueError:
+                configs[c] = {"error": "not a config number"}
+        for n in wanted:
+            if _budget_left() < 60.0:
+                configs[str(n)] = {"skipped": "time budget exhausted"}
+                _log(f"config {n} skipped (budget)")
+                continue
+            _log(f"config {n} starting (subprocess)")
+            configs[str(n)] = _run_config_subprocess(
+                n, timeout_s=max(60.0, _budget_left())
+            )
+            _log(f"config {n} done: {configs[str(n)]}")
+        result["configs"] = configs
+
+    print(json.dumps(result))
+    sys.stdout.flush()
 
 
 if __name__ == "__main__":

@@ -61,6 +61,7 @@ def make_allocate_solver(policy, max_rounds: int | None = None):
                 score_quantum=policy.score_quantum,
                 dyn_predicate_fn=policy.dyn_predicate,
                 global_serialize_fn=policy.global_serialize_fn,
+                domain_serialize_fn=policy.domain_serialize_fn,
             )
         return state
 
@@ -70,6 +71,7 @@ def make_allocate_solver(policy, max_rounds: int | None = None):
 @register_action
 class AllocateAction(Action):
     name = "allocate"
+    solver_factory = staticmethod(make_allocate_solver)
 
     def initialize(self, policy) -> None:
         self.policy = policy

@@ -61,6 +61,7 @@ def make_backfill_solver(policy, max_rounds: int | None = None):
             max_rounds=max_rounds,
             dyn_predicate_fn=policy.dyn_predicate,
             global_serialize_fn=policy.global_serialize_fn,
+            domain_serialize_fn=policy.domain_serialize_fn,
         )
 
     return solve
@@ -69,6 +70,7 @@ def make_backfill_solver(policy, max_rounds: int | None = None):
 @register_action
 class BackfillAction(Action):
     name = "backfill"
+    solver_factory = staticmethod(make_backfill_solver)
 
     def initialize(self, policy) -> None:
         self.policy = policy

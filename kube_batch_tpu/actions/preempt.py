@@ -40,6 +40,20 @@ from kube_batch_tpu.framework.policy import task_queue_of
 from kube_batch_tpu.ops.preemption import preemption_rounds
 
 
+def wanting_jobs_mask(policy):
+    """bool[J]: any valid job with pending work ("underRequest") — the
+    trigger set shared by reclaim and preempt's phase 2."""
+
+    def wanting(snap, state):
+        pending_cnt = count_per_job(
+            snap, status_is(state.task_state, TaskStatus.PENDING)
+        )
+        valid = policy.job_valid_mask(snap, state)
+        return snap.job_mask & valid & (pending_cnt > 0)
+
+    return wanting
+
+
 def starving_jobs_mask(policy):
     """bool[J]: jobs entitled to trigger evictions right now."""
 
@@ -70,7 +84,15 @@ def snapshot_victims(snap, state):
 
 def make_preempt_solver(policy, max_iters: int | None = None):
     """(snap, state) -> state with victims RELEASING and preemptors
-    PIPELINED — the pure transactional sweep."""
+    PIPELINED — the pure transactional sweep.
+
+    Two phases, like the reference (actions/preempt/preempt.go ·
+    Execute): phase 1 preempts BETWEEN jobs of one queue (job-rank
+    gated); phase 2 preempts WITHIN one job — a higher-priority pending
+    task displaces its own job's lower-priority running task, under the
+    same tiered vetoes (gang's minMember-survival veto in particular,
+    so a gang below its floor never cannibalises itself).
+    """
 
     def victim_fn(snap, state, p):
         tq = task_queue_of(snap)
@@ -80,8 +102,18 @@ def make_preempt_solver(policy, max_iters: int | None = None):
         return (
             snapshot_victims(snap, state)
             & (tq == tq[p])                      # same queue
-            & (snap.task_job != snap.task_job[p])  # never cannibalise own job
+            & (snap.task_job != snap.task_job[p])  # phase 1: other jobs only
             & (jrank[tj] > jrank[pj])            # only less-deserving jobs
+            & policy.preemptable_mask(snap, state, p)
+        )
+
+    def victim_fn_intra(snap, state, p):
+        # Phase 2: victims from the preemptor's OWN job, strictly lower
+        # task priority (preempt.go's second loop).
+        return (
+            snapshot_victims(snap, state)
+            & (snap.task_job == snap.task_job[p])
+            & (snap.task_prio < snap.task_prio[p])
             & policy.preemptable_mask(snap, state, p)
         )
 
@@ -98,10 +130,15 @@ def make_preempt_solver(policy, max_iters: int | None = None):
         tj = jnp.clip(snap.task_job, 0, snap.num_jobs - 1)
         return jv[tj] & (snap.task_job >= 0) & ~besteffort_mask(snap)
 
+    # Phase 2 serves any valid job with pending work — including Ready
+    # jobs whose higher-priority members wait behind lower-priority
+    # running ones.
+    wanting_intra = wanting_jobs_mask(policy)
+
     def solve(snap, state):
         state = policy.setup_state(snap, state)
         pred = policy.predicate_mask(snap)
-        return preemption_rounds(
+        state = preemption_rounds(
             snap,
             state,
             pred,
@@ -113,24 +150,47 @@ def make_preempt_solver(policy, max_iters: int | None = None):
             max_iters=max_iters,
             dyn_predicate_row_fn=policy.dyn_predicate_row,
         )
+        return preemption_rounds(
+            snap,
+            state,
+            pred,
+            victim_fn_intra,
+            wanting_intra,
+            policy.rank_fn,
+            eligible,
+            snap.eps,
+            max_iters=max_iters,
+            dyn_predicate_row_fn=policy.dyn_predicate_row,
+        )
 
     return solve
 
 
-def commit_new_evictions(ssn, prev_task_state: np.ndarray, reason: str) -> None:
+def commit_victim_indices(ssn, victims: np.ndarray, reason: str) -> int:
+    """The one victim-commit funnel (fused and per-action paths): clip
+    padding rows, land evictions, return how many actually landed."""
+    victims = victims[victims < ssn.meta.num_real_tasks]
+    before = len(ssn.evicted)
+    ssn.commit_evictions(victims.tolist(), reason)
+    return len(ssn.evicted) - before
+
+
+def commit_new_evictions(ssn, prev_task_state: np.ndarray, reason: str) -> int:
     """Land the solve's RELEASING transitions through the session funnel."""
     new = np.asarray(ssn.state.task_state)
     victims = np.nonzero(
         (new == int(TaskStatus.RELEASING))
         & (prev_task_state != int(TaskStatus.RELEASING))
     )[0]
-    victims = victims[victims < ssn.meta.num_real_tasks]
-    ssn.commit_evictions(victims.tolist(), reason)
+    return commit_victim_indices(ssn, victims, reason)
 
 
 @register_action
 class PreemptAction(Action):
     name = "preempt"
+    solver_factory = staticmethod(make_preempt_solver)
+    evicting = True  # fused cycle reports this action's RELEASING transitions
+    evict_reason = "preempted"
 
     def initialize(self, policy) -> None:
         self.policy = policy

@@ -18,8 +18,6 @@ from __future__ import annotations
 import jax
 import numpy as np
 
-from kube_batch_tpu.api.snapshot import count_per_job, status_is
-from kube_batch_tpu.api.types import TaskStatus
 from kube_batch_tpu.framework.plugin import Action, register_action
 from kube_batch_tpu.framework.policy import task_queue_of
 from kube_batch_tpu.ops.preemption import preemption_rounds
@@ -28,22 +26,18 @@ from kube_batch_tpu.actions.backfill import non_besteffort_eligible
 from kube_batch_tpu.actions.preempt import (
     commit_new_evictions,
     snapshot_victims,
+    wanting_jobs_mask,
 )
 
 
 def make_reclaim_solver(policy, max_iters: int | None = None):
-    def wanting(snap, state):
-        """bool[J]: any valid job with pending work may reclaim — the
-        stop condition is queue-level (its queue reaching deserved →
-        Overused, via the eligibility gate), NOT job-level gang
-        readiness: reclaim's purpose is pushing each queue up to its
-        fair share (≙ reclaim.go looping every pending task of every
-        non-overused queue)."""
-        pending_cnt = count_per_job(
-            snap, status_is(state.task_state, TaskStatus.PENDING)
-        )
-        valid = policy.job_valid_mask(snap, state)
-        return snap.job_mask & valid & (pending_cnt > 0)
+    # Any valid job with pending work may reclaim — the stop condition
+    # is queue-level (its queue reaching deserved → Overused, via the
+    # eligibility gate), NOT job-level gang readiness: reclaim's purpose
+    # is pushing each queue up to its fair share (≙ reclaim.go looping
+    # every pending task of every non-overused queue).
+    wanting = wanting_jobs_mask(policy)
+
     def victim_fn(snap, state, p):
         # Inline stop-at-deserved (≙ reclaim.go's own check on the
         # victim queue's allocations vs the proportion-computed
@@ -91,6 +85,9 @@ def make_reclaim_solver(policy, max_iters: int | None = None):
 @register_action
 class ReclaimAction(Action):
     name = "reclaim"
+    solver_factory = staticmethod(make_reclaim_solver)
+    evicting = True  # fused cycle reports this action's RELEASING transitions
+    evict_reason = "reclaimed"
 
     def initialize(self, policy) -> None:
         self.policy = policy

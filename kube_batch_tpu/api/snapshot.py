@@ -64,9 +64,28 @@ class SnapshotTensors:
     task_critical: jax.Array   # bool[T]    conformance-protected (critical) pod
     # inter-pod affinity over the pod-label vocab (K = pod-label vocab)
     task_podlabels: jax.Array  # f32[T, K]  this pod's own labels, multi-hot
-    task_aff: jax.Array        # f32[T, K]  required co-location terms
-    task_anti: jax.Array       # f32[T, K]  required anti-affinity terms
+    task_aff: jax.Array        # f32[T, K]  required co-location terms (node-level)
+    task_anti: jax.Array       # f32[T, K]  required anti-affinity terms (node-level)
     task_podpref: jax.Array    # f32[T, K]  preferred co-location, weighted
+    # topology-scoped affinity terms ("zone:app=web"): K2 = topo-term
+    # vocab, TK = topology-key vocab, D = domain vocab (nodes sharing a
+    # topology label value; nodes missing the label get private
+    # fallback domains).  K2 == 0 (static) ⇒ no topo terms in this
+    # snapshot and kernels skip the domain math entirely.
+    task_aff_topo: jax.Array   # f32[T, K2]  required co-location, by domain
+    task_anti_topo: jax.Array  # f32[T, K2]  anti-affinity, by domain
+    topo_term_key: jax.Array   # i32[K2]     term → topology-key index
+    topo_term_label: jax.Array  # i32[K2]    term → pod-label index (in K)
+    node_key_domain: jax.Array  # i32[N, TK] node → domain id per topology key
+    domain_mask: jax.Array     # bool[D]    real-domain rows
+    # volume feasibility (G = constrained-claim "volume group" vocab):
+    # a bound local PV pins the task to one node; an unbound claim's
+    # StorageClass restricts it to nodes matching >=1 allowed label.
+    # task_vol_node: NONE_IDX = unpinned; -2 = infeasible everywhere
+    # (conflicting/unknown claims — diagnosed via fit_errors).
+    task_vol_node: jax.Array   # i32[T]
+    task_vol_groups: jax.Array  # f32[T, G]  constrained claims mounted
+    vol_group_sel: jax.Array   # f32[G, L]  each group's OR-set of labels
 
     # -- jobs -----------------------------------------------------------
     job_queue: jax.Array       # i32[J]     owning queue index
@@ -83,11 +102,23 @@ class SnapshotTensors:
     node_taints: jax.Array     # f32[N, V]  NoSchedule/NoExecute taints, multi-hot
     node_ports: jax.Array      # f32[N, P]  occupied host ports, multi-hot
     node_ready: jax.Array      # bool[N]    node Ready condition / schedulable
+    node_pressure: jax.Array   # f32[N, 3]  memory/disk/PID pressure conditions
     node_mask: jax.Array       # bool[N]
 
     # -- queues ---------------------------------------------------------
     queue_weight: jax.Array    # f32[Q]     proportional-share weight
     queue_mask: jax.Array      # bool[Q]
+
+    # -- namespaces (S = namespace vocab; ≙ api/namespace_info.go) ------
+    task_ns: jax.Array         # i32[T]     owning namespace index
+    ns_weight: jax.Array       # f32[S]     fair-share weight (default 1)
+    ns_mask: jax.Array         # bool[S]
+
+    # -- pod disruption budgets (B = PDB vocab; ≙ JobInfo.PDB) ----------
+    # task_pdb: index of the (first) PDB whose selector matches the
+    # pod's labels, NONE_IDX if none.
+    task_pdb: jax.Array        # i32[T]
+    pdb_min: jax.Array         # i32[B]     minAvailable floors
 
     # -- cluster --------------------------------------------------------
     cluster_total: jax.Array   # f32[R]     sum of allocatable over real nodes
@@ -128,6 +159,10 @@ class SnapshotTensors:
             self.task_tol.shape[1],
             self.task_ports.shape[1],
             self.task_podlabels.shape[1],
+            self.task_aff_topo.shape[1],
+            self.node_key_domain.shape[1],
+            self.domain_mask.shape[0],
+            self.task_vol_groups.shape[1],
         )
 
 

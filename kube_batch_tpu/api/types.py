@@ -68,6 +68,48 @@ class PodGroupPhase(enum.StrEnum):
     INQUEUE = "Inqueue"
 
 
+import dataclasses as _dataclasses  # noqa: E402 — local to avoid re-export
+
+
+@_dataclasses.dataclass
+class PodGroupCondition:
+    """Typed status condition (≙ v1alpha1 · PodGroupCondition:
+    Type/Status/Reason/Message).  Supports `"text" in condition` so
+    message greps read naturally in tests and logs."""
+
+    type: str                 # e.g. "Unschedulable"
+    message: str = ""
+    status: bool = True
+    reason: str = ""
+
+    def __str__(self) -> str:
+        return f"{self.type}: {self.message}"
+
+    def __contains__(self, item: str) -> bool:
+        return item in str(self)
+
+
+@_dataclasses.dataclass
+class Event:
+    """A structured per-object event record (≙ the Kubernetes Events
+    the reference emits through its Recorder): object kind/name, a
+    CamelCase reason, a human message, and an aggregation count.
+    Supports `"text" in event` for message greps."""
+
+    kind: str                 # "Pod" | "PodGroup" | "Node" | "Scheduler"
+    name: str                 # object name ("" for scheduler-level)
+    reason: str               # "Bound" | "Evicted" | "BindFailed" | ...
+    message: str = ""
+    count: int = 1
+
+    def __str__(self) -> str:
+        suffix = f" (x{self.count})" if self.count > 1 else ""
+        return f"{self.kind}/{self.name} {self.reason}: {self.message}{suffix}"
+
+    def __contains__(self, item: str) -> bool:
+        return item in str(self)
+
+
 #: Annotation-equivalent key linking a task to its group
 #: (reference: pkg/apis/scheduling/v1alpha1/types.go · GroupNameAnnotationKey).
 GROUP_NAME_KEY = "scheduling.tpu/group-name"

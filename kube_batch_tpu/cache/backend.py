@@ -35,6 +35,16 @@ class StatusUpdater(Protocol):
         """Write back job phase/conditions (≙ PodGroup status update)."""
 
 
+@runtime_checkable
+class VolumeBinder(Protocol):
+    """The fourth side-effect interface (≙ cache/interface.go ·
+    VolumeBinder: AllocateVolumes/BindVolumes before the pod bind)."""
+
+    def bind_volumes(self, pod: Pod, node_name: str) -> None:
+        """Provision/bind the pod's claims for this node.  Raise to fail
+        the bind (the cache resyncs the task, same as a bind failure)."""
+
+
 class FakeBinder:
     """Records binds; `wait_for` mirrors the reference tests' channel
     pattern (assert expected binds arrive)."""
@@ -71,3 +81,17 @@ class FakeStatusUpdater:
 
     def update_pod_group(self, group: PodGroup) -> None:
         self.updates.append(group)
+
+
+class FakeVolumeBinder:
+    """Records volume binds; inject failures by pod name
+    (≙ FakeVolumeBinder in the reference's test utilities)."""
+
+    def __init__(self) -> None:
+        self.bound: list[tuple[str, str]] = []  # (pod name, node name)
+        self.fail_pods: set[str] = set()
+
+    def bind_volumes(self, pod: Pod, node_name: str) -> None:
+        if pod.name in self.fail_pods:
+            raise RuntimeError(f"injected volume-bind failure for {pod.name}")
+        self.bound.append((pod.name, node_name))

@@ -25,8 +25,22 @@ import threading
 
 from kube_batch_tpu.api.resource import ResourceSpec
 from kube_batch_tpu.api.types import TaskStatus
-from kube_batch_tpu.cache.backend import Binder, Evictor, StatusUpdater
-from kube_batch_tpu.cache.cluster import Node, Pod, PodGroup, Queue
+from kube_batch_tpu.cache.backend import (
+    Binder,
+    Evictor,
+    StatusUpdater,
+    VolumeBinder,
+)
+from kube_batch_tpu.cache.cluster import (
+    Claim,
+    Namespace,
+    Node,
+    Pod,
+    PodDisruptionBudget,
+    PodGroup,
+    Queue,
+    StorageClass,
+)
 from kube_batch_tpu.cache.info import JobInfo, NodeInfo, QueueInfo
 
 DEFAULT_QUEUE = "default"
@@ -40,6 +54,14 @@ class HostSnapshot:
     jobs: dict[str, JobInfo]          # by group name
     nodes: dict[str, NodeInfo]        # by node name
     queues: dict[str, QueueInfo]      # by queue name
+    claims: dict[str, Claim] = dataclasses.field(default_factory=dict)
+    storage_classes: dict[str, StorageClass] = dataclasses.field(
+        default_factory=dict
+    )
+    namespaces: dict[str, Namespace] = dataclasses.field(default_factory=dict)
+    pdbs: dict[str, PodDisruptionBudget] = dataclasses.field(
+        default_factory=dict
+    )
 
 
 class SchedulerCache:
@@ -49,12 +71,14 @@ class SchedulerCache:
         binder: Binder,
         evictor: Evictor,
         status_updater: StatusUpdater | None = None,
+        volume_binder: VolumeBinder | None = None,
         default_queue: str = DEFAULT_QUEUE,
     ) -> None:
         self.spec = spec
         self.binder = binder
         self.evictor = evictor
         self.status_updater = status_updater
+        self.volume_binder = volume_binder
         self.default_queue = default_queue
 
         self._lock = threading.RLock()
@@ -62,14 +86,67 @@ class SchedulerCache:
         self._jobs: dict[str, JobInfo] = {}      # by group name
         self._nodes: dict[str, NodeInfo] = {}    # by node name
         self._queues: dict[str, QueueInfo] = {}  # by queue name
+        self._claims: dict[str, Claim] = {}      # by claim name
+        self._storage_classes: dict[str, StorageClass] = {}  # by name
+        self._namespaces: dict[str, Namespace] = {}          # by name
+        self._pdbs: dict[str, PodDisruptionBudget] = {}      # by name
         self._resync: list[str] = []             # pod uids of failed binds
-        # Human-readable event log, bounded like an apiserver's event TTL
-        # window: a long-running daemon with a persistent unschedulable
-        # backlog appends diagnosis lines every cycle and nothing drains
-        # them — the ring keeps the newest window instead of OOMing.
-        self.events: collections.deque[str] = collections.deque(maxlen=10000)
+        # Structured per-object event records (≙ the reference's
+        # Recorder emitting Kubernetes Events), bounded like an
+        # apiserver's event TTL window: a long-running daemon with a
+        # persistent unschedulable backlog emits diagnosis every cycle
+        # and nothing drains it — the ring keeps the newest window, and
+        # repeats aggregate into one record's count (k8s-style).
+        self.events: collections.deque = collections.deque(maxlen=10000)
+        self._event_index: dict[tuple, object] = {}
 
         self.add_queue(Queue(name=default_queue, weight=1.0))
+
+    # -- events (≙ cache.go · Recorder) ---------------------------------
+
+    def record_event(self, kind: str, name: str, reason: str, message: str):
+        """Record (or aggregate) one structured event; returns it."""
+        from kube_batch_tpu.api.types import Event
+
+        with self._lock:
+            key = (kind, name, reason, message)
+            ev = self._event_index.get(key)
+            if ev is not None:
+                ev.count += 1
+                return ev
+            ev = Event(kind=kind, name=name, reason=reason, message=message)
+            if (
+                self.events.maxlen is not None
+                and len(self.events) == self.events.maxlen
+            ):
+                old = self.events[0]  # about to be evicted by append
+                self._event_index.pop(
+                    (old.kind, old.name, old.reason, old.message), None
+                )
+            self.events.append(ev)
+            self._event_index[key] = ev
+            return ev
+
+    def events_for(self, kind: str, name: str) -> list:
+        """Events attached to one object (filterable, unlike a string log)."""
+        with self._lock:
+            return [e for e in self.events if e.kind == kind and e.name == name]
+
+    def add_job_condition(self, job_name: str, condition) -> None:
+        """Append a typed PodGroup condition through the cache funnel
+        (plugins must not reach into private job state), deduplicated by
+        (type, message)."""
+        with self._lock:
+            job = self._jobs.get(job_name)
+            if job is None:
+                return
+            for existing in job.pod_group.conditions:
+                if (
+                    getattr(existing, "type", None) == condition.type
+                    and getattr(existing, "message", None) == condition.message
+                ):
+                    return
+            job.pod_group.conditions.append(condition)
 
     # -- event handlers (≙ cache/event_handlers.go) ---------------------
 
@@ -134,6 +211,20 @@ class SchedulerCache:
                 raise ValueError(f"node {node.name} already cached")
             self._nodes[node.name] = NodeInfo(spec=self.spec, node=node)
 
+    def update_node(self, node: Node) -> None:
+        """Replace a node's API object (readiness/labels/taints/
+        allocatable changes from the adapter; ≙ UpdateNode).  Capacity
+        accounting is re-derived: allocatable may have changed, and
+        idle = allocatable − used must track it.  Unknown node → add."""
+        with self._lock:
+            info = self._nodes.get(node.name)
+            if info is None:
+                self._nodes[node.name] = NodeInfo(spec=self.spec, node=node)
+            else:
+                info.node = node
+                info.allocatable = self.spec.vec(node.allocatable)
+                info.idle = info.allocatable - info.used
+
     def delete_node(self, name: str) -> None:
         with self._lock:
             info = self._nodes.pop(name, None)
@@ -166,6 +257,39 @@ class SchedulerCache:
     def delete_queue(self, name: str) -> None:
         with self._lock:
             self._queues.pop(name, None)
+
+    # -- volume objects (≙ the pv/pvc/sc informers of cache.go) ---------
+    def add_claim(self, claim: Claim) -> None:
+        with self._lock:
+            self._claims[claim.name] = claim
+
+    def delete_claim(self, name: str) -> None:
+        with self._lock:
+            self._claims.pop(name, None)
+
+    def add_storage_class(self, sc: StorageClass) -> None:
+        with self._lock:
+            self._storage_classes[sc.name] = sc
+
+    def delete_storage_class(self, name: str) -> None:
+        with self._lock:
+            self._storage_classes.pop(name, None)
+
+    def add_namespace(self, ns: Namespace) -> None:
+        with self._lock:
+            self._namespaces[ns.name] = ns
+
+    def delete_namespace(self, name: str) -> None:
+        with self._lock:
+            self._namespaces.pop(name, None)
+
+    def add_pdb(self, pdb: PodDisruptionBudget) -> None:
+        with self._lock:
+            self._pdbs[pdb.name] = pdb
+
+    def delete_pdb(self, name: str) -> None:
+        with self._lock:
+            self._pdbs.pop(name, None)
 
     def _node(self, name: str) -> NodeInfo:
         info = self._nodes.get(name)
@@ -200,7 +324,16 @@ class SchedulerCache:
                 if info.node.ready
             }
             queues = {name: QueueInfo(queue=q.queue) for name, q in self._queues.items()}
-            return HostSnapshot(spec=self.spec, jobs=jobs, nodes=nodes, queues=queues)
+            return HostSnapshot(
+                spec=self.spec,
+                jobs=jobs,
+                nodes=nodes,
+                queues=queues,
+                claims=dict(self._claims),
+                storage_classes=dict(self._storage_classes),
+                namespaces=dict(self._namespaces),
+                pdbs=dict(self._pdbs),
+            )
 
     # -- commit funnel (≙ cache.go · Bind / Evict) -----------------------
 
@@ -215,20 +348,29 @@ class SchedulerCache:
                 # Stale target (node vanished between snapshot and commit):
                 # treat as a failed bind and resync, don't crash the loop.
                 self._resync.append(pod_uid)
-                self.events.append(f"bind-failed {pod.name}: unknown node {node_name}")
+                self.record_event(
+                    "Pod", pod.name, "BindFailed",
+                    f"bind-failed: unknown node {node_name}",
+                )
                 return False
             self.update_pod_status(pod_uid, TaskStatus.BINDING, node=node_name)
         try:
+            # Volumes first (≙ cache.go binding VolumeBinder.AllocateVolumes
+            # + BindVolumes before the pod Binding subresource): a volume
+            # failure fails the whole bind and resyncs the task.
+            if self.volume_binder is not None and pod.claims:
+                self.volume_binder.bind_volumes(pod, node_name)
             self.binder.bind(pod, node_name)
         except Exception as exc:  # noqa: BLE001 — any bind failure is retryable
             with self._lock:
                 self.update_pod_status(pod_uid, TaskStatus.PENDING)
                 self._resync.append(pod_uid)
-                self.events.append(f"bind-failed {pod.name}: {exc}")
+            self.record_event("Pod", pod.name, "BindFailed",
+                              f"bind-failed: {exc}")
             return False
         with self._lock:
             self.update_pod_status(pod_uid, TaskStatus.BOUND)
-            self.events.append(f"bound {pod.name} -> {node_name}")
+        self.record_event("Pod", pod.name, "Bound", f"bound -> {node_name}")
         return True
 
     def evict(self, pod_uid: str, reason: str) -> bool:
@@ -243,10 +385,10 @@ class SchedulerCache:
         except Exception as exc:  # noqa: BLE001 — roll back, retry next cycle
             with self._lock:
                 self.update_pod_status(pod_uid, prev_status)
-                self.events.append(f"evict-failed {pod.name}: {exc}")
+            self.record_event("Pod", pod.name, "EvictFailed",
+                              f"evict-failed: {exc}")
             return False
-        with self._lock:
-            self.events.append(f"evicted {pod.name}: {reason}")
+        self.record_event("Pod", pod.name, "Evicted", f"evicted: {reason}")
         return True
 
     def update_job_status(self, group: PodGroup) -> None:

@@ -49,14 +49,21 @@ class Pod:
     priority: int = 0
     namespace: str = "default"
     selector: Mapping[str, str] = dataclasses.field(default_factory=dict)
-    # -- inter-pod affinity (topologyKey = node) ------------------------
+    # -- inter-pod affinity ---------------------------------------------
     # `labels` are this pod's own matchable labels; `affinity` terms
-    # require ≥1 resident pod carrying the label on the target node;
-    # `anti_affinity` terms forbid any such resident (and symmetrically,
-    # a resident's anti term blocks newcomers matching it); `pod_prefs`
-    # are soft co-location terms with weights (the
-    # InterPodAffinityPriority analog).  All terms are "key=value"
-    # strings, matching the node-label simplification above.
+    # require ≥1 resident pod carrying the label in the target topology
+    # domain; `anti_affinity` terms forbid any such resident (and
+    # symmetrically, a resident's anti term blocks newcomers matching
+    # it); `pod_prefs` are soft co-location terms with weights (the
+    # InterPodAffinityPriority analog; node-level terms only — a
+    # topology-scoped pref is warned about and ignored).  Term syntax
+    # for affinity/anti_affinity:
+    #   "key=value"            topologyKey = the node itself (hostname)
+    #   "zone:key=value"       topologyKey = node label "zone" — the
+    #                          domain is all nodes sharing that label's
+    #                          value (≙ the vendored predicate's
+    #                          arbitrary topologyKey support,
+    #                          plugins/predicates/predicates.go)
     labels: Mapping[str, str] = dataclasses.field(default_factory=dict)
     affinity: frozenset[str] = frozenset()
     anti_affinity: frozenset[str] = frozenset()
@@ -69,6 +76,7 @@ class Pod:
     preferences: Mapping[str, float] = dataclasses.field(default_factory=dict)
     tolerations: frozenset[str] = frozenset()
     ports: frozenset[int] = frozenset()
+    claims: frozenset[str] = frozenset()  # PVC names this pod mounts
     status: TaskStatus = TaskStatus.PENDING
     node: str | None = None            # assigned node name, if any
     uid: str = dataclasses.field(default_factory=lambda: _new_uid("pod"))
@@ -134,13 +142,24 @@ class Pod:
 
 @dataclasses.dataclass
 class Node:
-    """A schedulable machine (≙ core/v1 Node as seen by the scheduler)."""
+    """A schedulable machine (≙ core/v1 Node as seen by the scheduler).
+
+    The pressure booleans mirror the node conditions the reference's
+    optional predicates check (plugins/predicates/predicates.go ·
+    CheckNodeMemoryPressure / DiskPressure / PIDPressure, toggled via
+    `predicate.*PressureEnable` Arguments) — separate bits, NOT folded
+    into `ready`, so a conf written for the reference means the same
+    thing here.
+    """
 
     name: str
     allocatable: Mapping[str, float] = dataclasses.field(default_factory=dict)
     labels: Mapping[str, str] = dataclasses.field(default_factory=dict)
     taints: frozenset[str] = frozenset()   # "key=value:effect" strings
     ready: bool = True
+    memory_pressure: bool = False
+    disk_pressure: bool = False
+    pid_pressure: bool = False
     uid: str = dataclasses.field(default_factory=lambda: _new_uid("node"))
 
 
@@ -173,3 +192,61 @@ class Queue:
     name: str
     weight: float = 1.0
     uid: str = dataclasses.field(default_factory=lambda: _new_uid("queue"))
+
+
+@dataclasses.dataclass
+class Namespace:
+    """A namespace with a fair-share weight (≙ api/namespace_info.go:
+    the reference collects a per-namespace weight and serves namespaces
+    within a queue by weighted fairness via NamespaceOrderFn).
+    Namespaces never declared default to weight 1."""
+
+    name: str
+    weight: float = 1.0
+    uid: str = dataclasses.field(default_factory=lambda: _new_uid("ns"))
+
+
+@dataclasses.dataclass
+class PodDisruptionBudget:
+    """Eviction floor for plain pods (≙ JobInfo.PDB in api/job_info.go:
+    the reference carries the PDB alongside the job and victim filtering
+    honors it).  Pods whose labels match `selector` are members;
+    eviction is vetoed when healthy members would drop below
+    `min_available`."""
+
+    name: str
+    min_available: int = 0
+    selector: Mapping[str, str] = dataclasses.field(default_factory=dict)
+    uid: str = dataclasses.field(default_factory=lambda: _new_uid("pdb"))
+
+    def matches(self, pod: "Pod") -> bool:
+        return all(pod.labels.get(k) == v for k, v in self.selector.items())
+
+
+@dataclasses.dataclass
+class StorageClass:
+    """Provisioner constraints for unbound claims (≙ storage.k8s.io/v1
+    StorageClass + the PV node-affinity its volumes will carry).
+
+    `allowed_node_labels`: "key=value" strings; an unbound claim of this
+    class can only follow its pod to a node carrying AT LEAST ONE of
+    them (the OR-of-terms shape of PV nodeAffinity).  Empty = any node
+    (network storage).
+    """
+
+    name: str
+    allowed_node_labels: frozenset[str] = frozenset()
+    uid: str = dataclasses.field(default_factory=lambda: _new_uid("sc"))
+
+
+@dataclasses.dataclass
+class Claim:
+    """A persistent volume claim pods may mount (≙ core/v1 PVC as the
+    scheduler sees it: either bound to a node-affine PV already, or
+    unbound with a StorageClass whose provisioner constrains placement).
+    """
+
+    name: str
+    storage_class: str = ""
+    bound_node: str | None = None  # bound local PV pins pods to this node
+    uid: str = dataclasses.field(default_factory=lambda: _new_uid("pvc"))

@@ -56,6 +56,19 @@ def _multi_hot(items_per_row: list[list[int]], rows: int, width: int) -> np.ndar
     return out
 
 
+def split_topo_term(term: str) -> tuple[str | None, str]:
+    """'zone:app=web' → ('zone', 'app=web'); 'app=web' → (None, 'app=web').
+
+    A ':' counts as a topology-key separator only before the first '='
+    (label values may legally contain colons).
+    """
+    colon = term.find(":")
+    eq = term.find("=")
+    if colon > 0 and (eq < 0 or colon < eq):
+        return term[:colon], term[colon + 1:]
+    return None, term
+
+
 def pack_snapshot(host: HostSnapshot) -> tuple[SnapshotTensors, SnapshotMeta]:
     spec = host.spec
 
@@ -82,6 +95,17 @@ def pack_snapshot(host: HostSnapshot) -> tuple[SnapshotTensors, SnapshotMeta]:
     taints: set[str] = set()
     ports: set[int] = set()
     podlabels: set[str] = set()
+    topo_keys: set[str] = set()
+    topo_terms: set[tuple[str, str]] = set()  # (topology key, "k=v" label)
+
+    def _intern_terms(terms) -> None:
+        for term in terms:
+            tk, lab = split_topo_term(term)
+            podlabels.add(lab)
+            if tk is not None:
+                topo_keys.add(tk)
+                topo_terms.add((tk, lab))
+
     for pod in tasks:
         # empty-attribute guards: most pods carry no selector/taints/
         # ports, and skipping the no-op set.update calls removes ~200k
@@ -97,11 +121,38 @@ def pack_snapshot(host: HostSnapshot) -> tuple[SnapshotTensors, SnapshotMeta]:
         if pod.labels:
             podlabels.update(f"{k}={v}" for k, v in pod.labels.items())
         if pod.affinity:
-            podlabels.update(pod.affinity)
+            _intern_terms(pod.affinity)
         if pod.anti_affinity:
-            podlabels.update(pod.anti_affinity)
+            _intern_terms(pod.anti_affinity)
         if pod.pod_prefs:
-            podlabels.update(pod.pod_prefs)
+            for term in pod.pod_prefs:
+                if split_topo_term(term)[0] is not None:
+                    # Soft co-location is node-level only for now; a
+                    # silently-dead vocab entry would be worse than a
+                    # visible warning.
+                    import logging
+
+                    logging.getLogger(__name__).warning(
+                        "pod %s: topology-scoped soft preference %r is "
+                        "not supported (node-level terms only); ignored",
+                        pod.name, term,
+                    )
+                else:
+                    podlabels.add(term)
+    # Storage-class allowed labels enter the node-label vocab so volume
+    # feasibility is one more multi-hot product.
+    constrained_claims: list[str] = []
+    for pod in tasks:
+        if pod.claims:
+            for cname in pod.claims:
+                claim = host.claims.get(cname)
+                if claim is None or claim.bound_node is not None:
+                    continue
+                sc = host.storage_classes.get(claim.storage_class)
+                if sc is not None and sc.allowed_node_labels:
+                    labels.update(sc.allowed_node_labels)
+                    constrained_claims.append(cname)
+
     node_resident_ports: dict[str, set[int]] = {}
     for nname in node_names:
         info = host.nodes[nname]
@@ -164,19 +215,44 @@ def pack_snapshot(host: HostSnapshot) -> tuple[SnapshotTensors, SnapshotMeta]:
         [[pl_idx[f"{k}={v}"] for k, v in p.labels.items()] if p.labels else _empty
          for p in tasks], T, K,
     )
-    task_aff = _multi_hot(
-        [[pl_idx[a] for a in p.affinity] if p.affinity else _empty
-         for p in tasks], T, K,
-    )
-    task_anti = _multi_hot(
-        [[pl_idx[a] for a in p.anti_affinity] if p.anti_affinity else _empty
-         for p in tasks], T, K,
-    )
+
+    # Node-level terms index the pod-label vocab; topology-scoped terms
+    # ("zone:app=web") index the (key, label) topo-term vocab.
+    topo_term_list = sorted(topo_terms)
+    tt_idx = {t: i for i, t in enumerate(topo_term_list)}
+    topo_key_list = sorted(topo_keys)
+    tk_idx = {k: i for i, k in enumerate(topo_key_list)}
+    K2r = len(topo_term_list)
+
+    def _split_rows(attr: str) -> tuple[list[list[int]], list[list[int]]]:
+        node_rows, topo_rows = [], []
+        for p in tasks:
+            terms = getattr(p, attr)
+            if not terms:
+                node_rows.append(_empty)
+                topo_rows.append(_empty)
+                continue
+            nr, tr = [], []
+            for term in terms:
+                tk, lab = split_topo_term(term)
+                if tk is None:
+                    nr.append(pl_idx[lab])
+                else:
+                    tr.append(tt_idx[(tk, lab)])
+            node_rows.append(nr)
+            topo_rows.append(tr)
+        return node_rows, topo_rows
+
+    aff_rows, aff_topo_rows = _split_rows("affinity")
+    anti_rows, anti_topo_rows = _split_rows("anti_affinity")
+    task_aff = _multi_hot(aff_rows, T, K)
+    task_anti = _multi_hot(anti_rows, T, K)
     task_podpref = np.zeros((T, K), dtype=np.float32)
     for i, p in enumerate(tasks):
         if p.pod_prefs:
             for term, w in p.pod_prefs.items():
-                task_podpref[i, pl_idx[term]] = w
+                if term in pl_idx:  # topo-scoped prefs warned+dropped above
+                    task_podpref[i, pl_idx[term]] = w
 
     # -- job tensors ----------------------------------------------------
     job_queue = np.array(
@@ -215,9 +291,144 @@ def pack_snapshot(host: HostSnapshot) -> tuple[SnapshotTensors, SnapshotMeta]:
     node_ports = _multi_hot(
         [[prt_idx[p] for p in node_resident_ports[n]] for n in node_names], N, P
     )
+    node_pressure = np.array(
+        [
+            [
+                host.nodes[n].node.memory_pressure,
+                host.nodes[n].node.disk_pressure,
+                host.nodes[n].node.pid_pressure,
+            ]
+            for n in node_names
+        ],
+        dtype=np.float32,
+    ) if node_names else np.zeros((0, 3), np.float32)
+
+    # -- topology domains (only when topo-scoped terms exist) -----------
+    # Domain = the set of nodes sharing a topology label's value; a node
+    # missing the label gets a PRIVATE fallback domain (it can never
+    # co-locate with anything under that key).  The last padded domain
+    # row is a dead domain that padded topology-key columns point at.
+    if K2r:
+        TKr = len(topo_key_list)
+        TKp = bucket(TKr, minimum=1)
+        K2 = bucket(K2r, minimum=8)
+        dom_idx: dict[str, int] = {}
+        fallback_count = 0
+        nkd = np.zeros((N, TKp), dtype=np.int32)
+        for ti, tk in enumerate(topo_key_list):
+            for ni, nname in enumerate(node_names):
+                val = host.nodes[nname].node.labels.get(tk)
+                if val is None:
+                    # Private fallback domain; ids live after the
+                    # interned block — marked negative here, remapped
+                    # once dom_idx is final.
+                    fallback_count += 1
+                    nkd[ni, ti] = -fallback_count
+                else:
+                    key = f"{tk}={val}"
+                    if key not in dom_idx:
+                        dom_idx[key] = len(dom_idx)
+                    nkd[ni, ti] = dom_idx[key]
+        Dm = len(dom_idx)
+        nkd = np.where(nkd < 0, Dm + (-nkd - 1), nkd)
+        D_real = Dm + fallback_count
+        Dp = bucket(D_real + 1, minimum=8)
+        dead = Dp - 1
+        nkd[:, TKr:] = dead
+        node_key_domain = nkd
+        # Padded term columns carry key/label 0 — harmless, since their
+        # task_aff_topo/task_anti_topo columns are all-zero.
+        topo_term_key = pad_rows(np.array(
+            [tk_idx[t[0]] for t in topo_term_list], dtype=np.int32
+        ), K2)
+        topo_term_label = pad_rows(np.array(
+            [pl_idx[t[1]] for t in topo_term_list], dtype=np.int32
+        ), K2)
+        task_aff_topo = _multi_hot(aff_topo_rows, T, K2)
+        task_anti_topo = _multi_hot(anti_topo_rows, T, K2)
+        domain_mask_np = np.zeros(Dp, bool)
+        domain_mask_np[:D_real] = True
+    else:  # static zero-width: kernels skip all domain math
+        TKp, K2, Dp = 0, 0, 0
+        node_key_domain = np.zeros((N, 0), np.int32)
+        topo_term_key = np.zeros(0, np.int32)
+        topo_term_label = np.zeros(0, np.int32)
+        task_aff_topo = np.zeros((T, 0), np.float32)
+        task_anti_topo = np.zeros((T, 0), np.float32)
+        domain_mask_np = np.zeros(0, bool)
+
+    # -- volume feasibility (claims → pins / allowed-label groups) ------
+    INFEASIBLE = -2  # conflicting/unknown claims: no node can satisfy
+    group_names = sorted(set(constrained_claims))
+    g_idx = {c: i for i, c in enumerate(group_names)}
+    G = bucket(len(group_names), minimum=8) if group_names else 0
+    task_vol_node = np.full(T, NONE_IDX, np.int32)
+    task_vol_groups = np.zeros((T, G), np.float32)
+    vol_group_sel = np.zeros((G, L), np.float32)
+    for cname in group_names:
+        sc = host.storage_classes[host.claims[cname].storage_class]
+        for lab in sc.allowed_node_labels:
+            vol_group_sel[g_idx[cname], lab_idx[lab]] = 1.0
+    for ti, pod in enumerate(tasks):
+        if not pod.claims:
+            continue
+        for cname in pod.claims:
+            claim = host.claims.get(cname)
+            if claim is None:
+                task_vol_node[ti] = INFEASIBLE  # unknown PVC
+                continue
+            if claim.bound_node is not None:
+                pin = node_idx.get(claim.bound_node, INFEASIBLE)
+                if task_vol_node[ti] == NONE_IDX:
+                    task_vol_node[ti] = pin
+                elif task_vol_node[ti] != pin:
+                    task_vol_node[ti] = INFEASIBLE  # two different pins
+            elif cname in g_idx:
+                task_vol_groups[ti, g_idx[cname]] = 1.0
+            elif (
+                claim.storage_class
+                and claim.storage_class not in host.storage_classes
+            ):
+                task_vol_node[ti] = INFEASIBLE  # unknown StorageClass
 
     queue_weight = np.array(
         [host.queues[n].weight for n in queue_names], dtype=np.float32
+    )
+
+    # -- namespaces: declared weights + implicit weight-1 for the rest --
+    ns_names = sorted(
+        set(host.namespaces) | {p.namespace for p in tasks}
+    ) or ["default"]
+    ns_idx = {n: i for i, n in enumerate(ns_names)}
+    S = len(ns_names)
+    Sp = bucket(S)
+    task_ns = np.array(
+        [ns_idx[p.namespace] for p in tasks], dtype=np.int32
+    ) if tasks else np.zeros(0, np.int32)
+    ns_weight = np.array(
+        [
+            host.namespaces[n].weight if n in host.namespaces else 1.0
+            for n in ns_names
+        ],
+        dtype=np.float32,
+    )
+
+    # -- PDBs: first matching budget per pod (multi-PDB pods keep the
+    # first by name order; documented simplification) -------------------
+    pdb_names = sorted(host.pdbs)
+    Bp = bucket(len(pdb_names)) if pdb_names else 0
+    task_pdb = np.full(T, NONE_IDX, np.int32)
+    if pdb_names:
+        pdb_objs = [host.pdbs[n] for n in pdb_names]
+        for ti, pod in enumerate(tasks):
+            if not pod.labels:
+                continue
+            for bi, pdb in enumerate(pdb_objs):
+                if pdb.selector and pdb.matches(pod):
+                    task_pdb[ti] = bi
+                    break
+    pdb_min = np.array(
+        [host.pdbs[n].min_available for n in pdb_names], dtype=np.int32
     )
 
     snap = SnapshotTensors(
@@ -237,6 +448,17 @@ def pack_snapshot(host: HostSnapshot) -> tuple[SnapshotTensors, SnapshotMeta]:
         task_aff=jnp.asarray(pad_rows(task_aff, Tp)),
         task_anti=jnp.asarray(pad_rows(task_anti, Tp)),
         task_podpref=jnp.asarray(pad_rows(task_podpref, Tp)),
+        task_aff_topo=jnp.asarray(pad_rows(task_aff_topo, Tp)),
+        task_anti_topo=jnp.asarray(pad_rows(task_anti_topo, Tp)),
+        topo_term_key=jnp.asarray(topo_term_key),
+        topo_term_label=jnp.asarray(topo_term_label),
+        node_key_domain=jnp.asarray(
+            pad_rows(node_key_domain, Np, Dp - 1 if Dp else 0)
+        ),
+        domain_mask=jnp.asarray(domain_mask_np),
+        task_vol_node=jnp.asarray(pad_rows(task_vol_node, Tp, NONE_IDX)),
+        task_vol_groups=jnp.asarray(pad_rows(task_vol_groups, Tp)),
+        vol_group_sel=jnp.asarray(vol_group_sel),
         job_queue=jnp.asarray(pad_rows(job_queue, Jp, NONE_IDX)),
         job_min=jnp.asarray(pad_rows(job_min, Jp)),
         job_prio=jnp.asarray(pad_rows(job_prio, Jp)),
@@ -257,9 +479,15 @@ def pack_snapshot(host: HostSnapshot) -> tuple[SnapshotTensors, SnapshotMeta]:
                 False,
             )
         ),
+        node_pressure=jnp.asarray(pad_rows(node_pressure, Np)),
         node_mask=jnp.asarray(pad_rows(np.ones(N, bool), Np, False)),
         queue_weight=jnp.asarray(pad_rows(queue_weight, Qp)),
         queue_mask=jnp.asarray(pad_rows(np.ones(Q, bool), Qp, False)),
+        task_ns=jnp.asarray(pad_rows(task_ns, Tp, NONE_IDX)),
+        ns_weight=jnp.asarray(pad_rows(ns_weight, Sp)),
+        ns_mask=jnp.asarray(pad_rows(np.ones(S, bool), Sp, False)),
+        task_pdb=jnp.asarray(pad_rows(task_pdb, Tp, NONE_IDX)),
+        pdb_min=jnp.asarray(pad_rows(pdb_min, Bp) if Bp else pdb_min),
         cluster_total=jnp.asarray(node_cap.sum(axis=0).astype(np.float32)),
         eps=jnp.asarray(spec.eps.astype(np.float32)),
         besteffort_eps=jnp.asarray(spec.besteffort_eps.astype(np.float32)),

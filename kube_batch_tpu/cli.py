@@ -26,7 +26,7 @@ import sys
 import yaml
 
 from kube_batch_tpu.api.resource import ResourceSpec
-from kube_batch_tpu.cache.cluster import Node, Pod, PodGroup, Queue
+from kube_batch_tpu.cache.cluster import PodGroup, Queue
 from kube_batch_tpu.scheduler import DEFAULT_SCHEDULE_PERIOD, Scheduler
 from kube_batch_tpu.sim.simulator import make_world
 from kube_batch_tpu.version import version_string
@@ -56,6 +56,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "YAML file of nodes/queues/jobs")
     p.add_argument("--cycles", type=int, default=None,
                    help="stop after N cycles (default: run forever)")
+    p.add_argument("--profile-dir", default=None,
+                   help="capture a jax.profiler trace of the second "
+                        "cycle into this directory")
     p.add_argument("--version", action="store_true")
     return p
 
@@ -86,13 +89,33 @@ def load_world(spec_arg: str | None, default_queue: str):
     cache, sim = make_world(ResourceSpec(names), default_queue=default_queue)
     for q in raw.get("queues", []):
         sim.add_queue(Queue(name=q["name"], weight=float(q.get("weight", 1.0))))
+    from kube_batch_tpu.client.codec import (
+        CLAIM_KEYS,
+        NODE_KEYS,
+        STORAGE_CLASS_KEYS,
+        decode_claim,
+        decode_node,
+        decode_storage_class,
+    )
+
+    def _checked(obj: dict, known: frozenset, what: str) -> dict:
+        unknown = set(obj) - known
+        if unknown:
+            # Visible failure beats silently dropping constraints.
+            raise SystemExit(
+                f"{what} {obj.get('name', '?')}: unknown keys "
+                f"{sorted(unknown)} (known: {sorted(known)})"
+            )
+        return obj
+
     for n in raw.get("nodes", []):
-        sim.add_node(Node(
-            name=n["name"],
-            allocatable=dict(n.get("allocatable", {})),
-            labels=dict(n.get("labels", {})),
-            taints=frozenset(n.get("taints", [])),
-        ))
+        sim.add_node(decode_node(_checked(n, NODE_KEYS, "node")))
+    for sc in raw.get("storageClasses", []):
+        sim.add_storage_class(
+            decode_storage_class(_checked(sc, STORAGE_CLASS_KEYS, "storageClass"))
+        )
+    for c in raw.get("claims", []):
+        sim.add_claim(decode_claim(_checked(c, CLAIM_KEYS, "claim")))
     for j in raw.get("jobs", []):
         group = PodGroup(
             name=j["name"],
@@ -100,20 +123,18 @@ def load_world(spec_arg: str | None, default_queue: str):
             min_member=int(j.get("minMember", 1)),
             priority=int(j.get("priority", 0)),
         )
-        pods = [
-            Pod(
-                name=p["name"],
-                request=dict(p.get("request", {})),
-                priority=int(p.get("priority", group.priority)),
-                selector=dict(p.get("selector", {})),
-                tolerations=frozenset(p.get("tolerations", [])),
-                labels=dict(p.get("labels", {})),
-                affinity=frozenset(p.get("affinity", [])),
-                anti_affinity=frozenset(p.get("antiAffinity", [])),
-                pod_prefs=dict(p.get("podPrefs", {})),
-            )
-            for p in j.get("pods", [])
-        ]
+        from kube_batch_tpu.client.codec import POD_KEYS, decode_pod
+
+        pods = []
+        for p in j.get("pods", []):
+            unknown = set(p) - POD_KEYS
+            if unknown:
+                # Visible failure beats silently dropping constraints.
+                raise SystemExit(
+                    f"pod {p.get('name', '?')}: unknown keys {sorted(unknown)}"
+                    f" (known: {sorted(POD_KEYS)})"
+                )
+            pods.append(decode_pod({"priority": group.priority, **p}))
         sim.submit(group, pods)
     return cache, sim
 
@@ -153,6 +174,7 @@ def main(argv: list[str] | None = None) -> int:
         cache,
         conf_path=args.scheduler_conf,
         schedule_period=args.schedule_period,
+        profile_dir=args.profile_dir,
     )
     try:
         ran = scheduler.run(

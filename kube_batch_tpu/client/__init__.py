@@ -1,0 +1,17 @@
+"""Cluster client layer: the L0 adapter between an external cluster and
+the scheduler cache.
+
+Reference counterpart: pkg/client/ (the generated clientset/informers/
+listers) + cache/event_handlers.go (informer fan-in) — the machinery
+that turns apiserver watch streams into cache events and scheduler
+decisions into REST writes.  Here the wire protocol is JSON-lines over
+any duplex byte stream (see `kube_batch_tpu.client.adapter`): watch
+events flow in, bind/evict/status writes flow out with request/response
+correlation — the same shape as client-go's informer + REST round trips,
+without the Kubernetes dependency.
+"""
+
+from kube_batch_tpu.client.adapter import StreamBackend, WatchAdapter
+from kube_batch_tpu.client.external import ExternalCluster
+
+__all__ = ["WatchAdapter", "StreamBackend", "ExternalCluster"]

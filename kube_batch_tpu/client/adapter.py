@@ -1,0 +1,224 @@
+"""Watch-stream adapter: external cluster events → cache, writes → wire.
+
+Reference counterpart: cache/event_handlers.go (informer callbacks
+driving SchedulerCache add/update/delete) and cache/cache.go's
+defaultBinder/defaultEvictor/defaultStatusUpdater (REST writes to the
+apiserver).  The wire is JSON-lines over any duplex byte stream; one
+connection multiplexes both directions, like client-go's HTTP/2 session:
+
+    cluster → scheduler:  {"type": "ADDED"|"MODIFIED"|"DELETED",
+                           "kind": "Pod"|"Node"|"PodGroup"|"Queue",
+                           "object": {...}}
+                          {"type": "RESPONSE", "id": N, "ok": bool,
+                           "error": "..."}
+    scheduler → cluster:  {"type": "REQUEST", "id": N,
+                           "verb": "bind"|"evict"|"updatePodGroup", ...}
+
+`WatchAdapter` runs the read loop on its own thread (the informer
+goroutine analog) and drives the cache's event-handler funnel;
+`StreamBackend` implements the Binder/Evictor/StatusUpdater seam by
+writing correlated requests and blocking on their responses — so a
+failed bind surfaces synchronously and the cache's errTasks resync
+path works unchanged.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import logging
+import threading
+from typing import IO
+
+from kube_batch_tpu.api.types import TaskStatus
+from kube_batch_tpu.cache.cache import SchedulerCache
+from kube_batch_tpu.cache.cluster import Pod, PodGroup
+from kube_batch_tpu.client.codec import DECODERS, encode_pod_group
+
+log = logging.getLogger(__name__)
+
+
+class StreamBackend:
+    """Binder/Evictor/StatusUpdater writing correlated wire requests.
+
+    ≙ cache.go's default side-effect implementations: each verb is one
+    apiserver round trip; an error response raises, which the cache's
+    bind/evict funnel translates into resync/rollback.
+    """
+
+    def __init__(self, writer: IO[str], timeout: float = 10.0) -> None:
+        self._writer = writer
+        self._timeout = timeout
+        self._wlock = threading.Lock()
+        self._ids = itertools.count(1)
+        self._waiting: set[int] = set()
+        self._pending: dict[int, dict] = {}
+        self._cv = threading.Condition()
+
+    # -- called by WatchAdapter's read loop -----------------------------
+    def deliver_response(self, msg: dict) -> None:
+        with self._cv:
+            if msg.get("id") not in self._waiting:
+                return  # late response after its caller timed out — drop
+            self._pending[msg["id"]] = msg
+            self._cv.notify_all()
+
+    # -- the round trip -------------------------------------------------
+    def _call(self, payload: dict) -> None:
+        rid = next(self._ids)
+        payload["type"] = "REQUEST"
+        payload["id"] = rid
+        with self._cv:
+            self._waiting.add(rid)
+        with self._wlock:
+            self._writer.write(json.dumps(payload) + "\n")
+            self._writer.flush()
+        with self._cv:
+            ok = self._cv.wait_for(
+                lambda: rid in self._pending, timeout=self._timeout
+            )
+            resp = self._pending.pop(rid, None)
+            self._waiting.discard(rid)
+        if not ok or resp is None:
+            raise TimeoutError(f"no response for request {rid} ({payload['verb']})")
+        if not resp.get("ok", False):
+            raise RuntimeError(resp.get("error", "request failed"))
+
+    # -- the seam (cache/backend.py protocols) --------------------------
+    def bind(self, pod: Pod, node_name: str) -> None:
+        self._call({"verb": "bind", "pod": pod.uid, "node": node_name})
+
+    def evict(self, pod: Pod, reason: str) -> None:
+        self._call({"verb": "evict", "pod": pod.uid, "reason": reason})
+
+    def update_pod_group(self, group: PodGroup) -> None:
+        self._call({
+            "verb": "updatePodGroup", "object": encode_pod_group(group),
+        })
+
+
+class WatchAdapter:
+    """Reads the watch stream and drives the cache's event handlers.
+
+    ≙ the informer goroutines + cache/event_handlers.go.  One thread; on
+    EOF (cluster hung up) it stops, leaving the cache intact — a
+    reconnecting caller just re-lists (stateless recovery: drop the
+    cache, rebuild from the stream's initial ADDED burst).
+    """
+
+    def __init__(
+        self,
+        cache: SchedulerCache,
+        reader: IO[str],
+        backend: StreamBackend | None = None,
+    ) -> None:
+        self.cache = cache
+        self._reader = reader
+        self._backend = backend
+        self._thread: threading.Thread | None = None
+        self.synced = threading.Event()  # set on first SYNC marker
+        self.stopped = threading.Event()
+
+    # -- lifecycle (≙ cache.Run / WaitForCacheSync) ---------------------
+    def start(self) -> "WatchAdapter":
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        return self
+
+    def wait_for_sync(self, timeout: float = 10.0) -> bool:
+        """Block until the cluster's initial LIST replay is complete
+        (the stream sends a SYNC marker after its ADDED burst)."""
+        return self.synced.wait(timeout)
+
+    def join(self, timeout: float | None = None) -> None:
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    # -- the read loop --------------------------------------------------
+    def _run(self) -> None:
+        try:
+            for line in self._reader:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    msg = json.loads(line)
+                except json.JSONDecodeError:
+                    log.warning("undecodable watch line: %.120s", line)
+                    continue
+                self._dispatch(msg)
+        except (OSError, ValueError):
+            pass  # stream closed under us — treated as EOF
+        finally:
+            self.stopped.set()
+
+    def _dispatch(self, msg: dict) -> None:
+        mtype = msg.get("type")
+        if mtype == "RESPONSE":
+            if self._backend is not None:
+                self._backend.deliver_response(msg)
+            return
+        if mtype == "SYNC":
+            self.synced.set()
+            return
+        kind = msg.get("kind")
+        decode = DECODERS.get(kind)
+        if decode is None or mtype not in ("ADDED", "MODIFIED", "DELETED"):
+            log.warning("unknown watch message: type=%s kind=%s", mtype, kind)
+            return
+        obj = msg.get("object", {})
+        try:
+            self._apply(mtype, kind, obj, decode)
+        except Exception:  # noqa: BLE001 — one bad event must not kill ingest
+            log.exception("event handler failed: %s %s", mtype, kind)
+
+    def _apply(self, mtype: str, kind: str, obj: dict, decode) -> None:
+        cache = self.cache
+        if kind == "Pod":
+            if mtype == "ADDED":
+                cache.add_pod(decode(obj))
+            elif mtype == "DELETED":
+                cache.delete_pod(obj["uid"])
+            else:  # MODIFIED: kubelet/controller status+node movement
+                cache.update_pod_status(
+                    obj["uid"],
+                    TaskStatus[obj.get("status", "PENDING")],
+                    node=obj.get("node"),
+                )
+        elif kind == "Node":
+            if mtype == "ADDED":
+                cache.add_node(decode(obj))
+            elif mtype == "DELETED":
+                cache.delete_node(obj["name"])
+            else:
+                cache.update_node(decode(obj))
+        elif kind == "PodGroup":
+            if mtype == "DELETED":
+                cache.delete_pod_group(obj["name"])
+            else:
+                cache.add_pod_group(decode(obj))
+        elif kind == "Queue":
+            if mtype == "DELETED":
+                cache.delete_queue(obj["name"])
+            else:
+                cache.add_queue(decode(obj))
+        elif kind == "PersistentVolumeClaim":
+            if mtype == "DELETED":
+                cache.delete_claim(obj["name"])
+            else:
+                cache.add_claim(decode(obj))
+        elif kind == "StorageClass":
+            if mtype == "DELETED":
+                cache.delete_storage_class(obj["name"])
+            else:
+                cache.add_storage_class(decode(obj))
+        elif kind == "Namespace":
+            if mtype == "DELETED":
+                cache.delete_namespace(obj["name"])
+            else:
+                cache.add_namespace(decode(obj))
+        elif kind == "PodDisruptionBudget":
+            if mtype == "DELETED":
+                cache.delete_pdb(obj["name"])
+            else:
+                cache.add_pdb(decode(obj))

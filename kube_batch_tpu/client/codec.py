@@ -1,0 +1,274 @@
+"""JSON codec for the framework-native API objects.
+
+Reference counterpart: the generated deep-copy/serialization machinery
+of pkg/apis/scheduling/v1alpha1 + core/v1 as used by client-go.  Field
+names follow the Kubernetes-flavored camelCase the reference's YAML
+uses, so a world file and a wire object read the same.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from kube_batch_tpu.api.types import (
+    PodGroupCondition,
+    PodGroupPhase,
+    TaskStatus,
+)
+from kube_batch_tpu.cache.cluster import (
+    Claim,
+    Namespace,
+    Node,
+    Pod,
+    PodDisruptionBudget,
+    PodGroup,
+    Queue,
+    StorageClass,
+)
+
+
+def encode_pod(pod: Pod) -> dict[str, Any]:
+    return {
+        "uid": pod.uid,
+        "name": pod.name,
+        "namespace": pod.namespace,
+        "group": pod.group,
+        "request": dict(pod.request),
+        "priority": pod.priority,
+        "selector": dict(pod.selector),
+        "labels": dict(pod.labels),
+        "affinity": sorted(pod.affinity),
+        "antiAffinity": sorted(pod.anti_affinity),
+        "podPrefs": dict(pod.pod_prefs),
+        "preferences": dict(pod.preferences),
+        "tolerations": sorted(pod.tolerations),
+        "ports": sorted(pod.ports),
+        "claims": sorted(pod.claims),
+        "status": pod.status.name,
+        "node": pod.node,
+        "creation": pod.creation,
+    }
+
+
+# Wire/YAML keys each object may carry.  The single source of truth —
+# the CLI's world-file loader validates against these same sets, so a
+# new field needs exactly one decoder change.
+POD_KEYS = frozenset({
+    "uid", "name", "namespace", "group", "request", "priority", "selector",
+    "labels", "affinity", "antiAffinity", "podPrefs", "preferences",
+    "tolerations", "ports", "claims", "status", "node", "creation",
+})
+NODE_KEYS = frozenset({
+    "uid", "name", "allocatable", "labels", "taints", "ready",
+    "memoryPressure", "diskPressure", "pidPressure",
+})
+CLAIM_KEYS = frozenset({"uid", "name", "storageClass", "boundNode"})
+STORAGE_CLASS_KEYS = frozenset({"uid", "name", "allowedNodeLabels"})
+
+
+def decode_pod(d: dict[str, Any]) -> Pod:
+    """Wire dict → Pod.  `uid`/`creation` are optional: absent (fresh
+    YAML objects), the Pod defaults allocate them in arrival order."""
+    kwargs: dict[str, Any] = {}
+    if "uid" in d:
+        kwargs["uid"] = d["uid"]
+    if "creation" in d:
+        kwargs["creation"] = int(d["creation"])
+    return Pod(
+        name=d["name"],
+        namespace=d.get("namespace", "default"),
+        group=d.get("group"),
+        request=dict(d.get("request", {})),
+        priority=int(d.get("priority", 0)),
+        selector=dict(d.get("selector", {})),
+        labels=dict(d.get("labels", {})),
+        affinity=frozenset(d.get("affinity", [])),
+        anti_affinity=frozenset(d.get("antiAffinity", [])),
+        pod_prefs=dict(d.get("podPrefs", {})),
+        preferences=dict(d.get("preferences", {})),
+        tolerations=frozenset(d.get("tolerations", [])),
+        ports=frozenset(int(p) for p in d.get("ports", [])),
+        claims=frozenset(d.get("claims", [])),
+        status=TaskStatus[d.get("status", "PENDING")],
+        node=d.get("node"),
+        **kwargs,
+    )
+
+
+def encode_node(node: Node) -> dict[str, Any]:
+    return {
+        "uid": node.uid,
+        "name": node.name,
+        "allocatable": dict(node.allocatable),
+        "labels": dict(node.labels),
+        "taints": sorted(node.taints),
+        "ready": node.ready,
+        "memoryPressure": node.memory_pressure,
+        "diskPressure": node.disk_pressure,
+        "pidPressure": node.pid_pressure,
+    }
+
+
+def decode_node(d: dict[str, Any]) -> Node:
+    kwargs: dict[str, Any] = {}
+    if "uid" in d:
+        kwargs["uid"] = d["uid"]
+    return Node(
+        name=d["name"],
+        allocatable=dict(d.get("allocatable", {})),
+        labels=dict(d.get("labels", {})),
+        taints=frozenset(d.get("taints", [])),
+        ready=bool(d.get("ready", True)),
+        memory_pressure=bool(d.get("memoryPressure", False)),
+        disk_pressure=bool(d.get("diskPressure", False)),
+        pid_pressure=bool(d.get("pidPressure", False)),
+        **kwargs,
+    )
+
+
+def encode_pod_group(group: PodGroup) -> dict[str, Any]:
+    return {
+        "uid": group.uid,
+        "name": group.name,
+        "queue": group.queue,
+        "minMember": group.min_member,
+        "priority": group.priority,
+        "phase": group.phase.name,
+        "running": group.running,
+        "succeeded": group.succeeded,
+        "failed": group.failed,
+        "conditions": [
+            {
+                "type": c.type, "status": c.status,
+                "reason": c.reason, "message": c.message,
+            }
+            if isinstance(c, PodGroupCondition)
+            else {"type": "Note", "message": str(c)}
+            for c in group.conditions
+        ],
+        "creation": group.creation,
+    }
+
+
+def decode_pod_group(d: dict[str, Any]) -> PodGroup:
+    return PodGroup(
+        uid=d["uid"],
+        name=d["name"],
+        queue=d.get("queue", ""),
+        min_member=int(d.get("minMember", 1)),
+        priority=int(d.get("priority", 0)),
+        phase=PodGroupPhase[d.get("phase", "PENDING")],
+        running=int(d.get("running", 0)),
+        succeeded=int(d.get("succeeded", 0)),
+        failed=int(d.get("failed", 0)),
+        conditions=[
+            PodGroupCondition(
+                type=c.get("type", "Note"),
+                status=bool(c.get("status", True)),
+                reason=c.get("reason", ""),
+                message=c.get("message", ""),
+            )
+            if isinstance(c, dict)
+            else PodGroupCondition(type="Note", message=str(c))
+            for c in d.get("conditions", [])
+        ],
+        creation=int(d["creation"]) if "creation" in d else 0,
+    )
+
+
+def encode_queue(queue: Queue) -> dict[str, Any]:
+    return {"uid": queue.uid, "name": queue.name, "weight": queue.weight}
+
+
+def decode_queue(d: dict[str, Any]) -> Queue:
+    return Queue(
+        uid=d["uid"], name=d["name"], weight=float(d.get("weight", 1.0))
+    )
+
+
+def encode_claim(claim: Claim) -> dict[str, Any]:
+    return {
+        "uid": claim.uid,
+        "name": claim.name,
+        "storageClass": claim.storage_class,
+        "boundNode": claim.bound_node,
+    }
+
+
+def decode_claim(d: dict[str, Any]) -> Claim:
+    kwargs = {"uid": d["uid"]} if "uid" in d else {}
+    return Claim(
+        name=d["name"],
+        storage_class=d.get("storageClass", ""),
+        bound_node=d.get("boundNode"),
+        **kwargs,
+    )
+
+
+def encode_storage_class(sc: StorageClass) -> dict[str, Any]:
+    return {
+        "uid": sc.uid,
+        "name": sc.name,
+        "allowedNodeLabels": sorted(sc.allowed_node_labels),
+    }
+
+
+def decode_storage_class(d: dict[str, Any]) -> StorageClass:
+    kwargs = {"uid": d["uid"]} if "uid" in d else {}
+    return StorageClass(
+        name=d["name"],
+        allowed_node_labels=frozenset(d.get("allowedNodeLabels", [])),
+        **kwargs,
+    )
+
+
+def encode_namespace(ns: Namespace) -> dict[str, Any]:
+    return {"uid": ns.uid, "name": ns.name, "weight": ns.weight}
+
+
+def decode_namespace(d: dict[str, Any]) -> Namespace:
+    kwargs = {"uid": d["uid"]} if "uid" in d else {}
+    return Namespace(
+        name=d["name"], weight=float(d.get("weight", 1.0)), **kwargs
+    )
+
+
+def encode_pdb(pdb: PodDisruptionBudget) -> dict[str, Any]:
+    return {
+        "uid": pdb.uid,
+        "name": pdb.name,
+        "minAvailable": pdb.min_available,
+        "selector": dict(pdb.selector),
+    }
+
+
+def decode_pdb(d: dict[str, Any]) -> PodDisruptionBudget:
+    kwargs = {"uid": d["uid"]} if "uid" in d else {}
+    return PodDisruptionBudget(
+        name=d["name"],
+        min_available=int(d.get("minAvailable", 0)),
+        selector=dict(d.get("selector", {})),
+        **kwargs,
+    )
+
+
+ENCODERS = {
+    "Pod": encode_pod,
+    "Node": encode_node,
+    "PodGroup": encode_pod_group,
+    "Queue": encode_queue,
+    "PersistentVolumeClaim": encode_claim,
+    "StorageClass": encode_storage_class,
+    "Namespace": encode_namespace,
+    "PodDisruptionBudget": encode_pdb,
+}
+DECODERS = {
+    "Pod": decode_pod,
+    "Node": decode_node,
+    "PodGroup": decode_pod_group,
+    "Queue": decode_queue,
+    "PersistentVolumeClaim": decode_claim,
+    "StorageClass": decode_storage_class,
+    "Namespace": decode_namespace,
+    "PodDisruptionBudget": decode_pdb,
+}

@@ -73,7 +73,7 @@ def default_conf() -> SchedulerConf:
     """
     from kube_batch_tpu.framework.plugin import ACTION_REGISTRY, PLUGIN_REGISTRY
 
-    tier1 = ("priority", "gang", "conformance")
+    tier1 = ("priority", "gang", "conformance", "pdb")
     tier2 = ("drf", "predicates", "proportion", "nodeorder")
     actions = tuple(
         a for a in ("allocate", "backfill") if a in ACTION_REGISTRY

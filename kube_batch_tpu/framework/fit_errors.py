@@ -92,8 +92,9 @@ def render_fit_error(
     return f"0/{total} nodes are available for {task_name}: " + ", ".join(reasons)
 
 
-def diagnose_pending(ssn, max_events: int = 1000) -> list[str]:
-    """Event lines for real tasks still Pending at session end.
+def diagnose_pending(ssn, max_events: int = 1000) -> list[tuple[str, str]]:
+    """(pod name, message) pairs for real tasks still Pending at session
+    end — the caller attaches each to its pod as a structured event.
 
     Called from close_session; the [T, N] reductions run once on device,
     only the small per-task tallies cross to host.  `max_events` bounds
@@ -101,7 +102,7 @@ def diagnose_pending(ssn, max_events: int = 1000) -> list[str]:
     few reasons anyway).
     """
     snap, state = ssn.snap, ssn.state
-    task_state = np.asarray(state.task_state)
+    task_state = ssn.host_task_state()
     pending = np.nonzero(
         task_state[: ssn.meta.num_real_tasks] == int(TaskStatus.PENDING)
     )[0]
@@ -117,18 +118,23 @@ def diagnose_pending(ssn, max_events: int = 1000) -> list[str]:
 
         def full_mask(s, st):
             m = policy.predicate_mask(s)
-            dyn = policy.dynamic_predicate_fn(s, st)
+            # immediate=True: diagnose against the same mask the Idle
+            # pass refused with (incl. anti-affinity vs RELEASING
+            # residents), so "why pending" matches the actual refusal.
+            dyn = policy.dynamic_predicate_fn(s, st, immediate=True)
             return m if dyn is None else m & dyn
 
         diag = jax.jit(lambda s, st: failure_counts(s, st, full_mask(s, st)))
         policy._diagnose_jit = diag
     counts = {k: np.asarray(v) for k, v in diag(snap, state).items()}
-    out: list[str] = []
+    out: list[tuple[str, str]] = []
     for t in pending[:max_events]:
         pod = ssn.meta.task_pods[t]
-        out.append(render_fit_error(pod.name, counts, t, ssn.meta.spec.names))
+        out.append(
+            (pod.name, render_fit_error(pod.name, counts, t, ssn.meta.spec.names))
+        )
     if pending.size > max_events:
         out.append(
-            f"... and {pending.size - max_events} more unschedulable tasks"
+            ("", f"... and {pending.size - max_events} more unschedulable tasks")
         )
     return out

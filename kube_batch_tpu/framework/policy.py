@@ -98,6 +98,9 @@ class TensorPolicy:
     def __init__(self, num_tiers: int) -> None:
         self.num_tiers = num_tiers
         self.queue_order: list[list[QueueKeyFn]] = [[] for _ in range(num_tiers)]
+        # Namespace-level keys (f32[S]) sit between queue and job in the
+        # rank hierarchy (≙ session_plugins.go · AddNamespaceOrderFn).
+        self.namespace_order: list[list[JobKeyFn]] = [[] for _ in range(num_tiers)]
         self.job_order: list[list[JobKeyFn]] = [[] for _ in range(num_tiers)]
         self.task_order: list[list[TaskKeyFn]] = [[] for _ in range(num_tiers)]
         self.predicates: list[PredicateFn] = []
@@ -113,12 +116,16 @@ class TensorPolicy:
         # bool[T] masks of tasks that must be accepted at most one per
         # auction round globally (affinity bootstrap claimants).
         self.global_serialize: list = []
+        # bool[T] masks of tasks limited to one acceptance per topology
+        # DOMAIN per round (domain-scoped anti-affinity participants).
+        self.domain_serialize: list = []
         self.node_scores: list[tuple[float, NodeScoreFn]] = []
         self.job_valid: list[JobBoolFn] = []
         self.job_ready: list[JobBoolFn] = []
         self.job_pipelined: list[JobBoolFn] = []
         self.overused: list[QueueBoolFn] = []
         self.queue_vtime: list[list[VtimeFn]] = [[] for _ in range(num_tiers)]
+        self.ns_vtime: list[list[VtimeFn]] = [[] for _ in range(num_tiers)]
         self.job_vtime: list[list[VtimeFn]] = [[] for _ in range(num_tiers)]
         self.cycle_setup: list[tuple[str, Callable]] = []
         self.preemptable: list[list[VetoFn]] = [[] for _ in range(num_tiers)]
@@ -132,6 +139,12 @@ class TensorPolicy:
     # -- registration (≙ session_plugins.go Add*Fn) ---------------------
     def add_queue_order_fn(self, tier: int, fn: QueueKeyFn) -> None:
         self.queue_order[tier].append(fn)
+
+    def add_namespace_order_fn(self, tier: int, fn) -> None:
+        self.namespace_order[tier].append(fn)
+
+    def add_namespace_vtime_fn(self, tier: int, fn: VtimeFn) -> None:
+        self.ns_vtime[tier].append(fn)
 
     def add_job_order_fn(self, tier: int, fn: JobKeyFn) -> None:
         self.job_order[tier].append(fn)
@@ -147,6 +160,9 @@ class TensorPolicy:
 
     def add_global_serialize_fn(self, fn) -> None:
         self.global_serialize.append(fn)
+
+    def add_domain_serialize_fn(self, fn) -> None:
+        self.domain_serialize.append(fn)
 
     def add_node_order_fn(
         self, weight: float, fn: NodeScoreFn, state_dependent: bool = True
@@ -216,15 +232,23 @@ class TensorPolicy:
             m = m & fn(snap)
         return m
 
-    def dynamic_predicate_fn(self, snap: SnapshotTensors, state: AllocState):
+    def dynamic_predicate_fn(
+        self,
+        snap: SnapshotTensors,
+        state: AllocState,
+        immediate: bool = False,
+    ):
         """bool[T, N] AND of the registered state-dependent predicates,
         or None when none are registered (kernels skip the per-round
-        evaluation entirely)."""
+        evaluation entirely).  `immediate` is True for the Idle pass
+        (placements binding this cycle) — predicates may check against
+        still-terminating residents there (see
+        plugins/predicates.py · pod_affinity_predicate)."""
         if not self.dynamic_predicates:
             return None
         m = jnp.ones((snap.num_tasks, snap.num_nodes), bool)
         for fn, _row in self.dynamic_predicates:
-            m = m & fn(snap, state)
+            m = m & fn(snap, state, immediate)
         return m
 
     @property
@@ -258,9 +282,19 @@ class TensorPolicy:
     def global_serialize_fn(self):
         """(snap, state) -> bool[T] of tasks limited to one acceptance
         per auction round across the whole cluster (None when unused)."""
-        if not self.global_serialize:
+        return self._or_of(self.global_serialize)
+
+    @property
+    def domain_serialize_fn(self):
+        """(snap, state) -> bool[T] of tasks limited to one acceptance
+        per topology domain per round (None when unused)."""
+        return self._or_of(self.domain_serialize)
+
+    @staticmethod
+    def _or_of(fns_list):
+        if not fns_list:
             return None
-        fns = list(self.global_serialize)
+        fns = list(fns_list)
 
         def mask(snap, state):
             m = jnp.zeros(snap.num_tasks, bool)
@@ -292,6 +326,10 @@ class TensorPolicy:
         for tier_fns in reversed(self.job_order):
             for fn in reversed(tier_fns):
                 keys.append(fn(snap, state)[tj])
+        tns = jnp.clip(snap.task_ns, 0, snap.ns_weight.shape[0] - 1)
+        for tier_fns in reversed(self.namespace_order):
+            for fn in reversed(tier_fns):
+                keys.append(fn(snap, state)[tns])
         for tier_fns in reversed(self.queue_order):
             for fn in reversed(tier_fns):
                 keys.append(fn(snap, state)[tq])
@@ -307,24 +345,34 @@ class TensorPolicy:
         above everything — so the rank order reproduces the reference's
         one-pod-at-a-time share-feedback interleaving."""
         keys = self._static_keys(snap, state)
-        has_vtime = any(map(len, self.queue_vtime)) or any(
-            map(len, self.job_vtime)
-        )
-        if has_vtime:
-            from kube_batch_tpu.api.types import TaskStatus
+        vtime_levels = [self.job_vtime, self.ns_vtime, self.queue_vtime]
+        if not any(any(map(len, level)) for level in vtime_levels):
+            return rank_from_keys(keys, snap.num_tasks)
 
-            base = rank_from_keys(keys, snap.num_tasks)
-            pending = (
-                state.task_state == int(TaskStatus.PENDING)
-            ) & snap.task_mask
-            valid = pending & self.eligible_fn(snap, state)
-            for tier_fns in reversed(self.job_vtime):
+        from kube_batch_tpu.api.types import TaskStatus
+
+        rank = rank_from_keys(keys, snap.num_tasks)
+        pending = (
+            state.task_state == int(TaskStatus.PENDING)
+        ) & snap.task_mask
+        valid = pending & self.eligible_fn(snap, state)
+        # Hierarchical WFQ: each level's virtual start times are
+        # computed with the LOWER levels' rank as the within-segment
+        # service order, then refine the rank (job → namespace →
+        # queue).  A level's vtime is strictly monotone along its input
+        # order WITHIN a segment, so higher levels interleave segments
+        # without overriding lower-level fairness — the composition a
+        # single shared base cannot express (the queue vtime would
+        # otherwise fully order same-queue tasks and erase the
+        # namespace/job interleaving).
+        for level in vtime_levels:
+            for tier_fns in reversed(level):
                 for fn in reversed(tier_fns):
-                    keys.append(fn(snap, state, base, valid))
-            for tier_fns in reversed(self.queue_vtime):
-                for fn in reversed(tier_fns):
-                    keys.append(fn(snap, state, base, valid))
-        return rank_from_keys(keys, snap.num_tasks)
+                    vt = fn(snap, state, rank, valid)
+                    rank = rank_from_keys(
+                        [rank.astype(jnp.float32), vt], snap.num_tasks
+                    )
+        return rank
 
     def job_rank(self, snap: SnapshotTensors, state: AllocState) -> jax.Array:
         """i32[J]: job-level ranks (used by preempt's starving-job order)."""

@@ -72,6 +72,33 @@ class Session:
 
         self.bound: list[tuple[str, str]] = []     # (pod name, node) this cycle
         self.evicted: list[tuple[str, str]] = []   # (pod name, reason)
+        # JobReady cache: the fused cycle computes the mask on-device as
+        # part of its single dispatch and stores it here, so
+        # dispatch_binds/unready_jobs need no extra device round trip.
+        self._job_ready: np.ndarray | None = None
+        # Host copy of the FINAL task_state, filled at first post-action
+        # read — every later consumer (bind dispatch, pending gauge,
+        # diagnosis, the loop's result label) reuses it instead of
+        # paying another full D2H transfer on the tunneled backend.
+        self._host_task_state: np.ndarray | None = None
+
+    def host_task_state(self) -> np.ndarray:
+        """i32[T] host copy of the live task_state (cached; call only
+        after the cycle's actions have finished mutating self.state)."""
+        if self._host_task_state is None:
+            self._host_task_state = np.asarray(self.state.task_state)
+        return self._host_task_state
+
+    def job_ready(self) -> np.ndarray:
+        """bool[J] host copy of the gang commit gate (cached)."""
+        if self._job_ready is None:
+            self._job_ready = np.asarray(
+                self.policy.job_ready_mask(self.snap, self.state)
+            )
+        return self._job_ready
+
+    def set_job_ready(self, mask: np.ndarray) -> None:
+        self._job_ready = np.asarray(mask)
 
     # -- commit funnels -------------------------------------------------
     def commit_evictions(self, victim_idx: Sequence[int], reason: str) -> None:
@@ -87,9 +114,9 @@ class Session:
         """Bind every newly allocated task of every JobReady job
         (gang commit; ≙ session.go · Allocate's deferred dispatch)."""
         snap, state = self.snap, self.state
-        task_state = np.asarray(state.task_state)
+        task_state = self.host_task_state()
         task_node = np.asarray(state.task_node)
-        ready = np.asarray(self.policy.job_ready_mask(snap, state))
+        ready = self.job_ready()
         task_job = np.asarray(snap.task_job)
 
         newly_allocated = (
@@ -112,7 +139,7 @@ class Session:
     # -- introspection for plugins' close hooks ------------------------
     def unready_jobs(self) -> list[str]:
         """Names of jobs that wanted resources but failed the gang gate."""
-        ready = np.asarray(self.policy.job_ready_mask(self.snap, self.state))
+        ready = self.job_ready()
         out = []
         for j, name in enumerate(self.meta.job_names):
             if not ready[j]:
@@ -139,8 +166,11 @@ def close_session(ssn: Session, diagnose: bool = True) -> None:
 
     ssn.dispatch_binds()
     if diagnose:
-        for line in diagnose_pending(ssn):
-            ssn.cache.events.append(line)
+        for pod_name, message in diagnose_pending(ssn):
+            ssn.cache.record_event(
+                "Pod" if pod_name else "Scheduler",
+                pod_name, "FailedScheduling", message,
+            )
     for plugin in ssn.plugins:
         with metrics.plugin_latency.time(plugin.name, "close"):
             plugin.on_session_close(ssn)
@@ -151,7 +181,7 @@ def close_session(ssn: Session, diagnose: bool = True) -> None:
     metrics.pending_tasks.set(
         float(
             np.sum(
-                np.asarray(ssn.state.task_state)[: ssn.meta.num_real_tasks]
+                ssn.host_task_state()[: ssn.meta.num_real_tasks]
                 == int(TaskStatus.PENDING)
             )
         )

@@ -198,7 +198,13 @@ pods_evicted = REGISTRY.register(Counter(
     labels=("reason",),
 ))
 preemption_attempts = REGISTRY.register(Counter(
-    "preemption_attempts_total", "Preempt/reclaim sweeps executed.",
+    "preemption_attempts_total",
+    "Preempt/reclaim sweeps that chose at least one victim "
+    "(metrics.go counts real attempts, not action executions).",
+))
+preemption_victims = REGISTRY.register(Counter(
+    "preemption_victims_total",
+    "Victim tasks transitioned to Releasing by preempt/reclaim.",
 ))
 snapshot_pack_latency = REGISTRY.register(Histogram(
     "snapshot_pack_latency_seconds",

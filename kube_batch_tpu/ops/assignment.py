@@ -235,8 +235,9 @@ def allocate_rounds(
     max_rounds: int | None = None,
     one_per_node: bool = False,
     score_quantum: float = 0.0,
-    dyn_predicate_fn=None,     # (snap, state) -> bool[T, N], or None
+    dyn_predicate_fn=None,     # (snap, state, immediate) -> bool[T, N], or None
     global_serialize_fn=None,  # (snap, state) -> bool[T], or None
+    domain_serialize_fn=None,  # (snap, state) -> bool[T], or None
 ) -> AllocState:
     """Run auction rounds to a fixed point.
 
@@ -280,7 +281,7 @@ def allocate_rounds(
         fit = fits(snap.task_req[:, None, :], avail[None, :, :], eps)  # bool[T, N]
         feas = predicate_mask & fit & snap.node_mask[None, :] & eligible[:, None]
         if dyn_predicate_fn is not None:
-            feas = feas & dyn_predicate_fn(snap, st)
+            feas = feas & dyn_predicate_fn(snap, st, not use_future)
 
         score = jnp.where(feas, score_fn(snap, st), NEG_INF)
         if score_quantum > 0.0:
@@ -300,6 +301,39 @@ def allocate_rounds(
             one_per_node=one_per_node,
             serialize_mask=serialize_mask,
         )
+        if domain_serialize_fn is not None and snap.node_key_domain.shape[1]:
+            # At most ONE domain-anti-involved task lands per topology
+            # DOMAIN per round: two same-round acceptances on different
+            # nodes of one zone can't see each other in the residents
+            # table, so only the rank-first participant per (key,
+            # domain) survives; the rest retry next round against
+            # updated residents.  The per-NODE serialization above
+            # cannot express this (nodes of a domain are different
+            # segments); a global one-per-round rule would serialize
+            # the whole cluster instead (reviewed out: zone-spread of
+            # N pods must not cost N auction rounds per domain count).
+            big_d = jnp.iinfo(jnp.int32).max
+            part_mask = domain_serialize_fn(snap, st)
+            D = snap.domain_mask.shape[0]
+            for tk in range(snap.node_key_domain.shape[1]):
+                part = part_mask & accept
+                dom = snap.node_key_domain[
+                    jnp.clip(prop_node, 0, snap.num_nodes - 1), tk
+                ]
+                seg = jnp.where(part, dom, D)
+                minr = jax.ops.segment_min(
+                    jnp.where(part, rank, big_d), seg, num_segments=D + 1
+                )[:D]
+                keep = ~part | (rank == minr[jnp.clip(dom, 0, D - 1)])
+                cancelled = accept & ~keep
+                accept = accept & keep
+                # Rank watermark after cancellation (same invariant as
+                # the global-serialize step below): the kept per-domain
+                # winners rank below every cancelled task in their own
+                # domain, and the global rank-first acceptance is never
+                # cancelled, so >=1 acceptance still survives.
+                min_cancelled = jnp.min(jnp.where(cancelled, rank, big_d))
+                accept = accept & (rank < min_cancelled)
         if global_serialize_fn is not None:
             # At most ONE globally-serialized task (affinity bootstrap
             # claimant) lands per round: same-round claimants can't see
@@ -308,8 +342,19 @@ def allocate_rounds(
             # the rank-first claimant overall) means an unschedulable
             # claimant can never deadlock the others.
             gmask = global_serialize_fn(snap, st) & accept
-            best_g = jnp.min(jnp.where(gmask, rank, jnp.iinfo(jnp.int32).max))
+            big = jnp.iinfo(jnp.int32).max
+            best_g = jnp.min(jnp.where(gmask, rank, big))
+            cancelled = gmask & (rank != best_g)
             accept = accept & (~gmask | (rank == best_g))
+            # Re-apply the rank watermark after cancellation: a task may
+            # not keep capacity that a better-ranked cancelled claimant
+            # needed — the claimant retries next round with first pick
+            # (mirrors _resolve_conflicts' global watermark).  The kept
+            # claimant's rank is below every cancelled rank by
+            # construction, so >=1 acceptance still survives and the
+            # round loop still terminates.
+            min_cancelled = jnp.min(jnp.where(cancelled, rank, big))
+            accept = accept & (rank < min_cancelled)
 
         # -- apply accepted placements (pure scatter updates) ----------
         task_state = jnp.where(accept, new_status, st.task_state)

@@ -136,6 +136,23 @@ def preemption_rounds(
         st = c.state
         rank = rank_fn(snap, st)
         tj = jnp.clip(snap.task_job, 0, snap.num_jobs - 1)
+        # Cheap global progress test: when NOTHING in the cluster is
+        # evictable (e.g. a fresh world where no snapshot task holds
+        # resources) AND no eligible preemptor could finalize directly
+        # onto FutureIdle, every remaining per-preemptor plan is doomed
+        # — exit instead of burning one [T]-sort + [T,N] step per
+        # pending task just to mark it `tried` (measured: the
+        # difference between ~1.3 s and ~70 ms on BASELINE config 4's
+        # first cycle).  The direct-fit test ignores predicates — an
+        # over-approximation only ever keeps the loop alive longer.
+        from kube_batch_tpu.api.snapshot import allocated_mask
+
+        any_victim_possible = jnp.any(
+            allocated_mask(snap.task_state)
+            & allocated_mask(st.task_state)
+            & snap.task_mask
+            & ~c.prov
+        )
 
         # -- preemptor: the open plan's, else the rank-first starving ---
         pending = (st.task_state == int(TaskStatus.PENDING)) & snap.task_mask
@@ -148,6 +165,11 @@ def preemption_rounds(
             & ~c.tried
         )
         any_elig = jnp.any(elig)
+        any_direct_fit = jnp.any(
+            fits(snap.task_req[:, None, :], st.node_future[None, :, :], eps)
+            & elig[:, None]
+            & (snap.node_mask & snap.node_ready)[None, :]
+        )
         p_new = jnp.argmin(jnp.where(elig, rank, INT_MAX)).astype(jnp.int32)
         p = jnp.where(c.prov_active, c.prov_p, p_new)
         have_p = c.prov_active | any_elig
@@ -248,7 +270,8 @@ def preemption_rounds(
             prov_active=evict_step,
             prov_p=p,
             prov_n=n,
-            progressed=have_p,
+            progressed=have_p
+            & (any_victim_possible | any_direct_fit | c.prov_active),
             iters=c.iters + 1,
         )
 

@@ -59,6 +59,14 @@ def shard_cycle_inputs(snap, state, mesh: Mesh, axis: str = NODE_AXIS):
     """
     n = snap.num_nodes
     divisible = n % mesh.shape[axis] == 0
+    if not divisible:
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "padded node count %d not divisible by mesh axis %r (%d devices);"
+            " falling back to FULL REPLICATION — no node-axis parallelism",
+            n, axis, mesh.shape[axis],
+        )
     node_spec = P(axis) if divisible else P()
     repl = NamedSharding(mesh, P())
     node_sh = NamedSharding(mesh, node_spec)

@@ -50,6 +50,30 @@ def job_share(snap: SnapshotTensors, state: AllocState) -> jax.Array:
     return share_of(job_allocated(snap, state), snap.cluster_total)
 
 
+def ns_allocated(snap: SnapshotTensors, state: AllocState) -> jax.Array:
+    """f32[S, R]: resources currently held per namespace."""
+    held = (
+        allocated_mask(state.task_state)
+        | status_is(state.task_state, TaskStatus.PIPELINED)
+    ) & snap.task_mask & (snap.task_ns >= 0)
+    S = snap.ns_weight.shape[0]
+    seg = jnp.where(held, jnp.clip(snap.task_ns, 0, S - 1), S)
+    return jax.ops.segment_sum(
+        jnp.where(held[:, None], snap.task_req, 0.0),
+        seg, num_segments=S + 1,
+    )[:S]
+
+
+def ns_share(snap: SnapshotTensors, state: AllocState) -> jax.Array:
+    """f32[S]: weighted dominant share per namespace — allocated /
+    (clusterTotal · weight), lower served first (≙ the reference's
+    NamespaceOrderFn over api/namespace_info.go weights)."""
+    w = jnp.maximum(snap.ns_weight, 1e-9)[:, None]
+    return share_of(
+        ns_allocated(snap, state) / w, snap.cluster_total
+    )
+
+
 @register_plugin
 class DrfPlugin(Plugin):
     name = "drf"
@@ -87,8 +111,34 @@ class DrfPlugin(Plugin):
                 snap.num_jobs,
             )
 
+        def namespace_order(snap, state):
+            return ns_share(snap, state)
+
+        def ns_vtime(snap, state, base_rank, valid):
+            """WFQ virtual start times in weighted namespace-share
+            space — serves namespaces within a queue by weighted
+            fairness at per-task granularity."""
+            from kube_batch_tpu.framework.policy import virtual_start_times
+
+            S = snap.ns_weight.shape[0]
+            denom = jnp.maximum(snap.cluster_total, 1e-9)[None, :] * (
+                jnp.maximum(snap.ns_weight, 1e-9)[:, None]
+            )
+            return virtual_start_times(
+                snap.task_ns,
+                base_rank,
+                snap.task_req,
+                valid,
+                ns_allocated(snap, state),
+                denom,
+                S,
+            )
+
         if self.enabled_for("jobOrder"):
             policy.add_job_order_fn(tier, job_order)
             policy.add_job_vtime_fn(tier, job_vtime)
+        if self.enabled_for("namespaceOrder"):
+            policy.add_namespace_order_fn(tier, namespace_order)
+            policy.add_namespace_vtime_fn(tier, ns_vtime)
         if self.enabled_for("preemptable"):
             policy.add_preemptable_fn(tier, preemptable)

@@ -6,6 +6,7 @@ from kube_batch_tpu.plugins import (  # noqa: F401
     drf,
     gang,
     nodeorder,
+    pdb,
     predicates,
     priority,
     proportion,
@@ -16,6 +17,7 @@ BUILTIN_PLUGINS = [
     "drf",
     "gang",
     "nodeorder",
+    "pdb",
     "predicates",
     "priority",
     "proportion",

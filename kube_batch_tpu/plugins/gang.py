@@ -65,8 +65,11 @@ class GangPlugin(Plugin):
             policy.add_reclaimable_fn(tier, preemptable)
 
     def on_session_close(self, ssn) -> None:
-        """Emit unschedulable events/conditions for unready gangs
-        (≙ gang.go · OnSessionClose)."""
+        """Emit unschedulable events + typed PodGroup conditions for
+        unready gangs (≙ gang.go · OnSessionClose), through the cache's
+        recorder/condition funnels — never private cache state."""
+        from kube_batch_tpu.api.types import PodGroupCondition
+
         for name in ssn.unready_jobs():
             job = ssn.host.jobs.get(name)
             if job is None:
@@ -75,7 +78,11 @@ class GangPlugin(Plugin):
                 f"gang unschedulable: job {name} has {job.ready_task_num} ready, "
                 f"needs minMember {job.min_available}"
             )
-            ssn.cache.events.append(msg)
-            live = ssn.cache._jobs.get(name)
-            if live is not None and msg not in live.pod_group.conditions:
-                live.pod_group.conditions.append(msg)
+            ssn.cache.record_event("PodGroup", name, "Unschedulable", msg)
+            ssn.cache.add_job_condition(
+                name,
+                PodGroupCondition(
+                    type="Unschedulable", reason="NotEnoughResources",
+                    message=msg,
+                ),
+            )

@@ -1,0 +1,56 @@
+"""PDB plugin: PodDisruptionBudget-aware eviction vetoes.
+
+Reference counterpart: the PDB the reference carries on each job
+(api/job_info.go · JobInfo.PDB) and honors when filtering preemption/
+reclaim victims — plain pods matching a budget's selector may not be
+evicted below its minAvailable.  The gang plugin provides the analogous
+floor for gang members; this plugin covers everything else.
+
+Tensor shape: the packer resolves each pod's (first) matching budget
+into `task_pdb` (i32[T]) and the floors into `pdb_min` (i32[B]); the
+veto is then one segment count + gather per sweep step, recomputed
+against the LIVE state so cumulative evictions within one Statement
+keep respecting the floor.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from kube_batch_tpu.api.snapshot import allocated_mask
+from kube_batch_tpu.framework.plugin import Plugin, register_plugin
+
+
+def pdb_healthy_counts(snap, state) -> jax.Array:
+    """i32[B]: currently-healthy (resource-holding) members per budget."""
+    B = snap.pdb_min.shape[0]
+    member = (
+        allocated_mask(state.task_state)
+        & snap.task_mask
+        & (snap.task_pdb >= 0)
+    )
+    seg = jnp.where(member, jnp.clip(snap.task_pdb, 0, B - 1), B)
+    return jax.ops.segment_sum(
+        jnp.ones_like(seg, dtype=jnp.int32), seg, num_segments=B + 1
+    )[:B]
+
+
+@register_plugin
+class PdbPlugin(Plugin):
+    name = "pdb"
+
+    def register(self, policy, tier: int) -> None:
+        def veto(snap, state, preemptor):  # noqa: ARG001 — budget is global
+            B = snap.pdb_min.shape[0]
+            if B == 0:  # static: no budgets in this snapshot
+                return jnp.ones(snap.num_tasks, bool)
+            healthy = pdb_healthy_counts(snap, state)
+            tb = jnp.clip(snap.task_pdb, 0, B - 1)
+            survives = healthy[tb] - 1 >= snap.pdb_min[tb]
+            return survives | (snap.task_pdb < 0)
+
+        if self.enabled_for("preemptable"):
+            policy.add_preemptable_fn(tier, veto)
+        if self.enabled_for("reclaimable"):
+            policy.add_reclaimable_fn(tier, veto)

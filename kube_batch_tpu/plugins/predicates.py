@@ -29,12 +29,25 @@ reference) is registered as a DYNAMIC predicate — placements made
 earlier in the same cycle change feasibility, so it re-evaluates inside
 every auction round / preemption step; see `pod_affinity_predicate`.
 
+Inter-pod affinity supports arbitrary topology keys ("zone:app=web"
+terms): the packer interns (key, label) terms and node→domain indices,
+and the resident aggregation here runs per DOMAIN instead of per node
+(≙ the vendored predicate's topologyKey support).  Snapshots with no
+topo terms carry zero-width topo tensors and skip the domain math at
+trace time.
+
 Arguments (≙ predicates.go's `predicate.*Enable` toggles):
-    predicate.NodeSelectorEnable  (default true)
-    predicate.TaintsEnable        (default true)
-    predicate.HostPortsEnable     (default true)
-    predicate.NodeReadyEnable     (default true)
-    predicate.PodAffinityEnable   (default true)
+    predicate.NodeSelectorEnable    (default true)
+    predicate.TaintsEnable          (default true)
+    predicate.HostPortsEnable       (default true)
+    predicate.NodeReadyEnable       (default true)
+    predicate.PodAffinityEnable     (default true)
+    predicate.MemoryPressureEnable  (default false — opt-in, as upstream)
+    predicate.DiskPressureEnable    (default false)
+    predicate.PidPressureEnable     (default false)
+    predicate.VolumeBindingEnable   (default true — PVC/StorageClass
+                                     node feasibility, ≙ the VolumeBinder
+                                     informers in cache.go)
 """
 
 from __future__ import annotations
@@ -58,6 +71,15 @@ class PredicatesPlugin(Plugin):
         tnt_on = self.args.get_bool("predicate.TaintsEnable", True)
         prt_on = self.args.get_bool("predicate.HostPortsEnable", True)
         rdy_on = self.args.get_bool("predicate.NodeReadyEnable", True)
+        # Pressure checks are opt-in, matching upstream's defaults: a
+        # conf written for the reference that never mentions them gets
+        # identical behavior here.
+        pressure_on = (
+            self.args.get_bool("predicate.MemoryPressureEnable", False),
+            self.args.get_bool("predicate.DiskPressureEnable", False),
+            self.args.get_bool("predicate.PidPressureEnable", False),
+        )
+        vol_on = self.args.get_bool("predicate.VolumeBindingEnable", True)
 
         def predicate(snap):
             T, N = snap.num_tasks, snap.num_nodes
@@ -75,6 +97,28 @@ class PredicatesPlugin(Plugin):
                 ok = ok & (clash <= 0.5)
             if rdy_on:
                 ok = ok & snap.node_ready[None, :]
+            for dim, on in enumerate(pressure_on):
+                if on:
+                    ok = ok & (snap.node_pressure[None, :, dim] <= 0.5)
+            if vol_on:
+                # Volume feasibility (≙ the VolumeBinder's node filter):
+                # bound local PVs pin to one node; unbound constrained
+                # claims need >=1 allowed label per claim group.
+                node_ids = jnp.arange(N, dtype=jnp.int32)
+                pinned = snap.task_vol_node
+                ok = ok & (
+                    (pinned == -1)[:, None]
+                    | (pinned[:, None] == node_ids[None, :])
+                )
+                if snap.task_vol_groups.shape[1]:  # static: groups exist
+                    f = snap.task_vol_groups.dtype
+                    node_ok_g = (
+                        snap.node_labels @ snap.vol_group_sel.T
+                    ) > 0.5                                      # [N, G]
+                    miss = snap.task_vol_groups @ (
+                        1.0 - node_ok_g.astype(f)
+                    ).T                                          # [T, N]
+                    ok = ok & (miss <= 0.5)
             return ok
 
         policy.add_predicate_fn(predicate)
@@ -84,22 +128,22 @@ class PredicatesPlugin(Plugin):
                 pod_affinity_predicate, row_fn=pod_affinity_row
             )
             policy.add_global_serialize_fn(bootstrap_mask)
+            policy.add_domain_serialize_fn(topo_anti_participants)
 
 
-def resident_podlabels(snap, state):
+def resident_podlabels(snap, state, include_releasing: bool = False):
     """(Hb, Ab): bool[N, K] label/anti-term presence among each node's
     residents.  "Resident" = allocated statuses or pipelined with a node
     — future-oriented, so a RELEASING victim no longer anchors affinity
     or blocks anti-affinity for placements that land after it leaves
-    (consistent with FutureIdle reasoning)."""
-    held = (
-        (
-            allocated_mask(state.task_state)
-            | status_is(state.task_state, TaskStatus.PIPELINED)
-        )
-        & (state.task_node >= 0)
-        & snap.task_mask
-    )
+    (consistent with FutureIdle reasoning).
+
+    `include_releasing` widens the resident set to RELEASING tasks still
+    on their node: an IMMEDIATE (Idle-pass) placement binds while such a
+    victim may still be terminating, and anti-affinity is scheduler-
+    enforced only — the reference's vendored predicate still sees the
+    terminating pod in its node info and refuses (predicates.go)."""
+    held = _resident_mask(snap, state, include_releasing)
     seg = jnp.where(held, state.task_node, snap.num_nodes)
     w = held.astype(snap.task_podlabels.dtype)[:, None]
     Hb = jax.ops.segment_sum(
@@ -111,7 +155,87 @@ def resident_podlabels(snap, state):
     return Hb, Ab
 
 
-def pod_affinity_predicate(snap, state):
+def _resident_mask(snap, state, include_releasing: bool):
+    held = (
+        (
+            allocated_mask(state.task_state)
+            | status_is(state.task_state, TaskStatus.PIPELINED)
+        )
+        & (state.task_node >= 0)
+        & snap.task_mask
+    )
+    if include_releasing:
+        held = held | (
+            status_is(state.task_state, TaskStatus.RELEASING)
+            & (state.task_node >= 0)
+            & snap.task_mask
+        )
+    return held
+
+
+def resident_domain_labels(snap, state, include_releasing: bool = False):
+    """(Hd, Ad): bool[D, K] label / anti-term-label presence among each
+    topology DOMAIN's residents — the per-domain twin of
+    resident_podlabels, for topo-scoped terms.  Domain ids are disjoint
+    across topology keys (packer invariant), so one [D, K] table serves
+    every key."""
+    TK = snap.node_key_domain.shape[1]
+    D = snap.domain_mask.shape[0]
+    K = snap.task_podlabels.shape[1]
+    held = _resident_mask(snap, state, include_releasing)
+    w = held.astype(snap.task_podlabels.dtype)[:, None]
+    node_of = jnp.clip(state.task_node, 0, snap.num_nodes - 1)
+    onehot_lab = jax.nn.one_hot(
+        snap.topo_term_label, K, dtype=snap.task_podlabels.dtype
+    )  # [K2, K]
+    Hd = jnp.zeros((D, K), snap.task_podlabels.dtype)
+    Ad = jnp.zeros((D, K), snap.task_podlabels.dtype)
+    for tk in range(TK):  # static, small (# distinct topology keys)
+        seg = jnp.where(held, snap.node_key_domain[node_of, tk], D)
+        Hd = Hd + jax.ops.segment_sum(
+            snap.task_podlabels * w, seg, num_segments=D + 1
+        )[:D]
+        anti_this_key = snap.task_anti_topo * (
+            snap.topo_term_key == tk
+        ).astype(snap.task_anti_topo.dtype)[None, :]
+        anti_lab = anti_this_key @ onehot_lab                   # [T, K]
+        Ad = Ad + jax.ops.segment_sum(anti_lab * w, seg, num_segments=D + 1)[:D]
+    return Hd > 0, Ad > 0
+
+
+def _topo_feasibility(snap, Hb, Hd, Ad_now, Hd_now):
+    """(aff_ok, anti_sym_ok): bool[T, N] for the topo-scoped terms.
+
+    `Hb` is the node-level resident-label table (for the bootstrap
+    existence test — a term 'exists' if ANY resident anywhere carries
+    the label, regardless of domain); Hd/Hd_now/Ad_now are the domain
+    tables (future-oriented for affinity, releasing-inclusive for the
+    anti/symmetry side when immediate).
+    """
+    f = snap.task_aff_topo.dtype
+    A = snap.node_key_domain[:, snap.topo_term_key]             # i32[N, K2]
+    present = Hd[A, snap.topo_term_label[None, :]].astype(f)    # [N, K2]
+
+    need = jnp.sum(snap.task_aff_topo, axis=1, keepdims=True)
+    have = snap.task_aff_topo @ present.T                       # [T, N]
+    exists = jnp.any(Hb, axis=0)[snap.topo_term_label]          # bool[K2]
+    own_at_term = snap.task_podlabels[:, snap.topo_term_label]  # [T, K2]
+    bootstrap = jnp.sum(
+        snap.task_aff_topo * own_at_term * (~exists).astype(f)[None, :],
+        axis=1, keepdims=True,
+    )
+    aff_ok = have + bootstrap >= need
+
+    present_now = Hd_now[A, snap.topo_term_label[None, :]].astype(f)
+    anti_hit = snap.task_anti_topo @ present_now.T              # [T, N]
+    sym_hit = jnp.zeros_like(anti_hit)
+    for tk in range(snap.node_key_domain.shape[1]):
+        Ad_n = Ad_now[snap.node_key_domain[:, tk]].astype(f)    # [N, K]
+        sym_hit = sym_hit + snap.task_podlabels @ Ad_n.T
+    return aff_ok, (anti_hit <= 0.5) & (sym_hit <= 0.5)
+
+
+def pod_affinity_predicate(snap, state, immediate: bool = False):
     """bool[T, N] inter-pod affinity/anti-affinity feasibility
     (≙ the vendored k8s inter-pod affinity predicate in
     plugins/predicates/predicates.go, topologyKey = node):
@@ -122,9 +246,18 @@ def pod_affinity_predicate(snap, state):
       label, so the first gang member can land);
     * anti-affinity: no resident carries any of the task's anti terms;
     * symmetry: no resident's anti term matches the task's own labels.
+
+    `immediate` marks the Idle pass (placements that bind this cycle):
+    the anti/symmetry checks then also see RELEASING residents, whose
+    pods may outlive the bind on the cluster.  Positive affinity stays
+    future-oriented in both passes — a dying pod is no anchor.
     """
     Hb, Ab = resident_podlabels(snap, state)
     Hf = Hb.astype(snap.task_aff.dtype)
+    if immediate:
+        Hb_anti, Ab_anti = resident_podlabels(snap, state, include_releasing=True)
+    else:
+        Hb_anti, Ab_anti = Hb, Ab
 
     need = jnp.sum(snap.task_aff, axis=1, keepdims=True)       # f32[T,1]
     have = snap.task_aff @ Hf.T                                # f32[T,N]
@@ -142,14 +275,29 @@ def pod_affinity_predicate(snap, state):
     )                                                          # f32[T,1]
     aff_ok = have + bootstrap >= need
 
-    anti_hit = snap.task_anti @ Hf.T                           # f32[T,N]
-    sym_hit = snap.task_podlabels @ Ab.astype(Hf.dtype).T      # f32[T,N]
-    return aff_ok & (anti_hit <= 0.5) & (sym_hit <= 0.5)
+    anti_hit = snap.task_anti @ Hb_anti.astype(Hf.dtype).T     # f32[T,N]
+    sym_hit = snap.task_podlabels @ Ab_anti.astype(Hf.dtype).T  # f32[T,N]
+    ok = aff_ok & (anti_hit <= 0.5) & (sym_hit <= 0.5)
+
+    if snap.task_aff_topo.shape[1]:  # static: topo terms exist
+        Hd, Ad = resident_domain_labels(snap, state)
+        if immediate:
+            Hd_now, Ad_now = resident_domain_labels(
+                snap, state, include_releasing=True
+            )
+        else:
+            Hd_now, Ad_now = Hd, Ad
+        topo_aff_ok, topo_anti_ok = _topo_feasibility(
+            snap, Hb, Hd, Ad_now, Hd_now
+        )
+        ok = ok & topo_aff_ok & topo_anti_ok
+    return ok
 
 
 def pod_affinity_row(snap, state, p):
     """bool[N]: pod_affinity_predicate for ONE task — O(N·K) instead of
-    the full [T, N] matrix; used per preemption step."""
+    the full [T, N] matrix; used per preemption step.  Future-oriented
+    (the preemptor pipelines onto FutureIdle, after victims leave)."""
     Hb, Ab = resident_podlabels(snap, state)
     Hf = Hb.astype(snap.task_aff.dtype)
     aff = snap.task_aff[p]                                     # f32[K]
@@ -161,16 +309,58 @@ def pod_affinity_row(snap, state, p):
     aff_ok = have + bootstrap >= need
     anti_hit = Hf @ snap.task_anti[p]
     sym_hit = Ab.astype(Hf.dtype) @ own
-    return aff_ok & (anti_hit <= 0.5) & (sym_hit <= 0.5)
+    ok = aff_ok & (anti_hit <= 0.5) & (sym_hit <= 0.5)
+
+    if snap.task_aff_topo.shape[1]:  # static: topo terms exist
+        f = snap.task_aff_topo.dtype
+        Hd, Ad = resident_domain_labels(snap, state)
+        A = snap.node_key_domain[:, snap.topo_term_key]         # [N, K2]
+        present = Hd[A, snap.topo_term_label[None, :]].astype(f)
+        aff2 = snap.task_aff_topo[p]
+        need2 = jnp.sum(aff2)
+        have2 = present @ aff2                                  # f32[N]
+        exists2 = term_exists[snap.topo_term_label]
+        own2 = snap.task_podlabels[p, snap.topo_term_label]
+        boot2 = jnp.sum(aff2 * own2 * (~exists2).astype(f))
+        anti2 = present @ snap.task_anti_topo[p]
+        sym2 = jnp.zeros(snap.num_nodes, f)
+        for tk in range(snap.node_key_domain.shape[1]):
+            Ad_n = Ad[snap.node_key_domain[:, tk]].astype(f)    # [N, K]
+            sym2 = sym2 + Ad_n @ own
+        ok = ok & (have2 + boot2 >= need2) & (anti2 <= 0.5) & (sym2 <= 0.5)
+    return ok
 
 
 def bootstrap_mask(snap, state):
-    """bool[T]: pending tasks whose required affinity currently relies
-    on the bootstrap waiver — at most one of these may be accepted per
-    auction round (all of them placing at once would scatter a
-    self-affine gang across nodes)."""
+    """bool[T]: tasks that may be accepted at most once per auction
+    round GLOBALLY — pending tasks whose required affinity (node- or
+    domain-scoped) currently relies on the bootstrap waiver: all of
+    them placing at once would scatter a self-affine gang."""
     Hb, _ = resident_podlabels(snap, state)
     term_exists = jnp.any(Hb, axis=0)
-    return jnp.any(
+    m = jnp.any(
         (snap.task_aff > 0) & (~term_exists)[None, :], axis=1
+    )
+    if snap.task_aff_topo.shape[1]:  # static: topo terms exist
+        exists2 = term_exists[snap.topo_term_label]
+        m = m | jnp.any(
+            (snap.task_aff_topo > 0) & (~exists2)[None, :], axis=1
+        )
+    return m & snap.task_mask
+
+
+def topo_anti_participants(snap, state):  # noqa: ARG001 — snapshot-static
+    """bool[T]: tasks involved in DOMAIN-scoped anti-affinity (as term
+    holder or as label target) — limited to one acceptance per topology
+    domain per round (ops/assignment.py's domain-serialize step): two
+    same-round acceptances on different nodes of one zone can't see
+    each other in the residents table."""
+    if not snap.task_anti_topo.shape[1]:  # static: no topo terms
+        return jnp.zeros(snap.num_tasks, bool)
+    used2 = jnp.any(snap.task_anti_topo > 0, axis=0)            # bool[K2]
+    K = snap.task_podlabels.shape[1]
+    anti_union2 = jnp.zeros(K, bool).at[snap.topo_term_label].max(used2)
+    return (
+        jnp.any(snap.task_anti_topo > 0, axis=1)
+        | jnp.any((snap.task_podlabels > 0) & anti_union2[None, :], axis=1)
     ) & snap.task_mask

@@ -19,8 +19,11 @@ import logging
 import threading
 import time
 
+import numpy as np
+
 from kube_batch_tpu import metrics
 from kube_batch_tpu.actions import factory as _action_factory  # noqa: F401
+from kube_batch_tpu.api.types import TaskStatus
 from kube_batch_tpu.cache.cache import SchedulerCache
 from kube_batch_tpu.framework.conf import SchedulerConf, load_conf
 from kube_batch_tpu.framework.plugin import Action, get_action
@@ -34,6 +37,8 @@ from kube_batch_tpu.plugins import factory as _plugin_factory  # noqa: F401
 
 DEFAULT_SCHEDULE_PERIOD = 1.0  # ≙ scheduler.go · defaultSchedulePeriod (1s)
 
+_PENDING = int(TaskStatus.PENDING)
+
 
 class Scheduler:
     """≙ pkg/scheduler/scheduler.go · Scheduler."""
@@ -43,19 +48,109 @@ class Scheduler:
         cache: SchedulerCache,
         conf_path: str | None = None,
         schedule_period: float = DEFAULT_SCHEDULE_PERIOD,
+        profile_dir: str | None = None,
     ) -> None:
         self.cache = cache
         self.conf_path = conf_path
         self.schedule_period = schedule_period
+        # jax.profiler trace target (SURVEY §5 rebuild target): when
+        # set, the SECOND cycle of run() is captured (the first pays
+        # compilation and would swamp the trace).
+        self.profile_dir = profile_dir
+        self._profiled = False
         self._conf: SchedulerConf | None = None
         self._policy = None
         self._plugins: list = []
         self._actions: list[Action] = []
+        # Fused cycle: the whole action pipeline as one jitted dispatch
+        # (None when the conf names an action without a fuseable solver;
+        # the loop then falls back to per-action execution).
+        self._cycle = None
+        # Async prewarm state for conf hot-reload: a freshly-edited conf
+        # compiles on a background thread against the last cycle's
+        # shapes while the OLD policy keeps serving — a 1 s-period
+        # daemon must not blow its cycle budget on an XLA recompile.
+        self._pending: dict | None = None
+        self._last_snap = None
 
     # -- configuration (hot reload) -------------------------------------
+    def _build_from_conf(self, conf: SchedulerConf) -> dict:
+        """Policy + actions + fused cycle for `conf` (raises on bad conf)."""
+        policy, plugins = build_policy(conf)
+        actions = []
+        for name in conf.actions:
+            action = get_action(name)
+            action.initialize(policy)
+            actions.append(action)
+        try:
+            import jax
+
+            from kube_batch_tpu.actions.fused import make_cycle_solver
+
+            cycle = jax.jit(make_cycle_solver(policy, conf.actions))
+        except Exception as exc:  # noqa: BLE001 — any build failure must
+            # fall back to per-action dispatch, never break the daemon's
+            # keep-previous-policy contract (the actions themselves were
+            # already initialized successfully above).
+            cycle = None
+            if not isinstance(exc, KeyError):
+                logging.warning("fused cycle unavailable, per-action "
+                                "fallback: %s", exc)
+        return {
+            "conf": conf, "policy": policy, "plugins": plugins,
+            "actions": actions, "cycle": cycle,
+        }
+
+    def _adopt(self, built: dict) -> None:
+        for action in self._actions:
+            action.uninitialize()
+        self._conf = built["conf"]
+        self._policy, self._plugins = built["policy"], built["plugins"]
+        self._actions = built["actions"]
+        self._cycle = built["cycle"]
+
+    # If a background warm hasn't finished within this budget, adopt the
+    # new conf anyway and let the first cycle compile synchronously —
+    # slow cycle beats a conf that never lands.  (Compiling a second
+    # LARGE program in-process has been observed to hang on the
+    # tunneled TPU target — see bench.py's subprocess-isolation note —
+    # so the warm must never be allowed to wedge adoption forever.)
+    PREWARM_TIMEOUT_S = 120.0
+
+    def _start_prewarm(self, built: dict) -> None:
+        """Compile the new fused cycle on a daemon thread against the
+        last cycle's snapshot shapes; the old policy keeps serving until
+        the warm finishes (swap happens in a later _reload_conf call)."""
+        ready = threading.Event()
+        built["started"] = time.monotonic()
+        snap = self._last_snap
+        cycle = built["cycle"]
+
+        def warm() -> None:
+            try:
+                if cycle is not None and snap is not None:
+                    import jax
+
+                    from kube_batch_tpu.ops.assignment import init_state
+
+                    out = cycle(snap, init_state(snap))
+                    jax.block_until_ready(out)
+            except Exception:  # noqa: BLE001 — warm failure still swaps;
+                # the real cycle will surface (and log) any genuine error
+                logging.exception("conf prewarm failed; swapping anyway")
+            finally:
+                ready.set()
+
+        built["ready"] = ready
+        self._pending = built
+        threading.Thread(target=warm, daemon=True).start()
+
     def _reload_conf(self) -> None:
         """Re-read scheduler.conf; rebuild compiled policy only on change
-        (≙ scheduler.go · loadSchedulerConf every cycle)."""
+        (≙ scheduler.go · loadSchedulerConf every cycle).  A changed conf
+        is adopted ASYNCHRONOUSLY: built immediately, compiled on a
+        background thread, swapped in the first cycle after the warm
+        completes — steady-state cycles never pay the recompile."""
         try:
             conf = load_conf(self.conf_path)
         except Exception as exc:  # noqa: BLE001 — malformed YAML mid-edit
@@ -63,43 +158,98 @@ class Scheduler:
                 raise
             logging.warning("scheduler.conf unreadable, keeping policy: %s", exc)
             return
+
+        if self._pending is not None:
+            if conf == self._pending["conf"]:
+                timed_out = (
+                    time.monotonic() - self._pending["started"]
+                    > self.PREWARM_TIMEOUT_S
+                )
+                if self._pending["ready"].is_set() or timed_out:
+                    if timed_out and not self._pending["ready"].is_set():
+                        logging.warning(
+                            "conf prewarm exceeded %.0fs; adopting anyway "
+                            "(first cycle will compile in-line)",
+                            self.PREWARM_TIMEOUT_S,
+                        )
+                    self._adopt(self._pending)
+                    self._pending = None
+                    return
+                return  # still warming; keep serving the old policy
+            self._pending = None  # conf changed again under the warm
+
         if conf == self._conf:
             return
-        # Build everything first; commit (including self._conf) only on
-        # success, so a bad conf leaves the previous policy fully intact
-        # and is retried (and re-reported) every cycle.
+        # Build everything first; commit only on success, so a bad conf
+        # leaves the previous policy fully intact and is retried (and
+        # re-reported) every cycle.
         try:
-            policy, plugins = build_policy(conf)
-            actions = []
-            for name in conf.actions:
-                action = get_action(name)
-                action.initialize(policy)
-                actions.append(action)
+            built = self._build_from_conf(conf)
         except Exception as exc:  # noqa: BLE001 — e.g. unknown plugin/action
             if self._conf is None:
                 raise  # first load must be valid; nothing to fall back to
             logging.warning("scheduler.conf rejected, keeping policy: %s", exc)
             return
-        for action in self._actions:
-            action.uninitialize()
-        self._conf = conf
-        self._policy, self._plugins = policy, plugins
-        self._actions = actions
+        if self._conf is None or self._last_snap is None:
+            self._adopt(built)  # first load: nothing to serve meanwhile
+        else:
+            self._start_prewarm(built)
 
     # -- one cycle (≙ scheduler.go · runOnce) ---------------------------
+    def _execute_fused(self, ssn: Session) -> None:
+        """One device dispatch for the whole action pipeline, then commit
+        evictions per action on the host (see actions/fused.py)."""
+        from kube_batch_tpu.actions.preempt import commit_victim_indices
+
+        with metrics.action_latency.time("fused"):
+            state, evict_masks, job_ready = self._cycle(ssn.snap, ssn.state)
+            ssn.state = state
+            ssn.set_job_ready(np.asarray(job_ready))
+            from kube_batch_tpu.framework.plugin import get_action
+
+            for name in self._conf.actions:
+                if name not in evict_masks:
+                    continue
+                victims = np.nonzero(np.asarray(evict_masks[name]))[0]
+                reason = getattr(get_action(name), "evict_reason", name)
+                landed = commit_victim_indices(ssn, victims, reason)
+                if landed:
+                    metrics.preemption_attempts.inc()
+                    metrics.preemption_victims.inc(by=float(landed))
+
+    def _execute_actions(self, ssn: Session) -> None:
+        """Per-action dispatch fallback (custom registered actions)."""
+        for action in self._actions:
+            with metrics.action_latency.time(action.name):
+                before = (
+                    len(ssn.evicted)
+                    if getattr(action, "evicting", False) else None
+                )
+                action.execute(ssn)
+                if before is not None and len(ssn.evicted) > before:
+                    metrics.preemption_attempts.inc()
+                    metrics.preemption_victims.inc(
+                        by=float(len(ssn.evicted) - before)
+                    )
+
     def run_once(self) -> Session:
         with metrics.e2e_latency.time():
             self._reload_conf()
             ssn = open_session(self.cache, self._policy, self._plugins)
-            for action in self._actions:
-                with metrics.action_latency.time(action.name):
-                    action.execute(ssn)
-                if action.name in ("preempt", "reclaim"):
-                    metrics.preemption_attempts.inc()
+            if self._cycle is not None:
+                self._execute_fused(ssn)
+            else:
+                self._execute_actions(ssn)
             close_session(ssn)
+            self._last_snap = ssn.snap  # shapes for the next conf prewarm
         if ssn.bound or ssn.evicted:
             result = "scheduled"
-        elif metrics.pending_tasks.value() > 0:
+        elif np.any(
+            ssn.host_task_state()[: ssn.meta.num_real_tasks] == _PENDING
+        ):
+            # Pending from THIS session's final state, not the process-
+            # global gauge — multiple Scheduler instances in one process
+            # must not cross-contaminate each other's result labels.
             result = "unschedulable"   # pending work, nothing placeable
         else:
             result = "idle"            # nothing pending — not a failure
@@ -126,6 +276,15 @@ class Scheduler:
             max_cycles is None or cycles < max_cycles
         ):
             started = time.monotonic()
+            profiling = (
+                self.profile_dir is not None
+                and not self._profiled
+                and cycles == 1  # second cycle: first one compiled
+            )
+            if profiling:
+                import jax
+
+                jax.profiler.start_trace(self.profile_dir)
             try:
                 self.run_once()
             except Exception:  # noqa: BLE001
@@ -133,6 +292,14 @@ class Scheduler:
                     raise  # never successfully configured: fail loud
                 metrics.schedule_attempts.inc("error")
                 logging.exception("scheduling cycle failed; continuing")
+            finally:
+                if profiling:
+                    import jax
+
+                    jax.profiler.stop_trace()
+                    self._profiled = True
+                    logging.info("profiler trace written to %s",
+                                 self.profile_dir)
             if on_cycle is not None:
                 on_cycle()
             cycles += 1

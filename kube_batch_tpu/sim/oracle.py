@@ -232,13 +232,13 @@ def snapshot_to_numpy(snap, meta) -> dict:
     for name in (
         "task_req", "task_state", "task_job", "task_node", "task_prio",
         "task_order", "task_sel", "task_tol", "task_ports",
-        "task_podlabels", "task_aff", "task_anti",
+        "task_podlabels", "task_aff", "task_anti", "task_critical",
     ):
         out[name] = np.asarray(getattr(snap, name))[:Tn]
-    for name in ("node_cap", "node_idle", "node_labels", "node_taints",
-                 "node_ports", "node_ready"):
+    for name in ("node_cap", "node_idle", "node_releasing", "node_labels",
+                 "node_taints", "node_ports", "node_ready"):
         out[name] = np.asarray(getattr(snap, name))[:Nn]
-    for name in ("job_queue", "job_min", "job_prio"):
+    for name in ("job_queue", "job_min", "job_prio", "job_order"):
         out[name] = np.asarray(getattr(snap, name))[: len(meta.job_names)]
     out["queue_weight"] = np.asarray(snap.queue_weight)[: len(meta.queue_names)]
     out["eps"] = np.asarray(snap.eps)

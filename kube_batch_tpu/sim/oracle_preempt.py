@@ -1,0 +1,351 @@
+"""Serial CPU oracle for preempt/reclaim: an independent Statement loop.
+
+Reference shape (actions/preempt/preempt.go · Execute, actions/reclaim/
+reclaim.go · Execute, framework/statement.go): strictly serial —
+
+    while a starving (preempt) / wanting (reclaim) job exists:
+        preemptor = its rank-first pending task
+        open a Statement
+        pick a target node
+        evict candidate victims ONE BY ONE (vetoes recomputed against
+            the live state after every eviction)
+        the moment the preemptor fits FutureIdle: Commit (pipeline it)
+        victims run out first: Discard (roll everything back)
+
+Deliberately NumPy + Python loops, sharing NO kernel code with
+ops/preemption.py — divergence between the two is a bug in one of them.
+Node choice mirrors the kernel's published heuristic (fewest victims
+needed, lowest index on ties) so the two are comparable placement-for-
+placement, not just in aggregate.
+
+Status ints mirror api.types.TaskStatus: PENDING=0, ALLOCATED=1,
+PIPELINED=2, BINDING=3, BOUND=4, RUNNING=5, RELEASING=6, SUCCEEDED=7.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from kube_batch_tpu.sim.oracle import _waterfill
+
+PENDING, ALLOCATED, PIPELINED, RELEASING = 0, 1, 2, 6
+ALLOCATED_SET = (1, 3, 4, 5)          # Allocated/Binding/Bound/Running
+READY_SET = (1, 3, 4, 5, 7)           # + Succeeded
+VALID_SET = (0, 1, 2, 3, 4, 5, 7)     # + Pending/Pipelined
+
+
+class _World:
+    """Live mutable view the Statement loop operates on."""
+
+    def __init__(self, snap: dict):
+        self.snap = snap
+        self.T = snap["task_req"].shape[0]
+        self.N = snap["node_idle"].shape[0]
+        self.J = snap["job_min"].shape[0]
+        self.Q = snap["queue_weight"].shape[0]
+        self.task_state = snap["task_state"].astype(np.int64).copy()
+        self.task_node = snap["task_node"].astype(np.int64).copy()
+        self.future = snap["node_idle"] + snap["node_releasing"]
+        self.future = self.future.astype(np.float64).copy()
+        self.task_queue = np.array([
+            snap["job_queue"][j] if j >= 0 else -1 for j in snap["task_job"]
+        ])
+        req = np.zeros((self.Q, snap["task_req"].shape[1]))
+        for t in range(self.T):
+            q = self.task_queue[t]
+            if q >= 0:
+                req[q] += snap["task_req"][t]
+        self.deserved = _waterfill(
+            snap["queue_weight"], req, snap["node_cap"].sum(0)
+        )
+
+    # -- live accounting ------------------------------------------------
+    def counts(self, j: int, statuses) -> int:
+        return int(np.sum(
+            np.isin(self.task_state, statuses) & (self.snap["task_job"] == j)
+        ))
+
+    def queue_alloc(self) -> np.ndarray:
+        """f32[Q, R] live held requests (allocated statuses + pipelined)."""
+        held = (
+            np.isin(self.task_state, ALLOCATED_SET)
+            | (self.task_state == PIPELINED)
+        ) & (self.snap["task_job"] >= 0)
+        alloc = np.zeros_like(self.deserved)
+        for t in np.nonzero(held)[0]:
+            q = self.task_queue[t]
+            if q >= 0:
+                alloc[q] += self.snap["task_req"][t]
+        return alloc
+
+    def job_alloc(self) -> np.ndarray:
+        held = (
+            np.isin(self.task_state, ALLOCATED_SET)
+            | (self.task_state == PIPELINED)
+        ) & (self.snap["task_job"] >= 0)
+        alloc = np.zeros((self.J, self.snap["task_req"].shape[1]))
+        for t in np.nonzero(held)[0]:
+            alloc[self.snap["task_job"][t]] += self.snap["task_req"][t]
+        return alloc
+
+    def fits(self, req: np.ndarray, avail: np.ndarray) -> bool:
+        eps = self.snap["eps"]
+        return bool(np.all((req <= avail) | (req < eps)))
+
+
+def _job_rank_keys(w: _World):
+    """i32[J] dense job ranks with the default tiered keys:
+    priority desc > gang-unready-first > drf dominant share asc >
+    creation asc (framework/policy.py · job_rank with the default conf).
+    """
+    snap = w.snap
+    total = np.maximum(snap["node_cap"].sum(0), 1e-9)
+    jalloc = w.job_alloc()
+    keys = []
+    for j in range(w.J):
+        ready = w.counts(j, READY_SET) + int(np.sum(
+            (w.task_state == PIPELINED) & (snap["task_job"] == j)
+        ))
+        gang_unready = 1.0 if ready >= snap["job_min"][j] else 0.0
+        share = float((jalloc[j] / total).max())
+        keys.append((
+            -snap["job_prio"][j],        # priority plugin (tier 1)
+            gang_unready,                # gang plugin (tier 1)
+            share,                       # drf plugin (tier 2)
+            snap["job_order"][j],        # creation tiebreak
+        ))
+    order = sorted(range(w.J), key=lambda j: keys[j])
+    rank = np.zeros(w.J, np.int64)
+    for r, j in enumerate(order):
+        rank[j] = r
+    return rank
+
+
+def _task_sort_key(w: _World, t: int, qshare: np.ndarray, jrank: np.ndarray):
+    """Serial analog of the policy's task rank: queue share, then the
+    job's tiered rank, then task priority desc, then creation."""
+    q = w.task_queue[t]
+    j = w.snap["task_job"][t]
+    return (
+        float(qshare[q]) if q >= 0 else 0.0,
+        int(jrank[j]) if j >= 0 else 0,
+        -w.snap["task_prio"][t],
+        w.snap["task_order"][t],
+    )
+
+
+def _queue_share(w: _World) -> np.ndarray:
+    alloc = w.queue_alloc()
+    with np.errstate(invalid="ignore"):
+        ratio = np.where(
+            w.deserved > 0, alloc / np.maximum(w.deserved, 1e-9),
+            np.where(alloc > 0, 1e9, 0.0),
+        )
+    return ratio.max(axis=1)
+
+
+def _gang_veto_ok(w: _World, v: int) -> bool:
+    """gang PreemptableFn: victim's job must keep >= minMember ready."""
+    j = w.snap["task_job"][v]
+    if j < 0:
+        return True
+    ready = w.counts(j, READY_SET)
+    return ready - 1 >= w.snap["job_min"][j]
+
+
+def _conformance_ok(w: _World, v: int) -> bool:
+    return not bool(w.snap["task_critical"][v])
+
+
+def _stays_above_deserved(w: _World, v: int) -> bool:
+    """proportion's reclaim floor, per meaningful dimension."""
+    q = w.task_queue[v]
+    if q < 0:
+        return True
+    alloc = w.queue_alloc()[q] - w.snap["task_req"][v]
+    d = w.deserved[q]
+    beps = w.snap["besteffort_eps"]
+    return bool(np.all((d <= alloc) | (d < beps)))
+
+
+def _candidate_victims(w: _World, p: int, mode: str, jrank, prov: set):
+    """Victim candidacy under the LIVE state (recomputed per eviction)."""
+    snap = w.snap
+    pq, pj = w.task_queue[p], snap["task_job"][p]
+    out = []
+    for v in range(w.T):
+        if v in prov:
+            continue
+        if snap["task_state"][v] not in ALLOCATED_SET:
+            continue  # must really hold resources on the cluster
+        if w.task_state[v] not in ALLOCATED_SET:
+            continue  # already victimized this cycle
+        if w.task_node[v] < 0 or snap["task_job"][v] < 0:
+            continue
+        if not _gang_veto_ok(w, v) or not _conformance_ok(w, v):
+            continue  # tier-1 veto (decisive tier)
+        if mode == "preempt":
+            if w.task_queue[v] != pq:
+                continue
+            if snap["task_job"][v] == pj:
+                continue
+            if jrank[snap["task_job"][v]] <= jrank[pj]:
+                continue  # only less-deserving jobs
+        else:  # reclaim
+            if w.task_queue[v] == pq:
+                continue
+            if not _stays_above_deserved(w, v):
+                continue
+        out.append(v)
+    return out
+
+
+def _sacrifice_order(w: _World, victims, qshare, jrank):
+    """Least deserving evicted first = reverse of the task rank."""
+    return sorted(
+        victims, key=lambda v: _task_sort_key(w, v, qshare, jrank),
+        reverse=True,
+    )
+
+
+def _choose_node(w: _World, p: int, victims, qshare, jrank):
+    """The kernel's heuristic: fewest victims needed (in sacrifice
+    order, current state), lowest node index on ties; 0 victims when the
+    preemptor already fits FutureIdle."""
+    snap = w.snap
+    preq = snap["task_req"][p]
+    best_n, best_k = -1, None
+    order = _sacrifice_order(w, victims, qshare, jrank)
+    for n in range(w.N):
+        if not snap["node_ready"][n]:
+            continue
+        from kube_batch_tpu.sim.oracle import _predicate_ok
+
+        if not _predicate_ok(snap, p, n):
+            continue
+        if w.fits(preq, w.future[n]):
+            k = 0
+        else:
+            gain = np.zeros_like(preq)
+            k = None
+            cnt = 0
+            for v in order:
+                if w.task_node[v] != n:
+                    continue
+                cnt += 1
+                gain = gain + snap["task_req"][v]
+                if w.fits(preq, w.future[n] + gain):
+                    k = cnt
+                    break
+            if k is None:
+                continue
+        if best_k is None or k < best_k:
+            best_n, best_k = n, k
+    return best_n
+
+
+def serial_preempt(snap: dict, mode: str = "preempt") -> dict:
+    """Run the serial Statement loop (preempt or reclaim) over a
+    numpy-ified unpadded snapshot (see oracle.snapshot_to_numpy, plus
+    `node_releasing`, `job_order`, `task_critical` keys).
+
+    Returns {"pipelined": [(task, node)], "evicted": [task],
+    "victims_per_job": {job: count}, "final_state": i64[T]}.
+    """
+    w = _World(snap)
+    tried: set[int] = set()
+    pipelined: list[tuple[int, int]] = []
+    evicted: list[int] = []
+    victims_per_job: dict[int, int] = {}
+    besteffort = np.all(snap["task_req"] < snap["besteffort_eps"], axis=1)
+
+    while True:
+        jrank = _job_rank_keys(w)
+        qshare = _queue_share(w)
+        qalloc = w.queue_alloc()
+
+        # -- who may trigger evictions right now ------------------------
+        candidates = []
+        for t in range(w.T):
+            if w.task_state[t] != PENDING or t in tried or besteffort[t]:
+                continue
+            j = snap["task_job"][t]
+            if j < 0:
+                continue
+            if w.counts(j, VALID_SET) < snap["job_min"][j]:
+                continue  # gang invalid
+            ready = w.counts(j, READY_SET)
+            pipe = ready + int(np.sum(
+                (w.task_state == PIPELINED) & (snap["task_job"] == j)
+            ))
+            pending_cnt = int(np.sum(
+                (w.task_state == PENDING) & (snap["task_job"] == j)
+            ))
+            if pending_cnt == 0:
+                continue
+            if mode == "preempt":
+                # starving: not ready, not pipelined-satisfiable
+                if ready >= snap["job_min"][j] or pipe >= snap["job_min"][j]:
+                    continue
+            else:
+                # reclaim: queue must be under its deserved (not overused)
+                q = w.task_queue[t]
+                d, a = w.deserved[q], qalloc[q]
+                beps = snap["besteffort_eps"]
+                if np.all((d <= a) | (d < beps)):
+                    continue
+            candidates.append(t)
+        if not candidates:
+            break
+
+        p = min(candidates, key=lambda t: _task_sort_key(w, t, qshare, jrank))
+        preq = snap["task_req"][p]
+
+        victims = _candidate_victims(w, p, mode, jrank, set())
+        n = _choose_node(w, p, victims, qshare, jrank)
+        if n < 0:
+            tried.add(p)
+            continue
+
+        # -- the Statement: evict one by one, vetoes recomputed ---------
+        prov: set[int] = set()
+        saved_future = w.future[n].copy()
+        committed = False
+        while True:
+            if w.fits(preq, w.future[n]):
+                # Commit: pipeline the preemptor
+                w.task_state[p] = PIPELINED
+                w.task_node[p] = n
+                w.future[n] = w.future[n] - preq
+                for v in prov:
+                    victims_per_job[snap["task_job"][v]] = (
+                        victims_per_job.get(snap["task_job"][v], 0) + 1
+                    )
+                    evicted.append(v)
+                pipelined.append((p, n))
+                committed = True
+                break
+            vics = [
+                v for v in _candidate_victims(w, p, mode, jrank, prov)
+                if w.task_node[v] == n
+            ]
+            if not vics:
+                break
+            order = _sacrifice_order(w, vics, qshare, jrank)
+            v = order[0]
+            prov.add(v)
+            w.task_state[v] = RELEASING
+            w.future[n] = w.future[n] + snap["task_req"][v]
+        if not committed:
+            # Discard: restore provisional victims + node capacity
+            for v in prov:
+                w.task_state[v] = snap["task_state"][v]
+            w.future[n] = saved_future
+        tried.add(p)
+
+    return {
+        "pipelined": pipelined,
+        "evicted": sorted(evicted),
+        "victims_per_job": victims_per_job,
+        "final_state": w.task_state,
+    }

@@ -53,8 +53,26 @@ class SimulatedCluster:
             pod.group = group.name
             self.cache.add_pod(pod)
 
+    def submit_to_group(self, group_name: str, pods: list[Pod]) -> None:
+        """Additional member pods for an existing PodGroup (scale-up)."""
+        for pod in pods:
+            pod.group = group_name
+            self.cache.add_pod(pod)
+
     def add_queue(self, queue: Queue) -> None:
         self.cache.add_queue(queue)
+
+    def add_claim(self, claim) -> None:
+        self.cache.add_claim(claim)
+
+    def add_storage_class(self, sc) -> None:
+        self.cache.add_storage_class(sc)
+
+    def add_namespace(self, ns) -> None:
+        self.cache.add_namespace(ns)
+
+    def add_pdb(self, pdb) -> None:
+        self.cache.add_pdb(pdb)
 
     # -- time -----------------------------------------------------------
     def tick(self) -> None:

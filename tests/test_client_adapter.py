@@ -1,0 +1,168 @@
+"""E2E tests for the cluster-ingest adapter (L0 client layer).
+
+The scheduler learns about the world ONLY through the JSON-lines watch
+stream and writes back only through the correlated request/response
+wire — the reference's informer + REST path (pkg/client/,
+cache/event_handlers.go), minus Kubernetes.  Covers VERDICT r1 item 4:
+schedule a world ingested through the adapter, survive a mid-run node
+deletion, and resync a failed bind.
+"""
+
+import dataclasses
+import time
+
+from kube_batch_tpu.actions import BUILTIN_ACTIONS  # noqa: F401
+from kube_batch_tpu.api.resource import ResourceSpec
+from kube_batch_tpu.cache.cache import SchedulerCache
+from kube_batch_tpu.cache.cluster import Node, Pod, PodGroup
+from kube_batch_tpu.client import ExternalCluster, StreamBackend, WatchAdapter
+from kube_batch_tpu.client.external import stream_pair
+from kube_batch_tpu.models.workloads import GI
+from kube_batch_tpu.plugins import BUILTIN_PLUGINS  # noqa: F401
+from kube_batch_tpu.scheduler import Scheduler
+
+SPEC = ResourceSpec(("cpu", "memory", "pods", "accelerator"))
+
+
+def _wire_up():
+    """cluster + adapter-backed cache + scheduler, fully connected."""
+    cl_r, cl_w, sch_r, sch_w = stream_pair()
+    cluster = ExternalCluster(cl_r, cl_w).start()
+    backend = StreamBackend(sch_w, timeout=5.0)
+    cache = SchedulerCache(
+        SPEC, binder=backend, evictor=backend, status_updater=backend
+    )
+    adapter = WatchAdapter(cache, sch_r, backend=backend).start()
+    scheduler = Scheduler(cache, conf_path=None)
+    return cluster, cache, adapter, scheduler
+
+
+def _pods(prefix, n, cpu, mem):
+    return [
+        Pod(name=f"{prefix}-{i}",
+            request={"cpu": cpu, "memory": mem, "pods": 1})
+        for i in range(n)
+    ]
+
+
+def test_schedules_world_known_only_via_adapter():
+    cluster, cache, adapter, scheduler = _wire_up()
+    for i in range(3):
+        cluster.add_node(Node(
+            name=f"n{i}",
+            allocatable={"cpu": 8000, "memory": 16 * GI, "pods": 110},
+        ))
+    cluster.submit(
+        PodGroup(name="gang", queue="default", min_member=6),
+        _pods("gang", 6, cpu=2000, mem=4 * GI),
+    )
+    cluster.sync()
+    assert adapter.wait_for_sync(5.0)
+
+    ssn = scheduler.run_once()
+    assert len(ssn.bound) == 6
+    # The authoritative world saw the binds arrive over the wire.
+    assert len(cluster.binds) == 6
+    assert all(n in ("n0", "n1", "n2") for _, n in cluster.binds)
+
+    cluster.tick()  # kubelets start containers → MODIFIED Running events
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        snap = cache.snapshot()
+        job = snap.jobs.get("gang")
+        if job is not None and job.ready_task_num == 6:
+            break
+        time.sleep(0.02)
+    assert job.ready_task_num == 6
+
+
+def test_gang_all_or_nothing_via_adapter():
+    cluster, cache, adapter, scheduler = _wire_up()
+    cluster.add_node(Node(
+        name="n0", allocatable={"cpu": 4000, "memory": 8 * GI, "pods": 110},
+    ))
+    # minMember 4 but only 2 fit — nothing may bind.
+    cluster.submit(
+        PodGroup(name="big", queue="default", min_member=4),
+        _pods("big", 4, cpu=2000, mem=4 * GI),
+    )
+    cluster.sync()
+    assert adapter.wait_for_sync(5.0)
+    ssn = scheduler.run_once()
+    assert ssn.bound == []
+    assert cluster.binds == []
+
+
+def test_mid_run_node_deletion():
+    cluster, cache, adapter, scheduler = _wire_up()
+    for i in range(2):
+        cluster.add_node(Node(
+            name=f"n{i}",
+            allocatable={"cpu": 4000, "memory": 8 * GI, "pods": 110},
+        ))
+    cluster.submit(
+        PodGroup(name="job", queue="default", min_member=1),
+        _pods("job", 4, cpu=2000, mem=4 * GI),
+    )
+    cluster.sync()
+    assert adapter.wait_for_sync(5.0)
+    ssn = scheduler.run_once()
+    assert len(ssn.bound) == 4
+
+    # A node dies; its pods return Pending via the watch stream.
+    cluster.delete_node("n1")
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        snap = cache.snapshot()
+        if "n1" not in snap.nodes:
+            pending = [
+                p for j in snap.jobs.values() for p in j.tasks.values()
+                if p.status.name == "PENDING"
+            ]
+            if len(pending) == 2:
+                break
+        time.sleep(0.02)
+    assert "n1" not in snap.nodes
+    assert len(pending) == 2
+
+    # Next cycle: the orphans cannot fit on the one full node.
+    ssn2 = scheduler.run_once()
+    assert ssn2.bound == []
+    # But capacity freed on the dead node's replacement gets them placed.
+    cluster.add_node(Node(
+        name="n2", allocatable={"cpu": 4000, "memory": 8 * GI, "pods": 110},
+    ))
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        if "n2" in cache.snapshot().nodes:
+            break
+        time.sleep(0.02)
+    ssn3 = scheduler.run_once()
+    assert len(ssn3.bound) == 2
+    assert all(n == "n2" for _, n in ssn3.bound)
+
+
+def test_failed_bind_resync_via_adapter():
+    cluster, cache, adapter, scheduler = _wire_up()
+    cluster.add_node(Node(
+        name="n0", allocatable={"cpu": 8000, "memory": 16 * GI, "pods": 110},
+    ))
+    cluster.submit(
+        PodGroup(name="job", queue="default", min_member=1),
+        _pods("job", 2, cpu=2000, mem=4 * GI),
+    )
+    cluster.sync()
+    assert adapter.wait_for_sync(5.0)
+
+    cluster.fail_bind_pods.add("job-0")  # apiserver rejects this bind
+    ssn = scheduler.run_once()
+    # job-1 bound; job-0 failed and was queued for resync.
+    assert ("job-1", "n0") in cluster.binds
+    assert ("job-0", "n0") not in cluster.binds
+    resync = cache.drain_resync()
+    assert len(resync) == 1
+
+    # The failure clears (transient apiserver hiccup); retry succeeds.
+    cluster.fail_bind_pods.clear()
+    ssn2 = scheduler.run_once()
+    assert ("job-0", "n0") in cluster.binds

@@ -1,0 +1,166 @@
+"""Namespace fair-share weights + PodDisruptionBudget eviction floors.
+
+Reference behaviors: api/namespace_info.go + session_plugins.go ·
+AddNamespaceOrderFn (namespaces within a queue served by weighted
+fairness) and api/job_info.go · JobInfo.PDB (victim filtering honors
+disruption budgets for plain pods).
+"""
+
+import dataclasses
+
+import numpy as np
+
+from kube_batch_tpu.actions import BUILTIN_ACTIONS  # noqa: F401
+from kube_batch_tpu.api.resource import ResourceSpec
+from kube_batch_tpu.cache.cluster import (
+    Namespace,
+    Node,
+    Pod,
+    PodDisruptionBudget,
+    PodGroup,
+)
+from kube_batch_tpu.framework.conf import default_conf
+from kube_batch_tpu.framework.plugin import get_action
+from kube_batch_tpu.framework.session import (
+    build_policy,
+    close_session,
+    open_session,
+)
+from kube_batch_tpu.models.workloads import GI
+from kube_batch_tpu.plugins import BUILTIN_PLUGINS  # noqa: F401
+from kube_batch_tpu.sim.simulator import make_world
+
+SPEC = ResourceSpec(("cpu", "memory", "pods", "accelerator"))
+
+
+def run_cycle(cache, actions=("allocate",)):
+    conf = dataclasses.replace(default_conf(), actions=tuple(actions))
+    policy, plugins = build_policy(conf)
+    acts = [get_action(n) for n in conf.actions]
+    for a in acts:
+        a.initialize(policy)
+    ssn = open_session(cache, policy, plugins)
+    for a in acts:
+        a.execute(ssn)
+    close_session(ssn)
+    return ssn
+
+
+def test_namespace_weights_split_capacity():
+    """Two namespaces, weights 3:1, demand exceeding capacity: the
+    heavier namespace lands ~3x the pods (WFQ interleaving)."""
+    cache, sim = make_world(SPEC)
+    sim.add_namespace(Namespace(name="heavy", weight=3.0))
+    sim.add_namespace(Namespace(name="light", weight=1.0))
+    for i in range(2):
+        sim.add_node(Node(
+            name=f"n{i}",
+            allocatable={"cpu": 8000, "memory": 64 * GI, "pods": 110},
+        ))
+    # 16 slots total (1000m each); each namespace asks for 16.
+    for ns in ("heavy", "light"):
+        sim.submit(
+            PodGroup(name=f"job-{ns}", queue="default", min_member=1),
+            [Pod(name=f"{ns}-{i}", namespace=ns,
+                 request={"cpu": 1000, "memory": 1 * GI, "pods": 1})
+             for i in range(16)],
+        )
+    ssn = run_cycle(cache)
+    by_ns = {"heavy": 0, "light": 0}
+    for name, _node in ssn.bound:
+        by_ns[name.split("-")[0]] += 1
+    assert by_ns["heavy"] + by_ns["light"] == 16
+    assert by_ns["heavy"] == 12, by_ns  # 3:1 split of 16 slots
+    assert by_ns["light"] == 4, by_ns
+
+
+def test_equal_weights_without_namespace_objects():
+    """Pods in undeclared namespaces default to weight 1 — equal split."""
+    cache, sim = make_world(SPEC)
+    sim.add_node(Node(
+        name="n0", allocatable={"cpu": 8000, "memory": 64 * GI, "pods": 110},
+    ))
+    for ns in ("a", "b"):
+        sim.submit(
+            PodGroup(name=f"job-{ns}", queue="default", min_member=1),
+            [Pod(name=f"{ns}-{i}", namespace=ns,
+                 request={"cpu": 1000, "memory": 1 * GI, "pods": 1})
+             for i in range(8)],
+        )
+    ssn = run_cycle(cache)
+    by_ns = {"a": 0, "b": 0}
+    for name, _node in ssn.bound:
+        by_ns[name.split("-")[0]] += 1
+    assert by_ns == {"a": 4, "b": 4}
+
+
+def _running_world_with_pdb(min_available: int):
+    """Two plain low-prio pods labeled app=web running under a PDB, plus
+    a high-prio gang that needs their capacity."""
+    cache, sim = make_world(SPEC)
+    sim.add_node(Node(
+        name="n0", allocatable={"cpu": 4000, "memory": 8 * GI, "pods": 110},
+    ))
+    sim.add_pdb(PodDisruptionBudget(
+        name="web-pdb", min_available=min_available,
+        selector={"app": "web"},
+    ))
+    sim.submit(
+        PodGroup(name="web", queue="default", min_member=1),
+        [Pod(name=f"web-{i}", labels={"app": "web"},
+             request={"cpu": 2000, "memory": 4 * GI, "pods": 1})
+         for i in range(2)],
+    )
+    run_cycle(cache)
+    sim.tick()
+    sim.submit(
+        PodGroup(name="hi", queue="default", min_member=2, priority=1000),
+        [Pod(name=f"hi-{i}", priority=1000,
+             request={"cpu": 2000, "memory": 4 * GI, "pods": 1})
+         for i in range(2)],
+    )
+    return cache, sim
+
+
+def test_pdb_blocks_eviction_below_min_available():
+    cache, _sim = _running_world_with_pdb(min_available=2)
+    ssn = run_cycle(cache, ["allocate", "preempt"])
+    assert ssn.evicted == []  # both members protected
+
+
+def test_pdb_allows_eviction_down_to_floor():
+    cache, _sim = _running_world_with_pdb(min_available=1)
+    ssn = run_cycle(cache, ["allocate", "preempt"])
+    # Exactly one victim: the second eviction would cross the floor, so
+    # the 2-member gang cannot fully place and its plan depends on one
+    # freed slot only.
+    assert len(ssn.evicted) == 1
+    assert ssn.evicted[0][0].startswith("web")
+
+
+def test_unlabeled_pods_not_covered_by_pdb():
+    cache, sim = make_world(SPEC)
+    sim.add_node(Node(
+        name="n0", allocatable={"cpu": 4000, "memory": 8 * GI, "pods": 110},
+    ))
+    sim.add_pdb(PodDisruptionBudget(
+        name="web-pdb", min_available=2, selector={"app": "web"},
+    ))
+    # min_member 0: no gang floor, so the PDB (not covering these
+    # unlabeled pods) is the only thing that could protect them.
+    sim.submit(
+        PodGroup(name="other", queue="default", min_member=0),
+        [Pod(name=f"other-{i}",
+             request={"cpu": 2000, "memory": 4 * GI, "pods": 1})
+         for i in range(2)],
+    )
+    run_cycle(cache)
+    sim.tick()
+    sim.submit(
+        PodGroup(name="hi", queue="default", min_member=2, priority=1000),
+        [Pod(name=f"hi-{i}", priority=1000,
+             request={"cpu": 2000, "memory": 4 * GI, "pods": 1})
+         for i in range(2)],
+    )
+    ssn = run_cycle(cache, ["allocate", "preempt"])
+    assert len(ssn.evicted) == 2  # budget doesn't cover unlabeled pods

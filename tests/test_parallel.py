@@ -56,8 +56,120 @@ def test_sharded_preempt_matches_unsharded():
     )
 
 
+def test_sharded_reclaim_matches_unsharded():
+    from kube_batch_tpu.actions.reclaim import make_reclaim_solver
+
+    plain, sharded = _solve_both(2, make_reclaim_solver)
+    np.testing.assert_array_equal(
+        np.asarray(plain.task_state), np.asarray(sharded.task_state)
+    )
+
+
+def test_sharded_backfill_matches_unsharded():
+    from kube_batch_tpu.actions.backfill import make_backfill_solver
+
+    plain, sharded = _solve_both(2, make_backfill_solver)
+    np.testing.assert_array_equal(
+        np.asarray(plain.task_state), np.asarray(sharded.task_state)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(plain.task_node), np.asarray(sharded.task_node)
+    )
+
+
+def test_sharded_full_pipeline_matches_unsharded():
+    """The fused four-action cycle — the production dispatch — sharded
+    vs unsharded on an oversubscribed world (config 4 scaled down so
+    preempt/reclaim actually fire)."""
+    from kube_batch_tpu.actions.fused import make_full_pipeline
+
+    cache, _sim = build_config(2)
+    snap, _meta = pack_snapshot(cache.snapshot())
+    policy, _ = build_policy(default_conf())
+    cycle = jax.jit(make_full_pipeline(policy))
+
+    state0 = init_state(snap)
+    plain, plain_ev, plain_ready = cycle(snap, state0)
+
+    mesh = make_mesh(8)
+    snap_s, state_s = shard_cycle_inputs(snap, init_state(snap), mesh)
+    shard, shard_ev, shard_ready = cycle(snap_s, state_s)
+
+    np.testing.assert_array_equal(
+        np.asarray(plain.task_state), np.asarray(shard.task_state)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(plain_ready), np.asarray(shard_ready)
+    )
+    for name in plain_ev:
+        np.testing.assert_array_equal(
+            np.asarray(plain_ev[name]), np.asarray(shard_ev[name])
+        )
+
+
+def test_sharded_solve_at_2048_nodes():
+    """One sharded allocate at a node count where sharding matters:
+    2048 padded nodes over 8 devices (256 rows per shard)."""
+    from kube_batch_tpu.cache.cluster import PodGroup
+    from kube_batch_tpu.models.workloads import DEFAULT_SPEC, GI, _node, _pod
+    from kube_batch_tpu.sim.simulator import make_world
+
+    cache, sim = make_world(DEFAULT_SPEC)
+    for i in range(2048):
+        sim.add_node(_node(f"n{i}", cpu_milli=8000, mem=16 * GI))
+    for j in range(64):
+        sim.submit(
+            PodGroup(name=f"pg{j}", queue="default", min_member=8),
+            [_pod(f"pg{j}-{i}", cpu=2000, mem=4 * GI) for i in range(8)],
+        )
+    snap, meta = pack_snapshot(cache.snapshot())
+    assert snap.num_nodes == 2048  # power of two: shards evenly over 8
+    policy, _ = build_policy(default_conf())
+    solver = jax.jit(make_allocate_solver(policy))
+
+    plain = solver(snap, init_state(snap))
+    mesh = make_mesh(8)
+    snap_s, state_s = shard_cycle_inputs(snap, init_state(snap), mesh)
+    sharded = solver(snap_s, state_s)
+
+    placed = np.sum(
+        np.asarray(plain.task_state)[: meta.num_real_tasks] != 0
+    )
+    assert placed == 512  # every task placed
+    np.testing.assert_array_equal(
+        np.asarray(plain.task_state), np.asarray(sharded.task_state)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(plain.task_node), np.asarray(sharded.task_node)
+    )
+
+
 def test_mesh_device_count_guard():
     import pytest
 
     with pytest.raises(ValueError, match="devices"):
         make_mesh(1024)
+
+
+def test_replication_fallback_is_logged(caplog):
+    """A padded node count that doesn't divide the mesh must fall back
+    to replication LOUDLY (VERDICT r1: don't silently take it)."""
+    import logging
+
+    from kube_batch_tpu.cache.cluster import PodGroup
+    from kube_batch_tpu.models.workloads import DEFAULT_SPEC, GI, _node, _pod
+    from kube_batch_tpu.sim.simulator import make_world
+
+    cache, sim = make_world(DEFAULT_SPEC)
+    for i in range(4):
+        sim.add_node(_node(f"n{i}", cpu_milli=4000, mem=8 * GI))
+    sim.submit(
+        PodGroup(name="pg", queue="default", min_member=2),
+        [_pod(f"p{i}", cpu=1000, mem=1 * GI) for i in range(2)],
+    )
+    snap, _meta = pack_snapshot(cache.snapshot())
+    assert snap.num_nodes == 8  # bucketed: 8 % 3 != 0 for a 3-dev mesh
+    mesh = make_mesh(3)
+    with caplog.at_level(logging.WARNING, logger="kube_batch_tpu.parallel.mesh"):
+        shard_cycle_inputs(snap, init_state(snap), mesh)
+    assert any("FULL REPLICATION" in r.getMessage() for r in caplog.records)

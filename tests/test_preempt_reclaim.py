@@ -261,3 +261,50 @@ def test_allocate_alone_skips_besteffort():
 
     run_cycle(cache, ["allocate"])
     assert sim.binds == []
+
+
+def test_phase2_intra_job_preemption():
+    """Phase 2 (preempt.go's second loop): a job's higher-priority
+    pending task displaces its OWN lower-priority running member —
+    no other job is touched."""
+    cache, sim = make_world(SPEC)
+    sim.add_node(Node(name="n0", allocatable={"cpu": 4000, "memory": 8 * GI, "pods": 110}))
+    sim.add_node(Node(name="n1", allocatable={"cpu": 4000, "memory": 8 * GI, "pods": 110}))
+    # Bystander job fills n1 and runs.
+    sim.submit(
+        PodGroup(name="other", queue="default", min_member=1),
+        _pods("other", 2, cpu=2000, mem=4 * GI, prio=0),
+    )
+    # The mixed job fills n0 with two low-prio members and runs.
+    sim.submit(
+        PodGroup(name="mixed", queue="default", min_member=1),
+        _pods("mixed-lo", 2, cpu=2000, mem=4 * GI, prio=0),
+    )
+    run_cycle(cache, ["allocate"])
+    sim.tick()
+    assert len(sim.binds) == 4
+
+    # A high-priority member of the SAME job arrives; cluster is full.
+    # Phase 1 skips (job is Ready: 2 running >= minMember 1); phase 2
+    # must evict one of mixed's own low-priority members.
+    sim.submit_to_group("mixed", _pods("mixed-hi", 1, cpu=2000, mem=4 * GI, prio=1000))
+    ssn = run_cycle(cache, ["allocate", "preempt"])
+    assert len(ssn.evicted) == 1
+    assert ssn.evicted[0][0].startswith("mixed-lo")
+    assert all(not n.startswith("other") for n, _ in ssn.evicted)
+
+
+def test_phase2_gang_floor_blocks_self_cannibalism():
+    """A gang at exactly minMember may NOT evict its own member for a
+    higher-priority one (gang PreemptableFn veto holds in phase 2)."""
+    cache, sim = make_world(SPEC)
+    sim.add_node(Node(name="n0", allocatable={"cpu": 4000, "memory": 8 * GI, "pods": 110}))
+    sim.submit(
+        PodGroup(name="gang", queue="default", min_member=2),
+        _pods("gang-lo", 2, cpu=2000, mem=4 * GI, prio=0),
+    )
+    run_cycle(cache, ["allocate"])
+    sim.tick()
+    sim.submit_to_group("gang", _pods("gang-hi", 1, cpu=2000, mem=4 * GI, prio=1000))
+    ssn = run_cycle(cache, ["allocate", "preempt"])
+    assert ssn.evicted == []  # ready would drop to 1 < minMember 2

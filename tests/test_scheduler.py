@@ -43,3 +43,50 @@ def test_bad_conf_keeps_previous_policy(tmp_path):
     assert s._actions is good_actions
     conf.write_text("actions: allocate\n")
     s.run_once()  # recovers once conf is fixed
+
+
+def test_conf_hot_reload_prewarms_asynchronously(tmp_path):
+    """An edited conf compiles on a background thread while the OLD
+    policy keeps serving; the swap lands in a later cycle once warm —
+    a steady 1s-period daemon never pays the recompile in-cycle."""
+    import time
+
+    conf = tmp_path / "scheduler.conf"
+    conf.write_text("actions: allocate\n")
+    cache, sim = build_config(1)
+    s = Scheduler(cache, conf_path=str(conf))
+    s.run_once()
+    old_conf = s._conf
+    assert old_conf.actions == ("allocate",)
+
+    conf.write_text("actions: allocate, backfill\n")
+    s.run_once()  # kicks off the prewarm; old policy may still serve
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline and s._conf.actions != (
+        "allocate", "backfill",
+    ):
+        s.run_once()
+        time.sleep(0.05)
+    assert s._conf.actions == ("allocate", "backfill")
+    assert s._pending is None  # warm adopted and cleared
+
+
+def test_conf_edit_during_warm_restarts_prewarm(tmp_path):
+    """A second edit while a warm is in flight discards the stale
+    pending build and warms the newest conf."""
+    import time
+
+    conf = tmp_path / "scheduler.conf"
+    conf.write_text("actions: allocate\n")
+    cache, _sim = build_config(1)
+    s = Scheduler(cache, conf_path=str(conf))
+    s.run_once()
+
+    conf.write_text("actions: allocate, backfill\n")
+    s.run_once()
+    conf.write_text("actions: backfill\n")  # editor saves again
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline and s._conf.actions != ("backfill",):
+        s.run_once()
+        time.sleep(0.05)
+    assert s._conf.actions == ("backfill",)

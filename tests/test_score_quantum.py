@@ -1,0 +1,132 @@
+"""Quantify score-quantum divergence from serial semantics.
+
+VERDICT r1 weak #5: the auction floors state-dependent scores to a
+quantum so near-equal nodes tie and spread (ops/assignment.py ·
+allocate_rounds); the design bounds per-task divergence from the serial
+choice to one quantum but round 1 never measured placement quality at a
+shape where it could bite — many tasks, one node strictly better than
+the rest.  These tests pin the bound down.
+"""
+
+import dataclasses
+
+import numpy as np
+import jax
+
+from kube_batch_tpu.actions import BUILTIN_ACTIONS  # noqa: F401
+from kube_batch_tpu.actions.allocate import make_allocate_solver
+from kube_batch_tpu.api.resource import ResourceSpec
+from kube_batch_tpu.cache.cluster import Node, Pod, PodGroup
+from kube_batch_tpu.cache.packer import pack_snapshot
+from kube_batch_tpu.framework.conf import default_conf
+from kube_batch_tpu.framework.session import build_policy
+from kube_batch_tpu.models.workloads import GI
+from kube_batch_tpu.ops.assignment import init_state
+from kube_batch_tpu.plugins import BUILTIN_PLUGINS  # noqa: F401
+from kube_batch_tpu.sim.oracle import serial_allocate, snapshot_to_numpy
+from kube_batch_tpu.sim.simulator import make_world
+
+SPEC = ResourceSpec(("cpu", "memory", "pods", "accelerator"))
+
+
+def _dominant_node_world(n_small=7, n_tasks=24):
+    """One big nearly-empty node (serial's repeated best pick) + small
+    nodes within a quantum of it."""
+    cache, sim = make_world(SPEC)
+    sim.add_node(Node(
+        name="big", allocatable={"cpu": 64000, "memory": 256 * GI, "pods": 110},
+    ))
+    for i in range(n_small):
+        sim.add_node(Node(
+            name=f"s{i}",
+            allocatable={"cpu": 16000, "memory": 64 * GI, "pods": 110},
+        ))
+    sim.submit(
+        PodGroup(name="j", queue="default", min_member=1),
+        [Pod(name=f"p{i}", request={"cpu": 2000, "memory": 8 * GI, "pods": 1})
+         for i in range(n_tasks)],
+    )
+    return cache
+
+
+def _solve_kernel(cache):
+    snap, meta = pack_snapshot(cache.snapshot())
+    policy, _ = build_policy(default_conf())
+    out = jax.jit(make_allocate_solver(policy))(snap, init_state(snap))
+    return snap, meta, policy, out
+
+
+def test_placement_count_matches_serial_oracle():
+    """Quantization may move WHICH node a task takes (within a quantum)
+    but must never schedule fewer tasks than the serial loop."""
+    cache = _dominant_node_world()
+    snap, meta, policy, out = _solve_kernel(cache)
+    kernel_placed = int(np.sum(
+        np.asarray(out.task_state)[: meta.num_real_tasks] != 0
+    ))
+    oracle = serial_allocate(snapshot_to_numpy(snap, meta))
+    oracle_placed = int(np.sum(oracle["assigned"] >= 0))
+    assert kernel_placed == oracle_placed == 24
+
+
+def test_score_divergence_bounded_by_quantum():
+    """Replay the kernel's placements serially (rank order, evolving
+    capacities — the serial reference's trajectory over the SAME
+    choices) and assert each chosen node scores within ~one quantum of
+    the best feasible node at that moment.  This is the measured form
+    of the design claim in ops/assignment.py · allocate_rounds: score
+    flooring bounds per-task divergence from serial semantics to the
+    quantum (plus same-round capacity drift, < one more quantum at
+    these shapes)."""
+    cache = _dominant_node_world()
+    snap, meta, policy, out = _solve_kernel(cache)
+    Tn, Nn = meta.num_real_tasks, meta.num_real_nodes
+    task_state = np.asarray(out.task_state)[:Tn]
+    task_node = np.asarray(out.task_node)[:Tn]
+    rank = np.asarray(policy.rank_fn(snap, init_state(snap)))[:Tn]
+    req = np.asarray(snap.task_req)[:Tn]
+    eps = np.asarray(snap.eps)
+    quantum = policy.score_quantum
+    assert quantum > 0  # default conf registers state-dependent scores
+
+    placed = [t for t in range(Tn) if task_state[t] != 0]
+    placed.sort(key=lambda t: rank[t])
+    state = init_state(snap)
+    worst_gap = 0.0
+    for t in placed:
+        score = np.asarray(policy.score_fn(snap, state))   # current capacities
+        idle = np.asarray(state.node_idle)[:Nn]
+        feasible = np.all(
+            (req[t][None, :] <= idle) | (req[t] < eps), axis=1
+        )
+        n = int(task_node[t])
+        assert feasible[n], (t, n)  # replay must be self-consistent
+        gap = float(score[t, :Nn][feasible].max() - score[t, n])
+        worst_gap = max(worst_gap, gap)
+        # apply the placement and continue the trajectory
+        new_idle = np.asarray(state.node_idle).copy()
+        new_idle[n] -= req[t]
+        import jax.numpy as jnp
+
+        state = state.replace(node_idle=jnp.asarray(new_idle))
+    assert worst_gap <= 2 * quantum + 1e-5, worst_gap
+
+
+def test_packing_quality_not_degraded_under_pressure():
+    """Under tight capacity (total demand == total capacity) the
+    quantized auction still fills the cluster completely — divergence
+    must cost placements nothing even when every slot matters."""
+    cache, sim = make_world(SPEC)
+    for i in range(4):
+        sim.add_node(Node(
+            name=f"n{i}",
+            allocatable={"cpu": 8000, "memory": 32 * GI, "pods": 110},
+        ))
+    sim.submit(
+        PodGroup(name="j", queue="default", min_member=1),
+        [Pod(name=f"p{i}", request={"cpu": 2000, "memory": 8 * GI, "pods": 1})
+         for i in range(16)],  # exactly fills 4 nodes
+    )
+    snap, meta, policy, out = _solve_kernel(cache)
+    placed = int(np.sum(np.asarray(out.task_state)[: meta.num_real_tasks] != 0))
+    assert placed == 16

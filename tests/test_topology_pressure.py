@@ -1,0 +1,273 @@
+"""Topology-key inter-pod affinity + node pressure predicate tests.
+
+Reference behaviors: plugins/predicates/predicates.go — the vendored
+inter-pod affinity predicate's arbitrary topologyKey support
+(zone-level co-location/anti-affinity) and the optional
+CheckNodeMemoryPressure / DiskPressure / PIDPressure predicates toggled
+by `predicate.*PressureEnable` Arguments.
+"""
+
+import dataclasses
+
+from kube_batch_tpu.actions import BUILTIN_ACTIONS  # noqa: F401
+from kube_batch_tpu.api.resource import ResourceSpec
+from kube_batch_tpu.cache.cluster import Node, Pod, PodGroup
+from kube_batch_tpu.framework.conf import PluginConf, SchedulerConf, TierConf, default_conf
+from kube_batch_tpu.framework.plugin import get_action
+from kube_batch_tpu.framework.session import (
+    build_policy,
+    close_session,
+    open_session,
+)
+from kube_batch_tpu.models.workloads import GI
+from kube_batch_tpu.plugins import BUILTIN_PLUGINS  # noqa: F401
+from kube_batch_tpu.sim.simulator import make_world
+
+SPEC = ResourceSpec(("cpu", "memory", "pods", "accelerator"))
+
+
+def run_cycle(cache, actions=("allocate",), conf=None):
+    conf = conf or dataclasses.replace(default_conf(), actions=tuple(actions))
+    policy, plugins = build_policy(conf)
+    acts = [get_action(n) for n in conf.actions]
+    for a in acts:
+        a.initialize(policy)
+    ssn = open_session(cache, policy, plugins)
+    for a in acts:
+        a.execute(ssn)
+    close_session(ssn)
+    return ssn
+
+
+def _zone_world(n_zones=2, nodes_per_zone=2):
+    cache, sim = make_world(SPEC)
+    for z in range(n_zones):
+        for i in range(nodes_per_zone):
+            sim.add_node(Node(
+                name=f"z{z}-n{i}",
+                allocatable={"cpu": 8000, "memory": 16 * GI, "pods": 110},
+                labels={"zone": f"az-{z}", "disk": "ssd"},
+            ))
+    return cache, sim
+
+
+def _binds_by_pod(ssn):
+    return dict(ssn.bound)
+
+
+def test_zone_level_affinity_colocates_across_nodes():
+    """'zone:app=db' affinity is satisfied by a resident in the SAME
+    ZONE even on a DIFFERENT node — exactly what node-level terms
+    cannot express."""
+    cache, sim = _zone_world()
+    sim.submit(
+        PodGroup(name="db", queue="default", min_member=1),
+        [Pod(name="db-0", request={"cpu": 1000, "memory": 2 * GI, "pods": 1},
+             labels={"app": "db"})],
+    )
+    ssn1 = run_cycle(cache)
+    db_node = _binds_by_pod(ssn1)["db-0"]
+    db_zone = db_node.split("-")[0]
+    sim.tick()
+
+    # Fill the db node completely so the web pod CANNOT land there.
+    sim.submit(
+        PodGroup(name="fill", queue="default", min_member=1),
+        [Pod(name="fill-0", request={"cpu": 7000, "memory": 14 * GI, "pods": 1},
+             selector={"zone": f"az-{db_zone[1:]}"})],
+    )
+    # (fill targets the db zone; whichever node it takes, force the db
+    # node full by also filling the other zone node via direct request)
+    ssn2 = run_cycle(cache)
+    sim.tick()
+
+    sim.submit(
+        PodGroup(name="web", queue="default", min_member=1),
+        [Pod(name="web-0", request={"cpu": 1000, "memory": 2 * GI, "pods": 1},
+             affinity=frozenset({"zone:app=db"}))],
+    )
+    ssn3 = run_cycle(cache)
+    web_node = _binds_by_pod(ssn3).get("web-0")
+    assert web_node is not None, "zone affinity should be satisfiable"
+    assert web_node.split("-")[0] == db_zone  # same zone, any node
+
+
+def test_zone_level_affinity_blocks_other_zone():
+    """With the anchor in zone 0 and zone 0 FULL, a zone-affine pod
+    must stay pending rather than land in zone 1."""
+    cache, sim = _zone_world()
+    sim.submit(
+        PodGroup(name="db", queue="default", min_member=1),
+        [Pod(name="db-0", request={"cpu": 1000, "memory": 2 * GI, "pods": 1},
+             labels={"app": "db"}, selector={"zone": "az-0"})],
+    )
+    run_cycle(cache)
+    sim.tick()
+    # Fill ALL of zone 0.
+    sim.submit(
+        PodGroup(name="fill", queue="default", min_member=1),
+        [Pod(name=f"fill-{i}", request={"cpu": 7000, "memory": 13 * GI, "pods": 1},
+             selector={"zone": "az-0"}) for i in range(2)]
+        + [Pod(name="fill-rest",
+               request={"cpu": 1000, "memory": 1 * GI, "pods": 1},
+               selector={"zone": "az-0"})],
+    )
+    run_cycle(cache)
+    sim.tick()
+
+    sim.submit(
+        PodGroup(name="web", queue="default", min_member=1),
+        [Pod(name="web-0", request={"cpu": 4000, "memory": 4 * GI, "pods": 1},
+             affinity=frozenset({"zone:app=db"}))],
+    )
+    ssn = run_cycle(cache)
+    assert "web-0" not in _binds_by_pod(ssn)  # zone 1 has room but no anchor
+
+
+def test_zone_level_anti_affinity_spreads_zones():
+    """Two 'zone:app=web' anti-affine pods land in DIFFERENT zones,
+    not merely different nodes."""
+    cache, sim = _zone_world(n_zones=2, nodes_per_zone=2)
+    sim.submit(
+        PodGroup(name="web", queue="default", min_member=2),
+        [Pod(name=f"web-{i}",
+             request={"cpu": 1000, "memory": 2 * GI, "pods": 1},
+             labels={"app": "web"},
+             anti_affinity=frozenset({"zone:app=web"}))
+         for i in range(2)],
+    )
+    ssn = run_cycle(cache)
+    binds = _binds_by_pod(ssn)
+    assert len(binds) == 2
+    zones = {n.split("-")[0] for n in binds.values()}
+    assert len(zones) == 2, f"both in one zone: {binds}"
+
+
+def test_zone_anti_affinity_third_pod_pending():
+    """Three zone-anti pods over two zones: only two can place."""
+    cache, sim = _zone_world(n_zones=2, nodes_per_zone=2)
+    sim.submit(
+        PodGroup(name="web", queue="default", min_member=2),
+        [Pod(name=f"web-{i}",
+             request={"cpu": 1000, "memory": 2 * GI, "pods": 1},
+             labels={"app": "web"},
+             anti_affinity=frozenset({"zone:app=web"}))
+         for i in range(3)],
+    )
+    ssn = run_cycle(cache)
+    assert len(ssn.bound) == 2
+
+
+def test_node_level_terms_still_work_alongside_topo():
+    """A snapshot mixing node-level and zone-level terms applies each
+    at its own scope."""
+    cache, sim = _zone_world(n_zones=1, nodes_per_zone=2)
+    sim.submit(
+        PodGroup(name="pair", queue="default", min_member=2),
+        [
+            Pod(name="a", request={"cpu": 1000, "memory": 2 * GI, "pods": 1},
+                labels={"app": "a"}),
+            # node-level anti vs a: must take the OTHER node (same zone ok)
+            Pod(name="b", request={"cpu": 1000, "memory": 2 * GI, "pods": 1},
+                labels={"app": "b"}, anti_affinity=frozenset({"app=a"})),
+        ],
+    )
+    ssn = run_cycle(cache)
+    binds = _binds_by_pod(ssn)
+    assert len(binds) == 2
+    assert binds["a"] != binds["b"]
+
+
+def _pressure_conf(**extra_args):
+    args = tuple(extra_args.items())
+    return SchedulerConf(
+        actions=("allocate",),
+        tiers=(
+            TierConf(plugins=(
+                PluginConf(name="priority"),
+                PluginConf(name="gang"),
+            )),
+            TierConf(plugins=(
+                PluginConf(name="predicates", arguments=args),
+                PluginConf(name="nodeorder"),
+            )),
+        ),
+    )
+
+
+def test_pressure_predicates_off_by_default():
+    """Without the *PressureEnable Arguments, pressured nodes still
+    accept pods (upstream default)."""
+    cache, sim = make_world(SPEC)
+    sim.add_node(Node(
+        name="n0", allocatable={"cpu": 4000, "memory": 8 * GI, "pods": 110},
+        memory_pressure=True, disk_pressure=True, pid_pressure=True,
+    ))
+    sim.submit(
+        PodGroup(name="j", queue="default", min_member=1),
+        [Pod(name="p0", request={"cpu": 1000, "memory": 2 * GI, "pods": 1})],
+    )
+    ssn = run_cycle(cache, conf=_pressure_conf())
+    assert ("p0", "n0") in ssn.bound
+
+
+def test_memory_pressure_enable_excludes_node():
+    cache, sim = make_world(SPEC)
+    sim.add_node(Node(
+        name="bad", allocatable={"cpu": 4000, "memory": 8 * GI, "pods": 110},
+        memory_pressure=True,
+    ))
+    sim.add_node(Node(
+        name="good", allocatable={"cpu": 4000, "memory": 8 * GI, "pods": 110},
+    ))
+    sim.submit(
+        PodGroup(name="j", queue="default", min_member=1),
+        [Pod(name="p0", request={"cpu": 1000, "memory": 2 * GI, "pods": 1})],
+    )
+    conf = _pressure_conf(**{"predicate.MemoryPressureEnable": True})
+    ssn = run_cycle(cache, conf=conf)
+    assert dict(ssn.bound)["p0"] == "good"
+
+
+def test_disk_and_pid_pressure_toggles():
+    cache, sim = make_world(SPEC)
+    sim.add_node(Node(
+        name="diskbad", allocatable={"cpu": 4000, "memory": 8 * GI, "pods": 110},
+        disk_pressure=True,
+    ))
+    sim.add_node(Node(
+        name="pidbad", allocatable={"cpu": 4000, "memory": 8 * GI, "pods": 110},
+        pid_pressure=True,
+    ))
+    sim.submit(
+        PodGroup(name="j", queue="default", min_member=1),
+        [Pod(name="p0", request={"cpu": 1000, "memory": 2 * GI, "pods": 1}),
+         Pod(name="p1", request={"cpu": 1000, "memory": 2 * GI, "pods": 1})],
+    )
+    conf = _pressure_conf(**{
+        "predicate.DiskPressureEnable": True,
+        "predicate.PidPressureEnable": True,
+    })
+    ssn = run_cycle(cache, conf=conf)
+    assert ssn.bound == []  # both nodes excluded, both pods pending
+
+
+def test_zone_anti_spread_one_per_zone_at_width():
+    """8 zone-anti pods over 8 zones all place in ONE cycle, one per
+    zone — the per-DOMAIN serialization lets distinct domains accept in
+    the same auction round (a global rule would still converge, but
+    this pins the semantics: exactly one winner per zone)."""
+    cache, sim = _zone_world(n_zones=8, nodes_per_zone=2)
+    sim.submit(
+        PodGroup(name="web", queue="default", min_member=8),
+        [Pod(name=f"web-{i}",
+             request={"cpu": 1000, "memory": 2 * GI, "pods": 1},
+             labels={"app": "web"},
+             anti_affinity=frozenset({"zone:app=web"}))
+         for i in range(8)],
+    )
+    ssn = run_cycle(cache)
+    binds = _binds_by_pod(ssn)
+    assert len(binds) == 8
+    zones = [n.split("-")[0] for n in binds.values()]
+    assert len(set(zones)) == 8, binds

@@ -1,0 +1,154 @@
+"""VolumeBinder seam + volume predicate tests.
+
+Reference behaviors: cache/interface.go · VolumeBinder (the fourth
+side-effect interface, called before the pod bind) and the pv/pvc/sc
+informers in cache/cache.go feeding volume-aware placement.
+"""
+
+import dataclasses
+
+from kube_batch_tpu.actions import BUILTIN_ACTIONS  # noqa: F401
+from kube_batch_tpu.api.resource import ResourceSpec
+from kube_batch_tpu.cache.backend import (
+    FakeBinder,
+    FakeEvictor,
+    FakeVolumeBinder,
+)
+from kube_batch_tpu.cache.cache import SchedulerCache
+from kube_batch_tpu.cache.cluster import (
+    Claim,
+    Node,
+    Pod,
+    PodGroup,
+    StorageClass,
+)
+from kube_batch_tpu.framework.conf import default_conf
+from kube_batch_tpu.framework.plugin import get_action
+from kube_batch_tpu.framework.session import (
+    build_policy,
+    close_session,
+    open_session,
+)
+from kube_batch_tpu.models.workloads import GI
+from kube_batch_tpu.plugins import BUILTIN_PLUGINS  # noqa: F401
+from kube_batch_tpu.sim.simulator import make_world
+
+SPEC = ResourceSpec(("cpu", "memory", "pods", "accelerator"))
+
+
+def run_cycle(cache, actions=("allocate",)):
+    conf = dataclasses.replace(default_conf(), actions=tuple(actions))
+    policy, plugins = build_policy(conf)
+    acts = [get_action(n) for n in conf.actions]
+    for a in acts:
+        a.initialize(policy)
+    ssn = open_session(cache, policy, plugins)
+    for a in acts:
+        a.execute(ssn)
+    close_session(ssn)
+    return ssn
+
+
+def _nodes(sim, n=2, **labels_per_idx):
+    for i in range(n):
+        sim.add_node(Node(
+            name=f"n{i}",
+            allocatable={"cpu": 8000, "memory": 16 * GI, "pods": 110},
+            labels=labels_per_idx.get(f"n{i}", {}),
+        ))
+
+
+def test_bound_claim_pins_pod_to_node():
+    cache, sim = make_world(SPEC)
+    _nodes(sim, 3)
+    sim.add_claim(Claim(name="data", bound_node="n2"))
+    sim.submit(
+        PodGroup(name="j", queue="default", min_member=1),
+        [Pod(name="p0", request={"cpu": 1000, "memory": 2 * GI, "pods": 1},
+             claims=frozenset({"data"}))],
+    )
+    ssn = run_cycle(cache)
+    assert dict(ssn.bound)["p0"] == "n2"
+
+
+def test_storage_class_restricts_to_labeled_nodes():
+    cache, sim = make_world(SPEC)
+    cache_nodes = {
+        "n0": {"disk": "hdd"},
+        "n1": {"disk": "ssd"},
+    }
+    for name, labels in cache_nodes.items():
+        sim.add_node(Node(
+            name=name,
+            allocatable={"cpu": 8000, "memory": 16 * GI, "pods": 110},
+            labels=labels,
+        ))
+    sim.add_storage_class(StorageClass(
+        name="fast", allowed_node_labels=frozenset({"disk=ssd"}),
+    ))
+    sim.add_claim(Claim(name="scratch", storage_class="fast"))
+    sim.submit(
+        PodGroup(name="j", queue="default", min_member=1),
+        [Pod(name="p0", request={"cpu": 1000, "memory": 2 * GI, "pods": 1},
+             claims=frozenset({"scratch"}))],
+    )
+    ssn = run_cycle(cache)
+    assert dict(ssn.bound)["p0"] == "n1"
+
+
+def test_unsatisfiable_claim_diagnosed_pending():
+    """A claim no node can satisfy keeps the pod pending and shows up
+    in the why-unschedulable events (fit_errors)."""
+    cache, sim = make_world(SPEC)
+    _nodes(sim, 2)
+    sim.add_claim(Claim(name="ghost", bound_node="gone-node"))
+    sim.submit(
+        PodGroup(name="j", queue="default", min_member=1),
+        [Pod(name="p0", request={"cpu": 1000, "memory": 2 * GI, "pods": 1},
+             claims=frozenset({"ghost"}))],
+    )
+    ssn = run_cycle(cache)
+    assert ssn.bound == []
+    assert any("p0" in e for e in cache.events)
+
+
+def test_unknown_claim_is_infeasible():
+    cache, sim = make_world(SPEC)
+    _nodes(sim, 1)
+    sim.submit(
+        PodGroup(name="j", queue="default", min_member=1),
+        [Pod(name="p0", request={"cpu": 1000, "memory": 2 * GI, "pods": 1},
+             claims=frozenset({"never-created"}))],
+    )
+    ssn = run_cycle(cache)
+    assert ssn.bound == []
+
+
+def test_volume_binder_called_before_bind_and_failure_resyncs():
+    binder, evictor, vb = FakeBinder(), FakeEvictor(), FakeVolumeBinder()
+    cache = SchedulerCache(
+        SPEC, binder=binder, evictor=evictor, volume_binder=vb
+    )
+    cache.add_node(Node(
+        name="n0", allocatable={"cpu": 8000, "memory": 16 * GI, "pods": 110},
+    ))
+    cache.add_claim(Claim(name="data", bound_node="n0"))
+    cache.add_pod_group(PodGroup(name="j", queue="default", min_member=1))
+    pod_ok = Pod(name="ok", group="j",
+                 request={"cpu": 1000, "memory": 2 * GI, "pods": 1},
+                 claims=frozenset({"data"}))
+    pod_bad = Pod(name="bad", group="j",
+                  request={"cpu": 1000, "memory": 2 * GI, "pods": 1},
+                  claims=frozenset({"data"}))
+    cache.add_pod(pod_ok)
+    cache.add_pod(pod_bad)
+    vb.fail_pods.add("bad")
+
+    assert cache.bind(pod_ok.uid, "n0") is True
+    assert ("ok", "n0") in vb.bound        # volumes bound through the seam
+    assert ("ok", "n0") in binder.binds
+
+    assert cache.bind(pod_bad.uid, "n0") is False
+    assert ("bad", "n0") not in binder.binds  # pod bind never attempted
+    assert cache.drain_resync() == [pod_bad.uid]
+    assert pod_bad.status.name == "PENDING"
