@@ -9,7 +9,9 @@ scoring and conflict resolution ride ICI collectives emitted by XLA
 """
 
 from kube_batch_tpu.parallel.mesh import (  # noqa: F401
+    DCN_AXIS,
     NODE_AXIS,
     make_mesh,
+    make_multislice_mesh,
     shard_cycle_inputs,
 )

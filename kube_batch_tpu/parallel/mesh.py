@@ -38,6 +38,61 @@ def make_mesh(n_devices: int | None = None, axis: str = NODE_AXIS) -> Mesh:
     return Mesh(devices, (axis,))
 
 
+DCN_AXIS = "slice"
+
+
+def make_multislice_mesh(
+    n_slices: int,
+    chips_per_slice: int,
+    axis: str = NODE_AXIS,
+    dcn_axis: str = DCN_AXIS,
+) -> Mesh:
+    """A 2-D (slice × chip) mesh for multi-slice scale-out — the DCN
+    story SURVEY §2.11 gates on scale.
+
+    The node axis of every tensor shards over BOTH mesh axes jointly
+    (see shard_cycle_inputs): contiguous node blocks live within one
+    slice, so the heavy [T, N]-blocked work's reductions run over ICI
+    and only the small cross-slice combining (global argmax/watermark
+    scalars) crosses DCN.  On real multi-slice hardware build the
+    device array with `jax.experimental.mesh_utils.
+    create_hybrid_device_mesh` so rows align with physical slices; on a
+    virtual CPU mesh a plain reshape stands in.
+    """
+    import numpy as np
+
+    devices = jax.devices()
+    need = n_slices * chips_per_slice
+    if len(devices) < need:
+        raise ValueError(
+            f"need {need} devices ({n_slices}×{chips_per_slice}), "
+            f"have {len(devices)}"
+        )
+    # Group by physical slice when the platform exposes it: mesh rows
+    # MUST align with slices or the bulk reductions cross DCN and the
+    # 2-D layout defeats its own purpose.  Virtual CPU devices carry no
+    # slice identity; a plain reshape stands in there.
+    slice_ids = {getattr(d, "slice_index", None) for d in devices[:need]}
+    if None not in slice_ids:
+        by_slice: dict = {}
+        for d in devices:
+            by_slice.setdefault(d.slice_index, []).append(d)
+        rows = sorted(by_slice)[:n_slices]
+        if len(rows) < n_slices or any(
+            len(by_slice[s]) < chips_per_slice for s in rows
+        ):
+            raise ValueError(
+                f"cannot form {n_slices}×{chips_per_slice}: physical "
+                f"slices are {[(s, len(v)) for s, v in sorted(by_slice.items())]}"
+            )
+        grid = np.asarray(
+            [by_slice[s][:chips_per_slice] for s in rows], dtype=object
+        )
+    else:
+        grid = np.asarray(devices[:need]).reshape(n_slices, chips_per_slice)
+    return Mesh(grid, (dcn_axis, axis))
+
+
 def _node_sharded_fields(obj: Any, num_nodes: int) -> dict[str, bool]:
     """Which dataclass fields have a leading node dimension?"""
     out = {}
@@ -58,16 +113,28 @@ def shard_cycle_inputs(snap, state, mesh: Mesh, axis: str = NODE_AXIS):
     of two).
     """
     n = snap.num_nodes
-    divisible = n % mesh.shape[axis] == 0
-    if not divisible:
+    # Multi-axis meshes (multi-slice: ("slice", "node")) shard the node
+    # dimension over ALL axes jointly — slice-major blocks over DCN,
+    # chip blocks over ICI.  Degrade in steps: joint sharding; then the
+    # intra-slice axis only (replicate across slices — still full ICI
+    # parallelism); then, loudly, full replication.
+    multi = len(mesh.axis_names) > 1
+    total = 1
+    for name in mesh.axis_names:
+        total *= mesh.shape[name]
+    if n % total == 0:
+        node_spec = P(tuple(mesh.axis_names) if multi else axis)
+    elif multi and n % mesh.shape[axis] == 0:
+        node_spec = P(axis)  # per-slice sharding, cross-slice replication
+    else:
         import logging
 
         logging.getLogger(__name__).warning(
-            "padded node count %d not divisible by mesh axis %r (%d devices);"
+            "padded node count %d not divisible by mesh %r (%d devices);"
             " falling back to FULL REPLICATION — no node-axis parallelism",
-            n, axis, mesh.shape[axis],
+            n, dict(mesh.shape), total,
         )
-    node_spec = P(axis) if divisible else P()
+        node_spec = P()
     repl = NamedSharding(mesh, P())
     node_sh = NamedSharding(mesh, node_spec)
 
