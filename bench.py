@@ -257,7 +257,7 @@ def run_config(jax, n: int, timed_iters: int = 8) -> dict:
     state0 = init_state(snap)
 
     t0 = time.perf_counter()
-    state, evict_masks, job_ready = cycle_fn(snap, state0)
+    state, evict_masks, _job_ready, _diag = cycle_fn(snap, state0)
     final = np.asarray(state.task_state)
     compile_s = time.perf_counter() - t0
     _log(f"  config {n}: first solve (incl compile) {compile_s:.1f}s")
@@ -276,7 +276,7 @@ def run_config(jax, n: int, timed_iters: int = 8) -> dict:
     times = []
     for _ in range(timed_iters):
         t0 = time.perf_counter()
-        st, _, _ = cycle_fn(snap, state0)
+        st, _, _, _ = cycle_fn(snap, state0)
         np.asarray(st.task_state[:8])  # D2H fence
         times.append(time.perf_counter() - t0)
     solve_s = float(np.median(times)) if times else compile_s

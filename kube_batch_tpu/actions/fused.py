@@ -30,7 +30,10 @@ from kube_batch_tpu.ops.assignment import AllocState
 
 
 def make_cycle_solver(policy, action_names: Sequence[str]):
-    """(snap, state) -> (state, evict_masks, job_ready) — the full cycle.
+    """(snap, state) -> (state, evict_masks, job_ready, diag) — the
+    full cycle: final AllocState, per-evicting-action RELEASING masks,
+    the gang commit gate, and the why-unschedulable failure tallies
+    (fit_errors.failure_counts), all in ONE dispatch.
 
     Solvers come from the action REGISTRY (each fuseable Action class
     exposes `solver_factory`), so a custom action registered under a
@@ -65,7 +68,19 @@ def make_cycle_solver(policy, action_names: Sequence[str]):
                     & snap.task_mask
                 )
         job_ready = policy.job_ready_mask(snap, state)
-        return state, evict_masks, job_ready
+        # The why-unschedulable diagnosis rides the SAME program: a
+        # separate jitted diagnosis would be a second large [T, N]
+        # compile in-process, which the tunneled backend cannot survive
+        # at flagship shapes (see bench.py's subprocess-isolation note;
+        # an in-daemon second compile hangs the serving loop).  The
+        # extra reductions cost a few HBM passes inside an
+        # already-dispatched cycle.
+        from kube_batch_tpu.framework.fit_errors import failure_counts
+
+        mask = policy.predicate_mask(snap)
+        dyn = policy.dynamic_predicate_fn(snap, state, immediate=True)
+        diag = failure_counts(snap, state, mask if dyn is None else mask & dyn)
+        return state, evict_masks, job_ready, diag
 
     return cycle
 

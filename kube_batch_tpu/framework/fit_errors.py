@@ -108,25 +108,33 @@ def diagnose_pending(ssn, max_events: int = 1000) -> list[tuple[str, str]]:
     )[0]
     if pending.size == 0:
         return []
-    # One jitted dispatch for the whole diagnosis (predicate mask
-    # included): eager per-reduction dispatches would each pay the
-    # tunneled backend's fixed per-dispatch RTT (see bench.py notes).
-    policy = ssn.policy
-    diag = getattr(policy, "_diagnose_jit", None)
-    if diag is None:
-        import jax
+    # The fused cycle precomputes the tallies inside ITS dispatch
+    # (actions/fused.py) — compiling a separate diagnosis program here
+    # would be a second large in-process compile, which hangs the
+    # tunneled backend at flagship shapes.  Only the per-action
+    # fallback path (custom actions, small worlds) jits its own.
+    if ssn._diag is not None:
+        counts = {k: np.asarray(v) for k, v in ssn._diag.items()}
+    else:
+        policy = ssn.policy
+        diag = getattr(policy, "_diagnose_jit", None)
+        if diag is None:
+            import jax
 
-        def full_mask(s, st):
-            m = policy.predicate_mask(s)
-            # immediate=True: diagnose against the same mask the Idle
-            # pass refused with (incl. anti-affinity vs RELEASING
-            # residents), so "why pending" matches the actual refusal.
-            dyn = policy.dynamic_predicate_fn(s, st, immediate=True)
-            return m if dyn is None else m & dyn
+            def full_mask(s, st):
+                m = policy.predicate_mask(s)
+                # immediate=True: diagnose against the same mask the
+                # Idle pass refused with (incl. anti-affinity vs
+                # RELEASING residents), so "why pending" matches the
+                # actual refusal.
+                dyn = policy.dynamic_predicate_fn(s, st, immediate=True)
+                return m if dyn is None else m & dyn
 
-        diag = jax.jit(lambda s, st: failure_counts(s, st, full_mask(s, st)))
-        policy._diagnose_jit = diag
-    counts = {k: np.asarray(v) for k, v in diag(snap, state).items()}
+            diag = jax.jit(
+                lambda s, st: failure_counts(s, st, full_mask(s, st))
+            )
+            policy._diagnose_jit = diag
+        counts = {k: np.asarray(v) for k, v in diag(snap, state).items()}
     out: list[tuple[str, str]] = []
     for t in pending[:max_events]:
         pod = ssn.meta.task_pods[t]

@@ -64,9 +64,16 @@ class Session:
         self.policy = policy
         self.plugins = list(plugins)
 
-        self.host = cache.snapshot()
+        # Shared snapshot + pack as ONE critical section: the packer
+        # reads live Pod fields, so it must finish under the cache lock
+        # (≙ the reference holding its mutex for the whole Snapshot deep
+        # copy).  This removes the per-pod copy loop — the single
+        # largest host cost of a cycle at 50k pods — while keeping the
+        # adapter thread's mutations strictly before-or-after the view.
         with metrics.snapshot_pack_latency.time():
-            self.snap, self.meta = pack_snapshot(self.host)
+            with cache.lock():
+                self.host = cache.snapshot(shared=True)
+                self.snap, self.meta = pack_snapshot(self.host)
         self.state: AllocState = init_state(self.snap)
         self.initial_task_state = np.asarray(self.snap.task_state)
 
@@ -81,6 +88,7 @@ class Session:
         # diagnosis, the loop's result label) reuses it instead of
         # paying another full D2H transfer on the tunneled backend.
         self._host_task_state: np.ndarray | None = None
+        self._diag = None  # precomputed diagnosis (fused cycle only)
 
     def host_task_state(self) -> np.ndarray:
         """i32[T] host copy of the live task_state (cached; call only
@@ -99,6 +107,12 @@ class Session:
 
     def set_job_ready(self, mask: np.ndarray) -> None:
         self._job_ready = np.asarray(mask)
+
+    def set_diagnosis(self, diag) -> None:
+        """Why-unschedulable failure tallies computed inside the fused
+        cycle's dispatch (see actions/fused.py) — diagnose_pending uses
+        them instead of compiling a second device program."""
+        self._diag = diag
 
     # -- commit funnels -------------------------------------------------
     def commit_evictions(self, victim_idx: Sequence[int], reason: str) -> None:
@@ -137,6 +151,24 @@ class Session:
         return self.bound
 
     # -- introspection for plugins' close hooks ------------------------
+    def snapshot_ready_counts(self) -> np.ndarray:
+        """i32[J]: ready members per job AS OF THE PACKED SNAPSHOT —
+        computed from the frozen tensor copy, not live Pod statuses
+        (the shared snapshot's pods keep mutating after the lock is
+        released; see cache.snapshot(shared=True))."""
+        from kube_batch_tpu.api.types import READY_STATUSES
+
+        ready = np.isin(
+            self.initial_task_state,
+            [int(s) for s in READY_STATUSES],
+        )
+        task_job = np.asarray(self.snap.task_job)
+        J = int(self.snap.num_jobs)
+        valid = ready & (task_job >= 0)
+        return np.bincount(
+            task_job[valid], minlength=J
+        ).astype(np.int64)[:J]
+
     def unready_jobs(self) -> list[str]:
         """Names of jobs that wanted resources but failed the gang gate."""
         ready = self.job_ready()

@@ -76,7 +76,9 @@ def make_multislice_mesh(
     if None not in slice_ids:
         by_slice: dict = {}
         for d in devices:
-            by_slice.setdefault(d.slice_index, []).append(d)
+            sid = getattr(d, "slice_index", None)
+            if sid is not None:  # heterogeneous lists: skip unsliced devices
+                by_slice.setdefault(sid, []).append(d)
         rows = sorted(by_slice)[:n_slices]
         if len(rows) < n_slices or any(
             len(by_slice[s]) < chips_per_slice for s in rows

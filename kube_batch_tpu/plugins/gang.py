@@ -13,6 +13,7 @@ Reference counterpart: plugins/gang/gang.go —
 from __future__ import annotations
 
 import jax.numpy as jnp
+import numpy as np
 
 from kube_batch_tpu.api.snapshot import job_ready_counts, job_valid_counts
 from kube_batch_tpu.framework.plugin import Plugin, register_plugin
@@ -70,13 +71,19 @@ class GangPlugin(Plugin):
         recorder/condition funnels — never private cache state."""
         from kube_batch_tpu.api.types import PodGroupCondition
 
+        # Counts come from the frozen packed snapshot, not live Pod
+        # statuses — the shared snapshot's pods keep mutating after the
+        # cycle's lock is released (session.snapshot_ready_counts).
+        ready_counts = ssn.snapshot_ready_counts()
+        job_min = np.asarray(ssn.snap.job_min)
+        name_to_idx = {n: i for i, n in enumerate(ssn.meta.job_names)}
         for name in ssn.unready_jobs():
-            job = ssn.host.jobs.get(name)
-            if job is None:
+            j = name_to_idx.get(name)
+            if j is None:
                 continue
             msg = (
-                f"gang unschedulable: job {name} has {job.ready_task_num} ready, "
-                f"needs minMember {job.min_available}"
+                f"gang unschedulable: job {name} has {int(ready_counts[j])} "
+                f"ready, needs minMember {int(job_min[j])}"
             )
             ssn.cache.record_event("PodGroup", name, "Unschedulable", msg)
             ssn.cache.add_job_condition(

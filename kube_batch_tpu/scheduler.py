@@ -202,9 +202,12 @@ class Scheduler:
         from kube_batch_tpu.actions.preempt import commit_victim_indices
 
         with metrics.action_latency.time("fused"):
-            state, evict_masks, job_ready = self._cycle(ssn.snap, ssn.state)
+            state, evict_masks, job_ready, diag = self._cycle(
+                ssn.snap, ssn.state
+            )
             ssn.state = state
             ssn.set_job_ready(np.asarray(job_ready))
+            ssn.set_diagnosis(diag)
             from kube_batch_tpu.framework.plugin import get_action
 
             for name in self._conf.actions:

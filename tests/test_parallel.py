@@ -89,11 +89,11 @@ def test_sharded_full_pipeline_matches_unsharded():
     cycle = jax.jit(make_full_pipeline(policy))
 
     state0 = init_state(snap)
-    plain, plain_ev, plain_ready = cycle(snap, state0)
+    plain, plain_ev, plain_ready, _ = cycle(snap, state0)
 
     mesh = make_mesh(8)
     snap_s, state_s = shard_cycle_inputs(snap, init_state(snap), mesh)
-    shard, shard_ev, shard_ready = cycle(snap_s, state_s)
+    shard, shard_ev, shard_ready, _ = cycle(snap_s, state_s)
 
     np.testing.assert_array_equal(
         np.asarray(plain.task_state), np.asarray(shard.task_state)
