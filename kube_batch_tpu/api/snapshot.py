@@ -74,6 +74,7 @@ class SnapshotTensors:
     # snapshot and kernels skip the domain math entirely.
     task_aff_topo: jax.Array   # f32[T, K2]  required co-location, by domain
     task_anti_topo: jax.Array  # f32[T, K2]  anti-affinity, by domain
+    task_podpref_topo: jax.Array  # f32[T, K2 | 0]  preferred co-location, weighted, by domain (zero-width when no soft topo prefs)
     topo_term_key: jax.Array   # i32[K2]     term → topology-key index
     topo_term_label: jax.Array  # i32[K2]    term → pod-label index (in K)
     node_key_domain: jax.Array  # i32[N, TK] node → domain id per topology key
@@ -115,9 +116,11 @@ class SnapshotTensors:
     ns_mask: jax.Array         # bool[S]
 
     # -- pod disruption budgets (B = PDB vocab; ≙ JobInfo.PDB) ----------
-    # task_pdb: index of the (first) PDB whose selector matches the
-    # pod's labels, NONE_IDX if none.
-    task_pdb: jax.Array        # i32[T]
+    # task_pdbs: multi-hot of EVERY PDB whose selector matches the pod's
+    # labels — a pod under several budgets is evictable only if ALL of
+    # them survive (intersection semantics, matching how the reference
+    # would veto a victim under any one violated budget).
+    task_pdbs: jax.Array       # f32[T, B]
     pdb_min: jax.Array         # i32[B]     minAvailable floors
 
     # -- cluster --------------------------------------------------------
@@ -163,6 +166,8 @@ class SnapshotTensors:
             self.node_key_domain.shape[1],
             self.domain_mask.shape[0],
             self.task_vol_groups.shape[1],
+            self.task_pdbs.shape[1],
+            self.task_podpref_topo.shape[1],
         )
 
 

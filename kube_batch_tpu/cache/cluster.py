@@ -55,9 +55,9 @@ class Pod:
     # domain; `anti_affinity` terms forbid any such resident (and
     # symmetrically, a resident's anti term blocks newcomers matching
     # it); `pod_prefs` are soft co-location terms with weights (the
-    # InterPodAffinityPriority analog; node-level terms only — a
-    # topology-scoped pref is warned about and ignored).  Term syntax
-    # for affinity/anti_affinity:
+    # InterPodAffinityPriority analog; node-level AND topology-scoped
+    # terms — "zone:app=web" scores the whole zone's residents).  Term
+    # syntax for affinity/anti_affinity/pod_prefs:
     #   "key=value"            topologyKey = the node itself (hostname)
     #   "zone:key=value"       topologyKey = node label "zone" — the
     #                          domain is all nodes sharing that label's

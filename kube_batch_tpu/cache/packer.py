@@ -125,20 +125,11 @@ def pack_snapshot(host: HostSnapshot) -> tuple[SnapshotTensors, SnapshotMeta]:
         if pod.anti_affinity:
             _intern_terms(pod.anti_affinity)
         if pod.pod_prefs:
-            for term in pod.pod_prefs:
-                if split_topo_term(term)[0] is not None:
-                    # Soft co-location is node-level only for now; a
-                    # silently-dead vocab entry would be worse than a
-                    # visible warning.
-                    import logging
-
-                    logging.getLogger(__name__).warning(
-                        "pod %s: topology-scoped soft preference %r is "
-                        "not supported (node-level terms only); ignored",
-                        pod.name, term,
-                    )
-                else:
-                    podlabels.add(term)
+            # Soft co-location terms intern exactly like the hard ones:
+            # node-level terms into the pod-label vocab, topology-scoped
+            # terms ("zone:app=web") into the topo-term vocab — scored
+            # per DOMAIN by nodeorder's pod_affinity_score.
+            _intern_terms(pod.pod_prefs)
     # Storage-class allowed labels enter the node-label vocab so volume
     # feasibility is one more multi-hot product.
     constrained_claims: list[str] = []
@@ -248,11 +239,15 @@ def pack_snapshot(host: HostSnapshot) -> tuple[SnapshotTensors, SnapshotMeta]:
     task_aff = _multi_hot(aff_rows, T, K)
     task_anti = _multi_hot(anti_rows, T, K)
     task_podpref = np.zeros((T, K), dtype=np.float32)
+    podpref_topo_entries: list[tuple[int, int, float]] = []  # (row, term, w)
     for i, p in enumerate(tasks):
         if p.pod_prefs:
             for term, w in p.pod_prefs.items():
-                if term in pl_idx:  # topo-scoped prefs warned+dropped above
-                    task_podpref[i, pl_idx[term]] = w
+                tk, lab = split_topo_term(term)
+                if tk is None:
+                    task_podpref[i, pl_idx[lab]] = w
+                else:
+                    podpref_topo_entries.append((i, tt_idx[(tk, lab)], w))
 
     # -- job tensors ----------------------------------------------------
     job_queue = np.array(
@@ -346,6 +341,14 @@ def pack_snapshot(host: HostSnapshot) -> tuple[SnapshotTensors, SnapshotMeta]:
         ), K2)
         task_aff_topo = _multi_hot(aff_topo_rows, T, K2)
         task_anti_topo = _multi_hot(anti_topo_rows, T, K2)
+        # Zero-width when no task carries a soft topo pref, so snapshots
+        # using only HARD topo terms statically skip the extra domain
+        # scoring matmul (same convention as every other optional vocab).
+        task_podpref_topo = np.zeros(
+            (T, K2 if podpref_topo_entries else 0), np.float32
+        )
+        for row, term, w in podpref_topo_entries:
+            task_podpref_topo[row, term] = w
         domain_mask_np = np.zeros(Dp, bool)
         domain_mask_np[:D_real] = True
     else:  # static zero-width: kernels skip all domain math
@@ -355,6 +358,7 @@ def pack_snapshot(host: HostSnapshot) -> tuple[SnapshotTensors, SnapshotMeta]:
         topo_term_label = np.zeros(0, np.int32)
         task_aff_topo = np.zeros((T, 0), np.float32)
         task_anti_topo = np.zeros((T, 0), np.float32)
+        task_podpref_topo = np.zeros((T, 0), np.float32)
         domain_mask_np = np.zeros(0, bool)
 
     # -- volume feasibility (claims → pins / allowed-label groups) ------
@@ -413,11 +417,11 @@ def pack_snapshot(host: HostSnapshot) -> tuple[SnapshotTensors, SnapshotMeta]:
         dtype=np.float32,
     )
 
-    # -- PDBs: first matching budget per pod (multi-PDB pods keep the
-    # first by name order; documented simplification) -------------------
+    # -- PDBs: EVERY matching budget per pod (intersection semantics —
+    # a pod under several budgets is evictable only if all survive) ----
     pdb_names = sorted(host.pdbs)
     Bp = bucket(len(pdb_names)) if pdb_names else 0
-    task_pdb = np.full(T, NONE_IDX, np.int32)
+    task_pdbs = np.zeros((T, Bp), np.float32)
     if pdb_names:
         pdb_objs = [host.pdbs[n] for n in pdb_names]
         for ti, pod in enumerate(tasks):
@@ -425,8 +429,7 @@ def pack_snapshot(host: HostSnapshot) -> tuple[SnapshotTensors, SnapshotMeta]:
                 continue
             for bi, pdb in enumerate(pdb_objs):
                 if pdb.selector and pdb.matches(pod):
-                    task_pdb[ti] = bi
-                    break
+                    task_pdbs[ti, bi] = 1.0
     pdb_min = np.array(
         [host.pdbs[n].min_available for n in pdb_names], dtype=np.int32
     )
@@ -450,6 +453,7 @@ def pack_snapshot(host: HostSnapshot) -> tuple[SnapshotTensors, SnapshotMeta]:
         task_podpref=jnp.asarray(pad_rows(task_podpref, Tp)),
         task_aff_topo=jnp.asarray(pad_rows(task_aff_topo, Tp)),
         task_anti_topo=jnp.asarray(pad_rows(task_anti_topo, Tp)),
+        task_podpref_topo=jnp.asarray(pad_rows(task_podpref_topo, Tp)),
         topo_term_key=jnp.asarray(topo_term_key),
         topo_term_label=jnp.asarray(topo_term_label),
         node_key_domain=jnp.asarray(
@@ -486,7 +490,7 @@ def pack_snapshot(host: HostSnapshot) -> tuple[SnapshotTensors, SnapshotMeta]:
         task_ns=jnp.asarray(pad_rows(task_ns, Tp, NONE_IDX)),
         ns_weight=jnp.asarray(pad_rows(ns_weight, Sp)),
         ns_mask=jnp.asarray(pad_rows(np.ones(S, bool), Sp, False)),
-        task_pdb=jnp.asarray(pad_rows(task_pdb, Tp, NONE_IDX)),
+        task_pdbs=jnp.asarray(pad_rows(task_pdbs, Tp)),
         pdb_min=jnp.asarray(pad_rows(pdb_min, Bp) if Bp else pdb_min),
         cluster_total=jnp.asarray(node_cap.sum(axis=0).astype(np.float32)),
         eps=jnp.asarray(spec.eps.astype(np.float32)),

@@ -89,13 +89,28 @@ class NodeOrderPlugin(Plugin):
 
         def pod_affinity_score(snap, state):
             """Preferred co-location (≙ InterPodAffinityPriority):
-            weighted sum of soft terms matched by the node's residents,
-            normalized to MAX_SCORE."""
-            from kube_batch_tpu.plugins.predicates import resident_podlabels
+            weighted sum of soft terms matched by the node's residents —
+            node-level terms against the node's own residents, topology-
+            scoped terms ("zone:app=web") against the residents of the
+            node's DOMAIN under that key — normalized to MAX_SCORE over
+            the task's total preference weight."""
+            from kube_batch_tpu.plugins.predicates import (
+                resident_domain_labels,
+                resident_podlabels,
+            )
 
             Hb, _ = resident_podlabels(snap, state)
             raw = snap.task_podpref @ Hb.astype(jnp.float32).T  # f32[T,N]
-            denom = jnp.maximum(jnp.sum(snap.task_podpref, axis=1), 1e-9)
+            total_w = jnp.sum(snap.task_podpref, axis=1)
+            if snap.task_podpref_topo.shape[1]:  # static: topo terms exist
+                Hd, _ = resident_domain_labels(snap, state)
+                A = snap.node_key_domain[:, snap.topo_term_key]  # i32[N,K2]
+                present = Hd[A, snap.topo_term_label[None, :]]   # bool[N,K2]
+                raw = raw + snap.task_podpref_topo @ present.astype(
+                    jnp.float32
+                ).T
+                total_w = total_w + jnp.sum(snap.task_podpref_topo, axis=1)
+            denom = jnp.maximum(total_w, 1e-9)
             return raw / denom[:, None] * MAX_SCORE
 
         if w_least:

@@ -6,11 +6,13 @@ reclaim victims — plain pods matching a budget's selector may not be
 evicted below its minAvailable.  The gang plugin provides the analogous
 floor for gang members; this plugin covers everything else.
 
-Tensor shape: the packer resolves each pod's (first) matching budget
-into `task_pdb` (i32[T]) and the floors into `pdb_min` (i32[B]); the
-veto is then one segment count + gather per sweep step, recomputed
-against the LIVE state so cumulative evictions within one Statement
-keep respecting the floor.
+Tensor shape: the packer resolves each pod's matching budgets into the
+multi-hot `task_pdbs` (f32[T, B] — ALL budgets whose selector matches,
+not just the first) and the floors into `pdb_min` (i32[B]); the veto is
+then one matmul per sweep step, recomputed against the LIVE state so
+cumulative evictions within one Statement keep respecting every floor.
+A pod under several budgets is evictable only if ALL of them survive
+the eviction (intersection semantics).
 """
 
 from __future__ import annotations
@@ -23,17 +25,12 @@ from kube_batch_tpu.framework.plugin import Plugin, register_plugin
 
 
 def pdb_healthy_counts(snap, state) -> jax.Array:
-    """i32[B]: currently-healthy (resource-holding) members per budget."""
-    B = snap.pdb_min.shape[0]
+    """i32[B]: currently-healthy (resource-holding) members per budget.
+    A pod belonging to several budgets counts toward each of them."""
     member = (
-        allocated_mask(state.task_state)
-        & snap.task_mask
-        & (snap.task_pdb >= 0)
-    )
-    seg = jnp.where(member, jnp.clip(snap.task_pdb, 0, B - 1), B)
-    return jax.ops.segment_sum(
-        jnp.ones_like(seg, dtype=jnp.int32), seg, num_segments=B + 1
-    )[:B]
+        allocated_mask(state.task_state) & snap.task_mask
+    ).astype(snap.task_pdbs.dtype)
+    return (member @ snap.task_pdbs).astype(jnp.int32)  # f32[T] @ f32[T,B]
 
 
 @register_plugin
@@ -46,9 +43,12 @@ class PdbPlugin(Plugin):
             if B == 0:  # static: no budgets in this snapshot
                 return jnp.ones(snap.num_tasks, bool)
             healthy = pdb_healthy_counts(snap, state)
-            tb = jnp.clip(snap.task_pdb, 0, B - 1)
-            survives = healthy[tb] - 1 >= snap.pdb_min[tb]
-            return survives | (snap.task_pdb < 0)
+            # A budget is "at the floor" when losing one more member
+            # would violate it; a task survives the veto only if NONE of
+            # its budgets are at the floor (intersection over budgets).
+            at_floor = (healthy - 1 < snap.pdb_min).astype(snap.task_pdbs.dtype)
+            violated = snap.task_pdbs @ at_floor  # f32[T]
+            return violated <= 0.5
 
         if self.enabled_for("preemptable"):
             policy.add_preemptable_fn(tier, veto)

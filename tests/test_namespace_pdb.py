@@ -164,3 +164,49 @@ def test_unlabeled_pods_not_covered_by_pdb():
     )
     ssn = run_cycle(cache, ["allocate", "preempt"])
     assert len(ssn.evicted) == 2  # budget doesn't cover unlabeled pods
+
+
+def _running_world_with_two_pdbs(floor_a: int, floor_b: int):
+    """Two plain pods carrying BOTH labels (app=web + tier=fe), covered
+    by two different budgets; a high-prio gang wants their capacity."""
+    cache, sim = make_world(SPEC)
+    sim.add_node(Node(
+        name="n0", allocatable={"cpu": 4000, "memory": 8 * GI, "pods": 110},
+    ))
+    sim.add_pdb(PodDisruptionBudget(
+        name="a-web", min_available=floor_a, selector={"app": "web"},
+    ))
+    sim.add_pdb(PodDisruptionBudget(
+        name="b-fe", min_available=floor_b, selector={"tier": "fe"},
+    ))
+    sim.submit(
+        PodGroup(name="web", queue="default", min_member=1),
+        [Pod(name=f"web-{i}", labels={"app": "web", "tier": "fe"},
+             request={"cpu": 2000, "memory": 4 * GI, "pods": 1})
+         for i in range(2)],
+    )
+    run_cycle(cache)
+    sim.tick()
+    sim.submit(
+        PodGroup(name="hi", queue="default", min_member=1, priority=1000),
+        [Pod(name="hi-0", priority=1000,
+             request={"cpu": 2000, "memory": 4 * GI, "pods": 1})],
+    )
+    return cache, sim
+
+
+def test_multi_pdb_intersection_blocks_eviction():
+    """A pod under TWO budgets is evictable only if ALL survive: the
+    name-first budget (a-web) would allow one eviction, but the second
+    (b-fe, floor 2) must still veto it — first-match-only semantics
+    would wrongly evict here."""
+    cache, _sim = _running_world_with_two_pdbs(floor_a=1, floor_b=2)
+    ssn = run_cycle(cache, ["allocate", "preempt"])
+    assert ssn.evicted == []
+
+
+def test_multi_pdb_allows_eviction_when_all_floors_permit():
+    cache, _sim = _running_world_with_two_pdbs(floor_a=1, floor_b=1)
+    ssn = run_cycle(cache, ["allocate", "preempt"])
+    assert len(ssn.evicted) == 1
+    assert ssn.evicted[0][0].startswith("web")

@@ -271,3 +271,41 @@ def test_zone_anti_spread_one_per_zone_at_width():
     assert len(binds) == 8
     zones = [n.split("-")[0] for n in binds.values()]
     assert len(set(zones)) == 8, binds
+
+
+def test_topology_scoped_soft_preference_spreads_to_zone():
+    """'zone:app=cache' as a SOFT preference (pod_prefs) steers the pod
+    into the cache pod's ZONE even when (a) the cache node itself is
+    full and (b) least-requested would prefer the emptier other zone —
+    exactly what node-level soft terms cannot express."""
+    cache, sim = _zone_world()
+    sim.submit(
+        PodGroup(name="cache", queue="default", min_member=1),
+        [Pod(name="cache-0", labels={"app": "cache"},
+             selector={"zone": "az-0"},
+             request={"cpu": 7000, "memory": 14 * GI, "pods": 1})],
+    )
+    ssn1 = run_cycle(cache)
+    cache_node = _binds_by_pod(ssn1)["cache-0"]
+    assert cache_node.startswith("z0")
+    other_zone0 = "z0-n1" if cache_node == "z0-n0" else "z0-n0"
+    sim.tick()
+
+    # Make the zone-0 companion node LESS attractive to least-requested
+    # than the empty zone-1 nodes, so only the domain-scoped preference
+    # can pull the web pod there.
+    sim.submit(
+        PodGroup(name="filler", queue="default", min_member=1),
+        [Pod(name="filler-0", selector={"zone": "az-0"},
+             request={"cpu": 500, "memory": 1 * GI, "pods": 1})],
+    )
+    run_cycle(cache)
+    sim.tick()
+
+    sim.submit(
+        PodGroup(name="web", queue="default", min_member=1),
+        [Pod(name="web-0", pod_prefs={"zone:app=cache": 10.0},
+             request={"cpu": 1000, "memory": 2 * GI, "pods": 1})],
+    )
+    ssn = run_cycle(cache)
+    assert _binds_by_pod(ssn)["web-0"] == other_zone0
