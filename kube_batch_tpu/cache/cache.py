@@ -46,6 +46,42 @@ from kube_batch_tpu.cache.info import JobInfo, NodeInfo, QueueInfo
 DEFAULT_QUEUE = "default"
 
 
+class PackDirty:
+    """Per-consumer change journal between two tensor packs.
+
+    The incremental packer (cache/incremental.py) registers one of these
+    via `SchedulerCache.register_dirty_listener`; every cache mutation
+    records the minimal fact the packer needs to patch the previous
+    pack's arrays instead of rebuilding them.  `full` is the safety
+    hatch: any mutation whose tensor effect isn't row-local (object-set
+    or vocabulary changes) forces the next pack to rebuild from scratch.
+    All mutations happen under the cache lock; the packer drains the
+    journal under the same lock.
+    """
+
+    __slots__ = ("full", "full_reason", "status_pods", "nodes",
+                 "added_pods", "deleted_pods", "added_jobs")
+
+    def __init__(self) -> None:
+        self.clear()
+        self.full = True               # nothing packed yet
+        self.full_reason = "initial"
+
+    def clear(self) -> None:
+        self.full = False
+        self.full_reason = ""
+        self.status_pods: set[str] = set()     # pod uids
+        self.nodes: set[str] = set()           # node names
+        self.added_pods: list[str] = []        # pod uids, arrival order
+        self.deleted_pods: list[str] = []      # pod uids
+        self.added_jobs: list[str] = []        # group names (new or updated)
+
+    def mark_full(self, reason: str) -> None:
+        if not self.full:
+            self.full = True
+            self.full_reason = reason
+
+
 @dataclasses.dataclass
 class HostSnapshot:
     """Consistent host-side copy of the cache (≙ api.ClusterInfo)."""
@@ -99,8 +135,46 @@ class SchedulerCache:
         # repeats aggregate into one record's count (k8s-style).
         self.events: collections.deque = collections.deque(maxlen=10000)
         self._event_index: dict[tuple, object] = {}
+        # Change journals for incremental packers (see PackDirty).
+        self._dirty_listeners: list[PackDirty] = []
 
         self.add_queue(Queue(name=default_queue, weight=1.0))
+
+    # -- incremental-pack change journal --------------------------------
+
+    def register_dirty_listener(self) -> PackDirty:
+        """Create + register a change journal; the caller (an
+        IncrementalPacker) drains it under the cache lock at pack time."""
+        with self._lock:
+            d = PackDirty()
+            self._dirty_listeners.append(d)
+            return d
+
+    def _mark_full(self, reason: str) -> None:
+        for d in self._dirty_listeners:
+            d.mark_full(reason)
+
+    def _mark_status(self, uid: str) -> None:
+        for d in self._dirty_listeners:
+            d.status_pods.add(uid)
+
+    def _mark_node(self, name: str | None) -> None:
+        if name is None:
+            return
+        for d in self._dirty_listeners:
+            d.nodes.add(name)
+
+    def _mark_pod_added(self, uid: str) -> None:
+        for d in self._dirty_listeners:
+            d.added_pods.append(uid)
+
+    def _mark_pod_deleted(self, uid: str) -> None:
+        for d in self._dirty_listeners:
+            d.deleted_pods.append(uid)
+
+    def _mark_job_added(self, name: str) -> None:
+        for d in self._dirty_listeners:
+            d.added_jobs.append(name)
 
     # -- events (≙ cache.go · Recorder) ---------------------------------
 
@@ -171,6 +245,8 @@ class SchedulerCache:
                 job.add_task(pod)
             if pod.node is not None:
                 self._node(pod.node).add_task(pod)
+            self._mark_pod_added(pod.uid)
+            self._mark_node(pod.node)
 
     def delete_pod(self, pod_uid: str) -> None:
         with self._lock:
@@ -181,6 +257,8 @@ class SchedulerCache:
                 self._jobs[pod.group].remove_task(pod)
             if pod.node is not None and pod.node in self._nodes:
                 self._nodes[pod.node].remove_task(pod)
+            self._mark_pod_deleted(pod.uid)
+            self._mark_node(pod.node)
 
     def update_pod_status(
         self, pod_uid: str, status: TaskStatus, node: str | None = None
@@ -194,6 +272,7 @@ class SchedulerCache:
                 return
             if pod.node is not None and pod.node in self._nodes:
                 self._nodes[pod.node].remove_task(pod)
+            self._mark_node(pod.node)
             pod.status = status
             if node is not None:
                 pod.node = node
@@ -204,12 +283,15 @@ class SchedulerCache:
                     self._nodes[pod.node].add_task(pod)
                 else:  # node vanished under the pod
                     pod.node = None
+            self._mark_status(pod_uid)
+            self._mark_node(pod.node)
 
     def add_node(self, node: Node) -> None:
         with self._lock:
             if node.name in self._nodes:
                 raise ValueError(f"node {node.name} already cached")
             self._nodes[node.name] = NodeInfo(spec=self.spec, node=node)
+            self._mark_full("node-added")
 
     def update_node(self, node: Node) -> None:
         """Replace a node's API object (readiness/labels/taints/
@@ -220,10 +302,23 @@ class SchedulerCache:
             info = self._nodes.get(node.name)
             if info is None:
                 self._nodes[node.name] = NodeInfo(spec=self.spec, node=node)
+                self._mark_full("node-added")
             else:
+                old = info.node
                 info.node = node
                 info.allocatable = self.spec.vec(node.allocatable)
                 info.idle = info.allocatable - info.used
+                # Label/taint changes shift vocabularies (and topology
+                # domains); a readiness flip changes the packed node SET
+                # (snapshot filters unready nodes) — both need a rebuild.
+                if (
+                    dict(old.labels) != dict(node.labels)
+                    or set(old.taints) != set(node.taints)
+                    or old.ready != node.ready
+                ):
+                    self._mark_full("node-object-changed")
+                else:
+                    self._mark_node(node.name)
 
     def delete_node(self, name: str) -> None:
         with self._lock:
@@ -233,63 +328,82 @@ class SchedulerCache:
                 for pod in info.tasks.values():
                     pod.node = None
                     pod.status = TaskStatus.PENDING
+                self._mark_full("node-deleted")
 
     def add_pod_group(self, group: PodGroup) -> None:
         with self._lock:
             queue = group.queue or self.default_queue
             existing = self._jobs.get(group.name)
             if existing is not None:
+                if existing.queue != queue:
+                    self._mark_full("job-queue-changed")
+                else:
+                    self._mark_job_added(group.name)
                 existing.pod_group = group
                 existing.queue = queue
             else:
                 self._jobs[group.name] = JobInfo(
                     spec=self.spec, pod_group=group, queue=queue
                 )
+                self._mark_job_added(group.name)
 
     def delete_pod_group(self, name: str) -> None:
         with self._lock:
-            self._jobs.pop(name, None)
+            if self._jobs.pop(name, None) is not None:
+                self._mark_full("job-deleted")
 
     def add_queue(self, queue: Queue) -> None:
         with self._lock:
+            old = self._queues.get(queue.name)
             self._queues[queue.name] = QueueInfo(queue=queue)
+            if old is None or old.weight != queue.weight:
+                self._mark_full("queue-changed")
 
     def delete_queue(self, name: str) -> None:
         with self._lock:
-            self._queues.pop(name, None)
+            if self._queues.pop(name, None) is not None:
+                self._mark_full("queue-deleted")
 
     # -- volume objects (≙ the pv/pvc/sc informers of cache.go) ---------
     def add_claim(self, claim: Claim) -> None:
         with self._lock:
             self._claims[claim.name] = claim
+            self._mark_full("claim-changed")
 
     def delete_claim(self, name: str) -> None:
         with self._lock:
-            self._claims.pop(name, None)
+            if self._claims.pop(name, None) is not None:
+                self._mark_full("claim-deleted")
 
     def add_storage_class(self, sc: StorageClass) -> None:
         with self._lock:
             self._storage_classes[sc.name] = sc
+            self._mark_full("storage-class-changed")
 
     def delete_storage_class(self, name: str) -> None:
         with self._lock:
-            self._storage_classes.pop(name, None)
+            if self._storage_classes.pop(name, None) is not None:
+                self._mark_full("storage-class-deleted")
 
     def add_namespace(self, ns: Namespace) -> None:
         with self._lock:
             self._namespaces[ns.name] = ns
+            self._mark_full("namespace-changed")
 
     def delete_namespace(self, name: str) -> None:
         with self._lock:
-            self._namespaces.pop(name, None)
+            if self._namespaces.pop(name, None) is not None:
+                self._mark_full("namespace-deleted")
 
     def add_pdb(self, pdb: PodDisruptionBudget) -> None:
         with self._lock:
             self._pdbs[pdb.name] = pdb
+            self._mark_full("pdb-changed")
 
     def delete_pdb(self, name: str) -> None:
         with self._lock:
-            self._pdbs.pop(name, None)
+            if self._pdbs.pop(name, None) is not None:
+                self._mark_full("pdb-deleted")
 
     def _node(self, name: str) -> NodeInfo:
         info = self._nodes.get(name)

@@ -48,6 +48,27 @@ class SnapshotMeta:
         return len(self.node_names)
 
 
+@dataclasses.dataclass
+class PackInternals:
+    """Everything the incremental packer needs to patch a previous pack
+    in place: the PADDED host-side numpy arrays that produced the device
+    snapshot (same values, mutable), plus the intern tables.  Only
+    produced by `pack_snapshot_full`."""
+
+    arrays: dict[str, "np.ndarray"]    # SnapshotTensors field → padded array
+    task_uids: list[str]
+    task_pods: list
+    job_names: list[str]
+    node_names: list[str]
+    queue_names: list[str]
+    ns_names: list[str]
+    pdb_names: list[str]
+    lab_idx: dict[str, int]
+    tnt_idx: dict[str, int]
+    prt_idx: dict[int, int]
+    pl_idx: dict[str, int]
+
+
 def _multi_hot(items_per_row: list[list[int]], rows: int, width: int) -> np.ndarray:
     out = np.zeros((rows, width), dtype=np.float32)
     for i, items in enumerate(items_per_row):
@@ -70,6 +91,13 @@ def split_topo_term(term: str) -> tuple[str | None, str]:
 
 
 def pack_snapshot(host: HostSnapshot) -> tuple[SnapshotTensors, SnapshotMeta]:
+    snap, meta, _ = pack_snapshot_full(host)
+    return snap, meta
+
+
+def pack_snapshot_full(
+    host: HostSnapshot,
+) -> tuple[SnapshotTensors, SnapshotMeta, PackInternals]:
     spec = host.spec
 
     queue_names = sorted(host.queues)
@@ -434,68 +462,65 @@ def pack_snapshot(host: HostSnapshot) -> tuple[SnapshotTensors, SnapshotMeta]:
         [host.pdbs[n].min_available for n in pdb_names], dtype=np.int32
     )
 
-    snap = SnapshotTensors(
-        task_req=jnp.asarray(pad_rows(task_req, Tp)),
-        task_state=jnp.asarray(pad_rows(task_state, Tp)),
-        task_job=jnp.asarray(pad_rows(np.array(task_job, np.int32), Tp, NONE_IDX)),
-        task_node=jnp.asarray(pad_rows(task_node, Tp, NONE_IDX)),
-        task_prio=jnp.asarray(pad_rows(task_prio, Tp)),
-        task_order=jnp.asarray(pad_rows(task_order, Tp)),
-        task_mask=jnp.asarray(pad_rows(np.ones(T, bool), Tp, False)),
-        task_sel=jnp.asarray(pad_rows(task_sel, Tp)),
-        task_pref=jnp.asarray(pad_rows(task_pref, Tp)),
-        task_tol=jnp.asarray(pad_rows(task_tol, Tp)),
-        task_ports=jnp.asarray(pad_rows(task_ports, Tp)),
-        task_critical=jnp.asarray(pad_rows(task_critical, Tp, False)),
-        task_podlabels=jnp.asarray(pad_rows(task_podlabels, Tp)),
-        task_aff=jnp.asarray(pad_rows(task_aff, Tp)),
-        task_anti=jnp.asarray(pad_rows(task_anti, Tp)),
-        task_podpref=jnp.asarray(pad_rows(task_podpref, Tp)),
-        task_aff_topo=jnp.asarray(pad_rows(task_aff_topo, Tp)),
-        task_anti_topo=jnp.asarray(pad_rows(task_anti_topo, Tp)),
-        task_podpref_topo=jnp.asarray(pad_rows(task_podpref_topo, Tp)),
-        topo_term_key=jnp.asarray(topo_term_key),
-        topo_term_label=jnp.asarray(topo_term_label),
-        node_key_domain=jnp.asarray(
-            pad_rows(node_key_domain, Np, Dp - 1 if Dp else 0)
+    arrays: dict[str, np.ndarray] = {
+        "task_req": pad_rows(task_req, Tp),
+        "task_state": pad_rows(task_state, Tp),
+        "task_job": pad_rows(np.array(task_job, np.int32), Tp, NONE_IDX),
+        "task_node": pad_rows(task_node, Tp, NONE_IDX),
+        "task_prio": pad_rows(task_prio, Tp),
+        "task_order": pad_rows(task_order, Tp),
+        "task_mask": pad_rows(np.ones(T, bool), Tp, False),
+        "task_sel": pad_rows(task_sel, Tp),
+        "task_pref": pad_rows(task_pref, Tp),
+        "task_tol": pad_rows(task_tol, Tp),
+        "task_ports": pad_rows(task_ports, Tp),
+        "task_critical": pad_rows(task_critical, Tp, False),
+        "task_podlabels": pad_rows(task_podlabels, Tp),
+        "task_aff": pad_rows(task_aff, Tp),
+        "task_anti": pad_rows(task_anti, Tp),
+        "task_podpref": pad_rows(task_podpref, Tp),
+        "task_aff_topo": pad_rows(task_aff_topo, Tp),
+        "task_anti_topo": pad_rows(task_anti_topo, Tp),
+        "task_podpref_topo": pad_rows(task_podpref_topo, Tp),
+        "topo_term_key": topo_term_key,
+        "topo_term_label": topo_term_label,
+        "node_key_domain": pad_rows(node_key_domain, Np, Dp - 1 if Dp else 0),
+        "domain_mask": domain_mask_np,
+        "task_vol_node": pad_rows(task_vol_node, Tp, NONE_IDX),
+        "task_vol_groups": pad_rows(task_vol_groups, Tp),
+        "vol_group_sel": vol_group_sel,
+        "job_queue": pad_rows(job_queue, Jp, NONE_IDX),
+        "job_min": pad_rows(job_min, Jp),
+        "job_prio": pad_rows(job_prio, Jp),
+        "job_order": pad_rows(job_order, Jp),
+        "job_mask": pad_rows(np.ones(J, bool), Jp, False),
+        "node_cap": pad_rows(node_cap, Np),
+        "node_idle": pad_rows(node_idle, Np),
+        "node_releasing": pad_rows(node_rel, Np),
+        "node_labels": pad_rows(node_labels, Np),
+        "node_taints": pad_rows(node_taints, Np),
+        "node_ports": pad_rows(node_ports, Np),
+        "node_ready": pad_rows(
+            np.array([host.nodes[n].node.ready for n in node_names], dtype=bool),
+            Np,
+            False,
         ),
-        domain_mask=jnp.asarray(domain_mask_np),
-        task_vol_node=jnp.asarray(pad_rows(task_vol_node, Tp, NONE_IDX)),
-        task_vol_groups=jnp.asarray(pad_rows(task_vol_groups, Tp)),
-        vol_group_sel=jnp.asarray(vol_group_sel),
-        job_queue=jnp.asarray(pad_rows(job_queue, Jp, NONE_IDX)),
-        job_min=jnp.asarray(pad_rows(job_min, Jp)),
-        job_prio=jnp.asarray(pad_rows(job_prio, Jp)),
-        job_order=jnp.asarray(pad_rows(job_order, Jp)),
-        job_mask=jnp.asarray(pad_rows(np.ones(J, bool), Jp, False)),
-        node_cap=jnp.asarray(pad_rows(node_cap, Np)),
-        node_idle=jnp.asarray(pad_rows(node_idle, Np)),
-        node_releasing=jnp.asarray(pad_rows(node_rel, Np)),
-        node_labels=jnp.asarray(pad_rows(node_labels, Np)),
-        node_taints=jnp.asarray(pad_rows(node_taints, Np)),
-        node_ports=jnp.asarray(pad_rows(node_ports, Np)),
-        node_ready=jnp.asarray(
-            pad_rows(
-                np.array(
-                    [host.nodes[n].node.ready for n in node_names], dtype=bool
-                ),
-                Np,
-                False,
-            )
-        ),
-        node_pressure=jnp.asarray(pad_rows(node_pressure, Np)),
-        node_mask=jnp.asarray(pad_rows(np.ones(N, bool), Np, False)),
-        queue_weight=jnp.asarray(pad_rows(queue_weight, Qp)),
-        queue_mask=jnp.asarray(pad_rows(np.ones(Q, bool), Qp, False)),
-        task_ns=jnp.asarray(pad_rows(task_ns, Tp, NONE_IDX)),
-        ns_weight=jnp.asarray(pad_rows(ns_weight, Sp)),
-        ns_mask=jnp.asarray(pad_rows(np.ones(S, bool), Sp, False)),
-        task_pdbs=jnp.asarray(pad_rows(task_pdbs, Tp)),
-        pdb_min=jnp.asarray(pad_rows(pdb_min, Bp) if Bp else pdb_min),
-        cluster_total=jnp.asarray(node_cap.sum(axis=0).astype(np.float32)),
-        eps=jnp.asarray(spec.eps.astype(np.float32)),
-        besteffort_eps=jnp.asarray(spec.besteffort_eps.astype(np.float32)),
-    )
+        "node_pressure": pad_rows(node_pressure, Np),
+        "node_mask": pad_rows(np.ones(N, bool), Np, False),
+        "queue_weight": pad_rows(queue_weight, Qp),
+        "queue_mask": pad_rows(np.ones(Q, bool), Qp, False),
+        "task_ns": pad_rows(task_ns, Tp, NONE_IDX),
+        "ns_weight": pad_rows(ns_weight, Sp),
+        "ns_mask": pad_rows(np.ones(S, bool), Sp, False),
+        "task_pdbs": pad_rows(task_pdbs, Tp),
+        "pdb_min": pad_rows(pdb_min, Bp) if Bp else pdb_min,
+        "cluster_total": node_cap.sum(axis=0).astype(np.float32)
+        if len(node_names)
+        else np.zeros(spec.num, np.float32),
+        "eps": spec.eps.astype(np.float32),
+        "besteffort_eps": spec.besteffort_eps.astype(np.float32),
+    }
+    snap = SnapshotTensors(**{k: jnp.asarray(v) for k, v in arrays.items()})
     meta = SnapshotMeta(
         spec=spec,
         task_uids=tuple(p.uid for p in tasks),
@@ -508,4 +533,18 @@ def pack_snapshot(host: HostSnapshot) -> tuple[SnapshotTensors, SnapshotMeta]:
         port_vocab=port_vocab,
         podlabel_vocab=podlabel_vocab,
     )
-    return snap, meta
+    internals = PackInternals(
+        arrays=arrays,
+        task_uids=[p.uid for p in tasks],
+        task_pods=list(tasks),
+        job_names=list(job_names),
+        node_names=list(node_names),
+        queue_names=list(queue_names),
+        ns_names=list(ns_names),
+        pdb_names=list(pdb_names),
+        lab_idx=lab_idx,
+        tnt_idx=tnt_idx,
+        prt_idx=prt_idx,
+        pl_idx=pl_idx,
+    )
+    return snap, meta, internals

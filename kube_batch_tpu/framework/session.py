@@ -58,6 +58,7 @@ class Session:
         cache: SchedulerCache,
         policy: TensorPolicy,
         plugins: Sequence[Plugin],
+        packer=None,
     ) -> None:
         self.uid = next(_session_counter)
         self.cache = cache
@@ -70,10 +71,18 @@ class Session:
         # copy).  This removes the per-pod copy loop — the single
         # largest host cost of a cycle at 50k pods — while keeping the
         # adapter thread's mutations strictly before-or-after the view.
+        #
+        # With an IncrementalPacker (the daemon path), the pack itself
+        # is event-driven: only rows whose pods/nodes changed since the
+        # previous cycle are touched (see cache/incremental.py).
         with metrics.snapshot_pack_latency.time():
-            with cache.lock():
-                self.host = cache.snapshot(shared=True)
-                self.snap, self.meta = pack_snapshot(self.host)
+            if packer is not None:
+                self.host = None
+                self.snap, self.meta = packer.pack()
+            else:
+                with cache.lock():
+                    self.host = cache.snapshot(shared=True)
+                    self.snap, self.meta = pack_snapshot(self.host)
         self.state: AllocState = init_state(self.snap)
         self.initial_task_state = np.asarray(self.snap.task_state)
 
@@ -180,10 +189,13 @@ class Session:
 
 
 def open_session(
-    cache: SchedulerCache, policy: TensorPolicy, plugins: Sequence[Plugin]
+    cache: SchedulerCache,
+    policy: TensorPolicy,
+    plugins: Sequence[Plugin],
+    packer=None,
 ) -> Session:
     """≙ framework.go · OpenSession: snapshot + plugin open hooks."""
-    ssn = Session(cache, policy, plugins)
+    ssn = Session(cache, policy, plugins, packer=packer)
     for plugin in ssn.plugins:
         with metrics.plugin_latency.time(plugin.name, "open"):
             plugin.on_session_open(ssn)
