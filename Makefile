@@ -6,11 +6,13 @@ PY ?= python
 
 test: unit-test
 
+# KB_TPU_CHECK_PACK=1: every incremental pack re-verifies itself
+# against the live cache (cache/incremental.py · verify_against_live).
 unit-test:
-	$(PY) -m pytest tests/ -q
+	KB_TPU_CHECK_PACK=1 $(PY) -m pytest tests/ -q
 
 e2e:
-	$(PY) -m pytest tests/test_e2e_pipeline.py tests/test_scheduler.py -q
+	KB_TPU_CHECK_PACK=1 $(PY) -m pytest tests/test_e2e_pipeline.py tests/test_scheduler.py -q
 
 bench:
 	$(PY) bench.py

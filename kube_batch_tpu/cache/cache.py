@@ -22,6 +22,7 @@ import collections
 import copy
 import dataclasses
 import threading
+import weakref
 
 from kube_batch_tpu.api.resource import ResourceSpec
 from kube_batch_tpu.api.types import TaskStatus
@@ -60,7 +61,7 @@ class PackDirty:
     """
 
     __slots__ = ("full", "full_reason", "status_pods", "nodes",
-                 "added_pods", "deleted_pods", "added_jobs")
+                 "added_pods", "deleted_pods", "added_jobs", "__weakref__")
 
     def __init__(self) -> None:
         self.clear()
@@ -136,7 +137,13 @@ class SchedulerCache:
         self.events: collections.deque = collections.deque(maxlen=10000)
         self._event_index: dict[tuple, object] = {}
         # Change journals for incremental packers (see PackDirty).
-        self._dirty_listeners: list[PackDirty] = []
+        # Weakly held: a Scheduler constructs one per IncrementalPacker,
+        # and recreating schedulers on a long-lived cache must not leak
+        # dead journals (every mutation fans out over this set).
+        self._dirty_listeners: weakref.WeakSet[PackDirty] = weakref.WeakSet()
+        # O(1) status census for the idle early-out: pods per TaskStatus,
+        # maintained by every mutator below.
+        self._status_counts: collections.Counter = collections.Counter()
 
         self.add_queue(Queue(name=default_queue, weight=1.0))
 
@@ -144,10 +151,11 @@ class SchedulerCache:
 
     def register_dirty_listener(self) -> PackDirty:
         """Create + register a change journal; the caller (an
-        IncrementalPacker) drains it under the cache lock at pack time."""
+        IncrementalPacker) drains it under the cache lock at pack time.
+        Held weakly — the journal is unregistered by its owner dying."""
         with self._lock:
             d = PackDirty()
-            self._dirty_listeners.append(d)
+            self._dirty_listeners.add(d)
             return d
 
     def _mark_full(self, reason: str) -> None:
@@ -230,6 +238,7 @@ class SchedulerCache:
                 raise ValueError(f"pod {pod.uid} already cached")
             self.spec.pod_vec(pod)  # memoize request vector once, at ingest
             self._pods[pod.uid] = pod
+            self._status_counts[pod.status] += 1
             if pod.group is not None:
                 job = self._jobs.get(pod.group)
                 if job is None:
@@ -253,6 +262,7 @@ class SchedulerCache:
             pod = self._pods.pop(pod_uid, None)
             if pod is None:
                 return
+            self._status_counts[pod.status] -= 1
             if pod.group is not None and pod.group in self._jobs:
                 self._jobs[pod.group].remove_task(pod)
             if pod.node is not None and pod.node in self._nodes:
@@ -273,6 +283,8 @@ class SchedulerCache:
             if pod.node is not None and pod.node in self._nodes:
                 self._nodes[pod.node].remove_task(pod)
             self._mark_node(pod.node)
+            self._status_counts[pod.status] -= 1
+            self._status_counts[status] += 1
             pod.status = status
             if node is not None:
                 pod.node = node
@@ -327,6 +339,8 @@ class SchedulerCache:
                 # Residents lose their placement; they'll be rescheduled.
                 for pod in info.tasks.values():
                     pod.node = None
+                    self._status_counts[pod.status] -= 1
+                    self._status_counts[TaskStatus.PENDING] += 1
                     pod.status = TaskStatus.PENDING
                 self._mark_full("node-deleted")
 
@@ -559,6 +573,19 @@ class SchedulerCache:
             ]
         for group in groups:
             self.update_job_status(group)
+
+    def has_pending_work(self) -> bool:
+        """True when a scheduling cycle could possibly act: any pod is
+        Pending or Releasing, or a failed bind awaits resync.  O(1) via
+        the status census — the scheduler loop's idle early-out calls
+        this every cycle (≙ scheduler.go · runOnce being near-free on an
+        idle cluster)."""
+        with self._lock:
+            return bool(
+                self._status_counts[TaskStatus.PENDING]
+                or self._status_counts[TaskStatus.RELEASING]
+                or self._resync
+            )
 
     def drain_resync(self) -> list[str]:
         """Pod uids whose binds failed since last drain; the scheduler

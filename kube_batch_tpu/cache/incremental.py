@@ -171,7 +171,6 @@ class IncrementalPacker:
             )
             changed.add("cluster_total")
 
-        d.clear()
         if rows_changed:
             self._meta = SnapshotMeta(
                 spec=self._meta.spec,
@@ -186,9 +185,18 @@ class IncrementalPacker:
                 podlabel_vocab=self._meta.podlabel_vocab,
             )
         if changed:
-            self._snap = self._snap.replace(
-                **{f: jnp.asarray(a[f]) for f in changed}
-            )
+            try:
+                self._snap = self._snap.replace(
+                    **{f: jnp.asarray(a[f]) for f in changed}
+                )
+            except Exception:
+                # Device upload failed (e.g. OOM): the host arrays are
+                # patched but the device buffers are stale — force the
+                # next pack to rebuild rather than serve them.
+                d.mark_full("upload-failed")
+                raise
+        # Drain the journal only once the device state is consistent.
+        d.clear()
         self.incremental_packs += 1
         self.last_mode = f"incremental:{len(changed)}-arrays"
         return self._snap, self._meta
@@ -384,13 +392,23 @@ class IncrementalPacker:
         changed.update(("node_cap", "node_idle", "node_releasing",
                         "node_pressure", "node_ports"))
 
+    # -- host-side reads ------------------------------------------------
+
+    def host_task_state(self) -> np.ndarray:
+        """Padded i32[Tp] task_state as of the LAST pack — a fresh copy
+        (the packer patches its arrays in place between cycles).  Lets
+        the session skip a per-cycle D2H read of bytes the host already
+        has."""
+        return self._ints.arrays["task_state"].copy()
+
     # -- mechanical invariant check (VERDICT r2 weak #8) ---------------
 
     def verify_against_live(self) -> None:
-        """Assert the packed mutable pod fields (status/node) and node
-        accounting match the LIVE cache.  Called under the cache lock
-        this is trivially true — which is exactly the invariant: any
-        future code packing outside the lock, or mutating without
+        """Assert every MUTABLE packed field matches the LIVE cache:
+        pod status/node rows, node accounting, job rows (min/prio/
+        order/queue), and PDB membership bits.  Called under the cache
+        lock this is trivially true — which is exactly the invariant:
+        any future code packing outside the lock, or mutating without
         marking, fails here.  Enabled per-pack via KB_TPU_CHECK_PACK=1.
         """
         with self.cache.lock():
@@ -410,6 +428,17 @@ class IncrementalPacker:
                     f"pod {pod.name}: packed node row "
                     f"{a['task_node'][row]} != live {want}"
                 )
+                # PDB membership: the packed multi-hot must match a
+                # fresh evaluation of every budget's selector.
+                for bi, bname in enumerate(self._ints.pdb_names):
+                    pdb = self.cache._pdbs.get(bname)
+                    member = bool(
+                        pdb is not None and pdb.selector and pdb.matches(pod)
+                    )
+                    assert bool(a["task_pdbs"][row, bi]) == member, (
+                        f"pod {pod.name}: packed pdb[{bname}] bit "
+                        f"{bool(a['task_pdbs'][row, bi])} != live {member}"
+                    )
             for nname, row in self._node_row.items():
                 info = self.cache._nodes.get(nname)
                 assert info is not None, f"packed node {nname} vanished"
@@ -420,4 +449,24 @@ class IncrementalPacker:
                 np.testing.assert_allclose(
                     a["node_releasing"][row], info.releasing, rtol=1e-5,
                     err_msg=nname,
+                )
+            for jname, row in self._job_row.items():
+                job = self.cache._jobs.get(jname)
+                assert job is not None, f"packed job {jname} vanished"
+                assert a["job_min"][row] == job.min_available, (
+                    f"job {jname}: packed min {a['job_min'][row]} != "
+                    f"live {job.min_available}"
+                )
+                assert a["job_prio"][row] == job.priority, (
+                    f"job {jname}: packed prio {a['job_prio'][row]} != "
+                    f"live {job.priority}"
+                )
+                assert a["job_order"][row] == job.pod_group.creation, (
+                    f"job {jname}: packed order {a['job_order'][row]} != "
+                    f"live {job.pod_group.creation}"
+                )
+                want_q = self._queue_row.get(job.queue, NONE_IDX)
+                assert a["job_queue"][row] == want_q, (
+                    f"job {jname}: packed queue row {a['job_queue'][row]}"
+                    f" != live {want_q}"
                 )

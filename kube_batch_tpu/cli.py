@@ -10,10 +10,13 @@ Differences by design:
   the CLI drives the in-process simulator from a workload spec (a
   BASELINE config number or a YAML world file) — a real-cluster adapter
   slots in through the same `SchedulerCache` + Binder/Evictor seam;
-* leader election is a host-local advisory file lock (`fcntl.flock` on
-  `--lock-file`): same active/passive semantics — the standby blocks
-  until the leader dies, then takes over a freshly rebuilt cache
-  (stateless recovery, ≙ informer re-list after failover).
+* leader election: with `--cluster-stream` the lock object lives on
+  the CLUSTER (a TTL lease served over the wire — cross-host
+  active/passive HA, ≙ leaderelection.RunOrDie's resourcelock on the
+  apiserver); without a stream it falls back to a host-local advisory
+  file lock (`fcntl.flock` on `--lock-file`).  Either way the standby
+  takes over a freshly rebuilt cache (stateless recovery, ≙ informer
+  re-list after failover).
 """
 
 from __future__ import annotations
@@ -45,6 +48,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="seconds between cycles (default 1.0)")
     p.add_argument("--default-queue", default="default",
                    help="queue for jobs that name none")
+    p.add_argument("--scheduler-name", default="kube-batch",
+                   help="adopt only pods whose spec.schedulerName matches "
+                        "(k8s-format streams; ≙ options.go --scheduler-name)")
     p.add_argument("--listen-address", default=":8080",
                    help="metrics endpoint (host:port; empty disables)")
     p.add_argument("--leader-elect", action="store_true",
@@ -54,11 +60,21 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--workload", default=None,
                    help="world spec: a BASELINE config number (1-5) or a "
                         "YAML file of nodes/queues/jobs")
+    p.add_argument("--cluster-stream", default=None,
+                   help="host:port of a cluster watch/write stream (the "
+                        "apiserver seam); replaces --workload, accepts "
+                        "native or k8s-format events, and moves "
+                        "--leader-elect onto the wire lease")
     p.add_argument("--cycles", type=int, default=None,
                    help="stop after N cycles (default: run forever)")
     p.add_argument("--profile-dir", default=None,
                    help="capture a jax.profiler trace of the second "
                         "cycle into this directory")
+    p.add_argument("--compile-cache-dir", default=None,
+                   help="persistent XLA compile cache so a restarted "
+                        "daemon skips the first-cycle recompile "
+                        "(default: KB_TPU_COMPILE_CACHE or a tmp dir; "
+                        "empty string disables)")
     p.add_argument("--version", action="store_true")
     return p
 
@@ -139,6 +155,78 @@ def load_world(spec_arg: str | None, default_queue: str):
     return cache, sim
 
 
+def run_external(args) -> int:
+    """Drive a real (out-of-process) cluster over --cluster-stream:
+    the watch feed builds the cache, writes go back over the same
+    connection, and --leader-elect contends for the CLUSTER-side lease
+    (cross-host active/passive HA, ≙ app/server.go wiring
+    leaderelection.RunOrDie around scheduler.Run)."""
+    import os
+    import socket
+    import threading
+
+    from kube_batch_tpu.cache.cache import SchedulerCache
+    from kube_batch_tpu.client.adapter import LeaseElector, StreamBackend
+    from kube_batch_tpu.client.k8s import K8sWatchAdapter
+
+    host, _, port = args.cluster_stream.rpartition(":")
+    sock = socket.create_connection((host or "127.0.0.1", int(port)))
+    reader = sock.makefile("r", encoding="utf-8")
+    writer = sock.makefile("w", encoding="utf-8")
+    backend = StreamBackend(writer)
+    cache = SchedulerCache(
+        spec=ResourceSpec(),
+        binder=backend,
+        evictor=backend,
+        status_updater=backend,
+        default_queue=args.default_queue,
+    )
+    adapter = K8sWatchAdapter(
+        cache, reader, backend=backend, scheduler_name=args.scheduler_name
+    ).start()
+
+    stop = threading.Event()
+    # The stream hanging up ends the daemon (a supervisor restarts it;
+    # stateless recovery re-lists on the next connect).  Started BEFORE
+    # the lease acquire loop: a standby whose stream dies while waiting
+    # must exit and reconnect, not spin against a dead socket.
+    threading.Thread(
+        target=lambda: (adapter.stopped.wait(), stop.set()), daemon=True
+    ).start()
+
+    elector = None
+    if args.leader_elect:
+        elector = LeaseElector(
+            backend, holder=f"{socket.gethostname()}-{os.getpid()}"
+        )
+        logging.info("contending for the cluster lease as %s", elector.holder)
+        if not elector.acquire(stop):
+            logging.error("stream died while standing by for the lease")
+            return 1
+        elector.start_renewing(on_lost=stop.set)
+
+    if not adapter.wait_for_sync(60.0):
+        logging.error("cluster stream never completed its LIST replay")
+        return 1
+
+    scheduler = Scheduler(
+        cache,
+        conf_path=args.scheduler_conf,
+        schedule_period=args.schedule_period,
+        profile_dir=args.profile_dir,
+    )
+    try:
+        ran = scheduler.run(stop=stop, max_cycles=args.cycles)
+        logging.info("stopped after %d cycles", ran)
+    except KeyboardInterrupt:
+        logging.info("interrupted; shutting down")
+    finally:
+        if elector is not None:
+            elector.release()
+        sock.close()
+    return 0
+
+
 def acquire_leadership(lock_file: str):
     """Block until this process holds the flock (≙ leaderelection.
     RunOrDie's acquire loop).  Returns the held file object — keep it
@@ -160,8 +248,23 @@ def main(argv: list[str] | None = None) -> int:
         format="%(asctime)s %(levelname)s %(name)s: %(message)s",
     )
 
+    from kube_batch_tpu.compile_cache import enable_compile_cache
+
+    cache_dir = enable_compile_cache(args.compile_cache_dir)
+    if cache_dir:
+        logging.info("persistent XLA compile cache: %s", cache_dir)
+
+    if args.cluster_stream:
+        # Real-cluster mode: cache fed by the wire, HA on the wire lease.
+        if args.workload:
+            raise SystemExit("--cluster-stream and --workload are exclusive")
+        return run_external(args)
+
     lock = None
     if args.leader_elect:
+        # Single-host fallback: flock on a local file.  With a cluster
+        # stream configured, leadership contends for the CLUSTER-side
+        # lease instead (see run_external) — cross-host HA.
         lock = acquire_leadership(args.lock_file)
 
     if args.listen_address:

@@ -11,7 +11,18 @@ correlation — the same shape as client-go's informer + REST round trips,
 without the Kubernetes dependency.
 """
 
-from kube_batch_tpu.client.adapter import StreamBackend, WatchAdapter
+from kube_batch_tpu.client.adapter import (
+    LeaseElector,
+    StreamBackend,
+    WatchAdapter,
+)
 from kube_batch_tpu.client.external import ExternalCluster
+from kube_batch_tpu.client.k8s import K8sWatchAdapter
 
-__all__ = ["WatchAdapter", "StreamBackend", "ExternalCluster"]
+__all__ = [
+    "WatchAdapter",
+    "StreamBackend",
+    "ExternalCluster",
+    "LeaseElector",
+    "K8sWatchAdapter",
+]

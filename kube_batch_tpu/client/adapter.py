@@ -28,6 +28,7 @@ import itertools
 import json
 import logging
 import threading
+import time
 from typing import IO
 
 from kube_batch_tpu.api.types import TaskStatus
@@ -95,6 +96,100 @@ class StreamBackend:
         self._call({
             "verb": "updatePodGroup", "object": encode_pod_group(group),
         })
+
+    # -- lease verbs (cross-host HA; ≙ resourcelock Get/Update calls) ---
+    def acquire_lease(self, holder: str, ttl: float) -> None:
+        """Raises when another holder owns an unexpired lease."""
+        self._call({"verb": "acquireLease", "holder": holder, "ttl": ttl})
+
+    def renew_lease(self, holder: str, ttl: float) -> None:
+        """Raises when the lease was lost (expired + taken)."""
+        self._call({"verb": "renewLease", "holder": holder, "ttl": ttl})
+
+    def release_lease(self, holder: str) -> None:
+        self._call({"verb": "releaseLease", "holder": holder})
+
+
+class LeaseElector:
+    """Active/passive leader election over the wire lease
+    (≙ app/server.go · leaderelection.RunOrDie with a LeaseLock held on
+    the cluster side): `acquire` blocks until this process holds the
+    lease, `start_renewing` keeps it alive on a daemon thread and
+    invokes `on_lost` the moment a renewal is rejected — the standing-
+    down path OnStoppedLeading handles in the reference."""
+
+    def __init__(
+        self,
+        backend: StreamBackend,
+        holder: str,
+        ttl: float = 15.0,
+        retry_period: float | None = None,
+    ) -> None:
+        self.backend = backend
+        self.holder = holder
+        self.ttl = ttl
+        # ≙ RetryPeriod: contenders poll at a fraction of the TTL so an
+        # expired lease is picked up well before a full TTL elapses.
+        self.retry_period = retry_period if retry_period is not None else ttl / 3
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def acquire(self, stop: threading.Event | None = None) -> bool:
+        """Block until leadership is acquired (True) or `stop` fires
+        (False)."""
+        while stop is None or not stop.is_set():
+            try:
+                self.backend.acquire_lease(self.holder, self.ttl)
+                log.info("lease acquired by %s (ttl %.1fs)", self.holder, self.ttl)
+                return True
+            except Exception as exc:  # noqa: BLE001 — held by the leader
+                log.debug("lease acquire failed: %s", exc)
+            if stop is not None:
+                if stop.wait(self.retry_period):
+                    return False
+            else:
+                time.sleep(self.retry_period)
+        return False
+
+    def start_renewing(self, on_lost) -> None:
+        """Renew every retry_period until stopped.  Transient failures
+        (slow/dropped response) RETRY until renewals have failed for a
+        full TTL (≙ RenewDeadline) — one hiccup must not stand a
+        healthy leader down; only a sustained outage or an explicit
+        "lease lost" (another holder took over) fires on_lost, once."""
+
+        def renew_loop() -> None:
+            last_ok = time.monotonic()
+            while not self._stop.wait(self.retry_period):
+                try:
+                    self.backend.renew_lease(self.holder, self.ttl)
+                    last_ok = time.monotonic()
+                except RuntimeError as exc:
+                    # Definitive rejection: another holder owns it.
+                    log.error("lease lost by %s: %s", self.holder, exc)
+                    on_lost()
+                    return
+                except Exception as exc:  # noqa: BLE001 — transient
+                    if time.monotonic() - last_ok > self.ttl:
+                        log.error(
+                            "lease renewal failing for > ttl (%s); "
+                            "standing down: %s", self.holder, exc,
+                        )
+                        on_lost()
+                        return
+                    log.warning("lease renewal hiccup (retrying): %s", exc)
+
+        self._thread = threading.Thread(target=renew_loop, daemon=True)
+        self._thread.start()
+
+    def release(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(self.ttl)
+        try:
+            self.backend.release_lease(self.holder)
+        except Exception:  # noqa: BLE001 — releasing best-effort on the
+            pass           # way down; expiry reclaims it regardless
 
 
 class WatchAdapter:

@@ -1,0 +1,560 @@
+"""Real Kubernetes API-object ingest (VERDICT r3 next #3).
+
+Reference counterpart: the generated clientset/informers of
+pkg/client/ plus cache/event_handlers.go — kube-batch consumes actual
+core/v1 Pods and Nodes, scheduling.incubator.k8s.io/v1alpha1 PodGroup
+and Queue CRDs, policy/v1beta1 PodDisruptionBudgets and
+scheduling.k8s.io/v1beta1 PriorityClasses, straight from an apiserver
+watch.  This module decodes those SAME wire shapes (a k8s watch event:
+``{"type": "ADDED", "object": {"kind": "Pod", "metadata": ..., "spec":
+..., "status": ...}}``) into the framework-native objects, so a real
+cluster feed — or a recorded fixture of one — drives the identical
+cache funnel the native JSON-lines protocol does.
+
+Adoption rules (≙ cache.go's informer filters + app/options/options.go
+· --scheduler-name):
+
+* an UNASSIGNED pod is adopted only when ``spec.schedulerName``
+  matches this scheduler — a shared-cluster feed must not cause us to
+  schedule another scheduler's pods;
+* an ASSIGNED pod (``spec.nodeName`` set) is always ingested,
+  whatever its scheduler: it occupies real capacity.  Without a group
+  it lands unmanaged ("Others"), visible through node accounting only;
+* ``Failed`` pods are not adopted (and are dropped on transition):
+  terminal pods hold no resources and the framework has no Failed
+  task state by design;
+* an adopted pod names its gang via the ``scheduling.k8s.io/
+  group-name`` annotation; without one, a shadow PodGroup (minMember
+  1, default queue) is synthesized per controller owner — the
+  reference's shadow-podgroup behavior for plain Deployments/Jobs.
+
+Lowering notes (framework-native simplifications, cluster.py header):
+node selectors/affinities lower to exact ``key=value`` terms
+(single-value ``In`` expressions only — multi-value OR terms are
+logged and skipped); a toleration lowers to the ``key=value:effect``
+string form and matches by equality; PDB ``minAvailable`` percentages
+are not lowered (the object is skipped loudly — silently weakening a
+disruption budget would be worse).
+"""
+
+from __future__ import annotations
+
+import datetime
+import logging
+import re
+from typing import Any
+
+from kube_batch_tpu.api.resource import ResourceSpec
+from kube_batch_tpu.api.types import TaskStatus
+from kube_batch_tpu.cache.cache import SchedulerCache
+from kube_batch_tpu.cache.cluster import (
+    Namespace,
+    Node,
+    Pod,
+    PodDisruptionBudget,
+    PodGroup,
+    Queue,
+)
+from kube_batch_tpu.client.adapter import WatchAdapter
+
+log = logging.getLogger(__name__)
+
+#: ≙ the reference's default --scheduler-name (options.go).
+DEFAULT_SCHEDULER_NAME = "kube-batch"
+#: ≙ scheduling.k8s.io/group-name pod annotation (apis utils · GetController
+#: fallback is the owner reference — see shadow groups below).
+GROUP_ANNOTATION = "scheduling.k8s.io/group-name"
+
+#: Extended-resource names that map onto the framework's "accelerator"
+#: dimension when the spec has one.
+ACCELERATOR_RESOURCES = frozenset({
+    "nvidia.com/gpu", "amd.com/gpu", "google.com/tpu",
+    "cloud-tpus.google.com/v2", "cloud-tpus.google.com/v3",
+})
+
+_QTY_RE = re.compile(r"^([0-9.eE+-]+)([a-zA-Z]*)$")
+_SUFFIX = {
+    "": 1.0,
+    "m": 1e-3,
+    "k": 1e3, "M": 1e6, "G": 1e9, "T": 1e12, "P": 1e15, "E": 1e18,
+    "Ki": 2.0 ** 10, "Mi": 2.0 ** 20, "Gi": 2.0 ** 30,
+    "Ti": 2.0 ** 40, "Pi": 2.0 ** 50, "Ei": 2.0 ** 60,
+}
+
+
+def parse_quantity(q: Any) -> float:
+    """A k8s resource.Quantity string → float in its base unit
+    ("500m" → 0.5, "1Gi" → 1073741824, "128974848" → 128974848)."""
+    if isinstance(q, (int, float)):
+        return float(q)
+    m = _QTY_RE.match(str(q).strip())
+    if not m or m.group(2) not in _SUFFIX:
+        raise ValueError(f"unparseable quantity {q!r}")
+    return float(m.group(1)) * _SUFFIX[m.group(2)]
+
+
+def parse_creation(ts: Any) -> int | None:
+    """metadata.creationTimestamp (RFC3339) → epoch seconds."""
+    if not ts:
+        return None
+    try:
+        return int(
+            datetime.datetime.fromisoformat(
+                str(ts).replace("Z", "+00:00")
+            ).timestamp()
+        )
+    except ValueError:
+        return None
+
+
+def _project_resources(spec: ResourceSpec, resources: dict) -> dict[str, float]:
+    """One k8s quantity map → framework dimensions: cpu cores→milli,
+    extended accelerator names folded into "accelerator", unknown
+    dimensions dropped.  The ONE place unit scaling lives — pod
+    requests and node allocatable must never disagree in scale."""
+    known = set(spec.names)
+    out: dict[str, float] = {}
+    for raw_name, q in (resources or {}).items():
+        if raw_name == "cpu":
+            name, val = "cpu", parse_quantity(q) * 1e3  # cores→milli
+        elif raw_name in ACCELERATOR_RESOURCES:
+            name, val = "accelerator", parse_quantity(q)
+        else:
+            name, val = raw_name, parse_quantity(q)
+        if name in known:
+            out[name] = out.get(name, 0.0) + val
+    return out
+
+
+def _requests_vec(spec: ResourceSpec, pod_spec: dict) -> dict[str, float]:
+    """containers' requests summed + per-dimension max with init
+    containers (≙ resource_info.go · GetPodResourceRequest), projected
+    onto the framework spec's dimensions."""
+    total: dict[str, float] = {}
+    for c in pod_spec.get("containers", []):
+        projected = _project_resources(
+            spec, c.get("resources", {}).get("requests", {})
+        )
+        for name, v in projected.items():
+            total[name] = total.get(name, 0.0) + v
+    for c in pod_spec.get("initContainers", []):
+        projected = _project_resources(
+            spec, c.get("resources", {}).get("requests", {})
+        )
+        for name, v in projected.items():
+            total[name] = max(total.get(name, 0.0), v)
+    if "pods" in spec.names:
+        total["pods"] = 1.0
+    return total
+
+
+def _taint_str(t: dict) -> str:
+    return f"{t.get('key', '')}={t.get('value', '')}:{t.get('effect', '')}"
+
+
+def _match_labels_terms(sel: dict, what: str) -> dict[str, str]:
+    """A labelSelector → exact key=value map.  matchLabels pass through;
+    single-value `In` expressions lower; anything else is skipped loudly."""
+    out = dict(sel.get("matchLabels", {}))
+    for expr in sel.get("matchExpressions", []):
+        op, values = expr.get("operator"), expr.get("values", [])
+        if op == "In" and len(values) == 1:
+            out[expr["key"]] = values[0]
+        else:
+            log.warning(
+                "%s: matchExpression %s %s not lowerable to exact terms; "
+                "skipped", what, expr.get("key"), op,
+            )
+    return out
+
+
+class K8sDecoder:
+    """Stateful decoder: holds the PriorityClass table (the reference's
+    pc informer, resolved at pod-decode time) and the scheduler-name
+    adoption filter."""
+
+    def __init__(
+        self,
+        spec: ResourceSpec,
+        scheduler_name: str = DEFAULT_SCHEDULER_NAME,
+    ) -> None:
+        self.spec = spec
+        self.scheduler_name = scheduler_name
+        self.priority_classes: dict[str, int] = {}
+        self.default_priority = 0
+        self._default_class: str | None = None
+
+    # -- PriorityClass (≙ cache.go's pc informer + job_info.go·Priority) --
+    def observe_priority_class(self, obj: dict) -> None:
+        name = obj["metadata"]["name"]
+        value = int(obj.get("value", 0))
+        self.priority_classes[name] = value
+        if obj.get("globalDefault"):
+            self._default_class = name
+            self.default_priority = value
+
+    def forget_priority_class(self, name: str) -> None:
+        self.priority_classes.pop(name, None)
+        if name == self._default_class:
+            self._default_class = None
+            self.default_priority = 0
+
+    def resolve_priority(self, class_name: str | None) -> int:
+        if class_name:
+            if class_name in self.priority_classes:
+                return self.priority_classes[class_name]
+            log.warning("unknown PriorityClass %r; using default", class_name)
+        return self.default_priority
+
+    # -- Pod -------------------------------------------------------------
+    def pod(self, obj: dict) -> tuple[Pod, bool] | None:
+        """k8s Pod JSON → (Pod, group_is_synthetic), or None when not
+        adopted (foreign unassigned / Failed)."""
+        meta = obj.get("metadata", {})
+        spec = obj.get("spec", {})
+        status = obj.get("status", {})
+        node = spec.get("nodeName") or None
+        mine = spec.get("schedulerName", "default-scheduler") == \
+            self.scheduler_name
+        if node is None and not mine:
+            return None  # another scheduler's pending pod
+        phase = status.get("phase", "Pending")
+        if phase == "Failed":
+            return None  # terminal, holds nothing; no Failed task state
+
+        if meta.get("deletionTimestamp"):
+            task_status = TaskStatus.RELEASING
+        elif phase == "Succeeded":
+            task_status = TaskStatus.SUCCEEDED
+        elif phase == "Running":
+            task_status = TaskStatus.RUNNING
+        elif node is not None:
+            task_status = TaskStatus.BOUND  # scheduled, containers starting
+        else:
+            task_status = TaskStatus.PENDING
+
+        annotations = meta.get("annotations", {}) or {}
+        group = annotations.get(GROUP_ANNOTATION)
+        synthetic = False
+        if group is None and mine:
+            owners = meta.get("ownerReferences", []) or []
+            anchor = owners[0]["uid"] if owners else meta.get("uid")
+            if anchor:
+                group = f"shadow-pg-{anchor}"
+                synthetic = True
+
+        if "priority" in spec:  # admission already resolved the class
+            priority = int(spec["priority"])
+        else:
+            priority = self.resolve_priority(spec.get("priorityClassName"))
+
+        selector = {str(k): str(v)
+                    for k, v in (spec.get("nodeSelector") or {}).items()}
+        preferences: dict[str, float] = {}
+        affinity_terms: set[str] = set()
+        anti_terms: set[str] = set()
+        pod_prefs: dict[str, float] = {}
+        aff = spec.get("affinity") or {}
+
+        na = aff.get("nodeAffinity") or {}
+        req = na.get("requiredDuringSchedulingIgnoredDuringExecution") or {}
+        for term in req.get("nodeSelectorTerms", []):
+            selector.update(_match_labels_terms(
+                {"matchExpressions": term.get("matchExpressions", [])},
+                f"pod {meta.get('name')}: nodeAffinity",
+            ))
+        for pref in na.get(
+            "preferredDuringSchedulingIgnoredDuringExecution", []
+        ):
+            terms = _match_labels_terms(
+                {"matchExpressions":
+                 (pref.get("preference") or {}).get("matchExpressions", [])},
+                f"pod {meta.get('name')}: preferred nodeAffinity",
+            )
+            for k, v in terms.items():
+                preferences[f"{k}={v}"] = float(pref.get("weight", 1))
+
+        def _pod_terms(section: str, hard_sink: set[str] | None) -> None:
+            pa = aff.get(section) or {}
+            for term in pa.get(
+                "requiredDuringSchedulingIgnoredDuringExecution", []
+            ):
+                sel = _match_labels_terms(
+                    term.get("labelSelector", {}),
+                    f"pod {meta.get('name')}: {section}",
+                )
+                tk = term.get("topologyKey", "kubernetes.io/hostname")
+                for k, v in sel.items():
+                    lowered = (
+                        f"{k}={v}" if tk == "kubernetes.io/hostname"
+                        else f"{tk}:{k}={v}"
+                    )
+                    if hard_sink is not None:
+                        hard_sink.add(lowered)
+            for pref in pa.get(
+                "preferredDuringSchedulingIgnoredDuringExecution", []
+            ):
+                inner = pref.get("podAffinityTerm", {})
+                sel = _match_labels_terms(
+                    inner.get("labelSelector", {}),
+                    f"pod {meta.get('name')}: preferred {section}",
+                )
+                tk = inner.get("topologyKey", "kubernetes.io/hostname")
+                w = float(pref.get("weight", 1))
+                if section == "podAntiAffinity":
+                    w = -w  # negative soft weight = spread preference
+                for k, v in sel.items():
+                    lowered = (
+                        f"{k}={v}" if tk == "kubernetes.io/hostname"
+                        else f"{tk}:{k}={v}"
+                    )
+                    pod_prefs[lowered] = w
+
+        _pod_terms("podAffinity", affinity_terms)
+        _pod_terms("podAntiAffinity", anti_terms)
+
+        ports: set[int] = set()
+        claims: set[str] = set()
+        for c in spec.get("containers", []):
+            for p in c.get("ports", []):
+                if p.get("hostPort"):
+                    ports.add(int(p["hostPort"]))
+        for v in spec.get("volumes", []):
+            pvc = v.get("persistentVolumeClaim")
+            if pvc and pvc.get("claimName"):
+                claims.add(pvc["claimName"])
+
+        kwargs: dict[str, Any] = {}
+        # Same fallback the adapter keys the cache by — a stream without
+        # metadata.uid must still round-trip ADDED/MODIFIED/DELETED to
+        # ONE cache entry, never a second auto-uid copy.
+        uid = meta.get("uid") or meta.get("name")
+        if uid:
+            kwargs["uid"] = uid
+        creation = parse_creation(meta.get("creationTimestamp"))
+        if creation is not None:
+            kwargs["creation"] = creation
+        pod = Pod(
+            name=meta.get("name", kwargs.get("uid", "unnamed")),
+            namespace=meta.get("namespace", "default"),
+            group=group,
+            request=_requests_vec(self.spec, spec),
+            priority=priority,
+            selector=selector,
+            labels={str(k): str(v)
+                    for k, v in (meta.get("labels") or {}).items()},
+            affinity=frozenset(affinity_terms),
+            anti_affinity=frozenset(anti_terms),
+            pod_prefs=pod_prefs,
+            preferences=preferences,
+            tolerations=frozenset(
+                _taint_str(t) for t in spec.get("tolerations", [])
+            ),
+            ports=frozenset(ports),
+            claims=frozenset(claims),
+            status=task_status,
+            node=node,
+            **kwargs,
+        )
+        return pod, synthetic
+
+    # -- Node ------------------------------------------------------------
+    def node(self, obj: dict) -> Node:
+        meta = obj.get("metadata", {})
+        spec = obj.get("spec", {})
+        status = obj.get("status", {})
+        allocatable = _project_resources(
+            self.spec, status.get("allocatable") or status.get("capacity")
+        )
+        conds = {
+            c.get("type"): c.get("status") == "True"
+            for c in status.get("conditions", [])
+        }
+        ready = conds.get("Ready", True) and not spec.get("unschedulable")
+        kwargs = {"uid": meta["uid"]} if meta.get("uid") else {}
+        return Node(
+            name=meta["name"],
+            allocatable=allocatable,
+            labels={str(k): str(v)
+                    for k, v in (meta.get("labels") or {}).items()},
+            taints=frozenset(_taint_str(t) for t in spec.get("taints", [])),
+            ready=ready,
+            memory_pressure=conds.get("MemoryPressure", False),
+            disk_pressure=conds.get("DiskPressure", False),
+            pid_pressure=conds.get("PIDPressure", False),
+            **kwargs,
+        )
+
+    # -- CRDs ------------------------------------------------------------
+    def pod_group(self, obj: dict) -> PodGroup:
+        meta = obj.get("metadata", {})
+        spec = obj.get("spec", {})
+        kwargs: dict[str, Any] = {}
+        if meta.get("uid"):
+            kwargs["uid"] = meta["uid"]
+        creation = parse_creation(meta.get("creationTimestamp"))
+        if creation is not None:
+            kwargs["creation"] = creation
+        return PodGroup(
+            name=meta["name"],
+            queue=spec.get("queue", ""),
+            min_member=int(spec.get("minMember", 1)),
+            priority=self.resolve_priority(spec.get("priorityClassName")),
+            **kwargs,
+        )
+
+    def queue(self, obj: dict) -> Queue:
+        meta = obj.get("metadata", {})
+        kwargs = {"uid": meta["uid"]} if meta.get("uid") else {}
+        return Queue(
+            name=meta["name"],
+            weight=float(obj.get("spec", {}).get("weight", 1)),
+            **kwargs,
+        )
+
+    def pdb(self, obj: dict) -> PodDisruptionBudget | None:
+        meta = obj.get("metadata", {})
+        spec = obj.get("spec", {})
+        if "maxUnavailable" in spec and "minAvailable" not in spec:
+            # Lowering maxUnavailable needs the live matched-pod count,
+            # which the decoder doesn't have; ingesting it as floor 0
+            # would silently void the budget — skip loudly instead.
+            log.warning(
+                "PDB %s: maxUnavailable form not lowerable; budget NOT "
+                "ingested", meta.get("name"),
+            )
+            return None
+        min_avail = spec.get("minAvailable", 0)
+        if isinstance(min_avail, str) and min_avail.endswith("%"):
+            log.warning(
+                "PDB %s: percentage minAvailable %r not lowerable; "
+                "budget NOT ingested", meta.get("name"), min_avail,
+            )
+            return None
+        sel = _match_labels_terms(
+            spec.get("selector", {}), f"pdb {meta.get('name')}"
+        )
+        kwargs = {"uid": meta["uid"]} if meta.get("uid") else {}
+        return PodDisruptionBudget(
+            name=meta["name"],
+            min_available=int(min_avail),
+            selector=sel,
+            **kwargs,
+        )
+
+    def namespace(self, obj: dict) -> Namespace:
+        meta = obj.get("metadata", {})
+        kwargs = {"uid": meta["uid"]} if meta.get("uid") else {}
+        weight = float(
+            (meta.get("annotations") or {}).get(
+                "scheduling.k8s.io/namespace-weight", 1
+            )
+        )
+        return Namespace(name=meta["name"], weight=weight, **kwargs)
+
+
+class K8sWatchAdapter(WatchAdapter):
+    """WatchAdapter speaking BOTH wire dialects: lines whose object
+    carries a k8s ``kind`` decode through `K8sDecoder`; native lines
+    (and SYNC/RESPONSE control messages) fall through to the base
+    adapter, so one stream can replay either format."""
+
+    def __init__(
+        self,
+        cache: SchedulerCache,
+        reader,
+        backend=None,
+        scheduler_name: str = DEFAULT_SCHEDULER_NAME,
+    ) -> None:
+        super().__init__(cache, reader, backend)
+        self.decoder = K8sDecoder(cache.spec, scheduler_name)
+        self.ignored_pods = 0  # foreign/terminal pods filtered out
+
+    def _dispatch(self, msg: dict) -> None:
+        obj = msg.get("object")
+        if isinstance(obj, dict) and "kind" in obj:
+            try:
+                self._apply_k8s(msg.get("type"), obj)
+            except Exception:  # noqa: BLE001 — one bad event ≠ dead ingest
+                log.exception(
+                    "k8s event handler failed: %s %s",
+                    msg.get("type"), obj.get("kind"),
+                )
+            return
+        super()._dispatch(msg)
+
+    # -- k8s-shaped event routing (≙ cache/event_handlers.go) -----------
+    def _apply_k8s(self, mtype: str, obj: dict) -> None:
+        kind = obj.get("kind")
+        cache = self.cache
+        dec = self.decoder
+        meta = obj.get("metadata", {})
+        if kind == "Pod":
+            self._apply_pod(mtype, obj)
+        elif kind == "Node":
+            if mtype == "DELETED":
+                cache.delete_node(meta["name"])
+            else:  # ADDED/MODIFIED: upsert (re-list replays ADDED)
+                cache.update_node(dec.node(obj))
+        elif kind == "PodGroup":
+            if mtype == "DELETED":
+                cache.delete_pod_group(meta["name"])
+            else:
+                cache.add_pod_group(dec.pod_group(obj))
+        elif kind == "Queue":
+            if mtype == "DELETED":
+                cache.delete_queue(meta["name"])
+            else:
+                cache.add_queue(dec.queue(obj))
+        elif kind == "PriorityClass":
+            if mtype == "DELETED":
+                dec.forget_priority_class(meta["name"])
+            else:
+                dec.observe_priority_class(obj)
+        elif kind == "PodDisruptionBudget":
+            if mtype == "DELETED":
+                cache.delete_pdb(meta["name"])
+            else:
+                pdb = dec.pdb(obj)
+                if pdb is not None:
+                    cache.add_pdb(pdb)
+        elif kind == "Namespace":
+            if mtype == "DELETED":
+                cache.delete_namespace(meta["name"])
+            else:
+                cache.add_namespace(dec.namespace(obj))
+        else:
+            log.warning("unhandled k8s kind %s (%s)", kind, mtype)
+
+    def _ensure_shadow_group(self, group: str) -> None:
+        """Materialize a shadow PodGroup for a bare controller-owned pod
+        (minMember 1, default queue) unless a real one exists."""
+        with self.cache.lock():
+            job = self.cache._jobs.get(group)
+            if job is not None and job.queue:
+                return
+        self.cache.add_pod_group(PodGroup(name=group, queue="", min_member=1))
+
+    def _apply_pod(self, mtype: str, obj: dict) -> None:
+        cache = self.cache
+        meta = obj.get("metadata", {})
+        uid = meta.get("uid") or meta.get("name")
+        decoded = self.decoder.pod(obj)
+        if mtype == "DELETED":
+            cache.delete_pod(uid)
+            return
+        with cache.lock():
+            known = uid in cache._pods
+        if decoded is None:
+            if known:  # adopted earlier, now foreign/Failed: drop it
+                cache.delete_pod(uid)
+            else:
+                self.ignored_pods += 1
+            return
+        pod, synthetic = decoded
+        if synthetic and pod.group:
+            self._ensure_shadow_group(pod.group)
+        if not known:
+            cache.add_pod(pod)
+        else:  # MODIFIED: status / placement movement
+            cache.update_pod_status(uid, pod.status, node=pod.node)

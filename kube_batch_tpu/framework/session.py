@@ -79,12 +79,20 @@ class Session:
             if packer is not None:
                 self.host = None
                 self.snap, self.meta = packer.pack()
+                # The packer already holds the padded host task_state —
+                # reading it back from the device would cost a tunnel
+                # round trip per cycle for bytes the host still has.
+                self.initial_task_state = packer.host_task_state()
             else:
                 with cache.lock():
                     self.host = cache.snapshot(shared=True)
                     self.snap, self.meta = pack_snapshot(self.host)
-        self.state: AllocState = init_state(self.snap)
-        self.initial_task_state = np.asarray(self.snap.task_state)
+                self.initial_task_state = np.asarray(self.snap.task_state)
+        # Lazily materialized (see the `state` property): the fused
+        # cycle computes init_state INSIDE its single dispatch and
+        # overwrites this with the final state, so the daemon path never
+        # builds an initial AllocState on the host at all.
+        self._state: AllocState | None = None
 
         self.bound: list[tuple[str, str]] = []     # (pod name, node) this cycle
         self.evicted: list[tuple[str, str]] = []   # (pod name, reason)
@@ -92,12 +100,25 @@ class Session:
         # part of its single dispatch and stores it here, so
         # dispatch_binds/unready_jobs need no extra device round trip.
         self._job_ready: np.ndarray | None = None
-        # Host copy of the FINAL task_state, filled at first post-action
-        # read — every later consumer (bind dispatch, pending gauge,
-        # diagnosis, the loop's result label) reuses it instead of
-        # paying another full D2H transfer on the tunneled backend.
+        # Host copies of the FINAL task_state/task_node, filled at first
+        # post-action read (or in one batched transfer by the fused
+        # path via set_host_final) — every later consumer (bind
+        # dispatch, pending gauge, diagnosis, the loop's result label)
+        # reuses them instead of paying another D2H round trip on the
+        # tunneled backend.
         self._host_task_state: np.ndarray | None = None
+        self._host_task_node: np.ndarray | None = None
         self._diag = None  # precomputed diagnosis (fused cycle only)
+
+    @property
+    def state(self) -> AllocState:
+        if self._state is None:
+            self._state = init_state(self.snap)
+        return self._state
+
+    @state.setter
+    def state(self, value: AllocState) -> None:
+        self._state = value
 
     def host_task_state(self) -> np.ndarray:
         """i32[T] host copy of the live task_state (cached; call only
@@ -105,6 +126,21 @@ class Session:
         if self._host_task_state is None:
             self._host_task_state = np.asarray(self.state.task_state)
         return self._host_task_state
+
+    def host_task_node(self) -> np.ndarray:
+        """i32[T] host copy of the live task_node (cached like
+        host_task_state)."""
+        if self._host_task_node is None:
+            self._host_task_node = np.asarray(self.state.task_node)
+        return self._host_task_node
+
+    def set_host_final(
+        self, task_state: np.ndarray, task_node: np.ndarray
+    ) -> None:
+        """Install host copies fetched in the fused cycle's one batched
+        device_get."""
+        self._host_task_state = np.asarray(task_state)
+        self._host_task_node = np.asarray(task_node)
 
     def job_ready(self) -> np.ndarray:
         """bool[J] host copy of the gang commit gate (cached)."""
@@ -136,9 +172,9 @@ class Session:
     def dispatch_binds(self) -> list[tuple[str, str]]:
         """Bind every newly allocated task of every JobReady job
         (gang commit; ≙ session.go · Allocate's deferred dispatch)."""
-        snap, state = self.snap, self.state
+        snap = self.snap
         task_state = self.host_task_state()
-        task_node = np.asarray(state.task_node)
+        task_node = self.host_task_node()
         ready = self.job_ready()
         task_job = np.asarray(snap.task_job)
 

@@ -213,6 +213,11 @@ snapshot_pack_latency = REGISTRY.register(Histogram(
 pending_tasks = REGISTRY.register(Gauge(
     "pending_tasks", "Tasks still pending at session close.",
 ))
+idle_cycles_skipped = REGISTRY.register(Counter(
+    "idle_cycles_skipped_total",
+    "Cycles that skipped the solve dispatch entirely: no pending or "
+    "releasing pods, no failed-bind resync, no policy change.",
+))
 
 
 def serve(address: str = ":8080") -> threading.Thread:

@@ -28,10 +28,16 @@ individually passes.  What gets batched is everything inside one step:
   rolled back by a tensor restore (state ← snapshot values for `prov`
   rows) — `Commit`/`Discard` as pure array ops, no undo log.
 
-A preemptor whose plan fails is remembered in `tried` and not
-reattempted this cycle (the reference would scan further nodes; the
-heuristic rarely picks a jointly-infeasible node, and the next cycle
-retries from a fresh snapshot).
+A preemptor whose plan fails on a node RETRIES on the next-best node,
+with the failed node excluded (`excl`, scoped to the current
+preemptor) — the reference's behavior of scanning further nodes after
+a discarded Statement (preempt.go iterates candidate nodes; the first
+node whose Statement commits wins).  Only when no feasible node
+remains is the preemptor latched into `tried` for the cycle.  Node
+VISIT ORDER is the one deliberate divergence: the reference walks Go's
+arbitrary map order; this kernel visits fewest-victims-first (lowest
+index on ties) — a deterministic tie-break of the same search, matched
+exactly by the oracle differential (sim/oracle_preempt.py).
 """
 
 from __future__ import annotations
@@ -60,11 +66,13 @@ RankFn = Callable[[SnapshotTensors, AllocState], jax.Array]
 @struct.dataclass
 class PreemptCarry:
     state: AllocState
-    tried: jax.Array        # bool[T] preemptors served or given up on
+    tried: jax.Array        # bool[T] preemptors served or out of nodes
     prov: jax.Array         # bool[T] provisional victims of the open plan
     prov_active: jax.Array  # bool[]  a plan is in progress
     prov_p: jax.Array       # i32[]   its preemptor
     prov_n: jax.Array       # i32[]   its target node
+    excl: jax.Array         # bool[N] nodes whose plan failed for excl_p
+    excl_p: jax.Array       # i32[]   preemptor the exclusions belong to
     progressed: jax.Array   # bool[]  loop-exit latch
     iters: jax.Array        # i32[]
 
@@ -126,7 +134,13 @@ def preemption_rounds(
     leftover starving tasks simply stay Pending for the next cycle.
     """
     if max_iters is None:
-        max_iters = 2 * snap.num_tasks + 8
+        # Calibrated for the retry-scan: beyond the ~2T of the old
+        # one-plan-per-preemptor bound, failed plans (rolled back and
+        # retried on the next node) cost extra steps roughly bounded by
+        # the node axis.  Truncation is still safe — the post-loop
+        # cleanup discards any open plan and the next cycle retries
+        # from a fresh snapshot — just slower to converge.
+        max_iters = 2 * snap.num_tasks + 4 * snap.num_nodes + 16
     T = snap.num_tasks
 
     def cond(c: PreemptCarry):
@@ -175,6 +189,11 @@ def preemption_rounds(
         have_p = c.prov_active | any_elig
         preq = snap.task_req[p]
         is_p = jnp.arange(T, dtype=jnp.int32) == p
+        # Failed-node exclusions are scoped to one preemptor: a new
+        # preemptor starts with a clean slate (≙ preempt.go's per-task
+        # node scan starting over for each preemptor).
+        excl = jnp.where(p == c.excl_p, c.excl,
+                         jnp.zeros_like(c.excl))
 
         # -- candidate victims under the LIVE state (fresh vetoes) ------
         victims = (
@@ -207,6 +226,7 @@ def preemption_rounds(
                 & snap.node_mask
                 & snap.node_ready
                 & dyn_row
+                & ~excl       # nodes whose Statement already failed for p
             )
             kk = jnp.where(feasible, k, BIG_K)
             n_best = jnp.argmax(feasible & (kk == jnp.min(kk))).astype(
@@ -265,11 +285,19 @@ def preemption_rounds(
         )
         return PreemptCarry(
             state=new_state,
-            tried=c.tried | (is_p & (no_node | fail | finalize)),
+            # `fail` no longer gives up on the preemptor: the failed
+            # node joins its exclusion set and the next iteration
+            # retries the next-best node; `tried` latches only on
+            # success or node exhaustion.
+            tried=c.tried | (is_p & (no_node | finalize)),
             prov=jnp.where(closed, False, c.prov | is_v),
             prov_active=evict_step,
             prov_p=p,
             prov_n=n,
+            excl=jnp.where(
+                fail, excl | (jnp.arange(excl.shape[0]) == n), excl
+            ),
+            excl_p=p,
             progressed=have_p
             & (any_victim_possible | any_direct_fit | c.prov_active),
             iters=c.iters + 1,
@@ -282,6 +310,8 @@ def preemption_rounds(
         prov_active=jnp.asarray(False),
         prov_p=jnp.asarray(0, jnp.int32),
         prov_n=jnp.asarray(0, jnp.int32),
+        excl=jnp.zeros(snap.num_nodes, bool),
+        excl_p=jnp.asarray(-1, jnp.int32),
         progressed=jnp.asarray(True),
         iters=jnp.asarray(0, jnp.int32),
     )

@@ -13,6 +13,13 @@ then one matmul per sweep step, recomputed against the LIVE state so
 cumulative evictions within one Statement keep respecting every floor.
 A pod under several budgets is evictable only if ALL of them survive
 the eviction (intersection semantics).
+
+Known divergence: Kubernetes' eviction API refuses eviction OUTRIGHT
+for a pod covered by more than one budget (apiserver returns 500,
+regardless of headroom); this plugin instead allows it when every
+covering budget keeps its floor.  Intersection is strictly safer than
+first-match and never violates any individual budget, but it is more
+permissive than upstream's hard multi-PDB refusal.
 """
 
 from __future__ import annotations

@@ -241,6 +241,8 @@ def snapshot_to_numpy(snap, meta) -> dict:
     for name in ("job_queue", "job_min", "job_prio", "job_order"):
         out[name] = np.asarray(getattr(snap, name))[: len(meta.job_names)]
     out["queue_weight"] = np.asarray(snap.queue_weight)[: len(meta.queue_names)]
+    out["task_pdbs"] = np.asarray(snap.task_pdbs)[:Tn]
+    out["pdb_min"] = np.asarray(snap.pdb_min)
     out["eps"] = np.asarray(snap.eps)
     out["besteffort_eps"] = np.asarray(snap.besteffort_eps)
     return out

@@ -5,18 +5,25 @@ reclaim.go · Execute, framework/statement.go): strictly serial —
 
     while a starving (preempt) / wanting (reclaim) job exists:
         preemptor = its rank-first pending task
-        open a Statement
-        pick a target node
-        evict candidate victims ONE BY ONE (vetoes recomputed against
-            the live state after every eviction)
-        the moment the preemptor fits FutureIdle: Commit (pipeline it)
-        victims run out first: Discard (roll everything back)
+        SCAN candidate nodes; per node:
+            open a Statement
+            evict candidate victims ONE BY ONE (vetoes recomputed
+                against the live state after every eviction)
+            the moment the preemptor fits FutureIdle: Commit
+                (pipeline it) — scan over, next preemptor
+            victims run out first: Discard (roll everything back),
+                continue the scan on the next node
 
 Deliberately NumPy + Python loops, sharing NO kernel code with
 ops/preemption.py — divergence between the two is a bug in one of them.
-Node choice mirrors the kernel's published heuristic (fewest victims
-needed, lowest index on ties) so the two are comparable placement-for-
-placement, not just in aggregate.
+The node-scan-with-retry structure is preempt.go's (a discarded
+Statement moves on to the next node; only node exhaustion gives up on
+the preemptor).  VISIT ORDER is the one deliberate divergence from the
+reference: preempt.go walks Go's arbitrary map order; kernel and
+oracle both visit fewest-victims-first (lowest index on ties) — a
+deterministic tie-break of the same search.  Tier-1 victim vetoes
+cover gang minMember survival, conformance criticality, and PDB
+floors (all recomputed live, like the kernel's preemptable_mask).
 
 Status ints mirror api.types.TaskStatus: PENDING=0, ALLOCATED=1,
 PIPELINED=2, BINDING=3, BOUND=4, RUNNING=5, RELEASING=6, SUCCEEDED=7.
@@ -168,10 +175,25 @@ def _stays_above_deserved(w: _World, v: int) -> bool:
     return bool(np.all((d <= alloc) | (d < beps)))
 
 
+def _pdb_at_floor(w: _World) -> np.ndarray | None:
+    """bool[B]: budgets that would be violated by losing one more
+    healthy member (pdb plugin, tier 1 — plugins/pdb.py semantics:
+    healthy = live allocated members per budget)."""
+    pdb_min = w.snap.get("pdb_min")
+    if pdb_min is None or len(pdb_min) == 0:
+        return None
+    healthy = (
+        np.isin(w.task_state, ALLOCATED_SET).astype(np.float64)
+        @ w.snap["task_pdbs"].astype(np.float64)
+    )
+    return healthy - 1 < pdb_min
+
+
 def _candidate_victims(w: _World, p: int, mode: str, jrank, prov: set):
     """Victim candidacy under the LIVE state (recomputed per eviction)."""
     snap = w.snap
     pq, pj = w.task_queue[p], snap["task_job"][p]
+    at_floor = _pdb_at_floor(w)
     out = []
     for v in range(w.T):
         if v in prov:
@@ -184,6 +206,10 @@ def _candidate_victims(w: _World, p: int, mode: str, jrank, prov: set):
             continue
         if not _gang_veto_ok(w, v) or not _conformance_ok(w, v):
             continue  # tier-1 veto (decisive tier)
+        if at_floor is not None and bool(
+            (snap["task_pdbs"][v] * at_floor).sum() > 0
+        ):
+            continue  # pdb plugin: ALL covering budgets must survive
         if mode == "preempt":
             if w.task_queue[v] != pq:
                 continue
@@ -208,16 +234,20 @@ def _sacrifice_order(w: _World, victims, qshare, jrank):
     )
 
 
-def _choose_node(w: _World, p: int, victims, qshare, jrank):
-    """The kernel's heuristic: fewest victims needed (in sacrifice
-    order, current state), lowest node index on ties; 0 victims when the
-    preemptor already fits FutureIdle."""
+def _node_scan_order(w: _World, p: int, victims, qshare, jrank,
+                     excluded: set[int]):
+    """Candidate nodes for preemptor p, in the order the search visits
+    them: fewest victims needed first (in sacrifice order against the
+    current state), lowest node index on ties — the deterministic
+    tie-break both the kernel and this oracle use where preempt.go
+    walks Go's arbitrary map order.  `excluded` nodes (whose Statement
+    already failed for p) are skipped — the retry scan."""
     snap = w.snap
     preq = snap["task_req"][p]
-    best_n, best_k = -1, None
     order = _sacrifice_order(w, victims, qshare, jrank)
+    ranked: list[tuple[int, int]] = []
     for n in range(w.N):
-        if not snap["node_ready"][n]:
+        if n in excluded or not snap["node_ready"][n]:
             continue
         from kube_batch_tpu.sim.oracle import _predicate_ok
 
@@ -239,9 +269,9 @@ def _choose_node(w: _World, p: int, victims, qshare, jrank):
                     break
             if k is None:
                 continue
-        if best_k is None or k < best_k:
-            best_n, best_k = n, k
-    return best_n
+        ranked.append((k, n))
+    ranked.sort()
+    return [n for _k, n in ranked]
 
 
 def serial_preempt(snap: dict, mode: str = "preempt") -> dict:
@@ -301,46 +331,53 @@ def serial_preempt(snap: dict, mode: str = "preempt") -> dict:
         p = min(candidates, key=lambda t: _task_sort_key(w, t, qshare, jrank))
         preq = snap["task_req"][p]
 
-        victims = _candidate_victims(w, p, mode, jrank, set())
-        n = _choose_node(w, p, victims, qshare, jrank)
-        if n < 0:
-            tried.add(p)
-            continue
-
-        # -- the Statement: evict one by one, vetoes recomputed ---------
-        prov: set[int] = set()
-        saved_future = w.future[n].copy()
+        # -- the node scan: try a Statement per candidate node until one
+        # commits (≙ preempt.go iterating nodes, first success wins);
+        # a failed node is excluded and the scan continues -------------
         committed = False
-        while True:
-            if w.fits(preq, w.future[n]):
-                # Commit: pipeline the preemptor
-                w.task_state[p] = PIPELINED
-                w.task_node[p] = n
-                w.future[n] = w.future[n] - preq
+        excluded: set[int] = set()
+        while not committed:
+            victims = _candidate_victims(w, p, mode, jrank, set())
+            scan = _node_scan_order(w, p, victims, qshare, jrank, excluded)
+            if not scan:
+                break  # out of nodes: give up on p for this cycle
+            n = scan[0]
+
+            # -- the Statement: evict one by one, vetoes recomputed ----
+            prov: set[int] = set()
+            saved_future = w.future[n].copy()
+            while True:
+                if w.fits(preq, w.future[n]):
+                    # Commit: pipeline the preemptor
+                    w.task_state[p] = PIPELINED
+                    w.task_node[p] = n
+                    w.future[n] = w.future[n] - preq
+                    for v in prov:
+                        victims_per_job[snap["task_job"][v]] = (
+                            victims_per_job.get(snap["task_job"][v], 0) + 1
+                        )
+                        evicted.append(v)
+                    pipelined.append((p, n))
+                    committed = True
+                    break
+                vics = [
+                    v for v in _candidate_victims(w, p, mode, jrank, prov)
+                    if w.task_node[v] == n
+                ]
+                if not vics:
+                    break
+                order = _sacrifice_order(w, vics, qshare, jrank)
+                v = order[0]
+                prov.add(v)
+                w.task_state[v] = RELEASING
+                w.future[n] = w.future[n] + snap["task_req"][v]
+            if not committed:
+                # Discard: restore provisional victims + node capacity,
+                # exclude this node, retry the next-best one
                 for v in prov:
-                    victims_per_job[snap["task_job"][v]] = (
-                        victims_per_job.get(snap["task_job"][v], 0) + 1
-                    )
-                    evicted.append(v)
-                pipelined.append((p, n))
-                committed = True
-                break
-            vics = [
-                v for v in _candidate_victims(w, p, mode, jrank, prov)
-                if w.task_node[v] == n
-            ]
-            if not vics:
-                break
-            order = _sacrifice_order(w, vics, qshare, jrank)
-            v = order[0]
-            prov.add(v)
-            w.task_state[v] = RELEASING
-            w.future[n] = w.future[n] + snap["task_req"][v]
-        if not committed:
-            # Discard: restore provisional victims + node capacity
-            for v in prov:
-                w.task_state[v] = snap["task_state"][v]
-            w.future[n] = saved_future
+                    w.task_state[v] = snap["task_state"][v]
+                w.future[n] = saved_future
+                excluded.add(n)
         tried.add(p)
 
     return {

@@ -1,0 +1,388 @@
+"""Real-Kubernetes-JSON ingest e2e (VERDICT r3 next #3).
+
+Fixtures below are apiserver-shaped watch events (core/v1 Pod/Node,
+scheduling CRDs, PriorityClass, PDB) replayed through `K8sWatchAdapter`
+— the same path a recorded `kubectl get --watch -o json` feed would
+take.  Covers: quantity parsing, the --scheduler-name adoption filter,
+PriorityClass resolution, shadow PodGroups for bare pods, taints/
+tolerations, affinity lowering, and end-to-end scheduling of an
+adopted gang (reference: pkg/client/, cache/event_handlers.go,
+app/options/options.go).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+
+import pytest
+
+from kube_batch_tpu.api.types import TaskStatus
+from kube_batch_tpu.client.k8s import (
+    K8sWatchAdapter,
+    parse_quantity,
+)
+from kube_batch_tpu.models.workloads import DEFAULT_SPEC
+from kube_batch_tpu.scheduler import Scheduler
+from kube_batch_tpu.sim.simulator import make_world
+
+
+# ---------------------------------------------------------------------------
+# fixture builders: realistic k8s API object JSON
+# ---------------------------------------------------------------------------
+
+def k8s_node(name, cpu="16", mem="64Gi", labels=None, taints=None,
+             ready=True, gpus=None):
+    alloc = {"cpu": cpu, "memory": mem, "pods": "110"}
+    if gpus:
+        alloc["nvidia.com/gpu"] = gpus
+    return {
+        "kind": "Node", "apiVersion": "v1",
+        "metadata": {
+            "name": name, "uid": f"uid-node-{name}",
+            "labels": labels or {},
+            "creationTimestamp": "2026-07-29T08:00:00Z",
+        },
+        "spec": {"taints": taints or []},
+        "status": {
+            "allocatable": alloc,
+            "conditions": [
+                {"type": "Ready", "status": "True" if ready else "False"},
+                {"type": "MemoryPressure", "status": "False"},
+            ],
+        },
+    }
+
+
+def k8s_pod(name, cpu="500m", mem="1Gi", group=None, scheduler="kube-batch",
+            node=None, phase="Pending", priority_class=None, labels=None,
+            node_selector=None, tolerations=None, owner_uid=None,
+            uid=None, gpus=None):
+    requests = {"cpu": cpu, "memory": mem}
+    if gpus:
+        requests["nvidia.com/gpu"] = gpus
+    meta = {
+        "name": name, "namespace": "default",
+        "uid": uid or f"uid-pod-{name}",
+        "labels": labels or {},
+        "creationTimestamp": "2026-07-29T09:00:00Z",
+        "annotations": (
+            {"scheduling.k8s.io/group-name": group} if group else {}
+        ),
+    }
+    if owner_uid:
+        meta["ownerReferences"] = [{
+            "apiVersion": "apps/v1", "kind": "ReplicaSet",
+            "name": "rs", "uid": owner_uid, "controller": True,
+        }]
+    spec = {
+        "schedulerName": scheduler,
+        "containers": [{
+            "name": "main", "image": "img",
+            "resources": {"requests": requests},
+        }],
+    }
+    if priority_class:
+        spec["priorityClassName"] = priority_class
+    if node_selector:
+        spec["nodeSelector"] = node_selector
+    if tolerations:
+        spec["tolerations"] = tolerations
+    if node:
+        spec["nodeName"] = node
+    return {
+        "kind": "Pod", "apiVersion": "v1",
+        "metadata": meta, "spec": spec,
+        "status": {"phase": phase},
+    }
+
+
+def k8s_pod_group(name, min_member, queue="", priority_class=None):
+    spec = {"minMember": min_member}
+    if queue:
+        spec["queue"] = queue
+    if priority_class:
+        spec["priorityClassName"] = priority_class
+    return {
+        "kind": "PodGroup",
+        "apiVersion": "scheduling.incubator.k8s.io/v1alpha1",
+        "metadata": {
+            "name": name, "uid": f"uid-pg-{name}",
+            "creationTimestamp": "2026-07-29T09:00:00Z",
+        },
+        "spec": spec,
+    }
+
+
+def k8s_priority_class(name, value, global_default=False):
+    return {
+        "kind": "PriorityClass",
+        "apiVersion": "scheduling.k8s.io/v1",
+        "metadata": {"name": name},
+        "value": value, "globalDefault": global_default,
+    }
+
+
+def events(*objs, types=None):
+    """Watch-event lines (ADDED unless overridden) + trailing SYNC."""
+    lines = [
+        json.dumps({
+            "type": (types or {}).get(o["metadata"]["name"], "ADDED")
+            if "metadata" in o else "ADDED",
+            "object": o,
+        })
+        for o in objs
+    ]
+    lines.append(json.dumps({"type": "SYNC"}))
+    return io.StringIO("\n".join(lines) + "\n")
+
+
+def replay(stream, scheduler_name="kube-batch"):
+    cache, sim = make_world(DEFAULT_SPEC)
+    adapter = K8sWatchAdapter(
+        cache, stream, scheduler_name=scheduler_name
+    ).start()
+    assert adapter.wait_for_sync(10)
+    adapter.join(10)  # EOF after the fixture replay
+    return cache, sim, adapter
+
+
+# ---------------------------------------------------------------------------
+# quantity parsing
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("q,expected", [
+    ("500m", 0.5), ("2", 2.0), ("1Gi", float(1 << 30)),
+    ("1536Mi", 1536 * float(1 << 20)), ("128974848", 128974848.0),
+    ("12e6", 12e6), ("100k", 1e5), (4, 4.0),
+])
+def test_parse_quantity(q, expected):
+    assert parse_quantity(q) == expected
+
+
+def test_parse_quantity_rejects_garbage():
+    with pytest.raises(ValueError):
+        parse_quantity("1Qx")
+
+
+# ---------------------------------------------------------------------------
+# ingest semantics
+# ---------------------------------------------------------------------------
+
+def test_adopted_gang_schedules_end_to_end():
+    """A PodGroup + members in real k8s JSON, replayed over the wire,
+    must schedule exactly like native objects."""
+    stream = events(
+        k8s_node("n0"), k8s_node("n1"),
+        k8s_pod_group("train", min_member=3),
+        *[k8s_pod(f"train-{i}", group="train", cpu="1", mem="2Gi")
+          for i in range(3)],
+    )
+    cache, sim, _ = replay(stream)
+    ssn = Scheduler(cache).run_once()
+    assert len(ssn.bound) == 3
+    assert len(sim.binds) == 3
+
+
+def test_scheduler_name_filter():
+    """Foreign pending pods are ignored; foreign ASSIGNED pods occupy
+    capacity as unmanaged residents (cache.go's two informer filters)."""
+    stream = events(
+        k8s_node("n0", cpu="4"),
+        # Pending pod owned by the default scheduler: NOT ours.
+        k8s_pod("foreign-pending", scheduler="default-scheduler"),
+        # Assigned pod of another scheduler: occupies n0.
+        k8s_pod("foreign-running", scheduler="default-scheduler",
+                node="n0", phase="Running", cpu="3"),
+        # Ours.
+        k8s_pod_group("mine", min_member=1),
+        k8s_pod("mine-0", group="mine", cpu="2"),
+    )
+    cache, sim, adapter = replay(stream)
+    assert adapter.ignored_pods == 1
+    with cache.lock():
+        assert "uid-pod-foreign-pending" not in cache._pods
+        resident = cache._pods["uid-pod-foreign-running"]
+        assert resident.group is None  # unmanaged ("Others")
+        # foreign resident holds 3 cores of n0's 4
+        assert cache._nodes["n0"].idle[0] == pytest.approx(1000.0)
+    ssn = Scheduler(cache).run_once()
+    # mine-0 wants 2 cores; only 1 idle -> unschedulable
+    assert len(ssn.bound) == 0
+
+
+def test_priority_class_resolution():
+    stream = events(
+        k8s_node("n0"),
+        k8s_priority_class("high", 10000),
+        k8s_priority_class("low", 10, global_default=True),
+        k8s_pod_group("a", min_member=1),
+        k8s_pod("a-0", group="a", priority_class="high"),
+        k8s_pod("a-1", group="a"),                      # falls to default
+        k8s_pod("a-2", group="a", priority_class="nope"),  # unknown
+    )
+    cache, _sim, _ = replay(stream)
+    with cache.lock():
+        assert cache._pods["uid-pod-a-0"].priority == 10000
+        assert cache._pods["uid-pod-a-1"].priority == 10
+        assert cache._pods["uid-pod-a-2"].priority == 10
+
+
+def test_shadow_podgroup_for_bare_pod():
+    """A controller-owned pod without a group annotation gets a shadow
+    PodGroup (minMember 1, default queue) and schedules."""
+    stream = events(
+        k8s_node("n0"),
+        k8s_pod("web-abc12", owner_uid="rs-uid-1"),
+        k8s_pod("web-def34", owner_uid="rs-uid-1"),
+    )
+    cache, sim, _ = replay(stream)
+    with cache.lock():
+        assert cache._pods["uid-pod-web-abc12"].group == "shadow-pg-rs-uid-1"
+        job = cache._jobs["shadow-pg-rs-uid-1"]
+        assert job.queue == "default"
+        assert job.min_available == 1
+    ssn = Scheduler(cache).run_once()
+    assert len(ssn.bound) == 2
+
+
+def test_taints_tolerations_and_selector():
+    stream = events(
+        k8s_node("tainted", taints=[
+            {"key": "dedicated", "value": "ml", "effect": "NoSchedule"},
+        ], labels={"zone": "a"}),
+        k8s_pod_group("g", min_member=2),
+        k8s_pod("tolerates", group="g", tolerations=[
+            {"key": "dedicated", "operator": "Equal", "value": "ml",
+             "effect": "NoSchedule"},
+        ], node_selector={"zone": "a"}),
+        k8s_pod("plain", group="g"),
+    )
+    cache, _sim, _ = replay(stream)
+    with cache.lock():
+        assert cache._nodes["tainted"].node.taints == frozenset(
+            {"dedicated=ml:NoSchedule"}
+        )
+        assert cache._pods["uid-pod-tolerates"].tolerations == frozenset(
+            {"dedicated=ml:NoSchedule"}
+        )
+        assert cache._pods["uid-pod-tolerates"].selector == {"zone": "a"}
+    # gang of 2 with one untolerating pod: nothing binds (all-or-nothing)
+    ssn = Scheduler(cache).run_once()
+    assert len(ssn.bound) == 0
+
+
+def test_pod_lifecycle_modified_deleted():
+    """MODIFIED pods move status/node; Failed pods are dropped; DELETED
+    removes them."""
+    pod = k8s_pod("p0", group="g")
+    stream_lines = [
+        {"type": "ADDED", "object": k8s_node("n0")},
+        {"type": "ADDED", "object": k8s_pod_group("g", min_member=1)},
+        {"type": "ADDED", "object": pod},
+        {"type": "MODIFIED",
+         "object": k8s_pod("p0", group="g", node="n0", phase="Running")},
+        {"type": "SYNC"},
+    ]
+    reader = io.StringIO(
+        "\n".join(json.dumps(x) for x in stream_lines) + "\n"
+    )
+    cache, _sim, adapter = replay(reader)
+    with cache.lock():
+        p = cache._pods["uid-pod-p0"]
+        assert p.status == TaskStatus.RUNNING
+        assert p.node == "n0"
+        assert cache._nodes["n0"].idle[0] < 16000.0
+
+    # Failed transition drops the pod (terminal, frees resources)
+    reader2 = io.StringIO(json.dumps({
+        "type": "MODIFIED",
+        "object": k8s_pod("p0", group="g", node="n0", phase="Failed"),
+    }) + "\n")
+    adapter2 = K8sWatchAdapter(cache, reader2)
+    adapter2.start()
+    adapter2.join(10)
+    with cache.lock():
+        assert "uid-pod-p0" not in cache._pods
+        assert cache._nodes["n0"].idle[0] == pytest.approx(16000.0)
+
+
+def test_gpu_maps_to_accelerator_and_pdb_percentage_skipped():
+    stream = events(
+        k8s_node("gpu-node", gpus="8"),
+        k8s_pod_group("g", min_member=1),
+        k8s_pod("gpu-pod", group="g", gpus="2"),
+        {
+            "kind": "PodDisruptionBudget", "apiVersion": "policy/v1",
+            "metadata": {"name": "pct-pdb", "uid": "uid-pdb-1"},
+            "spec": {"minAvailable": "50%",
+                     "selector": {"matchLabels": {"app": "web"}}},
+        },
+        {
+            "kind": "PodDisruptionBudget", "apiVersion": "policy/v1",
+            "metadata": {"name": "int-pdb", "uid": "uid-pdb-2"},
+            "spec": {"minAvailable": 2,
+                     "selector": {"matchLabels": {"app": "web"}}},
+        },
+        {
+            # maxUnavailable form: not lowerable without live pod counts
+            # — must be skipped loudly, never ingested as floor 0.
+            "kind": "PodDisruptionBudget", "apiVersion": "policy/v1",
+            "metadata": {"name": "maxu-pdb", "uid": "uid-pdb-3"},
+            "spec": {"maxUnavailable": 1,
+                     "selector": {"matchLabels": {"app": "web"}}},
+        },
+    )
+    cache, _sim, _ = replay(stream)
+    with cache.lock():
+        accel_dim = DEFAULT_SPEC.index("accelerator")
+        assert cache._nodes["gpu-node"].allocatable[accel_dim] == 8.0
+        assert cache._pods["uid-pod-gpu-pod"].request["accelerator"] == 2.0
+        assert "pct-pdb" not in cache._pdbs   # loudly skipped
+        assert "maxu-pdb" not in cache._pdbs  # loudly skipped
+        assert cache._pdbs["int-pdb"].min_available == 2
+
+
+def test_affinity_lowering():
+    pod = k8s_pod("aff-pod", group="g")
+    pod["spec"]["affinity"] = {
+        "nodeAffinity": {
+            "requiredDuringSchedulingIgnoredDuringExecution": {
+                "nodeSelectorTerms": [{
+                    "matchExpressions": [
+                        {"key": "disk", "operator": "In", "values": ["ssd"]},
+                    ],
+                }],
+            },
+            "preferredDuringSchedulingIgnoredDuringExecution": [{
+                "weight": 10,
+                "preference": {"matchExpressions": [
+                    {"key": "zone", "operator": "In", "values": ["a"]},
+                ]},
+            }],
+        },
+        "podAffinity": {
+            "requiredDuringSchedulingIgnoredDuringExecution": [{
+                "labelSelector": {"matchLabels": {"app": "db"}},
+                "topologyKey": "topology.kubernetes.io/zone",
+            }],
+        },
+        "podAntiAffinity": {
+            "requiredDuringSchedulingIgnoredDuringExecution": [{
+                "labelSelector": {"matchLabels": {"app": "web"}},
+                "topologyKey": "kubernetes.io/hostname",
+            }],
+        },
+    }
+    stream = events(
+        k8s_node("n0"), k8s_pod_group("g", min_member=1), pod,
+    )
+    cache, _sim, _ = replay(stream)
+    with cache.lock():
+        p = cache._pods["uid-pod-aff-pod"]
+        assert p.selector == {"disk": "ssd"}
+        assert p.preferences == {"zone=a": 10.0}
+        assert p.affinity == frozenset(
+            {"topology.kubernetes.io/zone:app=db"}
+        )
+        assert p.anti_affinity == frozenset({"app=web"})

@@ -24,6 +24,48 @@ def test_run_max_cycles_and_steady_state():
     assert len(sim.binds) == 8
 
 
+def test_idle_cycles_skip_dispatch():
+    """Once nothing is Pending/Releasing and no binds await resync, the
+    cycle skips the solve dispatch entirely (run_once returns None) —
+    and re-engages the moment new work arrives (≙ the reference's
+    runOnce being near-free on an idle cluster)."""
+    import time
+
+    from kube_batch_tpu import metrics
+    from kube_batch_tpu.models.workloads import GI, _node, _pod
+    from kube_batch_tpu.cache.cluster import PodGroup
+
+    cache, sim = build_config(1)
+    s = Scheduler(cache)
+    assert s.run_once() is not None     # places all 8 pods
+    skipped0 = metrics.idle_cycles_skipped.value()
+    t0 = time.perf_counter()
+    assert s.run_once() is None         # idle: no pending, no releasing
+    idle_s = time.perf_counter() - t0
+    assert metrics.idle_cycles_skipped.value() == skipped0 + 1
+    assert idle_s < 0.05                # host-only early-out, no dispatch
+
+    # Bound→Running heartbeats alone still skip (nothing schedulable)...
+    sim.tick()
+    assert s.run_once() is None
+    # ...but refresh the PodGroup phase for the transitioned jobs.
+    with cache.lock():
+        assert all(
+            j.pod_group.running == len(j.tasks)
+            for j in cache._jobs.values()
+        )
+
+    # New pending work re-engages the full cycle.
+    sim.add_node(_node("late-n", cpu_milli=4000, mem=8 * GI))
+    sim.submit(
+        PodGroup(name="late-pg", queue="default", min_member=1),
+        [_pod("late-p", cpu=1000, mem=1 * GI)],
+    )
+    ssn = s.run_once()
+    assert ssn is not None
+    assert ("late-p", "late-n") in ssn.bound or len(ssn.bound) == 1
+
+
 def test_bad_conf_keeps_previous_policy(tmp_path):
     conf = tmp_path / "scheduler.conf"
     conf.write_text("actions: allocate\n")
