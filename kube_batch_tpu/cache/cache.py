@@ -61,7 +61,8 @@ class PackDirty:
     """
 
     __slots__ = ("full", "full_reason", "status_pods", "nodes",
-                 "added_pods", "deleted_pods", "added_jobs", "__weakref__")
+                 "added_pods", "deleted_pods", "added_jobs",
+                 "version", "groups", "__weakref__")
 
     def __init__(self) -> None:
         self.clear()
@@ -76,6 +77,14 @@ class PackDirty:
         self.added_pods: list[str] = []        # pod uids, arrival order
         self.deleted_pods: list[str] = []      # pod uids
         self.added_jobs: list[str] = []        # group names (new or updated)
+        # Idle-refresh bookkeeping: `version` bumps on EVERY pod/job
+        # mark (sets above can absorb a repeat mutation of the same
+        # uid invisibly; the counter cannot), `groups` collects the
+        # affected PodGroup names — together they let the idle-skipping
+        # scheduler refresh exactly when something changed, without
+        # draining the journal the next pack still needs.
+        self.version: int = 0
+        self.groups: set[str] = set()
 
     def mark_full(self, reason: str) -> None:
         if not self.full:
@@ -162,9 +171,12 @@ class SchedulerCache:
         for d in self._dirty_listeners:
             d.mark_full(reason)
 
-    def _mark_status(self, uid: str) -> None:
+    def _mark_status(self, uid: str, group: str | None = None) -> None:
         for d in self._dirty_listeners:
             d.status_pods.add(uid)
+            d.version += 1
+            if group:
+                d.groups.add(group)
 
     def _mark_node(self, name: str | None) -> None:
         if name is None:
@@ -172,17 +184,25 @@ class SchedulerCache:
         for d in self._dirty_listeners:
             d.nodes.add(name)
 
-    def _mark_pod_added(self, uid: str) -> None:
+    def _mark_pod_added(self, uid: str, group: str | None = None) -> None:
         for d in self._dirty_listeners:
             d.added_pods.append(uid)
+            d.version += 1
+            if group:
+                d.groups.add(group)
 
-    def _mark_pod_deleted(self, uid: str) -> None:
+    def _mark_pod_deleted(self, uid: str, group: str | None = None) -> None:
         for d in self._dirty_listeners:
             d.deleted_pods.append(uid)
+            d.version += 1
+            if group:
+                d.groups.add(group)
 
     def _mark_job_added(self, name: str) -> None:
         for d in self._dirty_listeners:
             d.added_jobs.append(name)
+            d.version += 1
+            d.groups.add(name)
 
     # -- events (≙ cache.go · Recorder) ---------------------------------
 
@@ -254,7 +274,7 @@ class SchedulerCache:
                 job.add_task(pod)
             if pod.node is not None:
                 self._node(pod.node).add_task(pod)
-            self._mark_pod_added(pod.uid)
+            self._mark_pod_added(pod.uid, pod.group)
             self._mark_node(pod.node)
 
     def delete_pod(self, pod_uid: str) -> None:
@@ -267,7 +287,7 @@ class SchedulerCache:
                 self._jobs[pod.group].remove_task(pod)
             if pod.node is not None and pod.node in self._nodes:
                 self._nodes[pod.node].remove_task(pod)
-            self._mark_pod_deleted(pod.uid)
+            self._mark_pod_deleted(pod.uid, pod.group)
             self._mark_node(pod.node)
 
     def update_pod_status(
@@ -295,7 +315,7 @@ class SchedulerCache:
                     self._nodes[pod.node].add_task(pod)
                 else:  # node vanished under the pod
                     pod.node = None
-            self._mark_status(pod_uid)
+            self._mark_status(pod_uid, pod.group)
             self._mark_node(pod.node)
 
     def add_node(self, node: Node) -> None:
@@ -564,15 +584,19 @@ class SchedulerCache:
             self.status_updater.update_pod_group(group)
 
     def refresh_job_statuses(self, names) -> None:
-        """Recompute + write back PodGroup statuses for `names`, under
-        the cache lock (event handlers may be mutating job.tasks from an
-        adapter thread; ≙ job_updater.go running against live informers)."""
+        """Recompute PodGroup statuses for `names` under the cache lock
+        (event handlers may be mutating job.tasks from an adapter
+        thread; ≙ job_updater.go running against live informers), then
+        write back only the ones that actually CHANGED — each write is
+        an apiserver round trip on the stream backend."""
         with self._lock:
             groups = [
-                self._jobs[n].refresh_status() for n in names if n in self._jobs
+                self._jobs[n].refresh_status()
+                for n in names if n in self._jobs
             ]
-        for group in groups:
-            self.update_job_status(group)
+        for group, changed in groups:
+            if changed:
+                self.update_job_status(group)
 
     def has_pending_work(self) -> bool:
         """True when a scheduling cycle could possibly act: any pod is

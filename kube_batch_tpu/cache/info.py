@@ -183,16 +183,21 @@ class JobInfo:
                 out += self.spec.pod_vec(t)
         return out
 
-    def refresh_status(self) -> PodGroup:
+    def refresh_status(self) -> tuple[PodGroup, bool]:
         """Recompute the PodGroup status subresource from member tasks
         (≙ framework/job_updater.go batching PodGroup status updates at
         session close): running/succeeded/failed counts, and phase —
         Running once the gang holds minMember running-or-done members,
         Unknown for a broken gang (some members running but below the
-        threshold), Pending otherwise."""
+        threshold), Pending otherwise.  Returns (group, changed):
+        `changed` is False when every status field is identical to the
+        last refresh, so callers skip the write-back — a steady-state
+        daemon must not re-send thousands of identical status updates
+        (one wire round trip each on the stream backend) every second."""
         from kube_batch_tpu.api.types import PodGroupPhase
 
         pg = self.pod_group
+        before = (pg.running, pg.succeeded, pg.failed, pg.phase)
         pg.running = self._count({TaskStatus.RUNNING, TaskStatus.BOUND,
                                   TaskStatus.BINDING})
         pg.succeeded = self._count({TaskStatus.SUCCEEDED})
@@ -203,7 +208,7 @@ class JobInfo:
             pg.phase = PodGroupPhase.UNKNOWN   # gang degraded below minMember
         else:
             pg.phase = PodGroupPhase.PENDING
-        return pg
+        return pg, (pg.running, pg.succeeded, pg.failed, pg.phase) != before
 
     def clone(self, pod_map: dict[str, Pod] | None = None) -> "JobInfo":
         """Deep copy (see NodeInfo.clone for `pod_map`)."""

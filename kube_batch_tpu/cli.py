@@ -254,6 +254,13 @@ def main(argv: list[str] | None = None) -> int:
     if cache_dir:
         logging.info("persistent XLA compile cache: %s", cache_dir)
 
+    # Metrics listener first: it serves in EVERY mode, including the
+    # real-cluster stream path below.
+    if args.listen_address:
+        from kube_batch_tpu import metrics
+
+        metrics.serve(args.listen_address)
+
     if args.cluster_stream:
         # Real-cluster mode: cache fed by the wire, HA on the wire lease.
         if args.workload:
@@ -266,11 +273,6 @@ def main(argv: list[str] | None = None) -> int:
         # stream configured, leadership contends for the CLUSTER-side
         # lease instead (see run_external) — cross-host HA.
         lock = acquire_leadership(args.lock_file)
-
-    if args.listen_address:
-        from kube_batch_tpu import metrics
-
-        metrics.serve(args.listen_address)
 
     cache, sim = load_world(args.workload, args.default_queue)
     scheduler = Scheduler(

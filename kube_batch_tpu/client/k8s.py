@@ -72,7 +72,9 @@ ACCELERATOR_RESOURCES = frozenset({
     "cloud-tpus.google.com/v2", "cloud-tpus.google.com/v3",
 })
 
-_QTY_RE = re.compile(r"^([0-9.eE+-]+)([a-zA-Z]*)$")
+# Mantissa with an OPTIONAL well-formed exponent: a bare trailing E/Ei
+# is a SUFFIX (exa/exbi), not an exponent — "2E" = 2e18, "12e6" = 12e6.
+_QTY_RE = re.compile(r"^([+-]?[0-9.]+(?:[eE][+-]?[0-9]+)?)([a-zA-Z]*)$")
 _SUFFIX = {
     "": 1.0,
     "m": 1e-3,

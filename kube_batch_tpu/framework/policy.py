@@ -312,67 +312,53 @@ class TensorPolicy:
             s = s + w * fn(snap, state)
         return s
 
-    def _static_keys(
-        self, snap: SnapshotTensors, state: AllocState
-    ) -> list[jax.Array]:
-        tq = task_queue_of(snap)
-        tj = jnp.clip(snap.task_job, 0, snap.num_jobs - 1)
-        keys: list[jax.Array] = [snap.task_order.astype(jnp.float32)]
-        # least-significant-first; within each level, later tiers are
-        # less significant than earlier ones → append reversed.
-        for tier_fns in reversed(self.task_order):
-            for fn in reversed(tier_fns):
-                keys.append(fn(snap, state))
-        for tier_fns in reversed(self.job_order):
-            for fn in reversed(tier_fns):
-                keys.append(fn(snap, state)[tj])
-        tns = jnp.clip(snap.task_ns, 0, snap.ns_weight.shape[0] - 1)
-        for tier_fns in reversed(self.namespace_order):
-            for fn in reversed(tier_fns):
-                keys.append(fn(snap, state)[tns])
-        for tier_fns in reversed(self.queue_order):
-            for fn in reversed(tier_fns):
-                keys.append(fn(snap, state)[tq])
-        return keys
-
     def rank_fn(self, snap: SnapshotTensors, state: AllocState) -> jax.Array:
         """i32[T]: global scheduling-order ranks from the tiered
         queue > job > task lexicographic ordering.
 
         When vtime fns are registered (drf/proportion), their
-        virtual-start-time keys are layered in at their level — job
-        vtimes above static job keys of the same tier, queue vtimes
-        above everything — so the rank order reproduces the reference's
-        one-pod-at-a-time share-feedback interleaving."""
-        keys = self._static_keys(snap, state)
-        vtime_levels = [self.job_vtime, self.ns_vtime, self.queue_vtime]
-        if not any(any(map(len, level)) for level in vtime_levels):
-            return rank_from_keys(keys, snap.num_tasks)
-
+        virtual-start-time keys slot in AT THEIR OWN TIER of their own
+        level: a vtime dominates its tier's static keys and everything
+        less significant, but stays subordinate to HIGHER tiers of the
+        same level and to higher levels — drf's tier-2 share WFQ must
+        never reorder across tier-1 priority (the reference's tiered
+        JobOrderFn decides priority first; share feedback only
+        interleaves jobs the decisive tiers left tied).  Each vtime is
+        computed with the so-far-accumulated rank as its within-segment
+        service order, so the per-task interleaving inside a segment
+        reproduces the reference's one-pod-at-a-time share feedback."""
         from kube_batch_tpu.api.types import TaskStatus
 
-        rank = rank_from_keys(keys, snap.num_tasks)
-        pending = (
-            state.task_state == int(TaskStatus.PENDING)
-        ) & snap.task_mask
-        valid = pending & self.eligible_fn(snap, state)
-        # Hierarchical WFQ: each level's virtual start times are
-        # computed with the LOWER levels' rank as the within-segment
-        # service order, then refine the rank (job → namespace →
-        # queue).  A level's vtime is strictly monotone along its input
-        # order WITHIN a segment, so higher levels interleave segments
-        # without overriding lower-level fairness — the composition a
-        # single shared base cannot express (the queue vtime would
-        # otherwise fully order same-queue tasks and erase the
-        # namespace/job interleaving).
-        for level in vtime_levels:
-            for tier_fns in reversed(level):
-                for fn in reversed(tier_fns):
-                    vt = fn(snap, state, rank, valid)
-                    rank = rank_from_keys(
-                        [rank.astype(jnp.float32), vt], snap.num_tasks
-                    )
-        return rank
+        tq = task_queue_of(snap)
+        tj = jnp.clip(snap.task_job, 0, snap.num_jobs - 1)
+        tns = jnp.clip(snap.task_ns, 0, snap.ns_weight.shape[0] - 1)
+        vtime_levels = [self.job_vtime, self.ns_vtime, self.queue_vtime]
+        have_vtime = any(any(map(len, level)) for level in vtime_levels)
+        if have_vtime:
+            pending = (
+                state.task_state == int(TaskStatus.PENDING)
+            ) & snap.task_mask
+            valid = pending & self.eligible_fn(snap, state)
+
+        # least-significant-first; within each level, later tiers are
+        # less significant than earlier ones.
+        keys: list[jax.Array] = [snap.task_order.astype(jnp.float32)]
+        for tier_fns in reversed(self.task_order):
+            for fn in reversed(tier_fns):
+                keys.append(fn(snap, state))
+
+        def level(static_fns, vtime_fns, gather):
+            for t in range(len(static_fns) - 1, -1, -1):
+                for fn in reversed(static_fns[t]):
+                    keys.append(gather(fn(snap, state)))
+                for fn in vtime_fns[t]:
+                    base = rank_from_keys(keys, snap.num_tasks)
+                    keys.append(fn(snap, state, base, valid))
+
+        level(self.job_order, self.job_vtime, lambda k: k[tj])
+        level(self.namespace_order, self.ns_vtime, lambda k: k[tns])
+        level(self.queue_order, self.queue_vtime, lambda k: k[tq])
+        return rank_from_keys(keys, snap.num_tasks)
 
     def job_rank(self, snap: SnapshotTensors, state: AllocState) -> jax.Array:
         """i32[J]: job-level ranks (used by preempt's starving-job order)."""

@@ -82,12 +82,10 @@ class Scheduler:
         # Idle early-out armed only after a full cycle has run under the
         # current policy (a fresh conf must always solve at least once).
         self._idle_armed = False
-        # Idle-refresh bookkeeping: which journal entries have already
-        # had their PodGroup statuses refreshed during skipped cycles
-        # (the journal itself must stay intact for the next real pack,
-        # so progress is tracked here, not by draining it).
-        self._idle_seen_uids: set[str] = set()
-        self._idle_jobs_mark = 0
+        # Journal version already status-refreshed during skipped
+        # cycles (the journal itself must stay intact for the next real
+        # pack, so progress is tracked here, not by draining it).
+        self._idle_refreshed_version = 0
 
     # -- configuration (hot reload) -------------------------------------
     def _build_from_conf(self, conf: SchedulerConf) -> dict:
@@ -289,18 +287,18 @@ class Scheduler:
             return False
         d = self.packer._dirty
         with self.cache.lock():
-            # Only entries NOT already refreshed during earlier skipped
-            # cycles: a 1 Hz idle daemon must not re-send thousands of
-            # identical PodGroup status updates every second.
-            groups = set(d.added_jobs[self._idle_jobs_mark:])
-            self._idle_jobs_mark = len(d.added_jobs)
-            fresh = (set(d.status_pods) | set(d.added_pods)) - \
-                self._idle_seen_uids
-            self._idle_seen_uids.update(fresh)
-            for uid in fresh:
-                pod = self.cache._pods.get(uid)
-                if pod is not None and pod.group:
-                    groups.add(pod.group)
+            # Refresh only when the journal's version moved since the
+            # last refresh — a 1 Hz idle daemon must not recompute (let
+            # alone re-send) thousands of PodGroup statuses every
+            # second.  The version counter catches what the journal's
+            # SETS cannot: a second transition of an already-journaled
+            # pod, and deletions.  refresh_job_statuses itself only
+            # writes back statuses that actually changed.
+            if d.version == self._idle_refreshed_version:
+                groups = None
+            else:
+                groups = set(d.groups)
+                self._idle_refreshed_version = d.version
         if groups:
             self.cache.refresh_job_statuses(groups)
         return True
@@ -326,8 +324,7 @@ class Scheduler:
             self._last_snap = ssn.snap  # shapes for the next conf prewarm
             self._idle_armed = True
             # The pack drained the journal; idle-refresh marks restart.
-            self._idle_seen_uids.clear()
-            self._idle_jobs_mark = 0
+            self._idle_refreshed_version = 0
         if ssn.bound or ssn.evicted:
             result = "scheduled"
         elif np.any(

@@ -99,3 +99,33 @@ def test_drf_lower_share_first():
     Scheduler(cache).run_once()
     bound = {p for p, _ in sim.binds}
     assert bound == {"b-0", "b-1"}, sim.binds
+
+
+def test_priority_dominates_share_feedback():
+    """Tier-1 priority must decide BEFORE tier-2 DRF share feedback:
+    once the high-priority gang holds one placement (its dominant share
+    now exceeds a newcomer's zero share), its REMAINING tasks still
+    outrank the zero-share low-priority job — the WFQ vtime only
+    interleaves jobs the decisive tiers left tied (≙ tiered JobOrderFn:
+    priority plugin tier 1, drf tier 2)."""
+    cache, sim = make_world(SPEC)
+    for i in range(2):
+        sim.add_node(Node(
+            name=f"n{i}",
+            allocatable={"cpu": 2000, "memory": 4 * GI, "pods": 110},
+        ))
+    sim.submit(
+        PodGroup(name="hi", queue="default", min_member=2, priority=1000),
+        [Pod(name=f"hi-{i}",
+             request={"cpu": 2000, "memory": 2 * GI, "pods": 1},
+             priority=1000)
+         for i in range(2)],
+    )
+    sim.submit(
+        PodGroup(name="low", queue="default", min_member=1),
+        [Pod(name="low-0",
+             request={"cpu": 2000, "memory": 2 * GI, "pods": 1})],
+    )
+    Scheduler(cache).run_once()
+    bound = sorted(name for name, _node in sim.binds)
+    assert bound == ["hi-0", "hi-1"], bound  # low-0 must wait

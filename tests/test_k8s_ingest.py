@@ -155,6 +155,7 @@ def replay(stream, scheduler_name="kube-batch"):
     ("500m", 0.5), ("2", 2.0), ("1Gi", float(1 << 30)),
     ("1536Mi", 1536 * float(1 << 20)), ("128974848", 128974848.0),
     ("12e6", 12e6), ("100k", 1e5), (4, 4.0),
+    ("2E", 2e18), ("1Ei", 2.0 ** 60),  # bare E/Ei are SUFFIXES
 ])
 def test_parse_quantity(q, expected):
     assert parse_quantity(q) == expected

@@ -55,6 +55,19 @@ def test_idle_cycles_skip_dispatch():
             for j in cache._jobs.values()
         )
 
+    # A SECOND transition of an already-journaled pod during the idle
+    # stretch must still refresh its group (the journal's version
+    # counter catches what its uid SETS cannot).
+    from kube_batch_tpu.api.types import TaskStatus
+
+    with cache.lock():
+        uid, pod = next(iter(cache._pods.items()))
+        group = pod.group
+    cache.update_pod_status(uid, TaskStatus.SUCCEEDED)
+    assert s.run_once() is None
+    with cache.lock():
+        assert cache._jobs[group].pod_group.succeeded == 1
+
     # New pending work re-engages the full cycle.
     sim.add_node(_node("late-n", cpu_milli=4000, mem=8 * GI))
     sim.submit(
