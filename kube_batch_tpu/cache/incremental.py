@@ -105,6 +105,11 @@ class IncrementalPacker:
         self.full_packs = 0
         self.incremental_packs = 0
         self.last_mode = ""
+        # PodGroups affected by the mutations this pack absorbed
+        # (None after a full rebuild = "all"): close_session refreshes
+        # exactly these instead of recomputing every job's status each
+        # cycle (~O(total tasks) of host Python at flagship scale).
+        self.last_groups: set[str] | None = None
         self.check = os.environ.get("KB_TPU_CHECK_PACK") == "1"
 
     # -- entry point ----------------------------------------------------
@@ -113,13 +118,17 @@ class IncrementalPacker:
         """(SnapshotTensors, SnapshotMeta) for the current cache state."""
         with self.cache.lock():
             d = self._dirty
+            affected = set(d.groups)
             if self._snap is None or d.full:
                 out = self._full(d.full_reason or "first-pack")
+                self.last_groups = None  # object set changed: refresh all
             else:
                 try:
                     out = self._incremental()
+                    self.last_groups = affected
                 except _FullRebuild as exc:
                     out = self._full(exc.reason)
+                    self.last_groups = None
             if self.check:
                 self.verify_against_live()
             return out
@@ -400,6 +409,21 @@ class IncrementalPacker:
         the session skip a per-cycle D2H read of bytes the host already
         has."""
         return self._ints.arrays["task_state"].copy()
+
+    def host_alloc_state(self):
+        """Initial AllocState built from the pack's HOST arrays (fresh
+        copies — the packer patches in place between cycles).  Numpy
+        leaves upload as part of the jitted cycle's argument transfer,
+        so state init costs the daemon zero extra device dispatches."""
+        from kube_batch_tpu.ops.assignment import AllocState
+
+        a = self._ints.arrays
+        return AllocState(
+            task_state=a["task_state"].copy(),
+            task_node=a["task_node"].copy(),
+            node_idle=a["node_idle"].copy(),
+            node_future=a["node_idle"] + a["node_releasing"],
+        )
 
     # -- mechanical invariant check (VERDICT r2 weak #8) ---------------
 

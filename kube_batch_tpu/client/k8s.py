@@ -520,6 +520,12 @@ class K8sWatchAdapter(WatchAdapter):
                 pdb = dec.pdb(obj)
                 if pdb is not None:
                     cache.add_pdb(pdb)
+                else:
+                    # MODIFIED into a non-lowerable form: enforcing the
+                    # STALE previous floor would silently contradict the
+                    # cluster's actual budget — drop it (loudly logged
+                    # by the decoder).
+                    cache.delete_pdb(meta["name"])
         elif kind == "Namespace":
             if mtype == "DELETED":
                 cache.delete_namespace(meta["name"])

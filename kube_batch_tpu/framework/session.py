@@ -88,11 +88,18 @@ class Session:
                     self.host = cache.snapshot(shared=True)
                     self.snap, self.meta = pack_snapshot(self.host)
                 self.initial_task_state = np.asarray(self.snap.task_state)
-        # Lazily materialized (see the `state` property): the fused
-        # cycle computes init_state INSIDE its single dispatch and
-        # overwrites this with the final state, so the daemon path never
-        # builds an initial AllocState on the host at all.
+        # Lazily materialized (see the `state` property).
+        self._packer = packer
         self._state: AllocState | None = None
+        # PodGroups whose statuses need recomputing at close: the
+        # groups this pack's mutations touched (None = all — full
+        # rebuilds and the packer-less path).  This cycle's binds and
+        # evictions add their groups as they land.
+        self._refresh_groups: set[str] | None = (
+            set(packer.last_groups)
+            if packer is not None and packer.last_groups is not None
+            else None
+        )
 
         self.bound: list[tuple[str, str]] = []     # (pod name, node) this cycle
         self.evicted: list[tuple[str, str]] = []   # (pod name, reason)
@@ -112,8 +119,19 @@ class Session:
 
     @property
     def state(self) -> AllocState:
+        """Initial AllocState, materialized on first use.  With a
+        packer, it is built from the packer's HOST arrays: numpy leaves
+        ride the jitted cycle's own argument transfer, so the daemon
+        pays no separate device dispatch for state init (the eager
+        `node_idle + node_releasing` add costs a full tunnel round
+        trip per cycle otherwise).  Folding init_state INTO the jitted
+        cycle is not an option: it flips XLA:TPU into a pathological
+        compile at flagship shapes (see Scheduler._ensure_compiled)."""
         if self._state is None:
-            self._state = init_state(self.snap)
+            if self._packer is not None:
+                self._state = self._packer.host_alloc_state()
+            else:
+                self._state = init_state(self.snap)
         return self._state
 
     @state.setter
@@ -167,6 +185,8 @@ class Session:
             pod = self.meta.task_pods[int(t)]
             if self.cache.evict(pod.uid, reason):
                 self.evicted.append((pod.name, reason))
+                if self._refresh_groups is not None and pod.group:
+                    self._refresh_groups.add(pod.group)
                 metrics.pods_evicted.inc(reason)
 
     def dispatch_binds(self) -> list[tuple[str, str]]:
@@ -192,6 +212,8 @@ class Session:
             node_name = self.meta.node_names[task_node[t]]
             if self.cache.bind(pod.uid, node_name):
                 self.bound.append((pod.name, node_name))
+                if self._refresh_groups is not None and pod.group:
+                    self._refresh_groups.add(pod.group)
                 metrics.pods_bound.inc()
         return self.bound
 
@@ -256,8 +278,15 @@ def close_session(ssn: Session, diagnose: bool = True) -> None:
             plugin.on_session_close(ssn)
     # Status writeback against the LIVE cache jobs, so phases reflect
     # this cycle's binds/evictions (≙ job_updater.go batching PodGroup
-    # status updates at CloseSession).
-    ssn.cache.refresh_job_statuses(ssn.meta.job_names)
+    # status updates at CloseSession).  With an incremental packer the
+    # recompute is targeted: only groups this pack's mutations touched
+    # plus this cycle's bind/evict groups can have changed status —
+    # recomputing all ~thousands of jobs is O(total tasks) of host
+    # Python per cycle for identical results.
+    ssn.cache.refresh_job_statuses(
+        ssn.meta.job_names
+        if ssn._refresh_groups is None else ssn._refresh_groups
+    )
     metrics.pending_tasks.set(
         float(
             np.sum(

@@ -82,6 +82,9 @@ class Scheduler:
         # Idle early-out armed only after a full cycle has run under the
         # current policy (a fresh conf must always solve at least once).
         self._idle_armed = False
+        # Shape keys the fused cycle has been AOT-compiled for (see
+        # _ensure_compiled).
+        self._compiled_shapes: set[tuple] = set()
         # Journal version already status-refreshed during skipped
         # cycles (the journal itself must stay intact for the next real
         # pack, so progress is tracked here, not by draining it).
@@ -100,18 +103,17 @@ class Scheduler:
             import jax
 
             from kube_batch_tpu.actions.fused import make_cycle_solver
-            from kube_batch_tpu.ops.assignment import init_state
 
-            solver = make_cycle_solver(policy, conf.actions)
-
-            # init_state folds INTO the jitted cycle: the daemon's fused
-            # path never pays the eager node_future add (one ~70 ms
-            # tunnel dispatch per cycle) nor materializes an initial
-            # AllocState at all.
-            def cycle(snap, _solver=solver):
-                return _solver(snap, init_state(snap))
-
-            cycle = jax.jit(cycle)
+            # The cycle takes the initial state as an ARGUMENT.  Folding
+            # init_state inside the jit looked like a free dispatch
+            # saved, but it flips XLA:TPU into a pathological compile at
+            # flagship shapes (measured: 29 s with state-arg vs 866 s
+            # with init-inside for the identical 4-action program).  The
+            # dispatch saving is kept a different way: the session
+            # builds the initial state from the packer's HOST arrays, so
+            # the upload rides the jit call's own argument transfer
+            # (framework/session.py · Session.state).
+            cycle = jax.jit(make_cycle_solver(policy, conf.actions))
         except Exception as exc:  # noqa: BLE001 — any build failure must
             # fall back to per-action dispatch, never break the daemon's
             # keep-previous-policy contract (the actions themselves were
@@ -133,6 +135,9 @@ class Scheduler:
         self._actions = built["actions"]
         self._cycle = built["cycle"]
         self._idle_armed = False  # new policy must solve before skipping
+        # The old cycle's id() may be reused by the new callable —
+        # stale shape keys would silently skip the explicit AOT step.
+        self._compiled_shapes.clear()
 
     # If a background warm hasn't finished within this budget, adopt the
     # new conf anyway and let the first cycle compile synchronously —
@@ -156,7 +161,14 @@ class Scheduler:
                 if cycle is not None and snap is not None:
                     import jax
 
-                    out = cycle(snap)
+                    from kube_batch_tpu.ops.assignment import init_state
+
+                    # AOT first (explicit, cache-writing compile step),
+                    # then one real execution so the in-memory
+                    # executable is hot when adopted.
+                    state = init_state(snap)
+                    cycle.lower(snap, state).compile()
+                    out = cycle(snap, state)
                     jax.block_until_ready(out)
             except Exception:  # noqa: BLE001 — warm failure still swaps;
                 # the real cycle will surface (and log) any genuine error
@@ -219,6 +231,37 @@ class Scheduler:
             self._start_prewarm(built)
 
     # -- one cycle (≙ scheduler.go · runOnce) ---------------------------
+    def _ensure_compiled(self, snap, state) -> None:
+        """AOT-compile the fused cycle for `snap`'s shapes before its
+        first execution: the compile becomes an explicit, logged,
+        separately-attributable step, and the persistent compile cache
+        is written even if the first dispatch never completes.
+
+        Measured caveat (2026-07-30, tunneled v5e, flagship 65k-task ×
+        8k-node shapes): XLA:TPU compile time is wildly program-
+        dependent here — the FULL 4-action pipeline compiles in ~30 s,
+        while allocate-only or allocate+backfill programs at the same
+        shapes take the compile service 7-13+ minutes (reproduced cold,
+        drained, AOT and first-call alike; CPU compiles the same
+        programs in ~2-5 s).  The persistent cache makes it a
+        once-per-shape cost; flagship deployments should prefer the
+        full-pipeline conf, which is also what BASELINE config 5
+        exercises."""
+        import dataclasses as _dc
+
+        key = (id(self._cycle),) + tuple(
+            (f.name, tuple(getattr(snap, f.name).shape))
+            for f in _dc.fields(snap)
+        )
+        if key in self._compiled_shapes:
+            return
+        started = time.monotonic()
+        self._cycle.lower(snap, state).compile()
+        took = time.monotonic() - started
+        if took > 1.0:
+            logging.info("fused cycle compiled for new shapes in %.1fs", took)
+        self._compiled_shapes.add(key)
+
     def _execute_fused(self, ssn: Session) -> None:
         """One device dispatch for the whole action pipeline, then commit
         evictions per action on the host (see actions/fused.py)."""
@@ -226,8 +269,11 @@ class Scheduler:
 
         from kube_batch_tpu.actions.preempt import commit_victim_indices
 
+        self._ensure_compiled(ssn.snap, ssn.state)
         with metrics.action_latency.time("fused"):
-            state, evict_masks, job_ready, diag = self._cycle(ssn.snap)
+            state, evict_masks, job_ready, diag = self._cycle(
+                ssn.snap, ssn.state
+            )
             ssn.state = state
             # ONE batched D2H for everything the host will read this
             # cycle: device_get starts every leaf's copy asynchronously
@@ -308,6 +354,13 @@ class Scheduler:
         cycle (nothing to schedule — no dispatch, no session)."""
         with metrics.e2e_latency.time():
             self._reload_conf()
+            # Consume the failed-bind queue (≙ processResyncTask): the
+            # pods are already back to Pending, so this cycle's solve
+            # retries them; consuming keeps the queue bounded and
+            # lets the idle early-out re-arm after recovery.
+            resync = self.cache.drain_resync()
+            if resync:
+                logging.info("retrying %d failed binds", len(resync))
             if self._skip_idle():
                 metrics.idle_cycles_skipped.inc()
                 metrics.schedule_attempts.inc("idle")

@@ -81,3 +81,47 @@ def test_leader_election_blocks_second_acquirer(tmp_path):
     # released → immediate acquisition succeeds
     again = acquire_leadership(lock_path)
     again.close()
+
+
+def test_cluster_stream_mode_end_to_end():
+    """`--cluster-stream HOST:PORT --leader-elect` drives a remote
+    cluster over real TCP: LIST replay builds the cache, binds flow
+    back over the wire, leadership rides the cluster-side lease and is
+    released on shutdown (cli.run_external; ≙ app/server.go wiring
+    leaderelection.RunOrDie around scheduler.Run)."""
+    import socket
+    import threading
+
+    from kube_batch_tpu.cache.cluster import Node, Pod, PodGroup
+    from kube_batch_tpu.cli import main
+    from kube_batch_tpu.client import ExternalCluster
+    from kube_batch_tpu.models.workloads import GI
+
+    srv = socket.create_server(("127.0.0.1", 0))
+    port = srv.getsockname()[1]
+    cluster = ExternalCluster().start()
+    cluster.add_node(Node(
+        name="n0", allocatable={"cpu": 8000, "memory": 16 * GI, "pods": 110},
+    ))
+    cluster.submit(
+        PodGroup(name="g", queue="default", min_member=2),
+        [Pod(name=f"p{i}",
+             request={"cpu": 2000, "memory": 2 * GI, "pods": 1})
+         for i in range(2)],
+    )
+
+    def accept():
+        conn, _ = srv.accept()
+        r = conn.makefile("r", encoding="utf-8")
+        w = conn.makefile("w", encoding="utf-8")
+        cluster.attach(r, w)
+        cluster.replay(w)
+
+    threading.Thread(target=accept, daemon=True).start()
+    rc = main([
+        "--cluster-stream", f"127.0.0.1:{port}", "--leader-elect",
+        "--cycles", "2", "--schedule-period", "0", "--listen-address", "",
+    ])
+    assert rc == 0
+    assert sorted(n for n, _ in cluster.binds) == ["p0", "p1"]
+    assert cluster.lease_holder is None  # released on the way down

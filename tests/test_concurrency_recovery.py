@@ -143,3 +143,29 @@ def test_failed_bind_resyncs_and_retries():
     cache.binder.fail_pods.clear()          # backend recovers
     s.run_once()
     assert ("j-0", "n0") in cache.binder.binds
+
+
+def test_resync_is_consumed_and_idle_skip_rearms():
+    """The scheduler loop itself consumes the failed-bind queue
+    (≙ processResyncTask) — a one-off bind failure must not leave a
+    stale resync entry that permanently disables the idle early-out."""
+    cache = SchedulerCache(spec=SPEC, binder=FakeBinder(), evictor=FakeEvictor())
+    cache.add_node(
+        Node(name="n0", allocatable={"cpu": 4000, "memory": 8 * GI, "pods": 110})
+    )
+    cache.add_pod_group(PodGroup(name="j", queue="default", min_member=1))
+    pod = Pod(name="j-0", group="j",
+              request={"cpu": 1000, "memory": GI, "pods": 1})
+    cache.add_pod(pod)
+
+    cache.binder.fail_pods.add("j-0")
+    s = Scheduler(cache, schedule_period=0.0)
+    s.run_once()                      # bind fails; pod back to Pending
+    cache.binder.fail_pods.clear()
+    s.run_once()                      # retry succeeds, queue consumed
+    assert ("j-0", "n0") in cache.binder.binds
+    from kube_batch_tpu.api.types import TaskStatus
+
+    cache.update_pod_status(pod.uid, TaskStatus.RUNNING)
+    assert not cache.has_pending_work()
+    assert s.run_once() is None       # idle early-out re-armed

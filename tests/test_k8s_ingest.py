@@ -387,3 +387,35 @@ def test_affinity_lowering():
             {"topology.kubernetes.io/zone:app=db"}
         )
         assert p.anti_affinity == frozenset({"app=web"})
+
+
+def test_pdb_modified_to_unlowerable_is_dropped():
+    """A budget edited into a form we cannot lower (percentage /
+    maxUnavailable) must not keep enforcing its STALE previous floor."""
+    stream = events(
+        k8s_node("n0"),
+        {
+            "kind": "PodDisruptionBudget", "apiVersion": "policy/v1",
+            "metadata": {"name": "web-pdb", "uid": "uid-pdb-w"},
+            "spec": {"minAvailable": 3,
+                     "selector": {"matchLabels": {"app": "web"}}},
+        },
+    )
+    cache, _sim, _ = replay(stream)
+    with cache.lock():
+        assert cache._pdbs["web-pdb"].min_available == 3
+
+    modified = io.StringIO(json.dumps({
+        "type": "MODIFIED",
+        "object": {
+            "kind": "PodDisruptionBudget", "apiVersion": "policy/v1",
+            "metadata": {"name": "web-pdb", "uid": "uid-pdb-w"},
+            "spec": {"minAvailable": "50%",
+                     "selector": {"matchLabels": {"app": "web"}}},
+        },
+    }) + "\n")
+    adapter = K8sWatchAdapter(cache, modified)
+    adapter.start()
+    adapter.join(10)
+    with cache.lock():
+        assert "web-pdb" not in cache._pdbs
