@@ -404,18 +404,19 @@ def run_daemon(jax, n: int = 5, steady_cycles: int = 10) -> dict:
     conf.write("actions: " + ", ".join(CONFIG_ACTIONS[n]) + "\n")
     conf.close()
     try:
-        return _run_daemon_phases(jax, cache, sim, conf.name, steady_cycles)
+        return _run_daemon_phases(
+            jax, n, cache, sim, conf.name, steady_cycles
+        )
     finally:
         os.unlink(conf.name)
 
 
-def _run_daemon_phases(jax, cache, sim, conf_path, steady_cycles) -> dict:
+def _run_daemon_phases(jax, n, cache, sim, conf_path, steady_cycles) -> dict:
     from kube_batch_tpu import metrics as _metrics
     from kube_batch_tpu.cache.cluster import PodGroup
     from kube_batch_tpu.models.workloads import GI, _pod
     from kube_batch_tpu.scheduler import Scheduler
 
-    n = 5  # shapes come from the already-built cache; label only
     s = Scheduler(cache, conf_path=conf_path, schedule_period=0.0)
 
     def one_cycle():

@@ -79,11 +79,33 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
-def load_world(spec_arg: str | None, default_queue: str):
-    """Build (cache, simulator) from --workload."""
+def load_world(spec_arg: str | None, default_queue: str,
+               scheduler_name: str = "kube-batch"):
+    """Build (cache, simulator) from --workload: a BASELINE config
+    number, a YAML world file, or a RECORDED KUBERNETES WATCH STREAM
+    (.jsonl of `kubectl get --watch -o json`-shaped events, replayed
+    through the k8s decoder — offline parity with --cluster-stream)."""
     if spec_arg is None:
         spec = ResourceSpec()
         return make_world(spec, default_queue=default_queue)
+    if spec_arg.endswith(".jsonl"):
+        from kube_batch_tpu.client.k8s import K8sWatchAdapter
+
+        cache, sim = make_world(ResourceSpec(), default_queue=default_queue)
+        with open(spec_arg, "r", encoding="utf-8") as f:
+            adapter = K8sWatchAdapter(
+                cache, f, scheduler_name=scheduler_name
+            ).start()
+            adapter.join(60.0)
+            if not adapter.stopped.is_set():
+                # Silently scheduling a half-ingested world is worse
+                # than failing: the replay must reach EOF.
+                raise SystemExit(
+                    f"--workload {spec_arg}: watch replay did not reach "
+                    "EOF within 60s (is this a live stream? use "
+                    "--cluster-stream for those)"
+                )
+        return cache, sim
     if spec_arg.isdigit():
         from kube_batch_tpu.models.workloads import CONFIG_BUILDERS, build_config
 
@@ -274,7 +296,9 @@ def main(argv: list[str] | None = None) -> int:
         # lease instead (see run_external) — cross-host HA.
         lock = acquire_leadership(args.lock_file)
 
-    cache, sim = load_world(args.workload, args.default_queue)
+    cache, sim = load_world(
+        args.workload, args.default_queue, args.scheduler_name
+    )
     scheduler = Scheduler(
         cache,
         conf_path=args.scheduler_conf,

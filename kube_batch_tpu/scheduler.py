@@ -82,9 +82,10 @@ class Scheduler:
         # Idle early-out armed only after a full cycle has run under the
         # current policy (a fresh conf must always solve at least once).
         self._idle_armed = False
-        # Shape keys the fused cycle has been AOT-compiled for (see
-        # _ensure_compiled).
-        self._compiled_shapes: set[tuple] = set()
+        # Shape key → AOT-compiled executable of the fused cycle (see
+        # _ensure_compiled); executed directly, so the compile happens
+        # exactly once per shape bucket.
+        self._compiled_shapes: dict[tuple, object] = {}
         # Journal version already status-refreshed during skipped
         # cycles (the journal itself must stay intact for the next real
         # pack, so progress is tracked here, not by draining it).
@@ -163,12 +164,14 @@ class Scheduler:
 
                     from kube_batch_tpu.ops.assignment import init_state
 
-                    # AOT first (explicit, cache-writing compile step),
-                    # then one real execution so the in-memory
-                    # executable is hot when adopted.
+                    # AOT compile + one real execution so both the
+                    # executable and its warmed dispatch are ready when
+                    # adopted (the executable is re-derived by
+                    # _ensure_compiled on first use, served from the
+                    # persistent cache).
                     state = init_state(snap)
-                    cycle.lower(snap, state).compile()
-                    out = cycle(snap, state)
+                    exe = cycle.lower(snap, state).compile()
+                    out = exe(snap, state)
                     jax.block_until_ready(out)
             except Exception:  # noqa: BLE001 — warm failure still swaps;
                 # the real cycle will surface (and log) any genuine error
@@ -231,7 +234,7 @@ class Scheduler:
             self._start_prewarm(built)
 
     # -- one cycle (≙ scheduler.go · runOnce) ---------------------------
-    def _ensure_compiled(self, snap, state) -> None:
+    def _ensure_compiled(self, snap, state):
         """AOT-compile the fused cycle for `snap`'s shapes before its
         first execution: the compile becomes an explicit, logged,
         separately-attributable step, and the persistent compile cache
@@ -253,14 +256,17 @@ class Scheduler:
             (f.name, tuple(getattr(snap, f.name).shape))
             for f in _dc.fields(snap)
         )
-        if key in self._compiled_shapes:
-            return
-        started = time.monotonic()
-        self._cycle.lower(snap, state).compile()
-        took = time.monotonic() - started
-        if took > 1.0:
-            logging.info("fused cycle compiled for new shapes in %.1fs", took)
-        self._compiled_shapes.add(key)
+        exe = self._compiled_shapes.get(key)
+        if exe is None:
+            started = time.monotonic()
+            exe = self._cycle.lower(snap, state).compile()
+            took = time.monotonic() - started
+            if took > 1.0:
+                logging.info(
+                    "fused cycle compiled for new shapes in %.1fs", took
+                )
+            self._compiled_shapes[key] = exe
+        return exe
 
     def _execute_fused(self, ssn: Session) -> None:
         """One device dispatch for the whole action pipeline, then commit
@@ -269,11 +275,9 @@ class Scheduler:
 
         from kube_batch_tpu.actions.preempt import commit_victim_indices
 
-        self._ensure_compiled(ssn.snap, ssn.state)
+        exe = self._ensure_compiled(ssn.snap, ssn.state)
         with metrics.action_latency.time("fused"):
-            state, evict_masks, job_ready, diag = self._cycle(
-                ssn.snap, ssn.state
-            )
+            state, evict_masks, job_ready, diag = exe(ssn.snap, ssn.state)
             ssn.state = state
             # ONE batched D2H for everything the host will read this
             # cycle: device_get starts every leaf's copy asynchronously

@@ -234,6 +234,44 @@ def _sacrifice_order(w: _World, victims, qshare, jrank):
     )
 
 
+def _affinity_row_ok(w: _World, p: int, n: int) -> bool:
+    """Node-level inter-pod affinity feasibility of preemptor p on node
+    n against the LIVE state (numpy twin of plugins/predicates.py ·
+    pod_affinity_row, the kernel's per-step dyn_predicate_row):
+    required terms need a resident of n carrying the label (with the
+    k8s bootstrap waiver when NO resident anywhere carries it and p
+    itself does); p's anti terms forbid matching residents; residents'
+    anti terms symmetrically forbid p's own labels.  Future-oriented:
+    RELEASING victims are no longer residents — evicting the anchor of
+    p's required affinity must fail the plan."""
+    snap = w.snap
+    aff = snap["task_aff"][p] > 0
+    anti = snap["task_anti"][p] > 0
+    own = snap["task_podlabels"][p] > 0
+    if not (aff.any() or anti.any() or own.any()):
+        return True
+    live = (
+        np.isin(w.task_state, ALLOCATED_SET) | (w.task_state == PIPELINED)
+    ) & (w.task_node >= 0)
+    K = snap["task_podlabels"].shape[1]
+    here = np.zeros(K, bool)       # labels present among n's residents
+    here_anti = np.zeros(K, bool)  # anti terms carried by n's residents
+    anywhere = np.zeros(K, bool)   # labels present among ANY resident
+    for t in np.nonzero(live)[0]:
+        labs = snap["task_podlabels"][t] > 0
+        anywhere |= labs
+        if w.task_node[t] == n:
+            here |= labs
+            here_anti |= snap["task_anti"][t] > 0
+    if not np.all(~aff | here | (own & ~anywhere)):
+        return False               # a required term lacks anchor+waiver
+    if np.any(anti & here):
+        return False               # p's anti term matches a resident
+    if np.any(own & here_anti):
+        return False               # symmetry: a resident repels p
+    return True
+
+
 def _node_scan_order(w: _World, p: int, victims, qshare, jrank,
                      excluded: set[int]):
     """Candidate nodes for preemptor p, in the order the search visits
@@ -253,6 +291,8 @@ def _node_scan_order(w: _World, p: int, victims, qshare, jrank,
 
         if not _predicate_ok(snap, p, n):
             continue
+        if not _affinity_row_ok(w, p, n):
+            continue  # dyn predicate at plan-open (kernel: choose_node)
         if w.fits(preq, w.future[n]):
             k = 0
         else:
@@ -347,6 +387,8 @@ def serial_preempt(snap: dict, mode: str = "preempt") -> dict:
             prov: set[int] = set()
             saved_future = w.future[n].copy()
             while True:
+                if not _affinity_row_ok(w, p, n):
+                    break  # evicted the anchor: plan no longer legal
                 if w.fits(preq, w.future[n]):
                     # Commit: pipeline the preemptor
                     w.task_state[p] = PIPELINED

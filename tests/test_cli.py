@@ -125,3 +125,23 @@ def test_cluster_stream_mode_end_to_end():
     assert rc == 0
     assert sorted(n for n, _ in cluster.binds) == ["p0", "p1"]
     assert cluster.lease_holder is None  # released on the way down
+
+
+def test_workload_k8s_jsonl_replay():
+    """--workload accepts a recorded k8s watch stream (.jsonl): the
+    fixture replays through the k8s decoder and schedules offline —
+    parity with --cluster-stream without a cluster."""
+    from kube_batch_tpu.cli import load_world
+    from kube_batch_tpu.scheduler import Scheduler
+
+    cache, sim = load_world("examples/k8s-world.jsonl", "default")
+    with cache.lock():
+        assert len(cache._nodes) == 3
+        assert cache._jobs["train-job"].min_available == 4
+        # PriorityClass resolved during the replay
+        assert all(
+            p.priority == 1000 for p in cache._pods.values()
+        )
+    ssn = Scheduler(cache).run_once()
+    assert len(ssn.bound) == 4
+    assert len(sim.binds) == 4

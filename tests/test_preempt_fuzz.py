@@ -9,10 +9,13 @@ exact preemptor-set and victims-per-job parity.
 
 Both searches are deterministic (all rank keys end in unique creation
 tie-breaks; node visit order is fewest-victims-first, lowest index on
-ties), so parity is exact, not statistical.  Inter-pod affinity terms
-are exercised by the dedicated kernel tests (test_pod_affinity.py) and
-stay out of this sweep: the oracle deliberately implements only the
-static predicate chain.
+ties), so parity is exact, not statistical.  Arrivals may carry
+node-level inter-pod affinity/anti-affinity terms against the labeled
+runners — the oracle re-evaluates the same dynamic predicate per
+statement step (evicting the preemptor's affinity anchor fails the
+plan, exactly like the kernel's dyn_predicate_row re-check).
+Topology-scoped ("zone:app=web") terms stay with the dedicated kernel
+tests (test_pod_affinity.py).
 
 Reference: actions/preempt/preempt.go · Execute, actions/reclaim/
 reclaim.go · Execute, framework/statement.go.
@@ -114,12 +117,24 @@ def _random_world(seed: int, mode: str):
         sel = {"zone": rng.choice(["a", "b"])} if rng.random() < 0.3 else {}
         tol = frozenset({"dedicated=batch:NoSchedule"}) \
             if tainted and rng.random() < 0.4 else frozenset()
+        # Node-level inter-pod (anti-)affinity against the labeled
+        # runners: sometimes the preemptor must co-locate with an app
+        # (and evicting its anchor must fail the plan), sometimes it
+        # repels one.
+        aff = frozenset()
+        anti = frozenset()
+        r = rng.random()
+        if r < 0.2:
+            aff = frozenset({f"app={rng.choice(['web', 'db', 'cache'])}"})
+        elif r < 0.35:
+            anti = frozenset({f"app={rng.choice(['web', 'db', 'cache'])}"})
         sim.submit(
             PodGroup(name=f"hi{j}", queue=arrival_queue, min_member=size,
                      priority=prio),
             [Pod(name=f"hi{j}-{i}",
                  request={"cpu": 2000, "memory": 4 * GI, "pods": 1},
-                 priority=prio, selector=sel, tolerations=tol)
+                 priority=prio, selector=sel, tolerations=tol,
+                 affinity=aff, anti_affinity=anti)
              for i in range(size)],
         )
     return cache, sim
