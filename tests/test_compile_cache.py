@@ -1,0 +1,42 @@
+"""Persistent compile cache plumbing (kube_batch_tpu/compile_cache.py).
+
+The cache is the daemon's restart-recovery story (doc/design/
+daemon-operations.md); these tests pin the configuration seams — the
+heavy measured behavior (minutes → seconds restarts) lives in the
+bench artifact, not in CI.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from kube_batch_tpu.compile_cache import enable_compile_cache
+
+
+def test_enable_points_jax_at_directory(tmp_path, monkeypatch):
+    target = tmp_path / "xla-cache"
+    got = enable_compile_cache(str(target))
+    assert got == str(target)
+    assert target.is_dir()  # created on demand
+    assert jax.config.jax_compilation_cache_dir == str(target)
+
+
+def test_empty_disables(monkeypatch):
+    assert enable_compile_cache("") is None
+
+
+def test_env_var_override(tmp_path, monkeypatch):
+    target = tmp_path / "from-env"
+    monkeypatch.setenv("KB_TPU_COMPILE_CACHE", str(target))
+    assert enable_compile_cache() == str(target)
+    assert target.is_dir()
+
+
+def test_cli_flag_reaches_config(tmp_path):
+    from kube_batch_tpu.cli import build_parser
+
+    args = build_parser().parse_args(
+        ["--compile-cache-dir", str(tmp_path / "cli-cache")]
+    )
+    got = enable_compile_cache(args.compile_cache_dir)
+    assert got == str(tmp_path / "cli-cache")
