@@ -55,6 +55,11 @@ class StreamBackend:
         self._waiting: set[int] = set()
         self._pending: dict[int, dict] = {}
         self._cv = threading.Condition()
+        # Set by the watch adapter on stream EOF: every in-flight and
+        # future call fails IMMEDIATELY instead of each waiting out its
+        # own timeout — a cycle dispatching thousands of binds against
+        # a dead stream must die fast, not in timeout × binds.
+        self.closed = threading.Event()
 
     # -- called by WatchAdapter's read loop -----------------------------
     def deliver_response(self, msg: dict) -> None:
@@ -64,22 +69,38 @@ class StreamBackend:
             self._pending[msg["id"]] = msg
             self._cv.notify_all()
 
+    def mark_closed(self) -> None:
+        """Stream is gone: wake and fail every waiter."""
+        self.closed.set()
+        with self._cv:
+            self._cv.notify_all()
+
     # -- the round trip -------------------------------------------------
     def _call(self, payload: dict) -> None:
+        if self.closed.is_set():
+            raise ConnectionError("cluster stream closed")
         rid = next(self._ids)
         payload["type"] = "REQUEST"
         payload["id"] = rid
         with self._cv:
             self._waiting.add(rid)
-        with self._wlock:
-            self._writer.write(json.dumps(payload) + "\n")
-            self._writer.flush()
+        try:
+            with self._wlock:
+                self._writer.write(json.dumps(payload) + "\n")
+                self._writer.flush()
+        except (OSError, ValueError) as exc:
+            with self._cv:
+                self._waiting.discard(rid)
+            raise ConnectionError(f"cluster stream closed: {exc}") from exc
         with self._cv:
             ok = self._cv.wait_for(
-                lambda: rid in self._pending, timeout=self._timeout
+                lambda: rid in self._pending or self.closed.is_set(),
+                timeout=self._timeout,
             )
             resp = self._pending.pop(rid, None)
             self._waiting.discard(rid)
+        if resp is None and self.closed.is_set():
+            raise ConnectionError("cluster stream closed")
         if not ok or resp is None:
             raise TimeoutError(f"no response for request {rid} ({payload['verb']})")
         if not resp.get("ok", False):
@@ -246,6 +267,8 @@ class WatchAdapter:
             pass  # stream closed under us — treated as EOF
         finally:
             self.stopped.set()
+            if self._backend is not None:
+                self._backend.mark_closed()  # fail in-flight writes NOW
 
     def _dispatch(self, msg: dict) -> None:
         mtype = msg.get("type")

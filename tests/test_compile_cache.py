@@ -9,11 +9,24 @@ bench artifact, not in CI.
 from __future__ import annotations
 
 import jax
+import pytest
 
 from kube_batch_tpu.compile_cache import enable_compile_cache
 
 
-def test_enable_points_jax_at_directory(tmp_path, monkeypatch):
+@pytest.fixture(autouse=True)
+def _restore_jax_config():
+    """These tests point the GLOBAL jax config at pytest tmp dirs that
+    die with the test — restore it so later >1s compiles in the session
+    don't try to persist into a deleted directory."""
+    old_dir = jax.config.jax_compilation_cache_dir
+    old_min = jax.config.jax_persistent_cache_min_compile_time_secs
+    yield
+    jax.config.update("jax_compilation_cache_dir", old_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", old_min)
+
+
+def test_enable_points_jax_at_directory(tmp_path):
     target = tmp_path / "xla-cache"
     got = enable_compile_cache(str(target))
     assert got == str(target)
@@ -21,7 +34,7 @@ def test_enable_points_jax_at_directory(tmp_path, monkeypatch):
     assert jax.config.jax_compilation_cache_dir == str(target)
 
 
-def test_empty_disables(monkeypatch):
+def test_empty_disables():
     assert enable_compile_cache("") is None
 
 

@@ -169,3 +169,61 @@ def test_standby_takeover_after_leader_death_mid_cycle():
     assert len(cluster.binds) == 8
     b_binds = [n for n, _node in cluster.binds[4:]]
     assert all(n.startswith("b-") for n in b_binds)
+
+
+def test_dead_stream_fails_calls_immediately():
+    """Once the stream is gone, EVERY pending and future backend call
+    fails at once — a cycle mid-way through dispatching thousands of
+    binds must not serially wait out one timeout per bind."""
+    import socket
+    import time as _time
+
+    # No cluster serves the far end: the stream is ALIVE (writes land
+    # in the socket buffer) but unresponsive — the realistic hang.
+    a, b = socket.socketpair()
+    sch_r = b.makefile("r", encoding="utf-8")
+    sch_w = b.makefile("w", encoding="utf-8")
+    backend = StreamBackend(sch_w, timeout=30.0)
+    cache = SchedulerCache(
+        SPEC, binder=backend, evictor=backend, status_updater=backend
+    )
+    adapter = WatchAdapter(cache, sch_r, backend=backend).start()
+
+    # -- a bind IN FLIGHT when the stream dies: the waiter must be
+    # woken and failed by mark_closed, not left to its 30s timeout ----
+    inflight: list = []
+
+    def blocked_bind():
+        t0 = _time.monotonic()
+        try:
+            backend.bind(
+                Pod(name="inflight", request={"cpu": 1, "pods": 1}), "n0"
+            )
+            inflight.append(("bound", _time.monotonic() - t0))
+        except (ConnectionError, TimeoutError) as exc:
+            inflight.append((type(exc).__name__, _time.monotonic() - t0))
+
+    t = threading.Thread(target=blocked_bind)
+    t.start()
+    _time.sleep(0.3)                  # the call is parked in wait_for
+    b.shutdown(socket.SHUT_RDWR)      # the cluster vanishes
+    assert adapter.stopped.wait(5.0)
+    t.join(10.0)
+    assert inflight, "in-flight bind never returned"
+    kind, took = inflight[0]
+    assert kind == "ConnectionError", inflight
+    assert took < 5.0, f"in-flight bind waited {took:.1f}s (not woken)"
+
+    # -- and every SUBSEQUENT call fails at the pre-check -------------
+    t0 = _time.monotonic()
+    failed = 0
+    for i in range(50):               # 50 binds against a dead stream
+        try:
+            backend.bind(
+                Pod(name=f"x{i}", request={"cpu": 1, "pods": 1}), "n0"
+            )
+        except (ConnectionError, TimeoutError):
+            failed += 1
+    took = _time.monotonic() - t0
+    assert failed == 50
+    assert took < 5.0, f"dead-stream binds took {took:.1f}s (not fail-fast)"
