@@ -410,6 +410,17 @@ class IncrementalPacker:
         has."""
         return self._ints.arrays["task_state"].copy()
 
+    def host_field(self, name: str) -> np.ndarray | None:
+        """Read-only zero-copy view of one packed host array (None when
+        the field isn't packed).  Writes through the view raise — the
+        underlying arrays are this packer's live patch state."""
+        arr = self._ints.arrays.get(name)
+        if arr is None:
+            return None
+        view = arr.view()
+        view.flags.writeable = False
+        return view
+
     def host_alloc_state(self):
         """Initial AllocState built from the pack's HOST arrays (fresh
         copies — the packer patches in place between cycles).  Numpy

@@ -91,6 +91,7 @@ class Session:
         # Lazily materialized (see the `state` property).
         self._packer = packer
         self._state: AllocState | None = None
+        self._host_fields: dict[str, np.ndarray] = {}
         # PodGroups whose statuses need recomputing at close: the
         # groups this pack's mutations touched (None = all — full
         # rebuilds and the packer-less path).  This cycle's binds and
@@ -137,6 +138,24 @@ class Session:
     @state.setter
     def state(self, value: AllocState) -> None:
         self._state = value
+
+    def host_snap_field(self, name: str) -> np.ndarray:
+        """Read-only host view of a STATIC snapshot field, cached per
+        session — served from the packer's host arrays when available,
+        because a per-cycle device read of bytes the host already holds
+        costs a full tunnel round trip (~45-70 ms each; three such
+        reads were most of close_session's cost at flagship scale).
+        The packer hands out non-writeable views, so accidental
+        mutation of its live patch state raises instead of corrupting
+        later packs."""
+        arr = self._host_fields.get(name)
+        if arr is None:
+            if self._packer is not None:
+                arr = self._packer.host_field(name)
+            if arr is None:
+                arr = np.asarray(getattr(self.snap, name))
+            self._host_fields[name] = arr
+        return arr
 
     def host_task_state(self) -> np.ndarray:
         """i32[T] host copy of the live task_state (cached; call only
@@ -192,11 +211,10 @@ class Session:
     def dispatch_binds(self) -> list[tuple[str, str]]:
         """Bind every newly allocated task of every JobReady job
         (gang commit; ≙ session.go · Allocate's deferred dispatch)."""
-        snap = self.snap
         task_state = self.host_task_state()
         task_node = self.host_task_node()
         ready = self.job_ready()
-        task_job = np.asarray(snap.task_job)
+        task_job = self.host_snap_field("task_job")
 
         newly_allocated = (
             (task_state == int(TaskStatus.ALLOCATED))
@@ -229,7 +247,7 @@ class Session:
             self.initial_task_state,
             [int(s) for s in READY_STATUSES],
         )
-        task_job = np.asarray(self.snap.task_job)
+        task_job = self.host_snap_field("task_job")
         J = int(self.snap.num_jobs)
         valid = ready & (task_job >= 0)
         return np.bincount(

@@ -75,7 +75,7 @@ class GangPlugin(Plugin):
         # statuses — the shared snapshot's pods keep mutating after the
         # cycle's lock is released (session.snapshot_ready_counts).
         ready_counts = ssn.snapshot_ready_counts()
-        job_min = np.asarray(ssn.snap.job_min)
+        job_min = ssn.host_snap_field("job_min")
         name_to_idx = {n: i for i, n in enumerate(ssn.meta.job_names)}
         for name in ssn.unready_jobs():
             j = name_to_idx.get(name)

@@ -180,9 +180,14 @@ def test_dead_stream_fails_calls_immediately():
 
     # No cluster serves the far end: the stream is ALIVE (writes land
     # in the socket buffer) but unresponsive — the realistic hang.
+    import contextlib
+
+    stack = contextlib.ExitStack()
     a, b = socket.socketpair()
-    sch_r = b.makefile("r", encoding="utf-8")
-    sch_w = b.makefile("w", encoding="utf-8")
+    stack.callback(a.close)
+    stack.callback(b.close)
+    sch_r = stack.enter_context(b.makefile("r", encoding="utf-8"))
+    sch_w = stack.enter_context(b.makefile("w", encoding="utf-8"))
     backend = StreamBackend(sch_w, timeout=30.0)
     cache = SchedulerCache(
         SPEC, binder=backend, evictor=backend, status_updater=backend
@@ -227,3 +232,7 @@ def test_dead_stream_fails_calls_immediately():
     took = _time.monotonic() - t0
     assert failed == 50
     assert took < 5.0, f"dead-stream binds took {took:.1f}s (not fail-fast)"
+    # Teardown: the adapter thread already exited on EOF (stopped set),
+    # so closing the file objects cannot deadlock on the reader lock.
+    adapter.join(5.0)
+    stack.close()
