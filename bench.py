@@ -58,35 +58,63 @@ def _log(msg: str) -> None:
     sys.stderr.flush()
 
 
-def _init_jax():
-    """Import jax with retry + auto/cpu fallback; never raises.
+def _init_jax(timeout_s: float = 120.0):
+    """Import jax with retry + auto/cpu fallback AND a hang watchdog;
+    never raises and never blocks forever.
 
     Returns (jax module | None, platform str | None, error str | None).
     Round 1 died on a transient `Unable to initialize backend 'axon'`
     during the first device transfer; the error message itself advises
     JAX_PLATFORMS='' — so retry the preferred backend with backoff, then
-    fall back to auto-selection, then to CPU explicitly.
+    fall back to auto-selection, then to CPU explicitly.  Round-4
+    lesson: a WEDGED tunnel makes `jax.devices()` HANG rather than
+    raise, and a benchmark that hangs emits nothing — the init runs on
+    a watchdogged thread and a hang degrades to an error entry.
     """
+    import threading
+
     import jax  # imports never fail; only backend init does
 
-    last = None
-    for attempt in range(3):
+    def attempt_init():
+        last = None
+        for attempt in range(3):
+            try:
+                return jax, jax.devices()[0].platform, None
+            except RuntimeError as exc:
+                last = exc
+                time.sleep(2.0 * (attempt + 1))
+        for platforms in ("", "cpu"):
+            try:
+                jax.config.update("jax_platforms", platforms or None)
+                return (
+                    jax,
+                    jax.devices()[0].platform,
+                    f"fell back to JAX_PLATFORMS={platforms!r}: {last}",
+                )
+            except RuntimeError as exc:
+                last = exc
+        return None, None, f"no backend available: {last}"
+
+    result: dict = {}
+
+    def run():
         try:
-            return jax, jax.devices()[0].platform, None
-        except RuntimeError as exc:
-            last = exc
-            time.sleep(2.0 * (attempt + 1))
-    for platforms in ("", "cpu"):
-        try:
-            jax.config.update("jax_platforms", platforms or None)
-            return (
-                jax,
-                jax.devices()[0].platform,
-                f"fell back to JAX_PLATFORMS={platforms!r}: {last}",
-            )
-        except RuntimeError as exc:
-            last = exc
-    return None, None, f"no backend available: {last}"
+            result["r"] = attempt_init()
+        except BaseException as exc:  # noqa: BLE001 — report, don't lose
+            result["r"] = (None, None, f"backend init raised: {exc!r}")
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if "r" not in result:
+        # Distinguish a genuine hang from anything else: the thread is
+        # still alive inside jax.devices().
+        return (
+            None, None,
+            f"backend init hung > {timeout_s:.0f}s (device tunnel down?)"
+            if t.is_alive() else "backend init thread died without result",
+        )
+    return result["r"]
 
 
 def _device_peak_bytes(jax) -> int | None:

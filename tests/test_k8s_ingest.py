@@ -419,3 +419,90 @@ def test_pdb_modified_to_unlowerable_is_dropped():
     adapter.join(10)
     with cache.lock():
         assert "web-pdb" not in cache._pdbs
+
+
+def test_node_modified_updates_conditions_and_capacity():
+    """Node MODIFIED events re-derive readiness, pressure bits and
+    allocatable through the update funnel (≙ UpdateNode)."""
+    stream = events(k8s_node("n0", cpu="16"))
+    cache, _sim, _ = replay(stream)
+    with cache.lock():
+        assert cache._nodes["n0"].node.ready
+
+    modified = dict(k8s_node("n0", cpu="8"))
+    modified["status"]["conditions"] = [
+        {"type": "Ready", "status": "True"},
+        {"type": "MemoryPressure", "status": "True"},
+    ]
+    reader = io.StringIO(json.dumps(
+        {"type": "MODIFIED", "object": modified}
+    ) + "\n")
+    adapter = K8sWatchAdapter(cache, reader)
+    adapter.start(); adapter.join(10)
+    with cache.lock():
+        info = cache._nodes["n0"]
+        assert info.node.memory_pressure
+        assert info.allocatable[0] == 8000.0  # re-derived, cores→milli
+
+    # unschedulable spec flips readiness off
+    cordoned = dict(k8s_node("n0", cpu="8"))
+    cordoned["spec"]["unschedulable"] = True
+    reader = io.StringIO(json.dumps(
+        {"type": "MODIFIED", "object": cordoned}
+    ) + "\n")
+    adapter = K8sWatchAdapter(cache, reader)
+    adapter.start(); adapter.join(10)
+    with cache.lock():
+        assert not cache._nodes["n0"].node.ready
+
+
+def test_podgroup_modified_updates_min_member():
+    """PodGroup MODIFIED re-upserts minMember (≙ the CRD informer's
+    update handler feeding add_pod_group)."""
+    stream = events(
+        k8s_node("n0"),
+        k8s_pod_group("g", min_member=4),
+        k8s_pod("g-0", group="g"),
+    )
+    cache, _sim, _ = replay(stream)
+    with cache.lock():
+        assert cache._jobs["g"].min_available == 4
+
+    reader = io.StringIO(json.dumps({
+        "type": "MODIFIED", "object": k8s_pod_group("g", min_member=1),
+    }) + "\n")
+    adapter = K8sWatchAdapter(cache, reader)
+    adapter.start(); adapter.join(10)
+    with cache.lock():
+        assert cache._jobs["g"].min_available == 1
+    # now schedulable: one member suffices
+    ssn = Scheduler(cache).run_once()
+    assert len(ssn.bound) == 1
+
+
+def test_queue_crd_weight_change():
+    stream = events(
+        k8s_node("n0"),
+        {
+            "kind": "Queue",
+            "apiVersion": "scheduling.incubator.k8s.io/v1alpha1",
+            "metadata": {"name": "prod", "uid": "uid-q-prod"},
+            "spec": {"weight": 3},
+        },
+    )
+    cache, _sim, _ = replay(stream)
+    with cache.lock():
+        assert cache._queues["prod"].weight == 3.0
+    reader = io.StringIO(json.dumps({
+        "type": "MODIFIED",
+        "object": {
+            "kind": "Queue",
+            "apiVersion": "scheduling.incubator.k8s.io/v1alpha1",
+            "metadata": {"name": "prod", "uid": "uid-q-prod"},
+            "spec": {"weight": 5},
+        },
+    }) + "\n")
+    adapter = K8sWatchAdapter(cache, reader)
+    adapter.start(); adapter.join(10)
+    with cache.lock():
+        assert cache._queues["prod"].weight == 5.0

@@ -308,3 +308,57 @@ def test_phase2_gang_floor_blocks_self_cannibalism():
     sim.submit_to_group("gang", _pods("gang-hi", 1, cpu=2000, mem=4 * GI, prio=1000))
     ssn = run_cycle(cache, ["allocate", "preempt"])
     assert ssn.evicted == []  # ready would drop to 1 < minMember 2
+
+
+def test_preempt_retries_next_node_after_failed_plan():
+    """The retry scan (≙ preempt.go iterating nodes after a discarded
+    Statement): the fewest-victims heuristic picks n0 first, whose plan
+    fails mid-statement (gang veto after two evictions), and the
+    preemptor must then succeed on n1 instead of giving up — with n0's
+    provisional evictions fully rolled back."""
+    cache, sim = make_world(SPEC)
+    for i, host in enumerate(("a", "b")):
+        sim.add_node(Node(
+            name=f"n{i}",
+            allocatable={"cpu": 8000, "memory": 16 * GI, "pods": 110},
+            labels={"host": host},
+        ))
+
+    def _pinned(prefix, host):
+        return [
+            Pod(name=f"{prefix}-{i}",
+                request={"cpu": 2000, "memory": 4 * GI, "pods": 1},
+                selector={"host": host})
+            for i in range(4)
+        ]
+
+    # n0's residents: gang with minMember 2 — at most TWO of four may
+    # ever be evicted; a 3-victim plan must discard mid-statement.
+    sim.submit(PodGroup(name="low", queue="default", min_member=2),
+               _pinned("low", "a"))
+    # n1's residents: minMember 1 — three of four are evictable.
+    sim.submit(PodGroup(name="other", queue="default", min_member=1),
+               _pinned("other", "b"))
+    run_cycle(cache, ["allocate"])
+    sim.tick()
+    assert len(sim.binds) == 8
+    with cache.lock():
+        low_on = {cache._pods[u].node for u in cache._pods
+                  if cache._pods[u].name.startswith("low")}
+        assert low_on == {"n0"}  # placement as constructed
+
+    sim.submit(
+        PodGroup(name="high", queue="default", min_member=1, priority=1000),
+        _pods("high", 1, cpu=6000, mem=12 * GI, prio=1000),
+    )
+    ssn = run_cycle(cache, ["allocate", "preempt"])
+    evicted_names = sorted(n for n, _r in ssn.evicted)
+    assert len(evicted_names) == 3, ssn.evicted
+    assert all(n.startswith("other") for n in evicted_names), ssn.evicted
+    # n0's failed plan rolled back completely: every gang member intact
+    with cache.lock():
+        assert all(
+            cache._pods[u].status.name == "RUNNING"
+            for u in cache._pods
+            if cache._pods[u].name.startswith("low")
+        )
