@@ -217,27 +217,33 @@ def run_external(args) -> int:
     ).start()
 
     elector = None
-    if args.leader_elect:
-        elector = LeaseElector(
-            backend, holder=f"{socket.gethostname()}-{os.getpid()}"
-        )
-        logging.info("contending for the cluster lease as %s", elector.holder)
-        if not elector.acquire(stop):
-            logging.error("stream died while standing by for the lease")
-            return 1
-        elector.start_renewing(on_lost=stop.set)
-
-    if not adapter.wait_for_sync(60.0):
-        logging.error("cluster stream never completed its LIST replay")
-        return 1
-
-    scheduler = Scheduler(
-        cache,
-        conf_path=args.scheduler_conf,
-        schedule_period=args.schedule_period,
-        profile_dir=args.profile_dir,
-    )
+    # Everything past a successful acquire runs under the release
+    # finally — a sync timeout must not strand the lease until its TTL
+    # expires (the next contender would wait out the full 15 s on every
+    # supervisor restart loop).
     try:
+        if args.leader_elect:
+            elector = LeaseElector(
+                backend, holder=f"{socket.gethostname()}-{os.getpid()}"
+            )
+            logging.info(
+                "contending for the cluster lease as %s", elector.holder
+            )
+            if not elector.acquire(stop):
+                logging.error("stream died while standing by for the lease")
+                return 1
+            elector.start_renewing(on_lost=stop.set)
+
+        if not adapter.wait_for_sync(60.0):
+            logging.error("cluster stream never completed its LIST replay")
+            return 1
+
+        scheduler = Scheduler(
+            cache,
+            conf_path=args.scheduler_conf,
+            schedule_period=args.schedule_period,
+            profile_dir=args.profile_dir,
+        )
         ran = scheduler.run(stop=stop, max_cycles=args.cycles)
         logging.info("stopped after %d cycles", ran)
     except KeyboardInterrupt:

@@ -260,11 +260,23 @@ class K8sDecoder:
 
         na = aff.get("nodeAffinity") or {}
         req = na.get("requiredDuringSchedulingIgnoredDuringExecution") or {}
-        for term in req.get("nodeSelectorTerms", []):
+        req_terms = req.get("nodeSelectorTerms", [])
+        if len(req_terms) == 1:
             selector.update(_match_labels_terms(
-                {"matchExpressions": term.get("matchExpressions", [])},
+                {"matchExpressions": req_terms[0].get("matchExpressions", [])},
                 f"pod {meta.get('name')}: nodeAffinity",
             ))
+        elif req_terms:
+            # nodeSelectorTerms are OR'd in Kubernetes; the framework's
+            # exact-match selector can only express AND.  Merging the
+            # terms would silently manufacture a WRONG constraint (zone=a
+            # OR zone=b collapsing to zone=b), so multi-term affinity is
+            # skipped loudly like every other non-lowerable construct.
+            log.warning(
+                "pod %s: required nodeAffinity has %d OR'd "
+                "nodeSelectorTerms; not lowerable to exact terms, skipped",
+                meta.get("name"), len(req_terms),
+            )
         for pref in na.get(
             "preferredDuringSchedulingIgnoredDuringExecution", []
         ):

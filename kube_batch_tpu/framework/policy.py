@@ -351,7 +351,9 @@ class TensorPolicy:
             for t in range(len(static_fns) - 1, -1, -1):
                 for fn in reversed(static_fns[t]):
                     keys.append(gather(fn(snap, state)))
-                for fn in vtime_fns[t]:
+                # reversed like the static keys: later-registered =
+                # less significant, so it must be appended FIRST.
+                for fn in reversed(vtime_fns[t]):
                     base = rank_from_keys(keys, snap.num_tasks)
                     keys.append(fn(snap, state, base, valid))
 

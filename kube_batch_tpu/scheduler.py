@@ -139,6 +139,22 @@ class Scheduler:
         # The old cycle's id() may be reused by the new callable —
         # stale shape keys would silently skip the explicit AOT step.
         self._compiled_shapes.clear()
+        # Seed the prewarmed executable (if the warm produced one):
+        # without this the first real cycle re-lowers and recompiles,
+        # and only CLI/bench runs (persistent cache on) get it cheap.
+        compiled = built.get("compiled")
+        if compiled is not None:
+            key, exe = compiled
+            self._compiled_shapes[key] = exe
+
+    @staticmethod
+    def _shape_key(cycle, snap) -> tuple:
+        import dataclasses as _dc
+
+        return (id(cycle),) + tuple(
+            (f.name, tuple(getattr(snap, f.name).shape))
+            for f in _dc.fields(snap)
+        )
 
     # If a background warm hasn't finished within this budget, adopt the
     # new conf anyway and let the first cycle compile synchronously —
@@ -166,13 +182,18 @@ class Scheduler:
 
                     # AOT compile + one real execution so both the
                     # executable and its warmed dispatch are ready when
-                    # adopted (the executable is re-derived by
-                    # _ensure_compiled on first use, served from the
-                    # persistent cache).
+                    # adopted; the executable itself rides into _adopt
+                    # via built["compiled"], so the first real cycle
+                    # executes it directly instead of re-lowering (which
+                    # only CLI/bench runs — persistent cache enabled —
+                    # would get back cheaply).
                     state = init_state(snap)
                     exe = cycle.lower(snap, state).compile()
                     out = exe(snap, state)
                     jax.block_until_ready(out)
+                    built["compiled"] = (
+                        Scheduler._shape_key(cycle, snap), exe
+                    )
             except Exception:  # noqa: BLE001 — warm failure still swaps;
                 # the real cycle will surface (and log) any genuine error
                 logging.exception("conf prewarm failed; swapping anyway")
@@ -250,12 +271,7 @@ class Scheduler:
         once-per-shape cost; flagship deployments should prefer the
         full-pipeline conf, which is also what BASELINE config 5
         exercises."""
-        import dataclasses as _dc
-
-        key = (id(self._cycle),) + tuple(
-            (f.name, tuple(getattr(snap, f.name).shape))
-            for f in _dc.fields(snap)
-        )
+        key = self._shape_key(self._cycle, snap)
         exe = self._compiled_shapes.get(key)
         if exe is None:
             started = time.monotonic()

@@ -389,6 +389,34 @@ def test_affinity_lowering():
         assert p.anti_affinity == frozenset({"app=web"})
 
 
+def test_multi_term_node_affinity_skipped_not_merged():
+    """nodeSelectorTerms are OR'd in Kubernetes; the exact-match
+    selector can only express AND.  zone=a OR zone=b must NOT collapse
+    into zone=b (a wrong, possibly unschedulable constraint) — the
+    multi-term affinity is skipped loudly instead."""
+    pod = k8s_pod("or-pod", group="g")
+    pod["spec"]["affinity"] = {
+        "nodeAffinity": {
+            "requiredDuringSchedulingIgnoredDuringExecution": {
+                "nodeSelectorTerms": [
+                    {"matchExpressions": [
+                        {"key": "zone", "operator": "In", "values": ["a"]},
+                    ]},
+                    {"matchExpressions": [
+                        {"key": "zone", "operator": "In", "values": ["b"]},
+                    ]},
+                ],
+            },
+        },
+    }
+    stream = events(
+        k8s_node("n0"), k8s_pod_group("g", min_member=1), pod,
+    )
+    cache, _sim, _ = replay(stream)
+    with cache.lock():
+        assert cache._pods["uid-pod-or-pod"].selector == {}
+
+
 def test_pdb_modified_to_unlowerable_is_dropped():
     """A budget edited into a form we cannot lower (percentage /
     maxUnavailable) must not keep enforcing its STALE previous floor."""
