@@ -145,6 +145,12 @@ class SchedulerCache:
         # repeats aggregate into one record's count (k8s-style).
         self.events: collections.deque = collections.deque(maxlen=10000)
         self._event_index: dict[tuple, object] = {}
+        # Optional write-side event forwarding (≙ the Recorder POSTing
+        # core/v1 Events to the apiserver): when set, every recorded
+        # event is ALSO pushed through the sink — the k8s stream
+        # backend implements it (client/k8s_write.py); None keeps
+        # events in-process only.
+        self.event_sink = None
         # Change journals for incremental packers (see PackDirty).
         # Weakly held: a Scheduler constructs one per IncrementalPacker,
         # and recreating schedulers on a long-lived cache must not leak
@@ -206,8 +212,12 @@ class SchedulerCache:
 
     # -- events (≙ cache.go · Recorder) ---------------------------------
 
-    def record_event(self, kind: str, name: str, reason: str, message: str):
-        """Record (or aggregate) one structured event; returns it."""
+    def record_event(self, kind: str, name: str, reason: str, message: str,
+                     namespace: str = "default"):
+        """Record (or aggregate) one structured event; returns it.
+        With an `event_sink` set, the event is also forwarded (outside
+        the lock — sinks may touch the wire) with its aggregate count,
+        ≙ the reference's Recorder posting Events to the apiserver."""
         from kube_batch_tpu.api.types import Event
 
         with self._lock:
@@ -215,19 +225,25 @@ class SchedulerCache:
             ev = self._event_index.get(key)
             if ev is not None:
                 ev.count += 1
-                return ev
-            ev = Event(kind=kind, name=name, reason=reason, message=message)
-            if (
-                self.events.maxlen is not None
-                and len(self.events) == self.events.maxlen
-            ):
-                old = self.events[0]  # about to be evicted by append
-                self._event_index.pop(
-                    (old.kind, old.name, old.reason, old.message), None
-                )
-            self.events.append(ev)
-            self._event_index[key] = ev
-            return ev
+            else:
+                ev = Event(kind=kind, name=name, reason=reason,
+                           message=message)
+                if (
+                    self.events.maxlen is not None
+                    and len(self.events) == self.events.maxlen
+                ):
+                    old = self.events[0]  # about to be evicted by append
+                    self._event_index.pop(
+                        (old.kind, old.name, old.reason, old.message), None
+                    )
+                self.events.append(ev)
+                self._event_index[key] = ev
+        if self.event_sink is not None:
+            self.event_sink.record_event(
+                kind, name, reason, message,
+                count=ev.count, namespace=namespace,
+            )
+        return ev
 
     def events_for(self, kind: str, name: str) -> list:
         """Events attached to one object (filterable, unlike a string log)."""
@@ -539,6 +555,7 @@ class SchedulerCache:
                 self.record_event(
                     "Pod", pod.name, "BindFailed",
                     f"bind-failed: unknown node {node_name}",
+                    namespace=pod.namespace,
                 )
                 return False
             self.update_pod_status(pod_uid, TaskStatus.BINDING, node=node_name)
@@ -554,11 +571,13 @@ class SchedulerCache:
                 self.update_pod_status(pod_uid, TaskStatus.PENDING)
                 self._resync.append(pod_uid)
             self.record_event("Pod", pod.name, "BindFailed",
-                              f"bind-failed: {exc}")
+                              f"bind-failed: {exc}",
+                              namespace=pod.namespace)
             return False
         with self._lock:
             self.update_pod_status(pod_uid, TaskStatus.BOUND)
-        self.record_event("Pod", pod.name, "Bound", f"bound -> {node_name}")
+        self.record_event("Pod", pod.name, "Bound", f"bound -> {node_name}",
+                          namespace=pod.namespace)
         return True
 
     def evict(self, pod_uid: str, reason: str) -> bool:
@@ -574,9 +593,11 @@ class SchedulerCache:
             with self._lock:
                 self.update_pod_status(pod_uid, prev_status)
             self.record_event("Pod", pod.name, "EvictFailed",
-                              f"evict-failed: {exc}")
+                              f"evict-failed: {exc}",
+                              namespace=pod.namespace)
             return False
-        self.record_event("Pod", pod.name, "Evicted", f"evicted: {reason}")
+        self.record_event("Pod", pod.name, "Evicted", f"evicted: {reason}",
+                          namespace=pod.namespace)
         return True
 
     def update_job_status(self, group: PodGroup) -> None:

@@ -65,6 +65,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "apiserver seam); replaces --workload, accepts "
                         "native or k8s-format events, and moves "
                         "--leader-elect onto the wire lease")
+    p.add_argument("--write-format", choices=("native", "k8s"),
+                   default="native",
+                   help="wire dialect for scheduling decisions: 'k8s' "
+                        "emits apiserver-shaped writes (Binding POST, "
+                        "graceful pod DELETE, PodGroup status update, "
+                        "core/v1 Events); 'native' (default) keeps the "
+                        "compact framework verbs")
     p.add_argument("--cycles", type=int, default=None,
                    help="stop after N cycles (default: run forever)")
     p.add_argument("--profile-dir", default=None,
@@ -195,7 +202,12 @@ def run_external(args) -> int:
     sock = socket.create_connection((host or "127.0.0.1", int(port)))
     reader = sock.makefile("r", encoding="utf-8")
     writer = sock.makefile("w", encoding="utf-8")
-    backend = StreamBackend(writer)
+    if args.write_format == "k8s":
+        from kube_batch_tpu.client.k8s_write import K8sStreamBackend
+
+        backend = K8sStreamBackend(writer)
+    else:
+        backend = StreamBackend(writer)
     cache = SchedulerCache(
         spec=ResourceSpec(),
         binder=backend,
@@ -203,6 +215,9 @@ def run_external(args) -> int:
         status_updater=backend,
         default_queue=args.default_queue,
     )
+    if args.write_format == "k8s":
+        # Events leave the process too in k8s mode (≙ the Recorder).
+        cache.event_sink = backend
     adapter = K8sWatchAdapter(
         cache, reader, backend=backend, scheduler_name=args.scheduler_name
     ).start()

@@ -70,6 +70,11 @@ class ExternalCluster:
         self.binds: list[tuple[str, str]] = []
         self.evictions: list[tuple[str, str]] = []
         self.status_updates: list[PodGroup] = []
+        # k8s-dialect write log: every apiserver-shaped request as it
+        # arrived on the wire — (verb, path, object) — so tests can
+        # assert the exact shapes a real apiserver would receive.
+        self.k8s_writes: list[tuple[str, str, dict]] = []
+        self.k8s_events: list[dict] = []  # core/v1 Event objects POSTed
         self.fail_bind_pods: set[str] = set()  # inject failures by pod name
         self._threads: list[threading.Thread] = []
         self._started = False
@@ -258,37 +263,142 @@ class ExternalCluster:
         self.lease_expires = now + ttl
         self._respond(writer, rid, True)
 
+    # -- apiserver-dialect writes (client/k8s_write.py shapes) ----------
+    def _find_pod(self, namespace: str, name: str) -> Pod | None:
+        for pod in self.pods.values():
+            if pod.namespace == namespace and pod.name == name:
+                return pod
+        return None
+
+    def _bind_pod(self, writer, rid: int, pod: Pod | None,
+                  node_name: str) -> None:
+        """Shared bind semantics for both wire dialects."""
+        if pod is None:
+            self._respond(writer, rid, False, "pod not found")
+        elif pod.name in self.fail_bind_pods:
+            self._respond(writer, rid, False, "injected bind failure")
+        elif node_name not in self.nodes:
+            self._respond(writer, rid, False, f"node {node_name} not found")
+        else:
+            pod.node = node_name
+            pod.status = TaskStatus.BOUND
+            self.binds.append((pod.name, node_name))
+            self._respond(writer, rid, True)
+            self._emit("MODIFIED", "Pod", encode_pod(pod))
+
+    def _evict_pod(self, writer, rid: int, pod: Pod | None,
+                   reason: str) -> None:
+        if pod is None:
+            self._respond(writer, rid, False, "pod not found")
+        else:
+            pod.node = None
+            pod.status = TaskStatus.PENDING
+            self.evictions.append((pod.name, reason))
+            self._respond(writer, rid, True)
+            self._emit("MODIFIED", "Pod", encode_pod(pod))
+
+    def _handle_k8s(self, writer, msg: dict) -> None:
+        """Route an apiserver-shaped request (verb + resource path +
+        k8s body) the way a real apiserver would, validating the shapes
+        the reference's REST calls carry."""
+        import re
+
+        verb, rid = msg.get("verb"), msg["id"]
+        path, obj = msg.get("path", ""), msg.get("object") or {}
+        self.k8s_writes.append((verb, path, obj))
+
+        m = re.fullmatch(r"/api/v1/namespaces/([^/]+)/pods/([^/]+)/binding",
+                         path)
+        if m and verb == "create":
+            if obj.get("kind") != "Binding" or \
+                    obj.get("target", {}).get("kind") != "Node":
+                self._respond(writer, rid, False,
+                              "malformed Binding object")
+                return
+            if obj.get("metadata", {}).get("name") != m.group(2):
+                self._respond(writer, rid, False,
+                              "Binding name does not match path")
+                return
+            self._bind_pod(writer, rid, self._find_pod(*m.groups()),
+                           obj["target"].get("name", ""))
+            return
+
+        m = re.fullmatch(r"/api/v1/namespaces/([^/]+)/pods/([^/]+)", path)
+        if m and verb == "delete":
+            pod = self._find_pod(*m.groups())
+            want_uid = (obj.get("preconditions") or {}).get("uid")
+            if pod is not None and want_uid and pod.uid != want_uid:
+                # ≙ apiserver 409: the named pod is not the one the
+                # eviction decision was made against.
+                self._respond(writer, rid, False,
+                              "precondition failed: uid mismatch")
+                return
+            self._evict_pod(writer, rid, pod, "k8s-delete")
+            return
+
+        m = re.fullmatch(
+            r"/apis/[^/]+/v1alpha\d/namespaces/([^/]+)/"
+            r"podgroups/([^/]+)/status", path,
+        )
+        if m and verb == "update":
+            if obj.get("kind") != "PodGroup" or "status" not in obj:
+                self._respond(writer, rid, False,
+                              "malformed PodGroup status object")
+                return
+            name, status = m.group(2), obj["status"]
+            group = self.groups.get(name)
+            if group is not None:
+                from kube_batch_tpu.api.types import (
+                    PodGroupCondition,
+                    PodGroupPhase,
+                )
+
+                group.phase = PodGroupPhase(status.get("phase", "Pending"))
+                group.running = int(status.get("running", 0))
+                group.succeeded = int(status.get("succeeded", 0))
+                group.failed = int(status.get("failed", 0))
+                group.conditions = [
+                    PodGroupCondition(
+                        type=c.get("type", "Note"),
+                        status=c.get("status") == "True",
+                        reason=c.get("reason", ""),
+                        message=c.get("message", ""),
+                    )
+                    for c in status.get("conditions", [])
+                ]
+                self.status_updates.append(group)
+            self._respond(writer, rid, group is not None,
+                          "" if group is not None else "podgroup not found")
+            return
+
+        m = re.fullmatch(r"/api/v1/namespaces/([^/]+)/events", path)
+        if m and verb == "create":
+            if obj.get("kind") != "Event" or "involvedObject" not in obj:
+                self._respond(writer, rid, False, "malformed Event object")
+                return
+            self.k8s_events.append(obj)
+            self._respond(writer, rid, True)
+            return
+
+        self._respond(writer, rid, False,
+                      f"unhandled k8s request {verb} {path}")
+
     def _handle(self, writer: IO[str], msg: dict) -> None:
         verb, rid = msg.get("verb"), msg["id"]
         with self._lock:
-            if verb in ("acquireLease", "renewLease", "releaseLease"):
+            if "path" in msg:  # apiserver-dialect write
+                self._handle_k8s(writer, msg)
+            elif verb in ("acquireLease", "renewLease", "releaseLease"):
                 self._handle_lease(writer, verb, msg)
             elif verb == "bind":
-                pod = self.pods.get(msg["pod"])
-                if pod is None:
-                    self._respond(writer, rid, False, "pod not found")
-                elif pod.name in self.fail_bind_pods:
-                    self._respond(writer, rid, False, "injected bind failure")
-                elif msg["node"] not in self.nodes:
-                    self._respond(
-                        writer, rid, False, f"node {msg['node']} not found"
-                    )
-                else:
-                    pod.node = msg["node"]
-                    pod.status = TaskStatus.BOUND
-                    self.binds.append((pod.name, msg["node"]))
-                    self._respond(writer, rid, True)
-                    self._emit("MODIFIED", "Pod", encode_pod(pod))
+                self._bind_pod(
+                    writer, rid, self.pods.get(msg["pod"]), msg["node"]
+                )
             elif verb == "evict":
-                pod = self.pods.get(msg["pod"])
-                if pod is None:
-                    self._respond(writer, rid, False, "pod not found")
-                else:
-                    pod.node = None
-                    pod.status = TaskStatus.PENDING
-                    self.evictions.append((pod.name, msg.get("reason", "")))
-                    self._respond(writer, rid, True)
-                    self._emit("MODIFIED", "Pod", encode_pod(pod))
+                self._evict_pod(
+                    writer, rid, self.pods.get(msg["pod"]),
+                    msg.get("reason", ""),
+                )
             elif verb == "updatePodGroup":
                 from kube_batch_tpu.client.codec import decode_pod_group
 

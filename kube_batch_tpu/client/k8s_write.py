@@ -1,0 +1,261 @@
+"""Kubernetes-shaped WRITE side of the cluster wire (VERDICT r4 next #2).
+
+Reference counterpart: the REST writes kube-batch issues against the
+apiserver —
+
+* cache/cache.go · Bind: ``defaultBinder`` POSTs a core/v1 ``Binding``
+  to the pod's ``binding`` subresource
+  (``POST /api/v1/namespaces/{ns}/pods/{name}/binding``);
+* cache/cache.go · Evict: ``defaultEvictor`` issues a graceful pod
+  DELETE (``DELETE /api/v1/namespaces/{ns}/pods/{name}`` with
+  DeleteOptions);
+* framework/job_updater.go: PodGroup STATUS updates against the
+  v1alpha1 ``status`` subresource;
+* cache/cache.go · Recorder: core/v1 ``Event`` objects POSTed to the
+  involved object's namespace.
+
+`K8sStreamBackend` emits these SAME shapes over the JSON-lines wire:
+each request carries the HTTP verb, the apiserver resource path, and
+the exact body a REST client would send — so an apiserver-shaped
+consumer can replay them against a real cluster verbatim, and the
+fixture tests can assert the wire shapes byte-for-byte.  Reads were
+already k8s-capable (client/k8s.py); with this module the scheduler
+speaks Kubernetes in BOTH directions.
+
+Lowering notes: the framework's PodGroup carries no namespace (the CRD
+is namespaced upstream) — status updates and PodGroup events are
+addressed to ``default``; eviction reasons ride the accompanying
+``Event`` (a pod DELETE has no reason field upstream either).
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+import threading
+import time
+from typing import Any
+
+from kube_batch_tpu.api.types import PodGroupCondition
+from kube_batch_tpu.cache.cluster import Pod, PodGroup
+from kube_batch_tpu.client.adapter import StreamBackend
+
+#: apiVersion the reference's CRDs live under (shivramsrivastava fork
+#: tracks upstream: scheduling.incubator.k8s.io/v1alpha1).
+PODGROUP_API_VERSION = "scheduling.incubator.k8s.io/v1alpha1"
+#: ≙ the grace period defaultEvictor's DELETE rides on (pod default).
+EVICT_GRACE_SECONDS = 30
+#: Event reasons that map to a Warning-type Event (k8s convention:
+#: failures warn, lifecycle is Normal).
+_WARNING_REASONS = frozenset({
+    "BindFailed", "EvictFailed", "FailedScheduling", "Unschedulable",
+})
+
+
+def binding_request(pod: Pod, node_name: str) -> dict[str, Any]:
+    """≙ defaultBinder: POST core/v1 Binding to the binding subresource."""
+    return {
+        "verb": "create",
+        "path": (
+            f"/api/v1/namespaces/{pod.namespace}/pods/{pod.name}/binding"
+        ),
+        "object": {
+            "apiVersion": "v1",
+            "kind": "Binding",
+            "metadata": {
+                "name": pod.name,
+                "namespace": pod.namespace,
+                "uid": pod.uid,
+            },
+            "target": {
+                "apiVersion": "v1",
+                "kind": "Node",
+                "name": node_name,
+            },
+        },
+    }
+
+
+def evict_request(pod: Pod) -> dict[str, Any]:
+    """≙ defaultEvictor: graceful pod DELETE with a uid precondition
+    (delete exactly the pod the decision was made against, not a
+    same-named successor)."""
+    return {
+        "verb": "delete",
+        "path": f"/api/v1/namespaces/{pod.namespace}/pods/{pod.name}",
+        "object": {
+            "apiVersion": "v1",
+            "kind": "DeleteOptions",
+            "gracePeriodSeconds": EVICT_GRACE_SECONDS,
+            "preconditions": {"uid": pod.uid},
+        },
+    }
+
+
+def pod_group_status_request(group: PodGroup) -> dict[str, Any]:
+    """≙ job_updater.go: update the PodGroup status subresource."""
+    return {
+        "verb": "update",
+        "path": (
+            f"/apis/{PODGROUP_API_VERSION}/namespaces/default/"
+            f"podgroups/{group.name}/status"
+        ),
+        "object": {
+            "apiVersion": PODGROUP_API_VERSION,
+            "kind": "PodGroup",
+            "metadata": {
+                "name": group.name,
+                "namespace": "default",
+                "uid": group.uid,
+            },
+            "status": {
+                "phase": str(group.phase),
+                "running": group.running,
+                "succeeded": group.succeeded,
+                "failed": group.failed,
+                "conditions": [
+                    {
+                        "type": c.type,
+                        "status": "True" if c.status else "False",
+                        "reason": c.reason,
+                        "message": c.message,
+                    }
+                    if isinstance(c, PodGroupCondition)
+                    else {"type": "Note", "status": "True",
+                          "reason": "", "message": str(c)}
+                    for c in group.conditions
+                ],
+            },
+        },
+    }
+
+
+def event_request(
+    kind: str,
+    name: str,
+    reason: str,
+    message: str,
+    count: int = 1,
+    namespace: str = "default",
+    sequence: int = 0,
+) -> dict[str, Any]:
+    """≙ cache.go · Recorder: POST a core/v1 Event naming the involved
+    object.  `sequence` disambiguates event names the way the client-go
+    recorder's timestamp suffix does."""
+    if kind == "PodGroup":
+        api_version = PODGROUP_API_VERSION
+    elif kind in ("Pod", "Node"):
+        api_version = "v1"
+    else:
+        api_version = ""
+    return {
+        "verb": "create",
+        "path": f"/api/v1/namespaces/{namespace}/events",
+        "object": {
+            "apiVersion": "v1",
+            "kind": "Event",
+            "metadata": {
+                "name": f"{name or 'scheduler'}.{sequence:08x}",
+                "namespace": namespace,
+            },
+            "involvedObject": {
+                "apiVersion": api_version,
+                "kind": kind,
+                "name": name,
+                "namespace": namespace,
+            },
+            "reason": reason,
+            "message": message,
+            "count": count,
+            "type": "Warning" if reason in _WARNING_REASONS else "Normal",
+            "source": {"component": "kube-batch-tpu"},
+        },
+    }
+
+
+class K8sStreamBackend(StreamBackend):
+    """Binder/Evictor/StatusUpdater/EventSink emitting apiserver-shaped
+    writes (verb + resource path + k8s body) over the correlated wire.
+
+    Drop-in for `StreamBackend` behind the same cache seam; selected by
+    ``--write-format k8s``.  Scheduling semantics are identical — only
+    the wire dialect changes, so a consumer that speaks apiserver can
+    relay the requests to a real cluster unmodified.
+    """
+
+    def __init__(self, writer, timeout: float = 10.0) -> None:
+        super().__init__(writer, timeout)
+        # Seeded with wall-clock nanoseconds so event names stay unique
+        # ACROSS restarts (≙ client-go's timestamp suffix): a relayed
+        # POST re-using a previous process's name would 409 on a real
+        # apiserver and the event would be silently lost.
+        self._event_seq = itertools.count(time.time_ns())
+        # Bounded hand-off queue + one flusher thread: recording an
+        # event must never block the scheduling path, even on a wedged
+        # (alive but unread) stream whose send buffer is full — only
+        # the flusher blocks there.  Overflow drops oldest (events are
+        # best-effort, exactly like a saturated client-go recorder).
+        self._event_q: collections.deque[dict] = collections.deque(maxlen=1000)
+        self._event_ready = threading.Event()
+        self._flusher = threading.Thread(
+            target=self._flush_events, daemon=True
+        )
+        self._flusher.start()
+
+    def _flush_events(self) -> None:
+        import json
+
+        while not self.closed.is_set():
+            self._event_ready.wait(0.5)
+            self._event_ready.clear()
+            while True:
+                try:
+                    payload = self._event_q.popleft()
+                except IndexError:
+                    break
+                try:
+                    with self._wlock:
+                        self._writer.write(json.dumps(payload) + "\n")
+                        self._writer.flush()
+                except (OSError, ValueError):
+                    return  # stream died; the watch loop handles it
+
+    # -- the Binder/Evictor/StatusUpdater seam --------------------------
+    def bind(self, pod: Pod, node_name: str) -> None:
+        self._call(binding_request(pod, node_name))
+
+    def evict(self, pod: Pod, reason: str) -> None:
+        # The DELETE carries no reason (k8s has no field for it); the
+        # cache records the "Evicted: <reason>" Event, which this
+        # backend forwards as a core/v1 Event — same split as the
+        # reference (Evict = delete + Recorder event).
+        self._call(evict_request(pod))
+
+    def update_pod_group(self, group: PodGroup) -> None:
+        self._call(pod_group_status_request(group))
+
+    # -- EventSink (cache.record_event forwarding) ----------------------
+    def record_event(
+        self,
+        kind: str,
+        name: str,
+        reason: str,
+        message: str,
+        count: int = 1,
+        namespace: str = "default",
+    ) -> None:
+        """Best-effort, fire-and-forget (≙ the async Recorder): the
+        post is queued for the flusher thread, so a slow or dead
+        stream never blocks the scheduling path here; bind/evict
+        failures already surface through their own correlated calls."""
+        if self.closed.is_set():
+            return
+        payload = event_request(
+            kind, name, reason, message,
+            count=count, namespace=namespace,
+            sequence=next(self._event_seq),
+        )
+        payload["type"] = "REQUEST"
+        payload["id"] = 0  # no waiter; consumer responses are dropped
+        self._event_q.append(payload)
+        self._event_ready.set()

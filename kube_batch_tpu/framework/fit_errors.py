@@ -92,9 +92,12 @@ def render_fit_error(
     return f"0/{total} nodes are available for {task_name}: " + ", ".join(reasons)
 
 
-def diagnose_pending(ssn, max_events: int = 1000) -> list[tuple[str, str]]:
-    """(pod name, message) pairs for real tasks still Pending at session
-    end — the caller attaches each to its pod as a structured event.
+def diagnose_pending(
+    ssn, max_events: int = 1000
+) -> list[tuple[str, str, str]]:
+    """(pod name, namespace, message) triples for real tasks still
+    Pending at session end — the caller attaches each to its pod as a
+    structured event.
 
     Called from close_session; the [T, N] reductions run once on device,
     only the small per-task tallies cross to host.  `max_events` bounds
@@ -135,14 +138,16 @@ def diagnose_pending(ssn, max_events: int = 1000) -> list[tuple[str, str]]:
             )
             policy._diagnose_jit = diag
         counts = {k: np.asarray(v) for k, v in diag(snap, state).items()}
-    out: list[tuple[str, str]] = []
+    out: list[tuple[str, str, str]] = []
     for t in pending[:max_events]:
         pod = ssn.meta.task_pods[t]
-        out.append(
-            (pod.name, render_fit_error(pod.name, counts, t, ssn.meta.spec.names))
-        )
+        out.append((
+            pod.name, pod.namespace,
+            render_fit_error(pod.name, counts, t, ssn.meta.spec.names),
+        ))
     if pending.size > max_events:
-        out.append(
-            ("", f"... and {pending.size - max_events} more unschedulable tasks")
-        )
+        out.append((
+            "", "default",
+            f"... and {pending.size - max_events} more unschedulable tasks",
+        ))
     return out

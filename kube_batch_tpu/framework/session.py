@@ -286,10 +286,11 @@ def close_session(ssn: Session, diagnose: bool = True) -> None:
 
     ssn.dispatch_binds()
     if diagnose:
-        for pod_name, message in diagnose_pending(ssn):
+        for pod_name, namespace, message in diagnose_pending(ssn):
             ssn.cache.record_event(
                 "Pod" if pod_name else "Scheduler",
                 pod_name, "FailedScheduling", message,
+                namespace=namespace,
             )
     for plugin in ssn.plugins:
         with metrics.plugin_latency.time(plugin.name, "close"):
