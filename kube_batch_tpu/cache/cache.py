@@ -632,6 +632,28 @@ class SchedulerCache:
                 or self._resync
             )
 
+    def clear(self) -> None:
+        """Drop every mirrored object (≙ DeltaFIFO Replace semantics
+        collapsed to their stateless-recovery core): after a watch gap
+        the cluster can no longer tell us what we missed, so the mirror
+        is rebuilt from a fresh LIST replay — in-process, keeping the
+        Scheduler, its compiled executables, and the wire session.
+        The event ring survives (in-process observability, not cluster
+        state)."""
+        with self._lock:
+            self._pods.clear()
+            self._jobs.clear()
+            self._nodes.clear()
+            self._queues.clear()
+            self._claims.clear()
+            self._storage_classes.clear()
+            self._namespaces.clear()
+            self._pdbs.clear()
+            self._resync.clear()
+            self._status_counts.clear()
+            self._mark_full("relist")
+            self.add_queue(Queue(name=self.default_queue, weight=1.0))
+
     def drain_resync(self) -> list[str]:
         """Pod uids whose binds failed since last drain; the scheduler
         loop retries them next cycle (≙ processResyncTask)."""

@@ -72,6 +72,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "graceful pod DELETE, PodGroup status update, "
                         "core/v1 Events); 'native' (default) keeps the "
                         "compact framework verbs")
+    p.add_argument("--stream-retries", type=int, default=3,
+                   help="in-process reconnect attempts when the cluster "
+                        "stream dies (watch resumed from the last-seen "
+                        "resourceVersion, or a full in-process re-list "
+                        "on a 410-style gap); 0 exits immediately to "
+                        "the supervisor")
     p.add_argument("--cycles", type=int, default=None,
                    help="stop after N cycles (default: run forever)")
     p.add_argument("--profile-dir", default=None,
@@ -193,15 +199,26 @@ def run_external(args) -> int:
     import os
     import socket
     import threading
+    import time
 
     from kube_batch_tpu.cache.cache import SchedulerCache
     from kube_batch_tpu.client.adapter import LeaseElector, StreamBackend
     from kube_batch_tpu.client.k8s import K8sWatchAdapter
 
     host, _, port = args.cluster_stream.rpartition(":")
-    sock = socket.create_connection((host or "127.0.0.1", int(port)))
-    reader = sock.makefile("r", encoding="utf-8")
-    writer = sock.makefile("w", encoding="utf-8")
+
+    def dial() -> tuple:
+        s = socket.create_connection(
+            (host or "127.0.0.1", int(port)), timeout=30
+        )
+        # Connect-only timeout: left on the socket it would fire on
+        # every >30s-quiet watch read and misdiagnose a healthy idle
+        # stream as dead (and can corrupt a mid-read buffered line).
+        s.settimeout(None)
+        return (s, s.makefile("r", encoding="utf-8"),
+                s.makefile("w", encoding="utf-8"))
+
+    sock, reader, writer = dial()
     if args.write_format == "k8s":
         from kube_batch_tpu.client.k8s_write import K8sStreamBackend
 
@@ -223,13 +240,81 @@ def run_external(args) -> int:
     ).start()
 
     stop = threading.Event()
-    # The stream hanging up ends the daemon (a supervisor restarts it;
-    # stateless recovery re-lists on the next connect).  Started BEFORE
-    # the lease acquire loop: a standby whose stream dies while waiting
-    # must exit and reconnect, not spin against a dead socket.
-    threading.Thread(
-        target=lambda: (adapter.stopped.wait(), stop.set()), daemon=True
-    ).start()
+    state = {"sock": sock, "adapter": adapter}
+
+    def reconnect_once(old, since: int):
+        """One dial + resume attempt; returns (sock, adapter)."""
+        nsock, nreader, nwriter = dial()
+        try:
+            backend.reconnect(nwriter)
+            nadapter = K8sWatchAdapter(
+                cache, nreader, backend=backend,
+                scheduler_name=args.scheduler_name,
+            )
+            nadapter.resource_versions.update(old.resource_versions)
+            nadapter.list_rv = old.list_rv
+            nadapter.start()
+            try:
+                backend.watch_resume(since)
+                logging.info(
+                    "cluster stream reconnected; watch resumed from "
+                    "rv %d", since,
+                )
+            except RuntimeError as exc:
+                # The 410-Gone analog: the missed tail is unservable.
+                # Stateless recovery IN-PROCESS: drop the mirror,
+                # re-list, keep the Scheduler + compiled executables.
+                logging.warning(
+                    "watch gap (%s); re-listing in-process", exc,
+                )
+                cache.clear()
+                backend.request_list()
+            if not nadapter.wait_for_sync(60.0):
+                raise TimeoutError("resume replay never completed")
+            return nsock, nadapter
+        except BaseException:
+            nsock.close()
+            raise
+
+    def supervise() -> None:
+        """Watch the live adapter; on stream death, reconnect with
+        bounded retries (≙ the reflector's re-watch/relist loop) before
+        giving up to the process supervisor.  The scheduler keeps
+        cycling meanwhile — binds fail fast on the closed backend and
+        land in the resync queue for the next cycle."""
+        while not stop.is_set():
+            old = state["adapter"]
+            old.stopped.wait()
+            if stop.is_set():
+                return
+            since = old.latest_rv
+            for attempt in range(1, args.stream_retries + 1):
+                if stop.is_set():
+                    return
+                try:
+                    dead_sock = state["sock"]
+                    state["sock"], state["adapter"] = \
+                        reconnect_once(old, since)
+                    dead_sock.close()  # don't leave CLOSE_WAIT fds to GC
+                    break
+                except Exception as exc:  # noqa: BLE001 — any dial/
+                    # resume failure is retryable up to the bound
+                    backend.mark_closed()  # never leave callers blocking
+                    logging.warning(
+                        "stream reconnect attempt %d/%d failed: %s",
+                        attempt, args.stream_retries, exc,
+                    )
+                    time.sleep(min(2.0 * attempt, 10.0))
+            else:
+                logging.error(
+                    "cluster stream lost and %d reconnect attempts "
+                    "failed; exiting to the supervisor",
+                    args.stream_retries,
+                )
+                stop.set()
+                return
+
+    threading.Thread(target=supervise, daemon=True).start()
 
     elector = None
     # Everything past a successful acquire runs under the release
@@ -249,7 +334,18 @@ def run_external(args) -> int:
                 return 1
             elector.start_renewing(on_lost=stop.set)
 
-        if not adapter.wait_for_sync(60.0):
+        # Wait on whatever adapter is CURRENT: the stream may drop and
+        # reconnect during the initial LIST replay, and the resumed
+        # session's sync must count (waiting on the dead first adapter
+        # would defeat the in-process recovery).
+        deadline = time.monotonic() + 60.0
+        while (
+            not state["adapter"].synced.wait(0.5)
+            and time.monotonic() < deadline
+            and not stop.is_set()
+        ):
+            pass
+        if not state["adapter"].synced.is_set():
             logging.error("cluster stream never completed its LIST replay")
             return 1
 
@@ -266,7 +362,7 @@ def run_external(args) -> int:
     finally:
         if elector is not None:
             elector.release()
-        sock.close()
+        state["sock"].close()
     return 0
 
 

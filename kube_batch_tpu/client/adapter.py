@@ -60,6 +60,11 @@ class StreamBackend:
         # own timeout — a cycle dispatching thousands of binds against
         # a dead stream must die fast, not in timeout × binds.
         self.closed = threading.Event()
+        # Bumped by every reconnect(): a DYING adapter's late
+        # mark_closed (its read thread can be descheduled across a
+        # whole successful reconnect) must not close the re-armed
+        # backend under the healthy new adapter.
+        self.generation = 0
 
     # -- called by WatchAdapter's read loop -----------------------------
     def deliver_response(self, msg: dict) -> None:
@@ -69,8 +74,15 @@ class StreamBackend:
             self._pending[msg["id"]] = msg
             self._cv.notify_all()
 
-    def mark_closed(self) -> None:
-        """Stream is gone: wake and fail every waiter."""
+    def mark_closed(self, expected_generation: int | None = None) -> None:
+        """Stream is gone: wake and fail every waiter.  A caller tied
+        to one connection passes the generation it was created under —
+        stale (pre-reconnect) deaths are ignored."""
+        if (
+            expected_generation is not None
+            and expected_generation != self.generation
+        ):
+            return
         self.closed.set()
         with self._cv:
             self._cv.notify_all()
@@ -117,6 +129,30 @@ class StreamBackend:
         self._call({
             "verb": "updatePodGroup", "object": encode_pod_group(group),
         })
+
+    # -- watch lifecycle verbs (≙ reflector LIST / re-WATCH calls) ------
+    def watch_resume(self, since: int) -> None:
+        """Ask the cluster for every event after `since` (≙ re-watching
+        from the last-seen resourceVersion).  Raises RuntimeError on
+        the 410-Gone analog — the caller must re-list."""
+        self._call({"verb": "watchResume", "since": int(since)})
+
+    def request_list(self) -> None:
+        """Ask for a full LIST replay (≙ reflector relist after 410)."""
+        self._call({"verb": "list"})
+
+    def reconnect(self, writer: IO[str]) -> None:
+        """Re-arm this backend on a fresh connection's writer: in-flight
+        callers were already failed by mark_closed; stale correlation
+        state is dropped so late responses from the OLD stream can
+        never satisfy a NEW request's id."""
+        with self._wlock:
+            with self._cv:
+                self._pending.clear()
+                self._waiting.clear()
+            self._writer = writer
+            self.generation += 1
+            self.closed.clear()
 
     # -- lease verbs (cross-host HA; ≙ resourcelock Get/Update calls) ---
     def acquire_lease(self, holder: str, ttl: float) -> None:
@@ -231,9 +267,19 @@ class WatchAdapter:
         self.cache = cache
         self._reader = reader
         self._backend = backend
+        # The backend generation this adapter's connection belongs to
+        # (see StreamBackend.mark_closed's staleness guard).
+        self._backend_gen = backend.generation if backend is not None else 0
         self._thread: threading.Thread | None = None
         self.synced = threading.Event()  # set on first SYNC marker
         self.stopped = threading.Event()
+        # Last-seen resourceVersion per object kind (≙ the reflector's
+        # lastSyncResourceVersion): a reconnecting session resumes the
+        # watch from max over kinds.  Fed by event envelopes' top-level
+        # "resourceVersion" (native dialect) and by SYNC markers (the
+        # LIST's collection RV).
+        self.resource_versions: dict[str, int] = {}
+        self.list_rv = 0
 
     # -- lifecycle (≙ cache.Run / WaitForCacheSync) ---------------------
     def start(self) -> "WatchAdapter":
@@ -266,9 +312,33 @@ class WatchAdapter:
         except (OSError, ValueError):
             pass  # stream closed under us — treated as EOF
         finally:
-            self.stopped.set()
+            # Fail writes BEFORE signalling stopped: a reconnect woken
+            # by `stopped` must never race a mark_closed that hasn't
+            # landed yet (generation-guarded for late deaths besides).
             if self._backend is not None:
-                self._backend.mark_closed()  # fail in-flight writes NOW
+                self._backend.mark_closed(self._backend_gen)
+            self.stopped.set()
+
+    @property
+    def latest_rv(self) -> int:
+        """Resume point for a reconnect (≙ lastSyncResourceVersion)."""
+        return max(self.list_rv, *self.resource_versions.values(), 0) \
+            if self.resource_versions else self.list_rv
+
+    def _track_rv(self, msg: dict, kind: str | None) -> None:
+        rv = msg.get("resourceVersion")
+        if rv is None:
+            return
+        try:
+            rv = int(rv)
+        except (TypeError, ValueError):
+            return  # opaque RV — resume unsupported for this stream
+        if kind is None:
+            self.list_rv = max(self.list_rv, rv)
+        else:
+            self.resource_versions[kind] = max(
+                self.resource_versions.get(kind, 0), rv
+            )
 
     def _dispatch(self, msg: dict) -> None:
         mtype = msg.get("type")
@@ -277,9 +347,11 @@ class WatchAdapter:
                 self._backend.deliver_response(msg)
             return
         if mtype == "SYNC":
+            self._track_rv(msg, None)
             self.synced.set()
             return
         kind = msg.get("kind")
+        self._track_rv(msg, kind)
         decode = DECODERS.get(kind)
         if decode is None or mtype not in ("ADDED", "MODIFIED", "DELETED"):
             log.warning("unknown watch message: type=%s kind=%s", mtype, kind)
@@ -293,22 +365,27 @@ class WatchAdapter:
     def _apply(self, mtype: str, kind: str, obj: dict, decode) -> None:
         cache = self.cache
         if kind == "Pod":
-            if mtype == "ADDED":
-                cache.add_pod(decode(obj))
-            elif mtype == "DELETED":
+            if mtype == "DELETED":
                 cache.delete_pod(obj["uid"])
-            else:  # MODIFIED: kubelet/controller status+node movement
-                cache.update_pod_status(
-                    obj["uid"],
-                    TaskStatus[obj.get("status", "PENDING")],
-                    node=obj.get("node"),
-                )
-        elif kind == "Node":
-            if mtype == "ADDED":
-                cache.add_node(decode(obj))
-            elif mtype == "DELETED":
-                cache.delete_node(obj["name"])
             else:
+                # ADDED upserts: a re-list replays every live object as
+                # ADDED over a possibly-populated cache (stateless
+                # recovery without a process restart), so a known uid
+                # becomes a status/placement update.
+                with cache.lock():
+                    known = obj.get("uid") in cache._pods
+                if mtype == "ADDED" and not known:
+                    cache.add_pod(decode(obj))
+                else:  # MODIFIED, or re-listed ADDED of a known pod
+                    cache.update_pod_status(
+                        obj["uid"],
+                        TaskStatus[obj.get("status", "PENDING")],
+                        node=obj.get("node"),
+                    )
+        elif kind == "Node":
+            if mtype == "DELETED":
+                cache.delete_node(obj["name"])
+            else:  # update_node upserts unknown nodes
                 cache.update_node(decode(obj))
         elif kind == "PodGroup":
             if mtype == "DELETED":

@@ -29,6 +29,7 @@ learns about through the stream).
 
 from __future__ import annotations
 
+import collections
 import json
 import socket
 import threading
@@ -59,10 +60,24 @@ def stream_pair() -> tuple[IO[str], IO[str], IO[str], IO[str]]:
 
 class ExternalCluster:
     def __init__(
-        self, reader: IO[str] | None = None, writer: IO[str] | None = None
+        self,
+        reader: IO[str] | None = None,
+        writer: IO[str] | None = None,
+        history: int = 1000,
     ) -> None:
         self._lock = threading.RLock()
         self._sessions: list[tuple[IO[str], IO[str]]] = []
+        # -- watch-resume bookkeeping (≙ apiserver resourceVersions +
+        # the bounded watch cache a reflector resumes from): every
+        # broadcast event gets a monotonically increasing RV and lands
+        # in a bounded history ring; a reconnecting session asks for
+        # everything after its last-seen RV ("watchResume") and gets
+        # either the missed tail or a 410-style "gone" forcing a
+        # full re-list.
+        self._rv = 0
+        self._history: "collections.deque[dict]" = collections.deque(
+            maxlen=history
+        )
         self.pods: dict[str, Pod] = {}
         self.nodes: dict[str, Node] = {}
         self.groups: dict[str, PodGroup] = {}
@@ -99,7 +114,9 @@ class ExternalCluster:
 
     def replay(self, writer: IO[str]) -> None:
         """LIST replay for a late-attaching session: every current
-        object as ADDED, then SYNC (≙ informer re-list + HasSynced)."""
+        object as ADDED, then SYNC carrying the collection's
+        resourceVersion (≙ informer re-list + HasSynced; the reflector
+        resumes its watch from the LIST's RV)."""
         with self._lock:
             for q in self.queues.values():
                 self._emit_to(writer, "ADDED", "Queue", encode_queue(q))
@@ -109,7 +126,9 @@ class ExternalCluster:
                 self._emit_to(writer, "ADDED", "PodGroup", encode_pod_group(g))
             for p in self.pods.values():
                 self._emit_to(writer, "ADDED", "Pod", encode_pod(p))
-            self._emit_to(writer, None, None, None, raw={"type": "SYNC"})
+            self._emit_to(writer, None, None, None, raw={
+                "type": "SYNC", "resourceVersion": self._rv,
+            })
 
     # -- wire out -------------------------------------------------------
     def _emit_to(self, writer, mtype, kind, obj, raw: dict | None = None):
@@ -124,8 +143,14 @@ class ExternalCluster:
 
     def _emit(self, mtype: str, kind: str, obj: dict) -> None:
         with self._lock:
+            self._rv += 1
+            msg = {
+                "type": mtype, "kind": kind, "object": obj,
+                "resourceVersion": self._rv,
+            }
+            self._history.append(msg)
             for _r, w in self._sessions:
-                self._emit_to(w, mtype, kind, obj)
+                self._emit_to(w, None, None, None, raw=msg)
 
     def _respond(
         self, writer: IO[str], rid: int, ok: bool, error: str = ""
@@ -140,7 +165,9 @@ class ExternalCluster:
         """Mark the initial LIST replay complete (≙ informer HasSynced)."""
         with self._lock:
             for _r, w in self._sessions:
-                self._emit_to(w, None, None, None, raw={"type": "SYNC"})
+                self._emit_to(w, None, None, None, raw={
+                    "type": "SYNC", "resourceVersion": self._rv,
+                })
 
     # -- authoritative world mutations (all emit watch events) ----------
     def add_node(self, node: Node) -> None:
@@ -383,11 +410,51 @@ class ExternalCluster:
         self._respond(writer, rid, False,
                       f"unhandled k8s request {verb} {path}")
 
+    # -- watch resume (≙ reflector re-watch from last RV / 410 Gone) ----
+    def _handle_watch_resume(self, writer, rid: int, since: int) -> None:
+        """Serve the missed event tail when the history ring still
+        covers `since`; otherwise answer the 410-Gone analog and the
+        client must re-list.  Either way a SYNC trails the replay so
+        the session's adapter re-arms its sync gate."""
+        if since > self._rv:
+            # The client is AHEAD of us: this cluster incarnation was
+            # restarted (fresh RV space) — its history cannot mean what
+            # the client thinks.  Force the re-list, like an apiserver
+            # answering 410 for an unknown RV.
+            self._respond(
+                writer, rid, False,
+                f"410 gone: rv {since} is from another watch incarnation",
+            )
+            return
+        if since < self._rv and (
+            not self._history or self._history[0]["resourceVersion"] > since + 1
+        ):
+            # The tail the client missed has partly fallen out of the
+            # ring — replaying the remainder would silently skip events.
+            self._respond(
+                writer, rid, False,
+                f"410 gone: watch history starts after rv {since}",
+            )
+            return
+        self._respond(writer, rid, True)
+        for past in self._history:
+            if past["resourceVersion"] > since:
+                self._emit_to(writer, None, None, None, raw=past)
+        self._emit_to(writer, None, None, None, raw={
+            "type": "SYNC", "resourceVersion": self._rv,
+        })
+
     def _handle(self, writer: IO[str], msg: dict) -> None:
         verb, rid = msg.get("verb"), msg["id"]
         with self._lock:
             if "path" in msg:  # apiserver-dialect write
                 self._handle_k8s(writer, msg)
+            elif verb == "watchResume":
+                self._handle_watch_resume(writer, rid,
+                                          int(msg.get("since", 0)))
+            elif verb == "list":
+                self._respond(writer, rid, True)
+                self.replay(writer)
             elif verb in ("acquireLease", "renewLease", "releaseLease"):
                 self._handle_lease(writer, verb, msg)
             elif verb == "bind":

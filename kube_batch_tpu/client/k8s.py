@@ -487,6 +487,12 @@ class K8sWatchAdapter(WatchAdapter):
     def _dispatch(self, msg: dict) -> None:
         obj = msg.get("object")
         if isinstance(obj, dict) and "kind" in obj:
+            # k8s dialect: the RV lives on the object's metadata (the
+            # envelope's top-level field serves the native dialect).
+            rv = (obj.get("metadata") or {}).get("resourceVersion",
+                                                 msg.get("resourceVersion"))
+            if rv is not None:
+                self._track_rv({"resourceVersion": rv}, obj.get("kind"))
             try:
                 self._apply_k8s(msg.get("type"), obj)
             except Exception:  # noqa: BLE001 — one bad event ≠ dead ingest

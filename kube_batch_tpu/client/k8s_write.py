@@ -203,12 +203,18 @@ class K8sStreamBackend(StreamBackend):
         self._flusher.start()
 
     def _flush_events(self) -> None:
+        """One eternal daemon: drains the queue while the stream is
+        up, idles while it is down (a reconnect() clearing `closed`
+        revives it with the queued backlog intact — bounded, so a long
+        outage sheds oldest events instead of growing)."""
         import json
 
-        while not self.closed.is_set():
+        while True:
             self._event_ready.wait(0.5)
             self._event_ready.clear()
-            while True:
+            if self.closed.is_set():
+                continue
+            while not self.closed.is_set():
                 try:
                     payload = self._event_q.popleft()
                 except IndexError:
@@ -218,7 +224,7 @@ class K8sStreamBackend(StreamBackend):
                         self._writer.write(json.dumps(payload) + "\n")
                         self._writer.flush()
                 except (OSError, ValueError):
-                    return  # stream died; the watch loop handles it
+                    break  # stream dying; retry after reconnect
 
     # -- the Binder/Evictor/StatusUpdater seam --------------------------
     def bind(self, pod: Pod, node_name: str) -> None:
@@ -247,9 +253,9 @@ class K8sStreamBackend(StreamBackend):
         """Best-effort, fire-and-forget (≙ the async Recorder): the
         post is queued for the flusher thread, so a slow or dead
         stream never blocks the scheduling path here; bind/evict
-        failures already surface through their own correlated calls."""
-        if self.closed.is_set():
-            return
+        failures already surface through their own correlated calls.
+        Queued even while the stream is down — the bounded queue
+        carries recent events across a reconnect."""
         payload = event_request(
             kind, name, reason, message,
             count=count, namespace=namespace,

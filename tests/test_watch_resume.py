@@ -1,0 +1,291 @@
+"""Watch-stream resume semantics (VERDICT r4 next #3).
+
+The reference's client-go reflector tracks resourceVersions, re-watches
+from the last-seen RV on a dropped stream, and falls back to a full
+re-list on 410 Gone — all without restarting the process.  These tests
+drive the same semantics over the JSON-lines wire: RV bookkeeping in
+the adapters, `watchResume` served from the cluster's bounded history
+ring, the 410-style gap answer forcing an in-process `cache.clear()` +
+re-list, and the CLI daemon reconnecting through all of it mid-churn.
+"""
+
+from __future__ import annotations
+
+import socket as socket_mod
+import threading
+import time
+
+from kube_batch_tpu.actions import BUILTIN_ACTIONS  # noqa: F401
+from kube_batch_tpu.api.resource import ResourceSpec
+from kube_batch_tpu.api.types import TaskStatus
+from kube_batch_tpu.cache.cache import SchedulerCache
+from kube_batch_tpu.cache.cluster import Node, Pod, PodGroup
+from kube_batch_tpu.client import ExternalCluster, StreamBackend, WatchAdapter
+from kube_batch_tpu.models.workloads import GI
+from kube_batch_tpu.plugins import BUILTIN_PLUGINS  # noqa: F401
+from kube_batch_tpu.scheduler import Scheduler
+
+SPEC = ResourceSpec(("cpu", "memory", "pods", "accelerator"))
+
+
+def _cluster_world(history: int = 1000) -> ExternalCluster:
+    cluster = ExternalCluster(history=history)
+    cluster.add_node(Node(
+        name="n0", allocatable={"cpu": 8000, "memory": 16 * GI, "pods": 110},
+    ))
+    cluster.submit(
+        PodGroup(name="g", queue="default", min_member=1),
+        [Pod(name="g-0", uid="uid-g-0",
+             request={"cpu": 1000, "memory": 1 * GI, "pods": 1})],
+    )
+    return cluster
+
+
+def _connect(cluster: ExternalCluster, replay: bool = True):
+    """Attach one scheduler session over a fresh socketpair; returns
+    (reader, writer, cluster_side_socket) — the raw socket so a test
+    can sever the 'network' with shutdown() (closing a file object a
+    thread is blocked reading would deadlock on the IO lock)."""
+    a, b = socket_mod.socketpair()
+    cl_r = a.makefile("r", encoding="utf-8")
+    cl_w = a.makefile("w", encoding="utf-8")
+    sch_r = b.makefile("r", encoding="utf-8")
+    sch_w = b.makefile("w", encoding="utf-8")
+    cluster.attach(cl_r, cl_w)
+    if not cluster._started:
+        cluster.start()
+    if replay:
+        cluster.replay(cl_w)
+    return sch_r, sch_w, a
+
+
+def _wait(predicate, timeout: float = 5.0) -> bool:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def test_adapter_tracks_resource_versions():
+    cluster = _cluster_world()
+    sch_r, sch_w, _a = _connect(cluster)
+    backend = StreamBackend(sch_w, timeout=5.0)
+    cache = SchedulerCache(SPEC, binder=backend, evictor=backend)
+    adapter = WatchAdapter(cache, sch_r, backend=backend).start()
+    assert adapter.wait_for_sync(5.0)
+    # The LIST replay's SYNC carried the collection RV.
+    assert adapter.list_rv == cluster._rv
+
+    before = adapter.latest_rv
+    cluster.submit(
+        PodGroup(name="h", queue="default", min_member=1),
+        [Pod(name="h-0", uid="uid-h-0",
+             request={"cpu": 100, "memory": 1 * GI, "pods": 1})],
+    )
+    assert _wait(lambda: adapter.latest_rv > before)
+    assert adapter.resource_versions["Pod"] == cluster._rv
+    assert adapter.resource_versions["PodGroup"] == cluster._rv - 1
+
+
+def test_watch_resume_replays_only_missed_tail():
+    cluster = _cluster_world()
+    sch_r, sch_w, _a = _connect(cluster)
+    backend = StreamBackend(sch_w, timeout=5.0)
+    cache = SchedulerCache(SPEC, binder=backend, evictor=backend)
+    adapter = WatchAdapter(cache, sch_r, backend=backend).start()
+    assert adapter.wait_for_sync(5.0)
+    assert _wait(lambda: "uid-g-0" in cache._pods)
+
+    # The stream dies (the "network" is severed under both sides).
+    since = adapter.latest_rv
+    _a.shutdown(socket_mod.SHUT_RDWR)
+    assert _wait(lambda: adapter.stopped.is_set())
+
+    # Mid-outage churn the scheduler never saw: a new gang arrives and
+    # the original pod is deleted.
+    cluster.submit(
+        PodGroup(name="late", queue="default", min_member=1),
+        [Pod(name="late-0", uid="uid-late-0",
+             request={"cpu": 500, "memory": 1 * GI, "pods": 1})],
+    )
+    with cluster._lock:
+        gone = cluster.pods.pop("uid-g-0")
+        cluster._emit("DELETED", "Pod", {"uid": gone.uid, "name": gone.name})
+
+    # Reconnect WITHOUT a server-side replay; resume from last RV.
+    sch_r2, sch_w2, _a2 = _connect(cluster, replay=False)
+    backend.reconnect(sch_w2)
+    adapter2 = WatchAdapter(cache, sch_r2, backend=backend)
+    adapter2.resource_versions.update(adapter.resource_versions)
+    adapter2.list_rv = adapter.list_rv
+    adapter2.start()
+    backend.watch_resume(since)
+    assert adapter2.wait_for_sync(5.0)
+
+    # The cache reconverged to cluster truth: missed ADDs and DELETEs
+    # applied, no re-list (the pre-outage node object was never resent).
+    assert _wait(lambda: "uid-late-0" in cache._pods)
+    assert _wait(lambda: "uid-g-0" not in cache._pods)
+    with cache.lock():
+        assert "late" in cache._jobs
+        assert "n0" in cache._nodes
+
+
+def test_watch_gap_answers_gone_and_relist_reconverges():
+    # History ring of 4: the outage churn below overflows it.
+    cluster = _cluster_world(history=4)
+    sch_r, sch_w, _a = _connect(cluster)
+    backend = StreamBackend(sch_w, timeout=5.0)
+    cache = SchedulerCache(SPEC, binder=backend, evictor=backend)
+    adapter = WatchAdapter(cache, sch_r, backend=backend).start()
+    assert adapter.wait_for_sync(5.0)
+    assert _wait(lambda: "uid-g-0" in cache._pods)
+
+    since = adapter.latest_rv
+    _a.shutdown(socket_mod.SHUT_RDWR)
+    assert _wait(lambda: adapter.stopped.is_set())
+
+    # Enough churn to push the missed tail out of the 4-event ring:
+    # the original pod is deleted and two new jobs arrive.
+    with cluster._lock:
+        gone = cluster.pods.pop("uid-g-0")
+        cluster._emit("DELETED", "Pod", {"uid": gone.uid, "name": gone.name})
+    for i in range(3):
+        cluster.submit(
+            PodGroup(name=f"j{i}", queue="default", min_member=1),
+            [Pod(name=f"j{i}-0", uid=f"uid-j{i}-0",
+                 request={"cpu": 100, "memory": 1 * GI, "pods": 1})],
+        )
+
+    sch_r2, sch_w2, _a2 = _connect(cluster, replay=False)
+    backend.reconnect(sch_w2)
+    adapter2 = WatchAdapter(cache, sch_r2, backend=backend)
+    adapter2.start()
+
+    import pytest
+
+    with pytest.raises(RuntimeError, match="410 gone"):
+        backend.watch_resume(since)
+
+    # ≙ reflector relist after 410: drop the mirror, LIST, reconverge.
+    cache.clear()
+    backend.request_list()
+    assert adapter2.wait_for_sync(5.0)
+    assert _wait(lambda: len(cache._pods) == 3)
+    with cache.lock():
+        assert "uid-g-0" not in cache._pods  # the missed DELETE "applied"
+        assert {"j0", "j1", "j2"} <= set(cache._jobs)
+        assert "n0" in cache._nodes
+
+
+def test_resume_ahead_of_server_answers_gone():
+    """A client resuming with an RV from a PREVIOUS cluster incarnation
+    (cluster restarted, fresh RV space) must get the 410 answer — an
+    empty 'nothing missed' reply would leave it scheduling against a
+    silently stale mirror."""
+    import pytest
+
+    cluster = _cluster_world()  # fresh incarnation: small _rv
+    sch_r, sch_w, _a = _connect(cluster, replay=False)
+    backend = StreamBackend(sch_w, timeout=5.0)
+    cache = SchedulerCache(SPEC, binder=backend, evictor=backend)
+    WatchAdapter(cache, sch_r, backend=backend).start()
+
+    with pytest.raises(RuntimeError, match="another watch incarnation"):
+        backend.watch_resume(5000)
+    # The prescribed fallback reconverges as usual.
+    cache.clear()
+    backend.request_list()
+    assert _wait(lambda: "uid-g-0" in cache._pods)
+
+
+def test_relist_over_populated_cache_upserts():
+    """A full replay over a live cache (double replay, or a relist
+    without clear()) must converge, not crash on duplicate ADDs."""
+    cluster = _cluster_world()
+    sch_r, sch_w, _a = _connect(cluster)
+    backend = StreamBackend(sch_w, timeout=5.0)
+    cache = SchedulerCache(SPEC, binder=backend, evictor=backend)
+    adapter = WatchAdapter(cache, sch_r, backend=backend).start()
+    assert adapter.wait_for_sync(5.0)
+    assert _wait(lambda: "uid-g-0" in cache._pods)
+
+    backend.request_list()  # second full replay onto the same cache
+    assert _wait(lambda: adapter.list_rv == cluster._rv)
+    with cache.lock():
+        assert len(cache._pods) == 1  # upserted, not duplicated/crashed
+        assert cache._status_counts[TaskStatus.PENDING] == 1
+
+
+def test_cli_daemon_reconnects_in_process():
+    """Kill the stream under a running daemon; it must resume the
+    watch in-process (bounded retries), see churn that happened while
+    away, and keep scheduling — no process restart."""
+    from kube_batch_tpu.cli import main
+
+    cluster = _cluster_world()
+    srv = socket_mod.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(4)
+    port = srv.getsockname()[1]
+    conns: list[socket_mod.socket] = []
+
+    def accept_loop() -> None:
+        first = True
+        while True:
+            try:
+                conn, _ = srv.accept()
+            except OSError:
+                return
+            conns.append(conn)
+            r = conn.makefile("r", encoding="utf-8")
+            w = conn.makefile("w", encoding="utf-8")
+            cluster.attach(r, w)
+            if not cluster._started:
+                cluster.start()
+            if first:  # fresh session gets the LIST; resumes are
+                cluster.replay(w)  # client-driven (watchResume/list)
+                first = False
+
+    threading.Thread(target=accept_loop, daemon=True).start()
+
+    rc_holder: dict = {}
+    runner = threading.Thread(
+        target=lambda: rc_holder.update(rc=main([
+            "--cluster-stream", f"127.0.0.1:{port}",
+            "--schedule-period", "0.05",
+            "--cycles", "400",
+            "--stream-retries", "3",
+            "--listen-address", "",
+        ])),
+        daemon=True,
+    )
+    runner.start()
+    assert _wait(lambda: ("g-0", "n0") in cluster.binds, timeout=30.0)
+
+    # Sever the live connection (tunnel blip).
+    conns[0].close()
+
+    # Churn during the outage: a new job the daemon must eventually see.
+    cluster.submit(
+        PodGroup(name="after", queue="default", min_member=1),
+        [Pod(name="after-0", uid="uid-after-0",
+             request={"cpu": 500, "memory": 1 * GI, "pods": 1})],
+    )
+
+    # The daemon reconnects in-process and schedules the new pod.
+    assert _wait(lambda: ("after-0", "n0") in cluster.binds, timeout=30.0)
+    assert runner.is_alive()  # same process, still cycling
+
+    # Shutdown: close everything; retries exhaust; daemon exits.
+    srv.close()
+    for c in conns:
+        try:
+            c.close()
+        except OSError:
+            pass
+    runner.join(60.0)
+    assert not runner.is_alive()
+    assert rc_holder.get("rc") == 0
