@@ -612,7 +612,9 @@ class SchedulerCache:
         an apiserver round trip on the stream backend."""
         with self._lock:
             groups = [
-                self._jobs[n].refresh_status()
+                self._jobs[n].refresh_status(
+                    self._jobs[n].queue in self._queues
+                )
                 for n in names if n in self._jobs
             ]
         for group, changed in groups:

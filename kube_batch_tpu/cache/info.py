@@ -183,17 +183,33 @@ class JobInfo:
                 out += self.spec.pod_vec(t)
         return out
 
-    def refresh_status(self) -> tuple[PodGroup, bool]:
+    def refresh_status(self, queue_known: bool = True) -> tuple[PodGroup, bool]:
         """Recompute the PodGroup status subresource from member tasks
         (≙ framework/job_updater.go batching PodGroup status updates at
         session close): running/succeeded/failed counts, and phase —
         Running once the gang holds minMember running-or-done members,
         Unknown for a broken gang (some members running but below the
-        threshold), Pending otherwise.  Returns (group, changed):
-        `changed` is False when every status field is identical to the
-        last refresh, so callers skip the write-back — a steady-state
-        daemon must not re-send thousands of identical status updates
-        (one wire round trip each on the stream backend) every second."""
+        threshold), Inqueue for a gang that passed admission (a real
+        queue and enough valid members to satisfy minMember) and is
+        awaiting resources, Pending otherwise.
+
+        Inqueue lowering note (≙ v1alpha1 · PodGroupPhase, the enqueue
+        action of later kube-batch/Volcano): upstream the phase gates
+        POD CREATION — the workload controller holds pods back until
+        the scheduler admits the group.  This framework schedules pods
+        that already exist, so the creation gate has nothing to gate;
+        what remains observable is the admission statement itself —
+        "this gang is complete and queued, only waiting for capacity" —
+        versus Pending's "not yet admissible" (incomplete gang or
+        unknown queue).  That distinction is exactly what the phase
+        reports here, and it leaves the process through the same
+        status-update writes the reference sends.
+
+        Returns (group, changed): `changed` is False when every status
+        field is identical to the last refresh, so callers skip the
+        write-back — a steady-state daemon must not re-send thousands
+        of identical status updates (one wire round trip each on the
+        stream backend) every second."""
         from kube_batch_tpu.api.types import PodGroupPhase
 
         pg = self.pod_group
@@ -206,6 +222,13 @@ class JobInfo:
             pg.phase = PodGroupPhase.RUNNING
         elif pg.running > 0:
             pg.phase = PodGroupPhase.UNKNOWN   # gang degraded below minMember
+        elif self.queue and queue_known and self.valid():
+            # Admitted, awaiting capacity.  `queue_known` comes from the
+            # caller holding the queue map (JobInfo cannot see it): a
+            # gang naming an unknown/deleted queue is NOT admitted —
+            # the snapshot excludes it entirely — and must read Pending,
+            # not "queued, waiting for capacity".
+            pg.phase = PodGroupPhase.INQUEUE
         else:
             pg.phase = PodGroupPhase.PENDING
         return pg, (pg.running, pg.succeeded, pg.failed, pg.phase) != before

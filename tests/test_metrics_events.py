@@ -85,6 +85,44 @@ def test_pod_group_phase_transitions():
     assert pg.phase == PodGroupPhase.RUNNING
 
 
+def test_pod_group_inqueue_phase():
+    """An admitted gang awaiting capacity reports Inqueue; an
+    incomplete gang stays Pending — the admission distinction the
+    reference's Inqueue phase / enqueue gate makes observable
+    (v1alpha1 · PodGroupPhase; lowering argument in
+    JobInfo.refresh_status)."""
+    from kube_batch_tpu.api.types import PodGroupPhase
+
+    cache, sim = make_world(SPEC)
+    sim.add_node(
+        Node(name="n0", allocatable={"cpu": 1000, "memory": 2 * GI, "pods": 110})
+    )
+    # Complete gang, nothing fits → admitted, waiting: Inqueue.
+    sim.submit(
+        PodGroup(name="adm", queue="default", min_member=2),
+        [Pod(name=f"adm-{i}", request={"cpu": 64000, "memory": GI, "pods": 1})
+         for i in range(2)],
+    )
+    # Incomplete gang (1 of 3 members exist) → not admissible: Pending.
+    sim.submit(
+        PodGroup(name="half", queue="default", min_member=3),
+        [Pod(name="half-0", request={"cpu": 100, "memory": GI, "pods": 1})],
+    )
+    # Complete gang naming a queue that doesn't exist → the snapshot
+    # excludes it, so it must NOT claim "queued, awaiting capacity".
+    sim.submit(
+        PodGroup(name="lost", queue="no-such-queue", min_member=1),
+        [Pod(name="lost-0", request={"cpu": 100, "memory": GI, "pods": 1})],
+    )
+    Scheduler(cache).run_once()
+    with cache.lock():
+        assert cache._jobs["adm"].pod_group.phase == PodGroupPhase.INQUEUE
+        assert cache._jobs["half"].pod_group.phase == PodGroupPhase.PENDING
+    cache.refresh_job_statuses(["lost"])
+    with cache.lock():
+        assert cache._jobs["lost"].pod_group.phase == PodGroupPhase.PENDING
+
+
 def test_feasible_but_outranked_is_reported():
     """A pod with room that lost to gang all-or-nothing shows as
     feasible-but-outranked, not as a resource shortfall."""
