@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: test unit-test e2e bench run-example verify clean
+.PHONY: test unit-test e2e bench run-example verify warm clean
 
 test: unit-test
 
@@ -16,6 +16,13 @@ e2e:
 
 bench:
 	$(PY) bench.py
+
+# Pre-compile every hot-swappable conf at the flagship shape into the
+# persistent XLA cache, so daemon conf swaps replay in seconds instead
+# of hitting the measured 7-13 min XLA:TPU compile cliff (see
+# kube_batch_tpu/warm.py).  Run once per machine / per program change.
+warm:
+	$(PY) -m kube_batch_tpu.warm --shape-configs 5
 
 run-example:
 	$(PY) -m kube_batch_tpu --workload examples/world.yaml \

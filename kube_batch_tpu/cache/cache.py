@@ -412,6 +412,10 @@ class SchedulerCache:
     def delete_queue(self, name: str) -> None:
         with self._lock:
             if self._queues.pop(name, None) is not None:
+                # Orphaned jobs need no extra marking: the full
+                # rebuild this forces makes the next session refresh
+                # ALL live jobs (refresh_job_statuses(None)), which
+                # corrects their Inqueue phase to Pending.
                 self._mark_full("queue-deleted")
 
     # -- volume objects (≙ the pv/pvc/sc informers of cache.go) ---------
@@ -604,18 +608,24 @@ class SchedulerCache:
         if self.status_updater is not None:
             self.status_updater.update_pod_group(group)
 
-    def refresh_job_statuses(self, names) -> None:
-        """Recompute PodGroup statuses for `names` under the cache lock
-        (event handlers may be mutating job.tasks from an adapter
-        thread; ≙ job_updater.go running against live informers), then
-        write back only the ones that actually CHANGED — each write is
-        an apiserver round trip on the stream backend."""
+    def refresh_job_statuses(self, names=None) -> None:
+        """Recompute PodGroup statuses for `names` — or EVERY live job
+        when None — under the cache lock (event handlers may be
+        mutating job.tasks from an adapter thread; ≙ job_updater.go
+        running against live informers), then write back only the ones
+        that actually CHANGED — each write is an apiserver round trip
+        on the stream backend.  None must mean the cache's jobs, not a
+        snapshot's: snapshot-excluded orphans (unknown/deleted queue)
+        still need their phases corrected."""
         with self._lock:
+            targets = list(self._jobs) if names is None else [
+                n for n in names if n in self._jobs
+            ]
             groups = [
                 self._jobs[n].refresh_status(
                     self._jobs[n].queue in self._queues
                 )
-                for n in names if n in self._jobs
+                for n in targets
             ]
         for group, changed in groups:
             if changed:

@@ -49,6 +49,7 @@ that invariant; `KB_TPU_CHECK_PACK=1` runs it after every pack.
 
 from __future__ import annotations
 
+import collections
 import logging
 import os
 
@@ -105,6 +106,10 @@ class IncrementalPacker:
         self.full_packs = 0
         self.incremental_packs = 0
         self.last_mode = ""
+        # Why each full rebuild happened (journal full_reason or the
+        # incremental path's bail-out reason): the soak bench reads
+        # this to make fallback storms visible instead of silent.
+        self.fallback_reasons: collections.Counter = collections.Counter()
         # PodGroups affected by the mutations this pack absorbed
         # (None after a full rebuild = "all"): close_session refreshes
         # exactly these instead of recomputing every job's status each
@@ -145,6 +150,7 @@ class IncrementalPacker:
         self._ns_row = {n: i for i, n in enumerate(ints.ns_names)}
         self._dirty.clear()
         self.full_packs += 1
+        self.fallback_reasons[reason] += 1
         self.last_mode = f"full:{reason}"
         log.debug("full pack (%s): T=%d N=%d", reason,
                   len(ints.task_uids), len(ints.node_names))

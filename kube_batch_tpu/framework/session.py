@@ -284,14 +284,16 @@ def close_session(ssn: Session, diagnose: bool = True) -> None:
     conditions), write back job status."""
     from kube_batch_tpu.framework.fit_errors import diagnose_pending
 
-    ssn.dispatch_binds()
+    with metrics.cycle_phase_latency.time("bind_dispatch"):
+        ssn.dispatch_binds()
     if diagnose:
-        for pod_name, namespace, message in diagnose_pending(ssn):
-            ssn.cache.record_event(
-                "Pod" if pod_name else "Scheduler",
-                pod_name, "FailedScheduling", message,
-                namespace=namespace,
-            )
+        with metrics.cycle_phase_latency.time("diagnosis"):
+            for pod_name, namespace, message in diagnose_pending(ssn):
+                ssn.cache.record_event(
+                    "Pod" if pod_name else "Scheduler",
+                    pod_name, "FailedScheduling", message,
+                    namespace=namespace,
+                )
     for plugin in ssn.plugins:
         with metrics.plugin_latency.time(plugin.name, "close"):
             plugin.on_session_close(ssn)
@@ -302,10 +304,12 @@ def close_session(ssn: Session, diagnose: bool = True) -> None:
     # plus this cycle's bind/evict groups can have changed status —
     # recomputing all ~thousands of jobs is O(total tasks) of host
     # Python per cycle for identical results.
-    ssn.cache.refresh_job_statuses(
-        ssn.meta.job_names
-        if ssn._refresh_groups is None else ssn._refresh_groups
-    )
+    # None = refresh ALL live cache jobs, not the snapshot's job list:
+    # a job orphaned by queue deletion leaves the snapshot but still
+    # needs its phase corrected (Inqueue → Pending) on the full-rebuild
+    # cycle the deletion forces.
+    with metrics.cycle_phase_latency.time("status_writeback"):
+        ssn.cache.refresh_job_statuses(ssn._refresh_groups)
     metrics.pending_tasks.set(
         float(
             np.sum(

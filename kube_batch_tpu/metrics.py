@@ -218,6 +218,16 @@ idle_cycles_skipped = REGISTRY.register(Counter(
     "Cycles that skipped the solve dispatch entirely: no pending or "
     "releasing pods, no failed-bind resync, no policy change.",
 ))
+cycle_phase_latency = REGISTRY.register(Histogram(
+    "cycle_phase_latency_seconds",
+    "Within-cycle phase attribution (VERDICT r4 #4): dispatch = "
+    "enqueueing the fused solve; solve_d2h = device compute wait + the "
+    "batched D2H read; evict_commit = landing victim evictions; "
+    "bind_dispatch = gang-gated bind fan-out; diagnosis = "
+    "why-unschedulable tallies; status_writeback = PodGroup status "
+    "recompute + writes.  Pack time is snapshot_pack_latency.",
+    labels=("phase",),
+))
 
 
 def serve(address: str = ":8080") -> threading.Thread:

@@ -113,6 +113,14 @@ def shard_cycle_inputs(snap, state, mesh: Mesh, axis: str = NODE_AXIS):
     Falls back to full replication when the padded node count doesn't
     divide the mesh (bucketed padding makes this rare: both are powers
     of two).
+
+    Trace programs over these sharded inputs inside
+    ``ops.assignment.shard_local_scan()``: the auction's node-axis
+    prefix sum must not all-gather the full [T, N] matrix under SPMD
+    (ops/assignment.py · _node_cumsum), while single-chip traces keep
+    the plain scan whose flagship compile time is the measured-fast
+    program — a process-global flip here would silently diverge later
+    single-chip traces from the `make warm`ed persistent-cache entries.
     """
     n = snap.num_nodes
     # Multi-axis meshes (multi-slice: ("slice", "node")) shard the node

@@ -1,0 +1,159 @@
+"""Pre-populate the persistent XLA compile cache for hot-swappable
+confs (`make warm`; VERDICT r4 #5).
+
+Reference counterpart: none needed — the reference's hot reload
+(scheduler.go · loadSchedulerConf) swaps Go closures for free.  Here a
+conf swap means a NEW XLA program, and compile time at flagship shapes
+is program-dependent with a measured cliff (scheduler.py ·
+_ensure_compiled: the 4-action pipeline compiles ~30 s on the tunneled
+TPU while 1/2-action variants take the compile service 7-13+ minutes).
+The daemon therefore refuses to adopt a conf whose prewarm exceeds its
+budget; this tool removes the wait entirely by compiling every conf an
+operator may adopt into the persistent cache ahead of time — after a
+`make warm`, a hot swap replays in seconds.
+
+Each (conf variant × shape bucket) compiles in its OWN subprocess,
+serially: compiling a second large program in one process has been
+observed to hang the tunneled backend (bench.py's isolation note), and
+a killed compile client leaves an orphan server-side compilation that
+queues everyone behind it for minutes — so children get generous
+timeouts and are never killed early unless truly past them.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import subprocess
+import sys
+import time
+
+#: The action pipelines an operator can hot-swap between (the distinct
+#: pipelines of bench.py's CONFIG_ACTIONS plus the 3-action middle
+#: ground).  Order: cheapest-compile first, so an interrupted warm run
+#: still banked something.
+ACTION_VARIANTS: tuple[tuple[str, ...], ...] = (
+    ("allocate", "backfill", "preempt", "reclaim"),  # ~30 s (the fast one)
+    ("allocate",),
+    ("allocate", "backfill"),
+    ("allocate", "backfill", "preempt"),
+)
+
+
+def warm_one(config_n: int, actions: tuple[str, ...],
+             conf_path: str | None) -> dict:
+    """Child-process body: build the world + policy, AOT-compile the
+    fused cycle (writing the persistent cache), report timing."""
+    from kube_batch_tpu.compile_cache import enable_compile_cache
+
+    cache_dir = enable_compile_cache()
+    import os
+
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS"):
+        # The axon sitecustomize pins the platform at interpreter
+        # startup; honoring the env var needs an explicit config update
+        # before first device use (see the verify skill's tunnel note).
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+    from kube_batch_tpu.actions import factory as _af  # noqa: F401
+    from kube_batch_tpu.actions.fused import make_cycle_solver
+    from kube_batch_tpu.framework.conf import default_conf, load_conf
+    from kube_batch_tpu.framework.session import build_policy
+    from kube_batch_tpu.models.workloads import build_config
+    from kube_batch_tpu.ops.assignment import init_state
+    from kube_batch_tpu.plugins import factory as _pf  # noqa: F401
+
+    base = load_conf(conf_path) if conf_path else default_conf()
+    conf = dataclasses.replace(base, actions=tuple(actions))
+    world_cache, _sim = build_config(config_n)
+    from kube_batch_tpu.cache.packer import pack_snapshot
+
+    snap, _meta = pack_snapshot(world_cache.snapshot())
+    policy, _plugins = build_policy(conf)
+    cycle = jax.jit(make_cycle_solver(policy, conf.actions))
+    state = init_state(snap)
+    t0 = time.monotonic()
+    cycle.lower(snap, state).compile()
+    return {
+        "config": config_n,
+        "actions": list(actions),
+        "compile_s": round(time.monotonic() - t0, 1),
+        "cache_dir": cache_dir,
+        "device": jax.devices()[0].platform,
+    }
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="kube-batch-tpu-warm",
+        description="compile every hot-swappable conf into the "
+                    "persistent XLA cache",
+    )
+    p.add_argument("--shape-configs", default="5",
+                   help="comma-separated BASELINE config numbers whose "
+                        "shapes to warm (default: 5, the flagship)")
+    p.add_argument("--scheduler-conf", default=None,
+                   help="warm the tiers of THIS conf file (default: "
+                        "built-in default tiers) with each action "
+                        "variant")
+    p.add_argument("--timeout", type=float, default=1500.0,
+                   help="per-compile subprocess timeout in seconds "
+                        "(generous: the slow variants are the point)")
+    p.add_argument("--_one", default=None, help=argparse.SUPPRESS)
+    args = p.parse_args(argv)
+
+    if args._one is not None:
+        spec = json.loads(args._one)
+        try:
+            out = warm_one(spec["config"], tuple(spec["actions"]),
+                           spec.get("conf"))
+        except Exception as exc:  # noqa: BLE001 — report, don't crash
+            out = {"error": f"{type(exc).__name__}: {exc}"}
+        print(json.dumps(out))
+        return 0 if "error" not in out else 1
+
+    shapes = [int(c) for c in args.shape_configs.split(",") if c.strip()]
+    results = []
+    for n in shapes:
+        for actions in ACTION_VARIANTS:
+            spec = json.dumps({
+                "config": n, "actions": list(actions),
+                "conf": args.scheduler_conf,
+            })
+            label = f"config {n} × {','.join(actions)}"
+            print(f"[warm] {label}: compiling (subprocess, "
+                  f"timeout {args.timeout:.0f}s)...", flush=True)
+            t0 = time.monotonic()
+            try:
+                proc = subprocess.run(
+                    [sys.executable, "-m", "kube_batch_tpu.warm",
+                     "--_one", spec],
+                    capture_output=True, text=True, timeout=args.timeout,
+                )
+                line = (proc.stdout.strip().splitlines() or [""])[-1]
+                try:
+                    r = json.loads(line)
+                except json.JSONDecodeError:
+                    r = {"error":
+                         f"rc={proc.returncode}: {(proc.stderr or '')[-200:]}"}
+            except subprocess.TimeoutExpired:
+                r = {"error": f"timed out after {args.timeout:.0f}s "
+                              "(an orphan compile may now be queued "
+                              "server-side — let it drain before "
+                              "retrying)"}
+            r.setdefault("config", n)
+            r.setdefault("actions", list(actions))
+            r["wall_s"] = round(time.monotonic() - t0, 1)
+            results.append(r)
+            print(f"[warm] {label}: {r}", flush=True)
+    failed = [r for r in results if "error" in r]
+    print(json.dumps({"warmed": len(results) - len(failed),
+                      "failed": len(failed), "results": results}))
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

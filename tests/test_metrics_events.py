@@ -123,6 +123,35 @@ def test_pod_group_inqueue_phase():
         assert cache._jobs["lost"].pod_group.phase == PodGroupPhase.PENDING
 
 
+def test_inqueue_reverts_on_queue_deletion():
+    """A gang admitted to a real queue reports Inqueue; deleting the
+    queue orphans it OUT of the snapshot, so the corrective Pending
+    write must come from the cache-wide refresh, not the snapshot's
+    job list — a stale 'queued, awaiting capacity' would otherwise
+    persist forever."""
+    from kube_batch_tpu.api.types import PodGroupPhase
+    from kube_batch_tpu.cache.cluster import Queue
+
+    cache, sim = make_world(SPEC)
+    sim.add_queue(Queue(name="batch", weight=1.0))
+    sim.add_node(
+        Node(name="n0", allocatable={"cpu": 1000, "memory": 2 * GI, "pods": 110})
+    )
+    sim.submit(
+        PodGroup(name="adm", queue="batch", min_member=1),
+        [Pod(name="adm-0", request={"cpu": 64000, "memory": GI, "pods": 1})],
+    )
+    s = Scheduler(cache)
+    s.run_once()
+    with cache.lock():
+        assert cache._jobs["adm"].pod_group.phase == PodGroupPhase.INQUEUE
+
+    cache.delete_queue("batch")
+    s.run_once()  # full-rebuild cycle must correct the orphan's phase
+    with cache.lock():
+        assert cache._jobs["adm"].pod_group.phase == PodGroupPhase.PENDING
+
+
 def test_feasible_but_outranked_is_reported():
     """A pod with room that lost to gang all-or-nothing shows as
     feasible-but-outranked, not as a resource shortfall."""

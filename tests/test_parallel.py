@@ -219,3 +219,27 @@ def test_multislice_indivisible_degrades_to_ici_only():
     # padded nodes (32) % 6 != 0 but % 2 == 0 → per-slice sharding
     assert snap.num_nodes % 6 != 0 and snap.num_nodes % 2 == 0
     assert snap_s.node_idle.sharding.spec == PartitionSpec("node")
+
+
+def test_node_cumsum_matches_plain_cumsum():
+    """The block-local prefix sum (shard-local SPMD form) is bit-equal
+    to jnp.cumsum over the node axis at divisible, ragged, and tiny
+    shapes (incl. the fallback path)."""
+    import jax.numpy as jnp
+
+    from kube_batch_tpu.ops import assignment
+
+    rng = np.random.default_rng(7)
+    prev = assignment.SHARD_LOCAL_SCAN
+    assignment.SHARD_LOCAL_SCAN = True  # exercise the blocked form
+    try:
+        for t, n in [(5, 1024), (3, 256), (2, 96), (4, 100), (2, 32),
+                     (1, 4)]:
+            x = rng.integers(0, 3, size=(t, n)).astype(np.int32)
+            got = np.asarray(assignment._node_cumsum(jnp.asarray(x)))
+            want = np.cumsum(x, axis=1)
+            np.testing.assert_array_equal(
+                got, want, err_msg=f"shape {(t, n)}"
+            )
+    finally:
+        assignment.SHARD_LOCAL_SCAN = prev

@@ -145,3 +145,40 @@ def test_conf_edit_during_warm_restarts_prewarm(tmp_path):
         s.run_once()
         time.sleep(0.05)
     assert s._conf.actions == ("backfill",)
+
+
+def test_stuck_prewarm_refuses_adoption(tmp_path, caplog):
+    """A prewarm that exceeds its budget must NOT be adopted cold —
+    the previous policy keeps serving (no minutes-long in-cycle
+    compile; the measured XLA:TPU cliff makes that a real failure
+    mode) and a loud warning repeats until the warm completes."""
+    import logging
+    import threading
+    import time
+
+    conf = tmp_path / "s.conf"
+    conf.write_text("actions: allocate\n")
+    cache, _sim = build_config(1)
+    s = Scheduler(cache, conf_path=str(conf), schedule_period=0.0)
+    s.run_once()
+    assert s._conf.actions == ("allocate",)
+
+    conf.write_text("actions: allocate, backfill\n")
+    s._reload_conf()  # starts the prewarm
+    assert s._pending is not None
+    real_ready = s._pending["ready"]
+    # Simulate a stuck warm well past its budget.
+    s._pending["started"] -= s.PREWARM_TIMEOUT_S + 1
+    s._pending["ready"] = threading.Event()  # never set
+
+    with caplog.at_level(logging.WARNING):
+        s._reload_conf()
+    assert s._conf.actions == ("allocate",)  # refused; old policy serves
+    assert any("REFUSING adoption" in r.message for r in caplog.records)
+
+    # Once the (real) warm completes, the next reload adopts it.
+    assert real_ready.wait(60.0)
+    s._pending["ready"] = real_ready
+    s._reload_conf()
+    assert s._pending is None
+    assert s._conf.actions == ("allocate", "backfill")
