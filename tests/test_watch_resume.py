@@ -89,6 +89,45 @@ def test_adapter_tracks_resource_versions():
     assert adapter.resource_versions["PodGroup"] == cluster._rv - 1
 
 
+def test_k8s_dialect_tracks_metadata_resource_version():
+    """k8s-format watch events carry their RV on object.metadata; the
+    adapter must track those for resume exactly like the native
+    envelope field."""
+    import io
+    import json
+
+    from kube_batch_tpu.client.k8s import K8sWatchAdapter
+    from kube_batch_tpu.models.workloads import DEFAULT_SPEC
+    from kube_batch_tpu.sim.simulator import make_world
+
+    node = {
+        "kind": "Node", "apiVersion": "v1",
+        "metadata": {"name": "n0", "uid": "uid-n0",
+                     "resourceVersion": "101"},
+        "status": {"allocatable": {"cpu": "8", "memory": "16Gi",
+                                   "pods": "110"}},
+    }
+    pod = {
+        "kind": "Pod", "apiVersion": "v1",
+        "metadata": {"name": "p0", "uid": "uid-p0", "namespace": "default",
+                     "resourceVersion": "107",
+                     "annotations":
+                     {"scheduling.k8s.io/group-name": "g"}},
+        "spec": {"schedulerName": "kube-batch", "containers": []},
+        "status": {"phase": "Pending"},
+    }
+    lines = [json.dumps({"type": "ADDED", "object": node}),
+             json.dumps({"type": "ADDED", "object": pod}),
+             json.dumps({"type": "SYNC", "resourceVersion": 107})]
+    cache, _sim = make_world(DEFAULT_SPEC)
+    adapter = K8sWatchAdapter(cache, io.StringIO("\n".join(lines) + "\n"))
+    adapter.start()
+    assert adapter.wait_for_sync(5.0)
+    adapter.join(5.0)
+    assert adapter.resource_versions == {"Node": 101, "Pod": 107}
+    assert adapter.latest_rv == 107
+
+
 def test_watch_resume_replays_only_missed_tail():
     cluster = _cluster_world()
     sch_r, sch_w, _a = _connect(cluster)
