@@ -65,6 +65,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "apiserver seam); replaces --workload, accepts "
                         "native or k8s-format events, and moves "
                         "--leader-elect onto the wire lease")
+    p.add_argument("--kube-api", default=None,
+                   help="base URL of a Kubernetes apiserver (http[s]://"
+                        "host:port): LIST/WATCH over chunked HTTP with "
+                        "reflector resume, writes as Binding POSTs / "
+                        "DELETEs / status PUTs / Event POSTs "
+                        "(≙ client-go; exclusive with --cluster-stream)")
+    p.add_argument("--kube-token-file", default=None,
+                   help="bearer-token file for --kube-api "
+                        "(≙ a serviceaccount token)")
+    p.add_argument("--kube-insecure", action="store_true",
+                   help="skip TLS verification for --kube-api (dev only)")
     p.add_argument("--write-format", choices=("native", "k8s"),
                    default="native",
                    help="wire dialect for scheduling decisions: 'k8s' "
@@ -366,6 +377,64 @@ def run_external(args) -> int:
     return 0
 
 
+def run_http(args) -> int:
+    """Drive a real apiserver over HTTP list/watch (≙ the reference's
+    client-go transport).  Reconnects live INSIDE the reflectors (re-
+    watch from last RV, re-list on 410), so there is no supervise loop
+    here; leader election falls back to the host-local flock (the
+    coordination/v1 Lease dance is not implemented — see
+    client/http_api.py)."""
+    from kube_batch_tpu.cache.cache import SchedulerCache
+    from kube_batch_tpu.client.http_api import (
+        HttpWatchMux,
+        K8sHttpBackend,
+        _Client,
+    )
+    from kube_batch_tpu.client.k8s import K8sWatchAdapter
+
+    client = _Client(
+        args.kube_api,
+        token_file=args.kube_token_file,  # re-read on rotation
+        insecure=args.kube_insecure,
+    )
+    backend = K8sHttpBackend(client)
+    cache = SchedulerCache(
+        spec=ResourceSpec(),
+        binder=backend,
+        evictor=backend,
+        status_updater=backend,
+        default_queue=args.default_queue,
+    )
+    cache.event_sink = backend
+    mux = HttpWatchMux(client).start()
+    adapter = K8sWatchAdapter(
+        cache, mux, scheduler_name=args.scheduler_name
+    ).start()
+
+    lock = None
+    if args.leader_elect:
+        lock = acquire_leadership(args.lock_file)
+    try:
+        if not adapter.wait_for_sync(120.0):
+            logging.error("apiserver LIST never completed")
+            return 1
+        scheduler = Scheduler(
+            cache,
+            conf_path=args.scheduler_conf,
+            schedule_period=args.schedule_period,
+            profile_dir=args.profile_dir,
+        )
+        ran = scheduler.run(max_cycles=args.cycles)
+        logging.info("stopped after %d cycles", ran)
+    except KeyboardInterrupt:
+        logging.info("interrupted; shutting down")
+    finally:
+        mux.close()
+        if lock is not None:
+            lock.close()
+    return 0
+
+
 def acquire_leadership(lock_file: str):
     """Block until this process holds the flock (≙ leaderelection.
     RunOrDie's acquire loop).  Returns the held file object — keep it
@@ -399,6 +468,13 @@ def main(argv: list[str] | None = None) -> int:
         from kube_batch_tpu import metrics
 
         metrics.serve(args.listen_address)
+
+    if args.kube_api:
+        if args.workload or args.cluster_stream:
+            raise SystemExit(
+                "--kube-api is exclusive with --workload/--cluster-stream"
+            )
+        return run_http(args)
 
     if args.cluster_stream:
         # Real-cluster mode: cache fed by the wire, HA on the wire lease.

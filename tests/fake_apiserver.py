@@ -1,0 +1,235 @@
+"""A minimal in-process Kubernetes apiserver for transport tests.
+
+Serves the REST surface `client/http_api.py` speaks: JSON LISTs,
+chunked watch streams with resourceVersions, and the write verbs
+(Binding POST, pod DELETE, PodGroup status PUT, Event POST) — enough
+to drive the reflector loop (including forced 410 Gone) without a real
+cluster, the way `ExternalCluster` stands in for the JSON-lines wire.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlsplit
+
+
+class FakeApiServer:
+    def __init__(self) -> None:
+        self.objects: dict[str, dict[str, dict]] = {}  # kind → name → obj
+        self._rv = 0
+        self._lock = threading.RLock()
+        self._watchers: list[tuple[str, queue.Queue]] = []  # (kind, q)
+        # Watch cache: a real apiserver replays events after the
+        # watch's resourceVersion; reflectors resume from it.
+        self._history: list[tuple[int, str, dict]] = []
+        self.bindings: list[dict] = []
+        self.deletes: list[str] = []          # paths
+        self.status_puts: list[dict] = []
+        self.events: list[dict] = []
+        self.force_gone = False               # next watches answer 410
+        self.relist_serves = 0
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args):  # noqa: N802 — silence
+                pass
+
+            def _json(self, code: int, obj: dict) -> None:
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802
+                u = urlsplit(self.path)
+                kind = server._kind_for(u.path)
+                if kind is None:
+                    self._json(404, {"kind": "Status", "code": 404})
+                    return
+                q = parse_qs(u.query)
+                if q.get("watch"):
+                    server._serve_watch(self, kind)
+                else:
+                    server._serve_list(self, kind)
+
+            def do_POST(self):  # noqa: N802
+                server._serve_write(self, "POST")
+
+            def do_PUT(self):  # noqa: N802
+                server._serve_write(self, "PUT")
+
+            def do_DELETE(self):  # noqa: N802
+                server._serve_write(self, "DELETE")
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self.httpd.server_address[1]
+        self.url = f"http://127.0.0.1:{self.port}"
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+
+    # -- world mutations (emit watch events) ----------------------------
+    def upsert(self, kind: str, obj: dict, mtype: str | None = None) -> None:
+        with self._lock:
+            self._rv += 1
+            obj.setdefault("metadata", {})
+            obj["metadata"]["resourceVersion"] = str(self._rv)
+            name = obj["metadata"]["name"]
+            known = name in self.objects.setdefault(kind, {})
+            self.objects[kind][name] = obj
+            self._broadcast(
+                kind, mtype or ("MODIFIED" if known else "ADDED"), obj
+            )
+
+    def delete(self, kind: str, name: str) -> None:
+        with self._lock:
+            obj = self.objects.get(kind, {}).pop(name, None)
+            if obj is not None:
+                self._rv += 1
+                obj["metadata"]["resourceVersion"] = str(self._rv)
+                self._broadcast(kind, "DELETED", obj)
+
+    def drop_watches(self) -> None:
+        """Close every live watch stream (a network blip)."""
+        with self._lock:
+            for _kind, q in self._watchers:
+                q.put(None)
+
+    def stop(self) -> None:
+        self.drop_watches()
+        self.httpd.shutdown()
+
+    # -- internals ------------------------------------------------------
+    def _kind_for(self, path: str) -> str | None:
+        from kube_batch_tpu.client.http_api import DEFAULT_RESOURCES
+
+        for kind, p in DEFAULT_RESOURCES:
+            if path == p:
+                return kind
+        return None
+
+    def _broadcast(self, kind: str, mtype: str, obj: dict) -> None:
+        msg = {"type": mtype, "object": obj}
+        self._history.append((self._rv, kind, msg))
+        for wkind, q in self._watchers:
+            if wkind == kind:
+                q.put(msg)
+
+    def _serve_list(self, handler, kind: str) -> None:
+        with self._lock:
+            self.relist_serves += 1
+            items = list(self.objects.get(kind, {}).values())
+            rv = str(self._rv)
+        handler._json(200, {
+            "kind": f"{kind}List",
+            "metadata": {"resourceVersion": rv},
+            "items": items,
+        })
+
+    def _serve_watch(self, handler, kind: str) -> None:
+        u = urlsplit(handler.path)
+        since = int(
+            (parse_qs(u.query).get("resourceVersion") or ["0"])[0] or 0
+        )
+        with self._lock:
+            if self.force_gone:
+                handler._json(410, {"kind": "Status", "code": 410,
+                                    "reason": "Expired"})
+                return
+            q: queue.Queue = queue.Queue()
+            # Replay the watch cache past `since` BEFORE registering,
+            # under the lock — no event can be missed or duplicated.
+            for rv, hkind, msg in self._history:
+                if hkind == kind and rv > since:
+                    q.put(msg)
+            self._watchers.append((kind, q))
+        handler.send_response(200)
+        handler.send_header("Content-Type", "application/json")
+        handler.send_header("Transfer-Encoding", "chunked")
+        handler.end_headers()
+
+        def chunk(data: bytes) -> bool:
+            try:
+                handler.wfile.write(
+                    f"{len(data):x}\r\n".encode() + data + b"\r\n"
+                )
+                handler.wfile.flush()
+                return True
+            except OSError:
+                return False
+
+        try:
+            while True:
+                try:
+                    msg = q.get(timeout=0.5)
+                except queue.Empty:
+                    continue
+                if msg is None:  # drop_watches: end the stream
+                    break
+                if not chunk((json.dumps(msg) + "\n").encode()):
+                    break
+            try:
+                handler.wfile.write(b"0\r\n\r\n")
+            except OSError:
+                pass
+        finally:
+            with self._lock:
+                self._watchers = [
+                    (k, wq) for k, wq in self._watchers if wq is not q
+                ]
+
+    def _serve_write(self, handler, method: str) -> None:
+        length = int(handler.headers.get("Content-Length") or 0)
+        body = json.loads(handler.rfile.read(length) or b"{}") \
+            if length else {}
+        path = urlsplit(handler.path).path
+
+        m = re.fullmatch(
+            r"/api/v1/namespaces/([^/]+)/pods/([^/]+)/binding", path
+        )
+        if m and method == "POST":
+            with self._lock:
+                self.bindings.append({"path": path, "object": body})
+                pod = self.objects.get("Pod", {}).get(m.group(2))
+            if pod is None:
+                handler._json(404, {"kind": "Status", "code": 404})
+                return
+            pod = json.loads(json.dumps(pod))
+            pod["spec"]["nodeName"] = body.get("target", {}).get("name")
+            self.upsert("Pod", pod)
+            handler._json(201, {"kind": "Status", "status": "Success"})
+            return
+
+        m = re.fullmatch(r"/api/v1/namespaces/([^/]+)/pods/([^/]+)", path)
+        if m and method == "DELETE":
+            with self._lock:
+                self.deletes.append(path)
+            self.delete("Pod", m.group(2))
+            handler._json(200, {"kind": "Status", "status": "Success"})
+            return
+
+        if re.fullmatch(
+            r"/apis/[^/]+/v1alpha\d/namespaces/[^/]+/podgroups/[^/]+/status",
+            path,
+        ) and method == "PUT":
+            with self._lock:
+                self.status_puts.append({"path": path, "object": body})
+            handler._json(200, body)
+            return
+
+        if re.fullmatch(r"/api/v1/namespaces/[^/]+/events", path) \
+                and method == "POST":
+            with self._lock:
+                self.events.append(body)
+            handler._json(201, body)
+            return
+
+        handler._json(404, {"kind": "Status", "code": 404,
+                            "message": f"{method} {path}"})

@@ -1,0 +1,173 @@
+"""HTTP list/watch transport e2e (the client-go reflector analog).
+
+Drives `client/http_api.py` against the in-process `FakeApiServer`:
+LIST + chunked WATCH feed the cache through the unchanged
+`K8sWatchAdapter`, scheduling decisions leave as real HTTP writes
+(Binding POST / DELETE / status PUT / Event POST), dropped watch
+streams re-watch from the last resourceVersion, and a 410 Gone forces
+a full re-list — all without a cluster.
+"""
+
+from __future__ import annotations
+
+import time
+
+from kube_batch_tpu.actions import BUILTIN_ACTIONS  # noqa: F401
+from kube_batch_tpu.api.resource import ResourceSpec
+from kube_batch_tpu.cache.cache import SchedulerCache
+from kube_batch_tpu.client.http_api import (
+    HttpWatchMux,
+    K8sHttpBackend,
+    _Client,
+)
+from kube_batch_tpu.client.k8s import K8sWatchAdapter
+from kube_batch_tpu.plugins import BUILTIN_PLUGINS  # noqa: F401
+from kube_batch_tpu.scheduler import Scheduler
+
+from tests.fake_apiserver import FakeApiServer
+from tests.test_k8s_ingest import k8s_node, k8s_pod, k8s_pod_group
+
+SPEC = ResourceSpec(("cpu", "memory", "pods", "accelerator"))
+
+
+def _wire_up(server: FakeApiServer):
+    client = _Client(server.url, timeout=10.0)
+    backend = K8sHttpBackend(client)
+    cache = SchedulerCache(
+        SPEC, binder=backend, evictor=backend, status_updater=backend
+    )
+    cache.event_sink = backend
+    mux = HttpWatchMux(client).start()
+    adapter = K8sWatchAdapter(cache, mux).start()
+    return cache, mux, adapter, Scheduler(cache, conf_path=None)
+
+
+def _wait(predicate, timeout: float = 10.0) -> bool:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def _world(server: FakeApiServer) -> None:
+    server.upsert("Node", k8s_node("n0"))
+    server.upsert("PodGroup", k8s_pod_group("gang", min_member=2))
+    server.upsert("Pod", k8s_pod("w-0", group="gang", cpu="1", mem="1Gi"))
+    server.upsert("Pod", k8s_pod("w-1", group="gang", cpu="1", mem="1Gi"))
+
+
+def test_http_list_watch_schedules_gang():
+    server = FakeApiServer()
+    try:
+        _world(server)
+        cache, mux, adapter, scheduler = _wire_up(server)
+        assert adapter.wait_for_sync(10.0)
+
+        ssn = scheduler.run_once()
+        assert len(ssn.bound) == 2
+        # Binds arrived as real HTTP Binding-subresource POSTs.
+        paths = sorted(b["path"] for b in server.bindings)
+        assert paths == [
+            "/api/v1/namespaces/default/pods/w-0/binding",
+            "/api/v1/namespaces/default/pods/w-1/binding",
+        ]
+        assert all(
+            b["object"]["kind"] == "Binding"
+            and b["object"]["target"]["name"] == "n0"
+            for b in server.bindings
+        )
+        # The server's MODIFIED (nodeName set) flowed back through the
+        # watch; PodGroup status left as a status-subresource PUT.
+        assert _wait(lambda: server.status_puts)
+        assert server.status_puts[-1]["object"]["status"]["running"] == 2
+        # Bound events POSTed to /events.
+        assert _wait(lambda: any(
+            e.get("reason") == "Bound" for e in server.events
+        ))
+        mux.close()
+    finally:
+        server.stop()
+
+
+def test_watch_drop_resumes_from_last_rv():
+    server = FakeApiServer()
+    try:
+        _world(server)
+        cache, mux, adapter, scheduler = _wire_up(server)
+        assert adapter.wait_for_sync(10.0)
+        scheduler.run_once()
+        lists_before = server.relist_serves
+
+        server.drop_watches()  # network blip: every stream closes
+        # Churn during the gap — the re-watch must deliver it.
+        server.upsert(
+            "Pod", k8s_pod("late-0", group="late", cpu="1", mem="1Gi")
+        )
+        server.upsert("PodGroup", k8s_pod_group("late", min_member=1))
+        assert _wait(lambda: "uid-pod-late-0" in cache._pods)
+        ssn = scheduler.run_once()
+        assert ("late-0", "n0") in ssn.bound
+        # Plain drops re-WATCH (from the last RV), they don't re-LIST.
+        assert server.relist_serves == lists_before
+        mux.close()
+    finally:
+        server.stop()
+
+
+def test_410_gone_forces_full_relist():
+    server = FakeApiServer()
+    try:
+        _world(server)
+        cache, mux, adapter, scheduler = _wire_up(server)
+        assert adapter.wait_for_sync(10.0)
+        lists_before = server.relist_serves
+
+        server.force_gone = True
+        server.drop_watches()
+        # Churn DURING the gap, including a deletion: the re-list must
+        # synthesize the DELETED (client-go Replace semantics) or the
+        # vanished pod's capacity leaks in the cache forever.
+        server.delete("Pod", "w-1")
+        server.upsert(
+            "Pod", k8s_pod("post-gone", group="pg2", cpu="1", mem="1Gi")
+        )
+        server.upsert("PodGroup", k8s_pod_group("pg2", min_member=1))
+        time.sleep(0.5)
+        server.force_gone = False
+        assert _wait(lambda: "uid-pod-post-gone" in cache._pods)
+        assert _wait(lambda: "uid-pod-w-1" not in cache._pods)
+        assert server.relist_serves > lists_before
+        assert any(r.relists for r in mux.reflectors)
+        mux.close()
+    finally:
+        server.stop()
+
+
+def test_base_url_path_prefix_survives():
+    """An apiserver behind a path prefix (kubectl proxy, Rancher) must
+    see the prefix on every request."""
+    client = _Client("http://127.0.0.1:1/k8s/clusters/abc/")
+    assert client.prefix == "/k8s/clusters/abc"
+
+
+def test_unschedulable_surfaces_as_http_events():
+    server = FakeApiServer()
+    try:
+        server.upsert("Node", k8s_node("n0", cpu="1"))
+        server.upsert("PodGroup", k8s_pod_group("big", min_member=1))
+        server.upsert(
+            "Pod", k8s_pod("big-0", group="big", cpu="64", mem="1Gi")
+        )
+        cache, mux, adapter, scheduler = _wire_up(server)
+        assert adapter.wait_for_sync(10.0)
+        scheduler.run_once()
+        assert _wait(lambda: any(
+            e.get("reason") == "FailedScheduling"
+            and e.get("type") == "Warning"
+            for e in server.events
+        ))
+        mux.close()
+    finally:
+        server.stop()
