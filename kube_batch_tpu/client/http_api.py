@@ -224,11 +224,19 @@ class Reflector:
 
     def _watch_once(self) -> bool:
         """One watch stream; returns True when a re-LIST is required
-        (410/ERROR), False on a plain drop (re-watch from last RV)."""
-        q = urllib.parse.urlencode(
-            {"watch": "1", "resourceVersion": self.last_rv}
-            if self.last_rv else {"watch": "1"}
-        )
+        (410/ERROR), False on a plain drop (re-watch from last RV).
+
+        `timeoutSeconds` bounds every watch server-side (client-go's
+        randomized minWatchTimeout): reads are deliberately blocking
+        (a client read timeout corrupts mid-chunk state), so a
+        half-open connection that lost its FIN would otherwise wedge
+        this resource's reflector forever — the server ending the
+        stream is what guarantees liveness."""
+        params = {"watch": "1",
+                  "timeoutSeconds": str(300 + (id(self) % 240))}
+        if self.last_rv:
+            params["resourceVersion"] = self.last_rv
+        q = urllib.parse.urlencode(params)
         conn = self.client.connect(timeout=10.0)
         try:
             conn.request(
@@ -365,7 +373,12 @@ class K8sHttpBackend:
     """Binder/Evictor/StatusUpdater/EventSink over real HTTP, issuing
     the exact shapes of client/k8s_write.py as REST calls (create →
     POST, delete → DELETE, update → PUT).  Raises on non-2xx, which
-    the cache's bind/evict funnel turns into resync/rollback."""
+    the cache's bind/evict funnel turns into resync/rollback.
+
+    Writes share ONE kept-alive connection (serialized under a lock,
+    reopened on error): a 100-pod gang commit at tunnel latencies must
+    not pay TCP+TLS setup per Binding POST — per-call connections
+    would multiply every decision's cost by handshake round trips."""
 
     _METHODS = {"create": "POST", "delete": "DELETE", "update": "PUT"}
 
@@ -377,11 +390,40 @@ class K8sHttpBackend:
         # restarts (a real apiserver 409s duplicate names).
         self._event_seq = time.time_ns()
         self._event_lock = threading.Lock()
+        self._conn: http.client.HTTPConnection | None = None
+        self._conn_lock = threading.Lock()
 
     def _issue(self, req: dict) -> None:
-        self.client.request_json(
-            self._METHODS[req["verb"]], req["path"], req["object"]
+        method = self._METHODS[req["verb"]]
+        path = self.client.prefix + req["path"]
+        payload = json.dumps(req["object"])
+        headers = self.client._headers(
+            {"Content-Type": "application/json"}
         )
+        with self._conn_lock:
+            for attempt in (1, 2):
+                try:
+                    if self._conn is None:
+                        self._conn = self.client.connect()
+                    self._conn.request(
+                        method, path, body=payload, headers=headers
+                    )
+                    resp = self._conn.getresponse()
+                    data = resp.read().decode("utf-8", "replace")
+                    if resp.status >= 300:
+                        raise HttpError(resp.status, data)
+                    return
+                except HttpError:
+                    raise  # a real apiserver answer; don't retry here
+                except (OSError, http.client.HTTPException):
+                    # Stale keep-alive (idle close, blip): reopen once.
+                    try:
+                        self._conn.close()
+                    except Exception:  # noqa: BLE001
+                        pass
+                    self._conn = None
+                    if attempt == 2:
+                        raise
 
     def bind(self, pod: Pod, node_name: str) -> None:
         self._issue(binding_request(pod, node_name))

@@ -90,6 +90,13 @@ class Scheduler:
         # cycles (the journal itself must stay intact for the next real
         # pack, so progress is tracked here, not by draining it).
         self._idle_refreshed_version = 0
+        # Opt-in compact D2H payload (see actions/fused.py ·
+        # make_cycle_solver): changes the compiled program, so it must
+        # not silently diverge a default daemon from the persistent
+        # cache's warmed entries.
+        import os
+
+        self._compact_wire = os.environ.get("KB_TPU_COMPACT_WIRE") == "1"
 
     # -- configuration (hot reload) -------------------------------------
     def _build_from_conf(self, conf: SchedulerConf) -> dict:
@@ -114,7 +121,9 @@ class Scheduler:
             # builds the initial state from the packer's HOST arrays, so
             # the upload rides the jit call's own argument transfer
             # (framework/session.py · Session.state).
-            cycle = jax.jit(make_cycle_solver(policy, conf.actions))
+            cycle = jax.jit(make_cycle_solver(
+                policy, conf.actions, compact_wire=self._compact_wire
+            ))
         except Exception as exc:  # noqa: BLE001 — any build failure must
             # fall back to per-action dispatch, never break the daemon's
             # keep-previous-policy contract (the actions themselves were
@@ -306,7 +315,7 @@ class Scheduler:
         exe = self._ensure_compiled(ssn.snap, ssn.state)
         with metrics.action_latency.time("fused"):
             with metrics.cycle_phase_latency.time("dispatch"):
-                state, evict_masks, job_ready, diag = exe(
+                state, evict_payload, job_ready, diag = exe(
                     ssn.snap, ssn.state
                 )
             ssn.state = state
@@ -318,12 +327,29 @@ class Scheduler:
             # between solve time and cycle time).  The ~MB diagnosis
             # tallies stay on device: diagnose_pending fetches them
             # only when something is actually Pending.
-            with metrics.cycle_phase_latency.time("solve_d2h"):
-                (host_state, host_node, host_ready,
-                 host_evicts) = jax.device_get((
-                     state.task_state, state.task_node, job_ready,
-                     evict_masks,
-                 ))
+            if self._compact_wire:
+                # evict_payload is the narrow `wire` dict; widen on the
+                # host after the (much smaller) transfer.
+                with metrics.cycle_phase_latency.time("solve_d2h"):
+                    (host_state_c, host_node_c, host_ready,
+                     host_code) = jax.device_get((
+                         evict_payload["task_state"],
+                         evict_payload["task_node"], job_ready,
+                         evict_payload["evict_code"],
+                     ))
+                host_state = host_state_c.astype(np.int32)
+                host_node = host_node_c.astype(np.int32)
+                host_evicts = {
+                    name: host_code == np.uint8(i + 1)
+                    for i, name in enumerate(self._conf.actions)
+                }
+            else:
+                with metrics.cycle_phase_latency.time("solve_d2h"):
+                    (host_state, host_node, host_ready,
+                     host_evicts) = jax.device_get((
+                         state.task_state, state.task_node, job_ready,
+                         evict_payload,
+                     ))
             ssn.set_host_final(host_state, host_node)
             ssn.set_job_ready(host_ready)
             ssn.set_diagnosis(diag)

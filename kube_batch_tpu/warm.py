@@ -68,12 +68,20 @@ def warm_one(config_n: int, actions: tuple[str, ...],
 
     base = load_conf(conf_path) if conf_path else default_conf()
     conf = dataclasses.replace(base, actions=tuple(actions))
+    # Warm the SAME program the daemon will compile: the compact-wire
+    # env flag changes the XLA program, and a cache warmed for the
+    # wrong variant is a cache miss at the worst moment.
+    import os
+
+    compact = os.environ.get("KB_TPU_COMPACT_WIRE") == "1"
     world_cache, _sim = build_config(config_n)
     from kube_batch_tpu.cache.packer import pack_snapshot
 
     snap, _meta = pack_snapshot(world_cache.snapshot())
     policy, _plugins = build_policy(conf)
-    cycle = jax.jit(make_cycle_solver(policy, conf.actions))
+    cycle = jax.jit(make_cycle_solver(
+        policy, conf.actions, compact_wire=compact
+    ))
     state = init_state(snap)
     t0 = time.monotonic()
     cycle.lower(snap, state).compile()

@@ -182,3 +182,55 @@ def test_stuck_prewarm_refuses_adoption(tmp_path, caplog):
     s._reload_conf()
     assert s._pending is None
     assert s._conf.actions == ("allocate", "backfill")
+
+
+def test_compact_wire_matches_default(tmp_path, monkeypatch):
+    """KB_TPU_COMPACT_WIRE=1 shrinks the device->host payload (u8/i16
+    codes instead of i32/bool arrays) but must commit IDENTICAL
+    decisions: same binds, same per-action evictions."""
+    from kube_batch_tpu.api.resource import ResourceSpec
+    from kube_batch_tpu.cache.cluster import Node, Pod, PodGroup
+    from kube_batch_tpu.models.workloads import GI
+    from kube_batch_tpu.sim.simulator import make_world
+
+    conf = tmp_path / "s.conf"
+    conf.write_text("actions: allocate, backfill, preempt, reclaim\n")
+
+    def drive(compact: bool):
+        if compact:
+            monkeypatch.setenv("KB_TPU_COMPACT_WIRE", "1")
+        else:
+            monkeypatch.delenv("KB_TPU_COMPACT_WIRE", raising=False)
+        spec = ResourceSpec(("cpu", "memory", "pods", "accelerator"))
+        cache, sim = make_world(spec)
+        for i in range(2):
+            sim.add_node(Node(
+                name=f"n{i}",
+                allocatable={"cpu": 4000, "memory": 8 * GI, "pods": 110},
+            ))
+        sim.submit(
+            PodGroup(name="low", queue="default", min_member=1),
+            [Pod(name=f"low-{i}",
+                 request={"cpu": 2000, "memory": 4 * GI, "pods": 1})
+             for i in range(4)],
+        )
+        s = Scheduler(cache, conf_path=str(conf), schedule_period=0.0)
+        s.run_once()
+        sim.tick()
+        sim.submit(
+            PodGroup(name="high", queue="default", min_member=2,
+                     priority=1000),
+            [Pod(name=f"high-{i}", priority=1000,
+                 request={"cpu": 2000, "memory": 4 * GI, "pods": 1})
+             for i in range(2)],
+        )
+        ssn2 = s.run_once()
+        evicted = sorted(ssn2.evicted)
+        sim.tick()
+        s.run_once()
+        return sorted(sim.binds), evicted, sorted(sim.evictions)
+
+    base = drive(False)
+    compact = drive(True)
+    assert compact == base
+    assert base[1], "scenario must actually exercise evictions"
