@@ -381,11 +381,15 @@ def run_http(args) -> int:
     """Drive a real apiserver over HTTP list/watch (≙ the reference's
     client-go transport).  Reconnects live INSIDE the reflectors (re-
     watch from last RV, re-list on 410), so there is no supervise loop
-    here; leader election falls back to the host-local flock (the
-    coordination/v1 Lease dance is not implemented — see
-    client/http_api.py)."""
+    here; --leader-elect contends for a coordination.k8s.io/v1 Lease
+    on the apiserver (≙ leaderelection.RunOrDie's LeaseLock)."""
+    import os
+    import socket
+    import threading
+
     from kube_batch_tpu.cache.cache import SchedulerCache
     from kube_batch_tpu.client.http_api import (
+        HttpLeaseElector,
         HttpWatchMux,
         K8sHttpBackend,
         _Client,
@@ -411,10 +415,21 @@ def run_http(args) -> int:
         cache, mux, scheduler_name=args.scheduler_name
     ).start()
 
-    lock = None
-    if args.leader_elect:
-        lock = acquire_leadership(args.lock_file)
+    elector = None
+    stop = threading.Event()
     try:
+        if args.leader_elect:
+            elector = HttpLeaseElector(
+                client, holder=f"{socket.gethostname()}-{os.getpid()}"
+            )
+            logging.info(
+                "contending for Lease %s as %s",
+                elector.name, elector.holder,
+            )
+            if not elector.acquire(stop):
+                return 1
+            elector.start_renewing(on_lost=stop.set)
+
         if not adapter.wait_for_sync(120.0):
             logging.error("apiserver LIST never completed")
             return 1
@@ -424,14 +439,14 @@ def run_http(args) -> int:
             schedule_period=args.schedule_period,
             profile_dir=args.profile_dir,
         )
-        ran = scheduler.run(max_cycles=args.cycles)
+        ran = scheduler.run(stop=stop, max_cycles=args.cycles)
         logging.info("stopped after %d cycles", ran)
     except KeyboardInterrupt:
         logging.info("interrupted; shutting down")
     finally:
         mux.close()
-        if lock is not None:
-            lock.close()
+        if elector is not None:
+            elector.release()
     return 0
 
 

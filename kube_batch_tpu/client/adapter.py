@@ -167,13 +167,26 @@ class StreamBackend:
         self._call({"verb": "releaseLease", "holder": holder})
 
 
+class FatalElectionError(Exception):
+    """An election error no amount of retrying fixes (bad token,
+    missing RBAC): `LeaseElector.acquire` re-raises it instead of
+    silently retrying forever — a misconfigured daemon must fail
+    loudly at startup, not sit at 'contending' with debug-level logs."""
+
+
 class LeaseElector:
-    """Active/passive leader election over the wire lease
-    (≙ app/server.go · leaderelection.RunOrDie with a LeaseLock held on
-    the cluster side): `acquire` blocks until this process holds the
-    lease, `start_renewing` keeps it alive on a daemon thread and
-    invokes `on_lost` the moment a renewal is rejected — the standing-
-    down path OnStoppedLeading handles in the reference."""
+    """Active/passive leader election over a lease lock
+    (≙ app/server.go · leaderelection.RunOrDie over a resourcelock):
+    `acquire` blocks until this process holds the lease,
+    `start_renewing` keeps it alive on a daemon thread and invokes
+    `on_lost` the moment a renewal is rejected — the standing-down
+    path OnStoppedLeading handles in the reference.
+
+    The lock primitive is whatever `backend` provides
+    (acquire_lease/renew_lease/release_lease): the wire-stream verbs
+    here, or the coordination/v1 Lease CAS of
+    `client.http_api.HttpLeaseElector` — one election state machine,
+    pluggable resourcelocks, exactly client-go's split."""
 
     def __init__(
         self,
@@ -199,6 +212,8 @@ class LeaseElector:
                 self.backend.acquire_lease(self.holder, self.ttl)
                 log.info("lease acquired by %s (ttl %.1fs)", self.holder, self.ttl)
                 return True
+            except FatalElectionError:
+                raise  # misconfiguration: fail loud, never spin
             except Exception as exc:  # noqa: BLE001 — held by the leader
                 log.debug("lease acquire failed: %s", exc)
             if stop is not None:

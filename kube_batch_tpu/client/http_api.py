@@ -24,8 +24,9 @@ Auth/TLS lowering: a bearer token (``--kube-token-file``) rides the
 Authorization header; https URLs use the default ssl context (or an
 unverified one with ``insecure=True`` — kubeconfig parsing and client
 certs are deliberately out of scope without a live cluster to verify
-against).  Leader election stays on the wire-lease/flock paths; the
-coordination/v1 Lease dance is not implemented.
+against).  `HttpLeaseElector` runs leader election on a
+coordination.k8s.io/v1 Lease with apiserver optimistic concurrency —
+the actual resourcelock `leaderelection.RunOrDie` uses.
 """
 
 from __future__ import annotations
@@ -393,6 +394,14 @@ class K8sHttpBackend:
         self._conn: http.client.HTTPConnection | None = None
         self._conn_lock = threading.Lock()
 
+    def _drop_conn(self) -> None:
+        try:
+            if self._conn is not None:
+                self._conn.close()
+        except Exception:  # noqa: BLE001
+            pass
+        self._conn = None
+
     def _issue(self, req: dict) -> None:
         method = self._METHODS[req["verb"]]
         path = self.client.prefix + req["path"]
@@ -402,28 +411,46 @@ class K8sHttpBackend:
         )
         with self._conn_lock:
             for attempt in (1, 2):
+                fresh = self._conn is None
+                if fresh:
+                    self._conn = self.client.connect()
                 try:
-                    if self._conn is None:
-                        self._conn = self.client.connect()
                     self._conn.request(
                         method, path, body=payload, headers=headers
                     )
-                    resp = self._conn.getresponse()
-                    data = resp.read().decode("utf-8", "replace")
-                    if resp.status >= 300:
-                        raise HttpError(resp.status, data)
-                    return
-                except HttpError:
-                    raise  # a real apiserver answer; don't retry here
                 except (OSError, http.client.HTTPException):
-                    # Stale keep-alive (idle close, blip): reopen once.
-                    try:
-                        self._conn.close()
-                    except Exception:  # noqa: BLE001
-                        pass
-                    self._conn = None
+                    # Failed to SEND: the server never saw it — always
+                    # safe to retry, even for non-idempotent verbs.
+                    self._drop_conn()
                     if attempt == 2:
                         raise
+                    continue
+                try:
+                    resp = self._conn.getresponse()
+                    data = resp.read().decode("utf-8", "replace")
+                except http.client.RemoteDisconnected:
+                    self._drop_conn()
+                    if not fresh and attempt == 1:
+                        # A REUSED keep-alive closed with zero response
+                        # bytes: the server shut the idle connection
+                        # before reading the request — retry on a
+                        # fresh one.  (A fresh connection dying here is
+                        # ambiguous: the write may have LANDED, and
+                        # blindly re-POSTing a Binding would 409 and
+                        # roll back a bind that succeeded — surface it
+                        # instead; the resync/watch paths reconcile.)
+                        continue
+                    raise ConnectionError(
+                        f"response lost for {method} {path}"
+                    )
+                except (OSError, http.client.HTTPException) as exc:
+                    self._drop_conn()
+                    raise ConnectionError(
+                        f"response lost for {method} {path}: {exc}"
+                    ) from exc
+                if resp.status >= 300:
+                    raise HttpError(resp.status, data)
+                return
 
     def bind(self, pod: Pod, node_name: str) -> None:
         self._issue(binding_request(pod, node_name))
@@ -448,3 +475,169 @@ class K8sHttpBackend:
             ))
         except Exception as exc:  # noqa: BLE001 — events are best-effort
             log.debug("event post failed: %s", exc)
+
+
+class _HttpLeaseLock:
+    """The resourcelock primitive over a coordination.k8s.io/v1 Lease
+    (≙ client-go's LeaseLock), consumed by the shared `LeaseElector`
+    state machine: acquire/renew raise when the lease is held, with
+    apiserver optimistic concurrency (a 409 on update = lost the race).
+
+    Expiry is judged by LOCAL observation, never by comparing clocks
+    across hosts: the remote renewTime is only a CHANGE detector — a
+    lease counts as expired when the SAME renewTime has been observed
+    locally for longer than leaseDurationSeconds (client-go's
+    observedTime dance).  Cross-host clock skew therefore cannot cause
+    a wrongful steal from a live leader."""
+
+    def __init__(
+        self,
+        client: _Client,
+        name: str = "kube-batch-tpu",
+        namespace: str = "kube-system",
+    ) -> None:
+        self.client = client
+        self.name = name
+        self.namespace = namespace
+        self.path = (
+            f"/apis/coordination.k8s.io/v1/namespaces/{namespace}"
+            f"/leases/{name}"
+        )
+        self.collection = (
+            f"/apis/coordination.k8s.io/v1/namespaces/{namespace}/leases"
+        )
+        # (renewTime string last seen, local monotonic when first seen)
+        self._observed: tuple[str | None, float] = (None, 0.0)
+
+    @staticmethod
+    def _now() -> str:
+        import datetime
+
+        return datetime.datetime.now(datetime.timezone.utc).strftime(
+            "%Y-%m-%dT%H:%M:%S.%fZ"
+        )
+
+    def _locally_expired(self, renew_time: str | None, ttl: float) -> bool:
+        import time as _time
+
+        seen, since = self._observed
+        if renew_time != seen:
+            # Fresh renewal observed: restart the local clock.
+            self._observed = (renew_time, _time.monotonic())
+            return False
+        return _time.monotonic() - since > ttl
+
+    def _try_take(self, holder: str, ttl: float) -> bool:
+        """One CAS attempt; True when `holder` now holds the Lease."""
+        from kube_batch_tpu.client.adapter import FatalElectionError
+
+        try:
+            try:
+                lease = self.client.request_json("GET", self.path)
+            except HttpError as exc:
+                if exc.status in (401, 403):
+                    raise FatalElectionError(
+                        f"lease access denied ({exc.status}): check the "
+                        f"token / RBAC on coordination.k8s.io leases"
+                    ) from exc
+                if exc.status != 404:
+                    raise
+                body = {
+                    "apiVersion": "coordination.k8s.io/v1",
+                    "kind": "Lease",
+                    "metadata": {"name": self.name,
+                                 "namespace": self.namespace},
+                    "spec": {
+                        "holderIdentity": holder,
+                        "leaseDurationSeconds": int(ttl),
+                        "acquireTime": self._now(),
+                        "renewTime": self._now(),
+                        "leaseTransitions": 0,
+                    },
+                }
+                try:
+                    self.client.request_json("POST", self.collection, body)
+                    return True
+                except HttpError as exc2:
+                    if exc2.status == 409:
+                        return False  # lost the creation race
+                    raise
+            spec = lease.get("spec") or {}
+            current = spec.get("holderIdentity")
+            if current and current != holder and not self._locally_expired(
+                spec.get("renewTime"),
+                float(spec.get("leaseDurationSeconds") or ttl),
+            ):
+                return False  # held by a live leader
+            spec.update({
+                "holderIdentity": holder,
+                "leaseDurationSeconds": int(ttl),
+                "renewTime": self._now(),
+            })
+            if current != holder:
+                spec["acquireTime"] = self._now()
+                spec["leaseTransitions"] = int(
+                    spec.get("leaseTransitions") or 0
+                ) + 1
+            lease["spec"] = spec
+            try:
+                self.client.request_json("PUT", self.path, lease)
+                return True
+            except HttpError as exc:
+                if exc.status == 409:
+                    return False  # lost the update race (stale RV)
+                raise
+        except FatalElectionError:
+            raise
+        except HttpError as exc:
+            if exc.status in (401, 403):
+                raise FatalElectionError(
+                    f"lease access denied ({exc.status})"
+                ) from exc
+            # Other apiserver answers are transient for election
+            # purposes — but must NOT look like a definitive "lease
+            # lost" (RuntimeError) to the renew loop.
+            raise ConnectionError(str(exc)) from exc
+
+    # -- the backend protocol LeaseElector consumes ---------------------
+    def acquire_lease(self, holder: str, ttl: float) -> None:
+        if not self._try_take(holder, ttl):
+            raise ConnectionError("lease held by the current leader")
+
+    def renew_lease(self, holder: str, ttl: float) -> None:
+        if not self._try_take(holder, ttl):
+            # Definitive: another identity owns an unexpired Lease
+            # (RuntimeError = the renew loop's stand-down signal).
+            raise RuntimeError(f"lease lost by {holder}")
+
+    def release_lease(self, holder: str) -> None:
+        try:
+            lease = self.client.request_json("GET", self.path)
+        except HttpError:
+            return
+        if (lease.get("spec") or {}).get("holderIdentity") == holder:
+            lease["spec"]["holderIdentity"] = ""
+            self.client.request_json("PUT", self.path, lease)
+
+
+def HttpLeaseElector(
+    client: _Client,
+    holder: str,
+    name: str = "kube-batch-tpu",
+    namespace: str = "kube-system",
+    ttl: float = 15.0,
+    retry_period: float | None = None,
+):
+    """Leader election on a coordination/v1 Lease: the shared
+    `LeaseElector` machinery (acquire loop, renew deadline, stand-down,
+    release) over the `_HttpLeaseLock` primitive — one election state
+    machine for both transports, differing only in the resourcelock
+    (≙ client-go's leaderelection / resourcelock split)."""
+    from kube_batch_tpu.client.adapter import LeaseElector
+
+    elector = LeaseElector(
+        _HttpLeaseLock(client, name, namespace), holder,
+        ttl=ttl, retry_period=retry_period,
+    )
+    elector.name = name
+    return elector

@@ -50,6 +50,8 @@ class FakeApiServer:
 
             def do_GET(self):  # noqa: N802
                 u = urlsplit(self.path)
+                if server._serve_lease(self, "GET", u.path):
+                    return
                 kind = server._kind_for(u.path)
                 if kind is None:
                     self._json(404, {"kind": "Status", "code": 404})
@@ -185,11 +187,64 @@ class FakeApiServer:
                     (k, wq) for k, wq in self._watchers if wq is not q
                 ]
 
+    # -- coordination.k8s.io/v1 Lease (optimistic concurrency) ----------
+    _LEASE_RE = re.compile(
+        r"/apis/coordination\.k8s\.io/v1/namespaces/([^/]+)/leases"
+        r"(?:/([^/]+))?$"
+    )
+
+    def _serve_lease(self, handler, method: str, path: str,
+                     body: dict | None = None) -> bool:
+        m = self._LEASE_RE.fullmatch(path)
+        if not m:
+            return False
+        name = m.group(2)
+        with self._lock:
+            leases = self.objects.setdefault("Lease", {})
+            if method == "GET":
+                if name and name in leases:
+                    handler._json(200, leases[name])
+                else:
+                    handler._json(404, {"kind": "Status", "code": 404})
+            elif method == "POST":
+                name = body["metadata"]["name"]
+                if name in leases:
+                    handler._json(409, {"kind": "Status", "code": 409,
+                                        "reason": "AlreadyExists"})
+                    return True
+                self._rv += 1
+                body["metadata"]["resourceVersion"] = str(self._rv)
+                leases[name] = body
+                handler._json(201, body)
+            elif method == "PUT":
+                current = leases.get(name)
+                if current is None:
+                    handler._json(404, {"kind": "Status", "code": 404})
+                    return True
+                want_rv = (body.get("metadata") or {}).get(
+                    "resourceVersion"
+                )
+                if want_rv != current["metadata"]["resourceVersion"]:
+                    # ≙ apiserver optimistic-concurrency Conflict.
+                    handler._json(409, {"kind": "Status", "code": 409,
+                                        "reason": "Conflict"})
+                    return True
+                self._rv += 1
+                body.setdefault("metadata", {})["resourceVersion"] = \
+                    str(self._rv)
+                leases[name] = body
+                handler._json(200, body)
+            else:
+                handler._json(405, {"kind": "Status", "code": 405})
+        return True
+
     def _serve_write(self, handler, method: str) -> None:
         length = int(handler.headers.get("Content-Length") or 0)
         body = json.loads(handler.rfile.read(length) or b"{}") \
             if length else {}
         path = urlsplit(handler.path).path
+        if self._serve_lease(handler, method, path, body):
+            return
 
         m = re.fullmatch(
             r"/api/v1/namespaces/([^/]+)/pods/([^/]+)/binding", path

@@ -171,3 +171,103 @@ def test_unschedulable_surfaces_as_http_events():
         mux.close()
     finally:
         server.stop()
+
+
+def test_http_lease_election_two_contenders():
+    """coordination/v1 Lease leader election (≙ leaderelection.RunOrDie
+    over the LeaseLock): one contender wins, the standby takes over
+    after the leader stops renewing, and a renewal after takeover
+    stands the old leader down."""
+    import threading
+
+    from kube_batch_tpu.client.http_api import HttpLeaseElector
+
+    server = FakeApiServer()
+    try:
+        import pytest
+
+        client = _Client(server.url, timeout=10.0)
+        a = HttpLeaseElector(client, holder="host-a", ttl=1.5,
+                             retry_period=0.2)
+        b = HttpLeaseElector(client, holder="host-b", ttl=1.5,
+                             retry_period=0.2)
+        assert a.acquire(threading.Event())
+        lease = server.objects["Lease"]["kube-batch-tpu"]
+        assert lease["spec"]["holderIdentity"] == "host-a"
+
+        # b cannot take a live lease (expiry is judged by LOCAL
+        # observation, so even a skewed remote renewTime can't be
+        # stolen before b has watched it stand still for a full ttl).
+        with pytest.raises(ConnectionError):
+            b.backend.acquire_lease("host-b", 1.5)
+
+        # a renews; the renewTime moves.
+        rt0 = lease["spec"]["renewTime"]
+        a.backend.renew_lease("host-a", 1.5)
+        assert server.objects["Lease"]["kube-batch-tpu"]["spec"][
+            "renewTime"] >= rt0
+
+        # a dies (stops renewing); after the duration b takes over,
+        # with a leaseTransitions bump.
+        stop_b = threading.Event()
+        got_b = threading.Event()
+        threading.Thread(
+            target=lambda: (b.acquire(stop_b), got_b.set()),
+            daemon=True,
+        ).start()
+        assert got_b.wait(10.0)
+        lease = server.objects["Lease"]["kube-batch-tpu"]
+        assert lease["spec"]["holderIdentity"] == "host-b"
+        assert int(lease["spec"]["leaseTransitions"]) == 1
+
+        # a's next renewal sees the loss and stands down.
+        lost = threading.Event()
+        a.start_renewing(on_lost=lost.set)
+        assert lost.wait(10.0)
+
+        # release clears the holder.
+        b.release()
+        assert server.objects["Lease"]["kube-batch-tpu"]["spec"][
+            "holderIdentity"] == ""
+    finally:
+        server.stop()
+
+
+def test_lease_expiry_is_locally_observed_not_clock_compared():
+    """A live leader whose host clock is skewed FAR behind must not be
+    robbed: remote renewTime is only a change detector; expiry requires
+    the SAME renewTime to stand still for a full ttl on OUR clock
+    (client-go's observedTime semantics)."""
+    from kube_batch_tpu.client.http_api import _HttpLeaseLock
+
+    lock = _HttpLeaseLock.__new__(_HttpLeaseLock)
+    lock._observed = (None, 0.0)
+    # A renewTime an hour in the past (skewed leader clock) but seen
+    # for the FIRST time: live, clock restarted.
+    assert not lock._locally_expired("2020-01-01T00:00:00.000000Z", 1.0)
+    # The leader renews (timestamp changes, still 'in the past'): live.
+    assert not lock._locally_expired("2020-01-01T00:00:01.000000Z", 1.0)
+    # The SAME timestamp observed past ttl on our clock: expired.
+    import time as _time
+
+    assert not lock._locally_expired("2020-01-01T00:00:01.000000Z", 0.2)
+    _time.sleep(0.25)
+    assert lock._locally_expired("2020-01-01T00:00:01.000000Z", 0.2)
+
+
+def test_cli_kube_api_with_leader_elect():
+    """The full --kube-api CLI path with Lease-based election."""
+    from kube_batch_tpu.cli import main
+
+    server = FakeApiServer()
+    try:
+        _world(server)
+        rc = main(["--kube-api", server.url, "--leader-elect",
+                   "--cycles", "2", "--schedule-period", "0",
+                   "--listen-address", ""])
+        assert rc == 0
+        assert len(server.bindings) == 2
+        lease = server.objects["Lease"]["kube-batch-tpu"]
+        assert lease["spec"]["holderIdentity"] == ""  # released on exit
+    finally:
+        server.stop()
