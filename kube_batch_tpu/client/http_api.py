@@ -190,6 +190,11 @@ class Reflector:
         # without it a 410 re-list leaks the deleted objects' capacity
         # in the scheduler cache forever).
         self._known: dict[str, dict] = {}
+        # 404 on LIST = the CRD isn't installed (fresh cluster, or the
+        # operator installs kube-batch before its CRDs): sync EMPTY so
+        # the daemon starts instead of blocking forever, and re-probe
+        # discovery until the resource appears.
+        self.crd_missing = False
 
     @staticmethod
     def _key(obj: dict) -> str:
@@ -209,8 +214,30 @@ class Reflector:
                 self._known[key] = obj
         self.sink.put(json.dumps({"type": mtype, "object": obj}))
 
+    #: How often a 404'd (CRD-less) resource re-probes discovery.
+    CRD_RETRY_S = 30.0
+
     def _list(self) -> None:
-        out = self.client.request_json("GET", self.path)
+        try:
+            out = self.client.request_json("GET", self.path)
+        except HttpError as exc:
+            if exc.status == 404:
+                if not self.crd_missing:
+                    log.warning(
+                        "%s: %s not served (404) — CRD not installed? "
+                        "syncing empty; discovery retries every %.0fs",
+                        self.kind, self.path, self.CRD_RETRY_S,
+                    )
+                self.crd_missing = True
+                # The resource may have EXISTED and been uninstalled
+                # at runtime: flush everything previously listed or
+                # its capacity leaks in the scheduler cache forever.
+                for key in list(self._known):
+                    self._emit("DELETED", self._known[key])
+                self.listed.set()  # empty view; don't block the daemon
+                return
+            raise
+        self.crd_missing = False
         fresh = {self._key(i): i for i in out.get("items", []) or []}
         # Objects that vanished during the gap: synthesize DELETED
         # before the upserts (≙ DeltaFIFO Replace).
@@ -247,6 +274,12 @@ class Reflector:
             resp = conn.getresponse()
             if resp.status == 410:
                 return True
+            if resp.status == 404:
+                # The CRD vanished mid-watch: route into _list()'s
+                # 404 handling (flush + empty-sync + discovery probe)
+                # instead of spinning re-watch attempts forever.
+                self.listed.clear()
+                return False
             if resp.status >= 300:
                 raise HttpError(resp.status, resp.read().decode(
                     "utf-8", "replace"))
@@ -298,6 +331,14 @@ class Reflector:
             try:
                 if not self.listed.is_set():
                     self._list()
+                if self.crd_missing:
+                    # Wait out the discovery period, then let the loop
+                    # top's single _list() call site retry (the watch
+                    # would just 404 too).
+                    if self.stop.wait(self.CRD_RETRY_S):
+                        return
+                    self.listed.clear()
+                    continue
                 if self._watch_once():
                     self.relists += 1
                     self.listed.clear()  # 410: full re-list next loop

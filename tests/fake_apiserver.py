@@ -31,6 +31,7 @@ class FakeApiServer:
         self.status_puts: list[dict] = []
         self.events: list[dict] = []
         self.force_gone = False               # next watches answer 410
+        self.missing_kinds: set[str] = set()  # "CRD not installed": 404s
         self.relist_serves = 0
         server = self
 
@@ -53,7 +54,7 @@ class FakeApiServer:
                 if server._serve_lease(self, "GET", u.path):
                     return
                 kind = server._kind_for(u.path)
-                if kind is None:
+                if kind is None or kind in server.missing_kinds:
                     self._json(404, {"kind": "Status", "code": 404})
                     return
                 q = parse_qs(u.query)

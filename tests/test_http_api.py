@@ -233,6 +233,72 @@ def test_http_lease_election_two_contenders():
         server.stop()
 
 
+def test_missing_crd_syncs_empty_then_discovers(monkeypatch):
+    """A cluster without the PodGroup CRD yet must not block the
+    daemon: the reflector syncs an empty view (404 = not served) and
+    re-probes discovery until the CRD appears, then lists and watches
+    it normally."""
+    from kube_batch_tpu.client.http_api import Reflector
+
+    monkeypatch.setattr(Reflector, "CRD_RETRY_S", 0.3)
+    server = FakeApiServer()
+    try:
+        server.missing_kinds.add("PodGroup")
+        server.upsert("Node", k8s_node("n0"))
+        # A bare controller-owned pod schedules via its shadow group
+        # even with the CRD absent.
+        server.upsert("Pod", k8s_pod("solo-0", owner_uid="rs-1"))
+        cache, mux, adapter, scheduler = _wire_up(server)
+        assert adapter.wait_for_sync(10.0)  # empty PodGroup view, synced
+        ssn = scheduler.run_once()
+        assert ("solo-0", "n0") in ssn.bound
+
+        # The CRD gets installed; a real PodGroup + gang arrive.
+        server.missing_kinds.discard("PodGroup")
+        server.upsert("PodGroup", k8s_pod_group("late", min_member=1))
+        server.upsert(
+            "Pod", k8s_pod("late-0", group="late", cpu="1", mem="1Gi")
+        )
+        assert _wait(lambda: "late" in cache._jobs and
+                     cache._jobs["late"].queue)
+        ssn2 = scheduler.run_once()
+        assert ("late-0", "n0") in ssn2.bound
+        assert not [r for r in mux.reflectors
+                    if r.kind == "PodGroup" and r.crd_missing]
+        mux.close()
+    finally:
+        server.stop()
+
+
+def test_crd_uninstalled_at_runtime_flushes_objects(monkeypatch):
+    """A CRD deleted while the daemon runs must FLUSH its objects from
+    the cache (synthesized DELETEDs), not strand them consuming
+    capacity forever."""
+    from kube_batch_tpu.client.http_api import Reflector
+
+    monkeypatch.setattr(Reflector, "CRD_RETRY_S", 0.3)
+    server = FakeApiServer()
+    try:
+        _world(server)
+        cache, mux, adapter, scheduler = _wire_up(server)
+        assert adapter.wait_for_sync(10.0)
+        assert _wait(lambda: "gang" in cache._jobs)
+
+        # The PodGroup CRD is uninstalled mid-watch.
+        server.missing_kinds.add("PodGroup")
+        server.drop_watches()
+        assert _wait(
+            lambda: [r for r in mux.reflectors
+                     if r.kind == "PodGroup" and r.crd_missing],
+            timeout=15.0,
+        )
+        # The listed PodGroup was flushed from the cache.
+        assert _wait(lambda: "gang" not in cache._jobs, timeout=15.0)
+        mux.close()
+    finally:
+        server.stop()
+
+
 def test_lease_expiry_is_locally_observed_not_clock_compared():
     """A live leader whose host clock is skewed FAR behind must not be
     robbed: remote renewTime is only a change detector; expiry requires
