@@ -193,8 +193,14 @@ class Reflector:
         # 404 on LIST = the CRD isn't installed (fresh cluster, or the
         # operator installs kube-batch before its CRDs): sync EMPTY so
         # the daemon starts instead of blocking forever, and re-probe
-        # discovery until the resource appears.
+        # discovery until the resource appears.  The DESTRUCTIVE flush
+        # of a previously-listed view requires CONSECUTIVE 404s: in an
+        # HA control plane one not-yet-synced apiserver replica can
+        # answer a single 404 for a perfectly healthy CRD, and one
+        # blip must not nuke live gang/queue state (client-go never
+        # clears its store on a list error).
         self.crd_missing = False
+        self._missing_streak = 0
 
     @staticmethod
     def _key(obj: dict) -> str:
@@ -222,6 +228,7 @@ class Reflector:
             out = self.client.request_json("GET", self.path)
         except HttpError as exc:
             if exc.status == 404:
+                self._missing_streak += 1
                 if not self.crd_missing:
                     log.warning(
                         "%s: %s not served (404) — CRD not installed? "
@@ -229,8 +236,12 @@ class Reflector:
                         self.kind, self.path, self.CRD_RETRY_S,
                     )
                 self.crd_missing = True
-                # The resource may have EXISTED and been uninstalled
-                # at runtime: flush everything previously listed or
+                if self._known and self._missing_streak < 2:
+                    # One blip: keep the live view; confirm shortly.
+                    self.listed.set()
+                    return
+                # Confirmed (or nothing was listed): a runtime CRD
+                # uninstall must flush everything previously listed or
                 # its capacity leaks in the scheduler cache forever.
                 for key in list(self._known):
                     self._emit("DELETED", self._known[key])
@@ -238,6 +249,7 @@ class Reflector:
                 return
             raise
         self.crd_missing = False
+        self._missing_streak = 0
         fresh = {self._key(i): i for i in out.get("items", []) or []}
         # Objects that vanished during the gap: synthesize DELETED
         # before the upserts (≙ DeltaFIFO Replace).
@@ -260,7 +272,7 @@ class Reflector:
         half-open connection that lost its FIN would otherwise wedge
         this resource's reflector forever — the server ending the
         stream is what guarantees liveness."""
-        params = {"watch": "1",
+        params = {"watch": "1", "allowWatchBookmarks": "true",
                   "timeoutSeconds": str(300 + (id(self) % 240))}
         if self.last_rv:
             params["resourceVersion"] = self.last_rv
@@ -310,6 +322,14 @@ class Reflector:
                         log.warning("undecodable watch line: %.120s", line)
                         continue
                     mtype = msg.get("type")
+                    if mtype == "BOOKMARK":
+                        # Progress marker only: advance the resume
+                        # point, emit nothing (≙ allowWatchBookmarks).
+                        rv = ((msg.get("object") or {}).get("metadata")
+                              or {}).get("resourceVersion")
+                        if rv is not None:
+                            self.last_rv = str(rv)
+                        continue
                     if mtype == "ERROR":
                         code = (msg.get("object") or {}).get("code")
                         log.warning(
@@ -332,10 +352,16 @@ class Reflector:
                 if not self.listed.is_set():
                     self._list()
                 if self.crd_missing:
-                    # Wait out the discovery period, then let the loop
-                    # top's single _list() call site retry (the watch
-                    # would just 404 too).
-                    if self.stop.wait(self.CRD_RETRY_S):
+                    # Wait out the discovery period (short when an
+                    # unconfirmed blip still holds live state), then
+                    # let the loop top's single _list() call site
+                    # retry (the watch would just 404 too).
+                    wait = (
+                        2.0
+                        if self._known and self._missing_streak < 2
+                        else self.CRD_RETRY_S
+                    )
+                    if self.stop.wait(wait):
                         return
                     self.listed.clear()
                     continue

@@ -299,6 +299,34 @@ def test_crd_uninstalled_at_runtime_flushes_objects(monkeypatch):
         server.stop()
 
 
+def test_single_404_blip_does_not_flush(monkeypatch):
+    """ONE transient 404 (an HA apiserver replica lagging a CRD) must
+    not nuke the live view — the destructive flush requires
+    consecutive confirmation."""
+    from kube_batch_tpu.client.http_api import Reflector
+
+    monkeypatch.setattr(Reflector, "CRD_RETRY_S", 5.0)
+    server = FakeApiServer()
+    try:
+        _world(server)
+        cache, mux, adapter, scheduler = _wire_up(server)
+        assert adapter.wait_for_sync(10.0)
+        assert _wait(lambda: "gang" in cache._jobs)
+        pg = [r for r in mux.reflectors if r.kind == "PodGroup"][0]
+
+        server.missing_kinds.add("PodGroup")
+        server.drop_watches()
+        assert _wait(lambda: pg._missing_streak >= 1, timeout=15.0)
+        # The blip clears within the confirmation window.
+        server.missing_kinds.discard("PodGroup")
+        assert _wait(lambda: not pg.crd_missing, timeout=15.0)
+        with cache.lock():
+            assert "gang" in cache._jobs  # live state survived the blip
+        mux.close()
+    finally:
+        server.stop()
+
+
 def test_lease_expiry_is_locally_observed_not_clock_compared():
     """A live leader whose host clock is skewed FAR behind must not be
     robbed: remote renewTime is only a change detector; expiry requires
@@ -335,5 +363,48 @@ def test_cli_kube_api_with_leader_elect():
         assert len(server.bindings) == 2
         lease = server.objects["Lease"]["kube-batch-tpu"]
         assert lease["spec"]["holderIdentity"] == ""  # released on exit
+    finally:
+        server.stop()
+
+
+def test_watch_bookmark_advances_resume_point():
+    """BOOKMARK events update the resume RV without emitting anything
+    (≙ allowWatchBookmarks): a resume after a quiet-but-bookmarked
+    stretch must not replay the whole quiet window."""
+    import json as _json
+    import queue as _queue
+    import threading as _threading
+
+    from kube_batch_tpu.client.http_api import Reflector, _Client
+
+    server = FakeApiServer()
+    try:
+        server.upsert("Node", k8s_node("n0"))
+        sink: _queue.Queue = _queue.Queue()
+        stop = _threading.Event()
+        r = Reflector(_Client(server.url, timeout=10.0), "Node",
+                      "/api/v1/nodes", sink, stop)
+        t = _threading.Thread(target=r.run, daemon=True)
+        t.start()
+        assert _wait(lambda: r.listed.is_set())
+        # The watch must be REGISTERED before broadcasting — a
+        # bookmark published into the gap between LIST and WATCH is
+        # irrecoverable (it never bumps the server rv, so the resume
+        # replay can't deliver it either).
+        assert _wait(lambda: server._watchers)
+        rv_before = r.last_rv
+        # The server sends a bookmark far ahead of the last real event.
+        server._broadcast("Node", "BOOKMARK", {
+            "kind": "Node", "metadata": {"resourceVersion": "99999"},
+        })
+        assert _wait(lambda: r.last_rv == "99999")
+        assert rv_before != "99999"
+        # Nothing was emitted for it beyond the LIST's ADDED.
+        emitted = []
+        while not sink.empty():
+            emitted.append(_json.loads(sink.get()))
+        assert all(m["type"] != "BOOKMARK" for m in emitted)
+        stop.set()
+        server.drop_watches()
     finally:
         server.stop()
