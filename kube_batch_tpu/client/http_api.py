@@ -229,17 +229,22 @@ class Reflector:
         except HttpError as exc:
             if exc.status == 404:
                 self._missing_streak += 1
-                if not self.crd_missing:
+                self.crd_missing = True
+                if self._known and self._missing_streak < 2:
+                    # One blip: keep the live view; confirm shortly.
+                    log.warning(
+                        "%s: %s answered 404 once (lagging HA "
+                        "replica?); keeping the live view, confirming "
+                        "in 2s", self.kind, self.path,
+                    )
+                    self.listed.set()
+                    return
+                if self._missing_streak <= 2:
                     log.warning(
                         "%s: %s not served (404) — CRD not installed? "
                         "syncing empty; discovery retries every %.0fs",
                         self.kind, self.path, self.CRD_RETRY_S,
                     )
-                self.crd_missing = True
-                if self._known and self._missing_streak < 2:
-                    # One blip: keep the live view; confirm shortly.
-                    self.listed.set()
-                    return
                 # Confirmed (or nothing was listed): a runtime CRD
                 # uninstall must flush everything previously listed or
                 # its capacity leaks in the scheduler cache forever.
@@ -443,10 +448,12 @@ class K8sHttpBackend:
     POST, delete → DELETE, update → PUT).  Raises on non-2xx, which
     the cache's bind/evict funnel turns into resync/rollback.
 
-    Writes share ONE kept-alive connection (serialized under a lock,
-    reopened on error): a 100-pod gang commit at tunnel latencies must
-    not pay TCP+TLS setup per Binding POST — per-call connections
-    would multiply every decision's cost by handshake round trips."""
+    Writes use ONE kept-alive connection PER THREAD (thread-local,
+    reopened on error): no TCP+TLS setup per Binding POST, and no
+    shared-connection lock either — the session's bind fan-out
+    (Session.BIND_WORKERS threads) must genuinely overlap its round
+    trips, or a 47.5k-pod gang commit at tunnel latencies serializes
+    right back to the hour the pool exists to prevent."""
 
     _METHODS = {"create": "POST", "delete": "DELETE", "update": "PUT"}
 
@@ -458,16 +465,25 @@ class K8sHttpBackend:
         # restarts (a real apiserver 409s duplicate names).
         self._event_seq = time.time_ns()
         self._event_lock = threading.Lock()
-        self._conn: http.client.HTTPConnection | None = None
-        self._conn_lock = threading.Lock()
+        self._local = threading.local()
+
+    def _conn_get(self) -> tuple[http.client.HTTPConnection, bool]:
+        """(connection, fresh) for THIS thread."""
+        conn = getattr(self._local, "conn", None)
+        if conn is None:
+            conn = self.client.connect()
+            self._local.conn = conn
+            return conn, True
+        return conn, False
 
     def _drop_conn(self) -> None:
+        conn = getattr(self._local, "conn", None)
         try:
-            if self._conn is not None:
-                self._conn.close()
+            if conn is not None:
+                conn.close()
         except Exception:  # noqa: BLE001
             pass
-        self._conn = None
+        self._local.conn = None
 
     def _issue(self, req: dict) -> None:
         method = self._METHODS[req["verb"]]
@@ -476,48 +492,45 @@ class K8sHttpBackend:
         headers = self.client._headers(
             {"Content-Type": "application/json"}
         )
-        with self._conn_lock:
-            for attempt in (1, 2):
-                fresh = self._conn is None
-                if fresh:
-                    self._conn = self.client.connect()
-                try:
-                    self._conn.request(
-                        method, path, body=payload, headers=headers
-                    )
-                except (OSError, http.client.HTTPException):
-                    # Failed to SEND: the server never saw it — always
-                    # safe to retry, even for non-idempotent verbs.
-                    self._drop_conn()
-                    if attempt == 2:
-                        raise
+        for attempt in (1, 2):
+            conn, fresh = self._conn_get()
+            try:
+                conn.request(
+                    method, path, body=payload, headers=headers
+                )
+            except (OSError, http.client.HTTPException):
+                # Failed to SEND: the server never saw it — always
+                # safe to retry, even for non-idempotent verbs.
+                self._drop_conn()
+                if attempt == 2:
+                    raise
+                continue
+            try:
+                resp = conn.getresponse()
+                data = resp.read().decode("utf-8", "replace")
+            except http.client.RemoteDisconnected:
+                self._drop_conn()
+                if not fresh and attempt == 1:
+                    # A REUSED keep-alive closed with zero response
+                    # bytes: the server shut the idle connection
+                    # before reading the request — retry on a
+                    # fresh one.  (A fresh connection dying here is
+                    # ambiguous: the write may have LANDED, and
+                    # blindly re-POSTing a Binding would 409 and
+                    # roll back a bind that succeeded — surface it
+                    # instead; the resync/watch paths reconcile.)
                     continue
-                try:
-                    resp = self._conn.getresponse()
-                    data = resp.read().decode("utf-8", "replace")
-                except http.client.RemoteDisconnected:
-                    self._drop_conn()
-                    if not fresh and attempt == 1:
-                        # A REUSED keep-alive closed with zero response
-                        # bytes: the server shut the idle connection
-                        # before reading the request — retry on a
-                        # fresh one.  (A fresh connection dying here is
-                        # ambiguous: the write may have LANDED, and
-                        # blindly re-POSTing a Binding would 409 and
-                        # roll back a bind that succeeded — surface it
-                        # instead; the resync/watch paths reconcile.)
-                        continue
-                    raise ConnectionError(
-                        f"response lost for {method} {path}"
-                    )
-                except (OSError, http.client.HTTPException) as exc:
-                    self._drop_conn()
-                    raise ConnectionError(
-                        f"response lost for {method} {path}: {exc}"
-                    ) from exc
-                if resp.status >= 300:
-                    raise HttpError(resp.status, data)
-                return
+                raise ConnectionError(
+                    f"response lost for {method} {path}"
+                )
+            except (OSError, http.client.HTTPException) as exc:
+                self._drop_conn()
+                raise ConnectionError(
+                    f"response lost for {method} {path}: {exc}"
+                ) from exc
+            if resp.status >= 300:
+                raise HttpError(resp.status, data)
+            return
 
     def bind(self, pod: Pod, node_name: str) -> None:
         self._issue(binding_request(pod, node_name))

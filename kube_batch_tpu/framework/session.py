@@ -208,9 +208,23 @@ class Session:
                     self._refresh_groups.add(pod.group)
                 metrics.pods_evicted.inc(reason)
 
+    #: Bind fan-out width (≙ the reference's async bind goroutines /
+    #: its 16-worker helper pools): each bind through a wire backend is
+    #: a full round trip, and a 47.5k-pod gang commit at a ~68 ms RTT
+    #: would take the better part of an hour dispatched serially.
+    BIND_WORKERS = 16
+    #: Below this many binds the pool costs more than it saves (the
+    #: in-process simulator path binds in microseconds).
+    _BIND_POOL_THRESHOLD = 64
+
     def dispatch_binds(self) -> list[tuple[str, str]]:
         """Bind every newly allocated task of every JobReady job
-        (gang commit; ≙ session.go · Allocate's deferred dispatch)."""
+        (gang commit; ≙ session.go · Allocate's deferred dispatch).
+        Large batches fan out over a thread pool; `cache.bind` is
+        thread-safe (mutations under the cache lock, the backend call
+        outside it) and result ORDER is preserved, so `self.bound` is
+        deterministic either way.  Bookkeeping (bound list, metrics,
+        refresh groups) stays on this thread."""
         task_state = self.host_task_state()
         task_node = self.host_task_node()
         ready = self.job_ready()
@@ -220,15 +234,33 @@ class Session:
             (task_state == int(TaskStatus.ALLOCATED))
             & (self.initial_task_state == int(TaskStatus.PENDING))
         )
+        to_bind: list[tuple[object, str]] = []
         for t in np.nonzero(newly_allocated)[0]:
             if t >= self.meta.num_real_tasks:
                 continue
             j = task_job[t]
             if j < 0 or not ready[j]:
                 continue  # gang gate: unready job's placements are dropped
-            pod = self.meta.task_pods[t]
-            node_name = self.meta.node_names[task_node[t]]
-            if self.cache.bind(pod.uid, node_name):
+            to_bind.append((
+                self.meta.task_pods[t],
+                self.meta.node_names[task_node[t]],
+            ))
+
+        if len(to_bind) > self._BIND_POOL_THRESHOLD:
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(
+                max_workers=self.BIND_WORKERS
+            ) as pool:
+                results = list(pool.map(
+                    lambda a: self.cache.bind(a[0].uid, a[1]), to_bind
+                ))
+        else:
+            results = [
+                self.cache.bind(pod.uid, node) for pod, node in to_bind
+            ]
+        for (pod, node_name), ok in zip(to_bind, results):
+            if ok:
                 self.bound.append((pod.name, node_name))
                 if self._refresh_groups is not None and pod.group:
                     self._refresh_groups.add(pod.group)

@@ -166,3 +166,31 @@ def test_failed_bind_resync_via_adapter():
     cluster.fail_bind_pods.clear()
     ssn2 = scheduler.run_once()
     assert ("job-0", "n0") in cluster.binds
+
+
+def test_large_gang_commit_fans_out_over_the_wire():
+    """A >64-pod gang commit dispatches binds over the thread pool
+    (≙ the reference's async bind goroutines): every bind lands as its
+    own correlated wire round trip, failures still resync, and
+    `ssn.bound` stays deterministic."""
+    cluster, cache, adapter, scheduler = _wire_up()
+    for i in range(4):
+        cluster.add_node(Node(
+            name=f"n{i}",
+            allocatable={"cpu": 64000, "memory": 256 * GI, "pods": 200},
+        ))
+    cluster.submit(
+        PodGroup(name="big", queue="default", min_member=100),
+        _pods("big", 100, cpu=1000, mem=1 * GI),
+    )
+    cluster.fail_bind_pods.update({"big-3", "big-57", "big-91"})
+    cluster.sync()
+    assert adapter.wait_for_sync(5.0)
+
+    ssn = scheduler.run_once()
+    assert len(ssn.bound) == 97
+    assert len(cluster.binds) == 97
+    assert not any(
+        name in ("big-3", "big-57", "big-91") for name, _ in cluster.binds
+    )
+    assert len(cache.drain_resync()) == 3
