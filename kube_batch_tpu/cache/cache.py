@@ -268,12 +268,28 @@ class SchedulerCache:
 
     # -- event handlers (≙ cache/event_handlers.go) ---------------------
 
+    def _mark_dynamic_pdbs(self, pod: Pod) -> None:
+        """Pod churn that changes a DYNAMIC budget's membership moves
+        its effective floor (percentage / maxUnavailable forms resolve
+        against the matched count at pack time) — force a repack so
+        the packed floor can never go stale."""
+        # Same membership predicate the packer enforces (selector must
+        # be non-empty): an empty-selector budget matches vacuously but
+        # is never packed, and repacking for it would permanently
+        # defeat incremental packing for zero effect.
+        if pod.labels and any(
+            p.dynamic and p.selector and p.matches(pod)
+            for p in self._pdbs.values()
+        ):
+            self._mark_full("pdb-membership-changed")
+
     def add_pod(self, pod: Pod) -> None:
         with self._lock:
             if pod.uid in self._pods:
                 raise ValueError(f"pod {pod.uid} already cached")
             self.spec.pod_vec(pod)  # memoize request vector once, at ingest
             self._pods[pod.uid] = pod
+            self._mark_dynamic_pdbs(pod)
             self._status_counts[pod.status] += 1
             if pod.group is not None:
                 job = self._jobs.get(pod.group)
@@ -298,6 +314,7 @@ class SchedulerCache:
             pod = self._pods.pop(pod_uid, None)
             if pod is None:
                 return
+            self._mark_dynamic_pdbs(pod)
             self._status_counts[pod.status] -= 1
             if pod.group is not None and pod.group in self._jobs:
                 self._jobs[pod.group].remove_task(pod)

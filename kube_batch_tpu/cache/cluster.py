@@ -211,16 +211,54 @@ class PodDisruptionBudget:
     """Eviction floor for plain pods (≙ JobInfo.PDB in api/job_info.go:
     the reference carries the PDB alongside the job and victim filtering
     honors it).  Pods whose labels match `selector` are members;
-    eviction is vetoed when healthy members would drop below
-    `min_available`."""
+    eviction is vetoed when healthy members would drop below the floor.
+
+    Floor forms (exactly one is meaningful, k8s's intstr fields):
+    * `min_available` — absolute floor (the static form);
+    * `min_available_pct` — percentage of the CURRENT matched count,
+      rounded UP (k8s rounds minAvailable percentages up);
+    * `max_unavailable` / `max_unavailable_pct` — allowed disruptions,
+      absolute or percentage of matched (percentage rounded DOWN —
+      both roundings chosen protectively: never allow more disruption
+      than the other rounding would).
+    The dynamic forms resolve to an absolute floor at PACK time from
+    the live matched count (`effective_floor`); any pod churn touching
+    a dynamic budget's membership forces a repack (cache.add_pod /
+    delete_pod mark full), so the floor can never go stale between
+    packs."""
 
     name: str
     min_available: int = 0
+    min_available_pct: float | None = None   # 0-100
+    max_unavailable: int | None = None
+    max_unavailable_pct: float | None = None  # 0-100
     selector: Mapping[str, str] = dataclasses.field(default_factory=dict)
     uid: str = dataclasses.field(default_factory=lambda: _new_uid("pdb"))
 
     def matches(self, pod: "Pod") -> bool:
         return all(pod.labels.get(k) == v for k, v in self.selector.items())
+
+    @property
+    def dynamic(self) -> bool:
+        """Floor depends on the live matched count."""
+        return (
+            self.min_available_pct is not None
+            or self.max_unavailable is not None
+            or self.max_unavailable_pct is not None
+        )
+
+    def effective_floor(self, matched: int) -> int:
+        """Absolute minAvailable given the current matched-pod count."""
+        import math
+
+        if self.max_unavailable is not None:
+            return max(matched - self.max_unavailable, 0)
+        if self.max_unavailable_pct is not None:
+            allowed = math.floor(self.max_unavailable_pct / 100.0 * matched)
+            return max(matched - allowed, 0)
+        if self.min_available_pct is not None:
+            return math.ceil(self.min_available_pct / 100.0 * matched)
+        return self.min_available
 
 
 @dataclasses.dataclass

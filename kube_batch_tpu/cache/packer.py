@@ -458,9 +458,19 @@ def pack_snapshot_full(
             for bi, pdb in enumerate(pdb_objs):
                 if pdb.selector and pdb.matches(pod):
                     task_pdbs[ti, bi] = 1.0
+    # Dynamic floor forms (percentages / maxUnavailable) resolve to an
+    # absolute floor HERE, against the live matched counts; membership
+    # churn on a dynamic budget forces a repack (cache.add_pod /
+    # delete_pod mark full), so this can never go stale between packs.
     pdb_min = np.array(
-        [host.pdbs[n].min_available for n in pdb_names], dtype=np.int32
-    )
+        [
+            host.pdbs[n].effective_floor(
+                int(task_pdbs[:, bi].sum())
+            )
+            for bi, n in enumerate(pdb_names)
+        ],
+        dtype=np.int32,
+    ) if pdb_names else np.zeros(0, np.int32)
 
     arrays: dict[str, np.ndarray] = {
         "task_req": pad_rows(task_req, Tp),

@@ -234,16 +234,28 @@ def decode_namespace(d: dict[str, Any]) -> Namespace:
 
 
 def encode_pdb(pdb: PodDisruptionBudget) -> dict[str, Any]:
-    return {
+    out: dict[str, Any] = {
         "uid": pdb.uid,
         "name": pdb.name,
         "minAvailable": pdb.min_available,
         "selector": dict(pdb.selector),
     }
+    if pdb.min_available_pct is not None:
+        out["minAvailablePct"] = pdb.min_available_pct
+    if pdb.max_unavailable is not None:
+        out["maxUnavailable"] = pdb.max_unavailable
+    if pdb.max_unavailable_pct is not None:
+        out["maxUnavailablePct"] = pdb.max_unavailable_pct
+    return out
 
 
 def decode_pdb(d: dict[str, Any]) -> PodDisruptionBudget:
-    kwargs = {"uid": d["uid"]} if "uid" in d else {}
+    kwargs: dict[str, Any] = {"uid": d["uid"]} if "uid" in d else {}
+    for wire, field in (("minAvailablePct", "min_available_pct"),
+                        ("maxUnavailable", "max_unavailable"),
+                        ("maxUnavailablePct", "max_unavailable_pct")):
+        if d.get(wire) is not None:
+            kwargs[field] = d[wire]
     return PodDisruptionBudget(
         name=d["name"],
         min_available=int(d.get("minAvailable", 0)),

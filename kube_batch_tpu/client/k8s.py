@@ -32,9 +32,10 @@ Lowering notes (framework-native simplifications, cluster.py header):
 node selectors/affinities lower to exact ``key=value`` terms
 (single-value ``In`` expressions only — multi-value OR terms are
 logged and skipped); a toleration lowers to the ``key=value:effect``
-string form and matches by equality; PDB ``minAvailable`` percentages
-are not lowered (the object is skipped loudly — silently weakening a
-disruption budget would be worse).
+string form and matches by equality; every PDB intstr floor form
+lowers (absolute and percentage minAvailable/maxUnavailable — the
+dynamic forms resolve against the live matched count at pack time,
+cluster.py · PodDisruptionBudget.effective_floor).
 """
 
 from __future__ import annotations
@@ -426,34 +427,34 @@ class K8sDecoder:
             **kwargs,
         )
 
-    def pdb(self, obj: dict) -> PodDisruptionBudget | None:
+    def pdb(self, obj: dict) -> PodDisruptionBudget:
+        """All four intstr floor forms lower: absolute minAvailable,
+        percentage minAvailable, absolute maxUnavailable, percentage
+        maxUnavailable.  The dynamic forms resolve against the live
+        matched count at PACK time (cluster.py · effective_floor), so
+        the decoder no longer needs to skip them."""
         meta = obj.get("metadata", {})
         spec = obj.get("spec", {})
-        if "maxUnavailable" in spec and "minAvailable" not in spec:
-            # Lowering maxUnavailable needs the live matched-pod count,
-            # which the decoder doesn't have; ingesting it as floor 0
-            # would silently void the budget — skip loudly instead.
-            log.warning(
-                "PDB %s: maxUnavailable form not lowerable; budget NOT "
-                "ingested", meta.get("name"),
-            )
-            return None
-        min_avail = spec.get("minAvailable", 0)
-        if isinstance(min_avail, str) and min_avail.endswith("%"):
-            log.warning(
-                "PDB %s: percentage minAvailable %r not lowerable; "
-                "budget NOT ingested", meta.get("name"), min_avail,
-            )
-            return None
         sel = _match_labels_terms(
             spec.get("selector", {}), f"pdb {meta.get('name')}"
         )
-        kwargs = {"uid": meta["uid"]} if meta.get("uid") else {}
+        kwargs: dict[str, Any] = (
+            {"uid": meta["uid"]} if meta.get("uid") else {}
+        )
+        if "maxUnavailable" in spec and "minAvailable" not in spec:
+            mu = spec["maxUnavailable"]
+            if isinstance(mu, str) and mu.endswith("%"):
+                kwargs["max_unavailable_pct"] = float(mu[:-1])
+            else:
+                kwargs["max_unavailable"] = int(mu)
+        else:
+            ma = spec.get("minAvailable", 0)
+            if isinstance(ma, str) and ma.endswith("%"):
+                kwargs["min_available_pct"] = float(ma[:-1])
+            else:
+                kwargs["min_available"] = int(ma)
         return PodDisruptionBudget(
-            name=meta["name"],
-            min_available=int(min_avail),
-            selector=sel,
-            **kwargs,
+            name=meta["name"], selector=sel, **kwargs,
         )
 
     def namespace(self, obj: dict) -> Namespace:
@@ -535,15 +536,7 @@ class K8sWatchAdapter(WatchAdapter):
             if mtype == "DELETED":
                 cache.delete_pdb(meta["name"])
             else:
-                pdb = dec.pdb(obj)
-                if pdb is not None:
-                    cache.add_pdb(pdb)
-                else:
-                    # MODIFIED into a non-lowerable form: enforcing the
-                    # STALE previous floor would silently contradict the
-                    # cluster's actual budget — drop it (loudly logged
-                    # by the decoder).
-                    cache.delete_pdb(meta["name"])
+                cache.add_pdb(dec.pdb(obj))
         elif kind == "Namespace":
             if mtype == "DELETED":
                 cache.delete_namespace(meta["name"])

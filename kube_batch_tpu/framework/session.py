@@ -31,6 +31,25 @@ from kube_batch_tpu.framework.plugin import Plugin, get_plugin_builder
 from kube_batch_tpu.framework.policy import TensorPolicy
 from kube_batch_tpu.ops.assignment import AllocState, init_state
 
+_BIND_POOL = None
+
+
+def _bind_pool():
+    """Process-shared bind fan-out pool, created on first large gang
+    commit and reused across cycles — worker threads must SURVIVE
+    between cycles so backend keep-alive state tied to them (e.g.
+    K8sHttpBackend's thread-local connections) keeps amortizing its
+    TCP+TLS setup instead of reconnecting every commit."""
+    global _BIND_POOL
+    if _BIND_POOL is None:
+        from concurrent.futures import ThreadPoolExecutor
+
+        _BIND_POOL = ThreadPoolExecutor(
+            max_workers=Session.BIND_WORKERS,
+            thread_name_prefix="bind-dispatch",
+        )
+    return _BIND_POOL
+
 _session_counter = itertools.count()
 
 
@@ -247,14 +266,9 @@ class Session:
             ))
 
         if len(to_bind) > self._BIND_POOL_THRESHOLD:
-            from concurrent.futures import ThreadPoolExecutor
-
-            with ThreadPoolExecutor(
-                max_workers=self.BIND_WORKERS
-            ) as pool:
-                results = list(pool.map(
-                    lambda a: self.cache.bind(a[0].uid, a[1]), to_bind
-                ))
+            results = list(_bind_pool().map(
+                lambda a: self.cache.bind(a[0].uid, a[1]), to_bind
+            ))
         else:
             results = [
                 self.cache.bind(pod.uid, node) for pod, node in to_bind

@@ -308,7 +308,7 @@ def test_pod_lifecycle_modified_deleted():
         assert cache._nodes["n0"].idle[0] == pytest.approx(16000.0)
 
 
-def test_gpu_maps_to_accelerator_and_pdb_percentage_skipped():
+def test_gpu_maps_to_accelerator_and_all_pdb_forms_lower():
     stream = events(
         k8s_node("gpu-node", gpus="8"),
         k8s_pod_group("g", min_member=1),
@@ -326,8 +326,6 @@ def test_gpu_maps_to_accelerator_and_pdb_percentage_skipped():
                      "selector": {"matchLabels": {"app": "web"}}},
         },
         {
-            # maxUnavailable form: not lowerable without live pod counts
-            # — must be skipped loudly, never ingested as floor 0.
             "kind": "PodDisruptionBudget", "apiVersion": "policy/v1",
             "metadata": {"name": "maxu-pdb", "uid": "uid-pdb-3"},
             "spec": {"maxUnavailable": 1,
@@ -339,9 +337,13 @@ def test_gpu_maps_to_accelerator_and_pdb_percentage_skipped():
         accel_dim = DEFAULT_SPEC.index("accelerator")
         assert cache._nodes["gpu-node"].allocatable[accel_dim] == 8.0
         assert cache._pods["uid-pod-gpu-pod"].request["accelerator"] == 2.0
-        assert "pct-pdb" not in cache._pdbs   # loudly skipped
-        assert "maxu-pdb" not in cache._pdbs  # loudly skipped
+        # Every intstr form lowers (dynamic ones resolve their floor
+        # at pack time against the matched count).
+        assert cache._pdbs["pct-pdb"].min_available_pct == 50.0
+        assert cache._pdbs["maxu-pdb"].max_unavailable == 1
         assert cache._pdbs["int-pdb"].min_available == 2
+        assert cache._pdbs["pct-pdb"].effective_floor(5) == 3   # ceil
+        assert cache._pdbs["maxu-pdb"].effective_floor(5) == 4
 
 
 def test_affinity_lowering():
@@ -417,9 +419,10 @@ def test_multi_term_node_affinity_skipped_not_merged():
         assert cache._pods["uid-pod-or-pod"].selector == {}
 
 
-def test_pdb_modified_to_unlowerable_is_dropped():
-    """A budget edited into a form we cannot lower (percentage /
-    maxUnavailable) must not keep enforcing its STALE previous floor."""
+def test_pdb_modified_to_percentage_form_reingests():
+    """A budget edited from an absolute floor into a percentage form
+    stays ingested — the dynamic floor resolves at pack time (it used
+    to be dropped loudly when percentages were not lowerable)."""
     stream = events(
         k8s_node("n0"),
         {
@@ -446,7 +449,10 @@ def test_pdb_modified_to_unlowerable_is_dropped():
     adapter.start()
     adapter.join(10)
     with cache.lock():
-        assert "web-pdb" not in cache._pdbs
+        pdb = cache._pdbs["web-pdb"]
+        assert pdb.min_available_pct == 50.0
+        assert pdb.dynamic
+        assert pdb.effective_floor(4) == 2
 
 
 def test_node_modified_updates_conditions_and_capacity():

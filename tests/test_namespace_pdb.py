@@ -94,16 +94,17 @@ def test_equal_weights_without_namespace_objects():
     assert by_ns == {"a": 4, "b": 4}
 
 
-def _running_world_with_pdb(min_available: int):
+def _running_world_with_pdb(min_available: int = 0, **floor):
     """Two plain low-prio pods labeled app=web running under a PDB, plus
-    a high-prio gang that needs their capacity."""
+    a high-prio gang that needs their capacity.  `floor` passes any
+    alternative floor form (max_unavailable / *_pct) straight through."""
     cache, sim = make_world(SPEC)
     sim.add_node(Node(
         name="n0", allocatable={"cpu": 4000, "memory": 8 * GI, "pods": 110},
     ))
     sim.add_pdb(PodDisruptionBudget(
         name="web-pdb", min_available=min_available,
-        selector={"app": "web"},
+        selector={"app": "web"}, **floor,
     ))
     sim.submit(
         PodGroup(name="web", queue="default", min_member=1),
@@ -136,6 +137,58 @@ def test_pdb_allows_eviction_down_to_floor():
     # freed slot only.
     assert len(ssn.evicted) == 1
     assert ssn.evicted[0][0].startswith("web")
+
+
+def test_pdb_max_unavailable_lowered_against_matched_count():
+    """maxUnavailable=1 over 2 matched pods resolves to floor 1 at
+    pack time: exactly one eviction allowed (≙ the disruption
+    controller's intstr lowering)."""
+    cache, _sim = _running_world_with_pdb(max_unavailable=1)
+    ssn = run_cycle(cache, ["allocate", "preempt"])
+    assert len(ssn.evicted) == 1
+    assert ssn.evicted[0][0].startswith("web")
+
+
+def test_pdb_percentage_min_available_rounds_up():
+    """minAvailable=75% of 2 matched pods ceils to 2: both protected."""
+    cache, _sim = _running_world_with_pdb(min_available_pct=75.0)
+    ssn = run_cycle(cache, ["allocate", "preempt"])
+    assert ssn.evicted == []
+
+
+def test_dynamic_pdb_floor_tracks_membership_churn():
+    """A dynamic budget's floor follows the matched count: new matching
+    pods force a repack and raise the allowed-disruption headroom
+    computed from the bigger membership."""
+    from kube_batch_tpu.cache.packer import pack_snapshot
+
+    cache, sim = make_world(SPEC)
+    sim.add_node(Node(
+        name="n0", allocatable={"cpu": 64000, "memory": 64 * GI, "pods": 110},
+    ))
+    sim.add_pdb(PodDisruptionBudget(
+        name="dyn", max_unavailable_pct=50.0, selector={"app": "web"},
+    ))
+    sim.submit(
+        PodGroup(name="web", queue="default", min_member=1),
+        [Pod(name=f"web-{i}", labels={"app": "web"},
+             request={"cpu": 100, "memory": GI, "pods": 1})
+         for i in range(2)],
+    )
+    snap, _meta = pack_snapshot(cache.snapshot())
+    import numpy as np
+
+    # single budget in this world: row 0 (packer sorts by name)
+    assert int(np.asarray(snap.pdb_min)[0]) == 1  # 2 - floor(50% of 2)
+
+    # Two more members arrive: floor recomputes against 4 matched.
+    sim.submit_to_group("web", [
+        Pod(name=f"web-{2 + i}", labels={"app": "web"},
+            request={"cpu": 100, "memory": GI, "pods": 1})
+        for i in range(2)
+    ])
+    snap2, _meta2 = pack_snapshot(cache.snapshot())
+    assert int(np.asarray(snap2.pdb_min)[0]) == 2  # 4 - floor(50% of 4)
 
 
 def test_unlabeled_pods_not_covered_by_pdb():
