@@ -147,16 +147,33 @@ def load_world(spec_arg: str | None, default_queue: str,
         return build_config(n)
     with open(spec_arg, "r", encoding="utf-8") as f:
         raw = yaml.safe_load(f) or {}
+    known_sections = frozenset({
+        "resources", "queues", "nodes", "storageClasses", "claims",
+        "pdbs", "namespaces", "jobs",
+    })
+    unknown_sections = set(raw) - known_sections
+    if unknown_sections:
+        # A typo like `pdb:` silently dropping a whole constraint set
+        # is exactly the failure the per-object key checks exist to
+        # prevent — apply the same policy to the sections themselves.
+        raise SystemExit(
+            f"world file: unknown sections {sorted(unknown_sections)} "
+            f"(known: {sorted(known_sections)})"
+        )
     names = tuple(raw.get("resources", ("cpu", "memory", "pods", "accelerator")))
     cache, sim = make_world(ResourceSpec(names), default_queue=default_queue)
     for q in raw.get("queues", []):
         sim.add_queue(Queue(name=q["name"], weight=float(q.get("weight", 1.0))))
     from kube_batch_tpu.client.codec import (
         CLAIM_KEYS,
+        NAMESPACE_KEYS,
         NODE_KEYS,
+        PDB_KEYS,
         STORAGE_CLASS_KEYS,
         decode_claim,
+        decode_namespace,
         decode_node,
+        decode_pdb,
         decode_storage_class,
     )
 
@@ -178,6 +195,24 @@ def load_world(spec_arg: str | None, default_queue: str,
         )
     for c in raw.get("claims", []):
         sim.add_claim(decode_claim(_checked(c, CLAIM_KEYS, "claim")))
+    for b in raw.get("pdbs", []):
+        floor_forms = [
+            k for k in ("minAvailable", "minAvailablePct",
+                        "maxUnavailable", "maxUnavailablePct")
+            if k in b
+        ]
+        if len(floor_forms) > 1:
+            # effective_floor would silently prefer one form; loud
+            # failure beats a budget that means less than it says.
+            raise SystemExit(
+                f"pdb {b.get('name', '?')}: declare exactly one floor "
+                f"form, got {floor_forms}"
+            )
+        sim.add_pdb(decode_pdb(_checked(b, PDB_KEYS, "pdb")))
+    for ns in raw.get("namespaces", []):
+        sim.add_namespace(
+            decode_namespace(_checked(ns, NAMESPACE_KEYS, "namespace"))
+        )
     for j in raw.get("jobs", []):
         group = PodGroup(
             name=j["name"],

@@ -64,6 +64,11 @@ NODE_KEYS = frozenset({
 })
 CLAIM_KEYS = frozenset({"uid", "name", "storageClass", "boundNode"})
 STORAGE_CLASS_KEYS = frozenset({"uid", "name", "allowedNodeLabels"})
+PDB_KEYS = frozenset({
+    "uid", "name", "minAvailable", "minAvailablePct",
+    "maxUnavailable", "maxUnavailablePct", "selector",
+})
+NAMESPACE_KEYS = frozenset({"uid", "name", "weight"})
 
 
 def decode_pod(d: dict[str, Any]) -> Pod:

@@ -26,6 +26,11 @@ def test_workload_yaml_world(tmp_path):
         "nodes": [
             {"name": "n0", "allocatable": {"cpu": 4000, "memory": 8 << 30, "pods": 110}}
         ],
+        "pdbs": [
+            {"name": "web-pdb", "maxUnavailable": 1,
+             "selector": {"app": "web"}},
+        ],
+        "namespaces": [{"name": "prod", "weight": 3}],
         "jobs": [
             {
                 "name": "j1",
@@ -45,6 +50,8 @@ def test_workload_yaml_world(tmp_path):
     assert set(snap.queues) == {"default", "gold"}
     assert set(snap.nodes) == {"n0"}
     assert snap.jobs["j1"].min_available == 2
+    assert snap.pdbs["web-pdb"].max_unavailable == 1
+    assert snap.namespaces["prod"].weight == 3
 
 
 def test_main_runs_cycles_on_config1(tmp_path):
