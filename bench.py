@@ -53,7 +53,10 @@ def _budget_left() -> float:
 
 
 def _log(msg: str) -> None:
-    """Progress to stderr (stdout carries exactly one JSON line)."""
+    """Progress to stderr.  stdout carries JSON only: the parent
+    process emits exactly one final line; daemon children additionally
+    emit one PARTIAL milestone line per completed phase (consumed by
+    `_collect_json_lines`)."""
     print(f"[bench +{time.monotonic() - _T_START:.0f}s] {msg}", file=sys.stderr)
     sys.stderr.flush()
 
@@ -504,6 +507,14 @@ def _run_daemon_phases(jax, n, cache, sim, conf_path, steady_cycles) -> dict:
 
     s = Scheduler(cache, conf_path=conf_path, schedule_period=0.0)
 
+    partial: dict = {"config": n, "partial": True}
+
+    def emit_partial(**fields) -> None:
+        """One JSON line per milestone: a killed/timed-out child still
+        leaves every completed phase on its stdout for the parent."""
+        partial.update(fields)
+        print(json.dumps(partial), flush=True)
+
     def one_cycle():
         t0 = time.perf_counter()
         ssn = s.run_once()
@@ -513,6 +524,9 @@ def _run_daemon_phases(jax, n, cache, sim, conf_path, steady_cycles) -> dict:
     first_ms, ssn1 = one_cycle()
     placed = len(ssn1.bound) if ssn1 is not None else 0
     _log(f"  daemon: first cycle {first_ms:.0f}ms ({placed} binds)")
+    emit_partial(
+        first_cycle_ms=round(first_ms, 1), pods_bound_first_cycle=placed
+    )
 
     # Cycle 2 absorbs every Bound->Running heartbeat at once (the
     # worst-case churn cycle the judge measured at 943 ms in r3).  A
@@ -526,6 +540,7 @@ def _run_daemon_phases(jax, n, cache, sim, conf_path, steady_cycles) -> dict:
     )
     churn_ms, _ = one_cycle()
     _log(f"  daemon: churn cycle {churn_ms:.0f}ms")
+    emit_partial(churn_cycle_ms=round(churn_ms, 1))
 
     # Steady state: a small gang arrives every cycle (light churn).
     # The per-phase histograms (metrics.cycle_phase_latency) are
@@ -594,12 +609,14 @@ def _run_daemon_phases(jax, n, cache, sim, conf_path, steady_cycles) -> dict:
         "pods_bound_first_cycle": placed,
         "rtt_floor_ms": round(measure_rtt_floor(jax) * 1e3, 2),
     }
+    emit_partial(**{k: v for k, v in out.items() if k != "config"})
 
     # -- sustained-churn soak (VERDICT r4 next #7) ----------------------
     if _budget_left() > 150.0:
         out["soak"] = _run_soak(s, sim, cache, one_cycle)
     else:
         out["soak"] = {"skipped": "time budget exhausted"}
+    emit_partial(soak=out["soak"])
 
     # -- conf hot-swap under the compile-cliff guard (VERDICT r4 #5) ----
     if _budget_left() > 120.0:
@@ -714,9 +731,50 @@ def _run_hotswap(s, sim, one_cycle, deadline_s: float = 180.0) -> dict:
     }
 
 
+def _text(b) -> str:
+    return b.decode(errors="replace") if isinstance(b, bytes) else (b or "")
+
+
+def _collect_json_lines(stdout: str) -> tuple[dict | None, dict | None]:
+    """(last JSON dict line, last PARTIAL milestone line) from a child's
+    stdout.  Kept separate so an error-only final line can be merged
+    over the milestones that completed before it."""
+    last, last_partial = None, None
+    for line in _text(stdout).strip().splitlines():
+        try:
+            obj = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(obj, dict):
+            last = obj
+            if obj.get("partial"):
+                last_partial = obj
+    return last, last_partial
+
+
+def _merge_partial(last: dict | None, partial: dict | None) -> dict | None:
+    """The child's final line wins field-by-field, but milestones from
+    emit_partial survive an error-only or truncated final line — a
+    crash after soak must not erase first-cycle/steady evidence (the
+    round-4 lesson, applied to every degraded path)."""
+    if last is None and partial is None:
+        return None
+    merged = {**(partial or {}), **(last or {})}
+    merged.pop("partial", None)
+    return merged
+
+
 def _run_daemon_subprocess(timeout_s: float) -> dict:
     """run_daemon in a fresh interpreter (same isolation rationale as
-    configs; also exactly what 'a restarted daemon' means)."""
+    configs; also exactly what 'a restarted daemon' means).
+
+    The child emits a PARTIAL result line after each milestone, so a
+    timeout degrades to whatever phases completed instead of erasing
+    the whole scoreboard (the round-4 lesson: one transient outage
+    zeroed every daemon field).  Killing the child mid-compile can
+    orphan a server-side XLA compilation that later compiles queue
+    behind — the error record says so.
+    """
     import subprocess
 
     try:
@@ -725,14 +783,27 @@ def _run_daemon_subprocess(timeout_s: float) -> dict:
              "--_budget", f"{max(timeout_s - 30.0, 30.0):.0f}"],
             capture_output=True, text=True, timeout=timeout_s,
         )
-    except subprocess.TimeoutExpired:
-        return {"error": f"timed out after {timeout_s:.0f}s"}
-    line = proc.stdout.strip().splitlines()[-1] if proc.stdout.strip() else ""
-    try:
-        return json.loads(line)
-    except json.JSONDecodeError:
-        tail = (proc.stderr or "")[-300:]
-        return {"error": f"rc={proc.returncode}: {tail}"}
+    except subprocess.TimeoutExpired as exc:
+        out = _merge_partial(*_collect_json_lines(exc.stdout)) or {}
+        out["error"] = (
+            f"timed out after {timeout_s:.0f}s (killed child may orphan "
+            "a server-side compilation; later compiles can queue behind "
+            "it)"
+        )
+        tail = _text(exc.stderr).strip().splitlines()[-3:]
+        if tail:
+            out["child_log_tail"] = tail
+        return out
+    out = _merge_partial(*_collect_json_lines(proc.stdout))
+    if out is not None:
+        if proc.returncode != 0 and "error" not in out:
+            out["error"] = (
+                f"child died rc={proc.returncode} after last partial: "
+                f"{_text(proc.stderr)[-200:]}"
+            )
+        return out
+    tail = _text(proc.stderr)[-300:]
+    return {"error": f"rc={proc.returncode}: {tail}"}
 
 
 def _retry_on_hang(run, what: str) -> dict:
@@ -779,8 +850,12 @@ def _run_config_subprocess(n: int, timeout_s: float) -> dict:
             ],
             capture_output=True, text=True, timeout=timeout_s,
         )
-    except subprocess.TimeoutExpired:
-        return {"error": f"timed out after {timeout_s:.0f}s"}
+    except subprocess.TimeoutExpired as exc:
+        out = {"error": f"timed out after {timeout_s:.0f}s"}
+        tail = _text(exc.stderr).strip().splitlines()[-3:]
+        if tail:
+            out["child_log_tail"] = tail
+        return out
     line = proc.stdout.strip().splitlines()[-1] if proc.stdout.strip() else ""
     try:
         return json.loads(line)
@@ -971,6 +1046,11 @@ def main() -> None:
                     daemon["warm_e2e_cycle_ms_p50"] = warm.get(
                         "e2e_cycle_ms_p50"
                     )
+                    if "error" in warm:
+                        # Partial milestones may have satisfied the
+                        # fields above; the failure itself must still
+                        # be visible in the artifact.
+                        daemon["warm_error"] = warm["error"]
                 result["daemon"] = daemon
                 # Surface the driver-metric fields at top level too.
                 if "e2e_cycle_ms_p50" in daemon:

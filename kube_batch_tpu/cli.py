@@ -201,12 +201,14 @@ def load_world(spec_arg: str | None, default_queue: str,
                         "maxUnavailable", "maxUnavailablePct")
             if k in b
         ]
-        if len(floor_forms) > 1:
-            # effective_floor would silently prefer one form; loud
-            # failure beats a budget that means less than it says.
+        if len(floor_forms) != 1 or b.get(floor_forms[0]) is None:
+            # Zero forms (or a null value) decodes to a floor of 0 — a
+            # PDB that protects nothing while the user believes it
+            # does; >1 would make effective_floor silently prefer one.
+            # Loud failure beats a budget that means less than it says.
             raise SystemExit(
-                f"pdb {b.get('name', '?')}: declare exactly one floor "
-                f"form, got {floor_forms}"
+                f"pdb {b.get('name', '?')}: declare exactly one "
+                f"non-null floor form, got {floor_forms}"
             )
         sim.add_pdb(decode_pdb(_checked(b, PDB_KEYS, "pdb")))
     for ns in raw.get("namespaces", []):

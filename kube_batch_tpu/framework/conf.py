@@ -71,7 +71,13 @@ def default_conf() -> SchedulerConf:
     Only plugins/actions actually registered are included, so the default
     path always runs (the full reference set fills in as plugins land).
     """
-    from kube_batch_tpu.framework.plugin import ACTION_REGISTRY, PLUGIN_REGISTRY
+    from kube_batch_tpu.framework.plugin import (
+        ACTION_REGISTRY,
+        PLUGIN_REGISTRY,
+        ensure_registered,
+    )
+
+    ensure_registered()
 
     tier1 = ("priority", "gang", "conformance", "pdb")
     tier2 = ("drf", "predicates", "proportion", "nodeorder")

@@ -104,6 +104,20 @@ def register_action(cls: type[Action]) -> type[Action]:
     return cls
 
 
+def ensure_registered() -> None:
+    """Import the built-in plugin/action packages for their registration
+    side effect.
+
+    Callers that consult the registries (default_conf, build_policy)
+    call this first so registration cannot depend on the caller's
+    import graph — a consumer arriving via framework-only imports would
+    otherwise silently get an EMPTY plugin set and a ~4x smaller
+    compiled program (the bug that made bench.py measure a plugin-free
+    policy through round 4 while the daemon ran the full one)."""
+    import kube_batch_tpu.actions  # noqa: F401  registration side effect
+    import kube_batch_tpu.plugins  # noqa: F401  registration side effect
+
+
 def get_plugin_builder(name: str) -> PluginBuilder:
     if name not in PLUGIN_REGISTRY:
         raise KeyError(f"unknown plugin {name!r}; known: {sorted(PLUGIN_REGISTRY)}")

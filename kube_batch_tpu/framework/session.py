@@ -58,6 +58,13 @@ def build_policy(conf: SchedulerConf) -> tuple[TensorPolicy, list[Plugin]]:
     fns — once per configuration (≙ every-cycle OnSessionOpen in the
     reference, hoisted to config time because fn identity is the XLA
     compile-cache key here)."""
+    # Hand-built SchedulerConfs can reach here without ever touching
+    # default_conf(); plugin lookups below must not depend on the
+    # caller's import graph (framework/plugin.py · ensure_registered).
+    from kube_batch_tpu.framework.plugin import ensure_registered
+
+    ensure_registered()
+
     policy = TensorPolicy(num_tiers=len(conf.tiers))
     plugins: list[Plugin] = []
     for tier_idx, tier in enumerate(conf.tiers):

@@ -1,0 +1,74 @@
+"""Plugin/action registration must not depend on the caller's import
+graph.
+
+Regression for a measurement-integrity bug found in round 5: bench.py's
+import graph never touched ``kube_batch_tpu.plugins``, so
+``build_policy(default_conf())`` silently produced an EMPTY plugin set —
+every headline/config number through round 4 measured a plugin-free
+policy (a ~4x smaller compiled program) while the daemon ran the full
+one.  ``default_conf``/``build_policy`` now force the registration
+imports themselves (≙ the reference's factory registration happening in
+package init, plugins/factory.go — but made import-order-proof).
+"""
+
+import subprocess
+import sys
+
+# The exact import graph bench.py's run_config uses — nothing else.
+BENCH_GRAPH = """
+import jax
+jax.config.update("jax_platforms", "cpu")
+from kube_batch_tpu.actions.fused import make_cycle_solver
+from kube_batch_tpu.cache.packer import pack_snapshot
+from kube_batch_tpu.framework.conf import default_conf
+from kube_batch_tpu.framework.session import build_policy
+from kube_batch_tpu.ops.assignment import init_state
+
+policy, plugins = build_policy(default_conf())
+print("NPLUGINS", len(plugins))
+"""
+
+FRAMEWORK_ONLY = """
+import jax
+jax.config.update("jax_platforms", "cpu")
+from kube_batch_tpu.framework.conf import SchedulerConf, TierConf, PluginConf
+from kube_batch_tpu.framework.session import build_policy
+
+conf = SchedulerConf(
+    actions=("allocate",),
+    tiers=(TierConf(plugins=(PluginConf("drf"), PluginConf("gang"))),),
+)
+policy, plugins = build_policy(conf)
+print("NPLUGINS", len(plugins))
+"""
+
+
+def _run(src: str) -> str:
+    proc = subprocess.run(
+        [sys.executable, "-c", src],
+        capture_output=True,
+        text=True,
+        timeout=180,
+    )
+    assert proc.returncode == 0, proc.stderr[-800:]
+    return proc.stdout
+
+
+def test_bench_import_graph_gets_full_plugin_set():
+    assert "NPLUGINS 8" in _run(BENCH_GRAPH)
+
+
+def test_hand_built_conf_resolves_plugins_without_package_import():
+    assert "NPLUGINS 2" in _run(FRAMEWORK_ONLY)
+
+
+def test_default_conf_lists_all_reference_plugins():
+    from kube_batch_tpu.framework.conf import default_conf
+
+    names = {
+        p.name for tier in default_conf().tiers for p in tier.plugins
+    }
+    assert names == {
+        "priority", "gang", "conformance", "pdb",
+        "drf", "predicates", "proportion", "nodeorder",
+    }
