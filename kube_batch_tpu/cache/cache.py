@@ -47,6 +47,15 @@ from kube_batch_tpu.cache.info import JobInfo, NodeInfo, QueueInfo
 DEFAULT_QUEUE = "default"
 
 
+class CacheResyncing(RuntimeError):
+    """Raised by snapshot() while the mirror is mid-relist: between a
+    watch gap's clear() and the LIST replay completing, the cache is a
+    consistent-prefix of the cluster (nodes may be present while their
+    bound pods are not yet replayed) — scheduling against it would see
+    phantom idle capacity and dispatch real overcommitting binds.  The
+    scheduler skips the cycle instead (scheduler.py · run_once)."""
+
+
 class PackDirty:
     """Per-consumer change journal between two tensor packs.
 
@@ -159,6 +168,10 @@ class SchedulerCache:
         # O(1) status census for the idle early-out: pods per TaskStatus,
         # maintained by every mutator below.
         self._status_counts: collections.Counter = collections.Counter()
+        # True between begin_resync() and end_resync(): the mirror is a
+        # half-replayed LIST and must not be scheduled against (see
+        # snapshot()'s guard).
+        self._resyncing = False
 
         self.add_queue(Queue(name=default_queue, weight=1.0))
 
@@ -490,6 +503,24 @@ class SchedulerCache:
         Session.__init__."""
         return self._lock
 
+    # -- relist quiescence (watch-gap recovery) --------------------------
+
+    def begin_resync(self) -> None:
+        """Mark the mirror unschedulable-against until end_resync():
+        call before clear() + LIST replay on a watch gap.  snapshot()
+        raises CacheResyncing under the same lock the packers hold, so
+        no cycle can pack a half-replayed mirror."""
+        with self._lock:
+            self._resyncing = True
+
+    def end_resync(self) -> None:
+        with self._lock:
+            self._resyncing = False
+
+    def is_resyncing(self) -> bool:
+        with self._lock:
+            return self._resyncing
+
     def snapshot(self, shared: bool = False) -> HostSnapshot:
         """Consistent view.  Jobs without a real PodGroup or with an
         unknown queue are skipped (≙ Snapshot's same filter) — their
@@ -508,6 +539,10 @@ class SchedulerCache:
         so post-lock ITERATION never races the adapter thread; post-lock
         pod reads must stick to immutable fields (uid/name/request)."""
         with self._lock:
+            if self._resyncing:
+                raise CacheResyncing(
+                    "cache mirror is mid-relist; skip this cycle"
+                )
             if shared:
                 jobs = {
                     name: job.clone()

@@ -315,10 +315,20 @@ def run_external(args) -> int:
                 logging.warning(
                     "watch gap (%s); re-listing in-process", exc,
                 )
+                # Quiesce scheduling BEFORE the clear: from here until
+                # the replay completes the mirror is a consistent
+                # prefix of the cluster (nodes present, their pods not
+                # yet), and a cycle packed from it would see phantom
+                # idle capacity and dispatch real overcommitting binds.
+                # snapshot() raises CacheResyncing under the cache lock
+                # until end_resync below (or a later successful retry —
+                # a failed attempt leaves the flag set on purpose).
+                cache.begin_resync()
                 cache.clear()
                 backend.request_list()
             if not nadapter.wait_for_sync(60.0):
                 raise TimeoutError("resume replay never completed")
+            cache.end_resync()
             return nsock, nadapter
         except BaseException:
             nsock.close()
@@ -481,6 +491,10 @@ def run_http(args) -> int:
     except KeyboardInterrupt:
         logging.info("interrupted; shutting down")
     finally:
+        # The final cycle's events (evictions, unschedulable
+        # diagnoses) are still on the async flusher's queue; give them
+        # a bounded chance to land before the daemon thread dies.
+        backend.drain_events(5.0)
         mux.close()
         if elector is not None:
             elector.release()

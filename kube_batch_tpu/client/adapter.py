@@ -142,14 +142,27 @@ class StreamBackend:
         self._call({"verb": "list"})
 
     def reconnect(self, writer: IO[str]) -> None:
-        """Re-arm this backend on a fresh connection's writer: in-flight
-        callers were already failed by mark_closed; stale correlation
-        state is dropped so late responses from the OLD stream can
-        never satisfy a NEW request's id."""
+        """Re-arm this backend on a fresh connection's writer: stale
+        correlation state is dropped so late responses from the OLD
+        stream can never satisfy a NEW request's id.
+
+        In-flight callers were woken by mark_closed, but a waiter can
+        be descheduled between that notify and re-evaluating its
+        predicate — if this method simply cleared `closed`, such a
+        straggler would re-block for its FULL remaining timeout (×16
+        bind workers = a stalled commit).  So every still-waiting rid
+        is handed an error response first: stragglers wake into an
+        immediate failure instead of a dead wait."""
         with self._wlock:
             with self._cv:
                 self._pending.clear()
+                for rid in self._waiting:
+                    self._pending[rid] = {
+                        "id": rid, "ok": False,
+                        "error": "cluster stream reconnected mid-call",
+                    }
                 self._waiting.clear()
+                self._cv.notify_all()
             self._writer = writer
             self.generation += 1
             self.closed.clear()

@@ -79,6 +79,10 @@ class ExternalCluster:
             maxlen=history
         )
         self.pods: dict[str, Pod] = {}
+        # (namespace, name) → uid index for the k8s dialect's
+        # path-addressed writes; pods are never removed (evict returns
+        # them to Pending), so submit() is the only maintenance site.
+        self._pods_by_name: dict[tuple[str, str], str] = {}
         self.nodes: dict[str, Node] = {}
         self.groups: dict[str, PodGroup] = {}
         self.queues: dict[str, Queue] = {}
@@ -200,6 +204,10 @@ class ExternalCluster:
             for pod in pods:
                 pod.group = group.name
                 self.pods[pod.uid] = pod
+                key = (pod.namespace, pod.name)
+                # First submission wins, matching the linear scan this
+                # index replaced (dict iteration = insertion order).
+                self._pods_by_name.setdefault(key, pod.uid)
                 self._emit("ADDED", "Pod", encode_pod(pod))
 
     def tick(self) -> None:
@@ -292,8 +300,22 @@ class ExternalCluster:
 
     # -- apiserver-dialect writes (client/k8s_write.py shapes) ----------
     def _find_pod(self, namespace: str, name: str) -> Pod | None:
+        """O(1) by-name lookup for the k8s dialect's path-addressed
+        writes: a 47.5k-pod gang commit issues one of these per
+        Binding POST, and a linear scan under the global lock would
+        make the fixture consumer quadratic in cluster size — the
+        bottleneck the scheduler's bind fan-out exists to remove."""
+        key = (namespace, name)
+        uid = self._pods_by_name.get(key)
+        pod = self.pods.get(uid) if uid is not None else None
+        if pod is not None:
+            return pod
+        # Index miss: tests (and uid churn — a controller recreating a
+        # same-named pod) mutate self.pods directly, so fall back to
+        # the scan the index replaced and repair the entry.
         for pod in self.pods.values():
             if pod.namespace == namespace and pod.name == name:
+                self._pods_by_name[key] = pod.uid
                 return pod
         return None
 

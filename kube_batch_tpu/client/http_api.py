@@ -459,6 +459,7 @@ class K8sHttpBackend:
 
     def __init__(self, client: _Client) -> None:
         self.client = client
+        import collections
         import time
 
         # Wall-clock seeded: event names must not collide across
@@ -466,6 +467,57 @@ class K8sHttpBackend:
         self._event_seq = time.time_ns()
         self._event_lock = threading.Lock()
         self._local = threading.local()
+        # Events post from ONE flusher thread, never the caller's (≙
+        # the async client-go recorder, and the same design as
+        # K8sStreamBackend): diagnosis can emit hundreds of Events per
+        # cycle, and at tunnel RTTs synchronous POSTs on the cycle
+        # thread would dwarf the 1 s period.  Bounded: overflow sheds
+        # oldest (events are best-effort).
+        self._event_q: collections.deque[dict] = collections.deque(
+            maxlen=1000
+        )
+        self._event_ready = threading.Event()
+        self._event_flusher = threading.Thread(
+            target=self._flush_events, daemon=True
+        )
+        self._event_flusher.start()
+
+    def _flush_events(self) -> None:
+        while True:
+            self._event_ready.wait(0.5)
+            self._event_ready.clear()
+            while True:
+                try:
+                    req = self._event_q.popleft()
+                except IndexError:
+                    break
+                try:
+                    self._issue(req)
+                except Exception as exc:  # noqa: BLE001 — best-effort
+                    # Keep the backlog across an apiserver outage
+                    # (same contract as K8sStreamBackend's flusher):
+                    # re-queue and retry on the next wakeup instead of
+                    # serially burning a timeout per queued event and
+                    # discarding them all.  appendleft on a full ring
+                    # sheds the newest instead of the oldest — fine,
+                    # shedding SOMETHING is the bounded queue's job.
+                    self._event_q.appendleft(req)
+                    log.debug("event post failed (kept queued): %s", exc)
+                    break
+
+    def drain_events(self, timeout: float = 5.0) -> bool:
+        """Best-effort blocking flush for process teardown: events
+        recorded by the FINAL cycle (evictions, unschedulable
+        diagnoses) would otherwise die with the daemon flusher thread.
+        Returns True when the queue emptied in time."""
+        import time as _time
+
+        deadline = _time.monotonic() + timeout
+        self._event_ready.set()
+        while self._event_q and _time.monotonic() < deadline:
+            _time.sleep(0.05)
+            self._event_ready.set()
+        return not self._event_q
 
     def _conn_get(self) -> tuple[http.client.HTTPConnection, bool]:
         """(connection, fresh) for THIS thread."""
@@ -548,13 +600,11 @@ class K8sHttpBackend:
         with self._event_lock:
             self._event_seq += 1
             seq = self._event_seq
-        try:
-            self._issue(event_request(
-                kind, name, reason, message,
-                count=count, namespace=namespace, sequence=seq,
-            ))
-        except Exception as exc:  # noqa: BLE001 — events are best-effort
-            log.debug("event post failed: %s", exc)
+        self._event_q.append(event_request(
+            kind, name, reason, message,
+            count=count, namespace=namespace, sequence=seq,
+        ))
+        self._event_ready.set()
 
 
 class _HttpLeaseLock:

@@ -24,7 +24,7 @@ import numpy as np
 from kube_batch_tpu import metrics
 from kube_batch_tpu.actions import factory as _action_factory  # noqa: F401
 from kube_batch_tpu.api.types import TaskStatus
-from kube_batch_tpu.cache.cache import SchedulerCache
+from kube_batch_tpu.cache.cache import CacheResyncing, SchedulerCache
 from kube_batch_tpu.framework.conf import SchedulerConf, load_conf
 from kube_batch_tpu.framework.plugin import Action, get_action
 from kube_batch_tpu.framework.session import (
@@ -394,6 +394,11 @@ class Scheduler:
         cycle patches everything at once."""
         if not self._idle_armed or self._pending is not None:
             return False
+        if self.cache.is_resyncing():
+            # Mid-relist the census is a partial view and a status
+            # refresh would write phases computed from half-replayed
+            # groups; fall through to the snapshot guard's clean skip.
+            return False
         if self.cache.has_pending_work():
             return False
         d = self.packer._dirty
@@ -431,9 +436,21 @@ class Scheduler:
                 metrics.schedule_attempts.inc("idle")
                 metrics.pending_tasks.set(0.0)  # skip implies none pending
                 return None
-            ssn = open_session(
-                self.cache, self._policy, self._plugins, packer=self.packer
-            )
+            try:
+                ssn = open_session(
+                    self.cache, self._policy, self._plugins,
+                    packer=self.packer,
+                )
+            except CacheResyncing:
+                # Watch-gap recovery is replaying a LIST into the
+                # mirror (cli.py · reconnect_once); scheduling against
+                # the half-replayed view would overcommit nodes.  The
+                # snapshot guard raises under the cache lock, so this
+                # skip is race-free; the replay's journal marks force a
+                # full re-pack on the next real cycle.
+                logging.info("cache mid-relist; skipping cycle")
+                metrics.schedule_attempts.inc("resync")
+                return None
             if self._cycle is not None:
                 self._execute_fused(ssn)
             else:

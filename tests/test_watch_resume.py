@@ -328,3 +328,64 @@ def test_cli_daemon_reconnects_in_process():
     runner.join(60.0)
     assert not runner.is_alive()
     assert rc_holder.get("rc") == 0
+
+
+def test_relist_quiesces_scheduling():
+    """Between begin_resync() and end_resync() the mirror is a
+    half-replayed LIST: snapshot() must refuse (under the cache lock,
+    so no pack can race it) and Scheduler.run_once must skip the cycle
+    instead of scheduling phantom-idle capacity."""
+    import pytest
+
+    from kube_batch_tpu.cache.cache import CacheResyncing
+    from kube_batch_tpu.models.workloads import build_config
+
+    cache, _sim = build_config(1)
+    s = Scheduler(cache, schedule_period=0.0)
+
+    cache.begin_resync()
+    with pytest.raises(CacheResyncing):
+        cache.snapshot()
+    assert s.run_once() is None  # clean skip, no dispatch, no raise
+
+    cache.end_resync()
+    ssn = s.run_once()
+    assert ssn is not None and len(ssn.bound) == 8  # config-1 gang lands
+
+
+def test_reconnect_fails_straggler_waiters_fast():
+    """A _call descheduled across a reconnect() must wake into an
+    immediate failure, not re-block for its full remaining timeout
+    (×16 bind workers = a stalled gang commit)."""
+    import io
+
+    a, b = socket_mod.socketpair()
+    writer = b.makefile("w", encoding="utf-8")
+    backend = StreamBackend(writer, timeout=20.0)
+
+    t0 = time.monotonic()
+    errors: list[BaseException] = []
+
+    def caller() -> None:
+        try:
+            backend.bind(
+                Pod(name="p", uid="u",
+                    request={"cpu": 1, "memory": 1, "pods": 1}),
+                "n0",
+            )
+        except BaseException as exc:  # noqa: BLE001 — recording
+            errors.append(exc)
+
+    th = threading.Thread(target=caller, daemon=True)
+    th.start()
+    assert _wait(lambda: len(backend._waiting) == 1)
+
+    # The consumer never responds; the supervisor re-arms the backend
+    # on a fresh writer while the caller is still parked in wait_for.
+    backend.reconnect(io.StringIO())
+    th.join(5.0)
+    assert not th.is_alive(), "caller still blocked after reconnect"
+    assert errors and "reconnected mid-call" in str(errors[0])
+    assert time.monotonic() - t0 < 10.0  # failed fast, not at timeout
+    a.close()
+    b.close()
