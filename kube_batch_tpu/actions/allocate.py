@@ -34,14 +34,17 @@ def make_allocate_solver(policy, max_rounds: int | None = None):
     production, and bench.py / __graft_entry__.py reuse it so what they
     measure/compile-check is exactly what runs.
 
-    `max_rounds` bounds auction rounds per pass (None → number of
-    tasks, which always converges; set a smaller cap to trade scheduling
-    completeness within one cycle for bounded cycle latency — leftover
-    tasks simply stay Pending for the next cycle).
+    `max_rounds` bounds auction rounds per pass (None → the policy's
+    `max_rounds` — conf `arguments: {allocate.max_rounds: N}` — and
+    failing that the number of tasks, which always converges; a cap
+    trades scheduling completeness within one cycle for bounded cycle
+    latency — leftover tasks simply stay Pending for the next cycle).
     """
 
     from kube_batch_tpu.actions.backfill import non_besteffort_eligible
 
+    if max_rounds is None:
+        max_rounds = getattr(policy, "max_rounds", None)
     eligible = non_besteffort_eligible(policy)
 
     def solve(snap, state):

@@ -493,9 +493,19 @@ class K8sHttpBackend:
                     break
                 try:
                     self._issue(req)
-                except Exception as exc:  # noqa: BLE001 — best-effort
-                    # Keep the backlog across an apiserver outage
-                    # (same contract as K8sStreamBackend's flusher):
+                except HttpError as exc:
+                    if 400 <= exc.status < 500:
+                        # Permanent rejection (RBAC denial, invalid
+                        # object): re-queueing would wedge the whole
+                        # pipeline behind one poison event — drop it
+                        # and keep posting the rest.
+                        log.debug("event rejected (%d), dropped: %s",
+                                  exc.status, exc)
+                        continue
+                    self._event_q.appendleft(req)  # 5xx: server transient
+                    break
+                except Exception as exc:  # noqa: BLE001 — transport down
+                    # Keep the backlog across an apiserver outage:
                     # re-queue and retry on the next wakeup instead of
                     # serially burning a timeout per queued event and
                     # discarding them all.  appendleft on a full ring

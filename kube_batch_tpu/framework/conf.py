@@ -56,6 +56,16 @@ class TierConf:
 class SchedulerConf:
     actions: tuple[str, ...]
     tiers: tuple[TierConf, ...]
+    #: Top-level arguments (action-scoped knobs, e.g.
+    #: `allocate.max_rounds`) — the action analog of per-plugin
+    #: Arguments.  The reference has no per-action config; this exists
+    #: for the one knob the tensor design adds: the auction round cap,
+    #: an operator latency valve (see actions/allocate.py).
+    arguments: tuple[tuple[str, Any], ...] = ()
+
+    @property
+    def args_dict(self) -> dict[str, Any]:
+        return dict(self.arguments)
 
     @property
     def fingerprint(self) -> int:
@@ -125,9 +135,14 @@ def parse_conf(text: str) -> SchedulerConf:
                 )
             )
         tiers.append(TierConf(plugins=tuple(plugins)))
+    arguments = tuple(sorted((raw.get("arguments") or {}).items()))
     if not tiers:
-        return dataclasses.replace(default_conf(), actions=actions)
-    return SchedulerConf(actions=actions, tiers=tuple(tiers))
+        return dataclasses.replace(
+            default_conf(), actions=actions, arguments=arguments
+        )
+    return SchedulerConf(
+        actions=actions, tiers=tuple(tiers), arguments=arguments
+    )
 
 
 def load_conf(path: str | None) -> SchedulerConf:

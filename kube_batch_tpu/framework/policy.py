@@ -135,6 +135,16 @@ class TensorPolicy:
         # allocate_rounds score_quantum).  Set when state-dependent
         # scores register; plugins may override via their Arguments.
         self.score_quantum = 0.0
+        # Auction round cap (operator latency valve; scheduler.conf
+        # top-level `arguments: {allocate.max_rounds: N}`).  None =
+        # exact: run to the fixed point.  Under oversubscription the
+        # serial-fidelity watermark places the scarce tail one rank
+        # burst per round (BASELINE.md round-5 attribution: config 4
+        # converges in ~128 rounds, ~4 ms each on TPU); capping bounds
+        # cycle latency and leaves the remainder Pending for the next
+        # cycle — the same degradation the reference exhibits when its
+        # serial cycle overruns the 1 s period.
+        self.max_rounds: int | None = None
 
     # -- registration (≙ session_plugins.go Add*Fn) ---------------------
     def add_queue_order_fn(self, tier: int, fn: QueueKeyFn) -> None:

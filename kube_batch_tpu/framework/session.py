@@ -66,6 +66,25 @@ def build_policy(conf: SchedulerConf) -> tuple[TensorPolicy, list[Plugin]]:
     ensure_registered()
 
     policy = TensorPolicy(num_tiers=len(conf.tiers))
+    # Loud validation (same posture as world-file section checks): a
+    # typo'd knob or a nonsense value must fail the conf build — the
+    # hot-reload path then keeps serving the previous policy and logs
+    # the error, instead of silently no-opping the operator's intent.
+    args = conf.args_dict
+    unknown = set(args) - {"allocate.max_rounds"}
+    if unknown:
+        raise ValueError(
+            f"unknown scheduler.conf arguments: {sorted(unknown)} "
+            "(supported: allocate.max_rounds)"
+        )
+    if "allocate.max_rounds" in args:
+        mr = int(args["allocate.max_rounds"])
+        if mr < 1:
+            raise ValueError(
+                f"allocate.max_rounds must be >= 1, got {mr} "
+                "(omit the key for the exact fixed-point solve)"
+            )
+        policy.max_rounds = mr
     plugins: list[Plugin] = []
     for tier_idx, tier in enumerate(conf.tiers):
         for pconf in tier.plugins:
