@@ -45,6 +45,12 @@ import numpy as np
 # Global wall-clock budget: past this, remaining configs are skipped so
 # the driver's capture always completes.
 TIME_BUDGET_S = 480.0
+
+#: Extra seconds granted past a child's timeout for an in-flight XLA
+#: compile to finish and bank its persistent-cache entry before the
+#: child is killed (killing mid-compile orphans a server-side
+#: compilation AND loses the cache write).
+COMPILE_GRACE_S = 240.0
 _T_START = time.monotonic()
 
 
@@ -377,6 +383,11 @@ def run_config(jax, n: int, timed_iters: int = 8) -> dict:
     t0 = time.perf_counter()
     compiled = jitted.lower(snap, state0).compile()
     compile_s = time.perf_counter() - t0
+    # Sentinel for the parent's kill discipline: the persistent cache
+    # is written at compile completion, so from here a timed-out child
+    # can be killed without orphaning server-side work (the string must
+    # be unique — generic "compile" substrings appear in XLA chatter).
+    _log("COMPILE_BANKED")
     xla_mem_mb = None
     try:
         ma = compiled.memory_analysis()
@@ -764,6 +775,55 @@ def _merge_partial(last: dict | None, partial: dict | None) -> dict | None:
     return merged
 
 
+def _wait_with_compile_grace(
+    argv: list[str], timeout_s: float, done_marker: str,
+    marker_in_stdout: bool, what: str,
+) -> tuple[bool, str, str, int | None]:
+    """Run a bench child; on timeout, grant a bounded grace window for
+    an in-flight XLA compile to finish and bank its persistent-cache
+    entry before killing (killing mid-compile both orphans a
+    server-side compilation — later compiles queue behind it for
+    minutes — and loses the cache write that makes future runs fast).
+
+    `done_marker` appearing in the child's output means the compile
+    already banked, so a timed-out child is killed immediately.
+    Returns (timed_out, stdout, stderr, returncode).
+
+    The parent reads the child's LIVE output with os.pread: parent and
+    child share the TemporaryFile's file description, so a seek()-based
+    read would move the shared offset and let concurrent child writes
+    land over already-captured bytes.
+    """
+    import subprocess
+    import tempfile
+
+    with tempfile.TemporaryFile("w+b") as out_f, \
+            tempfile.TemporaryFile("w+b") as err_f:
+        proc = subprocess.Popen(argv, stdout=out_f, stderr=err_f)
+
+        def _read(f) -> str:
+            size = os.fstat(f.fileno()).st_size
+            return os.pread(f.fileno(), size, 0).decode(errors="replace")
+
+        timed_out = False
+        try:
+            proc.wait(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            marker_src = out_f if marker_in_stdout else err_f
+            if done_marker not in _read(marker_src):
+                _log(f"{what}: over budget mid-compile; granting "
+                     f"{COMPILE_GRACE_S:.0f}s grace to bank the cache")
+                try:
+                    proc.wait(timeout=COMPILE_GRACE_S)
+                except subprocess.TimeoutExpired:
+                    pass
+            timed_out = proc.poll() is None
+            if timed_out:
+                proc.kill()
+                proc.wait()
+        return timed_out, _read(out_f), _read(err_f), proc.returncode
+
+
 def _run_daemon_subprocess(timeout_s: float) -> dict:
     """run_daemon in a fresh interpreter (same isolation rationale as
     configs; also exactly what 'a restarted daemon' means).
@@ -771,39 +831,37 @@ def _run_daemon_subprocess(timeout_s: float) -> dict:
     The child emits a PARTIAL result line after each milestone, so a
     timeout degrades to whatever phases completed instead of erasing
     the whole scoreboard (the round-4 lesson: one transient outage
-    zeroed every daemon field).  Killing the child mid-compile can
-    orphan a server-side XLA compilation that later compiles queue
-    behind — the error record says so.
+    zeroed every daemon field).  The `first_cycle_ms` milestone marks
+    the first-cycle compile complete (grace discipline in
+    _wait_with_compile_grace).
     """
-    import subprocess
+    timed_out, stdout, stderr, rc = _wait_with_compile_grace(
+        [sys.executable, __file__, "--_daemon",
+         "--_budget", f"{max(timeout_s - 30.0, 30.0):.0f}"],
+        timeout_s, done_marker="first_cycle_ms", marker_in_stdout=True,
+        what="daemon",
+    )
 
-    try:
-        proc = subprocess.run(
-            [sys.executable, __file__, "--_daemon",
-             "--_budget", f"{max(timeout_s - 30.0, 30.0):.0f}"],
-            capture_output=True, text=True, timeout=timeout_s,
-        )
-    except subprocess.TimeoutExpired as exc:
-        out = _merge_partial(*_collect_json_lines(exc.stdout)) or {}
+    if timed_out:
+        out = _merge_partial(*_collect_json_lines(stdout)) or {}
         out["error"] = (
-            f"timed out after {timeout_s:.0f}s (killed child may orphan "
-            "a server-side compilation; later compiles can queue behind "
-            "it)"
+            f"timed out after {timeout_s:.0f}s (+grace; a child killed "
+            "mid-compile may orphan a server-side compilation that "
+            "later compiles queue behind)"
         )
-        tail = _text(exc.stderr).strip().splitlines()[-3:]
+        tail = stderr.strip().splitlines()[-3:]
         if tail:
             out["child_log_tail"] = tail
         return out
-    out = _merge_partial(*_collect_json_lines(proc.stdout))
+
+    out = _merge_partial(*_collect_json_lines(stdout))
     if out is not None:
-        if proc.returncode != 0 and "error" not in out:
+        if rc != 0 and "error" not in out:
             out["error"] = (
-                f"child died rc={proc.returncode} after last partial: "
-                f"{_text(proc.stderr)[-200:]}"
+                f"child died rc={rc} after last partial: {stderr[-200:]}"
             )
         return out
-    tail = _text(proc.stderr)[-300:]
-    return {"error": f"rc={proc.returncode}: {tail}"}
+    return {"error": f"rc={rc}: {stderr[-300:]}"}
 
 
 def _retry_on_hang(run, what: str) -> dict:
@@ -836,32 +894,34 @@ def _run_config_subprocess(n: int, timeout_s: float) -> dict:
     (config 5 after config 4 reproduces it; either alone is fine), and a
     per-config device OOM must not take the whole sweep down.  The child
     prints one JSON dict; crash/timeout degrade to an error entry.
-    """
-    import subprocess
 
-    try:
-        proc = subprocess.run(
-            [
-                sys.executable, __file__, "--_one-config", str(n),
-                # Child inherits the PARENT'S remaining budget (its own
-                # _T_START resets at import), so its CPU-baseline gate
-                # skips rather than running the parent into the timeout.
-                "--_budget", f"{max(timeout_s - 45.0, 30.0):.0f}",
-            ],
-            capture_output=True, text=True, timeout=timeout_s,
-        )
-    except subprocess.TimeoutExpired as exc:
-        out = {"error": f"timed out after {timeout_s:.0f}s"}
-        tail = _text(exc.stderr).strip().splitlines()[-3:]
+    Kill discipline: the child logs the COMPILE_BANKED sentinel the
+    moment its AOT compile returns (the persistent-cache write happens
+    at compile completion); see _wait_with_compile_grace.
+    """
+    timed_out, stdout, stderr, rc = _wait_with_compile_grace(
+        [
+            sys.executable, __file__, "--_one-config", str(n),
+            # Child inherits the PARENT'S remaining budget (its own
+            # _T_START resets at import), so its CPU-baseline gate
+            # skips rather than running the parent into the timeout.
+            "--_budget", f"{max(timeout_s - 45.0, 30.0):.0f}",
+        ],
+        timeout_s, done_marker="COMPILE_BANKED", marker_in_stdout=False,
+        what=f"  config {n}",
+    )
+
+    if timed_out:
+        out = {"error": f"timed out after {timeout_s:.0f}s (+grace)"}
+        tail = stderr.strip().splitlines()[-3:]
         if tail:
             out["child_log_tail"] = tail
         return out
-    line = proc.stdout.strip().splitlines()[-1] if proc.stdout.strip() else ""
+    line = stdout.strip().splitlines()[-1] if stdout.strip() else ""
     try:
         return json.loads(line)
     except json.JSONDecodeError:
-        tail = (proc.stderr or "")[-300:]
-        return {"error": f"rc={proc.returncode}: {tail}"}
+        return {"error": f"rc={rc}: {stderr[-300:]}"}
 
 
 def main() -> None:

@@ -494,7 +494,9 @@ class K8sHttpBackend:
                 try:
                     self._issue(req)
                 except HttpError as exc:
-                    if 400 <= exc.status < 500:
+                    if 400 <= exc.status < 500 and exc.status not in (
+                        408, 429,  # timeouts/throttling are retryable
+                    ):
                         # Permanent rejection (RBAC denial, invalid
                         # object): re-queueing would wedge the whole
                         # pipeline behind one poison event — drop it
@@ -502,7 +504,7 @@ class K8sHttpBackend:
                         log.debug("event rejected (%d), dropped: %s",
                                   exc.status, exc)
                         continue
-                    self._event_q.appendleft(req)  # 5xx: server transient
+                    self._event_q.appendleft(req)  # transient: keep it
                     break
                 except Exception as exc:  # noqa: BLE001 — transport down
                     # Keep the backlog across an apiserver outage:

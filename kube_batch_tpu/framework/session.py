@@ -78,11 +78,13 @@ def build_policy(conf: SchedulerConf) -> tuple[TensorPolicy, list[Plugin]]:
             "(supported: allocate.max_rounds)"
         )
     if "allocate.max_rounds" in args:
-        mr = int(args["allocate.max_rounds"])
-        if mr < 1:
+        mr = args["allocate.max_rounds"]
+        if isinstance(mr, bool) or not isinstance(mr, int) or mr < 1:
+            # No silent coercion: 2.5, "4", or true must fail the
+            # build, not be quietly reinterpreted.
             raise ValueError(
-                f"allocate.max_rounds must be >= 1, got {mr} "
-                "(omit the key for the exact fixed-point solve)"
+                f"allocate.max_rounds must be an integer >= 1, got "
+                f"{mr!r} (omit the key for the exact fixed-point solve)"
             )
         policy.max_rounds = mr
     plugins: list[Plugin] = []

@@ -307,7 +307,7 @@ def test_conf_arguments_validated_loudly():
         build_policy(parse_conf(
             "actions: allocate\narguments:\n  allocate.maxRounds: 4\n"
         ))
-    with pytest.raises(ValueError, match="must be >= 1"):
+    with pytest.raises(ValueError, match="must be an integer"):
         build_policy(parse_conf(
             "actions: allocate\narguments:\n  allocate.max_rounds: 0\n"
         ))
