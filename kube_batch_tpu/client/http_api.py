@@ -63,6 +63,18 @@ DEFAULT_RESOURCES: tuple[tuple[str, str], ...] = (
     ("Namespace", "/api/v1/namespaces"),
 )
 
+#: Alternate CRD versions per kind, probed in turn when the primary
+#: path 404s.  ≙ the reference registering BOTH AddPodGroupV1alpha1
+#: and AddPodGroupV1alpha2 informer handlers (cache/event_handlers.go):
+#: a cluster serves whichever version its CRDs install; decode is
+#: version-agnostic (kind-routed, same field names; v1alpha2's extra
+#: spec.minResources is noted loudly by the decoder, not lowered).
+ALT_RESOURCE_PATHS: dict[str, tuple[str, ...]] = {
+    "PodGroup": (
+        "/apis/scheduling.incubator.k8s.io/v1alpha2/podgroups",),
+    "Queue": ("/apis/scheduling.incubator.k8s.io/v1alpha2/queues",),
+}
+
 
 class HttpError(RuntimeError):
     def __init__(self, status: int, body: str) -> None:
@@ -179,6 +191,13 @@ class Reflector:
         self.client = client
         self.kind = kind
         self.path = path
+        # Served-version rotation: on a CONFIRMED 404 the discovery
+        # retry probes the next known version of this kind's CRD
+        # before concluding "not installed".
+        self.paths: tuple[str, ...] = (
+            path, *ALT_RESOURCE_PATHS.get(kind, ()),
+        )
+        self._path_i = 0
         self.sink = sink
         self.stop = stop
         self.last_rv: str = ""
@@ -357,15 +376,33 @@ class Reflector:
                 if not self.listed.is_set():
                     self._list()
                 if self.crd_missing:
-                    # Wait out the discovery period (short when an
-                    # unconfirmed blip still holds live state), then
-                    # let the loop top's single _list() call site
-                    # retry (the watch would just 404 too).
-                    wait = (
-                        2.0
-                        if self._known and self._missing_streak < 2
-                        else self.CRD_RETRY_S
+                    confirmed = not (
+                        self._known and self._missing_streak < 2
                     )
+                    if confirmed and len(self.paths) > 1:
+                        # Probe the next served version of this CRD
+                        # (v1alpha1 → v1alpha2 → …) before waiting out
+                        # a full discovery period: a cluster that only
+                        # installed the other version answers the very
+                        # next LIST.
+                        self._path_i = (
+                            self._path_i + 1
+                        ) % len(self.paths)
+                        self.path = self.paths[self._path_i]
+                        log.info("%s: probing %s", self.kind, self.path)
+                        # A full cycle through every version without an
+                        # answer = genuinely not installed: back off for
+                        # the normal discovery period before the next
+                        # sweep; mid-cycle versions probe quickly.
+                        wait = (
+                            0.5 if self._path_i != 0 else self.CRD_RETRY_S
+                        )
+                    else:
+                        # Wait out the discovery period (short when an
+                        # unconfirmed blip still holds live state);
+                        # the loop top's single _list() call site
+                        # retries (the watch would just 404 too).
+                        wait = 2.0 if not confirmed else self.CRD_RETRY_S
                     if self.stop.wait(wait):
                         return
                     self.listed.clear()

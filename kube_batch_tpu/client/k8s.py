@@ -402,8 +402,21 @@ class K8sDecoder:
 
     # -- CRDs ------------------------------------------------------------
     def pod_group(self, obj: dict) -> PodGroup:
+        """Version-agnostic: v1alpha1 and v1alpha2 PodGroups share the
+        fields this scheduler consumes (minMember/queue/
+        priorityClassName); v1alpha2's extra spec.minResources —
+        aggregate-resource admission gating — is noted loudly and not
+        lowered (minMember is the gang gate here, as in the reference's
+        scheduler which reads MinResources only in its later enqueue
+        action)."""
         meta = obj.get("metadata", {})
         spec = obj.get("spec", {})
+        if spec.get("minResources"):
+            log.warning(
+                "PodGroup %s: spec.minResources (v1alpha2) is not "
+                "lowered; minMember alone gates the gang",
+                meta.get("name"),
+            )
         kwargs: dict[str, Any] = {}
         if meta.get("uid"):
             kwargs["uid"] = meta["uid"]

@@ -32,6 +32,8 @@ class FakeApiServer:
         self.events: list[dict] = []
         self.force_gone = False               # next watches answer 410
         self.missing_kinds: set[str] = set()  # "CRD not installed": 404s
+        self.missing_paths: set[str] = set()  # one VERSION 404s (alt-
+        # version discovery tests: v1alpha1 missing, v1alpha2 served)
         self.relist_serves = 0
         server = self
 
@@ -111,10 +113,17 @@ class FakeApiServer:
 
     # -- internals ------------------------------------------------------
     def _kind_for(self, path: str) -> str | None:
-        from kube_batch_tpu.client.http_api import DEFAULT_RESOURCES
+        from kube_batch_tpu.client.http_api import (
+            ALT_RESOURCE_PATHS,
+            DEFAULT_RESOURCES,
+        )
 
+        if path in self.missing_paths:
+            return None  # this VERSION isn't served (CRD version tests)
         for kind, p in DEFAULT_RESOURCES:
             if path == p:
+                return kind
+            if path in ALT_RESOURCE_PATHS.get(kind, ()):
                 return kind
         return None
 
