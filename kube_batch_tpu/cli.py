@@ -458,6 +458,7 @@ def run_http(args) -> int:
     )
     cache.event_sink = backend
     mux = HttpWatchMux(client).start()
+    backend.follow_served_versions(mux)
     adapter = K8sWatchAdapter(
         cache, mux, scheduler_name=args.scheduler_name
     ).start()

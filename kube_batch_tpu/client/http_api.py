@@ -198,6 +198,7 @@ class Reflector:
             path, *ALT_RESOURCE_PATHS.get(kind, ()),
         )
         self._path_i = 0
+        self._probes_this_sweep = 0
         self.sink = sink
         self.stop = stop
         self.last_rv: str = ""
@@ -274,6 +275,7 @@ class Reflector:
             raise
         self.crd_missing = False
         self._missing_streak = 0
+        self._probes_this_sweep = 0  # next 404 starts a fresh sweep
         fresh = {self._key(i): i for i in out.get("items", []) or []}
         # Objects that vanished during the gap: synthesize DELETED
         # before the upserts (≙ DeltaFIFO Replace).
@@ -390,12 +392,17 @@ class Reflector:
                         ) % len(self.paths)
                         self.path = self.paths[self._path_i]
                         log.info("%s: probing %s", self.kind, self.path)
-                        # A full cycle through every version without an
+                        # A full sweep through every version without an
                         # answer = genuinely not installed: back off for
-                        # the normal discovery period before the next
-                        # sweep; mid-cycle versions probe quickly.
+                        # the normal discovery period; versions not yet
+                        # probed THIS sweep go quickly.  Counted, not
+                        # `_path_i == 0`: a reflector that converged on
+                        # a non-zero index starts its sweeps there.
+                        self._probes_this_sweep += 1
                         wait = (
-                            0.5 if self._path_i != 0 else self.CRD_RETRY_S
+                            0.5
+                            if self._probes_this_sweep % len(self.paths)
+                            else self.CRD_RETRY_S
                         )
                     else:
                         # Wait out the discovery period (short when an
@@ -465,6 +472,19 @@ class HttpWatchMux:
                     return
         self._sink.put(json.dumps({"type": "SYNC"}))
 
+    def served_api_version(self, kind: str) -> str:
+        """group/version of the path `kind`'s reflector currently
+        serves from (e.g. "scheduling.incubator.k8s.io/v1alpha2") —
+        the version the WRITE side must target."""
+        for r in self.reflectors:
+            if r.kind == kind:
+                parts = r.path.split("/")
+                if len(parts) >= 4 and parts[1] == "apis":
+                    return f"{parts[2]}/{parts[3]}"
+        from kube_batch_tpu.client.k8s_write import PODGROUP_API_VERSION
+
+        return PODGROUP_API_VERSION
+
     def close(self) -> None:
         """Stop every reflector and end the line iterator (the adapter
         sees EOF, exactly like a dropped stream)."""
@@ -518,6 +538,22 @@ class K8sHttpBackend:
             target=self._flush_events, daemon=True
         )
         self._event_flusher.start()
+        # The PodGroup CRD version writes must target (a v1alpha2-only
+        # apiserver 404s a v1alpha1 status PUT).  Replaced with the
+        # mux's discovered-version getter by follow_served_versions();
+        # standalone backends keep the v1alpha1 default.
+        from kube_batch_tpu.client.k8s_write import PODGROUP_API_VERSION
+
+        self.pod_group_api_version = lambda: PODGROUP_API_VERSION
+
+    def follow_served_versions(self, mux: "HttpWatchMux") -> None:
+        """Thread the reflectors' served-version discovery into the
+        write path: status PUTs follow wherever the PodGroup LIST
+        actually converged (version rotation happens at runtime, so
+        this is a live getter, not a snapshot)."""
+        self.pod_group_api_version = (
+            lambda: mux.served_api_version("PodGroup")
+        )
 
     def _flush_events(self) -> None:
         while True:
@@ -640,7 +676,9 @@ class K8sHttpBackend:
         self._issue(evict_request(pod))
 
     def update_pod_group(self, group: PodGroup) -> None:
-        self._issue(pod_group_status_request(group))
+        self._issue(pod_group_status_request(
+            group, api_version=self.pod_group_api_version(),
+        ))
 
     def record_event(
         self, kind: str, name: str, reason: str, message: str,

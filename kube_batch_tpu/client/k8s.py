@@ -186,6 +186,7 @@ class K8sDecoder:
         self.priority_classes: dict[str, int] = {}
         self.default_priority = 0
         self._default_class: str | None = None
+        self._min_resources_warned: set[str | None] = set()
 
     # -- PriorityClass (≙ cache.go's pc informer + job_info.go·Priority) --
     def observe_priority_class(self, obj: dict) -> None:
@@ -412,11 +413,16 @@ class K8sDecoder:
         meta = obj.get("metadata", {})
         spec = obj.get("spec", {})
         if spec.get("minResources"):
-            log.warning(
-                "PodGroup %s: spec.minResources (v1alpha2) is not "
-                "lowered; minMember alone gates the gang",
-                meta.get("name"),
-            )
+            # Once per group, not per decode: every MODIFIED event and
+            # re-list re-decodes the object, and a 1 Hz status-update
+            # loop would otherwise flood the log forever.
+            name = meta.get("name")
+            if name not in self._min_resources_warned:
+                self._min_resources_warned.add(name)
+                log.warning(
+                    "PodGroup %s: spec.minResources (v1alpha2) is not "
+                    "lowered; minMember alone gates the gang", name,
+                )
         kwargs: dict[str, Any] = {}
         if meta.get("uid"):
             kwargs["uid"] = meta["uid"]

@@ -92,16 +92,21 @@ def evict_request(pod: Pod) -> dict[str, Any]:
     }
 
 
-def pod_group_status_request(group: PodGroup) -> dict[str, Any]:
-    """≙ job_updater.go: update the PodGroup status subresource."""
+def pod_group_status_request(
+    group: PodGroup, api_version: str = PODGROUP_API_VERSION,
+) -> dict[str, Any]:
+    """≙ job_updater.go: update the PodGroup status subresource.
+    `api_version` must be the version the cluster actually SERVES —
+    the HTTP backend threads the reflector's discovered version here
+    (a v1alpha2-only apiserver 404s a v1alpha1 status PUT)."""
     return {
         "verb": "update",
         "path": (
-            f"/apis/{PODGROUP_API_VERSION}/namespaces/default/"
+            f"/apis/{api_version}/namespaces/default/"
             f"podgroups/{group.name}/status"
         ),
         "object": {
-            "apiVersion": PODGROUP_API_VERSION,
+            "apiVersion": api_version,
             "kind": "PodGroup",
             "metadata": {
                 "name": group.name,

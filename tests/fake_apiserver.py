@@ -280,10 +280,18 @@ class FakeApiServer:
             handler._json(200, {"kind": "Status", "status": "Success"})
             return
 
-        if re.fullmatch(
-            r"/apis/[^/]+/v1alpha\d/namespaces/[^/]+/podgroups/[^/]+/status",
+        m = re.fullmatch(
+            r"(/apis/[^/]+/v1alpha\d)/namespaces/[^/]+/"
+            r"podgroups/[^/]+/status",
             path,
-        ) and method == "PUT":
+        )
+        if m and method == "PUT":
+            # A real apiserver 404s writes to a CRD version it doesn't
+            # serve — without this, a hardcoded write version passes
+            # the version-fallback e2e while failing a real cluster.
+            if f"{m.group(1)}/podgroups" in self.missing_paths:
+                handler._json(404, {"kind": "Status", "code": 404})
+                return
             with self._lock:
                 self.status_puts.append({"path": path, "object": body})
             handler._json(200, body)

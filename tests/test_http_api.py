@@ -38,6 +38,7 @@ def _wire_up(server: FakeApiServer):
     )
     cache.event_sink = backend
     mux = HttpWatchMux(client).start()
+    backend.follow_served_versions(mux)
     adapter = K8sWatchAdapter(cache, mux).start()
     return cache, mux, adapter, Scheduler(cache, conf_path=None)
 
@@ -445,6 +446,11 @@ def test_crd_version_fallback_v1alpha2():
 
         ssn = scheduler.run_once()
         assert len(ssn.bound) == 2  # the v1alpha2-served gang lands
+        # The WRITE side followed discovery: the status PUT targets
+        # the served v1alpha2 path (the fake 404s unserved versions,
+        # like a real apiserver would).
+        assert _wait(lambda: server.status_puts, timeout=10.0)
+        assert "/v1alpha2/" in server.status_puts[-1]["path"]
         mux.close()
     finally:
         server.stop()
