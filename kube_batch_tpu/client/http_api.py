@@ -690,6 +690,7 @@ class K8sHttpBackend:
         self._event_q.append(event_request(
             kind, name, reason, message,
             count=count, namespace=namespace, sequence=seq,
+            pod_group_api_version=self.pod_group_api_version(),
         ))
         self._event_ready.set()
 

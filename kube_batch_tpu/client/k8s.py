@@ -539,8 +539,27 @@ class K8sWatchAdapter(WatchAdapter):
         elif kind == "PodGroup":
             if mtype == "DELETED":
                 cache.delete_pod_group(meta["name"])
+                # A recreated same-named group must warn afresh (and
+                # the set must not grow without bound under churn).
+                dec._min_resources_warned.discard(meta["name"])
             else:
                 cache.add_pod_group(dec.pod_group(obj))
+                # Writes follow the version the cluster SPEAKS: a
+                # v1alpha2-ingested group gets v1alpha2-addressed
+                # status updates (the HTTP transport derives this from
+                # reflector discovery; the stream dialect's only
+                # version signal is the objects themselves).
+                api_version = obj.get("apiVersion")
+                if (
+                    api_version and "/" in api_version
+                    # String attr = the stream backend's static
+                    # version slot; the HTTP backend's is a live
+                    # getter fed by reflector discovery instead.
+                    and isinstance(getattr(
+                        self._backend, "pod_group_api_version", None,
+                    ), str)
+                ):
+                    self._backend.pod_group_api_version = api_version
         elif kind == "Queue":
             if mtype == "DELETED":
                 cache.delete_queue(meta["name"])

@@ -143,12 +143,15 @@ def event_request(
     count: int = 1,
     namespace: str = "default",
     sequence: int = 0,
+    pod_group_api_version: str = PODGROUP_API_VERSION,
 ) -> dict[str, Any]:
     """≙ cache.go · Recorder: POST a core/v1 Event naming the involved
     object.  `sequence` disambiguates event names the way the client-go
-    recorder's timestamp suffix does."""
+    recorder's timestamp suffix does; `pod_group_api_version` must be
+    the served CRD version (an involvedObject reference carrying an
+    unserved version 404s any tooling that resolves it)."""
     if kind == "PodGroup":
-        api_version = PODGROUP_API_VERSION
+        api_version = pod_group_api_version
     elif kind in ("Pod", "Node"):
         api_version = "v1"
     else:
@@ -190,6 +193,10 @@ class K8sStreamBackend(StreamBackend):
 
     def __init__(self, writer, timeout: float = 10.0) -> None:
         super().__init__(writer, timeout)
+        # Status writes address the CRD version the cluster SPEAKS:
+        # K8sWatchAdapter updates this from ingested PodGroups'
+        # apiVersion (the stream dialect's only version signal).
+        self.pod_group_api_version = PODGROUP_API_VERSION
         # Seeded with wall-clock nanoseconds so event names stay unique
         # ACROSS restarts (≙ client-go's timestamp suffix): a relayed
         # POST re-using a previous process's name would 409 on a real
@@ -243,7 +250,9 @@ class K8sStreamBackend(StreamBackend):
         self._call(evict_request(pod))
 
     def update_pod_group(self, group: PodGroup) -> None:
-        self._call(pod_group_status_request(group))
+        self._call(pod_group_status_request(
+            group, api_version=self.pod_group_api_version,
+        ))
 
     # -- EventSink (cache.record_event forwarding) ----------------------
     def record_event(
@@ -265,6 +274,7 @@ class K8sStreamBackend(StreamBackend):
             kind, name, reason, message,
             count=count, namespace=namespace,
             sequence=next(self._event_seq),
+            pod_group_api_version=self.pod_group_api_version,
         )
         payload["type"] = "REQUEST"
         payload["id"] = 0  # no waiter; consumer responses are dropped

@@ -364,3 +364,33 @@ def test_k8s_in_k8s_out_roundtrip():
 
     a.close()
     b.close()
+
+
+def test_status_update_follows_ingested_crd_version():
+    """A v1alpha2-ingested PodGroup gets v1alpha2-addressed status
+    updates: the stream dialect's only version signal is the objects
+    the cluster sends, so the write side follows ingest (the HTTP
+    transport follows reflector discovery instead)."""
+    import io
+
+    backend = K8sStreamBackend(io.StringIO(), timeout=0.1)
+    cache = SchedulerCache(
+        SPEC, binder=backend, evictor=backend, status_updater=backend
+    )
+    adapter = K8sWatchAdapter(cache, io.StringIO(), backend=backend)
+
+    pg = k8s_pod_group("g2", min_member=1)
+    pg["apiVersion"] = "scheduling.incubator.k8s.io/v1alpha2"
+    adapter._apply_k8s("ADDED", pg)
+
+    assert backend.pod_group_api_version == \
+        "scheduling.incubator.k8s.io/v1alpha2"
+    req = pod_group_status_request(
+        cache._jobs["g2"].pod_group,
+        api_version=backend.pod_group_api_version,
+    )
+    assert req["path"].startswith(
+        "/apis/scheduling.incubator.k8s.io/v1alpha2/"
+    )
+    assert req["object"]["apiVersion"] == \
+        "scheduling.incubator.k8s.io/v1alpha2"
