@@ -17,6 +17,13 @@ e2e:
 bench:
 	$(PY) bench.py
 
+# CPU smoke of the daemon bench phases (soak, hotswap, per-phase
+# attribution) at a SMALL config: keeps the TPU-only code paths from
+# rotting while the device tunnel is down.  ~3-5 min.
+bench-smoke:
+	KB_TPU_FORCE_CPU=1 $(PY) bench.py --_daemon --_daemon-config 2 \
+	    --_budget 600
+
 # Pre-compile every hot-swappable conf at the flagship shape into the
 # persistent XLA cache, so daemon conf swaps replay in seconds instead
 # of hitting the measured 7-13 min XLA:TPU compile cliff (see

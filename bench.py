@@ -940,6 +940,13 @@ def main() -> None:
         help=argparse.SUPPRESS,  # internal: child-process daemon mode
     )
     parser.add_argument(
+        "--_daemon-config", type=int, default=5, dest="daemon_config",
+        help=argparse.SUPPRESS,  # smoke: run the daemon phases at a
+        # small config so soak/hotswap stay CPU-testable (make
+        # bench-smoke); the driver's artifact always uses the default
+        # flagship config 5
+    )
+    parser.add_argument(
         "--skip-daemon", action="store_true",
         help="skip the e2e daemon benchmark phase",
     )
@@ -962,7 +969,8 @@ def main() -> None:
         cache_dir = enable_compile_cache()
         try:
             if args.daemon:
-                out = {"device": platform, **run_daemon(jax)}
+                out = {"device": platform,
+                       **run_daemon(jax, n=args.daemon_config)}
             else:
                 out = {"device": platform, **run_config(jax, args.one_config)}
             out["compile_cache_dir"] = cache_dir
