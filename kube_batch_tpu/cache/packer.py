@@ -97,7 +97,13 @@ def pack_snapshot(host: HostSnapshot) -> tuple[SnapshotTensors, SnapshotMeta]:
 
 def pack_snapshot_full(
     host: HostSnapshot,
+    min_buckets: dict[str, int] | None = None,
 ) -> tuple[SnapshotTensors, SnapshotMeta, PackInternals]:
+    """`min_buckets` forces minimum padded sizes for the primary dims
+    ("T"/"J"/"N"), used by the scheduler's growth prewarm to compile
+    the NEXT bucket's program before the cluster actually crosses the
+    boundary (scheduler.py · _maybe_prewarm_growth) — the padded rows
+    are ordinary inert padding either way."""
     spec = host.spec
 
     queue_names = sorted(host.queues)
@@ -193,7 +199,11 @@ def pack_snapshot_full(
     pl_idx = {s: i for i, s in enumerate(podlabel_vocab)}
 
     T, J, N, Q = len(tasks), len(job_names), len(node_names), len(queue_names)
-    Tp, Jp, Np, Qp = bucket(T), bucket(J), bucket(N), bucket(Q)
+    mb = min_buckets or {}
+    Tp = bucket(max(T, mb.get("T", 0)))
+    Jp = bucket(max(J, mb.get("J", 0)))
+    Np = bucket(max(N, mb.get("N", 0)))
+    Qp = bucket(Q)
     L, V, P = bucket(len(label_vocab)), bucket(len(taint_vocab)), bucket(len(port_vocab))
     K = bucket(len(podlabel_vocab))
 
@@ -558,3 +568,59 @@ def pack_snapshot_full(
         pl_idx=pl_idx,
     )
     return snap, meta, internals
+
+
+# -- growth-prewarm aval synthesis -------------------------------------
+
+_DIM_AXES: dict[str, dict[int, str]] | None = None
+
+
+def snapshot_dim_axes() -> dict[str, dict[int, str]]:
+    """field → {axis index: dim name} for the primary dims T/J/N,
+    derived MECHANICALLY: pack one tiny world twice, the second time
+    with unique forced buckets per dim, and read which axes moved.  No
+    hand-maintained field table to rot as SnapshotTensors grows."""
+    global _DIM_AXES
+    if _DIM_AXES is None:
+        import dataclasses as _dc
+
+        from kube_batch_tpu.models.workloads import config1_gang_small
+
+        cache, _sim = config1_gang_small()
+        host = cache.snapshot()
+        probes = {"T": 1024, "J": 256, "N": 512}  # unique, > any tiny bucket
+        a, _, _ = pack_snapshot_full(host)
+        b, _, _ = pack_snapshot_full(host, min_buckets=probes)
+        rev = {bucket(v): k for k, v in probes.items()}
+        axes: dict[str, dict[int, str]] = {}
+        for f in _dc.fields(a):
+            sa = getattr(a, f.name).shape
+            sb = getattr(b, f.name).shape
+            for i, (da, db) in enumerate(zip(sa, sb)):
+                if da != db:
+                    axes.setdefault(f.name, {})[i] = rev[db]
+        _DIM_AXES = axes
+    return _DIM_AXES
+
+
+def grown_avals(snap: SnapshotTensors, grow: dict[str, int]):
+    """ShapeDtypeStruct pytree of `snap` with the dims named in `grow`
+    (values = minimum real counts) grown to their padding buckets —
+    a lock-free, data-free input for AOT-compiling the next bucket's
+    program (scheduler.py · _maybe_prewarm_growth).  Vocab dims are
+    left as-is: vocabulary growth still recompiles in-cycle."""
+    import dataclasses as _dc
+
+    import jax
+
+    axes = snapshot_dim_axes()
+    targets = {d: bucket(n) for d, n in grow.items()}
+    out = {}
+    for f in _dc.fields(snap):
+        arr = getattr(snap, f.name)
+        shape = list(arr.shape)
+        for i, d in axes.get(f.name, {}).items():
+            if d in targets:
+                shape[i] = targets[d]
+        out[f.name] = jax.ShapeDtypeStruct(tuple(shape), arr.dtype)
+    return type(snap)(**out)

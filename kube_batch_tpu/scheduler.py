@@ -90,6 +90,14 @@ class Scheduler:
         # cycles (the journal itself must stay intact for the next real
         # pack, so progress is tracked here, not by draining it).
         self._idle_refreshed_version = 0
+        # Growth prewarm: when a primary dim (tasks/jobs/nodes) nears
+        # its padding bucket, the NEXT bucket's program compiles on a
+        # background thread before the cluster crosses the boundary —
+        # otherwise the crossing cycle stalls on an in-cycle compile
+        # (measured as the dominant soak-tail spikes; bench-smoke shows
+        # 500x p50).  O(log cluster-size) firings over a cluster's life.
+        self._growth_thread: threading.Thread | None = None
+        self._growth_warmed: set[tuple] = set()
         # Opt-in compact D2H payload (see actions/fused.py ·
         # make_cycle_solver): changes the compiled program, so it must
         # not silently diverge a default daemon from the persistent
@@ -148,6 +156,10 @@ class Scheduler:
         # The old cycle's id() may be reused by the new callable —
         # stale shape keys would silently skip the explicit AOT step.
         self._compiled_shapes.clear()
+        # Growth-prewarm marks belong to the OLD policy's executables:
+        # keeping them would silently suppress re-warming a boundary
+        # the new policy has never compiled.
+        self._growth_warmed.clear()
         # Seed the prewarmed executable (if the warm produced one):
         # without this the first real cycle re-lowers and recompiles,
         # and only CLI/bench runs (persistent cache on) get it cheap.
@@ -305,6 +317,92 @@ class Scheduler:
             self._compiled_shapes[key] = exe
         return exe
 
+    #: A dim whose real count exceeds this fraction of its padding
+    #: bucket triggers the growth prewarm.
+    GROWTH_OCCUPANCY = 0.875
+
+    def _maybe_prewarm_growth(self, ssn: Session) -> None:
+        """Compile the next padding bucket's program in the background
+        when any primary dim nears its bucket, so the cycle that
+        actually crosses the boundary replays instead of stalling on
+        an in-cycle compile.
+
+        Lock-free and pack-free: the grown inputs are ShapeDtypeStruct
+        avals synthesized from the CURRENT immutable snapshot
+        (packer.grown_avals — AOT compilation needs shapes, not data),
+        so the warm never touches the cache or blocks a cycle.  When
+        several dims near their buckets together, every single-dim
+        variant AND the combined shape are warmed (sequentially, one
+        thread): the dims may cross in any order, and each miss is a
+        multi-second in-cycle stall."""
+        if self._cycle is None:
+            return
+        if self._growth_thread is not None and self._growth_thread.is_alive():
+            return
+        snap, meta = ssn.snap, ssn.meta
+        grow: dict[str, int] = {}
+        occupancy = self.GROWTH_OCCUPANCY
+        if meta.num_real_tasks > snap.num_tasks * occupancy:
+            grow["T"] = int(snap.num_tasks) + 1
+        if len(meta.job_names) > snap.num_jobs * occupancy:
+            grow["J"] = int(snap.num_jobs) + 1
+        if meta.num_real_nodes > snap.num_nodes * occupancy:
+            grow["N"] = int(snap.num_nodes) + 1
+        if not grow:
+            return
+        variants = [{d: n} for d, n in grow.items()]
+        if len(grow) > 1:
+            variants.append(dict(grow))
+        mark = tuple(sorted(grow.items()))
+        if mark in self._growth_warmed:
+            return
+        self._growth_warmed.add(mark)
+        cycle = self._cycle
+
+        def warm() -> None:
+            import jax
+
+            from kube_batch_tpu.cache.packer import grown_avals
+            from kube_batch_tpu.ops.assignment import init_state
+
+            ok = True
+            for g in variants:
+                try:
+                    gsnap = grown_avals(snap, g)
+                    key = self._shape_key(cycle, gsnap)
+                    if key in self._compiled_shapes:
+                        continue
+                    started = time.monotonic()
+                    exe = cycle.lower(
+                        gsnap, jax.eval_shape(init_state, gsnap)
+                    ).compile()
+                    # The conf may have hot-swapped mid-warm; only
+                    # publish into the policy this warm started under.
+                    if self._cycle is cycle:
+                        self._compiled_shapes[key] = exe
+                        logging.info(
+                            "growth prewarm: next bucket %s compiled "
+                            "in %.1fs", g, time.monotonic() - started,
+                        )
+                    else:
+                        logging.info(
+                            "growth prewarm: %s compiled but conf "
+                            "swapped mid-warm; discarded", g,
+                        )
+                        ok = False
+                except Exception:  # noqa: BLE001 — best-effort
+                    logging.exception("growth prewarm failed for %s", g)
+                    ok = False
+            if not ok:
+                # A failed/discarded warm must not poison this
+                # boundary: let a later cycle retry it.
+                self._growth_warmed.discard(mark)
+
+        self._growth_thread = threading.Thread(
+            target=warm, name="growth-prewarm", daemon=True
+        )
+        self._growth_thread.start()
+
     def _execute_fused(self, ssn: Session) -> None:
         """One device dispatch for the whole action pipeline, then commit
         evictions per action on the host (see actions/fused.py)."""
@@ -460,6 +558,7 @@ class Scheduler:
             self._idle_armed = True
             # The pack drained the journal; idle-refresh marks restart.
             self._idle_refreshed_version = 0
+            self._maybe_prewarm_growth(ssn)
         if ssn.bound or ssn.evicted:
             result = "scheduled"
         elif np.any(

@@ -311,3 +311,78 @@ def test_conf_arguments_validated_loudly():
         build_policy(parse_conf(
             "actions: allocate\narguments:\n  allocate.max_rounds: 0\n"
         ))
+
+
+def test_growth_prewarm_compiles_next_bucket():
+    """Nearing a padding-bucket boundary compiles the NEXT bucket's
+    program on a background thread, so the cycle that actually crosses
+    the boundary replays instead of stalling on an in-cycle compile
+    (the dominant soak-tail spike in bench-smoke)."""
+    import time
+
+    from kube_batch_tpu.cache.cluster import PodGroup
+    from kube_batch_tpu.models.workloads import DEFAULT_SPEC, GI, _node, _pod
+    from kube_batch_tpu.sim.simulator import make_world
+
+    cache, sim = make_world(DEFAULT_SPEC)
+    for i in range(4):
+        sim.add_node(_node(f"n{i}", cpu_milli=32000, mem=64 * GI))
+    # 8 tasks = a FULL T-bucket of 8 (occupancy 8/8 > 7/8).
+    sim.submit(
+        PodGroup(name="g0", queue="", min_member=1),
+        [_pod(f"g0-{i}", cpu=500, mem=GI) for i in range(8)],
+    )
+    s = Scheduler(cache, schedule_period=0.0)
+    ssn = s.run_once()
+    assert ssn is not None and ssn.snap.num_tasks == 8
+
+    assert s._growth_thread is not None, "growth prewarm did not fire"
+    s._growth_thread.join(120.0)
+    assert not s._growth_thread.is_alive()
+    # The T=16 bucket's program is compiled and cached.
+    grown = [
+        k for k in s._compiled_shapes
+        if dict(k[1:])["task_state"] == (16,)
+    ]
+    assert grown, list(s._compiled_shapes)
+
+    # Cross the boundary: the new shape must hit the prewarmed entry —
+    # run_once compiles nothing (fast) and places the new gang.
+    sim.submit(
+        PodGroup(name="g1", queue="", min_member=1),
+        [_pod(f"g1-{i}", cpu=500, mem=GI) for i in range(4)],
+    )
+    before = len(s._compiled_shapes)
+    t0 = time.perf_counter()
+    ssn2 = s.run_once()
+    took = time.perf_counter() - t0
+    assert ssn2.snap.num_tasks == 16
+    assert len(ssn2.bound) == 4
+    assert len(s._compiled_shapes) == before  # replay, no new compile
+    assert took < 5.0, f"boundary cycle stalled {took:.1f}s (compiled?)"
+
+
+def test_grown_avals_match_real_grown_pack():
+    """The growth prewarm compiles from SYNTHESIZED avals (no lock, no
+    pack); this pins their exactness: for every SnapshotTensors field,
+    grown_avals' shape and dtype equal a REAL pack of the same world
+    with the same forced buckets — a mismatch would make the prewarmed
+    executable a silent cache miss at the boundary."""
+    import dataclasses
+
+    from kube_batch_tpu.cache.packer import (
+        grown_avals,
+        pack_snapshot_full,
+    )
+    from kube_batch_tpu.models.workloads import build_config
+
+    cache, _sim = build_config(2)  # 100x20: exercises vocab fields too
+    host = cache.snapshot()
+    snap, _, _ = pack_snapshot_full(host)
+    grow = {"T": int(snap.num_tasks) + 1, "N": int(snap.num_nodes) + 1}
+    real, _, _ = pack_snapshot_full(host, min_buckets=grow)
+    synth = grown_avals(snap, grow)
+    for f in dataclasses.fields(snap):
+        r, s = getattr(real, f.name), getattr(synth, f.name)
+        assert r.shape == s.shape, (f.name, r.shape, s.shape)
+        assert r.dtype == s.dtype, (f.name, r.dtype, s.dtype)
