@@ -517,6 +517,10 @@ def _run_daemon_phases(jax, n, cache, sim, conf_path, steady_cycles) -> dict:
     from kube_batch_tpu.scheduler import Scheduler
 
     s = Scheduler(cache, conf_path=conf_path, schedule_period=0.0)
+    # The daemon phases drive run_once directly (cycle-by-cycle
+    # measurement), so arm the growth prewarm explicitly — production
+    # arms it in Scheduler.run().
+    s._growth_armed = True
 
     partial: dict = {"config": n, "partial": True}
 
@@ -634,6 +638,12 @@ def _run_daemon_phases(jax, n, cache, sim, conf_path, steady_cycles) -> dict:
         out["hotswap_2action"] = _run_hotswap(s, sim, one_cycle)
     else:
         out["hotswap_2action"] = {"skipped": "time budget exhausted"}
+    # A growth-prewarm compile racing interpreter teardown aborts the
+    # child and would be misread as a daemon failure (same discipline
+    # as Scheduler.run()'s loop exit).
+    s._growth_armed = False
+    if s._growth_thread is not None and s._growth_thread.is_alive():
+        s._growth_thread.join(60.0)
     return out
 
 

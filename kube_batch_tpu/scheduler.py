@@ -98,6 +98,18 @@ class Scheduler:
         # 500x p50).  O(log cluster-size) firings over a cluster's life.
         self._growth_thread: threading.Thread | None = None
         self._growth_warmed: set[tuple] = set()
+        # Armed by run() (the daemon loop) — a bare run_once() caller
+        # (tests, one-shot tools) must not spawn background compiles
+        # that outlive it: a compile thread alive at interpreter
+        # teardown aborts the process (XLA throws into a dying
+        # runtime), and incidental warms during short-lived runs are
+        # wasted work anyway.
+        self._growth_armed = False
+        # Shape keys a growth warm is currently compiling → Event set
+        # when done: a cycle that crosses the boundary mid-warm JOINS
+        # the in-flight compile instead of racing a duplicate (same
+        # wait, half the compile work, no tunnel contention).
+        self._growth_inflight: dict[tuple, threading.Event] = {}
         # Opt-in compact D2H payload (see actions/fused.py ·
         # make_cycle_solver): changes the compiled program, so it must
         # not silently diverge a default daemon from the persistent
@@ -307,6 +319,23 @@ class Scheduler:
         key = self._shape_key(self._cycle, snap)
         exe = self._compiled_shapes.get(key)
         if exe is None:
+            # A growth warm may already be compiling exactly this
+            # shape: join it instead of racing a duplicate compile
+            # (same wall-clock wait, half the compile work, and no
+            # second large in-flight compile on the tunnel).
+            inflight = self._growth_inflight.get(key)
+            if inflight is not None:
+                logging.info(
+                    "cycle shapes are mid-growth-prewarm; joining the "
+                    "in-flight compile"
+                )
+                inflight.wait()
+            # Re-check either way: the warm may have published between
+            # the first lookup and the inflight read (it pops the
+            # inflight entry AFTER publishing).
+            exe = self._compiled_shapes.get(key)
+            if exe is not None:
+                return exe
             started = time.monotonic()
             exe = self._cycle.lower(snap, state).compile()
             took = time.monotonic() - started
@@ -335,24 +364,37 @@ class Scheduler:
         variant AND the combined shape are warmed (sequentially, one
         thread): the dims may cross in any order, and each miss is a
         multi-second in-cycle stall."""
-        if self._cycle is None:
+        if not self._growth_armed or self._cycle is None:
             return
         if self._growth_thread is not None and self._growth_thread.is_alive():
             return
         snap, meta = ssn.snap, ssn.meta
         grow: dict[str, int] = {}
-        occupancy = self.GROWTH_OCCUPANCY
-        if meta.num_real_tasks > snap.num_tasks * occupancy:
+
+        def near(real: int, padded: int) -> bool:
+            # Trigger on remaining HEADROOM, with an absolute floor:
+            # a fractional threshold alone gives small buckets only a
+            # couple of cycles' warning (bucket 128 × 12.5% = 16 rows),
+            # which loses the race against a multi-second compile.
+            # Clamped to half the bucket so tiny worlds don't trigger
+            # permanently.
+            frac = padded - int(padded * self.GROWTH_OCCUPANCY)
+            headroom = min(max(frac, 64), max(padded // 2, 1))
+            return real > padded - headroom
+
+        if near(meta.num_real_tasks, int(snap.num_tasks)):
             grow["T"] = int(snap.num_tasks) + 1
-        if len(meta.job_names) > snap.num_jobs * occupancy:
+        if near(len(meta.job_names), int(snap.num_jobs)):
             grow["J"] = int(snap.num_jobs) + 1
-        if meta.num_real_nodes > snap.num_nodes * occupancy:
+        if near(meta.num_real_nodes, int(snap.num_nodes)):
             grow["N"] = int(snap.num_nodes) + 1
         if not grow:
             return
-        variants = [{d: n} for d, n in grow.items()]
-        if len(grow) > 1:
-            variants.append(dict(grow))
+        # Combined shape FIRST: when several dims near their buckets
+        # together they usually cross together, and a sequential warm
+        # must bank the most likely shape before any boundary lands.
+        variants = [dict(grow)] if len(grow) > 1 else []
+        variants += [{d: n} for d, n in grow.items()]
         mark = tuple(sorted(grow.items()))
         if mark in self._growth_warmed:
             return
@@ -367,11 +409,14 @@ class Scheduler:
 
             ok = True
             for g in variants:
+                done = None
                 try:
                     gsnap = grown_avals(snap, g)
                     key = self._shape_key(cycle, gsnap)
                     if key in self._compiled_shapes:
                         continue
+                    done = threading.Event()
+                    self._growth_inflight[key] = done
                     started = time.monotonic()
                     exe = cycle.lower(
                         gsnap, jax.eval_shape(init_state, gsnap)
@@ -393,6 +438,10 @@ class Scheduler:
                 except Exception:  # noqa: BLE001 — best-effort
                     logging.exception("growth prewarm failed for %s", g)
                     ok = False
+                finally:
+                    if done is not None:
+                        self._growth_inflight.pop(key, None)
+                        done.set()
             if not ok:
                 # A failed/discarded warm must not poison this
                 # boundary: let a later cycle retry it.
@@ -588,6 +637,27 @@ class Scheduler:
         kubelet/controllers play against the reference; the world
         advances regardless of scheduler hiccups).  Returns the number
         of cycles run."""
+        cycles = 0
+        self._growth_armed = True  # daemon mode: background warms on
+        try:
+            return self._run_loop(stop, max_cycles, on_cycle)
+        finally:
+            # Don't leave a compile thread racing interpreter teardown
+            # (an XLA call into a dying runtime aborts the process) —
+            # on EVERY exit path, including Ctrl-C in the inter-cycle
+            # sleep and an on_cycle() hook raising.  Bounded: a tunnel
+            # compile can take minutes, and shutdown must not.
+            self._growth_armed = False
+            t = self._growth_thread
+            if t is not None and t.is_alive():
+                t.join(30.0)
+                if t.is_alive():
+                    logging.warning(
+                        "growth prewarm still compiling at loop exit; "
+                        "leaving it to finish in the background"
+                    )
+
+    def _run_loop(self, stop, max_cycles, on_cycle) -> int:
         cycles = 0
         while (stop is None or not stop.is_set()) and (
             max_cycles is None or cycles < max_cycles

@@ -333,6 +333,7 @@ def test_growth_prewarm_compiles_next_bucket():
         [_pod(f"g0-{i}", cpu=500, mem=GI) for i in range(8)],
     )
     s = Scheduler(cache, schedule_period=0.0)
+    s._growth_armed = True  # run() arms this in production
     ssn = s.run_once()
     assert ssn is not None and ssn.snap.num_tasks == 8
 
@@ -360,6 +361,11 @@ def test_growth_prewarm_compiles_next_bucket():
     assert len(ssn2.bound) == 4
     assert len(s._compiled_shapes) == before  # replay, no new compile
     assert took < 5.0, f"boundary cycle stalled {took:.1f}s (compiled?)"
+    # The crossing cycle may itself fire the NEXT boundary's warm; a
+    # compile thread alive at interpreter teardown aborts the process.
+    s._growth_armed = False
+    if s._growth_thread is not None:
+        s._growth_thread.join(120.0)
 
 
 def test_grown_avals_match_real_grown_pack():
