@@ -24,6 +24,7 @@ from __future__ import annotations
 import argparse
 import fcntl
 import logging
+import os
 import sys
 
 import yaml
@@ -522,6 +523,19 @@ def main(argv: list[str] | None = None) -> int:
         level=logging.INFO,
         format="%(asctime)s %(levelname)s %(name)s: %(message)s",
     )
+    # Honor JAX_PLATFORMS even under site customizations that pin the
+    # platform at interpreter startup (e.g. a tunneled-device image):
+    # the env var alone loses there, and a wedged device tunnel then
+    # HANGS the daemon in backend init.  JAX_PLATFORMS=cpu must always
+    # give an operator a working CPU daemon.  Must run before first
+    # device use (same handling as kube_batch_tpu/warm.py).
+    if os.environ.get("JAX_PLATFORMS"):
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+        except Exception as exc:  # noqa: BLE001 — backend may be up already
+            logging.warning("could not honor JAX_PLATFORMS: %s", exc)
 
     from kube_batch_tpu.compile_cache import enable_compile_cache
 
