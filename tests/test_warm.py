@@ -1,0 +1,30 @@
+"""`make warm` (kube_batch_tpu/warm.py): pre-compiling every
+hot-swappable conf variant into the persistent XLA cache.
+
+The tool is the operational answer to the measured XLA:TPU compile
+cliff (scheduler.py · _ensure_compiled): after a warm, daemon conf
+hot-swaps replay in seconds.  This pins the tool's contract — every
+variant compiles, the cache directory is actually populated, and the
+subprocess isolation (one live compile per child) survives env
+plumbing — on CPU at the smallest shape.
+"""
+
+from __future__ import annotations
+
+import json
+
+
+def test_warm_tool_banks_all_variants(tmp_path, monkeypatch, capsys):
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setenv("KB_TPU_COMPILE_CACHE", str(tmp_path))
+    from kube_batch_tpu.warm import ACTION_VARIANTS, main
+
+    rc = main(["--shape-configs", "1", "--timeout", "240"])
+    assert rc == 0
+    summary = json.loads(
+        capsys.readouterr().out.strip().splitlines()[-1]
+    )
+    assert summary["failed"] == 0
+    assert summary["warmed"] == len(ACTION_VARIANTS)
+    # The persistent cache was actually written (the whole point).
+    assert any(tmp_path.iterdir()), "no cache entries banked"
