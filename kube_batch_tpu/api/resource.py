@@ -19,7 +19,7 @@ plain counts — matching the reference's MilliCPU/Memory convention.
 from __future__ import annotations
 
 import dataclasses
-from typing import Iterable, Mapping
+from typing import Mapping
 
 import numpy as np
 

@@ -25,7 +25,7 @@ import numpy as np
 from kube_batch_tpu import metrics
 from kube_batch_tpu.api.types import TaskStatus
 from kube_batch_tpu.cache.cache import SchedulerCache
-from kube_batch_tpu.cache.packer import SnapshotMeta, pack_snapshot
+from kube_batch_tpu.cache.packer import pack_snapshot
 from kube_batch_tpu.framework.conf import SchedulerConf
 from kube_batch_tpu.framework.plugin import Plugin, get_plugin_builder
 from kube_batch_tpu.framework.policy import TensorPolicy

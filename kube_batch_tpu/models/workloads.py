@@ -18,7 +18,7 @@ import random
 
 from kube_batch_tpu.api.resource import ResourceSpec
 from kube_batch_tpu.cache.cluster import Node, Pod, PodGroup, Queue
-from kube_batch_tpu.sim.simulator import SimulatedCluster, make_world
+from kube_batch_tpu.sim.simulator import make_world
 
 GI = float(1 << 30)
 
