@@ -53,6 +53,7 @@ import collections
 import logging
 import os
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -201,9 +202,15 @@ class IncrementalPacker:
             )
         if changed:
             try:
-                self._snap = self._snap.replace(
-                    **{f: jnp.asarray(a[f]) for f in changed}
-                )
+                # ONE batched H2D for every changed array: device_put
+                # on a pytree starts all copies before blocking, so the
+                # tunnel round trip is paid once per cycle, not once
+                # per field (the exact mirror of the fused cycle's
+                # batched device_get on the D2H side — a steady cycle
+                # touches ~10 task/job arrays, and per-array transfers
+                # made the upload a top steady-cycle term).
+                uploaded = jax.device_put({f: a[f] for f in changed})
+                self._snap = self._snap.replace(**uploaded)
             except Exception:
                 # Device upload failed (e.g. OOM): the host arrays are
                 # patched but the device buffers are stale — force the
