@@ -238,6 +238,19 @@ def serve(address: str = ":8080") -> threading.Thread:
 
     class Handler(http.server.BaseHTTPRequestHandler):
         def do_GET(self):  # noqa: N802 (stdlib API)
+            if self.path == "/healthz":
+                # Liveness for supervisors/load-balancers (the
+                # deployment runbook's systemd watchdog target): the
+                # listener thread answering at all is the health
+                # signal — scheduling liveness shows in the metrics
+                # (e2e latency, schedule attempts by result).
+                body = b"ok"
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
             if self.path != "/metrics":
                 self.send_error(404)
                 return

@@ -47,6 +47,10 @@ def test_metrics_http_endpoint():
             f"http://127.0.0.1:{port}/metrics", timeout=5
         ).read().decode()
         assert "kube_batch_schedule_attempts_total" in body
+        health = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=5
+        ).read().decode()
+        assert health == "ok"
     finally:
         thread.server.shutdown()
 
