@@ -14,6 +14,7 @@ to host once per cycle only for tasks that stayed Pending.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -117,13 +118,16 @@ def diagnose_pending(
     # tunneled backend at flagship shapes.  Only the per-action
     # fallback path (custom actions, small worlds) jits its own.
     if ssn._diag is not None:
-        counts = {k: np.asarray(v) for k, v in ssn._diag.items()}
+        # ONE batched D2H for all per-reason tallies: any cycle with a
+        # pending backlog pays this fetch, and per-array np.asarray
+        # reads cost a tunnel round trip EACH (~68 ms × ~8 reasons —
+        # a large unattributed host term on oversubscribed steady
+        # state).
+        counts = jax.device_get(dict(ssn._diag))
     else:
         policy = ssn.policy
         diag = getattr(policy, "_diagnose_jit", None)
         if diag is None:
-            import jax
-
             def full_mask(s, st):
                 m = policy.predicate_mask(s)
                 # immediate=True: diagnose against the same mask the
@@ -137,7 +141,7 @@ def diagnose_pending(
                 lambda s, st: failure_counts(s, st, full_mask(s, st))
             )
             policy._diagnose_jit = diag
-        counts = {k: np.asarray(v) for k, v in diag(snap, state).items()}
+        counts = jax.device_get(dict(diag(snap, state)))
     out: list[tuple[str, str, str]] = []
     for t in pending[:max_events]:
         pod = ssn.meta.task_pods[t]
