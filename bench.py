@@ -1023,9 +1023,18 @@ def main() -> None:
         import glob
 
         here = os.path.dirname(os.path.abspath(__file__))
-        for path in sorted(
+        # Session-captured artifacts (bench_runs/) FIRST: they are the
+        # newest real-TPU measurements of the CURRENT program — the
+        # post-measurement-integrity-fix full-policy headline — while
+        # older BENCH_r*.json headline figures measured a plugin-free
+        # program (BASELINE.md round-5 correction).
+        candidates = sorted(
+            glob.glob(os.path.join(here, "bench_runs", "session-*.json")),
+            reverse=True,
+        ) + sorted(
             glob.glob(os.path.join(here, "BENCH_r*.json")), reverse=True
-        ):
+        )
+        for path in candidates:
             try:
                 with open(path, encoding="utf-8") as f:
                     prior = json.load(f)
@@ -1035,7 +1044,7 @@ def main() -> None:
                     and parsed.get("value", 0) > 0
                 ):
                     result["last_tpu_verified"] = {
-                        "source": os.path.basename(path),
+                        "source": os.path.relpath(path, here),
                         "metric": parsed.get("metric"),
                         "value": parsed.get("value"),
                         "cycle_ms_median": parsed.get("cycle_ms_median"),
