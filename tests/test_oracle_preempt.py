@@ -66,9 +66,11 @@ _POLICY = None
 _SOLVERS: dict = {}
 
 
-def _kernel_outcome(cache, solver_factory):
-    """Run the jitted sweep; return (preemptors, victims_per_job,
-    snap, meta, final_state_np)."""
+def _solve(cache, solver_factory):
+    """Pack + solve `cache`'s world with the module-cached jitted
+    sweep; return (snap, meta, state0, out).  Shared with
+    test_preempt_properties so both suites provably solve the SAME
+    program."""
     import jax
 
     global _POLICY
@@ -81,6 +83,13 @@ def _kernel_outcome(cache, solver_factory):
     snap, meta = pack_snapshot(cache.snapshot())
     state0 = init_state(snap)
     out = solve(snap, state0)
+    return snap, meta, state0, out
+
+
+def _kernel_outcome(cache, solver_factory):
+    """Run the jitted sweep; return (preemptors, victims_per_job,
+    snap, meta, final_state_np)."""
+    snap, meta, state0, out = _solve(cache, solver_factory)
     init_np = np.asarray(state0.task_state)
     fin_np = np.asarray(out.task_state)
     Tn = meta.num_real_tasks
