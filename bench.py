@@ -520,7 +520,7 @@ def _run_daemon_phases(jax, n, cache, sim, conf_path, steady_cycles) -> dict:
     # The daemon phases drive run_once directly (cycle-by-cycle
     # measurement), so arm the growth prewarm explicitly — production
     # arms it in Scheduler.run().
-    s._growth_armed = True
+    s.arm_growth_prewarm()
 
     partial: dict = {"config": n, "partial": True}
 
@@ -641,9 +641,7 @@ def _run_daemon_phases(jax, n, cache, sim, conf_path, steady_cycles) -> dict:
     # A growth-prewarm compile racing interpreter teardown aborts the
     # child and would be misread as a daemon failure (same discipline
     # as Scheduler.run()'s loop exit).
-    s._growth_armed = False
-    if s._growth_thread is not None and s._growth_thread.is_alive():
-        s._growth_thread.join(60.0)
+    s.disarm_growth_prewarm(60.0)
     return out
 
 

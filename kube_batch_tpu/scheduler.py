@@ -97,7 +97,22 @@ class Scheduler:
         # (measured as the dominant soak-tail spikes; bench-smoke shows
         # 500x p50).  O(log cluster-size) firings over a cluster's life.
         self._growth_thread: threading.Thread | None = None
-        self._growth_warmed: set[tuple] = set()
+        # True while a worker thread is draining the queue; set/cleared
+        # under _growth_lock (is_alive() alone is racy: a worker that
+        # just observed an empty queue is still alive while returning).
+        self._growth_worker_running = False
+        # Pending warm shapes, most-imminent-first; refreshed from the
+        # current snapshot every cycle (see _maybe_prewarm_growth) and
+        # drained by a single worker thread.
+        self._growth_queue: list[tuple] = []
+        self._growth_lock = threading.Lock()
+        # Per-dim real-count history + EMA growth rate (rows/cycle),
+        # used to order the queue by predicted time-to-cross.
+        self._growth_prev: dict[str, int] = {}
+        self._growth_rate: dict[str, float] = {}
+        # Shape keys whose warm compile errored: deterministic, so
+        # never retried under this policy (cleared on conf swap).
+        self._growth_failed: set[tuple] = set()
         # Armed by run() (the daemon loop) — a bare run_once() caller
         # (tests, one-shot tools) must not spawn background compiles
         # that outlive it: a compile thread alive at interpreter
@@ -168,10 +183,13 @@ class Scheduler:
         # The old cycle's id() may be reused by the new callable —
         # stale shape keys would silently skip the explicit AOT step.
         self._compiled_shapes.clear()
-        # Growth-prewarm marks belong to the OLD policy's executables:
-        # keeping them would silently suppress re-warming a boundary
-        # the new policy has never compiled.
-        self._growth_warmed.clear()
+        # Growth-prewarm state belongs to the OLD policy's executables:
+        # keeping it would silently suppress re-warming a boundary the
+        # new policy has never compiled (queue entries also carry the
+        # old cycle identity, so the worker would discard them anyway).
+        with self._growth_lock:
+            self._growth_queue.clear()
+        self._growth_failed.clear()
         # Seed the prewarmed executable (if the warm produced one):
         # without this the first real cycle re-lowers and recompiles,
         # and only CLI/bench runs (persistent cache on) get it cheap.
@@ -322,28 +340,55 @@ class Scheduler:
             # A growth warm may already be compiling exactly this
             # shape: join it instead of racing a duplicate compile
             # (same wall-clock wait, half the compile work, and no
-            # second large in-flight compile on the tunnel).
-            inflight = self._growth_inflight.get(key)
+            # second large in-flight compile on the tunnel).  Claimed
+            # under the growth lock so the decision is atomic against
+            # the worker's pop: the key is either inflight (join it),
+            # queued (steal the entry and compile it inline — and
+            # register inflight so the per-cycle refresh can't requeue
+            # a duplicate behind our back), or unknown (same, minus
+            # the steal).
+            mine: threading.Event | None = None
+            with self._growth_lock:
+                # Re-check under the lock: the worker may have
+                # published between the top-of-function miss and here
+                # (it pops the inflight entry AFTER publishing).
+                exe = self._compiled_shapes.get(key)
+                if exe is not None:
+                    return exe
+                inflight = self._growth_inflight.get(key)
+                if inflight is None:
+                    self._growth_queue[:] = [
+                        e for e in self._growth_queue if e[0] != key
+                    ]
+                    mine = threading.Event()
+                    self._growth_inflight[key] = mine
             if inflight is not None:
                 logging.info(
                     "cycle shapes are mid-growth-prewarm; joining the "
                     "in-flight compile"
                 )
                 inflight.wait()
-            # Re-check either way: the warm may have published between
-            # the first lookup and the inflight read (it pops the
-            # inflight entry AFTER publishing).
-            exe = self._compiled_shapes.get(key)
-            if exe is not None:
-                return exe
-            started = time.monotonic()
-            exe = self._cycle.lower(snap, state).compile()
-            took = time.monotonic() - started
-            if took > 1.0:
-                logging.info(
-                    "fused cycle compiled for new shapes in %.1fs", took
-                )
-            self._compiled_shapes[key] = exe
+                # The warm may have failed; fall through to compile
+                # inline if it never published.
+                exe = self._compiled_shapes.get(key)
+                if exe is not None:
+                    return exe
+                with self._growth_lock:
+                    mine = threading.Event()
+                    self._growth_inflight[key] = mine
+            try:
+                started = time.monotonic()
+                exe = self._cycle.lower(snap, state).compile()
+                took = time.monotonic() - started
+                if took > 1.0:
+                    logging.info(
+                        "fused cycle compiled for new shapes in %.1fs",
+                        took,
+                    )
+                self._compiled_shapes[key] = exe
+            finally:
+                self._growth_inflight.pop(key, None)
+                mine.set()
         return exe
 
     #: A dim whose real count exceeds this fraction of its padding
@@ -359,17 +404,25 @@ class Scheduler:
         Lock-free and pack-free: the grown inputs are ShapeDtypeStruct
         avals synthesized from the CURRENT immutable snapshot
         (packer.grown_avals — AOT compilation needs shapes, not data),
-        so the warm never touches the cache or blocks a cycle.  When
-        several dims near their buckets together, every single-dim
-        variant AND the combined shape are warmed (sequentially, one
-        thread): the dims may cross in any order, and each miss is a
-        multi-second in-cycle stall."""
+        so the warm never touches the cache or blocks a cycle.
+
+        The work list is a QUEUE refreshed from the current snapshot
+        EVERY cycle, not a one-shot variant list: under staggered
+        crossings (J crosses this cycle, T two cycles later — the
+        normal light-churn case) the shape needed at the second
+        boundary is (T grown, J in its NEW bucket), which no variant
+        predicted from the pre-crossing snapshot can match.  Refreshing
+        per cycle supersedes stale pending shapes; only the compile
+        already in flight is beyond recall.  Queue order is most-
+        imminent-first using observed per-dim growth rates (EMA of
+        rows/cycle): a full-but-static dim (e.g. a node bucket at
+        exactly its boundary with no nodes joining) sorts last instead
+        of burning the warm window, and the combined all-dims shape
+        leads only when the two nearest dims are predicted to cross
+        within one cycle of each other."""
         if not self._growth_armed or self._cycle is None:
             return
-        if self._growth_thread is not None and self._growth_thread.is_alive():
-            return
         snap, meta = ssn.snap, ssn.meta
-        grow: dict[str, int] = {}
 
         def near(real: int, padded: int) -> bool:
             # Trigger on remaining HEADROOM, with an absolute floor:
@@ -382,75 +435,169 @@ class Scheduler:
             headroom = min(max(frac, 64), max(padded // 2, 1))
             return real > padded - headroom
 
-        if near(meta.num_real_tasks, int(snap.num_tasks)):
-            grow["T"] = int(snap.num_tasks) + 1
-        if near(len(meta.job_names), int(snap.num_jobs)):
-            grow["J"] = int(snap.num_jobs) + 1
-        if near(meta.num_real_nodes, int(snap.num_nodes)):
-            grow["N"] = int(snap.num_nodes) + 1
+        dims = {
+            "T": (meta.num_real_tasks, int(snap.num_tasks)),
+            "J": (len(meta.job_names), int(snap.num_jobs)),
+            "N": (meta.num_real_nodes, int(snap.num_nodes)),
+        }
+        # Per-dim growth rate (EMA rows/cycle) from consecutive real
+        # counts: predicts which boundary lands first.  Shrinking
+        # counts clamp to 0 (completions don't predict crossings).
+        for d, (real, _p) in dims.items():
+            prev = self._growth_prev.get(d)
+            if prev is not None:
+                delta = max(real - prev, 0)
+                old = self._growth_rate.get(d, float(delta))
+                self._growth_rate[d] = 0.5 * old + 0.5 * delta
+            self._growth_prev[d] = real
+
+        grow = {d: p + 1 for d, (r, p) in dims.items() if near(r, p)}
         if not grow:
+            with self._growth_lock:
+                self._growth_queue.clear()  # nothing imminent: drop stale
             return
-        # Combined shape FIRST: when several dims near their buckets
-        # together they usually cross together, and a sequential warm
-        # must bank the most likely shape before any boundary lands.
-        variants = [dict(grow)] if len(grow) > 1 else []
-        variants += [{d: n} for d, n in grow.items()]
-        mark = tuple(sorted(grow.items()))
-        if mark in self._growth_warmed:
-            return
-        self._growth_warmed.add(mark)
+
+        def crossing_cycle(d: str) -> float:
+            # First cycle whose real count EXCEEDS the bucket (a count
+            # of exactly `padded` still fits), at the observed rate.
+            real, padded = dims[d]
+            rate = self._growth_rate.get(d, 0.0)
+            if rate <= 0.0:
+                return float("inf")
+            import math
+
+            return math.ceil(max(padded + 1 - real, 0) / rate)
+
+        # Cluster near dims by PREDICTED crossing cycle (within one
+        # cycle of each other, docstring contract): dims landing
+        # together need their combined shape, and get it ahead of their
+        # singles; clearly staggered dims only ever need singles —
+        # after the first one crosses, the next cycle's refresh
+        # recomputes the later dim's variant from the post-crossing
+        # snapshot, which is the shape a from-stale-snapshot combined
+        # could never match.  Unknown-rate dims (cold start: no
+        # history yet) cluster together too, so the first armed cycle
+        # keeps the combined-first guarantee.  Known-static dims
+        # (rate 0 with history, e.g. a full node bucket with nobody
+        # joining) sort last instead of burning the warm window.
+        order = sorted(grow, key=crossing_cycle)
+        groups: list[list[str]] = []
+        for d in order:
+            when = crossing_cycle(d)
+            if groups:
+                prev = crossing_cycle(groups[-1][-1])
+                same = (when == prev) or (when - prev <= 1.0)
+                if same:
+                    groups[-1].append(d)
+                    continue
+            groups.append([d])
+        variants: list[dict[str, int]] = []
+        for ds in groups:
+            if len(ds) > 1:
+                variants.append({d: grow[d] for d in ds})
+            variants.extend({d: grow[d]} for d in ds)
+
+        from kube_batch_tpu.cache.packer import grown_avals
+
         cycle = self._cycle
+        staged = [
+            (self._shape_key(cycle, gsnap), gsnap, cycle, dict(g))
+            for g, gsnap in (
+                (g, grown_avals(snap, g)) for g in variants
+            )
+        ]
+        with self._growth_lock:
+            # Membership checks under the SAME lock as the queue swap:
+            # checked outside it, a key the worker pops (and registers
+            # inflight) mid-refresh could land in the new queue as a
+            # duplicate.
+            fresh = [
+                e for e in staged
+                if e[0] not in self._compiled_shapes
+                and e[0] not in self._growth_failed
+                and e[0] not in self._growth_inflight
+            ]
+            # Wholesale replace: pending entries predicted from older
+            # snapshots are stale the moment a boundary moved.
+            self._growth_queue[:] = fresh
+            if not fresh or self._growth_worker_running:
+                return
+            self._growth_worker_running = True
+            self._growth_thread = threading.Thread(
+                target=self._growth_worker, name="growth-prewarm",
+                daemon=True,
+            )
+            self._growth_thread.start()
 
-        def warm() -> None:
-            import jax
+    def _growth_worker(self) -> None:
+        """Drain the growth queue one compile at a time, re-reading the
+        queue after each (the per-cycle refresh may have replaced it)."""
+        try:
+            self._drain_growth_queue()
+        finally:
+            # Normal exit already cleared this under the lock (see the
+            # empty-queue branch); this is crash insurance so an
+            # unexpected escape can't wedge the flag True and suppress
+            # every future worker spawn.
+            with self._growth_lock:
+                self._growth_worker_running = False
 
-            from kube_batch_tpu.cache.packer import grown_avals
-            from kube_batch_tpu.ops.assignment import init_state
+    def _drain_growth_queue(self) -> None:
+        import jax
 
-            ok = True
-            for g in variants:
-                done = None
-                try:
-                    gsnap = grown_avals(snap, g)
-                    key = self._shape_key(cycle, gsnap)
-                    if key in self._compiled_shapes:
-                        continue
-                    done = threading.Event()
-                    self._growth_inflight[key] = done
-                    started = time.monotonic()
-                    exe = cycle.lower(
-                        gsnap, jax.eval_shape(init_state, gsnap)
-                    ).compile()
-                    # The conf may have hot-swapped mid-warm; only
-                    # publish into the policy this warm started under.
-                    if self._cycle is cycle:
-                        self._compiled_shapes[key] = exe
-                        logging.info(
-                            "growth prewarm: next bucket %s compiled "
-                            "in %.1fs", g, time.monotonic() - started,
-                        )
-                    else:
-                        logging.info(
-                            "growth prewarm: %s compiled but conf "
-                            "swapped mid-warm; discarded", g,
-                        )
-                        ok = False
-                except Exception:  # noqa: BLE001 — best-effort
-                    logging.exception("growth prewarm failed for %s", g)
-                    ok = False
-                finally:
-                    if done is not None:
-                        self._growth_inflight.pop(key, None)
-                        done.set()
-            if not ok:
-                # A failed/discarded warm must not poison this
-                # boundary: let a later cycle retry it.
-                self._growth_warmed.discard(mark)
+        from kube_batch_tpu.ops.assignment import init_state
 
-        self._growth_thread = threading.Thread(
-            target=warm, name="growth-prewarm", daemon=True
-        )
-        self._growth_thread.start()
+        while True:
+            with self._growth_lock:
+                if not self._growth_queue or not self._growth_armed:
+                    # Cleared under the lock BEFORE the thread winds
+                    # down: the refresh checks this flag (not
+                    # is_alive(), which stays True while a returning
+                    # thread tears down) to decide whether to spawn,
+                    # so fresh work can never be stranded behind a
+                    # dying worker.
+                    self._growth_worker_running = False
+                    return
+                key, gsnap, cycle, label = self._growth_queue.pop(0)
+                # Registered under the SAME lock as the pop: a crossing
+                # cycle's _ensure_compiled must see the key either
+                # queued or inflight, never in the gap between.
+                done = threading.Event()
+                self._growth_inflight[key] = done
+            if (
+                key in self._compiled_shapes
+                or key in self._growth_failed
+                or self._cycle is not cycle
+            ):
+                self._growth_inflight.pop(key, None)
+                done.set()
+                continue
+            try:
+                started = time.monotonic()
+                exe = cycle.lower(
+                    gsnap, jax.eval_shape(init_state, gsnap)
+                ).compile()
+                # The conf may have hot-swapped mid-warm; only publish
+                # into the policy this warm started under.
+                if self._cycle is cycle:
+                    self._compiled_shapes[key] = exe
+                    logging.info(
+                        "growth prewarm: next bucket %s compiled "
+                        "in %.1fs", label, time.monotonic() - started,
+                    )
+                else:
+                    logging.info(
+                        "growth prewarm: %s compiled but conf swapped "
+                        "mid-warm; discarded", label,
+                    )
+            except Exception:  # noqa: BLE001 — best-effort; a compile
+                # error is deterministic, so retrying it every cycle
+                # would spam the compile service (cleared on conf swap).
+                logging.exception("growth prewarm failed for %s", label)
+                self._growth_failed.add(key)
+            finally:
+                self._growth_inflight.pop(key, None)
+                done.set()
 
     def _execute_fused(self, ssn: Session) -> None:
         """One device dispatch for the whole action pipeline, then commit
@@ -638,24 +785,36 @@ class Scheduler:
         advances regardless of scheduler hiccups).  Returns the number
         of cycles run."""
         cycles = 0
-        self._growth_armed = True  # daemon mode: background warms on
+        self.arm_growth_prewarm()  # daemon mode: background warms on
         try:
             return self._run_loop(stop, max_cycles, on_cycle)
         finally:
-            # Don't leave a compile thread racing interpreter teardown
-            # (an XLA call into a dying runtime aborts the process) —
-            # on EVERY exit path, including Ctrl-C in the inter-cycle
-            # sleep and an on_cycle() hook raising.  Bounded: a tunnel
-            # compile can take minutes, and shutdown must not.
-            self._growth_armed = False
-            t = self._growth_thread
-            if t is not None and t.is_alive():
-                t.join(30.0)
-                if t.is_alive():
-                    logging.warning(
-                        "growth prewarm still compiling at loop exit; "
-                        "leaving it to finish in the background"
-                    )
+            self.disarm_growth_prewarm()
+
+    def arm_growth_prewarm(self) -> None:
+        """Enable background next-bucket compiles.  run() arms this
+        automatically; a run_once()-driving harness (bench daemon
+        phases) must arm it explicitly to measure the same machinery
+        the daemon runs — and MUST pair it with disarm_growth_prewarm()
+        before exit."""
+        self._growth_armed = True
+
+    def disarm_growth_prewarm(self, join_timeout: float = 30.0) -> None:
+        """Disarm and join any in-flight growth compile.  Don't leave a
+        compile thread racing interpreter teardown (an XLA call into a
+        dying runtime aborts the process) — on EVERY exit path,
+        including Ctrl-C in the inter-cycle sleep and an on_cycle()
+        hook raising.  Bounded: a tunnel compile can take minutes, and
+        shutdown must not."""
+        self._growth_armed = False
+        t = self._growth_thread
+        if t is not None and t.is_alive():
+            t.join(join_timeout)
+            if t.is_alive():
+                logging.warning(
+                    "growth prewarm still compiling at loop exit; "
+                    "leaving it to finish in the background"
+                )
 
     def _run_loop(self, stop, max_cycles, on_cycle) -> int:
         cycles = 0
