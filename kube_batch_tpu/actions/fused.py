@@ -87,8 +87,13 @@ def make_cycle_solver(
         # compile in-process, which the tunneled backend cannot survive
         # at flagship shapes (see bench.py's subprocess-isolation note;
         # an in-daemon second compile hangs the serving loop).  The
-        # extra reductions cost a few HBM passes inside an
-        # already-dispatched cycle.
+        # extra reductions cost little INSIDE this program (XLA shares
+        # the fit pass with the auction: bare-allocate 240 ms vs
+        # allocate+diag 257 ms idle-world) — and the active-set form
+        # (fit_errors.failure_counts_subset, shrinking the tallies to
+        # the gathered pending set) was measured to flip this
+        # program's XLA:TPU compile past 28+ minutes, so it is NOT
+        # wired here (BASELINE.md round-5 negative results #2).
         from kube_batch_tpu.framework.fit_errors import failure_counts
 
         mask = policy.predicate_mask(snap)

@@ -631,3 +631,30 @@ def grown_avals(snap: SnapshotTensors, grow: dict[str, int]):
                 shape[i] = targets[d]
         out[f.name] = jax.ShapeDtypeStruct(tuple(shape), arr.dtype)
     return type(snap)(**out)
+
+
+def gather_tasks(snap: SnapshotTensors, idx, valid):
+    """SnapshotTensors with every task-axis (T) field gathered to the
+    `idx` rows (i32[P]) — the active-set projection: ops that only need
+    a bounded subset of tasks (e.g. why-unschedulable diagnosis over
+    the pending set, fit_errors.failure_counts_subset) run at [P, N]
+    instead of [T, N].  `valid` (bool[P]) kills the fill rows of a
+    jnp.nonzero(..., size=P) gather via task_mask, so padded gather
+    slots can never act as real tasks.  The field→axis map is the same
+    mechanically-derived one the growth prewarm uses
+    (snapshot_dim_axes) — no hand-maintained list to rot.  Jit-safe:
+    pure takes, no data-dependent shapes."""
+    import dataclasses as _dc
+
+    import jax.numpy as jnp
+
+    axes = snapshot_dim_axes()
+    out = {}
+    for f in _dc.fields(snap):
+        arr = getattr(snap, f.name)
+        t_axes = [i for i, d in axes.get(f.name, {}).items() if d == "T"]
+        for i in t_axes:
+            arr = jnp.take(arr, idx, axis=i)
+        out[f.name] = arr
+    out["task_mask"] = out["task_mask"] & valid
+    return type(snap)(**out)

@@ -66,6 +66,76 @@ def failure_counts(
     }
 
 
+def failure_counts_subset(
+    snap: SnapshotTensors,
+    state: AllocState,
+    policy,
+    max_rows: int = 2048,
+) -> dict[str, jnp.ndarray]:
+    """failure_counts restricted to the (bounded) pending set, scattered
+    back to [T] — the active-set diagnosis.
+
+    Every [T, N] tally pass shrinks to [P, N] (P = min(max_rows, T)): at
+    flagship 65k×8k shapes the full-diagnosis term is a measured 83 ms
+    per cycle; the P=2048 projection is ~1/32 of that data.  Exactness:
+    only PENDING rows of the result are ever consumed
+    (diagnose_pending), jnp.nonzero gathers pending indices in
+    ascending order — the same order diagnose_pending walks — and its
+    event volume is capped at max_events=1000 < P, so every consumed
+    row is inside the gathered set.  Rows beyond P (backlogs deeper
+    than P pending) scatter back as zeros and are only ever summarized
+    by the "... and N more" tail line.  Dynamic predicates evaluate
+    through their subset seam (residents from the FULL state, candidate
+    rows from the gathered subset); a policy carrying a dynamic
+    predicate WITHOUT a subset variant must use plain failure_counts —
+    the fused cycle checks policy.has_subset_dynamic_predicates.
+
+    Purely data-flow (gather/compute/scatter, no lax.cond): shape-
+    preserving control flow is what trips the XLA:TPU compile cliff
+    (BASELINE.md round-5 negative result); gathers do not.
+    """
+    from kube_batch_tpu.cache.packer import gather_tasks
+
+    T = snap.num_tasks
+    P = min(max_rows, T)
+    pending = (
+        (state.task_state == int(TaskStatus.PENDING)) & snap.task_mask
+    )
+    n_pend = jnp.sum(pending)
+    idx = jnp.nonzero(pending, size=P, fill_value=0)[0]        # i32[P], asc
+    valid = jnp.arange(P) < n_pend
+    sub = gather_tasks(snap, idx, valid)
+    sub_state = state.replace(
+        task_state=state.task_state[idx],
+        task_node=state.task_node[idx],
+    )
+    if not policy.has_subset_dynamic_predicates:
+        # A registered dynamic predicate with no subset variant cannot
+        # be evaluated for the gathered rows — silently dropping it
+        # would report its vetoed nodes as "feasible".  Fall back to
+        # the exact full-[T, N] evaluation instead of mis-diagnosing.
+        mask = policy.predicate_mask(snap)
+        dyn = policy.dynamic_predicate_fn(snap, state, immediate=True)
+        return failure_counts(
+            snap, state, mask if dyn is None else mask & dyn
+        )
+    mask = policy.predicate_mask(sub)
+    dyn = policy.dynamic_predicate_subset_fn(
+        snap, state, sub, sub_state, immediate=True
+    )
+    counts = failure_counts(sub, sub_state, mask if dyn is None else mask & dyn)
+    vz = valid.astype(jnp.int32)
+    return {
+        "nodes": counts["nodes"],
+        "predicate_failed": jnp.zeros(T, jnp.int32)
+        .at[idx].max(counts["predicate_failed"] * vz),
+        "insufficient": jnp.zeros((T, snap.num_resources), jnp.int32)
+        .at[idx].max(counts["insufficient"] * vz[:, None]),
+        "feasible": jnp.zeros(T, jnp.int32)
+        .at[idx].max(counts["feasible"] * vz),
+    }
+
+
 def render_fit_error(
     task_name: str,
     counts: dict[str, np.ndarray],

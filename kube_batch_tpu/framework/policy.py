@@ -109,10 +109,13 @@ class TensorPolicy:
         # inter-pod affinity lives here, because feasibility depends on
         # placements made earlier in the same cycle (the reference gets
         # this for free from its serial per-task PredicateNodes calls).
-        # Each entry is (full_fn, row_fn|None); row_fn(snap, state, p)
-        # -> bool[N] lets the preemption kernel evaluate one task
-        # without materializing [T, N] every step.
-        self.dynamic_predicates: list[tuple[NodeScoreFn, object]] = []
+        # Each entry is (full_fn, row_fn|None, subset_fn|None):
+        # row_fn(snap, state, p) -> bool[N] lets the preemption kernel
+        # evaluate one task without materializing [T, N] every step;
+        # subset_fn(snap, state, sub_snap, sub_state, immediate) ->
+        # bool[P, N] evaluates a gathered task subset against
+        # full-cluster residents (see add_dynamic_predicate_fn).
+        self.dynamic_predicates: list[tuple[NodeScoreFn, object, object]] = []
         # bool[T] masks of tasks that must be accepted at most one per
         # auction round globally (affinity bootstrap claimants).
         self.global_serialize: list = []
@@ -165,8 +168,16 @@ class TensorPolicy:
     def add_predicate_fn(self, fn: PredicateFn) -> None:
         self.predicates.append(fn)
 
-    def add_dynamic_predicate_fn(self, fn: NodeScoreFn, row_fn=None) -> None:
-        self.dynamic_predicates.append((fn, row_fn))
+    def add_dynamic_predicate_fn(
+        self, fn: NodeScoreFn, row_fn=None, subset_fn=None
+    ) -> None:
+        """`subset_fn(snap, state, sub_snap, sub_state, immediate) ->
+        bool[P, N]`, when provided, evaluates the predicate for a
+        GATHERED task subset (packer.gather_tasks) while reading
+        residents/aggregates from the FULL snapshot+state — the
+        active-set seam that lets [T, N] passes shrink to [P, N]
+        without losing sight of placed tasks."""
+        self.dynamic_predicates.append((fn, row_fn, subset_fn))
 
     def add_global_serialize_fn(self, fn) -> None:
         self.global_serialize.append(fn)
@@ -257,9 +268,32 @@ class TensorPolicy:
         if not self.dynamic_predicates:
             return None
         m = jnp.ones((snap.num_tasks, snap.num_nodes), bool)
-        for fn, _row in self.dynamic_predicates:
+        for fn, _row, _sub in self.dynamic_predicates:
             m = m & fn(snap, state, immediate)
         return m
+
+    def dynamic_predicate_subset_fn(
+        self, snap, state, sub_snap, sub_state, immediate: bool = False
+    ):
+        """bool[P, N] AND of the dynamic predicates evaluated for a
+        gathered task subset against FULL-cluster residents, or None
+        when no dynamic predicates are registered OR any registered one
+        lacks a subset variant (the caller must then fall back to the
+        full [T, N] evaluation)."""
+        if not self.dynamic_predicates:
+            return None
+        if any(sub is None for _f, _r, sub in self.dynamic_predicates):
+            return None
+        m = jnp.ones((sub_snap.num_tasks, snap.num_nodes), bool)
+        for _fn, _row, sub in self.dynamic_predicates:
+            m = m & sub(snap, state, sub_snap, sub_state, immediate)
+        return m
+
+    @property
+    def has_subset_dynamic_predicates(self) -> bool:
+        """True when the subset path is available: either no dynamic
+        predicates at all, or every one carries a subset variant."""
+        return all(sub is not None for _f, _r, sub in self.dynamic_predicates)
 
     @property
     def dyn_predicate(self):
@@ -278,7 +312,7 @@ class TensorPolicy:
 
         def row(snap, state, p):
             m = jnp.ones(snap.num_nodes, bool)
-            for fn, row_fn in entries:
+            for fn, row_fn, _sub in entries:
                 m = m & (
                     row_fn(snap, state, p)
                     if row_fn is not None

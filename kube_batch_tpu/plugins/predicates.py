@@ -125,7 +125,9 @@ class PredicatesPlugin(Plugin):
 
         if self.args.get_bool("predicate.PodAffinityEnable", True):
             policy.add_dynamic_predicate_fn(
-                pod_affinity_predicate, row_fn=pod_affinity_row
+                pod_affinity_predicate,
+                row_fn=pod_affinity_row,
+                subset_fn=pod_affinity_subset,
             )
             policy.add_global_serialize_fn(bootstrap_mask)
             policy.add_domain_serialize_fn(topo_anti_participants)
@@ -235,6 +237,65 @@ def _topo_feasibility(snap, Hb, Hd, Ad_now, Hd_now):
     return aff_ok, (anti_hit <= 0.5) & (sym_hit <= 0.5)
 
 
+def _affinity_tables(snap, state, immediate: bool):
+    """Resident-side aggregates of the affinity predicate — node/domain
+    label tables computed from the FULL task set (segment sums over the
+    task axis, O(T·K), no [T, N] term).  Split out so the candidate
+    side can run on a gathered subset (pod_affinity_subset)."""
+    Hb, Ab = resident_podlabels(snap, state)
+    if immediate:
+        Hb_anti, Ab_anti = resident_podlabels(snap, state, include_releasing=True)
+    else:
+        Hb_anti, Ab_anti = Hb, Ab
+    t = {"Hb": Hb, "Ab": Ab, "Hb_anti": Hb_anti, "Ab_anti": Ab_anti}
+    if snap.task_aff_topo.shape[1]:  # static: topo terms exist
+        Hd, Ad = resident_domain_labels(snap, state)
+        if immediate:
+            Hd_now, Ad_now = resident_domain_labels(
+                snap, state, include_releasing=True
+            )
+        else:
+            Hd_now, Ad_now = Hd, Ad
+        t.update({"Hd": Hd, "Ad": Ad, "Hd_now": Hd_now, "Ad_now": Ad_now})
+    return t
+
+
+def _affinity_candidate_ok(cand, t):
+    """bool[Tc, N] feasibility of `cand`'s task rows against the
+    resident tables `t`.  `cand` may be the full snapshot or a
+    gathered subset — only its task-axis arrays are read on the
+    candidate side; node/vocab arrays are identical either way."""
+    Hb = t["Hb"]
+    Hf = Hb.astype(cand.task_aff.dtype)
+
+    need = jnp.sum(cand.task_aff, axis=1, keepdims=True)       # f32[T,1]
+    have = cand.task_aff @ Hf.T                                # f32[T,N]
+    term_exists = jnp.any(Hb, axis=0)                          # bool[K]
+    # Bootstrap waiver (k8s rule): a term NO pod in the cluster matches
+    # is waived for ANY task that itself carries the label.  The auction
+    # keeps this sound in a batched round by accepting at most ONE
+    # bootstrap-dependent placement per round (see bootstrap_mask below
+    # and ops/assignment.py's global-serialize step) — after it lands,
+    # the term exists and the rest must genuinely co-locate.
+    bootstrap = jnp.sum(
+        cand.task_aff * (cand.task_podlabels > 0) * (~term_exists)[None, :],
+        axis=1,
+        keepdims=True,
+    )                                                          # f32[T,1]
+    aff_ok = have + bootstrap >= need
+
+    anti_hit = cand.task_anti @ t["Hb_anti"].astype(Hf.dtype).T   # f32[T,N]
+    sym_hit = cand.task_podlabels @ t["Ab_anti"].astype(Hf.dtype).T
+    ok = aff_ok & (anti_hit <= 0.5) & (sym_hit <= 0.5)
+
+    if cand.task_aff_topo.shape[1]:  # static: topo terms exist
+        topo_aff_ok, topo_anti_ok = _topo_feasibility(
+            cand, Hb, t["Hd"], t["Ad_now"], t["Hd_now"]
+        )
+        ok = ok & topo_aff_ok & topo_anti_ok
+    return ok
+
+
 def pod_affinity_predicate(snap, state, immediate: bool = False):
     """bool[T, N] inter-pod affinity/anti-affinity feasibility
     (≙ the vendored k8s inter-pod affinity predicate in
@@ -252,46 +313,18 @@ def pod_affinity_predicate(snap, state, immediate: bool = False):
     pods may outlive the bind on the cluster.  Positive affinity stays
     future-oriented in both passes — a dying pod is no anchor.
     """
-    Hb, Ab = resident_podlabels(snap, state)
-    Hf = Hb.astype(snap.task_aff.dtype)
-    if immediate:
-        Hb_anti, Ab_anti = resident_podlabels(snap, state, include_releasing=True)
-    else:
-        Hb_anti, Ab_anti = Hb, Ab
+    return _affinity_candidate_ok(snap, _affinity_tables(snap, state, immediate))
 
-    need = jnp.sum(snap.task_aff, axis=1, keepdims=True)       # f32[T,1]
-    have = snap.task_aff @ Hf.T                                # f32[T,N]
-    term_exists = jnp.any(Hb, axis=0)                          # bool[K]
-    # Bootstrap waiver (k8s rule): a term NO pod in the cluster matches
-    # is waived for ANY task that itself carries the label.  The auction
-    # keeps this sound in a batched round by accepting at most ONE
-    # bootstrap-dependent placement per round (see bootstrap_mask below
-    # and ops/assignment.py's global-serialize step) — after it lands,
-    # the term exists and the rest must genuinely co-locate.
-    bootstrap = jnp.sum(
-        snap.task_aff * (snap.task_podlabels > 0) * (~term_exists)[None, :],
-        axis=1,
-        keepdims=True,
-    )                                                          # f32[T,1]
-    aff_ok = have + bootstrap >= need
 
-    anti_hit = snap.task_anti @ Hb_anti.astype(Hf.dtype).T     # f32[T,N]
-    sym_hit = snap.task_podlabels @ Ab_anti.astype(Hf.dtype).T  # f32[T,N]
-    ok = aff_ok & (anti_hit <= 0.5) & (sym_hit <= 0.5)
-
-    if snap.task_aff_topo.shape[1]:  # static: topo terms exist
-        Hd, Ad = resident_domain_labels(snap, state)
-        if immediate:
-            Hd_now, Ad_now = resident_domain_labels(
-                snap, state, include_releasing=True
-            )
-        else:
-            Hd_now, Ad_now = Hd, Ad
-        topo_aff_ok, topo_anti_ok = _topo_feasibility(
-            snap, Hb, Hd, Ad_now, Hd_now
-        )
-        ok = ok & topo_aff_ok & topo_anti_ok
-    return ok
+def pod_affinity_subset(snap, state, sub_snap, sub_state, immediate=False):
+    """Active-set variant: candidate rows from the gathered `sub_snap`,
+    residents from the FULL (snap, state) — exact, since residency is a
+    property of placed tasks, which are never in the pending subset.
+    (`sub_state` is unused: the candidate side is stateless.)"""
+    del sub_state
+    return _affinity_candidate_ok(
+        sub_snap, _affinity_tables(snap, state, immediate)
+    )
 
 
 def pod_affinity_row(snap, state, p):

@@ -1,0 +1,137 @@
+"""Active-set diagnosis equivalence: failure_counts_subset == full.
+
+The fused cycle's why-unschedulable tallies run over the gathered
+pending set ([P, N]) instead of all tasks ([T, N]) — an 83 ms/cycle
+term at flagship shapes.  These tests pin the projection exact on the
+rows diagnose_pending actually consumes: for every PENDING task inside
+the gathered window, the subset tallies equal the full ones, including
+dynamic inter-pod (anti-)affinity (residents read from the FULL state
+through the subset seam) and topology-scoped terms.
+
+Reference: pkg/scheduler/api/unschedule_info.go · FitErrors.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from tests.test_preempt_fuzz import _random_world
+from kube_batch_tpu.api.types import TaskStatus
+from kube_batch_tpu.cache.packer import pack_snapshot
+from kube_batch_tpu.framework.conf import default_conf
+from kube_batch_tpu.framework.fit_errors import (
+    failure_counts,
+    failure_counts_subset,
+)
+from kube_batch_tpu.framework.session import build_policy
+from kube_batch_tpu.ops.assignment import init_state
+
+PENDING = int(TaskStatus.PENDING)
+
+_POLICY = None
+
+
+def _policy():
+    global _POLICY
+    if _POLICY is None:
+        _POLICY, _ = build_policy(default_conf())
+    return _POLICY
+
+
+def _full_counts(snap, state, policy):
+    mask = policy.predicate_mask(snap)
+    dyn = policy.dynamic_predicate_fn(snap, state, immediate=True)
+    return failure_counts(snap, state, mask if dyn is None else mask & dyn)
+
+
+def _compare(cache, max_rows):
+    policy = _policy()
+    snap, meta = pack_snapshot(cache.snapshot())
+    state = init_state(snap)
+    full = {k: np.asarray(v) for k, v in _full_counts(snap, state, policy).items()}
+    sub = {
+        k: np.asarray(v)
+        for k, v in failure_counts_subset(
+            snap, state, policy, max_rows=max_rows
+        ).items()
+    }
+    assert int(sub["nodes"]) == int(full["nodes"])
+    pending = np.nonzero(
+        (np.asarray(snap.task_state) == PENDING) & np.asarray(snap.task_mask)
+    )[0]
+    covered = pending[: min(max_rows, snap.num_tasks)]
+    assert covered.size > 0, "vacuous world: nothing pending"
+    for key in ("predicate_failed", "feasible", "insufficient"):
+        np.testing.assert_array_equal(
+            sub[key][covered], full[key][covered], err_msg=key
+        )
+    # Rows outside the window (and non-pending rows) scatter as zeros.
+    outside = np.setdiff1d(np.arange(snap.num_tasks), covered)
+    assert (sub["predicate_failed"][outside] == 0).all()
+    return covered.size
+
+
+@pytest.mark.parametrize("seed", [0, 1, 3, 7, 11])
+def test_subset_matches_full_on_affinity_worlds(seed):
+    """Random runner+arrival worlds with node-level (anti-)affinity,
+    taints, selectors, PDBs — the fuzz generator's feature mix."""
+    cache, _sim = _random_world(seed, "preempt")
+    _compare(cache, max_rows=2048)
+
+
+def test_subset_truncation_window():
+    """A window smaller than the pending backlog still matches full on
+    the covered prefix (ascending order, same as diagnose_pending)."""
+    cache, _sim = _random_world(2, "preempt")
+    covered = _compare(cache, max_rows=2)
+    assert covered == 2
+
+
+def test_subset_matches_full_with_topology_terms():
+    """Zone-scoped affinity terms go through the same subset seam
+    (domain tables from the full state)."""
+    from tests.test_topology_pressure import _zone_world
+    from kube_batch_tpu.cache.cluster import Pod, PodGroup
+
+    cache, sim = _zone_world(n_zones=2, nodes_per_zone=2)
+    sim.submit(
+        PodGroup(name="db", queue="", min_member=1),
+        [Pod(name="db-0", request={"cpu": 500, "memory": 1 << 30, "pods": 1},
+             labels={"app": "db"})],
+    )
+    sim.submit(
+        PodGroup(name="web", queue="", min_member=2),
+        [Pod(name=f"web-{i}",
+             request={"cpu": 500, "memory": 1 << 30, "pods": 1},
+             labels={"app": "web"},
+             anti_affinity=frozenset({"zone:app=web"}))
+         for i in range(2)],
+    )
+    _compare(cache, max_rows=64)
+
+
+def test_subset_falls_back_without_subset_variant():
+    """A custom dynamic predicate registered WITHOUT a subset variant
+    must not be silently dropped: failure_counts_subset falls back to
+    the exact full-[T, N] evaluation."""
+    import jax.numpy as jnp
+
+    cache, _sim = _random_world(0, "preempt")
+    policy, _ = build_policy(default_conf())
+
+    def veto_node0(snap, state, immediate=False):
+        m = jnp.ones((snap.num_tasks, snap.num_nodes), bool)
+        return m.at[:, 0].set(False)
+
+    policy.add_dynamic_predicate_fn(veto_node0)  # no subset_fn
+    assert not policy.has_subset_dynamic_predicates
+    snap, _meta = pack_snapshot(cache.snapshot())
+    state = init_state(snap)
+    full = {k: np.asarray(v) for k, v in _full_counts(snap, state, policy).items()}
+    sub = {
+        k: np.asarray(v)
+        for k, v in failure_counts_subset(snap, state, policy).items()
+    }
+    for key in ("nodes", "predicate_failed", "feasible", "insufficient"):
+        np.testing.assert_array_equal(sub[key], full[key], err_msg=key)
