@@ -96,6 +96,18 @@ def failure_counts_subset(
     """
     from kube_batch_tpu.cache.packer import gather_tasks
 
+    if not policy.has_subset_dynamic_predicates:
+        # A registered dynamic predicate with no subset variant cannot
+        # be evaluated for the gathered rows — silently dropping it
+        # would report its vetoed nodes as "feasible".  Fall back to
+        # the exact full-[T, N] evaluation instead of mis-diagnosing
+        # (checked before any gather work, which the fallback discards).
+        mask = policy.predicate_mask(snap)
+        dyn = policy.dynamic_predicate_fn(snap, state, immediate=True)
+        return failure_counts(
+            snap, state, mask if dyn is None else mask & dyn
+        )
+
     T = snap.num_tasks
     P = min(max_rows, T)
     pending = (
@@ -109,16 +121,6 @@ def failure_counts_subset(
         task_state=state.task_state[idx],
         task_node=state.task_node[idx],
     )
-    if not policy.has_subset_dynamic_predicates:
-        # A registered dynamic predicate with no subset variant cannot
-        # be evaluated for the gathered rows — silently dropping it
-        # would report its vetoed nodes as "feasible".  Fall back to
-        # the exact full-[T, N] evaluation instead of mis-diagnosing.
-        mask = policy.predicate_mask(snap)
-        dyn = policy.dynamic_predicate_fn(snap, state, immediate=True)
-        return failure_counts(
-            snap, state, mask if dyn is None else mask & dyn
-        )
     mask = policy.predicate_mask(sub)
     dyn = policy.dynamic_predicate_subset_fn(
         snap, state, sub, sub_state, immediate=True

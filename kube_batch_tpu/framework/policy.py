@@ -282,7 +282,7 @@ class TensorPolicy:
         full [T, N] evaluation)."""
         if not self.dynamic_predicates:
             return None
-        if any(sub is None for _f, _r, sub in self.dynamic_predicates):
+        if not self.has_subset_dynamic_predicates:
             return None
         m = jnp.ones((sub_snap.num_tasks, snap.num_nodes), bool)
         for _fn, _row, sub in self.dynamic_predicates:
