@@ -86,9 +86,9 @@ def failure_counts_subset(
     than P pending) scatter back as zeros and are only ever summarized
     by the "... and N more" tail line.  Dynamic predicates evaluate
     through their subset seam (residents from the FULL state, candidate
-    rows from the gathered subset); a policy carrying a dynamic
-    predicate WITHOUT a subset variant must use plain failure_counts —
-    the fused cycle checks policy.has_subset_dynamic_predicates.
+    rows from the gathered subset); for a policy carrying a dynamic
+    predicate WITHOUT a subset variant this function falls back to the
+    exact full-[T, N] failure_counts internally.
 
     Purely data-flow (gather/compute/scatter, no lax.cond): shape-
     preserving control flow is what trips the XLA:TPU compile cliff

@@ -457,16 +457,18 @@ class Scheduler:
                 self._growth_queue.clear()  # nothing imminent: drop stale
             return
 
-        def crossing_cycle(d: str) -> float:
+        import math
+
+        def _crossing_cycle(d: str) -> float:
             # First cycle whose real count EXCEEDS the bucket (a count
             # of exactly `padded` still fits), at the observed rate.
             real, padded = dims[d]
             rate = self._growth_rate.get(d, 0.0)
             if rate <= 0.0:
                 return float("inf")
-            import math
-
             return math.ceil(max(padded + 1 - real, 0) / rate)
+
+        crossing = {d: _crossing_cycle(d) for d in grow}
 
         # Cluster near dims by PREDICTED crossing cycle (within one
         # cycle of each other, docstring contract): dims landing
@@ -480,12 +482,13 @@ class Scheduler:
         # keeps the combined-first guarantee.  Known-static dims
         # (rate 0 with history, e.g. a full node bucket with nobody
         # joining) sort last instead of burning the warm window.
-        order = sorted(grow, key=crossing_cycle)
+        order = sorted(grow, key=crossing.get)
         groups: list[list[str]] = []
         for d in order:
-            when = crossing_cycle(d)
+            when = crossing[d]
             if groups:
-                prev = crossing_cycle(groups[-1][-1])
+                prev = crossing[groups[-1][-1]]
+                # `==` catches the inf-vs-inf cluster (inf - inf is nan).
                 same = (when == prev) or (when - prev <= 1.0)
                 if same:
                     groups[-1].append(d)
@@ -500,12 +503,10 @@ class Scheduler:
         from kube_batch_tpu.cache.packer import grown_avals
 
         cycle = self._cycle
-        staged = [
-            (self._shape_key(cycle, gsnap), gsnap, cycle, dict(g))
-            for g, gsnap in (
-                (g, grown_avals(snap, g)) for g in variants
-            )
-        ]
+        staged = []
+        for g in variants:
+            gsnap = grown_avals(snap, g)
+            staged.append((self._shape_key(cycle, gsnap), gsnap, cycle, g))
         with self._growth_lock:
             # Membership checks under the SAME lock as the queue swap:
             # checked outside it, a key the worker pops (and registers
