@@ -450,3 +450,76 @@ def test_growth_prewarm_queue_ordering_and_refresh():
     finally:
         s._growth_worker_running = False
         s.disarm_growth_prewarm()
+
+
+def test_ensure_compiled_joins_inflight_growth_compile():
+    """A cycle whose shape key is mid-growth-prewarm must WAIT for that
+    compile and use its published executable — never race a duplicate
+    compile on the tunnel."""
+    import threading
+    import time as _time
+
+    from kube_batch_tpu.cache.cluster import PodGroup
+    from kube_batch_tpu.cache.packer import pack_snapshot
+    from kube_batch_tpu.models.workloads import DEFAULT_SPEC, GI, _node, _pod
+    from kube_batch_tpu.ops.assignment import init_state
+    from kube_batch_tpu.sim.simulator import make_world
+
+    cache, sim = make_world(DEFAULT_SPEC)
+    sim.add_node(_node("n0", cpu_milli=32000, mem=64 * GI))
+    sim.submit(
+        PodGroup(name="g0", queue="", min_member=1),
+        [_pod("g0-0", cpu=500, mem=GI)],
+    )
+    s = Scheduler(cache, schedule_period=0.0)
+    s._reload_conf()
+    snap, _meta = pack_snapshot(cache.snapshot())
+    state = init_state(snap)
+    key = Scheduler._shape_key(s._cycle, snap)
+
+    sentinel = object()  # stands in for the warm's executable
+    done = threading.Event()
+    s._growth_inflight[key] = done
+
+    def publish():
+        _time.sleep(0.2)
+        s._compiled_shapes[key] = sentinel
+        s._growth_inflight.pop(key, None)
+        done.set()
+
+    t = threading.Thread(target=publish)
+    t.start()
+    exe = s._ensure_compiled(snap, state)
+    t.join()
+    assert exe is sentinel, "did not join the in-flight warm's result"
+
+
+def test_ensure_compiled_steals_queued_growth_entry():
+    """A cycle whose shape key is QUEUED (but not yet in flight) must
+    claim the entry — remove it from the queue and register in-flight —
+    so the worker and the per-cycle refresh can never produce a
+    duplicate compile of the same program."""
+    from kube_batch_tpu.cache.cluster import PodGroup
+    from kube_batch_tpu.cache.packer import pack_snapshot
+    from kube_batch_tpu.models.workloads import DEFAULT_SPEC, GI, _node, _pod
+    from kube_batch_tpu.ops.assignment import init_state
+    from kube_batch_tpu.sim.simulator import make_world
+
+    cache, sim = make_world(DEFAULT_SPEC)
+    sim.add_node(_node("n0", cpu_milli=32000, mem=64 * GI))
+    sim.submit(
+        PodGroup(name="g0", queue="", min_member=1),
+        [_pod("g0-0", cpu=500, mem=GI)],
+    )
+    s = Scheduler(cache, schedule_period=0.0)
+    s._reload_conf()
+    snap, _meta = pack_snapshot(cache.snapshot())
+    state = init_state(snap)
+    key = Scheduler._shape_key(s._cycle, snap)
+    s._growth_queue.append((key, snap, s._cycle, {"T": 1}))
+
+    exe = s._ensure_compiled(snap, state)
+    assert exe is not None
+    assert all(e[0] != key for e in s._growth_queue), "entry not stolen"
+    assert key not in s._growth_inflight, "in-flight claim not released"
+    assert s._compiled_shapes.get(key) is exe
