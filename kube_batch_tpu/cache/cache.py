@@ -22,8 +22,10 @@ import collections
 import copy
 import dataclasses
 import threading
+import time
 import weakref
 
+from kube_batch_tpu import metrics
 from kube_batch_tpu.api.resource import ResourceSpec
 from kube_batch_tpu.api.types import TaskStatus
 from kube_batch_tpu.cache.backend import (
@@ -168,6 +170,11 @@ class SchedulerCache:
         # O(1) status census for the idle early-out: pods per TaskStatus,
         # maintained by every mutator below.
         self._status_counts: collections.Counter = collections.Counter()
+        # Pending-pod arrival stamps (monotonic) → the per-task
+        # scheduling-latency histogram at bind (≙ metrics.go ·
+        # TaskSchedulingLatency).  Only pods that arrive PENDING count:
+        # a pod ingested already running was scheduled by someone else.
+        self._arrival_ts: dict[str, float] = {}
         # True between begin_resync() and end_resync(): the mirror is a
         # half-replayed LIST and must not be scheduled against (see
         # snapshot()'s guard).
@@ -304,6 +311,8 @@ class SchedulerCache:
             self._pods[pod.uid] = pod
             self._mark_dynamic_pdbs(pod)
             self._status_counts[pod.status] += 1
+            if pod.status == TaskStatus.PENDING:
+                self._arrival_ts[pod.uid] = time.monotonic()
             if pod.group is not None:
                 job = self._jobs.get(pod.group)
                 if job is None:
@@ -327,6 +336,7 @@ class SchedulerCache:
             pod = self._pods.pop(pod_uid, None)
             if pod is None:
                 return
+            self._arrival_ts.pop(pod_uid, None)
             self._mark_dynamic_pdbs(pod)
             self._status_counts[pod.status] -= 1
             if pod.group is not None and pod.group in self._jobs:
@@ -356,6 +366,11 @@ class SchedulerCache:
                 pod.node = node
             if status == TaskStatus.PENDING:
                 pod.node = None
+                # A pod re-entering PENDING (node vanished under it,
+                # eviction rollback) starts a FRESH latency clock;
+                # setdefault keeps the ORIGINAL arrival for failed-bind
+                # retries, whose stamp was never consumed.
+                self._arrival_ts.setdefault(pod_uid, time.monotonic())
             if pod.node is not None:
                 if pod.node in self._nodes:
                     self._nodes[pod.node].add_task(pod)
@@ -408,6 +423,9 @@ class SchedulerCache:
                     self._status_counts[pod.status] -= 1
                     self._status_counts[TaskStatus.PENDING] += 1
                     pod.status = TaskStatus.PENDING
+                    # Fresh scheduling-latency clock for the rebind
+                    # (same rule as update_pod_status -> PENDING).
+                    self._arrival_ts.setdefault(pod.uid, time.monotonic())
                 self._mark_full("node-deleted")
 
     def add_pod_group(self, group: PodGroup) -> None:
@@ -632,6 +650,9 @@ class SchedulerCache:
             return False
         with self._lock:
             self.update_pod_status(pod_uid, TaskStatus.BOUND)
+            ts = self._arrival_ts.pop(pod_uid, None)
+        if ts is not None:
+            metrics.task_scheduling_latency.observe(time.monotonic() - ts)
         self.record_event("Pod", pod.name, "Bound", f"bound -> {node_name}",
                           namespace=pod.namespace)
         return True
@@ -715,6 +736,7 @@ class SchedulerCache:
             self._pdbs.clear()
             self._resync.clear()
             self._status_counts.clear()
+            self._arrival_ts.clear()
             self._mark_full("relist")
             self.add_queue(Queue(name=self.default_queue, weight=1.0))
 

@@ -206,6 +206,11 @@ preemption_victims = REGISTRY.register(Counter(
     "preemption_victims_total",
     "Victim tasks transitioned to Releasing by preempt/reclaim.",
 ))
+task_scheduling_latency = REGISTRY.register(Histogram(
+    "task_scheduling_latency_seconds",
+    "Per-task latency from Pending arrival in the cache to its "
+    "successful bind dispatch (≙ metrics.go · TaskSchedulingLatency).",
+))
 snapshot_pack_latency = REGISTRY.register(Histogram(
     "snapshot_pack_latency_seconds",
     "HostSnapshot to device-tensor packing latency (H2D boundary).",

@@ -215,3 +215,63 @@ def test_structured_events_and_typed_conditions():
     assert conds and isinstance(conds[0], PodGroupCondition)
     assert conds[0].type == "Unschedulable"
     assert "minMember 3" in conds[0]
+
+
+def test_task_scheduling_latency_observed_on_bind():
+    """Per-task arrival→bind latency lands in the histogram (≙
+    metrics.go · TaskSchedulingLatency): observed once per successful
+    bind of a pod that arrived Pending, cleaned up on delete."""
+    from kube_batch_tpu import metrics
+    from kube_batch_tpu.api.resource import ResourceSpec
+    from kube_batch_tpu.cache.cluster import Node, Pod, PodGroup
+    from kube_batch_tpu.sim.simulator import make_world
+
+    cache, sim = make_world(ResourceSpec(("cpu", "memory", "pods")))
+    sim.add_node(Node(name="n0",
+                      allocatable={"cpu": 4000, "memory": 8 << 30,
+                                   "pods": 10}))
+    sim.submit(
+        PodGroup(name="g", queue="", min_member=1),
+        [Pod(name="p0", request={"cpu": 500, "memory": 1 << 30,
+                                 "pods": 1})],
+    )
+    before = metrics.task_scheduling_latency.count()
+    uid = next(iter(cache.snapshot().jobs["g"].tasks))
+    assert cache.bind(uid, "n0")
+    assert metrics.task_scheduling_latency.count() == before + 1
+    assert uid not in cache._arrival_ts
+    # A second bind of the same (already-stamped-consumed) pod must not
+    # double-observe.
+    cache.bind(uid, "n0")
+    assert metrics.task_scheduling_latency.count() == before + 1
+
+
+def test_task_latency_restamps_on_repending_and_clears_on_relist():
+    """A pod re-entering PENDING (node vanished under it) gets a FRESH
+    latency clock and its rebind is observed; a relist clear() drops
+    all stamps (stateless recovery holds)."""
+    from kube_batch_tpu import metrics
+    from kube_batch_tpu.api.resource import ResourceSpec
+    from kube_batch_tpu.cache.cluster import Node, Pod, PodGroup
+    from kube_batch_tpu.sim.simulator import make_world
+
+    cache, sim = make_world(ResourceSpec(("cpu", "memory", "pods")))
+    for n in ("n0", "n1"):
+        sim.add_node(Node(name=n, allocatable={"cpu": 4000,
+                                               "memory": 8 << 30,
+                                               "pods": 10}))
+    sim.submit(
+        PodGroup(name="g", queue="", min_member=1),
+        [Pod(name="p0", request={"cpu": 500, "memory": 1 << 30,
+                                 "pods": 1})],
+    )
+    uid = next(iter(cache.snapshot().jobs["g"].tasks))
+    assert cache.bind(uid, "n0")
+    before = metrics.task_scheduling_latency.count()
+    cache.delete_node("n0")          # pod falls back to Pending
+    assert uid in cache._arrival_ts, "re-pending did not restamp"
+    assert cache.bind(uid, "n1")
+    assert metrics.task_scheduling_latency.count() == before + 1
+
+    cache.clear()
+    assert not cache._arrival_ts, "relist left stale stamps"
