@@ -95,15 +95,37 @@ def pack_snapshot(host: HostSnapshot) -> tuple[SnapshotTensors, SnapshotMeta]:
     return snap, meta
 
 
+def pack_snapshot_host(
+    host: HostSnapshot,
+) -> tuple[SnapshotTensors, SnapshotMeta]:
+    """pack_snapshot WITHOUT the device transfer: the SnapshotTensors
+    fields stay numpy.  For callers that must not touch the device —
+    the driver's `__graft_entry__.entry()` builds example args with
+    this so a wedged device tunnel (which HANGS backend init, see
+    BASELINE.md outage logs) can never hang inside entry(); jit accepts
+    numpy arguments and pays the transfer at call time, under the
+    caller's own timeout control."""
+    snap, meta, _ = pack_snapshot_full(host, device=False)
+    return snap, meta
+
+
 def pack_snapshot_full(
     host: HostSnapshot,
     min_buckets: dict[str, int] | None = None,
+    device: bool = True,
 ) -> tuple[SnapshotTensors, SnapshotMeta, PackInternals]:
     """`min_buckets` forces minimum padded sizes for the primary dims
     ("T"/"J"/"N"), used by the scheduler's growth prewarm to compile
     the NEXT bucket's program before the cluster actually crosses the
     boundary (scheduler.py · _maybe_prewarm_growth) — the padded rows
-    are ordinary inert padding either way."""
+    are ordinary inert padding either way.
+
+    `device=False` skips the final device_put and returns numpy-backed
+    SnapshotTensors — CAUTION: those fields then ALIAS the returned
+    PackInternals.arrays dict (the incremental packer patches such
+    arrays in place), so a device=False caller must treat the
+    internals as consumed; the device path gets fresh device buffers
+    and has no such coupling."""
     spec = host.spec
 
     queue_names = sorted(host.queues)
@@ -544,10 +566,14 @@ def pack_snapshot_full(
     # pytree starts every copy before blocking, so the tunneled
     # backend's round trip is paid once per pack, not once per field
     # (~40 arrays; same batching as the incremental path's changed-set
-    # upload and the fused cycle's device_get).
-    import jax
+    # upload and the fused cycle's device_get).  `device=False` keeps
+    # the fields numpy for device-free callers (pack_snapshot_host).
+    if device:
+        import jax
 
-    snap = SnapshotTensors(**jax.device_put(arrays))
+        snap = SnapshotTensors(**jax.device_put(arrays))
+    else:
+        snap = SnapshotTensors(**arrays)
     meta = SnapshotMeta(
         spec=spec,
         task_uids=tuple(p.uid for p in tasks),
