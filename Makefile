@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: test unit-test e2e bench run-example verify warm clean
+.PHONY: test unit-test e2e bench run-example verify warm chaos clean
 
 test: unit-test
 
@@ -36,6 +36,15 @@ run-example:
 	    --scheduler-conf examples/scheduler.conf \
 	    --cycles 3 --schedule-period 0 --listen-address ""
 
+# Chaos smoke: the scenario engine drives the REAL scheduler through
+# the wire stack for 200 seeded ticks with stream drops, 410 watch
+# gaps, cursed binds, node vanishes and lease steals enabled, checking
+# invariants (no double-bind, gang gate, capacity, eviction accounting,
+# convergence) after every tick.  Exit 1 + a flight-recorder dump on
+# any violation.  Long soaks live in tests/ behind the `slow` marker.
+chaos:
+	JAX_PLATFORMS=cpu $(PY) -m kube_batch_tpu.chaos --seed 7 --ticks 200
+
 profile:
 	$(PY) -m kube_batch_tpu --workload 2 --cycles 3 --schedule-period 0 \
 	    --listen-address "" --profile-dir /tmp/kube-batch-tpu-trace
@@ -46,6 +55,7 @@ verify:
 	$(PY) -c "import __graft_entry__ as g; g.entry()"
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 	    $(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+	$(MAKE) chaos
 
 clean:
 	find . -name __pycache__ -type d -exec rm -rf {} +

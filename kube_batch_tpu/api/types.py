@@ -59,7 +59,15 @@ def allocated_status(status: TaskStatus) -> bool:
     return status in ALLOCATED_STATUSES
 
 
-class PodGroupPhase(enum.StrEnum):
+if hasattr(enum, "StrEnum"):
+    _StrEnum = enum.StrEnum
+else:  # Python 3.10 (the floor pyproject declares): same semantics
+    class _StrEnum(str, enum.Enum):
+        def __str__(self) -> str:
+            return str(self.value)
+
+
+class PodGroupPhase(_StrEnum):
     """Phase of a job/pod-group (reference: v1alpha1 · PodGroupPhase)."""
 
     PENDING = "Pending"

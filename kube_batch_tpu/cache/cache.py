@@ -359,7 +359,8 @@ class SchedulerCache:
             if pod.node is not None and pod.node in self._nodes:
                 self._nodes[pod.node].remove_task(pod)
             self._mark_node(pod.node)
-            self._status_counts[pod.status] -= 1
+            prior = pod.status
+            self._status_counts[prior] -= 1
             self._status_counts[status] += 1
             pod.status = status
             if node is not None:
@@ -371,6 +372,24 @@ class SchedulerCache:
                 # setdefault keeps the ORIGINAL arrival for failed-bind
                 # retries, whose stamp was never consumed.
                 self._arrival_ts.setdefault(pod_uid, time.monotonic())
+            elif (status != TaskStatus.BINDING
+                  and prior != TaskStatus.BINDING):
+                # Any other transition OUT of PENDING consumes the
+                # stamp: a pod flipped to RUNNING by an EXTERNAL status
+                # update was scheduled by someone else, and keeping its
+                # arrival would (a) leak the stamp until pod removal
+                # and (b) inflate a later bind's latency with the time
+                # it spent RUNNING if it re-enters PENDING (the
+                # setdefault above would then keep the stale arrival).
+                # BINDING — as either endpoint — is exempt: an in-flight
+                # bind still owns the stamp.  bind() consumes it on
+                # success, a failed bind's rollback to PENDING must keep
+                # the original arrival clock, and a wire backend echoes
+                # the scheduler's OWN bind back as a BINDING→BOUND/
+                # RUNNING watch event that can race the bind thread —
+                # popping here would silently drop that pod's latency
+                # observation.
+                self._arrival_ts.pop(pod_uid, None)
             if pod.node is not None:
                 if pod.node in self._nodes:
                     self._nodes[pod.node].add_task(pod)
@@ -649,8 +668,12 @@ class SchedulerCache:
                               namespace=pod.namespace)
             return False
         with self._lock:
-            self.update_pod_status(pod_uid, TaskStatus.BOUND)
+            # The successful bind consumes the stamp.  update_pod_status
+            # leaves stamps of BINDING pods alone (a wire backend's watch
+            # echo of this very bind races us here), so the stamp is
+            # still present however the echo interleaved.
             ts = self._arrival_ts.pop(pod_uid, None)
+            self.update_pod_status(pod_uid, TaskStatus.BOUND)
         if ts is not None:
             metrics.task_scheduling_latency.observe(time.monotonic() - ts)
         self.record_event("Pod", pod.name, "Bound", f"bound -> {node_name}",

@@ -1,0 +1,49 @@
+"""Chaos scenario engine: deterministic fault-injecting simulation.
+
+Drives the REAL scheduler through its production wire stack
+(StreamBackend/WatchAdapter against an instrumented ExternalCluster)
+under seeded workload churn and injected failures, checking scheduling
+invariants after every tick and dumping a flight recorder on failure.
+
+    python -m kube_batch_tpu.chaos --seed 7 --ticks 200
+
+See doc/design/chaos-engine.md for the event model, fault taxonomy,
+invariants and the flight-recorder format.
+"""
+
+from kube_batch_tpu.chaos.engine import (
+    ChaosEngine,
+    ChaosEngineError,
+    ChaosResult,
+    FlightRecorder,
+)
+from kube_batch_tpu.chaos.faults import ChaosCluster, FaultSpec, plan_faults
+from kube_batch_tpu.chaos.invariants import InvariantChecker, Violation
+from kube_batch_tpu.chaos.workload import (
+    ScenarioSpec,
+    apply_to_cluster,
+    apply_to_sim,
+    generate,
+    read_trace,
+    trace_hash,
+    write_trace,
+)
+
+__all__ = [
+    "ChaosEngine",
+    "ChaosEngineError",
+    "ChaosResult",
+    "ChaosCluster",
+    "FaultSpec",
+    "FlightRecorder",
+    "InvariantChecker",
+    "ScenarioSpec",
+    "Violation",
+    "apply_to_cluster",
+    "apply_to_sim",
+    "generate",
+    "plan_faults",
+    "read_trace",
+    "trace_hash",
+    "write_trace",
+]

@@ -1,0 +1,161 @@
+"""`python -m kube_batch_tpu.chaos` — the chaos scenario CLI.
+
+Exit codes: 0 = scenario completed with zero invariant violations and
+converged; 1 = an invariant failed (the flight-recorder dump path is
+printed); 2 = the harness itself broke (dead wire, quiesce timeout).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import logging
+import sys
+
+from kube_batch_tpu.chaos.engine import ChaosEngine, ChaosEngineError
+from kube_batch_tpu.chaos.faults import FaultSpec
+from kube_batch_tpu.chaos.workload import ScenarioSpec, read_trace
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m kube_batch_tpu.chaos",
+        description="Deterministic fault-injecting cluster simulation "
+                    "driving the real scheduler over its wire protocol, "
+                    "with per-tick invariant checking and a flight "
+                    "recorder.",
+    )
+    p.add_argument("--seed", type=int, default=None,
+                   help="scenario seed: same seed ⇒ identical trace "
+                        "hash and final assignment (default: the seed "
+                        "recorded in a replayed trace's meta header, "
+                        "else 0)")
+    p.add_argument("--ticks", type=int, default=200,
+                   help="scenario horizon in discrete ticks")
+    p.add_argument("--scenario", default=None,
+                   help="a recorded .jsonl trace to replay, or a JSON "
+                        "file of {workload: {...}, faults: {...}} "
+                        "spec overrides (default: built-in spec)")
+    p.add_argument("--scheduler-conf", default=None,
+                   help="policy YAML for the driven scheduler "
+                        "(default: the built-in default policy)")
+    p.add_argument("--no-faults", action="store_true",
+                   help="run the workload churn with fault injection "
+                        "disabled (baseline determinism runs); on a "
+                        "replayed trace this also strips the recorded "
+                        "inline fault events")
+    p.add_argument("--drain", type=int, default=80,
+                   help="post-scenario ticks every admissible gang "
+                        "must converge within")
+    p.add_argument("--record", type=int, default=64,
+                   help="flight-recorder depth: last K ticks kept for "
+                        "the post-mortem dump")
+    p.add_argument("--trace-out", default=None,
+                   help="write the scenario's replayable JSONL trace "
+                        "(workload + fault plan) to this path")
+    p.add_argument("--dump-dir", default=None,
+                   help="directory for flight-recorder dumps "
+                        "(default: the system temp dir)")
+    p.add_argument("--corrupt-tick", type=int, default=None,
+                   help="deliberately force a double-bind at this tick "
+                        "(invariant-checker self-test: the run MUST "
+                        "fail and dump)")
+    p.add_argument("--quiet", action="store_true",
+                   help="suppress progress logging; print only the "
+                        "summary JSON")
+    return p
+
+
+def _load_scenario(path: str) -> tuple:
+    """(events, workload_spec, fault_spec) from --scenario."""
+    if path.endswith(".jsonl"):
+        return read_trace(path), None, None
+    with open(path, "r", encoding="utf-8") as f:
+        raw = json.load(f)
+    unknown = set(raw) - {"workload", "faults"}
+    if unknown:
+        raise SystemExit(
+            f"--scenario {path}: unknown sections {sorted(unknown)} "
+            "(known: ['workload', 'faults'])"
+        )
+
+    def _build(cls, section):
+        fields = {f.name for f in dataclasses.fields(cls)}
+        bad = set(section) - fields
+        if bad:
+            raise SystemExit(
+                f"--scenario {path}: unknown {cls.__name__} keys "
+                f"{sorted(bad)} (known: {sorted(fields)})"
+            )
+        # JSON arrays decode as lists; the spec fields are tuples.
+        coerced = {
+            k: tuple(tuple(x) if isinstance(x, list) else x for x in v)
+            if isinstance(v, list) else v
+            for k, v in section.items()
+        }
+        return cls(**coerced)
+
+    return (
+        None,
+        _build(ScenarioSpec, raw.get("workload", {})),
+        _build(FaultSpec, raw.get("faults", {})),
+    )
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    logging.basicConfig(
+        level=logging.WARNING if args.quiet else logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+    )
+    from kube_batch_tpu.cli import honor_jax_platforms
+
+    honor_jax_platforms()
+    from kube_batch_tpu.compile_cache import enable_compile_cache
+
+    # Same persistent-cache policy as the daemon CLI: a rerun of the
+    # same scenario shapes replays its fused-cycle compiles from disk.
+    enable_compile_cache()
+
+    events, scenario, faults = (None, None, None)
+    if args.scenario:
+        events, scenario, faults = _load_scenario(args.scenario)
+    if args.no_faults:
+        faults = FaultSpec.none()
+        if events is not None:
+            # A replayed trace carries its fault schedule inline;
+            # "no faults" must strip those too, not just zero the
+            # bind-curse percentage.
+            events = [e for e in events if e.get("op") != "fault"]
+    seed = args.seed
+    if seed is None:
+        meta = next(
+            (e for e in events or () if e.get("op") == "meta"), None
+        )
+        seed = int(meta.get("seed", 0)) if meta else 0
+
+    engine = ChaosEngine(
+        seed=seed,
+        ticks=args.ticks,
+        scenario=scenario,
+        faults=faults,
+        events=events,
+        conf_path=args.scheduler_conf,
+        record=args.record,
+        drain=args.drain,
+        trace_path=args.trace_out,
+        dump_dir=args.dump_dir,
+        corrupt_tick=args.corrupt_tick,
+    )
+    try:
+        result = engine.run()
+    except ChaosEngineError as exc:
+        logging.error("chaos harness failed: %s", exc)
+        return 2
+    print(json.dumps(result.summary(), indent=1, sort_keys=True))
+    return 0 if result.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,553 @@
+"""The chaos scenario engine: deterministic fault-injecting simulation.
+
+One `ChaosEngine.run()` drives the REAL scheduler end-to-end through
+its production wire stack — `client.adapter.StreamBackend` +
+`WatchAdapter` over a socketpair against a `faults.ChaosCluster` — for
+`ticks` discrete steps.  Nothing mutates the scheduler cache directly:
+every workload arrival, node vanish and completion crosses the JSON-
+lines watch protocol, and every scheduling decision crosses back as a
+correlated bind/evict request, exactly like `--cluster-stream`
+production mode.
+
+Tick anatomy (strictly ordered, which is what makes a threaded wire
+stack deterministic)::
+
+    1. fire this tick's faults      (sever stream / expire history /
+                                     vanish node / steal lease / heal)
+    2. apply this tick's workload   (trace events → cluster → watch)
+    3. reconnect if the wire is down (resume-from-RV or 410 re-list —
+                                     the SAME resume_session helper the
+                                     CLI supervisor uses)
+    4. renew the cluster-side lease (stand down the tick it is lost)
+    5. quiesce ingest               (adapter caught up to cluster RV)
+    6. scheduler.run_once()         (one real cycle; binds/evicts land)
+    7. cluster.tick()               (kubelet: Bound → Running)
+    8. quiesce + invariant check    (chaos/invariants.py)
+    9. record the tick in the flight recorder
+
+After the horizon the engine drains: completions past the horizon
+still apply, no new arrivals or faults, and every admissible gang must
+bind within `drain` ticks — the eventual-convergence invariant.  On
+any violation the engine dumps the last `record` ticks of events and
+decisions (the flight recorder) to a JSON post-mortem and reports
+failure; the CLI (`python -m kube_batch_tpu.chaos`) exits non-zero.
+
+Determinism contract: same (seed, scenario, faults, ticks) ⇒ identical
+trace hash and identical final assignment.  The hash covers the input
+schedule AND the per-tick decision log (binds/evicts sorted by uid —
+the 16-way bind fan-out delivers in thread order, but the SET of
+decisions per tick is deterministic).
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import json
+import logging
+import os
+import random
+import socket
+import tempfile
+import time
+
+from kube_batch_tpu import metrics
+from kube_batch_tpu.api.resource import ResourceSpec
+from kube_batch_tpu.api.types import TaskStatus
+from kube_batch_tpu.cache.cache import SchedulerCache
+from kube_batch_tpu.chaos.faults import ChaosCluster, FaultSpec, plan_faults
+from kube_batch_tpu.chaos.invariants import InvariantChecker, Violation
+from kube_batch_tpu.chaos.workload import (
+    ScenarioSpec,
+    apply_to_cluster,
+    generate,
+    trace_hash,
+    write_trace,
+)
+from kube_batch_tpu.client.adapter import (
+    StreamBackend,
+    WatchAdapter,
+    resume_session,
+)
+from kube_batch_tpu.scheduler import Scheduler
+
+log = logging.getLogger(__name__)
+
+LEASE_HOLDER = "chaos-engine"
+LEASE_TTL = 1e9  # ticks are the only clock; only steal faults break it
+
+
+class ChaosEngineError(RuntimeError):
+    """The harness itself failed (quiesce timeout, dead wire) — exit 2,
+    distinct from an invariant violation (exit 1)."""
+
+
+@dataclasses.dataclass
+class ChaosResult:
+    ok: bool
+    ticks_run: int
+    violations: list[Violation]
+    trace_hash: str
+    final_assignment: dict[str, str]   # pod uid → node
+    faults: dict[str, int]
+    recoveries: dict[str, int]
+    converged_tick: int | None         # drain ticks until quiescent
+    dump_path: str | None
+
+    def summary(self) -> dict:
+        return {
+            "ok": self.ok,
+            "ticks": self.ticks_run,
+            "violations": [v.as_dict() for v in self.violations],
+            "trace_hash": self.trace_hash,
+            "bound_pods": len(self.final_assignment),
+            "faults": dict(self.faults),
+            "recoveries": dict(self.recoveries),
+            "converged_after_drain_ticks": self.converged_tick,
+            "flight_recorder": self.dump_path,
+        }
+
+
+class FlightRecorder:
+    """Bounded ring of per-tick records; dumped as a JSON post-mortem
+    the moment an invariant fails."""
+
+    def __init__(self, keep: int = 64) -> None:
+        self._ring: collections.deque = collections.deque(maxlen=keep)
+
+    def record(self, entry: dict) -> None:
+        self._ring.append(entry)
+
+    def dump(self, path: str, meta: dict) -> str:
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(
+                {"meta": meta, "ticks": list(self._ring)},
+                f, indent=1, sort_keys=True,
+            )
+            f.write("\n")
+        return path
+
+
+class ChaosEngine:
+    def __init__(
+        self,
+        seed: int = 0,
+        ticks: int = 200,
+        scenario: ScenarioSpec | None = None,
+        faults: FaultSpec | None = None,
+        events: list[dict] | None = None,
+        conf_path: str | None = None,
+        record: int = 64,
+        drain: int = 80,
+        trace_path: str | None = None,
+        dump_dir: str | None = None,
+        corrupt_tick: int | None = None,
+        quiesce_timeout: float = 30.0,
+    ) -> None:
+        self.seed = seed
+        self.ticks = ticks
+        self.scenario = scenario or ScenarioSpec()
+        self._preset_events = events   # a replayed trace, if any
+        if faults is None and events is not None:
+            # A recorded trace carries the recording's run-time fault
+            # parameters in its "meta" header line; adopt them unless
+            # the caller overrides explicitly.  Planned faults (drops,
+            # gaps, vanishes, steals) ride inline as events, so only
+            # bind_fail_pct — a fire-time curse decision — needs to
+            # survive the round trip for replay to reproduce the
+            # recording's decisions and hash.
+            meta = next(
+                (e for e in events if e.get("op") == "meta"), None
+            )
+            if meta is not None:
+                faults = FaultSpec(
+                    bind_fail_pct=int(meta.get("bind_fail_pct", 0))
+                )
+        self.faults = faults or FaultSpec()
+        self.conf_path = conf_path
+        self.drain = drain
+        self.trace_path = trace_path
+        self.dump_dir = dump_dir or tempfile.gettempdir()
+        self.corrupt_tick = corrupt_tick
+        self.quiesce_timeout = quiesce_timeout
+        self.recorder = FlightRecorder(keep=record)
+        self.fault_counts: collections.Counter = collections.Counter()
+        self.recovery_counts: collections.Counter = collections.Counter()
+        # Resolved-at-fire-time fault state.
+        self._vanish_rng = random.Random(f"chaos-vanish-{seed}")
+        self._healable: collections.deque = collections.deque()
+        self._pending_gap = False
+        self._have_lease = False
+        self._lease_lost = False
+        # Live wire state.
+        self.cluster: ChaosCluster | None = None
+        self.backend: StreamBackend | None = None
+        self.adapter: WatchAdapter | None = None
+        self.cache: SchedulerCache | None = None
+        self._socks: list[socket.socket] = []
+        self._cluster_sock: socket.socket | None = None
+        self._decision_cursor = 0
+        # Decision log folded into the trace hash (sorted per tick).
+        self._decisions: list[dict] = []
+
+    # -- wiring ---------------------------------------------------------
+    def _connect(self, replay: bool) -> None:
+        """One scheduler session over a fresh socketpair; the cluster
+        side serves requests on its reader thread."""
+        a, b = socket.socketpair()
+        cl_r = a.makefile("r", encoding="utf-8")
+        cl_w = a.makefile("w", encoding="utf-8")
+        sch_r = b.makefile("r", encoding="utf-8")
+        sch_w = b.makefile("w", encoding="utf-8")
+        self.cluster.attach(cl_r, cl_w)
+        if not self.cluster._started:
+            self.cluster.start()
+        if replay:
+            self.cluster.replay(cl_w)
+        old = self.adapter
+        if self.backend is None:
+            self.backend = StreamBackend(sch_w, timeout=10.0)
+        else:
+            self.backend.reconnect(sch_w)
+        adapter = WatchAdapter(self.cache, sch_r, backend=self.backend)
+        if old is not None:
+            adapter.resource_versions.update(old.resource_versions)
+            adapter.list_rv = old.list_rv
+        adapter.start()
+        self._socks.extend((a, b))
+        self._cluster_sock = a
+        self.adapter = adapter
+
+    def _sever_stream(self) -> None:
+        """Cut the 'network' under both sides (≙ a tunnel blip)."""
+        try:
+            self._cluster_sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        deadline = time.monotonic() + self.quiesce_timeout
+        while not self.adapter.stopped.wait(0.01):
+            if time.monotonic() > deadline:
+                raise ChaosEngineError("severed stream never stopped "
+                                       "the watch adapter")
+
+    def _reconnect(self) -> str:
+        """Dial a fresh session and resume — the identical recovery the
+        CLI supervisor runs (shared resume_session helper)."""
+        since = self.adapter.latest_rv
+        self._connect(replay=False)
+        mode = resume_session(
+            self.cache, self.backend, self.adapter, since,
+            sync_timeout=self.quiesce_timeout,
+        )
+        self.recovery_counts[mode] += 1
+        metrics.chaos_recoveries.inc(mode)
+        return mode
+
+    # -- per-tick phases ------------------------------------------------
+    def _fire_fault(self, ev: dict, rec: dict) -> None:
+        kind = ev["kind"]
+        detail: dict = {"kind": kind}
+        if kind in ("stream-drop", "watch-gap"):
+            self._sever_stream()
+            self._pending_gap = kind == "watch-gap"
+            self.fault_counts[kind] += 1
+            metrics.chaos_faults_injected.inc(kind)
+        elif kind == "node-vanish":
+            spec = self.cluster.vanish_node(self._vanish_rng)
+            if spec is None:
+                detail["skipped"] = True
+            else:
+                self._healable.append(spec)
+                detail["node"] = spec["name"]
+                self.fault_counts[kind] += 1
+                metrics.chaos_faults_injected.inc(kind)
+        elif kind == "node-heal":
+            if self._healable:
+                spec = self._healable.popleft()
+                self.cluster.heal_node(spec)
+                detail["node"] = spec["name"]
+                self.recovery_counts["node-healed"] += 1
+                metrics.chaos_recoveries.inc("node-healed")
+            else:
+                detail["skipped"] = True
+        elif kind == "lease-steal":
+            self.cluster.steal_lease()
+            self.fault_counts[kind] += 1
+            metrics.chaos_faults_injected.inc(kind)
+        elif kind == "lease-return":
+            self.cluster.return_lease()
+        else:
+            raise ChaosEngineError(f"unknown fault kind {kind!r}")
+        rec.setdefault("faults", []).append(detail)
+
+    def _maybe_force_gap(self) -> None:
+        """A watch-gap fault needs the missed tail to be UNSERVABLE:
+        guarantee the cluster moved past the adapter's RV (a benign
+        queue re-add bumps it if this tick's workload did not), then
+        expire the history ring so resume gets the 410 answer."""
+        if not self._pending_gap:
+            return
+        self._pending_gap = False
+        with self.cluster._lock:
+            rv_moved = self.cluster._rv > self.adapter.latest_rv
+        if not rv_moved:
+            q = self.cluster.queues.get("default")
+            if q is not None:
+                self.cluster.add_queue(q)  # benign upsert, bumps RV
+        self.cluster.expire_history()
+
+    def _renew_lease(self, rec: dict) -> bool:
+        """Synchronous per-tick renewal (the tick IS the clock).
+        Returns True when this engine currently leads; a lost lease
+        stands the scheduler down for the tick, re-acquiring as soon
+        as the usurper lets go — deterministic, no renewal thread."""
+        try:
+            if self._have_lease:
+                self.backend.renew_lease(LEASE_HOLDER, LEASE_TTL)
+            else:
+                self.backend.acquire_lease(LEASE_HOLDER, LEASE_TTL)
+                self._have_lease = True
+                if self._lease_lost:
+                    self._lease_lost = False
+                    rec["lease"] = "reacquired"
+                    self.recovery_counts["lease-reacquired"] += 1
+                    metrics.chaos_recoveries.inc("lease-reacquired")
+        except RuntimeError:
+            rec["lease"] = "lost" if self._have_lease else "contended"
+            self._have_lease = False
+            self._lease_lost = True
+            return False
+        except (ConnectionError, TimeoutError) as exc:
+            raise ChaosEngineError(f"lease verb failed on a live "
+                                   f"stream: {exc}") from exc
+        return True
+
+    def _quiesce(self) -> None:
+        """Block until the adapter ingested everything the cluster
+        emitted — the determinism barrier between phases."""
+        deadline = time.monotonic() + self.quiesce_timeout
+        while time.monotonic() < deadline:
+            if self.adapter.stopped.is_set():
+                return  # wire is down; next tick's reconnect handles it
+            with self.cluster._lock:
+                rv = self.cluster._rv
+            if self.adapter.synced.is_set() and \
+                    self.adapter.latest_rv >= rv:
+                return
+            time.sleep(0.002)
+        raise ChaosEngineError("ingest quiesce timed out")
+
+    def _drain_decisions(self, rec: dict) -> None:
+        """Fold this tick's wire-log tail into the recorder + hash
+        (sorted: the bind fan-out's thread order is not semantic)."""
+        with self.cluster._lock:
+            tail = self.cluster.wire_log[self._decision_cursor:]
+            self._decision_cursor = len(self.cluster.wire_log)
+        tail = sorted(
+            tail, key=lambda e: (e["op"], e.get("uid") or "",
+                                 e.get("node") or ""),
+        )
+        if tail:
+            rec["decisions"] = tail
+            self._decisions.extend(tail)
+        injected = sum(1 for e in tail if e["op"] == "bind-fault")
+        if injected:
+            self.fault_counts["bind-fault"] += injected
+            metrics.chaos_faults_injected.inc(
+                "bind-fault", by=float(injected)
+            )
+
+    # -- the run --------------------------------------------------------
+    def run(self) -> ChaosResult:
+        if self._preset_events is not None:
+            # A replayed trace carries its fault schedule inline and its
+            # run-time parameters in the meta header (consumed by
+            # __init__, excluded from the hashable schedule below).
+            events = [
+                e for e in self._preset_events
+                if e["op"] not in ("fault", "meta")
+            ]
+            fault_events = [
+                e for e in self._preset_events if e["op"] == "fault"
+            ]
+        else:
+            events = generate(self.scenario, self.seed, self.ticks)
+            fault_events = plan_faults(self.faults, self.seed, self.ticks)
+        by_tick: dict[int, list[dict]] = collections.defaultdict(list)
+        for ev in events:
+            by_tick[ev["tick"]].append(ev)
+        faults_by_tick: dict[int, list[dict]] = collections.defaultdict(list)
+        for ev in fault_events:
+            faults_by_tick[ev["tick"]].append(ev)
+        if self.trace_path:
+            # The header makes a recorded trace self-describing: replay
+            # recovers the seed (vanish-target + curse decisions are
+            # resolved from it at fire time) and bind_fail_pct without
+            # the operator re-passing them.
+            header = {
+                "tick": -1, "op": "meta", "seed": self.seed,
+                "bind_fail_pct": self.faults.bind_fail_pct,
+            }
+            write_trace(self.trace_path, [header] + events + fault_events)
+
+        self.cluster = ChaosCluster(
+            seed=self.seed, bind_fail_pct=self.faults.bind_fail_pct,
+            history=4096,
+        )
+        self.cache = SchedulerCache(
+            spec=ResourceSpec(),
+            binder=None, evictor=None, status_updater=None,
+        )
+        self._connect(replay=True)
+        # The backend exists only after _connect; wire the seams now.
+        self.cache.binder = self.backend
+        self.cache.evictor = self.backend
+        self.cache.status_updater = self.backend
+        if not self.adapter.wait_for_sync(self.quiesce_timeout):
+            raise ChaosEngineError("initial LIST replay never synced")
+        scheduler = Scheduler(
+            self.cache, conf_path=self.conf_path, schedule_period=0.0,
+        )
+        checker = InvariantChecker(self.cluster)
+        metrics.chaos_convergence_ticks.set(-1.0)
+
+        violations: list[Violation] = []
+        converged_tick: int | None = None
+        ticks_run = 0
+
+        def one_tick(t: int, active: bool) -> list[Violation]:
+            """active=False is the drain phase: completions only."""
+            nonlocal ticks_run
+            self.cluster.tick_now = t
+            rec: dict = {"tick": t}
+            if active:
+                for fe in faults_by_tick.get(t, ()):
+                    self._fire_fault(fe, rec)
+            evs = by_tick.get(t, ())
+            if not active:
+                evs = [e for e in evs if e["op"] == "complete"]
+            for ev in evs:
+                apply_to_cluster(self.cluster, ev)
+            rec["workload"] = len(evs)
+            self._maybe_force_gap()
+            if self.adapter.stopped.is_set() or \
+                    self.backend.closed.is_set():
+                rec["reconnect"] = self._reconnect()
+            lead = self._renew_lease(rec)
+            self._quiesce()
+            if self.adapter.stopped.is_set():
+                rec["reconnect"] = self._reconnect()
+                self._quiesce()
+            if lead:
+                scheduler.run_once()
+            else:
+                rec["stood-down"] = True
+            if self.corrupt_tick is not None and t == self.corrupt_tick:
+                if self.cluster.force_double_bind():
+                    rec["corruption"] = "forced-double-bind"
+            self.cluster.tick()
+            self._quiesce()
+            self._drain_decisions(rec)
+            found = checker.check_tick(t)
+            if found:
+                rec["violations"] = [v.as_dict() for v in found]
+                for v in found:
+                    metrics.chaos_invariant_violations.inc(v.kind)
+            self.recorder.record(rec)
+            ticks_run += 1
+            return found
+
+        try:
+            for t in range(self.ticks):
+                violations = one_tick(t, active=True)
+                if violations:
+                    break
+            else:
+                # Convergence drain: no new arrivals or faults; late
+                # completions keep applying (they free the capacity a
+                # backlog is waiting on); every admissible gang must
+                # bind before the deadline.
+                for extra in range(self.drain):
+                    t = self.ticks + extra
+                    violations = one_tick(t, active=False)
+                    if violations:
+                        break
+                    if self._all_settled():
+                        converged_tick = extra
+                        metrics.chaos_convergence_ticks.set(float(extra))
+                        break
+                else:
+                    violations = checker.pending_after_deadline(
+                        self.ticks + self.drain
+                    )
+        finally:
+            self._teardown()
+
+        # Recovery bookkeeping the cluster tracked itself.
+        if self.cluster.recovered_binds:
+            self.recovery_counts["bind-retried"] = \
+                self.cluster.recovered_binds
+            metrics.chaos_recoveries.inc(
+                "bind-retried", by=float(self.cluster.recovered_binds)
+            )
+
+        final = self._final_assignment()
+        full_hash = trace_hash(
+            events + fault_events + self._decisions
+        )
+        dump_path = None
+        if violations:
+            os.makedirs(self.dump_dir, exist_ok=True)
+            dump_path = os.path.join(
+                self.dump_dir,
+                f"chaos-flight-seed{self.seed}.json",
+            )
+            self.recorder.dump(dump_path, meta={
+                "seed": self.seed,
+                "ticks": ticks_run,
+                "violations": [v.as_dict() for v in violations],
+                "trace_hash": full_hash,
+            })
+            log.error(
+                "chaos: %d invariant violation(s); flight recorder "
+                "dumped to %s", len(violations), dump_path,
+            )
+        return ChaosResult(
+            ok=not violations,
+            ticks_run=ticks_run,
+            violations=list(violations),
+            trace_hash=full_hash,
+            final_assignment=final,
+            faults=dict(self.fault_counts),
+            recoveries=dict(self.recovery_counts),
+            converged_tick=converged_tick,
+            dump_path=dump_path,
+        )
+
+    # -- helpers --------------------------------------------------------
+    def _all_settled(self) -> bool:
+        with self.cluster._lock:
+            return all(
+                p.status in (TaskStatus.BOUND, TaskStatus.RUNNING)
+                for p in self.cluster.pods.values()
+            )
+
+    def _final_assignment(self) -> dict[str, str]:
+        with self.cluster._lock:
+            return {
+                uid: p.node
+                for uid, p in sorted(self.cluster.pods.items())
+                if p.node is not None
+            }
+
+    def _teardown(self) -> None:
+        try:
+            if self._have_lease and self.backend is not None:
+                self.backend.release_lease(LEASE_HOLDER)
+        except Exception:  # noqa: BLE001 — best effort on the way down
+            pass
+        for sock in self._socks:
+            try:
+                sock.close()
+            except OSError:
+                pass

@@ -1,0 +1,257 @@
+"""Deterministic fault injection over the wire-side cluster.
+
+Two pieces:
+
+* `plan_faults` — the fault SCHEDULE, a pure function of (spec, seed,
+  ticks): which tick gets a stream drop, a watch gap (drop + expired
+  history → the 410-Gone path), a node vanish (+ its later heal), or a
+  lease steal (+ its return).  The plan is a list of trace-style event
+  dicts, so it rides in the same JSONL trace as the workload and two
+  runs of the same seed produce the identical schedule.
+
+* `ChaosCluster` — `client.external.ExternalCluster` subclassed into a
+  hostile, instrumented apiserver: it can curse a deterministic subset
+  of pods so their FIRST bind attempt fails (the retry-through-resync
+  path), and it records every bind/evict/unplacement as a structured
+  wire-log entry (tick, uid, group, prior placement) that the invariant
+  checker replays.  Failure decisions key on the pod's uid hash, never
+  on call order — the scheduler's 16-way bind fan-out delivers requests
+  in nondeterministic thread order, and a seeded-RNG-by-arrival rule
+  would destroy same-seed reproducibility.
+
+The stream-drop / gap / lease faults need the engine's cooperation
+(it owns the socket and the lease renewal loop), so `plan_faults` only
+schedules them; `engine.ChaosEngine` executes them.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import hashlib
+import random
+
+from kube_batch_tpu.api.types import TaskStatus
+from kube_batch_tpu.client.external import ExternalCluster
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    """Fault cadence knobs.  Every `*_every` is a tick period (0
+    disables that fault class)."""
+
+    #: Sever the wire; the engine reconnects and resumes the watch from
+    #: its last-seen resourceVersion (the missed-tail replay path).
+    stream_drop_every: int = 31
+    #: Sever the wire AND expire the watch history, forcing the
+    #: 410-Gone answer and the in-process clear()+re-list recovery.
+    gap_every: int = 97
+    #: Percentage of pods whose FIRST bind attempt gets an injected
+    #: error response (decided by uid hash — deterministic under the
+    #: bind fan-out's thread order); the resync retry must land.
+    bind_fail_pct: int = 10
+    #: Abruptly delete a live node (residents go back to Pending).
+    node_vanish_every: int = 43
+    #: Ticks until a vanished node's replacement (same capacity, same
+    #: name) rejoins — keeps scenarios convergent.
+    heal_after: int = 7
+    #: A rogue holder usurps the cluster-side lease for one tick; the
+    #: engine's renewal fails, it stands down, then re-acquires.
+    lease_steal_every: int = 53
+
+    @classmethod
+    def none(cls) -> "FaultSpec":
+        return cls(stream_drop_every=0, gap_every=0, bind_fail_pct=0,
+                   node_vanish_every=0, lease_steal_every=0)
+
+
+def plan_faults(spec: FaultSpec, seed: int, ticks: int) -> list[dict]:
+    """The full fault schedule, trace-event shaped.  Node-vanish events
+    name no target — the victim is resolved at fire time from the live
+    node set with the rng seeded here, which is equally deterministic
+    and lets the plan survive workload-driven node churn."""
+    del seed  # cadence is spec-driven; kept in the signature so a
+    #           future jittered plan stays a same-shape change
+    events: list[dict] = []
+    for t in range(1, ticks):
+        if spec.gap_every and t % spec.gap_every == 0:
+            events.append({"tick": t, "op": "fault", "kind": "watch-gap"})
+        elif spec.stream_drop_every and t % spec.stream_drop_every == 0:
+            events.append({"tick": t, "op": "fault", "kind": "stream-drop"})
+        if spec.node_vanish_every and t % spec.node_vanish_every == 0:
+            events.append({"tick": t, "op": "fault", "kind": "node-vanish"})
+            events.append({
+                "tick": t + spec.heal_after, "op": "fault",
+                "kind": "node-heal",
+            })
+        if spec.lease_steal_every and t % spec.lease_steal_every == 0:
+            events.append({"tick": t, "op": "fault", "kind": "lease-steal"})
+            events.append({
+                "tick": t + 1, "op": "fault", "kind": "lease-return",
+            })
+    events.sort(key=lambda e: e["tick"])
+    return events
+
+
+def cursed(seed: int, uid: str, pct: int) -> bool:
+    """True iff this pod's first bind attempt is fated to fail —
+    a pure hash of (seed, uid), independent of delivery order."""
+    if pct <= 0:
+        return False
+    digest = hashlib.sha256(f"chaos-bind-{seed}:{uid}".encode()).digest()
+    return digest[0] % 100 < pct
+
+
+class ChaosCluster(ExternalCluster):
+    """ExternalCluster + deterministic bind sabotage + a structured
+    wire log for the invariant checker.
+
+    `tick_now` is stamped by the engine at the top of every tick; all
+    mutation entry points run under the inherited cluster lock, so log
+    appends are ordered and the checker drains them race-free.
+    """
+
+    def __init__(self, *, seed: int = 0, bind_fail_pct: int = 0,
+                 **kwargs) -> None:
+        super().__init__(**kwargs)
+        self.seed = seed
+        self.bind_fail_pct = bind_fail_pct
+        self.tick_now = 0
+        self.wire_log: list[dict] = []
+        self.bind_attempts: collections.Counter = collections.Counter()
+        self.injected_bind_failures = 0
+        self.recovered_binds = 0  # cursed pods whose retry later landed
+
+    # -- structured log -------------------------------------------------
+    def _log(self, entry: dict) -> None:
+        entry["tick"] = self.tick_now
+        self.wire_log.append(entry)
+
+    # -- bind sabotage + instrumentation -------------------------------
+    def _bind_pod(self, writer, rid, pod, node_name) -> None:
+        if pod is None:
+            super()._bind_pod(writer, rid, pod, node_name)
+            return
+        self.bind_attempts[pod.uid] += 1
+        first = self.bind_attempts[pod.uid] == 1
+        if first and cursed(self.seed, pod.uid, self.bind_fail_pct):
+            self.injected_bind_failures += 1
+            self._log({
+                "op": "bind-fault", "uid": pod.uid, "group": pod.group,
+                "node": node_name,
+            })
+            self._respond(writer, rid, False,
+                          "chaos: injected bind failure")
+            return
+        prior_status, prior_node = pod.status.name, pod.node
+        accepted = (
+            node_name in self.nodes
+            and pod.name not in self.fail_bind_pods
+        )
+        super()._bind_pod(writer, rid, pod, node_name)
+        if accepted:
+            if not first and cursed(self.seed, pod.uid,
+                                    self.bind_fail_pct):
+                self.recovered_binds += 1
+            self._log({
+                "op": "bind", "uid": pod.uid, "group": pod.group,
+                "node": node_name, "prior_status": prior_status,
+                "prior_node": prior_node,
+            })
+
+    def _evict_pod(self, writer, rid, pod, reason) -> None:
+        if pod is not None:
+            self._log({
+                "op": "evict", "uid": pod.uid, "group": pod.group,
+                "reason": reason, "prior_status": pod.status.name,
+                "prior_node": pod.node,
+            })
+        super()._evict_pod(writer, rid, pod, reason)
+
+    # -- unplacement bookkeeping (checker needs explicit transitions) --
+    def delete_node(self, name: str) -> None:
+        with self._lock:
+            node = self.nodes.get(name)
+            if node is not None:
+                for pod in self.pods.values():
+                    if pod.node == name:
+                        self._log({
+                            "op": "unplace", "uid": pod.uid,
+                            "group": pod.group, "reason": "node-gone",
+                        })
+            super().delete_node(name)
+
+    def delete_pod(self, uid: str) -> None:
+        with self._lock:
+            if uid in self.pods:
+                self._log({"op": "pod-gone", "uid": uid,
+                           "group": self.pods[uid].group})
+            super().delete_pod(uid)
+
+    # -- fault primitives the engine fires ------------------------------
+    def vanish_node(self, rng: random.Random) -> dict | None:
+        """Abruptly kill one live node (rng-chosen over the SORTED name
+        set — deterministic), returning its spec for the later heal."""
+        with self._lock:
+            names = sorted(self.nodes)
+            if not names:
+                return None
+            name = rng.choice(names)
+            node = self.nodes[name]
+            spec = {"name": name,
+                    "allocatable": dict(node.allocatable),
+                    "uid": node.uid}
+            self.delete_node(name)
+            return spec
+
+    def heal_node(self, spec: dict) -> None:
+        from kube_batch_tpu.cache.cluster import Node
+
+        self.add_node(Node(name=spec["name"],
+                           allocatable=spec["allocatable"],
+                           uid=spec["uid"]))
+
+    def steal_lease(self, usurper: str = "chaos-monkey") -> str | None:
+        """A rogue holder takes the lease: the rightful holder's next
+        renewal is rejected and it must stand down."""
+        import time
+
+        with self._lock:
+            previous = self.lease_holder
+            self.lease_holder = usurper
+            self.lease_expires = time.monotonic() + 3600.0
+            return previous
+
+    def return_lease(self) -> None:
+        with self._lock:
+            self.lease_holder = None
+            self.lease_expires = 0.0
+
+    # -- deliberate corruption (invariant-checker self-test) ------------
+    def force_double_bind(self) -> bool:
+        """Corrupt the world the way a buggy scheduler would: bind an
+        ALREADY-PLACED pod a second time, to a different node, behind
+        the normal funnel's back.  Returns True when a target existed —
+        the invariant checker MUST flag the resulting log entry."""
+        with self._lock:
+            placed = sorted(
+                (uid, p) for uid, p in self.pods.items()
+                if p.node is not None
+                and p.status in (TaskStatus.BOUND, TaskStatus.RUNNING)
+            )
+            if not placed or len(self.nodes) < 2:
+                return False
+            uid, pod = placed[0]
+            other = next(
+                (n for n in sorted(self.nodes) if n != pod.node), None
+            )
+            if other is None:
+                return False
+            self._log({
+                "op": "bind", "uid": uid, "group": pod.group,
+                "node": other, "prior_status": pod.status.name,
+                "prior_node": pod.node,
+            })
+            pod.node = other
+            self.binds.append((pod.name, other))
+            return True

@@ -1,0 +1,198 @@
+"""Post-tick invariant checking over the authoritative cluster.
+
+The checker owns a placement model it replays from the ChaosCluster's
+structured wire log (bind / evict / unplace / pod-gone entries) and
+cross-checks against the cluster's pod/node truth after every simulated
+tick.  Checked invariants:
+
+1. **no-double-bind** — a bind accepted for a pod the model already
+   holds placed (with no intervening unplacement) is a double bind:
+   the scheduler committed the same task twice.
+2. **gang-readiness** — the first tick any member of a gang receives a
+   bind attempt, the scheduler must have attempted at least
+   ``min_member`` placements for that gang (attempts = accepted binds
+   + injected bind faults; injected failures are the backend's doing,
+   not a gang-gate violation).  A partial first wave means a
+   non-Ready gang leaked through the JobReady gate.
+3. **capacity** — per node, the summed requests of its placed pods
+   never exceed allocatable in any resource dimension.
+4. **eviction-accounting** — every eviction targets a pod that was
+   actually placed, and the pod is observably unplaced (Pending or
+   gone) afterwards; nothing evicts into thin air and no evicted pod
+   silently keeps its node.
+5. **convergence** (engine-driven, `pending_after_deadline`) — after
+   the scenario quiesces, no admissible pod may stay Pending past the
+   drain deadline.
+
+Violations are values, not exceptions: the engine decides to dump the
+flight recorder and exit non-zero.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from kube_batch_tpu.api.types import TaskStatus
+
+#: Float slack for capacity sums (requests are floats; the scheduler's
+#: own fit test uses resource-spec epsilons far coarser than this).
+EPS = 1e-6
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    kind: str
+    tick: int
+    message: str
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class InvariantChecker:
+    """Replays the ChaosCluster wire log incrementally; `check_tick`
+    is called once per simulated tick with the cluster quiesced."""
+
+    def __init__(self, cluster) -> None:
+        self.cluster = cluster
+        self._log_cursor = 0
+        # uid → node, the model's view of current placements.
+        self._placed: dict[str, str] = {}
+        # group → uids ever placed (for gang first-wave detection).
+        self._group_placed: dict[str, set[str]] = {}
+
+    # -- per-tick -------------------------------------------------------
+    def check_tick(self, tick: int) -> list[Violation]:
+        cluster = self.cluster
+        with cluster._lock:
+            entries = cluster.wire_log[self._log_cursor:]
+            self._log_cursor = len(cluster.wire_log)
+            pods = {
+                uid: (p.group, p.status, p.node, dict(p.request))
+                for uid, p in cluster.pods.items()
+            }
+            nodes = {
+                name: dict(n.allocatable)
+                for name, n in cluster.nodes.items()
+            }
+            min_member = {
+                name: g.min_member for name, g in cluster.groups.items()
+            }
+        violations: list[Violation] = []
+        violations += self._replay_log(tick, entries, pods, min_member)
+        violations += self._check_capacity(tick, pods, nodes)
+        return violations
+
+    # -- 1 + 2 + 4: log replay -----------------------------------------
+    def _replay_log(self, tick, entries, pods, min_member):
+        violations: list[Violation] = []
+        # Gang first-wave accounting: attempts per group among THIS
+        # batch of entries (one engine tick = one scheduling cycle).
+        attempts: dict[str, int] = {}
+        placed_before = {
+            g: len(uids) for g, uids in self._group_placed.items()
+        }
+        first_wave: set[str] = set()
+        for e in entries:
+            op, uid, group = e["op"], e.get("uid"), e.get("group")
+            if op in ("bind", "bind-fault") and group is not None:
+                attempts[group] = attempts.get(group, 0) + 1
+                if placed_before.get(group, 0) == 0 and \
+                        group not in first_wave:
+                    first_wave.add(group)
+            if op == "bind":
+                if uid in self._placed:
+                    violations.append(Violation(
+                        "double-bind", tick,
+                        f"pod {uid} bound to {e['node']} while already "
+                        f"placed on {self._placed[uid]} "
+                        f"(prior status {e.get('prior_status')})",
+                    ))
+                self._placed[uid] = e["node"]
+                if group is not None:
+                    self._group_placed.setdefault(group, set()).add(uid)
+            elif op == "evict":
+                if e.get("prior_node") is None and \
+                        uid not in self._placed:
+                    violations.append(Violation(
+                        "eviction-unaccounted", tick,
+                        f"pod {uid} evicted while never placed "
+                        f"(prior status {e.get('prior_status')})",
+                    ))
+                self._unplace(uid, group)
+            elif op in ("unplace", "pod-gone"):
+                self._unplace(uid, group)
+        # Evicted pods must be observably unplaced by end of tick.
+        for e in entries:
+            if e["op"] != "evict":
+                continue
+            state = pods.get(e.get("uid"))
+            if state is not None and state[2] is not None and \
+                    state[1] not in (TaskStatus.PENDING,):
+                violations.append(Violation(
+                    "eviction-unaccounted", tick,
+                    f"pod {e['uid']} evicted but still holds node "
+                    f"{state[2]} in status {state[1].name}",
+                ))
+        for group in sorted(first_wave):
+            need = min_member.get(group)
+            if need is None:
+                continue  # group completed within the same tick
+            got = attempts.get(group, 0)
+            if got < need:
+                violations.append(Violation(
+                    "gang-partial-bind", tick,
+                    f"gang {group} got its first bind wave with only "
+                    f"{got}/{need} member placements attempted — a "
+                    "non-Ready gang leaked through the JobReady gate",
+                ))
+        return violations
+
+    def _unplace(self, uid, group) -> None:
+        self._placed.pop(uid, None)
+        if group in self._group_placed:
+            self._group_placed[group].discard(uid)
+
+    # -- 3: capacity ----------------------------------------------------
+    def _check_capacity(self, tick, pods, nodes):
+        violations: list[Violation] = []
+        used: dict[str, dict[str, float]] = {
+            name: {} for name in nodes
+        }
+        for uid, (_group, status, node, request) in sorted(pods.items()):
+            if node is None or status not in (
+                TaskStatus.BOUND, TaskStatus.RUNNING,
+            ):
+                continue
+            if node not in used:
+                continue  # raced a vanish; the pods re-Pending next event
+            for k, v in request.items():
+                used[node][k] = used[node].get(k, 0.0) + float(v)
+        for name, sums in sorted(used.items()):
+            alloc = nodes[name]
+            for k, v in sums.items():
+                if v > float(alloc.get(k, 0.0)) + EPS:
+                    violations.append(Violation(
+                        "capacity-exceeded", tick,
+                        f"node {name} over-committed on {k}: "
+                        f"{v} used > {alloc.get(k, 0.0)} allocatable",
+                    ))
+        return violations
+
+    # -- 5: convergence (engine calls at drain deadline) ----------------
+    def pending_after_deadline(self, tick: int) -> list[Violation]:
+        with self.cluster._lock:
+            stuck = sorted(
+                (p.group or "?", p.name)
+                for p in self.cluster.pods.values()
+                if p.status == TaskStatus.PENDING
+            )
+        if not stuck:
+            return []
+        groups = sorted({g for g, _n in stuck})
+        return [Violation(
+            "no-convergence", tick,
+            f"{len(stuck)} pod(s) still Pending after the drain "
+            f"deadline (gangs: {', '.join(groups[:8])}"
+            f"{', ...' if len(groups) > 8 else ''})",
+        )]

@@ -1,0 +1,328 @@
+"""Seeded arrival-process generation and the replayable JSONL trace.
+
+The chaos engine's workload is a deterministic function of (spec, seed,
+ticks): Poisson gang arrivals with periodic bursts, seeded gang sizes /
+priorities / queues, planned node add/remove churn, and job completions
+a seeded lifetime after submission.  The whole schedule is generated UP
+FRONT as a flat list of event dicts — one JSON object per line in a
+trace file — so a scenario is:
+
+* **replayable**: a recorded ``.jsonl`` trace feeds the engine instead
+  of a generator (``--scenario trace.jsonl``), and the same trace
+  applies to either backend (`apply_to_cluster` drives the wire-side
+  `ExternalCluster`, `apply_to_sim` the in-process simulator);
+* **diffable**: events are canonical JSON (sorted keys, no whitespace),
+  so two runs' traces diff line-by-line and hash stably
+  (`trace_hash`).
+
+Every object identity (pod/node/group uid) is assigned BY the
+generator — the framework's process-global uid counter would otherwise
+make a second run in the same process produce different uids and break
+same-seed determinism.
+
+Event grammar (all events carry ``tick`` and ``op``)::
+
+    {"tick": -1, "op": "meta",       "seed": s, "bind_fail_pct": p}
+    {"tick": 0, "op": "add-queue",   "name": q, "weight": w}
+    {"tick": 0, "op": "add-node",    "node": {<codec NODE_KEYS dict>}}
+    {"tick": t, "op": "remove-node", "name": n}
+    {"tick": t, "op": "submit",      "group": g, "queue": q,
+     "minMember": k, "priority": p, "pods": [{<codec POD_KEYS dict>}]}
+    {"tick": t, "op": "complete",    "group": g, "uids": [...]}
+
+``complete`` ticks may land past the scenario horizon — the engine
+applies them during its convergence drain so outstanding demand keeps
+freeing capacity.
+
+The ``meta`` header (written first by the engine's ``--trace-out``)
+makes a recorded trace self-describing: replay recovers the seed and
+the bind-curse percentage — both resolved at FIRE time, so they are
+not derivable from the inline events — without the operator
+re-passing them.  It is excluded from `trace_hash` so a recording and
+its replay hash identically.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import math
+import random
+from typing import Iterable
+
+GI = float(1 << 30)
+
+
+@dataclasses.dataclass(frozen=True)
+class ScenarioSpec:
+    """Knobs of the generated arrival process (all seeded)."""
+
+    #: Base nodes present from tick 0 (never churned away).
+    nodes: int = 6
+    node_cpu_milli: float = 8000.0
+    node_mem: float = 16 * GI
+    node_pods: float = 110.0
+    #: Poisson mean of gang arrivals per tick.
+    arrival_rate: float = 0.5
+    #: Every `burst_every` ticks, `burst_size` extra gangs land at once
+    #: (0 disables) — the hostile-traffic spike the north star names.
+    burst_every: int = 25
+    burst_size: int = 3
+    gang_min: int = 1
+    gang_max: int = 5
+    #: Fraction of a gang that must place before any member binds
+    #: (min_member = ceil(frac * size); 1.0 = strict all-or-nothing).
+    min_member_frac: float = 1.0
+    #: Priority levels sampled uniformly per gang.
+    priorities: tuple[int, ...] = (0, 10, 100)
+    #: (name, weight) fair-share queues; gangs sample uniformly.
+    queues: tuple[tuple[str, float], ...] = (
+        ("default", 1.0), ("batch", 2.0),
+    )
+    #: Mean ticks a bound gang runs before completing (geometric).
+    lifetime_mean: float = 30.0
+    #: Every `node_churn_every` ticks an EXTRA node joins or the
+    #: youngest extra leaves (alternating; 0 disables).  Base capacity
+    #: is never churned, so admissible gangs stay admissible.
+    node_churn_every: int = 40
+    #: Arrivals pause while outstanding demand exceeds this fraction of
+    #: BASE capacity — keeps every generated scenario convergent.
+    target_utilization: float = 0.75
+
+
+def _poisson(rng: random.Random, lam: float) -> int:
+    """Knuth's method — fine for the small per-tick rates used here."""
+    if lam <= 0.0:
+        return 0
+    limit = math.exp(-lam)
+    k, p = 0, 1.0
+    while True:
+        p *= rng.random()
+        if p <= limit:
+            return k
+        k += 1
+
+
+def generate(
+    spec: ScenarioSpec, seed: int, ticks: int
+) -> list[dict]:
+    """The full event schedule for one scenario — pure in (spec, seed,
+    ticks), so the same seed always yields the identical trace."""
+    rng = random.Random(f"chaos-workload-{seed}")
+    events: list[dict] = []
+    for name, weight in spec.queues:
+        events.append({
+            "tick": 0, "op": "add-queue", "name": name, "weight": weight,
+        })
+    for i in range(spec.nodes):
+        events.append(_node_event(0, f"base-{i}", spec))
+
+    queue_names = [q for q, _w in spec.queues]
+    total_cpu = spec.nodes * spec.node_cpu_milli
+    total_mem = spec.nodes * spec.node_mem
+    outstanding_cpu = 0.0
+    outstanding_mem = 0.0
+    # (tick, group, uids, cpu, mem) completions keyed by fire tick.
+    completions: list[tuple[int, str, list[str], float, float]] = []
+    extra_nodes: list[str] = []
+    gang_seq = 0
+    extra_seq = 0
+
+    for t in range(ticks):
+        # -- planned node churn (extras only; base capacity is fixed) --
+        if spec.node_churn_every and t and t % spec.node_churn_every == 0:
+            if extra_nodes and rng.random() < 0.5:
+                events.append({
+                    "tick": t, "op": "remove-node",
+                    "name": extra_nodes.pop(),
+                })
+            else:
+                name = f"extra-{seed}-{extra_seq}"
+                extra_seq += 1
+                extra_nodes.append(name)
+                events.append(_node_event(t, name, spec))
+
+        # -- completions due this tick free their demand --------------
+        for done in [c for c in completions if c[0] == t]:
+            completions.remove(done)
+            _dt, group, uids, cpu, mem = done
+            outstanding_cpu -= cpu
+            outstanding_mem -= mem
+            events.append({
+                "tick": t, "op": "complete", "group": group, "uids": uids,
+            })
+
+        # -- arrivals (Poisson + periodic burst), capacity-gated ------
+        n = _poisson(rng, spec.arrival_rate)
+        if spec.burst_every and t and t % spec.burst_every == 0:
+            n += spec.burst_size
+        for _ in range(n):
+            size = rng.randint(spec.gang_min, spec.gang_max)
+            cpu_per = float(rng.choice([250, 500, 1000, 2000]))
+            mem_per = float(rng.choice([1, 2, 4])) * GI
+            gang_cpu, gang_mem = size * cpu_per, size * mem_per
+            if (
+                outstanding_cpu + gang_cpu
+                > spec.target_utilization * total_cpu
+                or outstanding_mem + gang_mem
+                > spec.target_utilization * total_mem
+            ):
+                continue  # backlogged: keep the scenario convergent
+            group = f"gang-{seed}-{gang_seq}"
+            gang_seq += 1
+            queue = rng.choice(queue_names)
+            priority = rng.choice(spec.priorities)
+            min_member = max(1, math.ceil(spec.min_member_frac * size))
+            pods = [
+                {
+                    "name": f"{group}-{i}",
+                    "uid": f"uid-{group}-{i}",
+                    "group": group,
+                    "priority": priority,
+                    "request": {
+                        "cpu": cpu_per, "memory": mem_per, "pods": 1.0,
+                    },
+                }
+                for i in range(size)
+            ]
+            events.append({
+                "tick": t, "op": "submit", "group": group, "queue": queue,
+                "minMember": min_member, "priority": priority, "pods": pods,
+            })
+            outstanding_cpu += gang_cpu
+            outstanding_mem += gang_mem
+            lifetime = max(1, int(rng.expovariate(1.0 / spec.lifetime_mean)))
+            completions.append((
+                t + 1 + lifetime, group,
+                [p["uid"] for p in pods], gang_cpu, gang_mem,
+            ))
+
+    # Outstanding jobs complete past the horizon (the engine applies
+    # these during its convergence drain so capacity keeps freeing).
+    for when, group, uids, _cpu, _mem in sorted(completions):
+        events.append({
+            "tick": when, "op": "complete", "group": group, "uids": uids,
+        })
+    return events
+
+
+def _node_event(tick: int, name: str, spec: ScenarioSpec) -> dict:
+    return {
+        "tick": tick, "op": "add-node",
+        "node": {
+            "uid": f"uid-node-{name}",
+            "name": name,
+            "allocatable": {
+                "cpu": spec.node_cpu_milli,
+                "memory": spec.node_mem,
+                "pods": spec.node_pods,
+            },
+        },
+    }
+
+
+# -- trace format ------------------------------------------------------
+
+def trace_lines(events: Iterable[dict]) -> list[str]:
+    """Canonical JSONL: sorted keys, no whitespace — diffable and
+    hash-stable across runs."""
+    return [
+        json.dumps(e, sort_keys=True, separators=(",", ":"))
+        for e in events
+    ]
+
+
+def trace_hash(events: Iterable[dict]) -> str:
+    h = hashlib.sha256()
+    for line in trace_lines(events):
+        h.update(line.encode())
+        h.update(b"\n")
+    return h.hexdigest()
+
+
+def write_trace(path: str, events: Iterable[dict]) -> None:
+    with open(path, "w", encoding="utf-8") as f:
+        for line in trace_lines(events):
+            f.write(line + "\n")
+
+
+def read_trace(path: str) -> list[dict]:
+    events = []
+    with open(path, "r", encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                events.append(json.loads(line))
+    return events
+
+
+# -- appliers (trace event → world mutation) ---------------------------
+
+def _decode_submit(ev: dict):
+    from kube_batch_tpu.cache.cluster import PodGroup
+    from kube_batch_tpu.client.codec import decode_pod
+
+    group = PodGroup(
+        name=ev["group"],
+        queue=ev.get("queue", ""),
+        min_member=int(ev.get("minMember", 1)),
+        priority=int(ev.get("priority", 0)),
+        uid=f"uid-pg-{ev['group']}",
+    )
+    pods = [decode_pod(p) for p in ev["pods"]]
+    return group, pods
+
+
+def apply_to_cluster(cluster, ev: dict) -> None:
+    """Apply one trace event to the authoritative wire-side cluster
+    (`client.external.ExternalCluster`): the scheduler only ever learns
+    about it through the watch stream."""
+    from kube_batch_tpu.cache.cluster import Queue
+    from kube_batch_tpu.client.codec import decode_node
+
+    op = ev["op"]
+    if op == "add-queue":
+        cluster.add_queue(Queue(
+            name=ev["name"], weight=float(ev.get("weight", 1.0)),
+            uid=f"uid-queue-{ev['name']}",
+        ))
+    elif op == "add-node":
+        cluster.add_node(decode_node(ev["node"]))
+    elif op == "remove-node":
+        cluster.delete_node(ev["name"])
+    elif op == "submit":
+        group, pods = _decode_submit(ev)
+        cluster.submit(group, pods)
+    elif op == "complete":
+        cluster.complete_group(ev["group"])
+    else:
+        raise ValueError(f"unknown trace op {op!r}")
+
+
+def apply_to_sim(sim, ev: dict) -> None:
+    """Apply one trace event to the in-process simulator (the fast,
+    thread-free backend) — same grammar, so a recorded chaos trace
+    doubles as an offline workload for oracle/regression runs."""
+    from kube_batch_tpu.cache.cluster import Queue
+    from kube_batch_tpu.client.codec import decode_node
+
+    op = ev["op"]
+    if op == "add-queue":
+        sim.add_queue(Queue(
+            name=ev["name"], weight=float(ev.get("weight", 1.0)),
+            uid=f"uid-queue-{ev['name']}",
+        ))
+    elif op == "add-node":
+        sim.add_node(decode_node(ev["node"]))
+    elif op == "remove-node":
+        sim.delete_node(ev["name"])
+    elif op == "submit":
+        group, pods = _decode_submit(ev)
+        sim.submit(group, pods)
+    elif op == "complete":
+        for uid in ev.get("uids", []):
+            sim.delete_pod(uid)
+        sim.delete_pod_group(ev["group"])
+    else:
+        raise ValueError(f"unknown trace op {op!r}")

@@ -251,7 +251,11 @@ def run_external(args) -> int:
     import time
 
     from kube_batch_tpu.cache.cache import SchedulerCache
-    from kube_batch_tpu.client.adapter import LeaseElector, StreamBackend
+    from kube_batch_tpu.client.adapter import (
+        LeaseElector,
+        StreamBackend,
+        resume_session,
+    )
     from kube_batch_tpu.client.k8s import K8sWatchAdapter
 
     host, _, port = args.cluster_stream.rpartition(":")
@@ -292,7 +296,10 @@ def run_external(args) -> int:
     state = {"sock": sock, "adapter": adapter}
 
     def reconnect_once(old, since: int):
-        """One dial + resume attempt; returns (sock, adapter)."""
+        """One dial + resume attempt; returns (sock, adapter).  The
+        resume-or-relist tail (incl. the quiesce-before-clear guard)
+        is the shared `client.adapter.resume_session` helper — the
+        chaos engine's reconnect path runs the identical recovery."""
         nsock, nreader, nwriter = dial()
         try:
             backend.reconnect(nwriter)
@@ -303,33 +310,7 @@ def run_external(args) -> int:
             nadapter.resource_versions.update(old.resource_versions)
             nadapter.list_rv = old.list_rv
             nadapter.start()
-            try:
-                backend.watch_resume(since)
-                logging.info(
-                    "cluster stream reconnected; watch resumed from "
-                    "rv %d", since,
-                )
-            except RuntimeError as exc:
-                # The 410-Gone analog: the missed tail is unservable.
-                # Stateless recovery IN-PROCESS: drop the mirror,
-                # re-list, keep the Scheduler + compiled executables.
-                logging.warning(
-                    "watch gap (%s); re-listing in-process", exc,
-                )
-                # Quiesce scheduling BEFORE the clear: from here until
-                # the replay completes the mirror is a consistent
-                # prefix of the cluster (nodes present, their pods not
-                # yet), and a cycle packed from it would see phantom
-                # idle capacity and dispatch real overcommitting binds.
-                # snapshot() raises CacheResyncing under the cache lock
-                # until end_resync below (or a later successful retry —
-                # a failed attempt leaves the flag set on purpose).
-                cache.begin_resync()
-                cache.clear()
-                backend.request_list()
-            if not nadapter.wait_for_sync(60.0):
-                raise TimeoutError("resume replay never completed")
-            cache.end_resync()
+            resume_session(cache, backend, nadapter, since)
             return nsock, nadapter
         except BaseException:
             nsock.close()
@@ -514,6 +495,23 @@ def acquire_leadership(lock_file: str):
     return f
 
 
+def honor_jax_platforms() -> None:
+    """Honor JAX_PLATFORMS even under site customizations that pin the
+    platform at interpreter startup (e.g. a tunneled-device image):
+    the env var alone loses there, and a wedged device tunnel then
+    HANGS the daemon in backend init.  JAX_PLATFORMS=cpu must always
+    give an operator a working CPU daemon.  Must run before first
+    device use (same handling as kube_batch_tpu/warm.py); shared with
+    the chaos CLI (kube_batch_tpu.chaos.__main__)."""
+    if os.environ.get("JAX_PLATFORMS"):
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+        except Exception as exc:  # noqa: BLE001 — backend may be up already
+            logging.warning("could not honor JAX_PLATFORMS: %s", exc)
+
+
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
     if args.version:
@@ -523,19 +521,7 @@ def main(argv: list[str] | None = None) -> int:
         level=logging.INFO,
         format="%(asctime)s %(levelname)s %(name)s: %(message)s",
     )
-    # Honor JAX_PLATFORMS even under site customizations that pin the
-    # platform at interpreter startup (e.g. a tunneled-device image):
-    # the env var alone loses there, and a wedged device tunnel then
-    # HANGS the daemon in backend init.  JAX_PLATFORMS=cpu must always
-    # give an operator a working CPU daemon.  Must run before first
-    # device use (same handling as kube_batch_tpu/warm.py).
-    if os.environ.get("JAX_PLATFORMS"):
-        import jax
-
-        try:
-            jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
-        except Exception as exc:  # noqa: BLE001 — backend may be up already
-            logging.warning("could not honor JAX_PLATFORMS: %s", exc)
+    honor_jax_platforms()
 
     from kube_batch_tpu.compile_cache import enable_compile_cache
 

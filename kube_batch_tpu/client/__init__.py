@@ -15,6 +15,7 @@ from kube_batch_tpu.client.adapter import (
     LeaseElector,
     StreamBackend,
     WatchAdapter,
+    resume_session,
 )
 from kube_batch_tpu.client.external import ExternalCluster
 from kube_batch_tpu.client.k8s import K8sWatchAdapter
@@ -25,4 +26,5 @@ __all__ = [
     "ExternalCluster",
     "LeaseElector",
     "K8sWatchAdapter",
+    "resume_session",
 ]

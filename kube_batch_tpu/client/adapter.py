@@ -277,6 +277,49 @@ class LeaseElector:
             pass           # way down; expiry reclaims it regardless
 
 
+def resume_session(
+    cache: SchedulerCache,
+    backend: StreamBackend,
+    adapter: "WatchAdapter",
+    since: int,
+    sync_timeout: float = 60.0,
+) -> str:
+    """Resume a reconnected watch session from `since` — the shared
+    tail of every reconnect path (CLI supervisor, chaos engine).
+
+    Caller contract: `backend.reconnect(new_writer)` already ran and
+    `adapter` (a fresh adapter on the new reader, RVs carried over) is
+    started.  Returns "resumed" when the cluster served the missed
+    tail, "relisted" when the 410-Gone analog forced the in-process
+    stateless recovery: scheduling is quiesced (snapshot() raises
+    CacheResyncing under the cache lock) BEFORE the mirror is dropped —
+    between clear() and the LIST replay completing the cache is a
+    consistent prefix of the cluster (nodes present, their bound pods
+    not yet replayed), and a cycle packed from it would see phantom
+    idle capacity and dispatch real overcommitting binds.  Raises
+    TimeoutError when the replay never completes — the resync flag is
+    left set on purpose so no cycle schedules against the torn mirror
+    until a later attempt succeeds."""
+    mode = "resumed"
+    try:
+        backend.watch_resume(since)
+        log.info("cluster stream reconnected; watch resumed from rv %d",
+                 since)
+    except RuntimeError as exc:
+        # The 410-Gone analog: the missed tail is unservable.
+        # Stateless recovery IN-PROCESS: drop the mirror, re-list,
+        # keep the Scheduler + compiled executables.
+        log.warning("watch gap (%s); re-listing in-process", exc)
+        cache.begin_resync()
+        cache.clear()
+        backend.request_list()
+        mode = "relisted"
+    if not adapter.wait_for_sync(sync_timeout):
+        raise TimeoutError("resume replay never completed")
+    cache.end_resync()
+    return mode
+
+
 class WatchAdapter:
     """Reads the watch stream and drives the cache's event handlers.
 

@@ -218,6 +218,37 @@ class ExternalCluster:
                     pod.status = TaskStatus.RUNNING
                     self._emit("MODIFIED", "Pod", encode_pod(pod))
 
+    def delete_pod(self, uid: str) -> None:
+        """Remove a pod for good (a controller garbage-collecting a
+        finished workload — unlike evict, nothing recreates it)."""
+        with self._lock:
+            pod = self.pods.pop(uid, None)
+            if pod is None:
+                return
+            key = (pod.namespace, pod.name)
+            if self._pods_by_name.get(key) == uid:
+                self._pods_by_name.pop(key, None)
+            self._emit("DELETED", "Pod",
+                       {"uid": pod.uid, "name": pod.name})
+
+    def complete_group(self, name: str) -> None:
+        """A whole job finishes: its pods and PodGroup are deleted
+        (the controller reaping a Succeeded workload) — the watch
+        stream carries the teardown like any other churn."""
+        with self._lock:
+            group = self.groups.pop(name, None)
+            for uid in [u for u, p in self.pods.items() if p.group == name]:
+                self.delete_pod(uid)
+            if group is not None:
+                self._emit("DELETED", "PodGroup", encode_pod_group(group))
+
+    def expire_history(self) -> None:
+        """Drop the watch-event history ring (≙ apiserver etcd
+        compaction): the next `watchResume` over any missed tail is
+        forced onto the 410-Gone path and the client must re-list."""
+        with self._lock:
+            self._history.clear()
+
     # -- the serve loop (scheduler write requests) ----------------------
     def start(self) -> "ExternalCluster":
         with self._lock:

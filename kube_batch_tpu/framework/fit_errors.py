@@ -22,6 +22,12 @@ from kube_batch_tpu.api.snapshot import SnapshotTensors, fits
 from kube_batch_tpu.api.types import TaskStatus
 from kube_batch_tpu.ops.assignment import AllocState
 
+#: Per-cycle cap on rendered unschedulable events (diagnose_pending's
+#: default) — the single source of truth failure_counts_subset validates
+#: its window against: every consumed row must sit inside the gathered
+#: [P, N] subset, so the consumer's event cap must stay BELOW max_rows.
+MAX_DIAG_EVENTS = 1000
+
 
 def failure_counts(
     snap: SnapshotTensors,
@@ -71,6 +77,7 @@ def failure_counts_subset(
     state: AllocState,
     policy,
     max_rows: int = 2048,
+    max_events: int | None = MAX_DIAG_EVENTS,
 ) -> dict[str, jnp.ndarray]:
     """failure_counts restricted to the (bounded) pending set, scattered
     back to [T] — the active-set diagnosis.
@@ -93,7 +100,23 @@ def failure_counts_subset(
     Purely data-flow (gather/compute/scatter, no lax.cond): shape-
     preserving control flow is what trips the XLA:TPU compile cliff
     (BASELINE.md round-5 negative result); gathers do not.
+
+    `max_events` is the CONSUMER's per-cycle event cap (diagnose_pending
+    walks at most that many pending rows): the exactness argument above
+    requires it to stay below `max_rows`, and this function enforces
+    that in code instead of prose — shrinking `max_rows` below the cap
+    would silently scatter consumed rows back as all-zero tallies,
+    rendering as misleading "0/N nodes available:" events with no
+    reasons.  A caller that consumes rows by its own window rule (tests
+    probing small windows, benchmarks) opts out with `max_events=None`.
     """
+    if max_events is not None and max_events >= max_rows:
+        raise ValueError(
+            f"failure_counts_subset: max_events={max_events} must stay "
+            f"below max_rows={max_rows} — pending rows beyond the "
+            "gathered window scatter back as all-zero tallies and would "
+            "render as '0/N nodes available:' events with no reasons"
+        )
     from kube_batch_tpu.cache.packer import gather_tasks
 
     if not policy.has_subset_dynamic_predicates:
@@ -166,7 +189,7 @@ def render_fit_error(
 
 
 def diagnose_pending(
-    ssn, max_events: int = 1000
+    ssn, max_events: int = MAX_DIAG_EVENTS
 ) -> list[tuple[str, str, str]]:
     """(pod name, namespace, message) triples for real tasks still
     Pending at session end — the caller attaches each to its pod as a

@@ -46,6 +46,21 @@ class SimulatedCluster:
     def add_node(self, node: Node) -> None:
         self.cache.add_node(node)
 
+    def delete_node(self, name: str) -> None:
+        """Node vanishes (power loss / cordoned away): the cache
+        unplaces its residents, which re-enter Pending for rescheduling
+        — same semantics as ExternalCluster.delete_node, so a chaos
+        trace replays identically against either backend."""
+        self.cache.delete_node(name)
+
+    def delete_pod(self, uid: str) -> None:
+        """Remove a pod for good (controller reaping a finished
+        workload) — unlike evict, nothing recreates it."""
+        self.cache.delete_pod(uid)
+
+    def delete_pod_group(self, name: str) -> None:
+        self.cache.delete_pod_group(name)
+
     def submit(self, group: PodGroup, pods: list[Pod]) -> None:
         """One job arriving: PodGroup object plus its member pods."""
         self.cache.add_pod_group(group)

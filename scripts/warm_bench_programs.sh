@@ -11,19 +11,31 @@
 # everyone behind them) — the per-child timeout is the only guard.
 #
 # Usage: nohup scripts/warm_bench_programs.sh [wait_pid] &
-cd "$(dirname "$0")/.."
-LOG=/tmp/warm_bench.log
-T=2700
+#
+# Env knobs: PYTHON (interpreter, default python3), WARM_BENCH_LOG
+# (log path, default /tmp/warm_bench.log), WARM_BENCH_TIMEOUT
+# (per-child seconds, default 2700).
+set -euo pipefail
+cd "$(dirname "$0")/.." || {
+  echo "warm_bench_programs.sh: cannot cd to repo root" >&2
+  exit 1
+}
+PY="${PYTHON:-python3}"
+LOG="${WARM_BENCH_LOG:-/tmp/warm_bench.log}"
+T="${WARM_BENCH_TIMEOUT:-2700}"
 
-if [ -n "$1" ]; then
+if [ -n "${1:-}" ]; then
   echo "$(date +%T) waiting for in-flight warm child pid $1" >>"$LOG"
   while kill -0 "$1" 2>/dev/null; do sleep 15; done
 fi
 
 one() {
   echo "$(date +%T) warming: $1" >>"$LOG"
-  timeout "$T" python -m kube_batch_tpu.warm --_one "$1" >>"$LOG" 2>&1
-  echo "$(date +%T) rc=$? for: $1" >>"$LOG"
+  # Warming is best-effort per child (a timeout must not abort the
+  # queue under set -e), but the rc is always recorded loudly.
+  local rc=0
+  timeout "$T" "$PY" -m kube_batch_tpu.warm --_one "$1" >>"$LOG" 2>&1 || rc=$?
+  echo "$(date +%T) rc=$rc for: $1" >>"$LOG"
 }
 
 one '{"config": 4, "actions": ["allocate", "backfill", "preempt", "reclaim"], "conf": null}'
@@ -32,7 +44,8 @@ one '{"config": 3, "actions": ["allocate", "backfill"], "conf": null}'
 one '{"config": 1, "actions": ["allocate"], "conf": null}'
 
 echo "$(date +%T) warming: headline allocate solver" >>"$LOG"
-timeout "$T" python - >>"$LOG" 2>&1 <<'EOF'
+rc=0
+timeout "$T" "$PY" - >>"$LOG" 2>&1 <<'EOF' || rc=$?
 # Mirrors bench.run_headline's compile exactly (same policy, same
 # world, same jit of make_allocate_solver) so the cache key matches.
 from kube_batch_tpu.compile_cache import enable_compile_cache
@@ -57,7 +70,7 @@ solve.lower(snap, init_state(snap)).compile()
 print({"headline_allocate_compile_s": round(time.monotonic() - t0, 1),
        "device": jax.devices()[0].platform})
 EOF
-echo "$(date +%T) rc=$? for: headline" >>"$LOG"
+echo "$(date +%T) rc=$rc for: headline" >>"$LOG"
 
 one '{"config": 5, "actions": ["allocate", "backfill"], "conf": null}'
 

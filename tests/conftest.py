@@ -26,3 +26,16 @@ os.environ["XLA_FLAGS"] = _flags
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+# Persistent XLA compile cache for the suite: many test files compile
+# the IDENTICAL default-policy programs at the same tiny shape buckets,
+# and on CPU each costs seconds — across ~25 files that dominates suite
+# wall-clock.  The cache is keyed on the HLO fingerprint (code changes
+# miss cleanly) and also survives into the next pytest invocation, so
+# tier-1 reruns replay instead of recompiling.
+from kube_batch_tpu.compile_cache import enable_compile_cache  # noqa: E402
+
+if enable_compile_cache("/tmp/kube-batch-tpu-test-xla-cache"):
+    # The daemon-facing default (1 s) skips the suite's many ~0.3-1 s
+    # helper compiles; at test scale those add up to minutes.
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)

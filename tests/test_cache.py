@@ -113,3 +113,87 @@ def test_pack_vocabularies_config3():
     # every real task row maps to a valid job
     tj = np.asarray(snap.task_job)[: meta.num_real_tasks]
     assert tj.min() >= 0 and tj.max() < len(meta.job_names)
+
+
+def test_arrival_stamp_consumed_on_external_transition():
+    """ADVICE round-5: a pod flipped to RUNNING by an EXTERNAL status
+    update (stamp never consumed by a bind) must drop its arrival
+    stamp, so re-entering PENDING always restamps — bind latency is
+    never inflated by externally-driven RUNNING time, and stamps never
+    linger until pod removal."""
+    cache, sim = make_world(SPEC)
+    sim.add_node(Node(name="n0", allocatable={"cpu": 4000, "memory": 8 * GI}))
+    pg = PodGroup(name="g", queue="default", min_member=1)
+    pod = Pod(name="p0", group="g", request={"cpu": 1000, "memory": 2 * GI})
+    sim.submit(pg, [pod])
+    assert pod.uid in cache._arrival_ts
+    first = cache._arrival_ts[pod.uid]
+
+    # External controller flips it to RUNNING (no bind consumed it).
+    cache.update_pod_status(pod.uid, TaskStatus.RUNNING, node="n0")
+    assert pod.uid not in cache._arrival_ts  # no lingering stamp
+
+    # Re-entering PENDING starts a FRESH latency clock.
+    cache.update_pod_status(pod.uid, TaskStatus.PENDING)
+    assert cache._arrival_ts[pod.uid] >= first
+
+
+def test_arrival_stamp_survives_failed_bind_and_feeds_latency():
+    """The failed-bind retry keeps the ORIGINAL arrival (the stamp was
+    never consumed), and a successful bind still observes the latency
+    histogram exactly once."""
+    from kube_batch_tpu import metrics
+
+    cache, sim = make_world(SPEC)
+    sim.add_node(Node(name="n0", allocatable={"cpu": 4000, "memory": 8 * GI}))
+    pg = PodGroup(name="g", queue="default", min_member=1)
+    pod = Pod(name="p0", group="g", request={"cpu": 1000, "memory": 2 * GI})
+    sim.submit(pg, [pod])
+    original = cache._arrival_ts[pod.uid]
+
+    fails = {"n": 1}
+
+    class FlakyBinder:
+        def bind(self, p, node):
+            if fails["n"]:
+                fails["n"] -= 1
+                raise RuntimeError("transient")
+            sim.bind(p, node)
+
+    cache.binder = FlakyBinder()
+    before = metrics.task_scheduling_latency.count()
+    assert not cache.bind(pod.uid, "n0")      # BINDING → rollback PENDING
+    assert cache._arrival_ts[pod.uid] == original  # original clock kept
+    assert cache.bind(pod.uid, "n0")
+    assert pod.uid not in cache._arrival_ts   # consumed by the bind
+    assert metrics.task_scheduling_latency.count() == before + 1
+
+
+def test_arrival_stamp_survives_watch_echo_of_own_bind():
+    """Wire mode: the cluster echoes the scheduler's OWN successful
+    bind back as a MODIFIED(BOUND) watch event, and the adapter thread
+    can apply it while the pod is still BINDING — before cache.bind()
+    reacquires the lock to consume the stamp.  The echo must NOT pop
+    the stamp (the in-flight bind owns it), or the latency observation
+    is silently dropped."""
+    from kube_batch_tpu import metrics
+
+    cache, sim = make_world(SPEC)
+    sim.add_node(Node(name="n0", allocatable={"cpu": 4000, "memory": 8 * GI}))
+    pg = PodGroup(name="g", queue="default", min_member=1)
+    pod = Pod(name="p0", group="g", request={"cpu": 1000, "memory": 2 * GI})
+    sim.submit(pg, [pod])
+
+    class EchoingBinder:
+        """Applies the watch echo synchronously inside bind() — the
+        worst-case interleaving of the adapter reader thread."""
+
+        def bind(self, p, node):
+            sim.bind(p, node)
+            cache.update_pod_status(p.uid, TaskStatus.BOUND)
+
+    cache.binder = EchoingBinder()
+    before = metrics.task_scheduling_latency.count()
+    assert cache.bind(pod.uid, "n0")
+    assert pod.uid not in cache._arrival_ts
+    assert metrics.task_scheduling_latency.count() == before + 1

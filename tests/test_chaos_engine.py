@@ -1,0 +1,308 @@
+"""Chaos scenario engine: determinism, invariant checking, recovery.
+
+The engine drives the REAL scheduler through the production wire stack
+(StreamBackend/WatchAdapter over a socketpair against an instrumented
+ExternalCluster) — these tests pin the three properties the subsystem
+exists for:
+
+* same seed ⇒ identical trace hash and identical final assignment;
+* a deliberately corrupted tick (forced double-bind) is caught, fails
+  the run, and writes a flight-recorder post-mortem;
+* injected faults (stream drop, 410 watch gap, node vanish, cursed
+  binds, lease steal) all recover and the scenario still converges.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+
+import pytest
+
+from kube_batch_tpu.chaos import (
+    ChaosCluster,
+    ChaosEngine,
+    FaultSpec,
+    InvariantChecker,
+    ScenarioSpec,
+    apply_to_sim,
+    generate,
+    read_trace,
+    trace_hash,
+    write_trace,
+)
+
+# Small, fast worlds: every engine run below compiles a handful of tiny
+# fused-cycle shapes on CPU and then replays them.
+SCENARIO = ScenarioSpec(
+    nodes=4,
+    arrival_rate=0.6,
+    burst_every=8,
+    burst_size=2,
+    gang_max=3,
+    lifetime_mean=10.0,
+    node_churn_every=9,
+)
+FAULTS = FaultSpec(
+    stream_drop_every=7,
+    gap_every=13,
+    bind_fail_pct=20,
+    node_vanish_every=11,
+    heal_after=3,
+    lease_steal_every=9,
+)
+
+
+def _engine(**kw) -> ChaosEngine:
+    defaults = dict(seed=3, ticks=16, scenario=SCENARIO, faults=FAULTS,
+                    drain=40)
+    defaults.update(kw)
+    return ChaosEngine(**defaults)
+
+
+# -- workload generator / trace format ---------------------------------
+
+def test_workload_generation_is_deterministic(tmp_path):
+    a = generate(SCENARIO, seed=11, ticks=40)
+    b = generate(SCENARIO, seed=11, ticks=40)
+    assert a == b
+    assert trace_hash(a) == trace_hash(b)
+    assert trace_hash(a) != trace_hash(generate(SCENARIO, 12, 40))
+
+    path = tmp_path / "trace.jsonl"
+    write_trace(str(path), a)
+    assert read_trace(str(path)) == a
+
+
+def test_trace_applies_to_in_process_sim():
+    """The same trace grammar drives the thread-free simulator — a
+    recorded chaos workload doubles as an offline regression world."""
+    from kube_batch_tpu.api.resource import ResourceSpec
+    from kube_batch_tpu.sim.simulator import make_world
+
+    events = generate(SCENARIO, seed=11, ticks=40)
+    _cache, sim = make_world(ResourceSpec())
+    for ev in sorted(events, key=lambda e: e["tick"]):
+        apply_to_sim(sim, ev)
+    with sim.cache.lock():
+        assert len(sim.cache._nodes) >= SCENARIO.nodes
+    assert any(e["op"] == "submit" for e in events)
+    assert any(e["op"] == "complete" for e in events)
+
+
+# -- the three headline properties -------------------------------------
+
+def test_same_seed_identical_trace_and_assignment(tmp_path):
+    trace = tmp_path / "scenario.jsonl"
+    r1 = _engine(trace_path=str(trace)).run()
+    r2 = _engine().run()
+    assert r1.ok and r2.ok, (r1.violations, r2.violations)
+    assert r1.trace_hash == r2.trace_hash
+    assert r1.final_assignment == r2.final_assignment
+    assert r1.final_assignment, "vacuous scenario: nothing ever bound"
+
+    # And a RECORDED trace replays to the same behavior byte-for-byte.
+    # The fault schedule rides inline; the trace's meta header carries
+    # the recording's seed + bind_fail_pct (curses are seed+uid-hash
+    # decisions), so NO explicit FaultSpec is needed on replay.
+    recorded = read_trace(str(trace))
+    assert recorded[0] == {
+        "tick": -1, "op": "meta", "seed": 3,
+        "bind_fail_pct": FAULTS.bind_fail_pct,
+    }
+    replay = ChaosEngine(
+        seed=3, ticks=16, events=recorded, drain=40,
+    )
+    assert replay.faults.bind_fail_pct == FAULTS.bind_fail_pct
+    r3 = replay.run()
+    assert r3.ok
+    assert r3.trace_hash == r1.trace_hash
+    assert r3.final_assignment == r1.final_assignment
+
+
+def test_corrupted_tick_is_caught_and_dumped(tmp_path):
+    """Invariant-checker self-test: a forced double-bind behind the
+    scheduler's back MUST fail the run and write the post-mortem."""
+    result = _engine(
+        faults=FaultSpec.none(), corrupt_tick=10, ticks=14,
+        dump_dir=str(tmp_path),
+    ).run()
+    assert not result.ok
+    assert "double-bind" in {v.kind for v in result.violations}
+    assert result.dump_path is not None
+    with open(result.dump_path, encoding="utf-8") as f:
+        dump = json.load(f)
+    assert dump["meta"]["violations"]
+    assert any(
+        "corruption" in tick for tick in dump["ticks"]
+    ), "flight recorder lost the corrupted tick"
+
+
+def test_faults_recover_and_converge():
+    result = _engine(seed=5, ticks=27).run()
+    assert result.ok, result.violations
+    assert result.converged_tick is not None
+    # Every headline fault class fired at least once in 27 ticks...
+    assert result.faults.get("stream-drop", 0) >= 1
+    assert result.faults.get("watch-gap", 0) >= 1
+    assert result.faults.get("node-vanish", 0) >= 1
+    assert result.faults.get("lease-steal", 0) >= 1
+    # ... and the matching recoveries were observed.
+    assert result.recoveries.get("resumed", 0) >= 1
+    assert result.recoveries.get("relisted", 0) >= 1
+    assert result.recoveries.get("node-healed", 0) >= 1
+    assert result.recoveries.get("lease-reacquired", 0) >= 1
+
+
+# -- invariant checker unit behavior (no wire, no scheduler) -----------
+
+def _mini_cluster() -> ChaosCluster:
+    from kube_batch_tpu.cache.cluster import Node, Pod, PodGroup
+
+    cluster = ChaosCluster(seed=0, bind_fail_pct=0)
+    cluster.add_node(Node(name="n0", allocatable={"cpu": 1000.0}))
+    cluster.add_node(Node(name="n1", allocatable={"cpu": 1000.0}))
+    cluster.submit(
+        PodGroup(name="g", queue="default", min_member=2),
+        [Pod(name=f"g-{i}", uid=f"uid-g-{i}", request={"cpu": 800.0})
+         for i in range(2)],
+    )
+    return cluster
+
+
+def test_checker_flags_partial_gang_first_wave():
+    cluster = _mini_cluster()
+    checker = InvariantChecker(cluster)
+    w = io.StringIO()
+    # Only ONE of the two min_member pods gets a bind attempt: a
+    # non-Ready gang leaked through the gate.
+    cluster._handle(w, {"type": "REQUEST", "id": 1, "verb": "bind",
+                        "pod": "uid-g-0", "node": "n0"})
+    kinds = {v.kind for v in checker.check_tick(0)}
+    assert "gang-partial-bind" in kinds
+
+
+def test_checker_flags_capacity_overcommit():
+    cluster = _mini_cluster()
+    checker = InvariantChecker(cluster)
+    w = io.StringIO()
+    # Both 800-cpu pods land on the same 1000-cpu node.
+    for i in (0, 1):
+        cluster._handle(w, {"type": "REQUEST", "id": i + 1,
+                            "verb": "bind", "pod": f"uid-g-{i}",
+                            "node": "n0"})
+    kinds = {v.kind for v in checker.check_tick(0)}
+    assert "capacity-exceeded" in kinds
+    assert "gang-partial-bind" not in kinds  # both members attempted
+
+
+def test_checker_accepts_clean_gang_bind():
+    cluster = _mini_cluster()
+    checker = InvariantChecker(cluster)
+    w = io.StringIO()
+    for i in (0, 1):
+        cluster._handle(w, {"type": "REQUEST", "id": i + 1,
+                            "verb": "bind", "pod": f"uid-g-{i}",
+                            "node": f"n{i}"})
+    assert checker.check_tick(0) == []
+    # A rebind without any intervening unplacement is a double bind
+    # (the cluster now also shows n1 over-committed — both flags fire).
+    cluster._handle(w, {"type": "REQUEST", "id": 9, "verb": "bind",
+                        "pod": "uid-g-0", "node": "n1"})
+    kinds = {v.kind for v in checker.check_tick(1)}
+    assert "double-bind" in kinds
+    # Evicting a placed pod unplaces it cleanly; evicting it AGAIN
+    # (now unplaced) is unaccounted.
+    cluster._handle(w, {"type": "REQUEST", "id": 10, "verb": "evict",
+                        "pod": "uid-g-1", "reason": "test"})
+    assert checker.check_tick(2) == []
+    cluster._handle(w, {"type": "REQUEST", "id": 11, "verb": "evict",
+                        "pod": "uid-g-1", "reason": "test"})
+    kinds = {v.kind for v in checker.check_tick(3)}
+    assert "eviction-unaccounted" in kinds
+
+
+# -- the CLI -----------------------------------------------------------
+
+def test_cli_exit_codes(tmp_path, capsys):
+    from kube_batch_tpu.chaos.__main__ import main
+
+    rc = main([
+        "--seed", "3", "--ticks", "8", "--quiet",
+        "--dump-dir", str(tmp_path),
+        "--trace-out", str(tmp_path / "t.jsonl"),
+    ])
+    summary = json.loads(capsys.readouterr().out)
+    assert rc == 0 and summary["ok"] is True
+    assert (tmp_path / "t.jsonl").exists()
+
+    rc = main([
+        "--seed", "3", "--ticks", "10", "--quiet", "--no-faults",
+        "--corrupt-tick", "6", "--dump-dir", str(tmp_path),
+    ])
+    summary = json.loads(capsys.readouterr().out)
+    assert rc == 1 and summary["ok"] is False
+    assert summary["flight_recorder"]
+
+
+def test_cli_replay_resolution(tmp_path, monkeypatch, capsys):
+    """CLI-level replay semantics, no engine run: --seed defaults to
+    the trace's meta header, and --no-faults strips the recorded
+    inline fault events (not just the bind-curse percentage)."""
+    from kube_batch_tpu.chaos import __main__ as chaos_main
+
+    trace = tmp_path / "t.jsonl"
+    write_trace(str(trace), [
+        {"tick": -1, "op": "meta", "seed": 42, "bind_fail_pct": 35},
+        {"tick": 0, "op": "add-queue", "name": "default", "weight": 1.0},
+        {"tick": 1, "op": "fault", "kind": "stream-drop"},
+    ])
+
+    captured = {}
+
+    class FakeResult:
+        ok = True
+
+        def summary(self):
+            return {"ok": True}
+
+    class FakeEngine:
+        def __init__(self, **kw):
+            captured.clear()
+            captured.update(kw)
+
+        def run(self):
+            return FakeResult()
+
+    monkeypatch.setattr(chaos_main, "ChaosEngine", FakeEngine)
+
+    assert chaos_main.main(["--quiet", "--scenario", str(trace)]) == 0
+    capsys.readouterr()
+    assert captured["seed"] == 42          # adopted from the meta header
+    assert captured["faults"] is None      # engine adopts bind_fail_pct
+    assert any(e["op"] == "fault" for e in captured["events"])
+
+    assert chaos_main.main(
+        ["--quiet", "--scenario", str(trace), "--no-faults"]
+    ) == 0
+    capsys.readouterr()
+    assert captured["seed"] == 42
+    assert captured["faults"] == FaultSpec.none()
+    assert not any(e["op"] == "fault" for e in captured["events"])
+
+    # An explicit --seed still wins over the header.
+    assert chaos_main.main(
+        ["--quiet", "--scenario", str(trace), "--seed", "9"]
+    ) == 0
+    capsys.readouterr()
+    assert captured["seed"] == 9
+
+
+# -- long soak (excluded from tier-1) ----------------------------------
+
+@pytest.mark.slow
+def test_chaos_soak_default_scenario():
+    """The `make chaos` configuration, full length."""
+    result = ChaosEngine(seed=7, ticks=200).run()
+    assert result.ok, result.violations
+    assert result.converged_tick is not None

@@ -11,6 +11,8 @@
   (≙ errTasks workqueue → processResyncTask).
 """
 
+import pytest
+
 import copy
 import threading
 
@@ -29,6 +31,7 @@ from kube_batch_tpu.sim.simulator import make_world
 SPEC = ResourceSpec(("cpu", "memory", "pods", "accelerator"))
 
 
+@pytest.mark.slow  # soak-scale: keeps tier-1 inside its wall-clock budget
 def test_concurrent_churn_vs_cycles():
     cache, sim = make_world(SPEC)
     for i in range(8):

@@ -7,6 +7,8 @@ guarantees: high-priority gangs run via preemption, queues converge
 toward their weighted fair shares, best-effort pods fill the holes.
 """
 
+import pytest
+
 import dataclasses
 
 from kube_batch_tpu.actions import BUILTIN_ACTIONS  # noqa: F401
@@ -49,6 +51,7 @@ def _running_by_prefix(cache):
     return out
 
 
+@pytest.mark.slow  # soak-scale: keeps tier-1 inside its wall-clock budget
 def test_oversubscribed_priorities_converge():
     """Config-4 shape, scaled: low-priority work floods the cluster
     first; higher-priority gangs arriving later must end up running."""

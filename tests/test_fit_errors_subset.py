@@ -53,7 +53,9 @@ def _compare(cache, max_rows):
     sub = {
         k: np.asarray(v)
         for k, v in failure_counts_subset(
-            snap, state, policy, max_rows=max_rows
+            # max_events=None: this harness consumes rows by its own
+            # window rule below, not diagnose_pending's event cap.
+            snap, state, policy, max_rows=max_rows, max_events=None
         ).items()
     }
     assert int(sub["nodes"]) == int(full["nodes"])
@@ -135,3 +137,28 @@ def test_subset_falls_back_without_subset_variant():
     }
     for key in ("nodes", "predicate_failed", "feasible", "insufficient"):
         np.testing.assert_array_equal(sub[key], full[key], err_msg=key)
+
+
+def test_window_guard_enforces_consumer_cap():
+    """ADVICE round-5: the max_events < max_rows invariant is enforced
+    in code, not prose — a consumer-capped call with a window at or
+    below the cap must fail loudly instead of silently scattering
+    consumed rows back as all-zero '0/N nodes available:' tallies."""
+    from kube_batch_tpu.framework.fit_errors import (
+        MAX_DIAG_EVENTS,
+        diagnose_pending,
+    )
+
+    # Validation fires before any tensor work: no world needed.
+    with pytest.raises(ValueError, match="must stay below max_rows"):
+        failure_counts_subset(None, None, None, max_rows=512)
+    with pytest.raises(ValueError, match="must stay below max_rows"):
+        failure_counts_subset(
+            None, None, None, max_rows=64, max_events=64
+        )
+    # diagnose_pending's default cap IS the constant the guard uses.
+    import inspect
+
+    sig = inspect.signature(diagnose_pending)
+    assert sig.parameters["max_events"].default == MAX_DIAG_EVENTS
+    assert MAX_DIAG_EVENTS < 2048  # the subset default window

@@ -108,6 +108,12 @@ def test_watch_drop_resumes_from_last_rv():
         )
         server.upsert("PodGroup", k8s_pod_group("late", min_member=1))
         assert _wait(lambda: "uid-pod-late-0" in cache._pods)
+        # Pod and PodGroup ride SEPARATE re-watched streams: wait until
+        # the group's real spec landed too (a pod naming an unknown
+        # group shadow-creates its job with queue "", which the gang
+        # gate rightly refuses to schedule), or a slow PodGroup
+        # reflector defers the bind one cycle and the assert races.
+        assert _wait(lambda: getattr(cache._jobs.get("late"), "queue", ""))
         ssn = scheduler.run_once()
         assert ("late-0", "n0") in ssn.bound
         # Plain drops re-WATCH (from the last RV), they don't re-LIST.

@@ -6,6 +6,8 @@ fairness) and api/job_info.go · JobInfo.PDB (victim filtering honors
 disruption budgets for plain pods).
 """
 
+import pytest
+
 import dataclasses
 
 import numpy as np
@@ -139,6 +141,7 @@ def test_pdb_allows_eviction_down_to_floor():
     assert ssn.evicted[0][0].startswith("web")
 
 
+@pytest.mark.slow  # soak-scale: keeps tier-1 inside its wall-clock budget
 def test_pdb_max_unavailable_lowered_against_matched_count():
     """maxUnavailable=1 over 2 matched pods resolves to floor 1 at
     pack time: exactly one eviction allowed (≙ the disruption
@@ -248,6 +251,7 @@ def _running_world_with_two_pdbs(floor_a: int, floor_b: int):
     return cache, sim
 
 
+@pytest.mark.slow  # soak-scale: keeps tier-1 inside its wall-clock budget
 def test_multi_pdb_intersection_blocks_eviction():
     """A pod under TWO budgets is evictable only if ALL survive: the
     name-first budget (a-web) would allow one eviction, but the second

@@ -17,6 +17,7 @@ ops/assignment.py) — both are valid members of the reference's
 """
 
 import numpy as np
+import pytest
 import jax
 
 from kube_batch_tpu.actions import BUILTIN_ACTIONS  # noqa: F401
@@ -94,10 +95,12 @@ def test_config1_gang_parity():
     _check_parity(_run_both(1))
 
 
+@pytest.mark.slow  # soak-scale: keeps tier-1 inside its wall-clock budget
 def test_config2_fair_share_parity():
     _check_parity(_run_both(2))
 
 
+@pytest.mark.slow  # soak-scale: keeps tier-1 inside its wall-clock budget
 def test_config3_predicates_parity():
     r = _run_both(3)
     _check_parity(r)

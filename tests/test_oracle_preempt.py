@@ -11,6 +11,8 @@ Worlds are config-4 shaped (2 weighted queues, 4 priority classes,
 oversubscribed) at CPU-test scale.
 """
 
+import pytest
+
 import dataclasses
 import random
 
@@ -197,6 +199,7 @@ def test_preempt_parity_priorities():
     assert k_vpj == o_vpj, (k_vpj, o_vpj)
 
 
+@pytest.mark.slow  # soak-scale: keeps tier-1 inside its wall-clock budget
 def test_preempt_parity_seeds():
     for seed in (2, 3):
         cache, _sim = _world_priorities(n_nodes=5, seed=seed)

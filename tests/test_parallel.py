@@ -6,6 +6,8 @@ EXACTLY the placements of the single-device solve — sharding is a layout
 choice, never a semantics choice.
 """
 
+import pytest
+
 import numpy as np
 import jax
 
@@ -56,6 +58,7 @@ def test_sharded_preempt_matches_unsharded():
     )
 
 
+@pytest.mark.slow  # soak-scale: keeps tier-1 inside its wall-clock budget
 def test_sharded_reclaim_matches_unsharded():
     from kube_batch_tpu.actions.reclaim import make_reclaim_solver
 
@@ -77,6 +80,7 @@ def test_sharded_backfill_matches_unsharded():
     )
 
 
+@pytest.mark.slow  # soak-scale: keeps tier-1 inside its wall-clock budget
 def test_sharded_full_pipeline_matches_unsharded():
     """The fused four-action cycle — the production dispatch — sharded
     vs unsharded on an oversubscribed world (config 4 scaled down so

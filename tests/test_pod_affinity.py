@@ -5,6 +5,8 @@ consumed by plugins/predicates/predicates.go and the
 InterPodAffinityPriority score in plugins/nodeorder/nodeorder.go.
 """
 
+import pytest
+
 from kube_batch_tpu.actions import BUILTIN_ACTIONS  # noqa: F401
 from kube_batch_tpu.api.resource import ResourceSpec
 from kube_batch_tpu.cache.cluster import Node, Pod, PodGroup
@@ -85,6 +87,7 @@ def test_anti_affinity_unsatisfiable_blocks():
     assert ssn.bound == []   # gang all-or-nothing holds
 
 
+@pytest.mark.slow  # soak-scale: keeps tier-1 inside its wall-clock budget
 def test_symmetric_anti_affinity_blocks_newcomer():
     """A resident whose anti term matches the newcomer's labels keeps
     the newcomer off its node (k8s anti-affinity symmetry)."""
@@ -107,6 +110,7 @@ def test_symmetric_anti_affinity_blocks_newcomer():
     assert node_of(sim, "newb-0") != lonely_node
 
 
+@pytest.mark.slow  # soak-scale: keeps tier-1 inside its wall-clock budget
 def test_gang_self_affinity_bootstraps_same_cycle():
     """A gang whose members all require co-location with their own label
     must still schedule from an empty cluster (k8s bootstrap rule), and
@@ -148,6 +152,7 @@ def test_bootstrap_survives_unschedulable_first_claimant():
     assert node_of(sim, "ring-huge") is None
 
 
+@pytest.mark.slow  # soak-scale: keeps tier-1 inside its wall-clock budget
 def test_preempt_never_evicts_its_own_affinity_anchor():
     """If fitting the preemptor would require evicting the resident
     that satisfies its required affinity, the plan must roll back —

@@ -158,6 +158,7 @@ def test_reclaim_fuzz_parity(seed):
     assert k_vpj == o_vpj, (seed, k_vpj, o_vpj)
 
 
+@pytest.mark.slow  # soak-scale: keeps tier-1 inside its wall-clock budget
 def test_fuzz_exercises_evictions():
     """The sweep is vacuous if no seed ever preempts: assert a healthy
     fraction of worlds produce evictions on BOTH sides."""

@@ -5,6 +5,8 @@ preempt_test.go): real cache + simulated backend, run sessions, assert
 on the evictions and the binds that eventually land.
 """
 
+import pytest
+
 import dataclasses
 
 from kube_batch_tpu.actions import BUILTIN_ACTIONS  # noqa: F401 (registration)
@@ -57,6 +59,7 @@ def _pods(prefix, n, cpu, mem, prio=0):
     ]
 
 
+@pytest.mark.slow  # soak-scale: keeps tier-1 inside its wall-clock budget
 def test_preempt_evicts_lower_priority_within_queue():
     cache, sim = _two_node_world()
     # Low-priority job fills the cluster and starts running.
@@ -107,6 +110,7 @@ def test_preempt_respects_gang_min_member_of_victims():
     assert not any(name.startswith("high") for name, _ in sim.binds)
 
 
+@pytest.mark.slow  # soak-scale: keeps tier-1 inside its wall-clock budget
 def test_preempt_never_evicts_critical_pods():
     cache, sim = _two_node_world()
     critical = [
@@ -183,6 +187,7 @@ def test_preempt_priority_beats_drf_share_gap():
     assert len(ssn.evicted) == 3
 
 
+@pytest.mark.slow  # soak-scale: keeps tier-1 inside its wall-clock budget
 def test_reclaim_rebalances_across_queues():
     cache, sim = _two_node_world()
     sim.add_queue(Queue(name="gold", weight=3.0))
@@ -310,6 +315,7 @@ def test_phase2_gang_floor_blocks_self_cannibalism():
     assert ssn.evicted == []  # ready would drop to 1 < minMember 2
 
 
+@pytest.mark.slow  # soak-scale: keeps tier-1 inside its wall-clock budget
 def test_preempt_retries_next_node_after_failed_plan():
     """The retry scan (≙ preempt.go iterating nodes after a discarded
     Statement): the fewest-victims heuristic picks n0 first, whose plan

@@ -5,6 +5,8 @@ re-reads --scheduler-conf every cycle; a broken conf must not take down
 the running policy.
 """
 
+import pytest
+
 from kube_batch_tpu.models.workloads import build_config
 from kube_batch_tpu.scheduler import Scheduler
 
@@ -24,6 +26,7 @@ def test_run_max_cycles_and_steady_state():
     assert len(sim.binds) == 8
 
 
+@pytest.mark.slow  # soak-scale: keeps tier-1 inside its wall-clock budget
 def test_idle_cycles_skip_dispatch():
     """Once nothing is Pending/Releasing and no binds await resync, the
     cycle skips the solve dispatch entirely (run_once returns None) —
@@ -79,6 +82,7 @@ def test_idle_cycles_skip_dispatch():
     assert ("late-p", "late-n") in ssn.bound or len(ssn.bound) == 1
 
 
+@pytest.mark.slow  # soak-scale: keeps tier-1 inside its wall-clock budget
 def test_bad_conf_keeps_previous_policy(tmp_path):
     conf = tmp_path / "scheduler.conf"
     conf.write_text("actions: allocate\n")
@@ -126,6 +130,7 @@ def test_conf_hot_reload_prewarms_asynchronously(tmp_path):
     assert s._pending is None  # warm adopted and cleared
 
 
+@pytest.mark.slow  # soak-scale: keeps tier-1 inside its wall-clock budget
 def test_conf_edit_during_warm_restarts_prewarm(tmp_path):
     """A second edit while a warm is in flight discards the stale
     pending build and warms the newest conf."""
@@ -147,6 +152,7 @@ def test_conf_edit_during_warm_restarts_prewarm(tmp_path):
     assert s._conf.actions == ("backfill",)
 
 
+@pytest.mark.slow  # soak-scale: keeps tier-1 inside its wall-clock budget
 def test_stuck_prewarm_refuses_adoption(tmp_path, caplog):
     """A prewarm that exceeds its budget must NOT be adopted cold —
     the previous policy keeps serving (no minutes-long in-cycle
@@ -184,6 +190,7 @@ def test_stuck_prewarm_refuses_adoption(tmp_path, caplog):
     assert s._conf.actions == ("allocate", "backfill")
 
 
+@pytest.mark.slow  # soak-scale: keeps tier-1 inside its wall-clock budget
 def test_compact_wire_matches_default(tmp_path, monkeypatch):
     """KB_TPU_COMPACT_WIRE=1 shrinks the device->host payload (u8/i16
     codes instead of i32/bool arrays) but must commit IDENTICAL
@@ -313,6 +320,7 @@ def test_conf_arguments_validated_loudly():
         ))
 
 
+@pytest.mark.slow  # soak-scale: keeps tier-1 inside its wall-clock budget
 def test_growth_prewarm_compiles_next_bucket():
     """Nearing a padding-bucket boundary compiles the NEXT bucket's
     program on a background thread, so the cycle that actually crosses

@@ -13,7 +13,10 @@ from __future__ import annotations
 
 import json
 
+import pytest
 
+
+@pytest.mark.slow  # soak-scale: keeps tier-1 inside its wall-clock budget
 def test_warm_tool_banks_all_variants(tmp_path, monkeypatch, capsys):
     monkeypatch.setenv("JAX_PLATFORMS", "cpu")
     monkeypatch.setenv("KB_TPU_COMPILE_CACHE", str(tmp_path))

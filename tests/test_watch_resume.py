@@ -11,6 +11,8 @@ re-list, and the CLI daemon reconnecting through all of it mid-churn.
 
 from __future__ import annotations
 
+import pytest
+
 import socket as socket_mod
 import threading
 import time
@@ -85,7 +87,9 @@ def test_adapter_tracks_resource_versions():
              request={"cpu": 100, "memory": 1 * GI, "pods": 1})],
     )
     assert _wait(lambda: adapter.latest_rv > before)
-    assert adapter.resource_versions["Pod"] == cluster._rv
+    # Wait on the LAST event of the submission (the Pod rides behind
+    # its PodGroup on the stream; latest_rv alone races the tail).
+    assert _wait(lambda: adapter.resource_versions.get("Pod") == cluster._rv)
     assert adapter.resource_versions["PodGroup"] == cluster._rv - 1
 
 
@@ -258,6 +262,7 @@ def test_relist_over_populated_cache_upserts():
         assert cache._status_counts[TaskStatus.PENDING] == 1
 
 
+@pytest.mark.slow  # soak-scale: keeps tier-1 inside its wall-clock budget
 def test_cli_daemon_reconnects_in_process():
     """Kill the stream under a running daemon; it must resume the
     watch in-process (bounded retries), see churn that happened while
