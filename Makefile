@@ -42,8 +42,18 @@ run-example:
 # invariants (no double-bind, gang gate, capacity, eviction accounting,
 # convergence) after every tick.  Exit 1 + a flight-recorder dump on
 # any violation.  Long soaks live in tests/ behind the `slow` marker.
+#
+# The second run is the GUARDRAIL scenario (doc/design/guardrails.md):
+# a slow-backend window must climb the degradation ladder, a bind
+# blackhole must trip the wire breaker open (zero bind attempts while
+# open) and heal through the half-open probe, and an hbm_pressure
+# probe must be refused by ceiling admission — the engine asserts all
+# of it (ladder engagement, quiesce, recovery) as invariants, same
+# seed ⇒ same trace hash.
 chaos:
 	JAX_PLATFORMS=cpu $(PY) -m kube_batch_tpu.chaos --seed 7 --ticks 200
+	JAX_PLATFORMS=cpu $(PY) -m kube_batch_tpu.chaos --seed 11 --ticks 32 \
+	    --scenario examples/chaos-guardrail.json
 
 profile:
 	$(PY) -m kube_batch_tpu --workload 2 --cycles 3 --schedule-period 0 \

@@ -21,6 +21,7 @@ from __future__ import annotations
 import collections
 import copy
 import dataclasses
+import logging
 import threading
 import time
 import weakref
@@ -45,6 +46,7 @@ from kube_batch_tpu.cache.cluster import (
     StorageClass,
 )
 from kube_batch_tpu.cache.info import JobInfo, NodeInfo, QueueInfo
+from kube_batch_tpu.guardrails.breaker import is_transient
 
 DEFAULT_QUEUE = "default"
 
@@ -148,6 +150,12 @@ class SchedulerCache:
         self._namespaces: dict[str, Namespace] = {}          # by name
         self._pdbs: dict[str, PodDisruptionBudget] = {}      # by name
         self._resync: list[str] = []             # pod uids of failed binds
+        # PodGroups whose last status WRITE was swallowed as a
+        # transient wire failure: refresh_status's `changed` compares
+        # against the already-mutated in-memory fields, so without
+        # this the failed write would never be re-sent and the
+        # apiserver's status would stay stale forever.
+        self._status_retry: set[str] = set()
         # Structured per-object event records (≙ the reference's
         # Recorder emitting Kubernetes Events), bounded like an
         # apiserver's event TTL window: a long-running daemon with a
@@ -175,10 +183,27 @@ class SchedulerCache:
         # TaskSchedulingLatency).  Only pods that arrive PENDING count:
         # a pod ingested already running was scheduled by someone else.
         self._arrival_ts: dict[str, float] = {}
-        # True between begin_resync() and end_resync(): the mirror is a
-        # half-replayed LIST and must not be scheduled against (see
-        # snapshot()'s guard).
-        self._resyncing = False
+        # > 0 between begin_resync() and end_resync(): the mirror must
+        # not be scheduled against (see snapshot()'s guard).  A DEPTH,
+        # not a flag: two independent actors hold quiesces — the
+        # watch-gap relist (half-replayed LIST) and the guardrail wire
+        # breaker (open = zero bind attempts) — and either one ending
+        # must not cancel the other's hold.
+        self._resync_depth = 0
+        # The relist actor's SINGLE idempotent hold (contributes one to
+        # the depth while set): a timed-out relist deliberately leaves
+        # its hold in place, and the retry re-relists — begin_relist
+        # must not stack a second hold the single end_relist could
+        # never release.
+        self._relist_hold = False
+        # True when scheduling decisions leave the process in apiserver
+        # dialect (--write-format k8s / --kube-api): known divergences
+        # from upstream API semantics are then surfaced per decision —
+        # today that is the PDB multi-budget eviction, which upstream's
+        # eviction API refuses outright while this scheduler allows it
+        # whenever every covering budget keeps its floor (see
+        # plugins/pdb.py · "Known divergence").
+        self.k8s_write_format = False
 
         self.add_queue(Queue(name=default_queue, weight=1.0))
 
@@ -259,10 +284,24 @@ class SchedulerCache:
                 self.events.append(ev)
                 self._event_index[key] = ev
         if self.event_sink is not None:
-            self.event_sink.record_event(
-                kind, name, reason, message,
-                count=ev.count, namespace=namespace,
-            )
+            try:
+                self.event_sink.record_event(
+                    kind, name, reason, message,
+                    count=ev.count, namespace=namespace,
+                )
+            except Exception as exc:  # noqa: BLE001 — classified below
+                # Events are fire-and-forget; the in-process ring above
+                # already holds the record.  Same posture as
+                # update_job_status: transport failures (including an
+                # OPEN guardrail breaker, and HTTP 429/5xx — see
+                # guardrails.breaker.is_transient) never crash the
+                # caller.  App-level rejections stay loud: bugs.
+                if not is_transient(exc):
+                    raise
+                logging.warning(
+                    "event sink write failed (%s %s %s): %s",
+                    kind, name, reason, exc,
+                )
         return ev
 
     def events_for(self, kind: str, name: str) -> list:
@@ -468,6 +507,7 @@ class SchedulerCache:
         with self._lock:
             if self._jobs.pop(name, None) is not None:
                 self._mark_full("job-deleted")
+        self._status_retry.discard(name)
 
     def add_queue(self, queue: Queue) -> None:
         with self._lock:
@@ -543,20 +583,42 @@ class SchedulerCache:
     # -- relist quiescence (watch-gap recovery) --------------------------
 
     def begin_resync(self) -> None:
-        """Mark the mirror unschedulable-against until end_resync():
-        call before clear() + LIST replay on a watch gap.  snapshot()
-        raises CacheResyncing under the same lock the packers hold, so
-        no cycle can pack a half-replayed mirror."""
+        """Take one quiesce hold: the mirror is unschedulable-against
+        until the MATCHING end_resync() (holds nest — the wire breaker
+        balances its own pair; the relist actor uses the idempotent
+        begin_relist/end_relist below).  snapshot() raises
+        CacheResyncing under the same lock the packers hold, so no
+        cycle can pack a half-replayed mirror or bind into an open
+        breaker."""
         with self._lock:
-            self._resyncing = True
+            self._resync_depth += 1
 
     def end_resync(self) -> None:
         with self._lock:
-            self._resyncing = False
+            self._resync_depth = max(0, self._resync_depth - 1)
+
+    def begin_relist(self) -> None:
+        """The watch-gap relist actor's hold — IDEMPOTENT: a relist
+        retried after a timed-out replay (whose hold was deliberately
+        kept) re-arms the same single hold instead of stacking an
+        unreleasable second one."""
+        with self._lock:
+            if not self._relist_hold:
+                self._relist_hold = True
+                self._resync_depth += 1
+
+    def end_relist(self) -> None:
+        """Release the relist hold if one is outstanding (this
+        attempt's or a timed-out predecessor's); a no-op otherwise —
+        in particular it can NEVER release the breaker's hold."""
+        with self._lock:
+            if self._relist_hold:
+                self._relist_hold = False
+                self._resync_depth = max(0, self._resync_depth - 1)
 
     def is_resyncing(self) -> bool:
         with self._lock:
-            return self._resyncing
+            return self._resync_depth > 0
 
     def snapshot(self, shared: bool = False) -> HostSnapshot:
         """Consistent view.  Jobs without a real PodGroup or with an
@@ -576,9 +638,10 @@ class SchedulerCache:
         so post-lock ITERATION never races the adapter thread; post-lock
         pod reads must stick to immutable fields (uid/name/request)."""
         with self._lock:
-            if self._resyncing:
+            if self._resync_depth > 0:
                 raise CacheResyncing(
-                    "cache mirror is mid-relist; skip this cycle"
+                    "cache mirror is quiesced (mid-relist or breaker "
+                    "open); skip this cycle"
                 )
             if shared:
                 jobs = {
@@ -686,6 +749,10 @@ class SchedulerCache:
             if pod is None:
                 return False
             prev_status = pod.status
+            budgets = (
+                self._matching_budgets(pod) if self.k8s_write_format
+                else ()
+            )
             self.update_pod_status(pod_uid, TaskStatus.RELEASING)
         try:
             self.evictor.evict(pod, reason)
@@ -696,13 +763,67 @@ class SchedulerCache:
                               f"evict-failed: {exc}",
                               namespace=pod.namespace)
             return False
+        if len(budgets) > 1:
+            # Upstream divergence, surfaced per decision: Kubernetes'
+            # eviction API refuses ANY eviction of a pod covered by
+            # more than one PDB (apiserver 500, regardless of
+            # headroom); this scheduler allowed it because every
+            # covering budget keeps its floor (plugins/pdb.py
+            # intersection semantics).  An operator mirroring these
+            # k8s-dialect writes into upstream tooling must know the
+            # two systems would disagree here.
+            logging.warning(
+                "evicted pod %s covered by %d PodDisruptionBudgets "
+                "(%s): upstream's eviction API would have refused "
+                "this outright; allowed here because every budget "
+                "keeps its floor", pod.name, len(budgets),
+                ", ".join(budgets),
+            )
+            self.record_event(
+                "Pod", pod.name, "MultiBudgetEviction",
+                f"evicted under {len(budgets)} PDBs "
+                f"({', '.join(budgets)}); upstream's eviction API "
+                "refuses multi-budget pods outright — allowed here "
+                "because every covering budget keeps its floor",
+                namespace=pod.namespace,
+            )
         self.record_event("Pod", pod.name, "Evicted", f"evicted: {reason}",
                           namespace=pod.namespace)
         return True
 
+    def _matching_budgets(self, pod) -> list[str]:
+        """Names of every PDB whose selector matches `pod` (sorted;
+        caller holds the lock).  Empty-selector budgets match nothing,
+        same as the packer's task_pdbs resolution."""
+        return sorted(
+            name for name, b in self._pdbs.items()
+            if b.selector and b.matches(pod)
+        )
+
     def update_job_status(self, group: PodGroup) -> None:
-        if self.status_updater is not None:
+        if self.status_updater is None:
+            return
+        try:
             self.status_updater.update_pod_group(group)
+        except Exception as exc:  # noqa: BLE001 — classified below
+            # Status writes are advisory observability; a dead wire —
+            # the guardrail breaker quiescing it (BreakerOpen is a
+            # ConnectionError), or an apiserver answering 429/5xx
+            # (guardrails.breaker.is_transient) — must not crash the
+            # cycle.  The mirror still differs from the cluster, so
+            # the next cycle's refresh retries this group.
+            # Application-level rejections (RuntimeError, HTTP 4xx)
+            # stay loud: those are bugs.
+            if not is_transient(exc):
+                raise
+            # Mark for re-send: the in-memory status already mutated,
+            # so the next refresh would otherwise compute changed=False
+            # and never retry this write.
+            self._status_retry.add(group.name)
+            logging.warning(
+                "podgroup %s status write failed (retried next "
+                "cycle): %s", group.name, exc,
+            )
 
     def refresh_job_statuses(self, names=None) -> None:
         """Recompute PodGroup statuses for `names` — or EVERY live job
@@ -724,7 +845,12 @@ class SchedulerCache:
                 for n in targets
             ]
         for group, changed in groups:
-            if changed:
+            if changed or group.name in self._status_retry:
+                # A group whose last write was swallowed (transient
+                # wire failure) re-sends even when nothing changed
+                # since — update_job_status re-marks it on failure, so
+                # the retry survives repeated outcycles.
+                self._status_retry.discard(group.name)
                 self.update_job_status(group)
 
     def has_pending_work(self) -> bool:

@@ -82,6 +82,34 @@ class ChaosEngineError(RuntimeError):
     distinct from an invariant violation (exit 1)."""
 
 
+# -- guardrail-fault tuning (active only when a FaultSpec enables a
+#    guardrail fault; see faults.FaultSpec.guardrail_faults) -----------
+#: Watchdog reference period: above a healthy tiny-shape CPU cycle
+#: (a few ms), far below a slow-backend write (slow_response_s).
+GUARDRAIL_WATCHDOG_PERIOD = 0.05
+#: Consecutive overruns per rung / healthy cycles per recovery — small
+#: so short fault windows climb and descend within a scenario, but
+#: still ≥ 2 so one isolated compile spike cannot engage the ladder.
+GUARDRAIL_ENGAGE_AFTER = 2
+GUARDRAIL_RECOVER_AFTER = 3
+#: Breaker knobs in TICK time (the breaker is clocked off
+#: ChaosCluster.tick_now, so reset_after is a tick count).
+GUARDRAIL_TRIP_AFTER = 5
+GUARDRAIL_RESET_TICKS = 3.0
+#: Wire round-trip timeout while a blackhole fault is configured: a
+#: swallowed bind must fail in seconds, not the production 10 s.
+BLACKHOLE_WIRE_TIMEOUT = 1.5
+
+#: FaultSpec fields that must survive the trace round trip because
+#: they change run behavior outside the inline event schedule (curse
+#: decisions, Guardrails wiring, blackhole wire timeout, slow-fault
+#: delay).  Written into the trace's meta header; adopted on replay.
+_META_FAULT_FIELDS = (
+    "bind_fail_pct", "slow_at", "slow_ticks", "slow_response_s",
+    "blackhole_at", "blackhole_ticks", "hbm_pressure_at",
+)
+
+
 @dataclasses.dataclass
 class ChaosResult:
     ok: bool
@@ -93,6 +121,10 @@ class ChaosResult:
     recoveries: dict[str, int]
     converged_tick: int | None         # drain ticks until quiescent
     dump_path: str | None
+    #: Guardrail observability (None unless a guardrail fault ran):
+    #: max ladder rung seen, final /healthz state, breaker open/close
+    #: counts, swallowed requests, HBM refusals, binds-while-open.
+    guardrail: dict | None = None
 
     def summary(self) -> dict:
         return {
@@ -105,6 +137,7 @@ class ChaosResult:
             "recoveries": dict(self.recoveries),
             "converged_after_drain_ticks": self.converged_tick,
             "flight_recorder": self.dump_path,
+            "guardrail": self.guardrail,
         }
 
 
@@ -143,6 +176,7 @@ class ChaosEngine:
         dump_dir: str | None = None,
         corrupt_tick: int | None = None,
         quiesce_timeout: float = 30.0,
+        wire_timeout: float | None = None,
     ) -> None:
         self.seed = seed
         self.ticks = ticks
@@ -152,17 +186,21 @@ class ChaosEngine:
             # A recorded trace carries the recording's run-time fault
             # parameters in its "meta" header line; adopt them unless
             # the caller overrides explicitly.  Planned faults (drops,
-            # gaps, vanishes, steals) ride inline as events, so only
-            # bind_fail_pct — a fire-time curse decision — needs to
-            # survive the round trip for replay to reproduce the
-            # recording's decisions and hash.
+            # gaps, vanishes, steals) ride inline as events, but the
+            # fields below change RUN behavior, not the schedule:
+            # bind_fail_pct is a fire-time curse decision, and the
+            # guardrail windows decide whether a Guardrails instance
+            # (breaker, watchdog, ceiling) is wired at all plus the
+            # blackhole wire timeout — without them a replayed
+            # guardrail trace would apply the inline blackhole/slow
+            # events against an unguarded scheduler and diverge.
             meta = next(
                 (e for e in events if e.get("op") == "meta"), None
             )
             if meta is not None:
-                faults = FaultSpec(
-                    bind_fail_pct=int(meta.get("bind_fail_pct", 0))
-                )
+                faults = FaultSpec(**{
+                    k: meta[k] for k in _META_FAULT_FIELDS if k in meta
+                })
         self.faults = faults or FaultSpec()
         self.conf_path = conf_path
         self.drain = drain
@@ -179,6 +217,38 @@ class ChaosEngine:
         self._pending_gap = False
         self._have_lease = False
         self._lease_lost = False
+        # Guardrail wiring: any guardrail fault in the spec makes the
+        # driven scheduler carry a Guardrails instance, its breaker
+        # clocked off the TICK counter (reset windows count ticks, not
+        # wall seconds — same-seed runs stay reproducible).
+        self.guardrails = None
+        if self.faults.guardrail_faults:
+            from kube_batch_tpu.guardrails import (
+                GuardrailConfig,
+                Guardrails,
+            )
+
+            self.guardrails = Guardrails(GuardrailConfig(
+                hbm_ceiling_mb=None,
+                watchdog_overruns=GUARDRAIL_ENGAGE_AFTER,
+                watchdog_recovery=GUARDRAIL_RECOVER_AFTER,
+                watchdog_period=GUARDRAIL_WATCHDOG_PERIOD,
+                breaker_failures=GUARDRAIL_TRIP_AFTER,
+                breaker_reset_s=GUARDRAIL_RESET_TICKS,
+                backoff_base_s=0.01,
+                backoff_cap_s=0.04,
+                backoff_attempts=2,
+            ))
+        if wire_timeout is None:
+            wire_timeout = (
+                BLACKHOLE_WIRE_TIMEOUT if self.faults.blackhole_at
+                else 10.0
+            )
+        self.wire_timeout = wire_timeout
+        #: tick -> breaker state at END of tick (guardrail runs only);
+        #: the breaker-open invariant reads consecutive "open" pairs.
+        self._breaker_by_tick: dict[int, str] = {}
+        self.scheduler: Scheduler | None = None
         # Live wire state.
         self.cluster: ChaosCluster | None = None
         self.backend: StreamBackend | None = None
@@ -206,7 +276,7 @@ class ChaosEngine:
             self.cluster.replay(cl_w)
         old = self.adapter
         if self.backend is None:
-            self.backend = StreamBackend(sch_w, timeout=10.0)
+            self.backend = StreamBackend(sch_w, timeout=self.wire_timeout)
         else:
             self.backend.reconnect(sch_w)
         adapter = WatchAdapter(self.cache, sch_r, backend=self.backend)
@@ -276,6 +346,44 @@ class ChaosEngine:
             metrics.chaos_faults_injected.inc(kind)
         elif kind == "lease-return":
             self.cluster.return_lease()
+        elif kind == "slow-backend":
+            self.cluster.response_delay = self.faults.slow_response_s
+            detail["delay_s"] = self.faults.slow_response_s
+            self.fault_counts[kind] += 1
+            metrics.chaos_faults_injected.inc(kind)
+        elif kind == "slow-heal":
+            self.cluster.response_delay = 0.0
+            self.recovery_counts["slow-healed"] += 1
+            metrics.chaos_recoveries.inc("slow-healed")
+        elif kind == "bind-blackhole":
+            self.cluster.blackhole = True
+            self.fault_counts[kind] += 1
+            metrics.chaos_faults_injected.inc(kind)
+        elif kind == "blackhole-heal":
+            self.cluster.blackhole = False
+            self.recovery_counts["blackhole-healed"] += 1
+            metrics.chaos_recoveries.inc("blackhole-healed")
+        elif kind == "hbm-pressure":
+            # Compile ONE next-bucket program through the real
+            # compile-then-admit path under a 1-byte ceiling: the HBM
+            # admission must refuse it and the serving program must
+            # survive.  Needs a prior non-idle cycle (warm_grown uses
+            # the last snapshot's shapes).
+            verdict = None
+            if self.scheduler is not None and self.guardrails is not None:
+                ceiling = self.guardrails.hbm
+                prev = ceiling.ceiling_bytes
+                ceiling.ceiling_bytes = 1
+                try:
+                    verdict = self.scheduler.warm_grown()
+                finally:
+                    ceiling.ceiling_bytes = prev
+            detail["refused"] = verdict is False
+            if verdict is False:
+                self.fault_counts[kind] += 1
+                metrics.chaos_faults_injected.inc(kind)
+            else:
+                detail["skipped"] = True
         else:
             raise ChaosEngineError(f"unknown fault kind {kind!r}")
         rec.setdefault("faults", []).append(detail)
@@ -382,11 +490,12 @@ class ChaosEngine:
         if self.trace_path:
             # The header makes a recorded trace self-describing: replay
             # recovers the seed (vanish-target + curse decisions are
-            # resolved from it at fire time) and bind_fail_pct without
-            # the operator re-passing them.
+            # resolved from it at fire time) and every behavior-bearing
+            # fault field without the operator re-passing them.
             header = {
                 "tick": -1, "op": "meta", "seed": self.seed,
-                "bind_fail_pct": self.faults.bind_fail_pct,
+                **{k: getattr(self.faults, k)
+                   for k in _META_FAULT_FIELDS},
             }
             write_trace(self.trace_path, [header] + events + fault_events)
 
@@ -400,14 +509,29 @@ class ChaosEngine:
         )
         self._connect(replay=True)
         # The backend exists only after _connect; wire the seams now.
-        self.cache.binder = self.backend
-        self.cache.evictor = self.backend
-        self.cache.status_updater = self.backend
+        # With guardrail faults the write seams go through the retry +
+        # breaker wrapper — exactly the production CLI wiring, with
+        # the breaker clocked off ticks instead of wall seconds.  The
+        # engine's OWN verbs (lease renewal, watch resume) keep using
+        # the raw backend: GuardedBackend protects the scheduler's
+        # write path, not the harness.
+        if self.guardrails is not None:
+            seam = self.guardrails.guard_backend(
+                self.backend, self.cache, name="chaos-wire",
+                clock=lambda: float(self.cluster.tick_now),
+            )
+        else:
+            seam = self.backend
+        self.cache.binder = seam
+        self.cache.evictor = seam
+        self.cache.status_updater = seam
         if not self.adapter.wait_for_sync(self.quiesce_timeout):
             raise ChaosEngineError("initial LIST replay never synced")
         scheduler = Scheduler(
             self.cache, conf_path=self.conf_path, schedule_period=0.0,
+            guardrails=self.guardrails,
         )
+        self.scheduler = scheduler
         checker = InvariantChecker(self.cluster)
         metrics.chaos_convergence_ticks.set(-1.0)
 
@@ -448,6 +572,16 @@ class ChaosEngine:
             self.cluster.tick()
             self._quiesce()
             self._drain_decisions(rec)
+            if self.guardrails is not None:
+                # Sampled at end-of-tick for the recorder AND the
+                # breaker-open invariant; NOT part of the trace hash
+                # (rung transitions depend on wall latency).
+                state = self.guardrails.breaker_state()
+                self._breaker_by_tick[t] = state
+                rec["guardrail"] = {
+                    "state": self.guardrails.state,
+                    "breaker": state,
+                }
             found = checker.check_tick(t)
             if found:
                 rec["violations"] = [v.as_dict() for v in found]
@@ -472,7 +606,11 @@ class ChaosEngine:
                     violations = one_tick(t, active=False)
                     if violations:
                         break
-                    if self._all_settled():
+                    if self._all_settled() and self._rails_recovered():
+                        # Guardrail runs also drain until the ladder
+                        # descends and the breaker closes: "converged"
+                        # means the workload settled AND the daemon is
+                        # back to full service.
                         converged_tick = extra
                         metrics.chaos_convergence_ticks.set(float(extra))
                         break
@@ -480,6 +618,8 @@ class ChaosEngine:
                     violations = checker.pending_after_deadline(
                         self.ticks + self.drain
                     )
+                if not violations and self.faults.guardrail_faults:
+                    violations = self._check_guardrails(ticks_run)
         finally:
             self._teardown()
 
@@ -522,7 +662,98 @@ class ChaosEngine:
             recoveries=dict(self.recovery_counts),
             converged_tick=converged_tick,
             dump_path=dump_path,
+            guardrail=self._guardrail_summary(),
         )
+
+    # -- guardrail invariants ------------------------------------------
+    def _rails_recovered(self) -> bool:
+        """Full service restored: ladder at rung 0, breaker not open."""
+        if self.guardrails is None:
+            return True
+        from kube_batch_tpu.guardrails import CircuitBreaker
+
+        return (
+            self.guardrails.rung == 0
+            and self.guardrails.breaker_state() != CircuitBreaker.OPEN
+        )
+
+    def _open_tick_binds(self) -> int:
+        """Bind requests received during FULLY-open breaker ticks
+        (state "open" at the end of both the tick and its
+        predecessor): the scheduler must have quiesced — zero."""
+        total = 0
+        for t, state in sorted(self._breaker_by_tick.items()):
+            if state == "open" and \
+                    self._breaker_by_tick.get(t - 1) == "open":
+                total += self.cluster.bind_requests_by_tick.get(t, 0)
+        return total
+
+    def _check_guardrails(self, tick: int) -> list[Violation]:
+        """Post-run assertions that the self-protection layer actually
+        engaged, quiesced, and recovered — violations ride the same
+        flight-recorder/exit-code path as scheduling invariants."""
+        out: list[Violation] = []
+        rails = self.guardrails
+        breaker = rails.breaker if rails is not None else None
+        if self.faults.slow_at and rails.watchdog.max_rung_seen < 1:
+            out.append(Violation(
+                "ladder-never-engaged", tick,
+                "slow-backend window ran but the cycle watchdog never "
+                "left rung 0 (no degradation under sustained overrun)",
+            ))
+        if self.faults.blackhole_at:
+            if breaker is None or breaker.opened_count < 1:
+                out.append(Violation(
+                    "breaker-never-tripped", tick,
+                    "bind-blackhole window ran but the wire breaker "
+                    "never tripped open",
+                ))
+            elif breaker.closed_count < 1:
+                out.append(Violation(
+                    "breaker-never-closed", tick,
+                    "wire breaker tripped but never recovered after "
+                    "the blackhole healed (half-open probe broken?)",
+                ))
+            binds_open = self._open_tick_binds()
+            if binds_open:
+                out.append(Violation(
+                    "bind-while-open", tick,
+                    f"{binds_open} bind request(s) reached the wire "
+                    "during fully-open breaker ticks — scheduling did "
+                    "not quiesce",
+                ))
+        if self.faults.hbm_pressure_at and \
+                self.fault_counts.get("hbm-pressure", 0) < 1:
+            out.append(Violation(
+                "hbm-admission-not-exercised", tick,
+                "hbm-pressure fault fired but no refusal was recorded "
+                "(warm_grown skipped or admitted over a 1-byte "
+                "ceiling)",
+            ))
+        if not self._rails_recovered():
+            out.append(Violation(
+                "guardrail-not-recovered", tick,
+                f"scenario drained but the daemon is still degraded "
+                f"(rung {rails.rung} {rails.state!r}, breaker "
+                f"{rails.breaker_state()!r})",
+            ))
+        return out
+
+    def _guardrail_summary(self) -> dict | None:
+        rails = self.guardrails
+        if rails is None:
+            return None
+        breaker = rails.breaker
+        return {
+            "max_rung_seen": rails.watchdog.max_rung_seen,
+            "final_state": rails.state,
+            "final_breaker": rails.breaker_state(),
+            "breaker_opened": breaker.opened_count if breaker else 0,
+            "breaker_closed": breaker.closed_count if breaker else 0,
+            "blackholed_requests": self.cluster.blackholed_requests,
+            "binds_while_open": self._open_tick_binds(),
+            "hbm_refusals": rails.hbm.refusals,
+        }
 
     # -- helpers --------------------------------------------------------
     def _all_settled(self) -> bool:

@@ -59,10 +59,45 @@ class FaultSpec:
     #: engine's renewal fails, it stands down, then re-acquires.
     lease_steal_every: int = 53
 
+    # -- guardrail faults (kube_batch_tpu/guardrails/) -----------------
+    # These are sustained WINDOWS (one onset tick + a duration), not
+    # cadences: a breaker needs a dead backend long enough to trip and
+    # probe, a watchdog needs consecutive overruns.  All default OFF —
+    # they exist to exercise the self-protection ladder, and enabling
+    # any of them makes the engine construct a Guardrails instance for
+    # the driven scheduler (see engine.ChaosEngine).
+
+    #: Tick the backend turns SLOW: write verbs (bind/evict/status/
+    #: ping) are answered only after `slow_response_s` — every cycle
+    #: that writes overruns, and the cycle watchdog must climb its
+    #: degradation ladder.  0 disables; heals at slow_at + slow_ticks.
+    slow_at: int = 0
+    slow_ticks: int = 10
+    slow_response_s: float = 0.4
+    #: Tick the write path goes DARK: bind/evict/status/ping requests
+    #: are swallowed with no response (the scheduler's calls time out;
+    #: the watch and lease verbs stay live, so heal is observable).
+    #: The wire breaker must trip open and quiesce scheduling.  0
+    #: disables; heals at blackhole_at + blackhole_ticks.
+    blackhole_at: int = 0
+    blackhole_ticks: int = 8
+    #: Tick the hbm-pressure fault fires: the engine compiles ONE
+    #: next-bucket program through `Scheduler.warm_grown` under a
+    #: 1-byte ceiling — HBM admission must refuse it and the previous
+    #: program must keep serving.  0 disables.
+    hbm_pressure_at: int = 0
+
     @classmethod
     def none(cls) -> "FaultSpec":
         return cls(stream_drop_every=0, gap_every=0, bind_fail_pct=0,
                    node_vanish_every=0, lease_steal_every=0)
+
+    @property
+    def guardrail_faults(self) -> bool:
+        """Any guardrail fault configured — the engine then drives the
+        scheduler with a Guardrails instance wired for tick time."""
+        return bool(self.slow_at or self.blackhole_at
+                    or self.hbm_pressure_at)
 
 
 def plan_faults(spec: FaultSpec, seed: int, ticks: int) -> list[dict]:
@@ -89,6 +124,28 @@ def plan_faults(spec: FaultSpec, seed: int, ticks: int) -> list[dict]:
             events.append({
                 "tick": t + 1, "op": "fault", "kind": "lease-return",
             })
+    if spec.slow_at:
+        events.append({
+            "tick": spec.slow_at, "op": "fault", "kind": "slow-backend",
+        })
+        events.append({
+            "tick": spec.slow_at + spec.slow_ticks, "op": "fault",
+            "kind": "slow-heal",
+        })
+    if spec.blackhole_at:
+        events.append({
+            "tick": spec.blackhole_at, "op": "fault",
+            "kind": "bind-blackhole",
+        })
+        events.append({
+            "tick": spec.blackhole_at + spec.blackhole_ticks,
+            "op": "fault", "kind": "blackhole-heal",
+        })
+    if spec.hbm_pressure_at:
+        events.append({
+            "tick": spec.hbm_pressure_at, "op": "fault",
+            "kind": "hbm-pressure",
+        })
     events.sort(key=lambda e: e["tick"])
     return events
 
@@ -111,6 +168,14 @@ class ChaosCluster(ExternalCluster):
     appends are ordered and the checker drains them race-free.
     """
 
+    #: Verbs the blackhole swallows and the slow fault delays — the
+    #: scheduler's write path plus the breaker's half-open probe.  The
+    #: watch, LIST/resume and lease verbs stay live: a real "dead
+    #: backend" outage keeps the informer side up (that is what makes
+    #: heal observable), and the blackhole must not kill the engine's
+    #: own per-tick lease renewal.
+    WRITE_VERBS = frozenset({"bind", "evict", "updatePodGroup", "ping"})
+
     def __init__(self, *, seed: int = 0, bind_fail_pct: int = 0,
                  **kwargs) -> None:
         super().__init__(**kwargs)
@@ -121,6 +186,37 @@ class ChaosCluster(ExternalCluster):
         self.bind_attempts: collections.Counter = collections.Counter()
         self.injected_bind_failures = 0
         self.recovered_binds = 0  # cursed pods whose retry later landed
+        # -- guardrail fault state (engine-toggled) --------------------
+        #: While True, WRITE_VERBS requests are swallowed: no response
+        #: (the client times out), no mutation, no wire-log entry.
+        #: Kept OUT of the wire log because how many attempts race in
+        #: before the breaker trips depends on thread timing — hashing
+        #: them would break same-seed reproducibility; the side
+        #: counters below carry the evidence instead.
+        self.blackhole = False
+        #: Seconds each WRITE_VERBS response is held back while > 0
+        #: (the slow-backend fault; responses still land, just late).
+        self.response_delay = 0.0
+        self.blackholed_requests = 0
+        #: tick -> bind requests RECEIVED (answered or swallowed):
+        #: the breaker-open invariant asserts this is zero for every
+        #: tick the breaker spent fully open.
+        self.bind_requests_by_tick: collections.Counter = \
+            collections.Counter()
+
+    def _handle(self, writer, msg: dict) -> None:
+        verb = msg.get("verb")
+        is_write = verb in self.WRITE_VERBS or "path" in msg
+        if verb == "bind":
+            self.bind_requests_by_tick[self.tick_now] += 1
+        if is_write and self.blackhole:
+            self.blackholed_requests += 1
+            return  # swallowed: caller times out, nothing mutates
+        if is_write and self.response_delay > 0.0:
+            import time
+
+            time.sleep(self.response_delay)
+        super()._handle(writer, msg)
 
     # -- structured log -------------------------------------------------
     def _log(self, entry: dict) -> None:

@@ -22,7 +22,9 @@ same-seed determinism.
 
 Event grammar (all events carry ``tick`` and ``op``)::
 
-    {"tick": -1, "op": "meta",       "seed": s, "bind_fail_pct": p}
+    {"tick": -1, "op": "meta",       "seed": s, "bind_fail_pct": p,
+     "slow_at": t, "slow_ticks": n, "slow_response_s": d,
+     "blackhole_at": t, "blackhole_ticks": n, "hbm_pressure_at": t}
     {"tick": 0, "op": "add-queue",   "name": q, "weight": w}
     {"tick": 0, "op": "add-node",    "node": {<codec NODE_KEYS dict>}}
     {"tick": t, "op": "remove-node", "name": n}
@@ -35,11 +37,13 @@ applies them during its convergence drain so outstanding demand keeps
 freeing capacity.
 
 The ``meta`` header (written first by the engine's ``--trace-out``)
-makes a recorded trace self-describing: replay recovers the seed and
-the bind-curse percentage — both resolved at FIRE time, so they are
-not derivable from the inline events — without the operator
-re-passing them.  It is excluded from `trace_hash` so a recording and
-its replay hash identically.
+makes a recorded trace self-describing: replay recovers the seed, the
+bind-curse percentage, and the guardrail fault windows — all of which
+shape RUN behavior (curse decisions, Guardrails wiring, wire
+timeouts) rather than the inline event schedule, so they are not
+derivable from the events — without the operator re-passing them.  It
+is excluded from `trace_hash` so a recording and its replay hash
+identically.
 """
 
 from __future__ import annotations

@@ -100,8 +100,55 @@ def build_parser() -> argparse.ArgumentParser:
                         "daemon skips the first-cycle recompile "
                         "(default: KB_TPU_COMPILE_CACHE or a tmp dir; "
                         "empty string disables)")
+    # -- guardrails (kube_batch_tpu/guardrails/; doc/design/guardrails.md)
+    p.add_argument("--hbm-ceiling-mb", type=float, default=None,
+                   help="HBM-ceiling admission: refuse growth-prewarm "
+                        "adoption of any program whose XLA "
+                        "memory_analysis projects more device memory "
+                        "than this many MB (default: "
+                        "KB_TPU_HBM_CEILING_MB; unset disables)")
+    p.add_argument("--watchdog-overruns", type=int, default=3,
+                   help="consecutive cycle overruns (latency > "
+                        "schedule period) before the degradation "
+                        "ladder climbs a rung (ok -> degraded -> "
+                        "overloaded, mirrored by /healthz; 0 disables)")
+    p.add_argument("--watchdog-recovery", type=int, default=5,
+                   help="consecutive healthy cycles before the ladder "
+                        "descends a rung (hysteresis: recovery is "
+                        "deliberately slower than engagement)")
+    p.add_argument("--breaker-failures", type=int, default=5,
+                   help="consecutive wire transport failures before "
+                        "the per-backend circuit breaker trips open "
+                        "and quiesces scheduling (0 disables)")
+    p.add_argument("--breaker-reset", type=float, default=15.0,
+                   help="seconds an open breaker waits before a "
+                        "half-open probe of the backend")
     p.add_argument("--version", action="store_true")
     return p
+
+
+def build_guardrails(args):
+    """The daemon's self-protection layer from CLI flags (env supplies
+    the ceiling default; flags win).  Shared by every run mode — the
+    sim path gets the watchdog + ceiling, the wire paths additionally
+    wrap their write backend via `Guardrails.guard_backend`."""
+    import dataclasses
+
+    from kube_batch_tpu.guardrails import GuardrailConfig, Guardrails
+
+    base = GuardrailConfig.from_env()
+    ceiling = (
+        args.hbm_ceiling_mb if args.hbm_ceiling_mb is not None
+        else base.hbm_ceiling_mb
+    )
+    return Guardrails(dataclasses.replace(
+        base,
+        hbm_ceiling_mb=ceiling,
+        watchdog_overruns=args.watchdog_overruns,
+        watchdog_recovery=args.watchdog_recovery,
+        breaker_failures=args.breaker_failures,
+        breaker_reset_s=args.breaker_reset,
+    ))
 
 
 def load_world(spec_arg: str | None, default_queue: str,
@@ -285,9 +332,24 @@ def run_external(args) -> int:
         status_updater=backend,
         default_queue=args.default_queue,
     )
+    # The write seams go through the guardrail wrapper: bounded
+    # backoff on transient wire errors, and a circuit breaker that
+    # quiesces scheduling (CacheResyncing) instead of hot-looping
+    # binds into a dead backend.  Watch/lease verbs stay raw — the
+    # watch must stay live so heal is observable, and the elector has
+    # its own retry discipline.
+    guardrails = build_guardrails(args)
+    guarded = guardrails.guard_backend(backend, cache)
+    cache.binder = guarded
+    cache.evictor = guarded
+    cache.status_updater = guarded
     if args.write_format == "k8s":
         # Events leave the process too in k8s mode (≙ the Recorder).
-        cache.event_sink = backend
+        cache.event_sink = guarded
+        # The PDB multi-budget divergence warning only matters when
+        # evictions leave the process in apiserver dialect (upstream's
+        # eviction API would refuse them outright; see plugins/pdb.py).
+        cache.k8s_write_format = True
     adapter = K8sWatchAdapter(
         cache, reader, backend=backend, scheduler_name=args.scheduler_name
     ).start()
@@ -394,6 +456,7 @@ def run_external(args) -> int:
             conf_path=args.scheduler_conf,
             schedule_period=args.schedule_period,
             profile_dir=args.profile_dir,
+            guardrails=guardrails,
         )
         ran = scheduler.run(stop=stop, max_cycles=args.cycles)
         logging.info("stopped after %d cycles", ran)
@@ -438,7 +501,15 @@ def run_http(args) -> int:
         status_updater=backend,
         default_queue=args.default_queue,
     )
-    cache.event_sink = backend
+    # Same guardrail wrapping as the stream path: backoff + breaker on
+    # the write seams; the reflectors reconnect on their own.
+    guardrails = build_guardrails(args)
+    guarded = guardrails.guard_backend(backend, cache, name="http")
+    cache.binder = guarded
+    cache.evictor = guarded
+    cache.status_updater = guarded
+    cache.event_sink = guarded
+    cache.k8s_write_format = True  # HTTP writes ARE the apiserver dialect
     mux = HttpWatchMux(client).start()
     backend.follow_served_versions(mux)
     adapter = K8sWatchAdapter(
@@ -468,6 +539,7 @@ def run_http(args) -> int:
             conf_path=args.scheduler_conf,
             schedule_period=args.schedule_period,
             profile_dir=args.profile_dir,
+            guardrails=guardrails,
         )
         ran = scheduler.run(stop=stop, max_cycles=args.cycles)
         logging.info("stopped after %d cycles", ran)
@@ -564,6 +636,9 @@ def main(argv: list[str] | None = None) -> int:
         conf_path=args.scheduler_conf,
         schedule_period=args.schedule_period,
         profile_dir=args.profile_dir,
+        # Sim mode has no wire to break, but the watchdog ladder and
+        # the HBM-ceiling admission apply the same.
+        guardrails=build_guardrails(args),
     )
     try:
         ran = scheduler.run(

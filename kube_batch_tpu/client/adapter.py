@@ -130,6 +130,13 @@ class StreamBackend:
             "verb": "updatePodGroup", "object": encode_pod_group(group),
         })
 
+    def ping(self) -> None:
+        """Cheapest possible round trip — the wire circuit breaker's
+        half-open probe (guardrails.Guardrails.pre_cycle).  Touches no
+        cluster state; a response at all proves the request/response
+        path is live again."""
+        self._call({"verb": "ping"})
+
     # -- watch lifecycle verbs (≙ reflector LIST / re-WATCH calls) ------
     def watch_resume(self, since: int) -> None:
         """Ask the cluster for every event after `since` (≙ re-watching
@@ -310,13 +317,16 @@ def resume_session(
         # Stateless recovery IN-PROCESS: drop the mirror, re-list,
         # keep the Scheduler + compiled executables.
         log.warning("watch gap (%s); re-listing in-process", exc)
-        cache.begin_resync()
+        cache.begin_relist()
         cache.clear()
         backend.request_list()
         mode = "relisted"
     if not adapter.wait_for_sync(sync_timeout):
         raise TimeoutError("resume replay never completed")
-    cache.end_resync()
+    # Releases this attempt's hold — or a timed-out predecessor's, now
+    # that the mirror finally replayed whole; no-op on a clean
+    # "resumed" with no outstanding relist hold.
+    cache.end_relist()
     return mode
 
 

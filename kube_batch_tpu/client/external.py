@@ -519,6 +519,10 @@ class ExternalCluster:
                     writer, rid, self.pods.get(msg["pod"]),
                     msg.get("reason", ""),
                 )
+            elif verb == "ping":
+                # Health probe (the wire breaker's half-open check):
+                # answer, touch nothing.
+                self._respond(writer, rid, True)
             elif verb == "updatePodGroup":
                 from kube_batch_tpu.client.codec import decode_pod_group
 

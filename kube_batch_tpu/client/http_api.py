@@ -669,6 +669,13 @@ class K8sHttpBackend:
                 raise HttpError(resp.status, data)
             return
 
+    def ping(self) -> None:
+        """Cheapest possible round trip — the guardrail breaker's
+        half-open probe (guardrails.Guardrails.pre_cycle).  GET
+        /version touches no resources and answers on any live
+        apiserver; any response at all proves the wire recovered."""
+        self.client.request_json("GET", "/version")
+
     def bind(self, pod: Pod, node_name: str) -> None:
         self._issue(binding_request(pod, node_name))
 

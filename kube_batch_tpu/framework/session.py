@@ -274,15 +274,20 @@ class Session:
         refresh groups) stays on this thread."""
         task_state = self.host_task_state()
         task_node = self.host_task_node()
-        ready = self.job_ready()
         task_job = self.host_snap_field("task_job")
 
         newly_allocated = (
             (task_state == int(TaskStatus.ALLOCATED))
             & (self.initial_task_state == int(TaskStatus.PENDING))
         )
+        newly_idx = np.nonzero(newly_allocated)[0]
+        # Nothing newly allocated (e.g. a ceiling-paused cycle that
+        # never ran the solve): don't touch job_ready — its fallback
+        # computes the gang mask on-device, a dispatch (and at a new
+        # shape, a compile) this cycle deliberately avoided.
+        ready = self.job_ready() if newly_idx.size else None
         to_bind: list[tuple[object, str]] = []
-        for t in np.nonzero(newly_allocated)[0]:
+        for t in newly_idx:
             if t >= self.meta.num_real_tasks:
                 continue
             j = task_job[t]

@@ -1,0 +1,369 @@
+"""Guardrails: the scheduler's self-protection layer.
+
+The reference scheduler survives overload by shedding serially — pods
+simply stay Pending past the 1 s period (scheduler.go ·
+defaultSchedulePeriod) and the loop never does more work than one
+cycle's worth.  A tensorized rebuild fails differently: it fails at
+CLIFFS.  A next-bucket program that does not fit HBM OOMs the device
+the cycle the cluster crosses the boundary; a backend outage hot-loops
+thousands of bind timeouts through resync with no backoff; a
+persistently-overrunning cycle has no way to shed optional work.  This
+package gives the daemon a ladder to stand on — three coordinated
+mechanisms behind one facade the scheduler consults every cycle:
+
+* **HBM-ceiling admission** (`hbm.HbmCeiling`) — growth prewarm runs
+  XLA ``memory_analysis`` on the candidate next-bucket executable
+  BEFORE adoption and refuses (loudly, repeatedly — mirroring the
+  compile-cliff conf-adoption refusal in scheduler.py) when the
+  projected device memory exceeds a configurable ceiling.
+
+* **Cycle-overrun watchdog** (`watchdog.CycleWatchdog`) — rolling
+  cycle latency vs the schedule period; past a threshold of
+  CONSECUTIVE overruns it climbs a degradation ladder
+  (ok → degraded → overloaded) with hysteresis-based recovery,
+  emitting a k8s-style Event and a `/healthz` state transition at
+  each rung.
+
+* **Wire circuit breaker** (`breaker.CircuitBreaker` +
+  `breaker.GuardedBackend`) — bind/evict/status writes get bounded
+  exponential backoff with deterministic jitter, and a per-backend
+  breaker that trips open after repeated transport failures,
+  QUIESCING scheduling (reusing the cache's ``CacheResyncing``
+  mechanism) instead of burning cycles re-binding into a dead
+  backend, with half-open probing for recovery.
+
+Operational semantics, ceiling table and the runbook for operating at
+the capacity ceiling: doc/design/guardrails.md.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import time
+
+from kube_batch_tpu import metrics
+from kube_batch_tpu.guardrails.breaker import (
+    Backoff,
+    BreakerOpen,
+    CircuitBreaker,
+    GuardedBackend,
+    is_transient,
+)
+from kube_batch_tpu.guardrails.hbm import HbmCeiling, projected_device_bytes
+from kube_batch_tpu.guardrails.watchdog import RUNGS, CycleWatchdog
+
+__all__ = [
+    "Backoff",
+    "BreakerOpen",
+    "CircuitBreaker",
+    "CycleWatchdog",
+    "GuardedBackend",
+    "Guardrails",
+    "GuardrailConfig",
+    "HbmCeiling",
+    "RUNGS",
+    "is_transient",
+    "projected_device_bytes",
+]
+
+log = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class GuardrailConfig:
+    """Knobs for all three mechanisms (CLI flags / env / chaos)."""
+
+    #: Projected-HBM admission ceiling in MB; 0/None disables.  Env
+    #: default: KB_TPU_HBM_CEILING_MB.
+    hbm_ceiling_mb: float | None = None
+    #: Consecutive cycle overruns before the ladder climbs one rung;
+    #: 0 disables the watchdog.
+    watchdog_overruns: int = 3
+    #: Consecutive healthy cycles before the ladder descends one rung
+    #: (hysteresis: recovery is deliberately slower than engagement).
+    watchdog_recovery: int = 5
+    #: A cycle counts as an overrun when its latency exceeds
+    #: ``watchdog_factor × schedule_period``.
+    watchdog_factor: float = 1.0
+    #: Watchdog reference period in seconds; None → the scheduler's
+    #: own schedule_period (<= 0 disables — a period-0 harness has no
+    #: budget to overrun).
+    watchdog_period: float | None = None
+    #: Consecutive transport failures before the wire breaker trips
+    #: open; 0 disables the breaker.
+    breaker_failures: int = 5
+    #: Seconds an open breaker waits before allowing a half-open probe.
+    breaker_reset_s: float = 15.0
+    #: Bounded-exponential-backoff retry knobs for transient wire
+    #: errors (per write call; app-level rejections are never retried).
+    backoff_base_s: float = 0.05
+    backoff_cap_s: float = 2.0
+    backoff_attempts: int = 3
+
+    @classmethod
+    def from_env(cls) -> "GuardrailConfig":
+        raw = os.environ.get("KB_TPU_HBM_CEILING_MB")
+        ceiling = None
+        if raw:
+            try:
+                ceiling = float(raw)
+            except ValueError:
+                log.warning("ignoring unparsable KB_TPU_HBM_CEILING_MB=%r",
+                            raw)
+        return cls(hbm_ceiling_mb=ceiling)
+
+
+class Guardrails:
+    """Facade the scheduler loop consults every cycle.
+
+    One instance per Scheduler; owns the ceiling, the watchdog, and
+    (once `guard_backend` wires one) the wire breaker.  All state
+    transitions are surfaced three ways: a log line, a structured
+    cache event (→ a k8s Event under ``--write-format k8s``), and the
+    `/healthz` + metrics gauges (`guardrail_state`, `breaker_state`).
+    """
+
+    def __init__(self, config: GuardrailConfig | None = None) -> None:
+        self.config = config or GuardrailConfig.from_env()
+        ceiling = self.config.hbm_ceiling_mb
+        self.hbm = HbmCeiling(
+            int(ceiling * 1e6) if ceiling else None
+        )
+        self.watchdog = CycleWatchdog(
+            period=self.config.watchdog_period,
+            engage_after=self.config.watchdog_overruns,
+            recover_after=self.config.watchdog_recovery,
+            factor=self.config.watchdog_factor,
+        )
+        self.breaker: CircuitBreaker | None = None
+        self._guarded: GuardedBackend | None = None
+        self._cache = None  # quiesce target once a backend is guarded
+        #: True while the scheduler's current snapshot shapes require
+        #: a program the HBM-ceiling admission refused — the solve is
+        #: paused, so /healthz floors at "degraded".
+        self._hbm_blocked = False
+        # Deliberately NO metrics.set_health_state here: the /healthz
+        # body is process-global, and Scheduler default-constructs a
+        # Guardrails whenever none is passed — a second instance must
+        # not reset a live daemon's degraded state to "ok".  The
+        # module default is "ok"; transitions publish from here on.
+
+    # -- wiring ---------------------------------------------------------
+    def guard_backend(self, inner, cache, name: str = "wire",
+                      sleep=time.sleep,
+                      clock=time.monotonic) -> GuardedBackend:
+        """Wrap a write backend (StreamBackend / K8sHttpBackend) in
+        retry + breaker protection, quiescing `cache` while open.  The
+        returned object is what the cache's binder/evictor/
+        status_updater seams should point at; watch-lifecycle and
+        lease verbs pass through undecorated (the watch must stay live
+        so heal is observable, and the elector has its own retry
+        discipline)."""
+        if self.config.breaker_failures <= 0:
+            return GuardedBackend(inner, breaker=None,
+                                  backoff=self._backoff(), sleep=sleep)
+        if not callable(getattr(inner, "ping", None)):
+            # Half-open recovery's ONLY evidence of heal is the probe:
+            # while the breaker is open, scheduling is quiesced, so no
+            # regular write can ever close it.  A ping-less backend
+            # would either wedge open forever or (worse) close blind —
+            # refuse at wiring time instead.
+            raise TypeError(
+                f"guard_backend({type(inner).__name__}): a "
+                "breaker-guarded backend must expose a ping() probe "
+                "verb (set breaker_failures=0 for retry/backoff-only "
+                "guarding)"
+            )
+        self._cache = cache
+        self.breaker = CircuitBreaker(
+            name=name,
+            trip_after=self.config.breaker_failures,
+            reset_after=self.config.breaker_reset_s,
+            clock=clock,
+            on_open=self._on_breaker_open,
+            on_close=self._on_breaker_close,
+        )
+        self._guarded = GuardedBackend(
+            inner, breaker=self.breaker, backoff=self._backoff(),
+            sleep=sleep,
+        )
+        return self._guarded
+
+    def _backoff(self) -> Backoff:
+        return Backoff(
+            base=self.config.backoff_base_s,
+            cap=self.config.backoff_cap_s,
+            attempts=self.config.backoff_attempts,
+        )
+
+    # -- /healthz publication -------------------------------------------
+    def _publish_health(self) -> None:
+        """The /healthz body is the ladder rung FLOORED at "degraded"
+        while service is actually quiesced (wire breaker not closed,
+        or the HBM ceiling is blocking the solve): a dead backend or a
+        paused solve is degradation regardless of how fast the skipped
+        cycles run, and probes/runbooks must not read "ok" mid-outage."""
+        rung = self.watchdog.rung
+        if self._hbm_blocked or (
+            self.breaker is not None
+            and self.breaker.state != CircuitBreaker.CLOSED
+        ):
+            rung = max(rung, 1)
+        metrics.set_health_state(RUNGS[rung])
+
+    def note_hbm_block(self, blocked: bool) -> None:
+        """Scheduler hook: the cycle's solve was (or no longer is)
+        paused by a refused over-ceiling program."""
+        if blocked != self._hbm_blocked:
+            self._hbm_blocked = blocked
+            self._publish_health()
+
+    @property
+    def hbm_blocked(self) -> bool:
+        """True while the ceiling is pausing the solve — the scheduler
+        also skips the per-pod diagnosis fan-out on these cycles (it
+        would compile a second device program at the refused shape,
+        and the HbmCeilingBlocked event already says why everything
+        pending is pending)."""
+        return self._hbm_blocked
+
+    # -- breaker transitions (quiesce / resume scheduling) --------------
+    def _on_breaker_open(self, name: str) -> None:
+        log.error(
+            "wire breaker %r tripped OPEN after %d consecutive transport "
+            "failures; QUIESCING scheduling (cycles skip via the "
+            "CacheResyncing mechanism — zero bind attempts until a "
+            "half-open probe succeeds)",
+            name, self.config.breaker_failures,
+        )
+        self._publish_health()
+        if self._cache is not None:
+            self._cache.begin_resync()
+            self._cache.record_event(
+                "Scheduler", name, "BreakerOpen",
+                f"wire breaker tripped after "
+                f"{self.config.breaker_failures} transport failures; "
+                "scheduling quiesced",
+            )
+
+    def _on_breaker_close(self, name: str) -> None:
+        log.warning(
+            "wire breaker %r CLOSED (half-open probe succeeded); "
+            "scheduling resumes", name,
+        )
+        self._publish_health()
+        if self._cache is not None:
+            self._cache.end_resync()
+            self._cache.record_event(
+                "Scheduler", name, "BreakerClosed",
+                "wire backend recovered; scheduling resumed",
+            )
+
+    # -- per-cycle hooks the scheduler calls ----------------------------
+    def pre_cycle(self) -> None:
+        """Half-open probing: when the breaker is open and its reset
+        window elapsed, send one cheap probe (the backend's `ping`
+        verb) — success closes the breaker and un-quiesces; failure
+        re-opens it for another window.  A closed/absent breaker is a
+        no-op."""
+        breaker = self.breaker
+        if breaker is None or breaker.state == CircuitBreaker.CLOSED:
+            return
+        if not breaker.allow():
+            return  # still inside the open window
+        inner = self._guarded.inner if self._guarded is not None else None
+        probe = getattr(inner, "ping", None)
+        if probe is None:
+            # guard_backend requires ping, so this is unreachable in
+            # normal wiring — but closing without evidence would
+            # un-quiesce into a possibly-dead backend, so fail safe.
+            log.error("wire breaker half-open: no ping probe available; "
+                      "staying open")
+            breaker.record_failure()
+            return
+        try:
+            probe()
+        except Exception as exc:  # noqa: BLE001 — classified below
+            if is_transient(exc):
+                # Wire still dead: re-open for another full window.
+                log.warning("wire breaker half-open probe failed: %s",
+                            exc)
+                breaker.record_failure()
+                return
+            # An application-level answer (e.g. a proxy 403/404 on the
+            # probe endpoint) is PROOF the request/response path is
+            # live — the same classification GuardedBackend applies to
+            # writes.  Counting it as failure would wedge the breaker
+            # (and quiesced scheduling) open forever over a healthy
+            # wire.
+            log.warning(
+                "wire breaker half-open probe got an app-level answer "
+                "(%s): wire is live; closing", exc,
+            )
+        breaker.record_success()
+
+    def observe_cycle(self, cycle_s: float, cache=None,
+                      period: float | None = None) -> None:
+        """Feed one cycle's wall latency to the watchdog; a rung
+        transition is evented + logged + exported here."""
+        changed = self.watchdog.observe(cycle_s, period=period)
+        if changed is None:
+            return
+        state = RUNGS[self.watchdog.rung]
+        self._publish_health()
+        if self.watchdog.rung > changed[0]:
+            log.error(
+                "cycle watchdog: %d consecutive overruns (last %.3fs "
+                "vs period %.3fs); degradation ladder → %r (growth "
+                "prewarm paused%s)",
+                self.config.watchdog_overruns, cycle_s,
+                self.watchdog.effective_period(period), state,
+                "; diagnosis skipped, period stretched"
+                if self.watchdog.rung >= 2 else "",
+            )
+        else:
+            log.warning(
+                "cycle watchdog: %d consecutive healthy cycles; "
+                "recovery → %r", self.config.watchdog_recovery, state,
+            )
+        if cache is not None:
+            cache.record_event(
+                "Scheduler", "watchdog", "GuardrailStateChanged",
+                f"degradation ladder {RUNGS[changed[0]]} -> {state}",
+            )
+
+    # -- ladder effect queries ------------------------------------------
+    @property
+    def rung(self) -> int:
+        return self.watchdog.rung
+
+    @property
+    def state(self) -> str:
+        return RUNGS[self.watchdog.rung]
+
+    def pause_prewarm(self) -> bool:
+        """rung ≥ 1: background next-bucket compiles pause — an
+        overrunning daemon must not feed the compile service while it
+        is behind (they resume on recovery; the boundary cycle then
+        joins or pays the compile, which is the pre-guardrail
+        behavior, not a new failure mode)."""
+        return self.watchdog.rung >= 1
+
+    def skip_diagnosis(self) -> bool:
+        """rung ≥ 2: the per-pod why-unschedulable diagnosis fan-out
+        (events + conditions, O(pending) host work) is optional
+        observability and the first work shed when overloaded."""
+        return self.watchdog.rung >= 2
+
+    def period_multiplier(self) -> float:
+        """rung ≥ 2: the daemon loop stretches its effective period —
+        scheduling less often batches more work per cycle, the direct
+        analog of the reference's serial shedding (pods simply stay
+        Pending past the period)."""
+        return 2.0 if self.watchdog.rung >= 2 else 1.0
+
+    def breaker_state(self) -> str:
+        return self.breaker.state if self.breaker is not None \
+            else CircuitBreaker.CLOSED

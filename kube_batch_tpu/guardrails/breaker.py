@@ -1,0 +1,276 @@
+"""Wire circuit breaker + bounded-backoff retry for backend writes.
+
+Failure mode this removes: a dead-but-connected backend (bind requests
+time out, the watch may even stay up) makes every cycle dispatch its
+full bind fan-out into 10 s timeouts, fail them all into the resync
+queue, and re-dispatch next cycle — a hot loop that burns the period
+on a backend that cannot accept work.  The reference leans on
+client-go's rate limiters and the errTasks workqueue's per-item
+backoff; here the equivalent is explicit:
+
+* `Backoff` — bounded exponential backoff with DETERMINISTIC jitter
+  (hash of (name, key, attempt), not an RNG): retries spread out
+  without destroying the chaos engine's same-seed reproducibility.
+* `CircuitBreaker` — closed → open after `trip_after` CONSECUTIVE
+  transport failures; open → half-open after `reset_after` seconds;
+  half-open admits exactly ONE probe (races lose), whose outcome
+  closes or re-opens the breaker.
+* `GuardedBackend` — wraps a StreamBackend / K8sHttpBackend's WRITE
+  verbs (bind / evict / update_pod_group).  Transport errors
+  (ConnectionError, TimeoutError, OSError) and HTTP backpressure /
+  server errors (429, 5xx — see `is_transient`) are retried under the
+  backoff and counted by the breaker; application-level rejections
+  (RuntimeError: "node not found", "lease lost", HTTP 4xx) are never
+  retried — the wire answered, so they count as breaker SUCCESS and
+  propagate.  While open, calls raise `BreakerOpen` WITHOUT touching
+  the wire.
+
+The breaker's open/close callbacks are where scheduling quiesces: the
+`Guardrails` facade wires them to `cache.begin_resync()` /
+`end_resync()`, so open-state cycles skip via the same CacheResyncing
+mechanism a watch-gap relist uses — zero bind attempts while open.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import threading
+import time
+
+from kube_batch_tpu import metrics
+
+log = logging.getLogger(__name__)
+
+#: Exception classes that indicate the WIRE failed (retry + count)
+#: rather than the request being rejected (pass through).
+TRANSIENT_ERRORS = (ConnectionError, TimeoutError, OSError)
+
+
+def is_transient(exc: BaseException) -> bool:
+    """Wire-level failure (retry + count toward the breaker) vs
+    application-level rejection (never retried; passes through as
+    breaker SUCCESS — the wire answered).  Besides transport
+    exceptions, HTTP backpressure/server errors — 429 or any 5xx,
+    duck-typed on an integer ``status`` attribute so this module needs
+    no HTTP import — count as transient: an apiserver answering 503 on
+    every write is exactly the dead-backend hot loop the breaker
+    exists to quiesce.  Other 4xx stay app-level (the REQUEST is
+    wrong, not the wire)."""
+    if isinstance(exc, TRANSIENT_ERRORS):
+        return True
+    status = getattr(exc, "status", None)
+    return isinstance(status, int) and (status == 429 or status >= 500)
+
+
+class BreakerOpen(ConnectionError):
+    """Raised instead of touching the wire while the breaker is open.
+    Subclasses ConnectionError so existing callers (cache.bind's
+    failure funnel, LeaseElector) treat it as the transport failure
+    it represents."""
+
+
+class Backoff:
+    """Bounded exponential backoff with deterministic jitter.
+
+    delay(attempt) ∈ [0.5·raw, raw] where raw = min(cap, base·2^attempt)
+    — full determinism (same (key, attempt) ⇒ same delay) keeps seeded
+    chaos runs reproducible while still decorrelating concurrent
+    retriers (each pod uid lands elsewhere in the window).
+    """
+
+    def __init__(self, base: float = 0.05, cap: float = 2.0,
+                 attempts: int = 3, name: str = "wire") -> None:
+        self.base = base
+        self.cap = cap
+        self.attempts = max(int(attempts), 1)
+        self.name = name
+
+    def delay(self, attempt: int, key: str = "") -> float:
+        raw = min(self.cap, self.base * (2.0 ** attempt))
+        digest = hashlib.sha256(
+            f"{self.name}:{key}:{attempt}".encode()
+        ).digest()
+        frac = 0.5 + (digest[0] / 255.0) * 0.5   # [0.5, 1.0]
+        return raw * frac
+
+
+class CircuitBreaker:
+    CLOSED = "closed"
+    OPEN = "open"
+    HALF_OPEN = "half-open"
+    _STATE_VALUE = {CLOSED: 0.0, HALF_OPEN: 1.0, OPEN: 2.0}
+
+    def __init__(
+        self,
+        name: str = "wire",
+        trip_after: int = 5,
+        reset_after: float = 15.0,
+        clock=time.monotonic,
+        on_open=None,
+        on_close=None,
+    ) -> None:
+        self.name = name
+        self.trip_after = max(int(trip_after), 1)
+        self.reset_after = reset_after
+        self._clock = clock
+        self._on_open = on_open
+        self._on_close = on_close
+        self._lock = threading.Lock()
+        self._state = self.CLOSED
+        self._failures = 0
+        self._opened_at = 0.0
+        self._probe_out = False  # a half-open probe is in flight
+        self.opened_count = 0
+        self.closed_count = 0
+        metrics.breaker_state.set(0.0, name)
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    def _set_state(self, state: str) -> None:
+        self._state = state
+        metrics.breaker_state.set(self._STATE_VALUE[state], self.name)
+
+    def allow(self) -> bool:
+        """May a call touch the wire right now?  Open → False until
+        `reset_after` elapsed, then exactly ONE caller gets True (the
+        half-open probe); concurrent racers get False until the probe
+        reports back."""
+        with self._lock:
+            if self._state == self.CLOSED:
+                return True
+            if self._state == self.OPEN:
+                if self._clock() - self._opened_at < self.reset_after:
+                    return False
+                self._set_state(self.HALF_OPEN)
+                self._probe_out = True
+                return True
+            # half-open: one probe only
+            if self._probe_out:
+                return False
+            self._probe_out = True
+            return True
+
+    def record_success(self) -> None:
+        fire = None
+        with self._lock:
+            self._failures = 0
+            self._probe_out = False
+            if self._state != self.CLOSED:
+                self._set_state(self.CLOSED)
+                self.closed_count += 1
+                fire = self._on_close
+        if fire is not None:
+            fire(self.name)
+
+    def record_failure(self) -> None:
+        fire = None
+        with self._lock:
+            self._failures += 1
+            if self._state == self.HALF_OPEN:
+                # The probe failed: back to a full open window.
+                self._probe_out = False
+                self._set_state(self.OPEN)
+                self._opened_at = self._clock()
+            elif (
+                self._state == self.CLOSED
+                and self._failures >= self.trip_after
+            ):
+                self._set_state(self.OPEN)
+                self._opened_at = self._clock()
+                self.opened_count += 1
+                fire = self._on_open
+        if fire is not None:
+            fire(self.name)
+
+
+class GuardedBackend:
+    """Retry + breaker decoration over a write backend's verbs:
+    `bind`, `evict`, `update_pod_group`.
+
+    Everything else (watch lifecycle, lease verbs, `record_event`,
+    `closed`, `reconnect`, …) delegates to the inner backend
+    untouched: the breaker protects the scheduling WRITE path; the
+    watch and the elector must stay live so heal is observable.
+    `record_event` is deliberately NOT guarded — every backend that
+    has one (K8sStreamBackend, K8sHttpBackend) is an async local
+    enqueue that cannot block on the wire, and counting its
+    always-local success would reset the breaker's CONSECUTIVE
+    transport-failure streak between real bind failures, making the
+    breaker untrippable.
+    """
+
+    def __init__(self, inner, breaker: CircuitBreaker | None = None,
+                 backoff: Backoff | None = None, sleep=time.sleep) -> None:
+        self.inner = inner
+        self.breaker = breaker
+        self.backoff = backoff or Backoff()
+        self._sleep = sleep
+
+    def __getattr__(self, name):
+        # Only called for attributes NOT defined on this class —
+        # everything un-guarded passes through.
+        return getattr(self.inner, name)
+
+    def _guarded(self, verb: str, call, key: str = ""):
+        breaker = self.breaker
+        last: Exception | None = None
+        for attempt in range(self.backoff.attempts):
+            if breaker is not None and not breaker.allow():
+                raise BreakerOpen(
+                    f"wire breaker {breaker.name!r} is open; "
+                    f"{verb} not attempted"
+                )
+            try:
+                out = call()
+            except Exception as exc:  # noqa: BLE001 — classified below
+                if not is_transient(exc):
+                    # Application-level rejection (RuntimeError from
+                    # the stream dialect's ok=False answer, an
+                    # HttpError 4xx, ...): never retried — but it IS
+                    # proof the wire is alive, so it counts as breaker
+                    # success.  This matters most in HALF_OPEN, where
+                    # this call may hold the single probe slot:
+                    # propagating without recording would leak the
+                    # slot and wedge the breaker half-open forever.
+                    if breaker is not None:
+                        breaker.record_success()
+                    raise
+                last = exc
+                if breaker is not None:
+                    breaker.record_failure()
+                    if breaker.state != CircuitBreaker.CLOSED:
+                        break  # tripped mid-call: stop retrying into it
+                if attempt + 1 < self.backoff.attempts:
+                    metrics.wire_backoff_retries.inc(verb)
+                    self._sleep(self.backoff.delay(attempt, key))
+                continue
+            if breaker is not None:
+                breaker.record_success()
+            return out
+        raise last if last is not None else ConnectionError(
+            f"{verb} failed with no attempts"
+        )
+
+    # -- the guarded write seam (cache/backend.py protocols) ------------
+    def bind(self, pod, node_name: str) -> None:
+        return self._guarded(
+            "bind", lambda: self.inner.bind(pod, node_name),
+            key=getattr(pod, "uid", ""),
+        )
+
+    def evict(self, pod, reason: str) -> None:
+        return self._guarded(
+            "evict", lambda: self.inner.evict(pod, reason),
+            key=getattr(pod, "uid", ""),
+        )
+
+    def update_pod_group(self, group) -> None:
+        return self._guarded(
+            "updatePodGroup",
+            lambda: self.inner.update_pod_group(group),
+            key=getattr(group, "name", ""),
+        )

@@ -1,0 +1,90 @@
+"""HBM-ceiling admission: refuse programs that cannot fit the chip.
+
+Config 5 peaks at ~14.1 GB of device memory on a ~16 GB v5e
+(BASELINE.md round-5 capture) — one padding-bucket growth past the
+flagship shape and the next-bucket program the growth prewarm would
+happily adopt no longer fits.  Without admission the crossing cycle
+OOMs the device mid-daemon; with it, the prewarm measures the
+candidate executable's XLA buffer assignment (``memory_analysis`` —
+the same static bound bench.py reports as ``peak_hbm_mb``) BEFORE
+publishing it, and refuses adoption with a loud, repeated warning when
+the projection exceeds the configured ceiling.  The previous program
+keeps serving below the boundary; if the cluster actually crosses it,
+the refusal is ENFORCED — the scheduler pauses the solve (placed work
+keeps running, pending rows wait, /healthz floors at "degraded")
+rather than executing a program the ceiling says cannot fit, and
+resumes on its own once completions shrink the world back under the
+serving bucket.  Serial shedding — the reference's own overload
+behavior — instead of the daemon dying.
+
+The ceiling is configuration, not discovery: tunneled backends hide
+live ``memory_stats``, so the operator states the budget
+(``--hbm-ceiling-mb`` / KB_TPU_HBM_CEILING_MB) from the known chip
+minus a safety margin.  Operator options at the ceiling — shard the
+solve, shrink padding buckets, cap admission — are in
+doc/design/guardrails.md.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from kube_batch_tpu import metrics
+
+log = logging.getLogger(__name__)
+
+
+def projected_device_bytes(exe) -> int | None:
+    """Static device-memory bound of a compiled executable from XLA's
+    buffer assignment: peak when the backend reports it, else the
+    argument+output+temp sum (the same fallback bench.py's
+    ``peak_hbm_mb`` uses).  None when the executable exposes no
+    analysis (non-XLA fakes in tests)."""
+    try:
+        ma = exe.memory_analysis()
+        peak = getattr(ma, "peak_memory_in_bytes", 0) or (
+            ma.temp_size_in_bytes
+            + ma.argument_size_in_bytes
+            + ma.output_size_in_bytes
+        )
+        return int(peak)
+    except Exception:  # noqa: BLE001 — analysis is advisory evidence;
+        # an executable that cannot report it is admitted (None)
+        return None
+
+
+class HbmCeiling:
+    """Admission decision + bookkeeping.  Ceiling None disables."""
+
+    def __init__(self, ceiling_bytes: int | None = None) -> None:
+        self.ceiling_bytes = ceiling_bytes
+        self.refusals = 0
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.ceiling_bytes)
+
+    def admit(self, exe, label: str = "") -> tuple[bool, int | None]:
+        """(admitted, projected_bytes) for one candidate executable.
+        A refusal is counted and logged here; the CALLER owns making
+        the warning repeat (scheduler.py re-warns every cycle while
+        the refused boundary stays imminent) and recording the event."""
+        projected = projected_device_bytes(exe)
+        if projected is not None:
+            metrics.hbm_projected_bytes.set(float(projected))
+        if not self.enabled or projected is None:
+            return True, projected
+        if projected <= self.ceiling_bytes:
+            return True, projected
+        self.refusals += 1
+        metrics.hbm_admission_refusals.inc()
+        log.error(
+            "HBM-ceiling admission REFUSED %s: projected device memory "
+            "%.1f MB exceeds the configured ceiling %.1f MB — the "
+            "current program keeps serving; past the boundary the "
+            "solve pauses (placed work keeps running, pending rows "
+            "wait); operator options: shard the solve, shrink padding "
+            "buckets, or cap admission (doc/design/guardrails.md)",
+            label or "program", projected / 1e6, self.ceiling_bytes / 1e6,
+        )
+        return False, projected

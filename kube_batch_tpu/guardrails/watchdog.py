@@ -1,0 +1,105 @@
+"""Cycle-overrun watchdog: the degradation ladder's state machine.
+
+A 1 s-period daemon that persistently takes longer than its period is
+in overload: the backlog compounds, latency SLOs are already gone, and
+the right move is to shed optional work and schedule less often — not
+to keep maximizing per-cycle completeness.  The reference scheduler
+gets this for free (its serial loop simply leaves pods Pending); the
+tensorized rebuild needs it made explicit.
+
+State machine::
+
+    rung 0 "ok"  ──(engage_after consecutive overruns)──► rung 1
+    rung 1 "degraded"  ──(engage_after more)──► rung 2 "overloaded"
+    rung N ──(recover_after consecutive healthy cycles)──► rung N-1
+
+Hysteresis is structural: engagement and recovery both require
+CONSECUTIVE streaks, and any overrun resets the healthy streak (and
+vice versa) — oscillating load that alternates overrun/healthy can
+neither climb nor descend, so the ladder cannot flap.  Recovery is
+deliberately slower than engagement (recover_after > engage_after by
+default): dropping a rung too eagerly re-enters the overload that
+engaged it.
+
+The watchdog only OBSERVES and reports (rung + metrics); the ladder's
+effects — prewarm pause, diagnosis skip, period stretch — are queried
+from it by the scheduler loop (see guardrails.Guardrails), so a
+harness that drives `run_once` directly feels only the effects that
+exist inside one cycle.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from kube_batch_tpu import metrics
+
+#: Ladder rungs, index == severity.  Also the `/healthz` body.
+RUNGS = ("ok", "degraded", "overloaded")
+
+
+class CycleWatchdog:
+    def __init__(
+        self,
+        period: float | None = None,
+        engage_after: int = 3,
+        recover_after: int = 5,
+        factor: float = 1.0,
+    ) -> None:
+        #: Reference period; None → the per-observe caller supplies it
+        #: (the scheduler passes its own schedule_period).  A resolved
+        #: period <= 0 disables the watchdog for that observation —
+        #: a period-0 harness has no budget to overrun.
+        self.period = period
+        self.engage_after = max(int(engage_after), 0)
+        self.recover_after = max(int(recover_after), 1)
+        self.factor = factor
+        self.rung = 0
+        self.max_rung_seen = 0
+        self._overruns = 0   # current consecutive-overrun streak
+        self._healthy = 0    # current consecutive-healthy streak
+        self._lock = threading.Lock()
+        # Deliberately NO metrics.guardrail_state.set(0.0) here: the
+        # gauge is process-global and initialized at registration —
+        # constructing a second watchdog (a second Scheduler in the
+        # same process) must not erase a live instance's rung.
+
+    @property
+    def enabled(self) -> bool:
+        return self.engage_after > 0
+
+    def effective_period(self, period: float | None = None) -> float:
+        p = self.period if self.period is not None else period
+        return p if p is not None else 0.0
+
+    def observe(
+        self, cycle_s: float, period: float | None = None
+    ) -> tuple[int, int] | None:
+        """Record one cycle's wall latency.  Returns ``(old, new)``
+        when the rung changed, else None."""
+        if not self.enabled:
+            return None
+        p = self.effective_period(period)
+        if p <= 0.0:
+            return None
+        with self._lock:
+            old = self.rung
+            if cycle_s > self.factor * p:
+                metrics.cycle_overrun_total.inc()
+                self._healthy = 0
+                self._overruns += 1
+                if self._overruns >= self.engage_after and \
+                        self.rung < len(RUNGS) - 1:
+                    self.rung += 1
+                    self._overruns = 0
+            else:
+                self._overruns = 0
+                self._healthy += 1
+                if self._healthy >= self.recover_after and self.rung > 0:
+                    self.rung -= 1
+                    self._healthy = 0
+            self.max_rung_seen = max(self.max_rung_seen, self.rung)
+            if self.rung == old:
+                return None
+            metrics.guardrail_state.set(float(self.rung))
+            return (old, self.rung)

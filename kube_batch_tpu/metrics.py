@@ -256,6 +256,69 @@ cycle_phase_latency = REGISTRY.register(Histogram(
     labels=("phase",),
 ))
 
+# -- guardrail subsystem (kube_batch_tpu/guardrails/) ------------------------
+guardrail_state = REGISTRY.register(Gauge(
+    "guardrail_state",
+    "Degradation-ladder rung of the cycle watchdog "
+    "(0 ok, 1 degraded, 2 overloaded); mirrored by /healthz.",
+))
+# Exposed from process start (not from a constructor: Guardrails /
+# CycleWatchdog instances must never reset the process-global rung a
+# LIVE instance already published — see test_second_scheduler_does_
+# not_stomp_health).
+guardrail_state.set(0.0)
+cycle_overrun_total = REGISTRY.register(Counter(
+    "cycle_overrun_total",
+    "Scheduling cycles whose wall latency exceeded the schedule "
+    "period (the watchdog's escalation signal).",
+))
+breaker_state = REGISTRY.register(Gauge(
+    "breaker_state",
+    "Wire circuit-breaker state per backend "
+    "(0 closed, 1 half-open, 2 open).",
+    labels=("backend",),
+))
+wire_backoff_retries = REGISTRY.register(Counter(
+    "wire_backoff_retries_total",
+    "Transient-error retries of backend write verbs under the "
+    "bounded-exponential-backoff policy.",
+    labels=("verb",),
+))
+hbm_projected_bytes = REGISTRY.register(Gauge(
+    "hbm_projected_bytes",
+    "Last XLA memory_analysis projection of a candidate executable's "
+    "device memory (growth-prewarm admission input).",
+))
+hbm_admission_refusals = REGISTRY.register(Counter(
+    "hbm_admission_refusals_total",
+    "Candidate programs the HBM-ceiling admission refused to adopt.",
+))
+hbm_blocked_cycles = REGISTRY.register(Counter(
+    "hbm_blocked_cycles_total",
+    "Cycles whose solve was PAUSED because the snapshot's shapes "
+    "require a program the HBM-ceiling admission refused (placed "
+    "work keeps running; pending rows wait for shrink or operator "
+    "action).",
+))
+
+# -- /healthz state (set by the guardrail watchdog) --------------------------
+_health_lock = threading.Lock()
+_health_state = "ok"
+
+
+def set_health_state(state: str) -> None:
+    """Transition the /healthz body (ok | degraded | overloaded) —
+    the watchdog's rung, externally observable without scraping
+    metrics (load-balancers and runbooks read this)."""
+    global _health_state
+    with _health_lock:
+        _health_state = state
+
+
+def health_state() -> str:
+    with _health_lock:
+        return _health_state
+
 
 def serve(address: str = ":8080") -> threading.Thread:
     """Serve /metrics on `address` (≙ --listen-address), daemon thread."""
@@ -268,10 +331,13 @@ def serve(address: str = ":8080") -> threading.Thread:
             if self.path == "/healthz":
                 # Liveness for supervisors/load-balancers (the
                 # deployment runbook's systemd watchdog target): the
-                # listener thread answering at all is the health
-                # signal — scheduling liveness shows in the metrics
-                # (e2e latency, schedule attempts by result).
-                body = b"ok"
+                # listener thread answering at all is the LIVE signal;
+                # the body carries the guardrail watchdog's ladder
+                # state (ok | degraded | overloaded) so runbooks and
+                # probes see degradation without scraping /metrics.
+                # Always 200: a degraded daemon is still the leader
+                # and must not be LB-evicted into a failover storm.
+                body = health_state().encode()
                 self.send_response(200)
                 self.send_header("Content-Type", "text/plain")
                 self.send_header("Content-Length", str(len(body)))

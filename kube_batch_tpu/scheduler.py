@@ -33,6 +33,7 @@ from kube_batch_tpu.framework.session import (
     close_session,
     open_session,
 )
+from kube_batch_tpu.guardrails import Guardrails
 from kube_batch_tpu.plugins import factory as _plugin_factory  # noqa: F401
 
 DEFAULT_SCHEDULE_PERIOD = 1.0  # ≙ scheduler.go · defaultSchedulePeriod (1s)
@@ -49,10 +50,19 @@ class Scheduler:
         conf_path: str | None = None,
         schedule_period: float = DEFAULT_SCHEDULE_PERIOD,
         profile_dir: str | None = None,
+        guardrails: Guardrails | None = None,
     ) -> None:
         self.cache = cache
         self.conf_path = conf_path
         self.schedule_period = schedule_period
+        # Self-protection layer (kube_batch_tpu/guardrails/): the loop
+        # consults it every cycle — half-open breaker probing before,
+        # watchdog latency observation after, HBM-ceiling admission
+        # inside the growth prewarm.  The default instance reads its
+        # ceiling from KB_TPU_HBM_CEILING_MB; the CLI passes a
+        # flag-configured one shared with the wire-backend wrapper.
+        self.guardrails = guardrails if guardrails is not None \
+            else Guardrails()
         # Event-driven tensor pack: the daemon patches the previous
         # cycle's arrays instead of rebuilding them (cache/incremental.py)
         # — the host-side work of a steady-state cycle is O(changes),
@@ -113,6 +123,16 @@ class Scheduler:
         # Shape keys whose warm compile errored: deterministic, so
         # never retried under this policy (cleared on conf swap).
         self._growth_failed: set[tuple] = set()
+        # Shape keys the HBM-ceiling admission REFUSED → (label,
+        # projected bytes).  Not retried (the projection is a pure
+        # function of the program), but re-warned about every cycle
+        # the boundary stays imminent — mirroring the compile-cliff
+        # conf-adoption refusal above.  Cleared on conf swap.
+        self._growth_refused: dict[tuple, tuple[str, float]] = {}
+        # True while the CURRENT run_once is a quiesced skip
+        # (mid-relist / breaker open): such cycles bypass the overrun
+        # watchdog — their near-zero latency is not evidence of health.
+        self._cycle_quiesced = False
         # Armed by run() (the daemon loop) — a bare run_once() caller
         # (tests, one-shot tools) must not spawn background compiles
         # that outlive it: a compile thread alive at interpreter
@@ -190,6 +210,7 @@ class Scheduler:
         with self._growth_lock:
             self._growth_queue.clear()
         self._growth_failed.clear()
+        self._growth_refused.clear()
         # Seed the prewarmed executable (if the warm produced one):
         # without this the first real cycle re-lowers and recompiles,
         # and only CLI/bench runs (persistent cache on) get it cheap.
@@ -318,6 +339,23 @@ class Scheduler:
             self._start_prewarm(built)
 
     # -- one cycle (≙ scheduler.go · runOnce) ---------------------------
+    def _pin_blocks(self, key: tuple) -> tuple[str, float] | None:
+        """The (label, projected-bytes) HBM refusal pin for `key` IF
+        it still holds against the LIVE ceiling — the single source of
+        truth for pin validity (compile entry, join-in-flight, and the
+        prewarm re-warn loop all route here).  A pin the ceiling has
+        moved past (raised, disabled, or a harness's temporary ceiling
+        restored) is dropped and None returned, so the once-refused
+        program becomes warmable/compilable again."""
+        refused = self._growth_refused.get(key)
+        if refused is None:
+            return None
+        if self.guardrails.hbm.enabled and \
+                refused[1] > self.guardrails.hbm.ceiling_bytes:
+            return refused
+        self._growth_refused.pop(key, None)
+        return None
+
     def _ensure_compiled(self, snap, state):
         """AOT-compile the fused cycle for `snap`'s shapes before its
         first execution: the compile becomes an explicit, logged,
@@ -335,6 +373,13 @@ class Scheduler:
         full-pipeline conf, which is also what BASELINE config 5
         exercises."""
         key = self._shape_key(self._cycle, snap)
+        if self._pin_blocks(key) is not None:
+            # The snapshot crossed into a bucket whose program the
+            # HBM-ceiling admission refused: executing it anyway would
+            # OOM the device mid-daemon — the exact failure the
+            # refusal promised to prevent.  Return None; the caller
+            # pauses this cycle's solve (see _hbm_blocked_cycle).
+            return None
         exe = self._compiled_shapes.get(key)
         if exe is None:
             # A growth warm may already be compiling exactly this
@@ -373,6 +418,12 @@ class Scheduler:
                 exe = self._compiled_shapes.get(key)
                 if exe is not None:
                     return exe
+                if self._pin_blocks(key) is not None:
+                    # The warm we joined finished by being REFUSED:
+                    # recompiling the identical over-ceiling program
+                    # inline would block the cycle for the same
+                    # multi-minute compile only to be refused again.
+                    return None
                 with self._growth_lock:
                     mine = threading.Event()
                     self._growth_inflight[key] = mine
@@ -385,6 +436,26 @@ class Scheduler:
                         "fused cycle compiled for new shapes in %.1fs",
                         took,
                     )
+                if self.guardrails.hbm.enabled:
+                    # The boundary arrived before any prewarm could
+                    # measure this program: measure it now, and apply
+                    # the SAME admission the prewarm would have — an
+                    # over-ceiling program is refused, never executed
+                    # (the caller pauses the solve; placed work keeps
+                    # running).  The refusal is pinned so later cycles
+                    # skip straight to the pause without recompiling.
+                    label = (
+                        f"in-cycle T={int(snap.num_tasks)}"
+                        f"×N={int(snap.num_nodes)}"
+                    )
+                    admitted, projected = self.guardrails.hbm.admit(
+                        exe, label=label
+                    )
+                    if not admitted:
+                        self._growth_refused[key] = (
+                            label, float(projected or 0.0)
+                        )
+                        return None
                 self._compiled_shapes[key] = exe
             finally:
                 self._growth_inflight.pop(key, None)
@@ -421,6 +492,12 @@ class Scheduler:
         leads only when the two nearest dims are predicted to cross
         within one cycle of each other."""
         if not self._growth_armed or self._cycle is None:
+            return
+        if self.guardrails.pause_prewarm():
+            # Degradation ladder rung >= 1: an overrunning daemon must
+            # not feed the compile service while it is behind.  The
+            # queue refresh stops (stale entries are superseded on
+            # recovery anyway); a compile already in flight finishes.
             return
         snap, meta = ssn.snap, ssn.meta
 
@@ -507,6 +584,35 @@ class Scheduler:
         for g in variants:
             gsnap = grown_avals(snap, g)
             staged.append((self._shape_key(cycle, gsnap), gsnap, cycle, g))
+        # A previously-REFUSED next-bucket program whose boundary is
+        # still imminent re-warns EVERY cycle (loud + repeated, like
+        # the compile-cliff conf refusal): the operator must not be
+        # able to miss that the cluster is rowing toward a program
+        # that does not fit the chip.
+        for key, _gsnap, _cycle, g in staged:
+            refused = self._pin_blocks(key)
+            if refused is not None:
+                label, projected = refused
+                logging.error(
+                    "growth prewarm: next bucket %s remains REFUSED by "
+                    "HBM-ceiling admission (projected %.1f MB > ceiling "
+                    "%.1f MB) and the boundary is still imminent — the "
+                    "current program keeps serving; if the cluster "
+                    "actually crosses the boundary the solve will "
+                    "PAUSE (placed work keeps running, pending rows "
+                    "wait).  Operator options: shard the solve, shrink "
+                    "padding buckets, or cap admission "
+                    "(doc/design/guardrails.md)",
+                    label, projected / 1e6,
+                    (self.guardrails.hbm.ceiling_bytes or 0) / 1e6,
+                )
+                self.cache.record_event(
+                    "Scheduler", "growth-prewarm", "HbmAdmissionRefused",
+                    f"next-bucket program {label} projected "
+                    f"{projected / 1e6:.1f} MB over the "
+                    f"{(self.guardrails.hbm.ceiling_bytes or 0) / 1e6:.0f}"
+                    " MB ceiling; previous program keeps serving",
+                )
         with self._growth_lock:
             # Membership checks under the SAME lock as the queue swap:
             # checked outside it, a key the worker pops (and registers
@@ -516,6 +622,7 @@ class Scheduler:
                 e for e in staged
                 if e[0] not in self._compiled_shapes
                 and e[0] not in self._growth_failed
+                and e[0] not in self._growth_refused
                 and e[0] not in self._growth_inflight
             ]
             # Wholesale replace: pending entries predicted from older
@@ -581,11 +688,13 @@ class Scheduler:
                 # The conf may have hot-swapped mid-warm; only publish
                 # into the policy this warm started under.
                 if self._cycle is cycle:
-                    self._compiled_shapes[key] = exe
-                    logging.info(
-                        "growth prewarm: next bucket %s compiled "
-                        "in %.1fs", label, time.monotonic() - started,
-                    )
+                    if self._admit_growth(key, exe, label):
+                        self._compiled_shapes[key] = exe
+                        logging.info(
+                            "growth prewarm: next bucket %s compiled "
+                            "in %.1fs", label,
+                            time.monotonic() - started,
+                        )
                 else:
                     logging.info(
                         "growth prewarm: %s compiled but conf swapped "
@@ -600,14 +709,120 @@ class Scheduler:
                 self._growth_inflight.pop(key, None)
                 done.set()
 
+    def _admit_growth(self, key: tuple, exe, label) -> bool:
+        """HBM-ceiling admission for one candidate next-bucket
+        executable: measure its XLA ``memory_analysis`` projection and
+        refuse adoption when it exceeds the configured ceiling.  The
+        refusal is recorded (key -> projection) so the per-cycle
+        refresh re-warns while the boundary stays imminent instead of
+        recompiling the same too-big program every cycle."""
+        admitted, projected = self.guardrails.hbm.admit(
+            exe, label=str(label)
+        )
+        if admitted:
+            self._growth_refused.pop(key, None)
+            return True
+        self._growth_refused[key] = (str(label), float(projected or 0.0))
+        self.cache.record_event(
+            "Scheduler", "growth-prewarm", "HbmAdmissionRefused",
+            f"next-bucket program {label} projected "
+            f"{(projected or 0) / 1e6:.1f} MB over the "
+            f"{(self.guardrails.hbm.ceiling_bytes or 0) / 1e6:.1f} MB "
+            "ceiling; previous program keeps serving",
+        )
+        return False
+
+    def warm_grown(self, grow: dict[str, int] | None = None) -> bool | None:
+        """Synchronously compile + admit ONE next-bucket program for
+        the last snapshot's shapes — the harness/chaos entry into the
+        same compile-then-admit path `_drain_growth_queue` runs on its
+        worker thread.  Returns the admission verdict (True adopted,
+        False refused), or None when no cycle has run yet.  Default
+        growth: one row past the task bucket."""
+        snap, cycle = self._last_snap, self._cycle
+        if snap is None or cycle is None:
+            return None
+        import jax
+
+        from kube_batch_tpu.cache.packer import grown_avals
+        from kube_batch_tpu.ops.assignment import init_state
+
+        grow = grow or {"T": int(snap.num_tasks) + 1}
+        gsnap = grown_avals(snap, grow)
+        key = self._shape_key(cycle, gsnap)
+        exe = cycle.lower(gsnap, jax.eval_shape(init_state, gsnap)).compile()
+        if self._admit_growth(key, exe, label=grow):
+            self._compiled_shapes[key] = exe
+            return True
+        return False
+
+    def _hbm_blocked_cycle(self, ssn: Session) -> None:
+        """The snapshot's shapes require a program the HBM-ceiling
+        admission refused: PAUSE the solve instead of executing a
+        program the operator's ceiling says cannot fit.  Placed work
+        keeps running (no binds or evictions land this cycle, nothing
+        already on a node is touched); pending rows wait until
+        completions shrink the world back under the serving bucket —
+        at which point the admitted program resumes on its own — or
+        the operator intervenes.  Loud + repeated every blocked cycle,
+        like every guardrail refusal."""
+        key = self._shape_key(self._cycle, ssn.snap)
+        label, projected = self._growth_refused.get(
+            key, ("program", 0.0)
+        )
+        ceiling_mb = (self.guardrails.hbm.ceiling_bytes or 0) / 1e6
+        logging.error(
+            "cycle solve PAUSED by HBM-ceiling admission: %s projects "
+            "%.1f MB over the %.1f MB ceiling and no admitted program "
+            "can represent this snapshot — placed work keeps running; "
+            "pending rows wait.  Scheduling resumes when the cluster "
+            "shrinks under the serving bucket; operator options: "
+            "shard the solve, shrink padding buckets, or raise the "
+            "ceiling (doc/design/guardrails.md)",
+            label, projected / 1e6, ceiling_mb,
+        )
+        self.cache.record_event(
+            "Scheduler", "hbm-ceiling", "HbmCeilingBlocked",
+            f"solve paused: {label} projects {projected / 1e6:.1f} MB "
+            f"over the {ceiling_mb:.1f} MB ceiling; pending rows wait",
+        )
+        metrics.hbm_blocked_cycles.inc()
+        self.guardrails.note_hbm_block(True)
+        # The incremental packer never SHRINKS padded buckets on its
+        # own — without this, one crossing would pin the refused shape
+        # (and the pause) forever, even after completions brought the
+        # real counts back under the serving bucket.  When a fresh
+        # full pack would produce smaller buckets, force it: the next
+        # cycle then serves with the admitted smaller program.
+        from kube_batch_tpu.api.snapshot import bucket
+
+        natural = {
+            "T": bucket(ssn.meta.num_real_tasks),
+            "J": bucket(len(ssn.meta.job_names)),
+            "N": bucket(ssn.meta.num_real_nodes),
+        }
+        padded = {
+            "T": int(ssn.snap.num_tasks),
+            "J": int(ssn.snap.num_jobs),
+            "N": int(ssn.snap.num_nodes),
+        }
+        if any(natural[d] < padded[d] for d in natural):
+            self.packer._dirty.mark_full("hbm-shrink")
+
     def _execute_fused(self, ssn: Session) -> None:
         """One device dispatch for the whole action pipeline, then commit
-        evictions per action on the host (see actions/fused.py)."""
+        evictions per action on the host (see actions/fused.py).  A
+        None from _ensure_compiled means the shapes need a ceiling-
+        refused program: the solve pauses for this cycle instead."""
         import jax
 
         from kube_batch_tpu.actions.preempt import commit_victim_indices
 
         exe = self._ensure_compiled(ssn.snap, ssn.state)
+        if exe is None:
+            self._hbm_blocked_cycle(ssn)
+            return
+        self.guardrails.note_hbm_block(False)
         with metrics.action_latency.time("fused"):
             with metrics.cycle_phase_latency.time("dispatch"):
                 state, evict_payload, job_ready, diag = exe(
@@ -716,7 +931,33 @@ class Scheduler:
 
     def run_once(self) -> Session | None:
         """One cycle; returns the Session, or None for a skipped idle
-        cycle (nothing to schedule — no dispatch, no session)."""
+        cycle (nothing to schedule — no dispatch, no session).
+
+        Guardrail hooks bracket the cycle: `pre_cycle` runs the wire
+        breaker's half-open probe (an open breaker quiesces the cycle
+        via CacheResyncing — zero bind attempts until the backend
+        heals); `observe_cycle` feeds the wall latency to the overrun
+        watchdog, whose rung sheds optional work (prewarm, diagnosis,
+        period) on the next cycles."""
+        self.guardrails.pre_cycle()
+        started = time.monotonic()
+        self._cycle_quiesced = False
+        try:
+            return self._cycle_once()
+        finally:
+            if not self._cycle_quiesced:
+                # Quiesced skips (mid-relist, breaker open) return in
+                # microseconds and are NOT evidence of health: feeding
+                # them to the watchdog would walk the ladder back to
+                # "ok" in the middle of a dead-backend outage.  Idle
+                # skips still count — a genuinely idle daemon IS
+                # healthy.
+                self.guardrails.observe_cycle(
+                    time.monotonic() - started, cache=self.cache,
+                    period=self.schedule_period,
+                )
+
+    def _cycle_once(self) -> Session | None:
         with metrics.e2e_latency.time():
             self._reload_conf()
             # Consume the failed-bind queue (≙ processResyncTask): the
@@ -730,6 +971,10 @@ class Scheduler:
                 metrics.idle_cycles_skipped.inc()
                 metrics.schedule_attempts.inc("idle")
                 metrics.pending_tasks.set(0.0)  # skip implies none pending
+                # An idle world has no solve to pause: if the ceiling
+                # was blocking, the blocked rows are gone — lift the
+                # /healthz floor.
+                self.guardrails.note_hbm_block(False)
                 return None
             try:
                 ssn = open_session(
@@ -745,12 +990,21 @@ class Scheduler:
                 # full re-pack on the next real cycle.
                 logging.info("cache mid-relist; skipping cycle")
                 metrics.schedule_attempts.inc("resync")
+                self._cycle_quiesced = True
                 return None
             if self._cycle is not None:
                 self._execute_fused(ssn)
             else:
                 self._execute_actions(ssn)
-            close_session(ssn)
+            # Ladder rung >= 2: the per-pod why-unschedulable fan-out
+            # (events + conditions, O(pending) host work) is the first
+            # optional work shed when overloaded.
+            close_session(
+                ssn, diagnose=not (
+                    self.guardrails.skip_diagnosis()
+                    or self.guardrails.hbm_blocked
+                )
+            )
             self._last_snap = ssn.snap  # shapes for the next conf prewarm
             self._idle_armed = True
             # The pack drained the journal; idle-refresh marks restart.
@@ -850,7 +1104,14 @@ class Scheduler:
             if on_cycle is not None:
                 on_cycle()
             cycles += 1
-            sleep_for = self.schedule_period - (time.monotonic() - started)
+            # Ladder rung >= 2 stretches the effective period:
+            # scheduling less often batches more work per cycle — the
+            # direct analog of the reference's serial shedding.
+            period = (
+                self.schedule_period
+                * self.guardrails.period_multiplier()
+            )
+            sleep_for = period - (time.monotonic() - started)
             if sleep_for > 0 and (max_cycles is None or cycles < max_cycles):
                 if stop is not None:
                     stop.wait(sleep_for)

@@ -31,6 +31,7 @@ from kube_batch_tpu.chaos import (
     trace_hash,
     write_trace,
 )
+from kube_batch_tpu.chaos.engine import _META_FAULT_FIELDS
 
 # Small, fast worlds: every engine run below compiles a handful of tiny
 # fused-cycle shapes on CPU and then replays them.
@@ -103,12 +104,14 @@ def test_same_seed_identical_trace_and_assignment(tmp_path):
 
     # And a RECORDED trace replays to the same behavior byte-for-byte.
     # The fault schedule rides inline; the trace's meta header carries
-    # the recording's seed + bind_fail_pct (curses are seed+uid-hash
-    # decisions), so NO explicit FaultSpec is needed on replay.
+    # the recording's seed plus every behavior-bearing fault field
+    # (curse pct, guardrail windows — all resolved at RUN time, not
+    # derivable from the events), so NO explicit FaultSpec is needed
+    # on replay.
     recorded = read_trace(str(trace))
     assert recorded[0] == {
         "tick": -1, "op": "meta", "seed": 3,
-        "bind_fail_pct": FAULTS.bind_fail_pct,
+        **{k: getattr(FAULTS, k) for k in _META_FAULT_FIELDS},
     }
     replay = ChaosEngine(
         seed=3, ticks=16, events=recorded, drain=40,

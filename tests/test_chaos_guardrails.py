@@ -1,0 +1,144 @@
+"""Chaos × guardrails: the self-protection ladder under injected
+overload, exercised through the REAL wire stack.
+
+One seeded scenario drives all three guardrail fault types:
+
+* ``slow_backend`` — write responses delayed past the watchdog period:
+  the degradation ladder must engage (and /healthz must leave "ok");
+* ``bind_blackhole`` — the write path goes dark: the wire breaker must
+  trip open, scheduling must quiesce (ZERO bind requests reach the
+  wire during fully-open ticks), and the half-open ping probe must
+  close it after heal;
+* ``hbm_pressure`` — a next-bucket compile under a 1-byte ceiling:
+  HBM admission must refuse adoption while the serving program
+  survives.
+
+The engine itself asserts the ladder/breaker/recovery invariants
+(engine._check_guardrails) and folds violations into the normal
+flight-recorder + exit-code path, so `result.ok` carries them all;
+the tests below additionally pin the observable summary counters and
+same-seed reproducibility.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from kube_batch_tpu.chaos import ChaosEngine, FaultSpec, ScenarioSpec
+
+# Busy little world: constant arrivals + short lifetimes keep most
+# ticks binding, so the slow window reliably produces CONSECUTIVE
+# overrunning cycles (the watchdog's engagement condition).
+SCENARIO = ScenarioSpec(
+    nodes=4,
+    arrival_rate=1.2,
+    burst_every=8,
+    burst_size=2,
+    gang_max=3,
+    lifetime_mean=8.0,
+    node_churn_every=0,
+)
+# Windows in tick time: slow 5..13, dark 18..24, hbm probe at 27.
+FAULTS = FaultSpec(
+    stream_drop_every=0, gap_every=0, bind_fail_pct=0,
+    node_vanish_every=0, lease_steal_every=0,
+    slow_at=5, slow_ticks=8, slow_response_s=0.4,
+    blackhole_at=18, blackhole_ticks=6,
+    hbm_pressure_at=27,
+)
+
+
+def _run(seed: int = 11):
+    return ChaosEngine(
+        seed=seed, ticks=32, scenario=SCENARIO, faults=FAULTS, drain=40,
+    ).run()
+
+
+def test_guardrail_scenario_ladder_breaker_and_ceiling():
+    from kube_batch_tpu import metrics
+
+    result = _run()
+    # ok covers the engine's own guardrail invariants too:
+    # ladder-never-engaged / breaker-never-tripped / bind-while-open /
+    # hbm-admission-not-exercised / guardrail-not-recovered all fold
+    # into violations.
+    assert result.ok, [v.as_dict() for v in result.violations]
+    rails = result.guardrail
+    assert rails is not None
+    # Watchdog: the slow window engaged the ladder and it recovered.
+    assert rails["max_rung_seen"] >= 1
+    assert rails["final_state"] == "ok"
+    assert metrics.health_state() == "ok"
+    # Breaker: tripped during the blackhole, closed after heal, and
+    # while fully open NOTHING reached the wire.
+    assert rails["breaker_opened"] >= 1
+    assert rails["breaker_closed"] >= 1
+    assert rails["binds_while_open"] == 0
+    assert rails["blackholed_requests"] > 0
+    assert rails["final_breaker"] == "closed"
+    # HBM admission refused the 1-byte-ceiling probe.
+    assert rails["hbm_refusals"] >= 1
+    assert result.faults.get("hbm-pressure") == 1
+    # The workload still converged after all of it.
+    assert result.converged_tick is not None
+
+
+@pytest.mark.slow  # double engine run; kept out of the tier-1 budget
+def test_guardrail_scenario_same_seed_same_hash():
+    a, b = _run(), _run()
+    assert a.ok and b.ok
+    assert a.trace_hash == b.trace_hash
+    assert a.final_assignment == b.final_assignment
+
+
+def test_replayed_trace_meta_restores_guardrail_fault_spec():
+    """The meta header must restore every behavior-bearing fault field
+    on replay: without them the inline blackhole/slow events would run
+    against an UNGUARDED scheduler (no breaker, no watchdog, the
+    production 10 s wire timeout) and the replay would diverge from
+    the recording it claims to reproduce."""
+    from kube_batch_tpu.chaos.engine import (
+        BLACKHOLE_WIRE_TIMEOUT,
+        _META_FAULT_FIELDS,
+    )
+
+    meta = {"tick": -1, "op": "meta", "seed": 11, "bind_fail_pct": 0,
+            "slow_at": 5, "slow_ticks": 8, "slow_response_s": 0.4,
+            "blackhole_at": 18, "blackhole_ticks": 6,
+            "hbm_pressure_at": 27}
+    eng = ChaosEngine(seed=11, ticks=32, events=[meta])
+    for field in _META_FAULT_FIELDS:
+        assert getattr(eng.faults, field) == meta[field]
+    assert eng.guardrails is not None
+    assert eng.wire_timeout == BLACKHOLE_WIRE_TIMEOUT
+
+    # A pre-guardrail trace (meta carries only seed + curse pct)
+    # still replays unguarded with the production timeout.
+    old = ChaosEngine(seed=3, ticks=8, events=[
+        {"tick": -1, "op": "meta", "seed": 3, "bind_fail_pct": 10},
+    ])
+    assert old.faults.bind_fail_pct == 10
+    assert old.guardrails is None
+    assert old.wire_timeout == 10.0
+
+
+@pytest.mark.slow  # record + replay = two full engine runs
+def test_guardrail_trace_record_then_replay_identical(tmp_path):
+    """The replay contract ON a guardrail scenario: a recorded trace
+    replays to the identical hash and final assignment, breaker trip
+    and all."""
+    from kube_batch_tpu.chaos.workload import read_trace
+
+    trace = tmp_path / "guardrail.jsonl"
+    a = ChaosEngine(
+        seed=11, ticks=32, scenario=SCENARIO, faults=FAULTS, drain=40,
+        trace_path=str(trace),
+    ).run()
+    assert a.ok, [v.as_dict() for v in a.violations]
+    b = ChaosEngine(
+        seed=11, ticks=32, events=read_trace(str(trace)), drain=40,
+    ).run()
+    assert b.ok, [v.as_dict() for v in b.violations]
+    assert b.guardrail is not None and b.guardrail["breaker_opened"] >= 1
+    assert a.trace_hash == b.trace_hash
+    assert a.final_assignment == b.final_assignment

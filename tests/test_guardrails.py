@@ -1,0 +1,980 @@
+"""Guardrail subsystem: backoff, breaker, watchdog, HBM admission.
+
+The self-protection layer (kube_batch_tpu/guardrails/) has three
+coordinated mechanisms; these tests pin each one's edge cases plus the
+scheduler integration:
+
+* bounded-exponential backoff with deterministic jitter (bounds, cap,
+  reproducibility);
+* circuit breaker: trip threshold, half-open single-probe race, probe
+  failure re-opens, success closes and un-quiesces — and while open,
+  NOTHING touches the wire (no stale binds replay after heal);
+* cycle watchdog hysteresis: consecutive-streak engagement/recovery,
+  no flapping under oscillating load;
+* HBM-ceiling admission: growth prewarm refuses a program whose XLA
+  memory_analysis exceeds the ceiling, loudly and repeatably, while
+  the previous program keeps serving.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+from kube_batch_tpu.guardrails import (
+    Backoff,
+    BreakerOpen,
+    CircuitBreaker,
+    CycleWatchdog,
+    GuardedBackend,
+    GuardrailConfig,
+    Guardrails,
+    HbmCeiling,
+    RUNGS,
+)
+
+
+# -- backoff -----------------------------------------------------------
+
+def test_backoff_delay_bounds_and_cap():
+    b = Backoff(base=0.05, cap=2.0, attempts=3)
+    for attempt in range(8):
+        raw = min(2.0, 0.05 * (2.0 ** attempt))
+        d = b.delay(attempt, key="pod-1")
+        assert 0.5 * raw <= d <= raw
+    # Far past the cap the raw delay is pinned to it.
+    assert b.delay(30, key="x") <= 2.0
+
+
+def test_backoff_jitter_is_deterministic_and_keyed():
+    b = Backoff(base=0.05, cap=2.0)
+    assert b.delay(2, key="uid-a") == b.delay(2, key="uid-a")
+    # Different keys land elsewhere in the window (decorrelation) —
+    # sha256 of distinct inputs colliding on the jitter byte for ALL
+    # of these keys would be astronomically unlucky.
+    delays = {b.delay(2, key=f"uid-{i}") for i in range(64)}
+    assert len(delays) > 8
+
+
+# -- circuit breaker ---------------------------------------------------
+
+class Clock:
+    def __init__(self) -> None:
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+
+def test_breaker_trips_after_consecutive_failures_only():
+    clock = Clock()
+    br = CircuitBreaker(trip_after=3, reset_after=10.0, clock=clock)
+    br.record_failure()
+    br.record_failure()
+    br.record_success()  # streak broken
+    br.record_failure()
+    br.record_failure()
+    assert br.state == CircuitBreaker.CLOSED
+    br.record_failure()
+    assert br.state == CircuitBreaker.OPEN
+    assert br.opened_count == 1
+
+
+def test_breaker_open_window_then_single_half_open_probe():
+    clock = Clock()
+    br = CircuitBreaker(trip_after=1, reset_after=10.0, clock=clock)
+    br.record_failure()
+    assert br.state == CircuitBreaker.OPEN
+    assert not br.allow()          # inside the open window
+    clock.t = 9.9
+    assert not br.allow()
+    clock.t = 10.1
+    assert br.allow()              # exactly one probe admitted
+    assert br.state == CircuitBreaker.HALF_OPEN
+    assert not br.allow()          # concurrent racers lose
+    assert not br.allow()
+    br.record_success()
+    assert br.state == CircuitBreaker.CLOSED
+    assert br.closed_count == 1
+    assert br.allow()
+
+
+def test_breaker_probe_failure_reopens_full_window():
+    clock = Clock()
+    br = CircuitBreaker(trip_after=1, reset_after=10.0, clock=clock)
+    br.record_failure()
+    clock.t = 10.5
+    assert br.allow()
+    br.record_failure()            # probe failed
+    assert br.state == CircuitBreaker.OPEN
+    clock.t = 15.0                 # window restarts at the probe failure
+    assert not br.allow()
+    clock.t = 20.6
+    assert br.allow()
+
+
+def test_breaker_half_open_probe_race_is_single_winner_threaded():
+    clock = Clock()
+    br = CircuitBreaker(trip_after=1, reset_after=1.0, clock=clock)
+    br.record_failure()
+    clock.t = 2.0
+    wins = []
+    barrier = threading.Barrier(8)
+
+    def racer() -> None:
+        barrier.wait()
+        if br.allow():
+            wins.append(1)
+
+    threads = [threading.Thread(target=racer) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(wins) == 1
+
+
+# -- guarded backend ---------------------------------------------------
+
+class StubBackend:
+    """Scriptable write backend: fail the next N calls with `err`."""
+
+    def __init__(self) -> None:
+        self.fail_next = 0
+        self.err: type[Exception] = TimeoutError
+        self.calls: list[tuple] = []
+
+    def _maybe_fail(self, entry: tuple) -> None:
+        self.calls.append(entry)
+        if self.fail_next > 0:
+            self.fail_next -= 1
+            raise self.err("stub wire failure")
+
+    def bind(self, pod, node_name: str) -> None:
+        self._maybe_fail(("bind", getattr(pod, "uid", pod), node_name))
+
+    def evict(self, pod, reason: str) -> None:
+        self._maybe_fail(("evict", getattr(pod, "uid", pod), reason))
+
+    def update_pod_group(self, group) -> None:
+        self._maybe_fail(("updatePodGroup", getattr(group, "name", group)))
+
+    def ping(self) -> None:
+        self._maybe_fail(("ping",))
+
+
+class FakePod:
+    def __init__(self, uid: str) -> None:
+        self.uid = uid
+
+
+def test_guarded_backend_retries_transient_then_succeeds():
+    inner = StubBackend()
+    inner.fail_next = 2
+    sleeps: list[float] = []
+    gb = GuardedBackend(inner, backoff=Backoff(attempts=3),
+                        sleep=sleeps.append)
+    gb.bind(FakePod("u1"), "n1")
+    assert len(inner.calls) == 3           # 2 failures + 1 success
+    assert len(sleeps) == 2                # backed off between attempts
+    assert sleeps[0] < sleeps[1] or sleeps[1] == pytest.approx(
+        sleeps[1])  # exponential (jitter may reorder only within bound)
+
+
+def test_guarded_backend_exhausts_attempts_and_raises_last():
+    inner = StubBackend()
+    inner.fail_next = 99
+    gb = GuardedBackend(inner, backoff=Backoff(attempts=3),
+                        sleep=lambda s: None)
+    with pytest.raises(TimeoutError):
+        gb.bind(FakePod("u1"), "n1")
+    assert len(inner.calls) == 3
+
+
+def test_guarded_backend_app_rejection_no_retry_counts_as_alive():
+    """RuntimeError is the wire ANSWERING with a rejection: never
+    retried (retrying cannot help) but recorded as breaker SUCCESS —
+    the wire is demonstrably alive, so the consecutive-transport-
+    failure streak resets."""
+    inner = StubBackend()
+    inner.fail_next = 1
+    inner.err = RuntimeError
+    br = CircuitBreaker(trip_after=2)
+    br.record_failure()                    # streak of 1
+    gb = GuardedBackend(inner, breaker=br, backoff=Backoff(attempts=3),
+                        sleep=lambda s: None)
+    with pytest.raises(RuntimeError):
+        gb.bind(FakePod("u1"), "n1")
+    assert len(inner.calls) == 1           # no retry
+    assert br.state == CircuitBreaker.CLOSED
+    br.record_failure()                    # streak was reset by the answer
+    assert br.state == CircuitBreaker.CLOSED
+
+
+def test_half_open_probe_slot_not_leaked_by_app_rejection():
+    """The probe-winning call answering with an app-level rejection
+    must release (and close) the breaker — a leaked probe slot would
+    wedge it HALF_OPEN forever, quiescing scheduling until restart."""
+    clock = Clock()
+    inner = StubBackend()
+    br = CircuitBreaker(trip_after=1, reset_after=10.0, clock=clock)
+    br.record_failure()
+    assert br.state == CircuitBreaker.OPEN
+    clock.t = 11.0
+    inner.fail_next = 1
+    inner.err = RuntimeError               # e.g. "already bound"
+    gb = GuardedBackend(inner, breaker=br, backoff=Backoff(attempts=2),
+                        sleep=lambda s: None)
+    with pytest.raises(RuntimeError):
+        gb.bind(FakePod("u1"), "n1")       # wins the half-open slot
+    assert br.state == CircuitBreaker.CLOSED
+    assert br.allow()                      # nothing leaked
+
+
+def test_http_5xx_and_429_are_transient_4xx_is_app_level():
+    """In --kube-api mode every write failure surfaces as HttpError (a
+    RuntimeError carrying `.status`).  Backpressure/server errors —
+    429, any 5xx — must count as WIRE failures (retried, trip the
+    breaker: an apiserver answering 503 on every bind is the
+    dead-backend hot loop the breaker exists to quiesce); other 4xx
+    are the request being wrong — app-level, never retried, breaker
+    success."""
+    from kube_batch_tpu.client.http_api import HttpError
+    from kube_batch_tpu.guardrails.breaker import is_transient
+
+    assert is_transient(HttpError(503, "overloaded"))
+    assert is_transient(HttpError(429, "slow down"))
+    assert is_transient(HttpError(500, "boom"))
+    assert not is_transient(HttpError(404, "no such node"))
+    assert not is_transient(HttpError(409, "conflict"))
+
+    # 503 storm: retried under backoff, trips the breaker.
+    inner = StubBackend()
+    inner.fail_next = 99
+    inner.err = lambda msg: HttpError(503, msg)
+    br = CircuitBreaker(trip_after=3)
+    gb = GuardedBackend(inner, breaker=br, backoff=Backoff(attempts=4),
+                        sleep=lambda s: None)
+    with pytest.raises(HttpError):
+        gb.bind(FakePod("u1"), "n1")
+    assert br.state == CircuitBreaker.OPEN   # 3 consecutive 503s tripped
+    assert len(inner.calls) == 3             # stopped retrying once open
+
+    # 404: one attempt, passthrough, streak reset (breaker success).
+    inner2 = StubBackend()
+    inner2.fail_next = 1
+    inner2.err = lambda msg: HttpError(404, msg)
+    br2 = CircuitBreaker(trip_after=2)
+    br2.record_failure()
+    gb2 = GuardedBackend(inner2, breaker=br2, backoff=Backoff(attempts=3),
+                         sleep=lambda s: None)
+    with pytest.raises(HttpError):
+        gb2.bind(FakePod("u1"), "n1")
+    assert len(inner2.calls) == 1            # never retried
+    br2.record_failure()
+    assert br2.state == CircuitBreaker.CLOSED  # streak was reset
+
+
+def test_cache_funnels_swallow_http_5xx_but_not_4xx():
+    """The status/event write funnels must survive an apiserver 5xx
+    (retried next cycle) exactly like a dead wire, while genuine
+    request bugs (4xx) stay loud."""
+    from kube_batch_tpu.api.resource import ResourceSpec
+    from kube_batch_tpu.cache.cache import SchedulerCache
+    from kube_batch_tpu.cache.cluster import PodGroup
+    from kube_batch_tpu.client.http_api import HttpError
+
+    class Failing:
+        def __init__(self, status):
+            self.status = status
+
+        def update_pod_group(self, group):
+            raise HttpError(self.status, "nope")
+
+        def record_event(self, *a, **kw):
+            raise HttpError(self.status, "nope")
+
+    cache = SchedulerCache(spec=ResourceSpec(), binder=None,
+                           evictor=None, status_updater=Failing(503))
+    cache.event_sink = Failing(503)
+    cache.update_job_status(PodGroup(name="g", queue="q"))  # swallowed
+    cache.record_event("Scheduler", "x", "Reason", "msg")   # swallowed
+
+    cache.status_updater = Failing(404)
+    cache.event_sink = Failing(404)
+    with pytest.raises(HttpError):
+        cache.update_job_status(PodGroup(name="g", queue="q"))
+    with pytest.raises(HttpError):
+        cache.record_event("Scheduler", "x", "Reason2", "msg")
+
+
+def test_swallowed_status_write_is_resent_next_refresh():
+    """A transient status-write failure is swallowed — but the
+    in-memory status already mutated, so without explicit retry
+    tracking the next refresh computes changed=False and the
+    apiserver's PodGroup stays stale forever."""
+    from kube_batch_tpu.api.resource import ResourceSpec
+    from kube_batch_tpu.cache.cache import SchedulerCache
+    from kube_batch_tpu.cache.cluster import PodGroup, Queue
+    from kube_batch_tpu.client.http_api import HttpError
+
+    class Recorder:
+        def __init__(self):
+            self.writes = []
+
+        def update_pod_group(self, group):
+            self.writes.append(group.name)
+
+    class Failing:
+        def update_pod_group(self, group):
+            raise HttpError(503, "overloaded")
+
+    cache = SchedulerCache(spec=ResourceSpec(), binder=None,
+                           evictor=None, status_updater=None)
+    cache.add_queue(Queue(name="q", weight=1))
+    cache.add_pod_group(PodGroup(name="g", queue="q"))
+    rec = Recorder()
+    cache.status_updater = rec
+    cache.refresh_job_statuses()
+    cache.refresh_job_statuses()
+    steady = len(rec.writes)
+    cache.refresh_job_statuses()
+    assert len(rec.writes) == steady       # steady state: no re-sends
+
+    cache.status_updater = Failing()
+    cache.update_job_status(cache._jobs["g"].pod_group)  # swallowed
+    cache.status_updater = rec
+    cache.refresh_job_statuses()           # unchanged, but marked
+    assert len(rec.writes) == steady + 1   # ...so it re-sends once
+    cache.refresh_job_statuses()
+    assert len(rec.writes) == steady + 1   # and only once
+
+
+def test_half_open_probe_app_level_answer_closes_the_breaker():
+    """The probe endpoint answering with an app-level error (e.g. a
+    proxy 403 on /version) proves the request/response path is LIVE —
+    counting it as a probe failure would wedge the breaker (and
+    quiesced scheduling) open forever over a healthy wire."""
+    from kube_batch_tpu.client.http_api import HttpError
+
+    clock = Clock()
+    cache = FakeCache()
+    inner = StubBackend()
+    rails = _rails()
+    guarded = rails.guard_backend(inner, cache, sleep=lambda s: None,
+                                  clock=clock)
+    inner.fail_next = 99
+    with pytest.raises(TimeoutError):
+        guarded.bind(FakePod("u1"), "n1")
+    with pytest.raises((TimeoutError, BreakerOpen)):
+        guarded.bind(FakePod("u2"), "n1")
+    assert rails.breaker.state == CircuitBreaker.OPEN
+
+    inner.err = lambda msg: HttpError(403, msg)   # probe answered 403
+    clock.t = 11.0
+    rails.pre_cycle()
+    assert rails.breaker.state == CircuitBreaker.CLOSED
+    assert ("end_resync",) in cache.log
+
+
+def test_record_event_is_not_guarded_and_cannot_reset_the_streak():
+    """Event sinks are async local enqueues on every backend that has
+    one: they must bypass the breaker entirely — their always-local
+    'success' between two real bind failures must not reset the
+    consecutive-transport-failure streak (or the breaker could never
+    trip in --kube-api mode, where every failed bind records a
+    BindFailed event)."""
+    class Inner(StubBackend):
+        def record_event(self, *a, **kw) -> None:
+            self.calls.append(("record_event",))
+
+    inner = Inner()
+    br = CircuitBreaker(trip_after=2)
+    gb = GuardedBackend(inner, breaker=br, backoff=Backoff(attempts=1),
+                        sleep=lambda s: None)
+    inner.fail_next = 1
+    with pytest.raises(TimeoutError):
+        gb.bind(FakePod("u1"), "n1")       # streak 1
+    gb.record_event("Pod", "p", "BindFailed", "...")  # local enqueue
+    inner.fail_next = 1
+    with pytest.raises(TimeoutError):
+        gb.bind(FakePod("u2"), "n1")       # streak 2 → trips
+    assert br.state == CircuitBreaker.OPEN
+    # And while open, events still flow (observability never quiesces).
+    gb.record_event("Pod", "p", "Evicted", "...")
+    assert inner.calls[-1] == ("record_event",)
+
+
+def test_guarded_backend_open_breaker_never_touches_wire():
+    clock = Clock()
+    inner = StubBackend()
+    inner.fail_next = 99
+    br = CircuitBreaker(trip_after=2, reset_after=10.0, clock=clock)
+    gb = GuardedBackend(inner, breaker=br, backoff=Backoff(attempts=2),
+                        sleep=lambda s: None)
+    with pytest.raises(TimeoutError):
+        gb.bind(FakePod("u1"), "n1")   # 2 failures → trips
+    assert br.state == CircuitBreaker.OPEN
+    wire_calls = len(inner.calls)
+    with pytest.raises(BreakerOpen):
+        gb.bind(FakePod("u2"), "n1")
+    with pytest.raises(BreakerOpen):
+        gb.evict(FakePod("u1"), "preempted")
+    assert len(inner.calls) == wire_calls  # nothing reached the wire
+    # BreakerOpen IS a ConnectionError: the cache's bind funnel treats
+    # it as a failed bind and resyncs rather than crashing the cycle.
+    assert issubclass(BreakerOpen, ConnectionError)
+
+
+def test_guarded_backend_delegates_unguarded_verbs():
+    class Inner(StubBackend):
+        def watch_resume(self, since):
+            self.calls.append(("watch_resume", since))
+
+    inner = Inner()
+    br = CircuitBreaker(trip_after=1)
+    br.record_failure()
+    gb = GuardedBackend(inner, breaker=br)
+    gb.watch_resume(7)   # not a write verb: passes through even open
+    assert inner.calls == [("watch_resume", 7)]
+
+
+def test_resync_quiesce_holds_nest():
+    """Two actors hold quiesces independently (watch-gap relist + open
+    breaker): ending one hold must not cancel the other's — a breaker
+    closing mid-relist must NOT expose the half-replayed mirror."""
+    from kube_batch_tpu.api.resource import ResourceSpec
+    from kube_batch_tpu.cache.cache import CacheResyncing, SchedulerCache
+
+    cache = SchedulerCache(spec=ResourceSpec(), binder=None, evictor=None)
+    cache.begin_resync()   # the relist's hold
+    cache.begin_resync()   # the breaker's hold
+    cache.end_resync()     # breaker closes mid-relist
+    assert cache.is_resyncing()
+    with pytest.raises(CacheResyncing):
+        cache.snapshot()
+    cache.end_resync()     # relist replay completes
+    assert not cache.is_resyncing()
+    cache.snapshot()       # schedulable again
+    cache.end_resync()     # unbalanced extra end is clamped, not negative
+    cache.begin_resync()
+    assert cache.is_resyncing()
+    cache.end_resync()
+
+
+# -- watchdog hysteresis ----------------------------------------------
+
+def test_watchdog_engages_after_consecutive_overruns_only():
+    wd = CycleWatchdog(period=1.0, engage_after=3, recover_after=5)
+    for _ in range(2):
+        assert wd.observe(2.0) is None
+    assert wd.observe(0.1) is None     # streak broken
+    for _ in range(2):
+        assert wd.observe(2.0) is None
+    assert wd.observe(2.0) == (0, 1)   # third consecutive → degraded
+    assert wd.rung == 1
+
+
+def test_watchdog_oscillating_load_cannot_flap():
+    """Alternating overrun/healthy resets BOTH streaks: the ladder
+    neither climbs nor descends — no flapping between rungs."""
+    wd = CycleWatchdog(period=1.0, engage_after=2, recover_after=3)
+    for _ in range(2):
+        wd.observe(2.0)
+    assert wd.rung == 1
+    for _ in range(20):
+        assert wd.observe(2.0) is None
+        assert wd.observe(0.1) is None
+    assert wd.rung == 1
+
+
+def test_watchdog_recovery_is_slower_and_stepwise():
+    wd = CycleWatchdog(period=1.0, engage_after=2, recover_after=3)
+    for _ in range(4):
+        wd.observe(5.0)
+    assert wd.rung == 2                # overloaded (and capped there)
+    for _ in range(4):
+        wd.observe(5.0)
+    assert wd.rung == 2                # cannot exceed the top rung
+    changes = [wd.observe(0.1) for _ in range(6)]
+    assert (2, 1) in changes and (1, 0) in changes
+    assert wd.rung == 0
+    assert wd.max_rung_seen == 2
+
+
+def test_watchdog_disabled_by_zero_period_or_engage():
+    assert CycleWatchdog(period=0.0).observe(99.0) is None
+    wd = CycleWatchdog(period=1.0, engage_after=0)
+    assert not wd.enabled
+    assert wd.observe(99.0) is None
+    # None period defers to the caller's (the scheduler passes its
+    # schedule_period); <= 0 there disables too.
+    wd2 = CycleWatchdog(period=None, engage_after=1)
+    assert wd2.observe(99.0, period=0.0) is None
+    assert wd2.observe(99.0, period=1.0) == (0, 1)
+
+
+# -- the facade: quiesce on open, probe on pre_cycle -------------------
+
+class FakeCache:
+    def __init__(self) -> None:
+        self.log: list[tuple] = []
+
+    def begin_resync(self) -> None:
+        self.log.append(("begin_resync",))
+
+    def end_resync(self) -> None:
+        self.log.append(("end_resync",))
+
+    def record_event(self, kind, name, reason, message, **kw) -> None:
+        self.log.append((reason,))
+
+
+def _rails(**over) -> Guardrails:
+    cfg = dict(watchdog_overruns=2, watchdog_recovery=3,
+               watchdog_period=1.0, breaker_failures=2,
+               breaker_reset_s=10.0, backoff_attempts=1)
+    cfg.update(over)
+    return Guardrails(GuardrailConfig(**cfg))
+
+
+def test_guard_backend_requires_ping_when_breaker_enabled():
+    """While the breaker is open scheduling is quiesced, so the ping
+    probe is the ONLY path back to closed: a ping-less backend would
+    either wedge open forever or close blind into a dead wire.  Refuse
+    at wiring time; breaker-disabled guarding (retry/backoff only)
+    stays available to any backend."""
+    class PingLess:
+        def bind(self, pod, node_name):
+            pass
+
+    with pytest.raises(TypeError, match="ping"):
+        _rails().guard_backend(PingLess(), FakeCache())
+    guarded = _rails(breaker_failures=0).guard_backend(
+        PingLess(), FakeCache(), sleep=lambda s: None)
+    guarded.bind(FakePod("u1"), "n1")   # retry-only wrapper still works
+
+
+def test_quiesce_then_heal_replays_no_stale_binds():
+    """The full breaker lifecycle through the facade: repeated
+    transport failures trip it → the cache quiesces (begin_resync) →
+    while open NOTHING reaches the wire → the half-open ping probe
+    heals it → end_resync — and the binds that failed pre-trip were
+    never half-applied, so nothing stale replays."""
+    clock = Clock()
+    cache = FakeCache()
+    inner = StubBackend()
+    rails = _rails()
+    guarded = rails.guard_backend(inner, cache, sleep=lambda s: None,
+                                  clock=clock)
+
+    inner.fail_next = 99
+    with pytest.raises(TimeoutError):
+        guarded.bind(FakePod("u1"), "n1")
+    with pytest.raises((TimeoutError, BreakerOpen)):
+        guarded.bind(FakePod("u2"), "n1")
+    assert rails.breaker.state == CircuitBreaker.OPEN
+    assert ("begin_resync",) in cache.log
+    assert ("BreakerOpen",) in cache.log
+
+    wire = len(inner.calls)
+    with pytest.raises(BreakerOpen):
+        guarded.bind(FakePod("u3"), "n1")
+    assert len(inner.calls) == wire    # open: zero wire attempts
+
+    # Probe before the reset window: no-op, still open.
+    rails.pre_cycle()
+    assert rails.breaker.state == CircuitBreaker.OPEN
+    assert len(inner.calls) == wire
+
+    # Window elapsed but the backend is still dark: probe fails,
+    # breaker re-opens for another full window.
+    clock.t = 11.0
+    rails.pre_cycle()
+    assert rails.breaker.state == CircuitBreaker.OPEN
+    assert inner.calls[-1] == ("ping",)
+
+    # Heal; next window's probe closes the breaker and un-quiesces.
+    inner.fail_next = 0
+    clock.t = 23.0
+    rails.pre_cycle()
+    assert rails.breaker.state == CircuitBreaker.CLOSED
+    assert ("end_resync",) in cache.log
+    assert ("BreakerClosed",) in cache.log
+
+    # Post-heal the wire carries only NEW binds — the pre-trip
+    # failures funneled to resync (cache-side) and are re-decided, not
+    # replayed from the wrapper.
+    guarded.bind(FakePod("u9"), "n2")
+    assert inner.calls[-1] == ("bind", "u9", "n2")
+
+
+def test_quiesced_cycles_do_not_recover_the_ladder(tmp_path):
+    """A quiesced skip (mid-relist / breaker open) returns in
+    microseconds; feeding it to the watchdog would walk the ladder
+    back to "ok" mid-outage.  run_once must not observe such cycles —
+    the rung freezes until real cycles run again."""
+    from kube_batch_tpu import metrics
+    from kube_batch_tpu.models.workloads import DEFAULT_SPEC, GI, _pod
+    from kube_batch_tpu.cache.cluster import Node, PodGroup
+    from kube_batch_tpu.scheduler import Scheduler
+    from kube_batch_tpu.sim.simulator import make_world
+
+    metrics.set_health_state("ok")
+    cache, sim = make_world(DEFAULT_SPEC)
+    sim.add_node(Node(
+        name="n0",
+        allocatable={"cpu": 8000, "memory": 32 * GI, "pods": 110},
+    ))
+    sim.submit(
+        PodGroup(name="g", queue="", min_member=1),
+        [_pod("g-0", cpu=1000, mem=1 * GI)],
+    )
+    # Huge reference period: every REAL cycle (even the compile one)
+    # counts healthy, so recovery timing is deterministic.
+    rails = Guardrails(GuardrailConfig(
+        watchdog_overruns=1, watchdog_recovery=2,
+        watchdog_period=1000.0,
+    ))
+    s = Scheduler(cache, schedule_period=0.0, guardrails=rails)
+    assert s.run_once() is not None          # compile out of the way
+    rails.observe_cycle(5000.0)              # one overrun engages
+    assert rails.state == "degraded"
+    assert metrics.health_state() == "degraded"
+
+    # Outage: a watch-gap relist quiesces the mirror (the journal is
+    # marked full, so the pack goes through snapshot(), which raises
+    # CacheResyncing) — exactly resume_session's sequence.
+    cache.begin_relist()
+    cache.clear()
+    try:
+        for _ in range(6):                   # 3× the recovery threshold
+            assert s.run_once() is None
+        assert rails.state == "degraded"     # frozen, not recovered
+        assert metrics.health_state() == "degraded"
+    finally:
+        cache.end_relist()
+
+    # Post-heal cycles DO recover the ladder (these are idle skips —
+    # a genuinely idle daemon is healthy and still observed).
+    for _ in range(2):
+        s.run_once()
+    assert rails.state == "ok"
+    assert metrics.health_state() == "ok"
+
+
+def test_breaker_open_floors_healthz_and_ctor_does_not_stomp():
+    """While the breaker is not closed /healthz reads at least
+    "degraded" even at ladder rung 0 — probes must not see "ok" during
+    a dead-backend outage.  And constructing ANOTHER Guardrails (as
+    any default-constructed Scheduler does) must not reset the
+    process-global health state a live instance published."""
+    from kube_batch_tpu import metrics
+
+    metrics.set_health_state("ok")
+    clock = Clock()
+    cache = FakeCache()
+    inner = StubBackend()
+    rails = _rails()
+    guarded = rails.guard_backend(inner, cache, sleep=lambda s: None,
+                                  clock=clock)
+    inner.fail_next = 99
+    with pytest.raises(TimeoutError):
+        guarded.bind(FakePod("u1"), "n1")
+    with pytest.raises((TimeoutError, BreakerOpen)):
+        guarded.bind(FakePod("u2"), "n1")
+    assert rails.breaker.state == CircuitBreaker.OPEN
+    assert rails.state == "ok"                   # ladder untouched
+    assert metrics.health_state() == "degraded"  # floored by the breaker
+
+    Guardrails(GuardrailConfig())                # a second instance
+    assert metrics.health_state() == "degraded"  # ...did not stomp it
+
+    inner.fail_next = 0
+    clock.t = 11.0
+    rails.pre_cycle()                            # probe heals
+    assert rails.breaker.state == CircuitBreaker.CLOSED
+    assert metrics.health_state() == "ok"
+
+    # The HBM-ceiling pause floors the body the same way.
+    rails.note_hbm_block(True)
+    assert metrics.health_state() == "degraded"
+    rails.note_hbm_block(False)
+    assert metrics.health_state() == "ok"
+
+
+def test_observe_cycle_transitions_healthz_and_events():
+    from kube_batch_tpu import metrics
+
+    cache = FakeCache()
+    rails = _rails()
+    assert metrics.health_state() == RUNGS[0]
+    rails.observe_cycle(5.0, cache=cache)
+    rails.observe_cycle(5.0, cache=cache)
+    assert rails.state == "degraded"
+    assert metrics.health_state() == "degraded"
+    assert ("GuardrailStateChanged",) in cache.log
+    assert rails.pause_prewarm()
+    assert not rails.skip_diagnosis()
+    assert rails.period_multiplier() == 1.0
+    rails.observe_cycle(5.0, cache=cache)
+    rails.observe_cycle(5.0, cache=cache)
+    assert rails.state == "overloaded"
+    assert rails.skip_diagnosis()
+    assert rails.period_multiplier() > 1.0
+    for _ in range(6):
+        rails.observe_cycle(0.01, cache=cache)
+    assert rails.state == "ok"
+    assert metrics.health_state() == "ok"
+
+
+# -- HBM-ceiling admission --------------------------------------------
+
+class FakeAnalysis:
+    def __init__(self, peak: int) -> None:
+        self.peak_memory_in_bytes = peak
+        self.temp_size_in_bytes = 0
+        self.argument_size_in_bytes = 0
+        self.output_size_in_bytes = 0
+
+
+class FakeExe:
+    def __init__(self, peak: int) -> None:
+        self._peak = peak
+
+    def memory_analysis(self) -> FakeAnalysis:
+        return FakeAnalysis(self._peak)
+
+
+class OpaqueExe:
+    """No memory_analysis at all (non-XLA fakes)."""
+
+
+def test_hbm_ceiling_admits_refuses_and_counts():
+    ceiling = HbmCeiling(ceiling_bytes=1000)
+    ok, projected = ceiling.admit(FakeExe(900), label="small")
+    assert ok and projected == 900
+    ok, projected = ceiling.admit(FakeExe(1001), label="big")
+    assert not ok and projected == 1001
+    assert ceiling.refusals == 1
+    # Disabled ceiling admits everything; opaque executables are
+    # admitted (no evidence is not evidence of overflow).
+    assert HbmCeiling(None).admit(FakeExe(10**12))[0]
+    assert ceiling.admit(OpaqueExe())[0]
+
+
+def test_scheduler_growth_prewarm_refuses_over_ceiling(tmp_path):
+    """The acceptance path: a 1-byte ceiling refuses the next-bucket
+    program at adoption (previous program keeps serving), records the
+    HbmAdmissionRefused event, and does NOT retry the same key; a
+    disabled ceiling adopts the identical program."""
+    from kube_batch_tpu.models.workloads import DEFAULT_SPEC, GI, _pod
+    from kube_batch_tpu.cache.cluster import Node, PodGroup
+    from kube_batch_tpu.scheduler import Scheduler
+    from kube_batch_tpu.sim.simulator import make_world
+
+    def world():
+        cache, sim = make_world(DEFAULT_SPEC)
+        sim.add_node(Node(
+            name="n0",
+            allocatable={"cpu": 8000, "memory": 32 * GI, "pods": 110},
+        ))
+        sim.submit(
+            PodGroup(name="g", queue="", min_member=2),
+            [_pod(f"g-{i}", cpu=1000, mem=1 * GI) for i in range(2)],
+        )
+        return cache
+
+    from kube_batch_tpu.guardrails import projected_device_bytes
+
+    rails = Guardrails(GuardrailConfig(hbm_ceiling_mb=None))
+    refusing = Scheduler(world(), schedule_period=0.0, guardrails=rails)
+    assert refusing.run_once() is not None
+    # Ceiling = the serving program's own projection: the base program
+    # stays admitted (<=), the bigger next-bucket program is refused.
+    (base_exe,) = refusing._compiled_shapes.values()
+    rails.hbm.ceiling_bytes = projected_device_bytes(base_exe)
+    assert refusing.warm_grown() is False
+    assert len(refusing._growth_refused) == 1
+    (label, projected), = refusing._growth_refused.values()
+    assert projected > 1.0  # a real memory_analysis projection
+    assert refusing.guardrails.hbm.refusals == 1
+    events = refusing.cache.events_for("Scheduler", "growth-prewarm")
+    assert any(e.reason == "HbmAdmissionRefused" for e in events)
+    # The refused key is pinned: nothing adopted it.
+    before = dict(refusing._compiled_shapes)
+    assert refusing.warm_grown() is False   # same verdict, no adoption
+    assert refusing._compiled_shapes.keys() == before.keys()
+
+    adopting = Scheduler(
+        world(), schedule_period=0.0,
+        guardrails=Guardrails(GuardrailConfig(hbm_ceiling_mb=None)),
+    )
+    assert adopting.run_once() is not None
+    shapes_before = set(adopting._compiled_shapes)
+    assert adopting.warm_grown() is True
+    assert len(adopting._compiled_shapes) == len(shapes_before) + 1
+
+
+def test_prewarm_refresh_drops_stale_refusal_when_ceiling_moves(tmp_path):
+    """A refusal pinned under an older (or temporary) ceiling must not
+    outlive it: once the ceiling is raised or disabled, the per-cycle
+    prewarm refresh drops the pin and re-queues the warm — no false
+    HbmAdmissionRefused alarms, no permanently-lost prewarm."""
+    from kube_batch_tpu.guardrails import projected_device_bytes
+    from kube_batch_tpu.models.workloads import DEFAULT_SPEC, GI, _pod
+    from kube_batch_tpu.cache.cluster import Node, PodGroup
+    from kube_batch_tpu.scheduler import Scheduler
+    from kube_batch_tpu.sim.simulator import make_world
+
+    cache, sim = make_world(DEFAULT_SPEC)
+    sim.add_node(Node(
+        name="n0",
+        allocatable={"cpu": 64000, "memory": 256 * GI, "pods": 110},
+    ))
+    # 6 tasks: inside the 8-bucket but within its growth-trigger
+    # headroom, so every cycle's refresh stages the next bucket.  One
+    # is unschedulable (oversized), keeping the daemon out of the idle
+    # early-out — the refresh only runs on real cycles.
+    sim.submit(
+        PodGroup(name="g", queue="", min_member=1),
+        [_pod(f"g-{i}", cpu=1000, mem=1 * GI) for i in range(5)]
+        + [_pod("g-huge", cpu=999000, mem=1 * GI)],
+    )
+    rails = Guardrails(GuardrailConfig(hbm_ceiling_mb=None))
+    s = Scheduler(cache, schedule_period=0.0, guardrails=rails)
+    assert s.run_once() is not None
+    (base_exe,) = s._compiled_shapes.values()
+    rails.hbm.ceiling_bytes = projected_device_bytes(base_exe)
+    assert s.warm_grown() is False           # pin the next bucket
+    (refused_key,) = s._growth_refused.keys()
+
+    s._growth_armed = True
+    try:
+        # Ceiling still live: the refresh re-warns, pin stays.
+        assert s.run_once() is not None
+        assert refused_key in s._growth_refused
+
+        # Ceiling disabled: the refresh drops the stale pin and the
+        # prewarm worker compiles + adopts the once-refused bucket.
+        rails.hbm.ceiling_bytes = None
+        assert s.run_once() is not None
+        assert refused_key not in s._growth_refused
+        t = s._growth_thread
+        if t is not None:
+            t.join(timeout=120)
+        assert refused_key in s._compiled_shapes
+    finally:
+        s._growth_armed = False
+        t = s._growth_thread
+        if t is not None:
+            t.join(timeout=120)
+
+
+def test_crossing_a_refused_boundary_pauses_the_solve(tmp_path):
+    """Enforcement at the crossing: once the cluster actually grows
+    into a refused bucket, the scheduler must NOT execute the
+    over-ceiling program — the solve pauses (no binds land, placed
+    work keeps running, /healthz floors at "degraded", an
+    HbmCeilingBlocked event fires every paused cycle) and resumes on
+    its own when the world shrinks back under the serving bucket."""
+    from kube_batch_tpu import metrics
+    from kube_batch_tpu.models.workloads import DEFAULT_SPEC, GI, _pod
+    from kube_batch_tpu.cache.cluster import Node, PodGroup
+    from kube_batch_tpu.scheduler import Scheduler
+    from kube_batch_tpu.sim.simulator import make_world
+
+    metrics.set_health_state("ok")
+    cache, sim = make_world(DEFAULT_SPEC)
+    sim.add_node(Node(
+        name="n0",
+        allocatable={"cpu": 64000, "memory": 256 * GI, "pods": 110},
+    ))
+    sim.submit(
+        PodGroup(name="g", queue="", min_member=2),
+        [_pod(f"g-{i}", cpu=1000, mem=1 * GI) for i in range(2)],
+    )
+    from kube_batch_tpu.guardrails import projected_device_bytes
+
+    rails = Guardrails(GuardrailConfig(hbm_ceiling_mb=None))
+    s = Scheduler(cache, schedule_period=0.0, guardrails=rails)
+    ssn = s.run_once()
+    assert ssn is not None and len(ssn.bound) == 2   # g fits, binds
+    # Ceiling = the serving program's own projection: the 8-bucket
+    # program keeps serving, anything bigger is refused.
+    (base_exe,) = s._compiled_shapes.values()
+    rails.hbm.ceiling_bytes = projected_device_bytes(base_exe)
+    # Pin the refusal for the next task bucket (2 tasks pad to 8; the
+    # grown program pads to 16), exactly as the prewarm would have.
+    assert s.warm_grown() is False
+    (refused_key,) = s._growth_refused.keys()
+
+    # Cross the boundary: 8 more single-pod-gang tasks → 10 real
+    # tasks → the pack needs the refused 16-bucket program.
+    sim.submit(
+        PodGroup(name="h", queue="", min_member=1),
+        [_pod(f"h-{i}", cpu=1000, mem=1 * GI) for i in range(8)],
+    )
+    blocked = s.run_once()
+    assert blocked is not None
+    assert blocked.bound == []                       # solve paused
+    assert refused_key not in s._compiled_shapes     # never compiled
+    assert metrics.health_state() == "degraded"      # floored
+    events = cache.events_for("Scheduler", "hbm-ceiling")
+    assert any(e.reason == "HbmCeilingBlocked" for e in events)
+    # Placed work untouched: g's two pods are still on n0.
+    assert {p.node for p in cache._pods.values()
+            if p.name.startswith("g-")} == {"n0"}
+    # Paused cycles re-warn every cycle, like every guardrail refusal
+    # (identical events dedupe into a count).
+    def blocked_count():
+        return sum(
+            e.count for e in cache.events_for("Scheduler", "hbm-ceiling")
+            if e.reason == "HbmCeilingBlocked"
+        )
+
+    n_events = blocked_count()
+    assert s.run_once() is not None
+    assert blocked_count() > n_events
+
+    # Joiner race: a cycle that joins an in-flight warm must honor a
+    # refusal pinned WHILE it waited — recompiling the identical
+    # over-ceiling program inline would block the cycle for the same
+    # compile only to be refused again.  (Refusal count unchanged ⇒
+    # no duplicate inline compile+admission ran.)
+    import threading as _threading
+
+    pin = s._growth_refused.pop(refused_key)
+    ev = _threading.Event()
+    s._growth_inflight[refused_key] = ev
+
+    def _worker():
+        s._growth_refused[refused_key] = pin    # the warm refuses...
+        ev.set()                                # ...and finishes
+
+    refusals_before = rails.hbm.refusals
+    t = _threading.Thread(target=_worker)
+    t.start()
+    assert s._ensure_compiled(blocked.snap, blocked.state) is None
+    t.join()
+    s._growth_inflight.pop(refused_key, None)
+    assert rails.hbm.refusals == refusals_before
+    assert refused_key not in s._compiled_shapes
+
+    # Shrink back under the serving bucket (keep ONE pending row so
+    # the resume is a real solving cycle): service resumes by itself.
+    # The incremental packer never shrinks buckets on its own, so the
+    # first post-shrink cycle is still blocked — it detects the shrink
+    # and forces a full repack; the one after serves.
+    h_uids = sorted(uid for uid, p in cache._pods.items()
+                    if p.name.startswith("h-"))
+    for uid in h_uids[:-1]:
+        sim.delete_pod(uid)
+    still = s.run_once()
+    assert still is not None and still.bound == []
+    assert s.packer._dirty.full_reason == "hbm-shrink"
+    resumed = s.run_once()
+    assert resumed is not None
+    assert len(resumed.bound) == 1           # the survivor binds
+    assert metrics.health_state() == "ok"

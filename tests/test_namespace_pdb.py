@@ -267,3 +267,32 @@ def test_multi_pdb_allows_eviction_when_all_floors_permit():
     ssn = run_cycle(cache, ["allocate", "preempt"])
     assert len(ssn.evicted) == 1
     assert ssn.evicted[0][0].startswith("web")
+
+
+def test_multi_pdb_eviction_divergence_surfaced_in_k8s_mode():
+    """Upstream's eviction API refuses ANY eviction of a pod covered
+    by >1 budget; this scheduler allows it when every floor survives
+    (plugins/pdb.py · "Known divergence").  Under the apiserver write
+    dialect that divergence must be surfaced PER EVICT — a
+    MultiBudgetEviction event naming both budgets — so an operator
+    mirroring the writes knows where upstream tooling would refuse."""
+    cache, _sim = _running_world_with_two_pdbs(floor_a=1, floor_b=1)
+    cache.k8s_write_format = True  # ≙ --write-format k8s / --kube-api
+    ssn = run_cycle(cache, ["allocate", "preempt"])
+    assert len(ssn.evicted) == 1
+    victim = ssn.evicted[0][0]
+    events = cache.events_for("Pod", victim)
+    diverged = [e for e in events if e.reason == "MultiBudgetEviction"]
+    assert len(diverged) == 1
+    assert "a-web" in diverged[0].message
+    assert "b-fe" in diverged[0].message
+
+    # Native dialect stays quiet: the divergence only matters when the
+    # decisions leave the process in apiserver shape.
+    cache2, _sim2 = _running_world_with_two_pdbs(floor_a=1, floor_b=1)
+    ssn2 = run_cycle(cache2, ["allocate", "preempt"])
+    assert len(ssn2.evicted) == 1
+    assert not [
+        e for e in cache2.events_for("Pod", ssn2.evicted[0][0])
+        if e.reason == "MultiBudgetEviction"
+    ]
