@@ -17,12 +17,16 @@ e2e:
 bench:
 	$(PY) bench.py
 
-# CPU smoke of the daemon bench phases (soak, hotswap, per-phase
-# attribution) at a SMALL config: keeps the TPU-only code paths from
-# rotting while the device tunnel is down.  ~3-5 min.
+# CPU smoke of the daemon bench phases (commit-pipeline comparison,
+# soak, hotswap, per-phase attribution) at config-1 scale: keeps the
+# TPU-only code paths from rotting while the device tunnel is down,
+# and self-checks the FINAL artifact line the driver parses (one
+# json.loads-able object with the phase evidence + the >=1.5x
+# pipelined-commit speedup) — wired into `make verify`.  ~2-4 min.
 bench-smoke:
-	KB_TPU_FORCE_CPU=1 $(PY) bench.py --_daemon --_daemon-config 2 \
-	    --_budget 600
+	KB_TPU_FORCE_CPU=1 $(PY) bench.py --_daemon --_daemon-config 1 \
+	    --_budget 420 > /tmp/kb-bench-smoke.out
+	$(PY) scripts/check_bench_smoke.py < /tmp/kb-bench-smoke.out
 
 # Pre-compile every hot-swappable conf at the flagship shape into the
 # persistent XLA cache, so daemon conf swaps replay in seconds instead
@@ -50,10 +54,24 @@ run-example:
 # probe must be refused by ceiling admission — the engine asserts all
 # of it (ladder engagement, quiesce, recovery) as invariants, same
 # seed ⇒ same trace hash.
+# The third and fourth runs are the PIPELINED-COMMIT dimension
+# (doc/design/pipelined-commit.md): the guardrail scenario through the
+# asynchronous commit pipeline, twice — scripts/check_chaos_pipelined.py
+# asserts zero violations, same seed ⇒ same trace hash across the two
+# runs, per-pod wire-write order preserved, and the breaker trip
+# draining to zero in-flight writes.
 chaos:
 	JAX_PLATFORMS=cpu $(PY) -m kube_batch_tpu.chaos --seed 7 --ticks 200
 	JAX_PLATFORMS=cpu $(PY) -m kube_batch_tpu.chaos --seed 11 --ticks 32 \
 	    --scenario examples/chaos-guardrail.json
+	JAX_PLATFORMS=cpu $(PY) -m kube_batch_tpu.chaos --seed 11 --ticks 32 \
+	    --scenario examples/chaos-guardrail.json --wire-commit pipelined \
+	    --quiet > /tmp/kb-chaos-pipelined-1.json
+	JAX_PLATFORMS=cpu $(PY) -m kube_batch_tpu.chaos --seed 11 --ticks 32 \
+	    --scenario examples/chaos-guardrail.json --wire-commit pipelined \
+	    --quiet > /tmp/kb-chaos-pipelined-2.json
+	$(PY) scripts/check_chaos_pipelined.py /tmp/kb-chaos-pipelined-1.json \
+	    /tmp/kb-chaos-pipelined-2.json
 
 profile:
 	$(PY) -m kube_batch_tpu --workload 2 --cycles 3 --schedule-period 0 \
@@ -66,6 +84,7 @@ verify:
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 	    $(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 	$(MAKE) chaos
+	$(MAKE) bench-smoke
 
 clean:
 	find . -name __pycache__ -type d -exec rm -rf {} +

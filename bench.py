@@ -668,9 +668,29 @@ def _run_daemon_phases(jax, n, cache, sim, conf_path, steady_cycles) -> dict:
     }
     emit_partial(**{k: v for k, v in out.items() if k != "config"})
 
+    # -- pipelined-vs-sync wire commit (simulated 68 ms RTT) ------------
+    # Cheap (a tiny world, seconds of wall) and acceptance-bearing:
+    # every daemon artifact must record the steady-cycle speedup, so
+    # a tight budget shrinks the window instead of skipping it.
+    try:
+        out["commit_pipeline"] = run_commit_compare(
+            cycles=6 if _budget_left() > 90.0 else 3
+        )
+    except Exception as exc:  # noqa: BLE001 — degrade, never die
+        out["commit_pipeline"] = {"error": str(exc)[:300]}
+    emit_partial(commit_pipeline=out["commit_pipeline"])
+
     # -- sustained-churn soak (VERDICT r4 next #7) ----------------------
+    # Budget degradation ladder: full 50 cycles, then a shorter soak,
+    # then skip only when there is genuinely nothing left — the
+    # trajectory should record a wire-cycle number every round.
     if _budget_left() > 150.0:
         out["soak"] = _run_soak(s, sim, cache, one_cycle)
+    elif _budget_left() > 60.0:
+        out["soak"] = {
+            **_run_soak(s, sim, cache, one_cycle, cycles=10),
+            "degraded": "time budget low; 10-cycle soak",
+        }
     else:
         out["soak"] = {"skipped": "time budget exhausted"}
     emit_partial(soak=out["soak"])
@@ -792,6 +812,95 @@ def _run_hotswap(s, sim, one_cycle, deadline_s: float = 180.0) -> dict:
     }
 
 
+def run_commit_compare(cycles: int = 6, gang: int = 8,
+                       rtt_s: float = 0.068) -> dict:
+    """Pipelined-vs-sync steady-cycle comparison against a simulated
+    68 ms-RTT wire backend (the measured tunnel round trip): the same
+    light-churn steady state — one fresh gang arriving per cycle — is
+    run once with the synchronous commit (every bind/status write
+    blocks the cycle) and once through the asynchronous commit
+    pipeline (framework/commit.py; cycle ends at enqueue, RTTs flush
+    concurrently).  Reports steady-state cycles/sec for both and the
+    speedup; the pipelined wall INCLUDES the final drain, so a
+    pipeline that couldn't keep pace cannot inflate its number."""
+    from kube_batch_tpu.api.types import TaskStatus
+    from kube_batch_tpu.cache.backend import (
+        FakeBinder,
+        FakeEvictor,
+        FakeStatusUpdater,
+    )
+    from kube_batch_tpu.cache.cache import SchedulerCache
+    from kube_batch_tpu.cache.cluster import PodGroup
+    from kube_batch_tpu.framework.commit import CommitPipeline
+    from kube_batch_tpu.models.workloads import DEFAULT_SPEC, GI, _node, _pod
+    from kube_batch_tpu.scheduler import Scheduler
+
+    def submit(cache, name: str, n: int) -> None:
+        cache.add_pod_group(PodGroup(name=name, queue="default",
+                                     min_member=n))
+        for k in range(n):
+            pod = _pod(f"{name}-{k}", cpu=250, mem=GI / 2)
+            pod.group = name
+            cache.add_pod(pod)
+
+    def one_mode(pipelined: bool) -> tuple[float, int, dict | None]:
+        binder = FakeBinder(rtt_s=rtt_s)
+        cache = SchedulerCache(
+            spec=DEFAULT_SPEC, binder=binder, evictor=FakeEvictor(),
+            status_updater=FakeStatusUpdater(rtt_s=rtt_s),
+        )
+        for i in range(4):
+            cache.add_node(_node(f"cmp-n{i}", cpu_milli=32000,
+                                 mem=128 * GI))
+        commit = None
+        if pipelined:
+            commit = CommitPipeline(cache=cache, max_inflight=256)
+            cache.commit = commit
+        s = Scheduler(cache, schedule_period=0.0)
+        # Base load + warmup: park the task count deep inside one
+        # padding bucket so the timed cycles never cross a shape
+        # boundary (a mid-window recompile would swamp the RTT signal),
+        # and pay the jit compile outside the timed window.
+        for i in range(8):
+            submit(cache, f"cmp-base-{i}", gang)
+        submit(cache, "cmp-warm", gang)
+        s.run_once()
+        if commit is not None:
+            commit.drain()
+        t0 = time.perf_counter()
+        for i in range(cycles):
+            submit(cache, f"cmp-steady-{i}", gang)
+            s.run_once()
+        if commit is not None:
+            commit.drain()
+        wall = time.perf_counter() - t0
+        with cache.lock():
+            bound = sum(
+                1 for p in cache._pods.values()
+                if p.status == TaskStatus.BOUND
+            )
+        stats = commit.stats() if commit is not None else None
+        if commit is not None:
+            commit.close(timeout=5.0)
+        return wall, bound, stats
+
+    sync_wall, sync_bound, _ = one_mode(pipelined=False)
+    pipe_wall, pipe_bound, pipe_stats = one_mode(pipelined=True)
+    sync_cps = cycles / sync_wall if sync_wall > 0 else 0.0
+    pipe_cps = cycles / pipe_wall if pipe_wall > 0 else 0.0
+    return {
+        "rtt_ms": round(rtt_s * 1e3, 1),
+        "cycles": cycles,
+        "gang_per_cycle": gang,
+        "sync_cycles_per_sec": round(sync_cps, 2),
+        "pipelined_cycles_per_sec": round(pipe_cps, 2),
+        "speedup": round(pipe_cps / sync_cps, 2) if sync_cps > 0 else None,
+        "sync_pods_bound": sync_bound,
+        "pipelined_pods_bound": pipe_bound,
+        "pipeline_stats": pipe_stats,
+    }
+
+
 def _text(b) -> str:
     return b.decode(errors="replace") if isinstance(b, bytes) else (b or "")
 
@@ -799,6 +908,20 @@ def _text(b) -> str:
 #: Per-line clip for child-log embeds: a child that dies spewing a
 #: traceback with a megabyte repr in it must not bloat the artifact.
 MAX_TAIL_LINE_CHARS = 200
+#: Known-noise stderr lines excluded from child-log tails: XLA's
+#: persistent compile cache replayed on a host with different CPU
+#: features floods the tail with cpu_aot_loader machine-feature
+#: warnings (bench r05's artifact drowned in them and parsed null).
+#: The compile cache is now host-fingerprinted (compile_cache.py ·
+#: host_fingerprint) so fresh caches can't hit this, but a tail
+#: containing pre-fingerprint entries must still surface the REAL
+#: last error, not the warning flood.
+NOISE_TAIL_MARKERS = (
+    "cpu_aot_loader",
+    "cpu_aot_compilation_result",
+    "machine features",
+    "cpu feature guard",
+)
 #: Hard cap on the final artifact line.  The driver reads the LAST
 #: stdout line as the whole scoreboard; one unbounded embed can make
 #: that line unparseable-in-practice and zero every field (VERDICT
@@ -808,8 +931,14 @@ MAX_ARTIFACT_BYTES = 128 * 1024
 
 def _clip_tail(stderr: str, lines: int = 3) -> list[str]:
     """Last `lines` of a child's stderr, each clipped to
-    MAX_TAIL_LINE_CHARS — bounded evidence, never the whole log."""
-    tail = _text(stderr).strip().splitlines()[-lines:]
+    MAX_TAIL_LINE_CHARS — bounded evidence, never the whole log.
+    Known-noise warning classes (NOISE_TAIL_MARKERS) are dropped
+    first, so a flood of XLA machine-feature chatter cannot bury the
+    line that actually explains the death."""
+    tail = [
+        ln for ln in _text(stderr).strip().splitlines()
+        if not any(m in ln.lower() for m in NOISE_TAIL_MARKERS)
+    ][-lines:]
     return [
         ln if len(ln) <= MAX_TAIL_LINE_CHARS
         else ln[: MAX_TAIL_LINE_CHARS - 1] + "…"
@@ -934,7 +1063,7 @@ def _wait_with_compile_grace(
         return timed_out, _read(out_f), _read(err_f), proc.returncode
 
 
-def _run_daemon_subprocess(timeout_s: float) -> dict:
+def _run_daemon_subprocess(timeout_s: float, config: int = 5) -> dict:
     """run_daemon in a fresh interpreter (same isolation rationale as
     configs; also exactly what 'a restarted daemon' means).
 
@@ -943,10 +1072,12 @@ def _run_daemon_subprocess(timeout_s: float) -> dict:
     the whole scoreboard (the round-4 lesson: one transient outage
     zeroed every daemon field).  The `first_cycle_ms` milestone marks
     the first-cycle compile complete (grace discipline in
-    _wait_with_compile_grace).
+    _wait_with_compile_grace).  `config` lets a budget-starved parent
+    DEGRADE the phase to a smaller world instead of skipping it.
     """
     timed_out, stdout, stderr, rc = _wait_with_compile_grace(
         [sys.executable, __file__, "--_daemon",
+         "--_daemon-config", str(config),
          "--_budget", f"{max(timeout_s - 30.0, 30.0):.0f}"],
         timeout_s, done_marker="first_cycle_ms", marker_in_stdout=True,
         what="daemon",
@@ -1239,44 +1370,58 @@ def main() -> None:
         # persisted executable).  Warm: ANOTHER fresh process — the
         # restarted-leader story; its first cycle must be replay-fast.
         if not args.skip_daemon:
-            if _budget_left() < 90.0:
-                result["daemon"] = {"skipped": "time budget exhausted"}
-            else:
-                # The daemon phase runs LAST and gets a hard floor well
-                # beyond TIME_BUDGET_S: with a cold compile cache the
-                # flagship fused-cycle compile through the tunnel takes
-                # 400-700 s (measured; the persistent cache turns the
-                # rerun into ~10 s), and a timed-out daemon phase would
-                # erase exactly the e2e evidence the driver records.
-                _log("daemon phase starting (subprocess, cold)")
-                daemon = _retry_on_hang(
-                    lambda: _run_daemon_subprocess(
-                        max(780.0, _budget_left())
-                    ),
-                    "daemon cold",
+            # Budget degradation, not a skip (bench r05 recorded
+            # `"skipped": "time budget exhausted"` and the trajectory
+            # lost its wire-cycle number for the round): a starved
+            # parent runs the daemon phases at config 1 — small world,
+            # seconds of compile — so first-cycle/steady/commit-
+            # pipeline evidence lands every round, labeled degraded.
+            degraded = _budget_left() < 90.0
+            daemon_cfg = 1 if degraded else 5
+            # The daemon phase runs LAST and gets a hard floor well
+            # beyond TIME_BUDGET_S: with a cold compile cache the
+            # flagship fused-cycle compile through the tunnel takes
+            # 400-700 s (measured; the persistent cache turns the
+            # rerun into ~10 s), and a timed-out daemon phase would
+            # erase exactly the e2e evidence the driver records.
+            _log(f"daemon phase starting (subprocess, cold, "
+                 f"config {daemon_cfg})")
+            daemon = _retry_on_hang(
+                lambda: _run_daemon_subprocess(
+                    max(300.0 if degraded else 780.0, _budget_left()),
+                    config=daemon_cfg,
+                ),
+                "daemon cold",
+            )
+            _log(f"daemon cold done: {daemon}")
+            if degraded:
+                daemon["degraded_config"] = daemon_cfg
+            if "error" not in daemon and not degraded:
+                _log("daemon phase starting (subprocess, warm restart)")
+                warm = _run_daemon_subprocess(
+                    max(120.0, _budget_left()), config=daemon_cfg,
                 )
-                _log(f"daemon cold done: {daemon}")
-                if "error" not in daemon:
-                    _log("daemon phase starting (subprocess, warm restart)")
-                    warm = _run_daemon_subprocess(max(120.0, _budget_left()))
-                    _log(f"daemon warm done: {warm}")
-                    daemon["first_cycle_warm_ms"] = warm.get(
-                        "first_cycle_ms", warm.get("error")
-                    )
-                    daemon["warm_e2e_cycle_ms_p50"] = warm.get(
-                        "e2e_cycle_ms_p50"
-                    )
-                    if "error" in warm:
-                        # Partial milestones may have satisfied the
-                        # fields above; the failure itself must still
-                        # be visible in the artifact.
-                        daemon["warm_error"] = warm["error"]
-                result["daemon"] = daemon
-                # Surface the driver-metric fields at top level too.
-                if "e2e_cycle_ms_p50" in daemon:
-                    result["e2e_cycle_ms_p50"] = daemon["e2e_cycle_ms_p50"]
-                    result["e2e_cycle_ms_p99"] = daemon["e2e_cycle_ms_p99"]
-                    result["first_cycle_ms"] = daemon["first_cycle_ms"]
+                _log(f"daemon warm done: {warm}")
+                daemon["first_cycle_warm_ms"] = warm.get(
+                    "first_cycle_ms", warm.get("error")
+                )
+                daemon["warm_e2e_cycle_ms_p50"] = warm.get(
+                    "e2e_cycle_ms_p50"
+                )
+                if "error" in warm:
+                    # Partial milestones may have satisfied the
+                    # fields above; the failure itself must still
+                    # be visible in the artifact.
+                    daemon["warm_error"] = warm["error"]
+            result["daemon"] = daemon
+            # Surface the driver-metric fields at top level too.
+            if "e2e_cycle_ms_p50" in daemon:
+                result["e2e_cycle_ms_p50"] = daemon["e2e_cycle_ms_p50"]
+                result["e2e_cycle_ms_p99"] = daemon["e2e_cycle_ms_p99"]
+                result["first_cycle_ms"] = daemon["first_cycle_ms"]
+            cmp_ = daemon.get("commit_pipeline")
+            if isinstance(cmp_, dict) and cmp_.get("speedup"):
+                result["commit_pipeline_speedup"] = cmp_["speedup"]
 
     _emit_artifact(result)
 

@@ -11,6 +11,7 @@ cluster, because actions can only touch the world through these calls.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Protocol, runtime_checkable
 
 from kube_batch_tpu.cache.cluster import Pod, PodGroup
@@ -47,17 +48,34 @@ class VolumeBinder(Protocol):
 
 class FakeBinder:
     """Records binds; `wait_for` mirrors the reference tests' channel
-    pattern (assert expected binds arrive)."""
+    pattern (assert expected binds arrive).
 
-    def __init__(self) -> None:
+    `rtt_s` makes this the fake HIGH-RTT wire backend the commit-
+    pipeline tests and the bench's pipelined-vs-sync comparison drive:
+    every bind sleeps one simulated round trip before acking (`sleep`
+    is injectable so tests can keep a fast wall clock).  `fail_once`
+    fails a pod's FIRST bind only — the resync-retry path — while
+    `fail_pods` keeps failing every attempt."""
+
+    def __init__(self, rtt_s: float = 0.0, sleep=time.sleep) -> None:
         self.binds: list[tuple[str, str]] = []  # (pod name, node name)
         self._cv = threading.Condition()
         self.fail_pods: set[str] = set()        # inject bind failures by name
+        self.fail_once: set[str] = set()        # fail only the first attempt
+        self.rtt_s = rtt_s
+        self._sleep = sleep
 
     def bind(self, pod: Pod, node_name: str) -> None:
+        if self.rtt_s:
+            self._sleep(self.rtt_s)
         if pod.name in self.fail_pods:
             raise RuntimeError(f"injected bind failure for {pod.name}")
         with self._cv:
+            if pod.name in self.fail_once:
+                self.fail_once.discard(pod.name)
+                raise RuntimeError(
+                    f"injected first-attempt bind failure for {pod.name}"
+                )
             self.binds.append((pod.name, node_name))
             self._cv.notify_all()
 
@@ -76,10 +94,17 @@ class FakeEvictor:
 
 
 class FakeStatusUpdater:
-    def __init__(self) -> None:
+    """Records status writes; `rtt_s`/`sleep` simulate the wire round
+    trip exactly like FakeBinder."""
+
+    def __init__(self, rtt_s: float = 0.0, sleep=time.sleep) -> None:
         self.updates: list[PodGroup] = []
+        self.rtt_s = rtt_s
+        self._sleep = sleep
 
     def update_pod_group(self, group: PodGroup) -> None:
+        if self.rtt_s:
+            self._sleep(self.rtt_s)
         self.updates.append(group)
 
 

@@ -196,6 +196,14 @@ class SchedulerCache:
         # must not stack a second hold the single end_relist could
         # never release.
         self._relist_hold = False
+        # Asynchronous wire-commit pipeline (framework/commit.py),
+        # attached by wire-mode wiring (cli.py / chaos engine) when
+        # --wire-commit pipelined: bind flushes, PodGroup status writes
+        # and event-sink forwards route through it with per-object
+        # ordering keys, so the cycle thread never blocks on a wire
+        # RTT.  None (the default, and the in-process simulator path)
+        # keeps every commit synchronous and inline.
+        self.commit = None
         # True when scheduling decisions leave the process in apiserver
         # dialect (--write-format k8s / --kube-api): known divergences
         # from upstream API semantics are then surfaced per decision —
@@ -284,25 +292,48 @@ class SchedulerCache:
                 self.events.append(ev)
                 self._event_index[key] = ev
         if self.event_sink is not None:
-            try:
-                self.event_sink.record_event(
-                    kind, name, reason, message,
-                    count=ev.count, namespace=namespace,
+            commit = self.commit
+            if commit is not None:
+                # Pipelined: the sink forward flushes off-thread under
+                # one shared ordering key, preserving global event
+                # order.  The count is captured NOW — the record may
+                # aggregate further before the flush lands.
+                count = ev.count
+                commit.submit(
+                    "events",
+                    lambda: self._send_event(
+                        kind, name, reason, message, count, namespace,
+                    ),
+                    verb="event",
                 )
-            except Exception as exc:  # noqa: BLE001 — classified below
-                # Events are fire-and-forget; the in-process ring above
-                # already holds the record.  Same posture as
-                # update_job_status: transport failures (including an
-                # OPEN guardrail breaker, and HTTP 429/5xx — see
-                # guardrails.breaker.is_transient) never crash the
-                # caller.  App-level rejections stay loud: bugs.
-                if not is_transient(exc):
-                    raise
-                logging.warning(
-                    "event sink write failed (%s %s %s): %s",
-                    kind, name, reason, exc,
+            else:
+                self._send_event(
+                    kind, name, reason, message, ev.count, namespace,
                 )
         return ev
+
+    def _send_event(self, kind, name, reason, message, count,
+                    namespace) -> None:
+        """Forward one event through the sink (outside the lock — sinks
+        may touch the wire)."""
+        try:
+            self.event_sink.record_event(
+                kind, name, reason, message,
+                count=count, namespace=namespace,
+            )
+        except Exception as exc:  # noqa: BLE001 — classified below
+            # Events are fire-and-forget; the in-process ring already
+            # holds the record.  Same posture as update_job_status:
+            # transport failures (including an OPEN guardrail breaker,
+            # and HTTP 429/5xx — see guardrails.breaker.is_transient)
+            # never crash the caller.  App-level rejections stay loud:
+            # bugs.
+            if not is_transient(exc):
+                raise
+            logging.warning(
+                "event sink write failed (%s %s %s): %s",
+                kind, name, reason, exc,
+            )
 
     def events_for(self, kind: str, name: str) -> list:
         """Events attached to one object (filterable, unlike a string log)."""
@@ -698,8 +729,21 @@ class SchedulerCache:
     # -- commit funnel (≙ cache.go · Bind / Evict) -----------------------
 
     def bind(self, pod_uid: str, node_name: str) -> bool:
-        """Dispatch a bind through the Binder.  On failure the task is
-        reset to PENDING and queued for resync (≙ errTasks workqueue)."""
+        """Dispatch a bind through the Binder, synchronously.  On
+        failure the task is reset to PENDING and queued for resync
+        (≙ errTasks workqueue).  The pipelined commit path calls the
+        same two halves split across threads: `begin_bind` on the
+        cycle thread (the cache mutation the next pack must see),
+        `finish_bind` on a commit-flush worker (the wire RTT)."""
+        if not self.begin_bind(pod_uid, node_name):
+            return False
+        return self.finish_bind(pod_uid, node_name)
+
+    def begin_bind(self, pod_uid: str, node_name: str) -> bool:
+        """Phase 1, under the lock: validate the target and mark the
+        pod BINDING on its node.  Returns False (with resync + event
+        for a vanished node) when there is nothing to flush — the pod
+        was deleted between decision and commit, or the node is gone."""
         with self._lock:
             pod = self._pods.get(pod_uid)
             if pod is None:
@@ -715,6 +759,20 @@ class SchedulerCache:
                 )
                 return False
             self.update_pod_status(pod_uid, TaskStatus.BINDING, node=node_name)
+        return True
+
+    def finish_bind(self, pod_uid: str, node_name: str) -> bool:
+        """Phase 2, wire side: the backend round trip plus its
+        success/failure bookkeeping.  Caller contract: `begin_bind`
+        already marked the pod BINDING.  Thread-safe — mutations under
+        the lock, the backend call outside it."""
+        with self._lock:
+            pod = self._pods.get(pod_uid)
+        if pod is None:
+            # Deleted while the flush was queued (the relist path
+            # drains the pipeline BEFORE clearing the mirror, so this
+            # is a plain racing delete): nothing to bind or roll back.
+            return False
         try:
             # Volumes first (≙ cache.go binding VolumeBinder.AllocateVolumes
             # + BindVolumes before the pod Binding subresource): a volume
@@ -734,11 +792,14 @@ class SchedulerCache:
             # The successful bind consumes the stamp.  update_pod_status
             # leaves stamps of BINDING pods alone (a wire backend's watch
             # echo of this very bind races us here), so the stamp is
-            # still present however the echo interleaved.
+            # still present however the echo interleaved.  With the
+            # pipelined commit the latency observation lands HERE, at
+            # the wire ack — not at the cycle's enqueue.
             ts = self._arrival_ts.pop(pod_uid, None)
             self.update_pod_status(pod_uid, TaskStatus.BOUND)
         if ts is not None:
             metrics.task_scheduling_latency.observe(time.monotonic() - ts)
+        metrics.pods_bound.inc()
         self.record_event("Pod", pod.name, "Bound", f"bound -> {node_name}",
                           namespace=pod.namespace)
         return True
@@ -803,6 +864,22 @@ class SchedulerCache:
     def update_job_status(self, group: PodGroup) -> None:
         if self.status_updater is None:
             return
+        commit = self.commit
+        if commit is not None:
+            # Pipelined: the wire write flushes off-thread, keyed by
+            # group so one PodGroup's successive status writes stay
+            # ordered while unrelated groups overlap their RTTs.  The
+            # flushed callable is the same funnel with the same
+            # swallow-transient + _status_retry semantics.
+            commit.submit(
+                f"group:{group.name}",
+                lambda: self._send_job_status(group),
+                verb="status",
+            )
+            return
+        self._send_job_status(group)
+
+    def _send_job_status(self, group: PodGroup) -> None:
         try:
             self.status_updater.update_pod_group(group)
         except Exception as exc:  # noqa: BLE001 — classified below

@@ -122,7 +122,22 @@ class IncrementalPacker:
 
     def pack(self):
         """(SnapshotTensors, SnapshotMeta) for the current cache state."""
+        from kube_batch_tpu.cache.cache import CacheResyncing
+
         with self.cache.lock():
+            if self.cache.is_resyncing():
+                # The quiesce guard cache.snapshot() applies, extended
+                # to INCREMENTAL packs (which never call snapshot):
+                # without it a mid-relist or breaker-open hold only
+                # quiesced full-rebuild cycles, and incremental cycles
+                # kept solving — hot-looping bind attempts into a dead
+                # wire and (pipelined) re-enqueueing commits the drain
+                # just cleared.  The journal is left intact; the first
+                # cycle after the hold releases packs everything.
+                raise CacheResyncing(
+                    "cache mirror is quiesced (mid-relist or breaker "
+                    "open); skip this cycle"
+                )
             d = self._dirty
             affected = set(d.groups)
             if self._snap is None or d.full:

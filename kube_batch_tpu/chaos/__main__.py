@@ -61,6 +61,16 @@ def build_parser() -> argparse.ArgumentParser:
                    help="deliberately force a double-bind at this tick "
                         "(invariant-checker self-test: the run MUST "
                         "fail and dump)")
+    p.add_argument("--wire-commit", choices=("sync", "pipelined"),
+                   default=None,
+                   help="commit dimension: 'pipelined' flushes binds/"
+                        "status writes through the asynchronous commit "
+                        "pipeline (per-pod ordering, drain barrier per "
+                        "tick, extra invariants: wire-write order, "
+                        "zero in-flight writes while the breaker is "
+                        "open, drained queue); default: the mode "
+                        "recorded in a replayed trace's meta header, "
+                        "else 'sync'")
     p.add_argument("--quiet", action="store_true",
                    help="suppress progress logging; print only the "
                         "summary JSON")
@@ -147,6 +157,7 @@ def main(argv: list[str] | None = None) -> int:
         trace_path=args.trace_out,
         dump_dir=args.dump_dir,
         corrupt_tick=args.corrupt_tick,
+        wire_commit=args.wire_commit,
     )
     try:
         result = engine.run()

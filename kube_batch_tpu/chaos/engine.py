@@ -109,6 +109,12 @@ _META_FAULT_FIELDS = (
     "blackhole_at", "blackhole_ticks", "hbm_pressure_at",
 )
 
+#: Commit-pipeline drain bound per tick (wall seconds): under a
+#: blackhole each queued op burns its wire timeout × retry attempts
+#: before the breaker trips and the rest fail fast, so the bound must
+#: cover a few serialized timeouts, not just the happy path.
+COMMIT_DRAIN_TIMEOUT = 60.0
+
 
 @dataclasses.dataclass
 class ChaosResult:
@@ -125,6 +131,10 @@ class ChaosResult:
     #: max ladder rung seen, final /healthz state, breaker open/close
     #: counts, swallowed requests, HBM refusals, binds-while-open.
     guardrail: dict | None = None
+    #: Commit-pipeline observability: mode, and (pipelined runs) the
+    #: pipeline's own stats — max depth, order violations (must be 0),
+    #: flush errors (must be 0), final depth after drain (must be 0).
+    commit: dict | None = None
 
     def summary(self) -> dict:
         return {
@@ -138,6 +148,7 @@ class ChaosResult:
             "converged_after_drain_ticks": self.converged_tick,
             "flight_recorder": self.dump_path,
             "guardrail": self.guardrail,
+            "commit": self.commit,
         }
 
 
@@ -177,11 +188,29 @@ class ChaosEngine:
         corrupt_tick: int | None = None,
         quiesce_timeout: float = 30.0,
         wire_timeout: float | None = None,
+        wire_commit: str | None = None,
     ) -> None:
         self.seed = seed
         self.ticks = ticks
         self.scenario = scenario or ScenarioSpec()
         self._preset_events = events   # a replayed trace, if any
+        # The pipelined dimension changes RUN behavior (commit flushes
+        # off-thread between run_once and the tick barrier), so like
+        # the guardrail windows it rides the trace meta header and is
+        # adopted on replay unless the caller overrides explicitly.
+        if wire_commit is None and events is not None:
+            meta = next(
+                (e for e in events if e.get("op") == "meta"), None
+            )
+            if meta is not None:
+                wire_commit = meta.get("wire_commit")
+        self.wire_commit = wire_commit or "sync"
+        if self.wire_commit not in ("sync", "pipelined"):
+            raise ValueError(
+                f"wire_commit must be 'sync' or 'pipelined', got "
+                f"{self.wire_commit!r}"
+            )
+        self.commit = None  # CommitPipeline, created in run()
         if faults is None and events is not None:
             # A recorded trace carries the recording's run-time fault
             # parameters in its "meta" header line; adopt them unless
@@ -494,6 +523,7 @@ class ChaosEngine:
             # fault field without the operator re-passing them.
             header = {
                 "tick": -1, "op": "meta", "seed": self.seed,
+                "wire_commit": self.wire_commit,
                 **{k: getattr(self.faults, k)
                    for k in _META_FAULT_FIELDS},
             }
@@ -525,6 +555,45 @@ class ChaosEngine:
         self.cache.binder = seam
         self.cache.evictor = seam
         self.cache.status_updater = seam
+        if self.wire_commit == "pipelined":
+            # The pipelined dimension: binds/status writes flush on the
+            # commit pipeline between run_once and this tick's drain
+            # barrier — the overlap is real (concurrent flush against
+            # the live wire stack), the barrier keeps same-seed ⇒
+            # same-hash (the decision log is drained per tick with the
+            # pipeline empty, and the logged binds ARE the commit
+            # acks).
+            from kube_batch_tpu.framework.commit import (
+                DEFAULT_WORKERS,
+                CommitPipeline,
+            )
+
+            on_flush = None
+            if self.guardrails is not None:
+                on_flush = lambda s: self.guardrails.observe_flush(  # noqa: E731
+                    s, cache=self.cache,
+                )
+            workers = DEFAULT_WORKERS
+            if self.faults.slow_at:
+                # A slow-but-ALIVE backend serializes its delayed
+                # responses, so N concurrent sends see up to N×delay
+                # of queueing before their own answer — full flush
+                # concurrency would turn the slow window into timeout
+                # storms, and a timed-out-but-server-committed bind
+                # retried through resync is the double-bind ambiguity.
+                # Clamp concurrency so worst-case queueing stays well
+                # inside the wire timeout (production guidance:
+                # doc/design/pipelined-commit.md · sizing).
+                workers = min(DEFAULT_WORKERS, max(1, int(
+                    (self.wire_timeout * 0.5)
+                    / max(self.faults.slow_response_s, 1e-6)
+                )))
+            self.commit = CommitPipeline(
+                cache=self.cache, on_flush=on_flush, workers=workers,
+            )
+            self.cache.commit = self.commit
+            if self.guardrails is not None:
+                self.guardrails.attach_commit(self.commit)
         if not self.adapter.wait_for_sync(self.quiesce_timeout):
             raise ChaosEngineError("initial LIST replay never synced")
         scheduler = Scheduler(
@@ -564,6 +633,19 @@ class ChaosEngine:
                 self._quiesce()
             if lead:
                 scheduler.run_once()
+                if self.commit is not None:
+                    # Tick barrier: every commit enqueued this cycle
+                    # must land (or fail into resync) before the
+                    # kubelet tick and the invariant check — the
+                    # determinism boundary of the pipelined dimension.
+                    # With the breaker open the queue fails fast, so a
+                    # timeout here is a harness failure, not a slow
+                    # wire.
+                    if not self.commit.drain(COMMIT_DRAIN_TIMEOUT):
+                        raise ChaosEngineError(
+                            "commit pipeline never drained at the "
+                            f"tick barrier (depth {self.commit.depth})"
+                        )
             else:
                 rec["stood-down"] = True
             if self.corrupt_tick is not None and t == self.corrupt_tick:
@@ -620,6 +702,8 @@ class ChaosEngine:
                     )
                 if not violations and self.faults.guardrail_faults:
                     violations = self._check_guardrails(ticks_run)
+                if not violations and self.commit is not None:
+                    violations = self._check_commit(ticks_run)
         finally:
             self._teardown()
 
@@ -663,6 +747,7 @@ class ChaosEngine:
             converged_tick=converged_tick,
             dump_path=dump_path,
             guardrail=self._guardrail_summary(),
+            commit=self._commit_summary(),
         )
 
     # -- guardrail invariants ------------------------------------------
@@ -688,6 +773,64 @@ class ChaosEngine:
                 total += self.cluster.bind_requests_by_tick.get(t, 0)
         return total
 
+    def _open_tick_writes(self) -> int:
+        """ALL write-verb requests (bind/evict/status; ping excluded —
+        it is the heal probe) received during fully-open breaker
+        ticks.  The pipelined commit must drain-then-quiesce on trip,
+        so this is zero: no queued flush may leak onto the wire while
+        the breaker is open."""
+        total = 0
+        for t, state in sorted(self._breaker_by_tick.items()):
+            if state == "open" and \
+                    self._breaker_by_tick.get(t - 1) == "open":
+                total += self.cluster.write_requests_by_tick.get(t, 0)
+        return total
+
+    def _check_commit(self, tick: int) -> list[Violation]:
+        """Pipelined-dimension assertions: per-pod wire-write order
+        preserved (pipeline self-check; the wire-log replay's
+        commit-order invariant covers the observable side), no op
+        escaped its failure funnel, and the queue is fully drained —
+        including through every breaker trip."""
+        out: list[Violation] = []
+        stats = self.commit.stats()
+        if stats["order_violations"]:
+            out.append(Violation(
+                "commit-order", tick,
+                f"{stats['order_violations']} op(s) of one ordering "
+                "key observed running concurrently — per-pod "
+                "wire-write order broken",
+            ))
+        if stats["flush_errors"]:
+            out.append(Violation(
+                "commit-flush-error", tick,
+                f"{stats['flush_errors']} flush op(s) raised past the "
+                "cache's failure funnels",
+            ))
+        if stats["depth"]:
+            out.append(Violation(
+                "commit-not-drained", tick,
+                f"{stats['depth']} commit op(s) still in flight after "
+                "the final drain barrier",
+            ))
+        writes_open = self._open_tick_writes()
+        if writes_open:
+            out.append(Violation(
+                "write-while-open", tick,
+                f"{writes_open} write request(s) reached the wire "
+                "during fully-open breaker ticks — the commit "
+                "pipeline did not drain-then-quiesce on trip",
+            ))
+        return out
+
+    def _commit_summary(self) -> dict | None:
+        base = {"mode": self.wire_commit}
+        if self.commit is None:
+            return base
+        base.update(self.commit.stats())
+        base["writes_while_open"] = self._open_tick_writes()
+        return base
+
     def _check_guardrails(self, tick: int) -> list[Violation]:
         """Post-run assertions that the self-protection layer actually
         engaged, quiesced, and recovered — violations ride the same
@@ -695,7 +838,7 @@ class ChaosEngine:
         out: list[Violation] = []
         rails = self.guardrails
         breaker = rails.breaker if rails is not None else None
-        if self.faults.slow_at and rails.watchdog.max_rung_seen < 1:
+        if self.faults.slow_at and rails.max_rung_seen < 1:
             out.append(Violation(
                 "ladder-never-engaged", tick,
                 "slow-backend window ran but the cycle watchdog never "
@@ -745,7 +888,7 @@ class ChaosEngine:
             return None
         breaker = rails.breaker
         return {
-            "max_rung_seen": rails.watchdog.max_rung_seen,
+            "max_rung_seen": rails.max_rung_seen,
             "final_state": rails.state,
             "final_breaker": rails.breaker_state(),
             "breaker_opened": breaker.opened_count if breaker else 0,
@@ -772,6 +915,11 @@ class ChaosEngine:
             }
 
     def _teardown(self) -> None:
+        if self.commit is not None:
+            try:
+                self.commit.close(timeout=COMMIT_DRAIN_TIMEOUT)
+            except Exception:  # noqa: BLE001 — best effort on the way down
+                pass
         try:
             if self._have_lease and self.backend is not None:
                 self.backend.release_lease(LEASE_HOLDER)

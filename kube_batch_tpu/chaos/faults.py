@@ -203,12 +203,21 @@ class ChaosCluster(ExternalCluster):
         #: tick the breaker spent fully open.
         self.bind_requests_by_tick: collections.Counter = \
             collections.Counter()
+        #: tick -> ALL write-verb requests received EXCEPT the breaker's
+        #: ping probe (bind/evict/updatePodGroup): the pipelined-commit
+        #: dimension strengthens the breaker-open invariant from "zero
+        #: binds" to "zero in-flight writes of any kind" — a status
+        #: flush leaking through an open breaker is the same bug.
+        self.write_requests_by_tick: collections.Counter = \
+            collections.Counter()
 
     def _handle(self, writer, msg: dict) -> None:
         verb = msg.get("verb")
         is_write = verb in self.WRITE_VERBS or "path" in msg
         if verb == "bind":
             self.bind_requests_by_tick[self.tick_now] += 1
+        if is_write and verb != "ping":
+            self.write_requests_by_tick[self.tick_now] += 1
         if is_write and self.blackhole:
             self.blackholed_requests += 1
             return  # swallowed: caller times out, nothing mutates

@@ -7,7 +7,11 @@ tick.  Checked invariants:
 
 1. **no-double-bind** — a bind accepted for a pod the model already
    holds placed (with no intervening unplacement) is a double bind:
-   the scheduler committed the same task twice.
+   the scheduler committed the same task twice.  The companion
+   **commit-order** check catches the pipelined-commit reordering
+   hazard: an injected first-attempt bind-fault arriving AFTER an
+   accepted bind for the same pod means a retry overtook its first
+   attempt on the wire (per-pod write order broken).
 2. **gang-readiness** — the first tick any member of a gang receives a
    bind attempt, the scheduler must have attempted at least
    ``min_member`` placements for that gang (attempts = accepted binds
@@ -100,6 +104,20 @@ class InvariantChecker:
                 if placed_before.get(group, 0) == 0 and \
                         group not in first_wave:
                     first_wave.add(group)
+            if op == "bind-fault" and uid in self._placed:
+                # Per-pod wire-write order: the injected fault fires
+                # only on a pod's FIRST bind attempt, so a bind-fault
+                # arriving while the model already holds the pod placed
+                # means a retry OVERTOOK its first attempt on the wire
+                # — exactly the reordering the commit pipeline's
+                # per-pod ordering keys exist to prevent.
+                violations.append(Violation(
+                    "commit-order", tick,
+                    f"bind-fault for pod {uid} arrived after an "
+                    f"accepted bind on {self._placed[uid]} — a retry "
+                    "overtook its first attempt (per-pod wire-write "
+                    "order broken)",
+                ))
             if op == "bind":
                 if uid in self._placed:
                     violations.append(Violation(

@@ -77,6 +77,23 @@ def build_parser() -> argparse.ArgumentParser:
                         "(≙ a serviceaccount token)")
     p.add_argument("--kube-insecure", action="store_true",
                    help="skip TLS verification for --kube-api (dev only)")
+    p.add_argument("--wire-commit", choices=("pipelined", "sync"),
+                   default=("sync"
+                            if os.environ.get("KB_TPU_WIRE_COMMIT")
+                            == "sync" else "pipelined"),
+                   help="wire-mode commit strategy: 'pipelined' "
+                        "(default) ends the cycle when the cache "
+                        "mutations land and flushes bind/status/event "
+                        "round trips on a bounded per-pod-ordered "
+                        "queue, overlapping cycle N's RTTs with cycle "
+                        "N+1's solve; 'sync' (or env "
+                        "KB_TPU_WIRE_COMMIT=sync) blocks the cycle on "
+                        "every write.  The in-process simulator path "
+                        "always commits inline")
+    p.add_argument("--commit-inflight-max", type=int, default=256,
+                   help="bound on queued+running pipelined commit ops; "
+                        "past it the solve pauses instead of the "
+                        "queue growing (doc/design/pipelined-commit.md)")
     p.add_argument("--write-format", choices=("native", "k8s"),
                    default="native",
                    help="wire dialect for scheduling decisions: 'k8s' "
@@ -149,6 +166,33 @@ def build_guardrails(args):
         breaker_failures=args.breaker_failures,
         breaker_reset_s=args.breaker_reset,
     ))
+
+
+def build_commit_pipeline(args, cache, guardrails):
+    """The asynchronous wire-commit pipeline (framework/commit.py) for
+    a wire-mode daemon, or None under --wire-commit sync.  Attached to
+    the cache (which routes bind/status/event flushes through it) and
+    to the guardrails (breaker-open drain + the flush watchdog via
+    on_flush).  The caller owns shutdown: `close()` on every exit
+    path."""
+    if args.wire_commit != "pipelined":
+        return None
+    from kube_batch_tpu.framework.commit import CommitPipeline
+
+    commit = CommitPipeline(
+        cache=cache,
+        max_inflight=args.commit_inflight_max,
+        on_flush=lambda s: guardrails.observe_flush(
+            s, cache=cache, period=args.schedule_period,
+        ),
+    )
+    cache.commit = commit
+    guardrails.attach_commit(commit)
+    logging.info(
+        "wire commit: pipelined (inflight max %d; "
+        "KB_TPU_WIRE_COMMIT=sync opts out)", args.commit_inflight_max,
+    )
+    return commit
 
 
 def load_world(spec_arg: str | None, default_queue: str,
@@ -343,6 +387,7 @@ def run_external(args) -> int:
     cache.binder = guarded
     cache.evictor = guarded
     cache.status_updater = guarded
+    commit = build_commit_pipeline(args, cache, guardrails)
     if args.write_format == "k8s":
         # Events leave the process too in k8s mode (≙ the Recorder).
         cache.event_sink = guarded
@@ -463,6 +508,14 @@ def run_external(args) -> int:
     except KeyboardInterrupt:
         logging.info("interrupted; shutting down")
     finally:
+        # The final cycle's wire flushes land before the socket dies —
+        # the same drain-on-every-exit-path discipline as the growth
+        # compile threads and the bind fan-out pool.
+        if commit is not None:
+            commit.close(timeout=10.0)
+        from kube_batch_tpu.framework.session import shutdown_bind_pool
+
+        shutdown_bind_pool()
         if elector is not None:
             elector.release()
         state["sock"].close()
@@ -510,6 +563,7 @@ def run_http(args) -> int:
     cache.status_updater = guarded
     cache.event_sink = guarded
     cache.k8s_write_format = True  # HTTP writes ARE the apiserver dialect
+    commit = build_commit_pipeline(args, cache, guardrails)
     mux = HttpWatchMux(client).start()
     backend.follow_served_versions(mux)
     adapter = K8sWatchAdapter(
@@ -548,7 +602,14 @@ def run_http(args) -> int:
     finally:
         # The final cycle's events (evictions, unschedulable
         # diagnoses) are still on the async flusher's queue; give them
-        # a bounded chance to land before the daemon thread dies.
+        # a bounded chance to land before the daemon thread dies.  The
+        # commit pipeline drains FIRST — its flushes feed the event
+        # funnel.
+        if commit is not None:
+            commit.close(timeout=10.0)
+        from kube_batch_tpu.framework.session import shutdown_bind_pool
+
+        shutdown_bind_pool()
         backend.drain_events(5.0)
         mux.close()
         if elector is not None:

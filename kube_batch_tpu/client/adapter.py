@@ -317,7 +317,23 @@ def resume_session(
         # Stateless recovery IN-PROCESS: drop the mirror, re-list,
         # keep the Scheduler + compiled executables.
         log.warning("watch gap (%s); re-listing in-process", exc)
+        # QUIESCE FIRST, then drain: the scheduler keeps cycling on its
+        # own thread during a supervise()-driven reconnect, so a drain
+        # taken before the relist hold could complete and then watch a
+        # fresh cycle enqueue new pipelined binds in the gap before
+        # clear().  With the hold up, new cycles skip (CacheResyncing),
+        # and the drain flushes the in-flight tail — a bind completing
+        # against objects the clear() is about to erase would land in
+        # the re-listed mirror as a stale write.  begin_relist is
+        # idempotent, so the end_relist below (or a retry's) still
+        # balances it.
         cache.begin_relist()
+        commit = getattr(cache, "commit", None)
+        if commit is not None and not commit.drain(timeout=30.0):
+            log.warning(
+                "commit pipeline still draining before relist "
+                "(depth %d)", commit.depth,
+            )
         cache.clear()
         backend.request_list()
         mode = "relisted"

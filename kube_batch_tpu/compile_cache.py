@@ -13,27 +13,78 @@ recompiling it.
 
 Enabled by default from the CLI and the benchmark; disable with
 `--compile-cache-dir ""` or KB_TPU_COMPILE_CACHE="".
+
+The cache directory is FINGERPRINTED by host/backend signature
+(machine arch, CPU feature flags, jax version, pinned platform): XLA's
+persistent cache keys on the HLO, not on the machine that compiled it,
+so a cache directory shared across heterogeneous hosts (NFS homedirs,
+a bench artifact rsync'd between machines) replays CPU-AOT executables
+compiled for a DIFFERENT microarchitecture — at best a flood of
+`cpu_aot_loader` machine-feature warnings drowning every log tail
+(bench r05's artifact ended `"parsed": null` exactly that way), at
+worst a SIGILL on an instruction the replaying host lacks.  Each
+distinct host signature gets its own `hw-<fingerprint>` subdirectory,
+so entries can only ever replay on a machine whose features match the
+one that wrote them.
 """
 
 from __future__ import annotations
 
+import functools
+import hashlib
 import logging
 import os
+import platform
 
 DEFAULT_DIR = "/tmp/kube-batch-tpu-xla-cache"
 
 log = logging.getLogger(__name__)
 
 
+@functools.lru_cache(maxsize=1)
+def host_fingerprint() -> str:
+    """Stable 12-hex-char signature of everything that makes a
+    persisted executable host-portable or not: machine arch + OS, the
+    CPU feature flags (the cpu_aot_loader / SIGILL axis), the jax
+    version (cache format + lowering changes), and the pinned platform
+    (a cpu-pinned daemon and a tpu-tunnel daemon must not share
+    entries).  Deliberately avoids touching jax's backend — probing
+    devices here could hang startup on a wedged tunnel."""
+    parts = [
+        platform.machine(),
+        platform.system(),
+        os.environ.get("JAX_PLATFORMS", ""),
+    ]
+    try:
+        import jax
+
+        parts.append(getattr(jax, "__version__", "unknown"))
+    except Exception:  # noqa: BLE001 — fingerprint must never fail
+        parts.append("no-jax")
+    try:
+        with open("/proc/cpuinfo", encoding="utf-8") as f:
+            for line in f:
+                # x86 exposes "flags", aarch64 "Features" — either is
+                # the exact instruction-set surface AOT code depends on.
+                if line.lower().startswith(("flags", "features")):
+                    parts.append(line.split(":", 1)[-1].strip())
+                    break
+    except OSError:
+        parts.append("no-cpuinfo")
+    return hashlib.sha256("|".join(parts).encode()).hexdigest()[:12]
+
+
 def enable_compile_cache(path: str | None = None) -> str | None:
-    """Point jax's persistent compilation cache at `path` (or the
-    KB_TPU_COMPILE_CACHE env var, or the default tmp dir).  Returns the
-    directory in use, or None when disabled/unavailable.  Safe to call
-    more than once; must be called before the first big jit to help."""
+    """Point jax's persistent compilation cache at the host-fingerprinted
+    subdirectory of `path` (or the KB_TPU_COMPILE_CACHE env var, or the
+    default tmp dir).  Returns the directory in use, or None when
+    disabled/unavailable.  Safe to call more than once; must be called
+    before the first big jit to help."""
     if path is None:
         path = os.environ.get("KB_TPU_COMPILE_CACHE", DEFAULT_DIR)
     if not path:
         return None
+    path = os.path.join(path, f"hw-{host_fingerprint()}")
     try:
         import jax
 

@@ -1,0 +1,387 @@
+"""Asynchronous wire-commit pipeline: overlap commit RTTs with the
+next cycle's solve.
+
+In wire mode the steady-state cycle is dominated by the COMMIT tail:
+`close_session` used to block on every bind round trip (~68 ms RTT
+each through the tunnel), then the PodGroup status refresh, then the
+event sink, before the next cycle could pack.  The reference scheduler
+never waits like that — its bind goroutines return before the
+apiserver acks (cache.go · Bind) — and our cache already supports the
+same structurally: `cache.begin_bind` marks BINDING under the lock
+BEFORE the wire call, and failures funnel into the resync queue.  This
+module is the missing piece: the wall-clock cycle ends when the cache
+mutations land, and the wire RTTs of cycle N flush on worker threads
+while cycle N+1 packs and solves.
+
+Semantics:
+
+* **Per-key FIFO ordering.**  Every op carries an ordering key (a
+  pod's bind flush keys on ``pod:<uid>``, a PodGroup status write on
+  ``group:<name>``, event-sink forwards on ``events``).  Ops sharing a
+  key execute strictly in submission order, at most one in flight —
+  so a pod's BINDING → wire-bind → rollback/ack sequence can never
+  reorder on the wire — while unrelated keys flush concurrently
+  across the worker pool.
+
+* **Bounded, with backpressure.**  At most ``max_inflight`` ops may be
+  queued+running; a `submit` past the bound BLOCKS the caller (the
+  scheduler's commit enqueue — so the solve pauses instead of the
+  queue growing without bound).  Submissions from a flush worker
+  itself (e.g. the Bound event a bind ack records) bypass the wait:
+  blocking a worker on the queue it drains would deadlock the pool.
+
+* **Failure semantics are the cache's.**  The flushed callables are
+  the cache's own funnels (`finish_bind`, `_send_job_status`,
+  `_send_event`), which already classify transport vs app errors,
+  roll back to PENDING + resync on a failed bind, mark
+  `_status_retry` on a swallowed status write, and observe
+  `task_scheduling_latency` at the wire ack.  An op that still raises
+  is a bug: logged with stack, counted in ``flush_errors``, and the
+  worker survives.
+
+* **Breaker interplay.**  The guardrail breaker/backoff wraps the
+  backend the flushed funnels call, so retry + trip accounting happen
+  on the flush side.  When the breaker trips open, queued ops fail
+  fast (`BreakerOpen` never touches the wire) and drain into the
+  resync queue; the scheduler's quiesced-skip path and
+  `Guardrails.pre_cycle` then `drain()` the remainder, so an open
+  breaker means ZERO in-flight wire writes — the chaos invariant.
+
+* **Drain on every exit path.**  `drain()` blocks until the queue is
+  empty (quiesce/relist in `client.adapter.resume_session`, the
+  scheduler loop's exit, the chaos engine's per-tick barrier);
+  `close()` drains then stops the workers, and is also registered
+  atexit with the same bounded-join discipline as the growth-compile
+  threads and the bind fan-out pool — no flush thread may race
+  interpreter teardown.  A closed pipeline degrades to synchronous
+  inline execution, never drops a commit.
+
+Batch accounting: `begin_cycle()` seals the previous cycle's ops into
+a batch; when a sealed batch's last op completes, its flush latency
+(first enqueue → last completion) is reported through ``on_flush`` —
+the guardrail facade feeds it to a SECOND watchdog, so a slow wire
+degrades the ladder even though cycles now return fast.  Per-op
+latencies land in ``commit_flush_latency_seconds``; ``cycle_overlap_
+ratio`` tracks the fraction of flush busy-time hidden behind in-cycle
+compute.
+
+Design doc: doc/design/pipelined-commit.md.
+"""
+
+from __future__ import annotations
+
+import atexit
+import collections
+import logging
+import threading
+import time
+
+from kube_batch_tpu import metrics
+
+log = logging.getLogger(__name__)
+
+#: Default bound on queued+running ops (--commit-inflight-max).  Sized
+#: for a large gang commit (hundreds of binds) without letting a dead
+#: wire accumulate an unbounded backlog: past this, the enqueue (and
+#: therefore the next solve) waits.
+DEFAULT_INFLIGHT_MAX = 256
+#: Flush fan-out width — matches Session.BIND_WORKERS (the reference's
+#: 16-worker bind pools): each op through a wire backend is a full
+#: round trip, and unrelated keys should overlap theirs.
+DEFAULT_WORKERS = 16
+
+_worker_tls = threading.local()
+
+
+class _Op:
+    __slots__ = ("key", "verb", "fn", "enqueued_at", "batch")
+
+    def __init__(self, key, verb, fn, enqueued_at, batch):
+        self.key = key
+        self.verb = verb
+        self.fn = fn
+        self.enqueued_at = enqueued_at
+        self.batch = batch
+
+
+class CommitPipeline:
+    """Bounded in-flight commit queue with per-key ordering.
+
+    One instance per wire-mode daemon, shared by the cache (which
+    routes bind/status/event flushes through it when its ``commit``
+    attribute is set) and the scheduler loop (cycle batching, overlap
+    accounting, drain on quiesce).
+    """
+
+    def __init__(
+        self,
+        cache=None,
+        max_inflight: int = DEFAULT_INFLIGHT_MAX,
+        workers: int = DEFAULT_WORKERS,
+        name: str = "commit",
+        on_flush=None,
+    ) -> None:
+        self._cache = cache
+        self.max_inflight = max(int(max_inflight), 1)
+        self._nworkers = max(int(workers), 1)
+        self.name = name
+        self._on_flush = on_flush
+        self._cv = threading.Condition()
+        self._queues: dict[str, collections.deque] = {}   # key -> FIFO
+        self._ready: collections.deque[str] = collections.deque()
+        self._running_keys: dict[str, int] = {}
+        self._pending = 0            # submitted, not yet completed
+        self._closed = False
+        self._threads: list[threading.Thread] = []
+        # -- cycle batches (flush-latency attribution) ------------------
+        self._batch_seq = 0
+        self._batches: dict[int, dict] = {
+            0: {"pending": 0, "first": None, "last": None, "sealed": False}
+        }
+        self.batches_completed = 0
+        # -- stats (chaos invariants + observability) -------------------
+        self.max_depth_seen = 0
+        #: Two ops of one key observed running concurrently — the
+        #: per-pod wire-order guarantee broken.  Structurally
+        #: impossible; counted so the chaos engine can ASSERT it.
+        self.order_violations = 0
+        self.flush_errors = 0
+        self.backpressure_waits = 0
+        self._flush_busy_s = 0.0
+        self._overlap_busy_s = 0.0
+        self._solving = False
+        # Same teardown discipline as the growth-compile threads and
+        # the bind fan-out pool: a flush thread alive at interpreter
+        # teardown must not race the dying runtime.
+        atexit.register(self._atexit_close)
+
+    # -- submission seams ------------------------------------------------
+    def submit_bind(self, pod_uid: str, node_name: str) -> None:
+        """Flush one bind's wire round trip (the cache already marked
+        the pod BINDING on the cycle thread via `begin_bind`)."""
+        cache = self._cache
+        self.submit(
+            f"pod:{pod_uid}",
+            lambda: cache.finish_bind(pod_uid, node_name),
+            verb="bind",
+        )
+
+    def submit(self, key: str, fn, verb: str = "write"):
+        """Enqueue one flush op under `key`.  Blocks while the queue is
+        at ``max_inflight`` (backpressure — unless called FROM a flush
+        worker, which must never wait on its own pool).  On a closed
+        pipeline the op runs inline, synchronously: shutdown degrades
+        to the sync commit path, never to a dropped write."""
+        in_worker = getattr(_worker_tls, "active", False)
+        with self._cv:
+            blocked = False
+            while (
+                not self._closed
+                and not in_worker
+                and self._pending >= self.max_inflight
+            ):
+                if not blocked:
+                    blocked = True
+                    self.backpressure_waits += 1
+                    metrics.commit_backpressure_waits.inc()
+                self._cv.wait()
+            if self._closed:
+                run_inline = True
+            else:
+                run_inline = False
+                now = time.monotonic()
+                b = self._batches[self._batch_seq]
+                if b["first"] is None:
+                    b["first"] = now
+                b["pending"] += 1
+                op = _Op(key, verb, fn, now, self._batch_seq)
+                q = self._queues.get(key)
+                if q is None:
+                    q = self._queues[key] = collections.deque()
+                    self._running_keys.setdefault(key, 0)
+                q.append(op)
+                if self._running_keys[key] == 0 and len(q) == 1:
+                    self._ready.append(key)
+                self._pending += 1
+                self.max_depth_seen = max(self.max_depth_seen, self._pending)
+                metrics.commit_queue_depth.set(float(self._pending))
+                if len(self._threads) < self._nworkers:
+                    self._spawn_workers_locked()
+                self._cv.notify()
+        if run_inline:
+            return fn()
+        return None
+
+    def _spawn_workers_locked(self) -> None:
+        while len(self._threads) < self._nworkers:
+            t = threading.Thread(
+                target=self._worker,
+                name=f"commit-flush-{len(self._threads)}",
+                daemon=True,
+            )
+            self._threads.append(t)
+            t.start()
+
+    # -- the flush loop --------------------------------------------------
+    def _worker(self) -> None:
+        _worker_tls.active = True
+        while True:
+            with self._cv:
+                while not self._ready:
+                    if self._closed and self._pending == 0:
+                        return
+                    self._cv.wait(0.1 if self._closed else None)
+                key = self._ready.popleft()
+                op = self._queues[key].popleft()
+                self._running_keys[key] += 1
+                if self._running_keys[key] > 1:  # pragma: no cover —
+                    # structurally impossible; counted for the chaos
+                    # engine's commit-order assertion.
+                    self.order_violations += 1
+            started = time.monotonic()
+            overlapped = self._solving
+            try:
+                op.fn()
+            except Exception:  # noqa: BLE001 — the flushed funnels own
+                # their failure semantics (rollback/resync/_status_retry);
+                # anything escaping is a bug, but the worker must survive.
+                self.flush_errors += 1
+                metrics.commit_flush_errors.inc()
+                log.exception(
+                    "commit flush op (%s %s) raised unexpectedly",
+                    op.verb, op.key,
+                )
+            done = time.monotonic()
+            metrics.commit_flush_latency.observe(
+                done - op.enqueued_at, op.verb
+            )
+            finalize = None
+            with self._cv:
+                self._running_keys[key] -= 1
+                q = self._queues.get(key)
+                if q:
+                    self._ready.append(key)
+                elif self._running_keys.get(key) == 0:
+                    self._queues.pop(key, None)     # keys are pod uids:
+                    self._running_keys.pop(key, None)  # don't leak them
+                self._pending -= 1
+                metrics.commit_queue_depth.set(float(self._pending))
+                dur = done - started
+                self._flush_busy_s += dur
+                if overlapped or self._solving:
+                    self._overlap_busy_s += dur
+                if self._flush_busy_s > 0.0:
+                    metrics.cycle_overlap_ratio.set(
+                        self._overlap_busy_s / self._flush_busy_s
+                    )
+                b = self._batches.get(op.batch)
+                if b is not None:
+                    b["pending"] -= 1
+                    b["last"] = done
+                    if b["sealed"] and b["pending"] == 0:
+                        first = b["first"] if b["first"] is not None else done
+                        finalize = done - first
+                        del self._batches[op.batch]
+                        self.batches_completed += 1
+                self._cv.notify_all()
+            if finalize is not None:
+                self._fire_on_flush(finalize)
+
+    def _fire_on_flush(self, latency: float) -> None:
+        if self._on_flush is None:
+            return
+        try:
+            self._on_flush(latency)
+        except Exception:  # noqa: BLE001 — observability must not kill flush
+            log.exception("commit on_flush callback failed")
+
+    # -- cycle hooks (scheduler loop) -----------------------------------
+    def begin_cycle(self) -> None:
+        """Seal the previous cycle's ops into a batch (its flush
+        latency reports through ``on_flush`` when the last op lands)
+        and open a fresh one for this cycle's enqueues."""
+        finalize = None
+        with self._cv:
+            b = self._batches.get(self._batch_seq)
+            if b is not None:
+                b["sealed"] = True
+                if b["pending"] == 0:
+                    if b["first"] is not None:
+                        finalize = (b["last"] or b["first"]) - b["first"]
+                        self.batches_completed += 1
+                    del self._batches[self._batch_seq]
+            self._batch_seq += 1
+            self._batches[self._batch_seq] = {
+                "pending": 0, "first": None, "last": None, "sealed": False,
+            }
+        if finalize is not None:
+            self._fire_on_flush(finalize)
+
+    def note_solve(self, active: bool) -> None:
+        """Scheduler hook bracketing in-cycle compute: flush busy-time
+        spent while set is OVERLAPPED (hidden) work — the numerator of
+        `cycle_overlap_ratio`."""
+        with self._cv:
+            self._solving = bool(active)
+
+    # -- drain / shutdown ------------------------------------------------
+    @property
+    def depth(self) -> int:
+        with self._cv:
+            return self._pending
+
+    def idle(self) -> bool:
+        return self.depth == 0
+
+    def drain(self, timeout: float | None = None) -> bool:
+        """Block until every submitted op completed (True), or the
+        timeout expires with work still in flight (False).  Never call
+        from a flush worker."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cv:
+            while self._pending > 0:
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return False
+                    self._cv.wait(remaining)
+                else:
+                    self._cv.wait(1.0)
+            return True
+
+    def close(self, timeout: float = 10.0) -> bool:
+        """Drain (bounded), then stop the workers.  Later submits run
+        inline.  Returns whether the drain completed."""
+        ok = self.drain(timeout)
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+        for t in self._threads:
+            t.join(1.0)
+        # A closed pipeline no longer needs the teardown hook — and the
+        # hook's strong reference would otherwise pin this pipeline
+        # (and the whole cache world its closures capture) for process
+        # lifetime across repeated chaos/bench/test constructions.
+        atexit.unregister(self._atexit_close)
+        return ok
+
+    def _atexit_close(self) -> None:
+        try:
+            self.close(timeout=5.0)
+        except Exception:  # noqa: BLE001 — best effort on the way down
+            pass
+
+    def stats(self) -> dict:
+        with self._cv:
+            return {
+                "max_depth_seen": self.max_depth_seen,
+                "depth": self._pending,
+                "order_violations": self.order_violations,
+                "flush_errors": self.flush_errors,
+                "backpressure_waits": self.backpressure_waits,
+                "batches_completed": self.batches_completed,
+                "overlap_ratio": (
+                    self._overlap_busy_s / self._flush_busy_s
+                    if self._flush_busy_s > 0.0 else 0.0
+                ),
+            }

@@ -17,6 +17,7 @@ the configured actions.  Cluster effects happen only at two funnels:
 
 from __future__ import annotations
 
+import atexit
 import itertools
 from typing import Sequence
 
@@ -49,6 +50,24 @@ def _bind_pool():
             thread_name_prefix="bind-dispatch",
         )
     return _BIND_POOL
+
+
+def shutdown_bind_pool(wait: bool = False) -> None:
+    """Tear down the process-global bind fan-out pool.  Registered
+    atexit (and callable explicitly by daemon shutdown paths) so a
+    worker mid-wire-call cannot race interpreter teardown the way the
+    growth-compile threads once did — queued-but-unstarted binds are
+    cancelled, and a later `_bind_pool()` call simply builds a fresh
+    pool.  The commit pipeline's flush executor applies the same
+    discipline (framework/commit.py · CommitPipeline.close, also
+    atexit-registered)."""
+    global _BIND_POOL
+    pool, _BIND_POOL = _BIND_POOL, None
+    if pool is not None:
+        pool.shutdown(wait=wait, cancel_futures=True)
+
+
+atexit.register(shutdown_bind_pool)
 
 _session_counter = itertools.count()
 
@@ -267,11 +286,21 @@ class Session:
     def dispatch_binds(self) -> list[tuple[str, str]]:
         """Bind every newly allocated task of every JobReady job
         (gang commit; ≙ session.go · Allocate's deferred dispatch).
-        Large batches fan out over a thread pool; `cache.bind` is
+
+        With an asynchronous commit pipeline attached to the cache
+        (`cache.commit`, wire mode's default), each bind's CACHE half
+        lands here synchronously (`begin_bind` marks BINDING, so the
+        next cycle's pack can never re-place the pod) and the wire
+        round trip flushes on the pipeline keyed by pod uid — the
+        cycle's `bind_dispatch` phase is then ENQUEUE time, and cycle
+        N's RTTs overlap cycle N+1's solve.
+
+        Synchronous path (simulator, --wire-commit sync): large
+        batches fan out over a thread pool; `cache.bind` is
         thread-safe (mutations under the cache lock, the backend call
         outside it) and result ORDER is preserved, so `self.bound` is
-        deterministic either way.  Bookkeeping (bound list, metrics,
-        refresh groups) stays on this thread."""
+        deterministic either way.  Bookkeeping (bound list, refresh
+        groups) stays on this thread."""
         task_state = self.host_task_state()
         task_node = self.host_task_node()
         task_job = self.host_snap_field("task_job")
@@ -298,6 +327,20 @@ class Session:
                 self.meta.node_names[task_node[t]],
             ))
 
+        commit = getattr(self.cache, "commit", None)
+        if commit is not None:
+            # Pipelined: the cache mutation is the cycle's commit; the
+            # wire RTT flushes later.  A pod whose begin_bind refused
+            # (deleted, or its node vanished) is already resynced by
+            # the cache — same outcome as a failed sync bind.
+            for pod, node_name in to_bind:
+                if not self.cache.begin_bind(pod.uid, node_name):
+                    continue
+                commit.submit_bind(pod.uid, node_name)
+                self.bound.append((pod.name, node_name))
+                if self._refresh_groups is not None and pod.group:
+                    self._refresh_groups.add(pod.group)
+            return self.bound
         if len(to_bind) > self._BIND_POOL_THRESHOLD:
             results = list(_bind_pool().map(
                 lambda a: self.cache.bind(a[0].uid, a[1]), to_bind
@@ -311,7 +354,6 @@ class Session:
                 self.bound.append((pod.name, node_name))
                 if self._refresh_groups is not None and pod.group:
                     self._refresh_groups.add(pod.group)
-                metrics.pods_bound.inc()
         return self.bound
 
     # -- introspection for plugins' close hooks ------------------------

@@ -137,9 +137,22 @@ class Guardrails:
             recover_after=self.config.watchdog_recovery,
             factor=self.config.watchdog_factor,
         )
+        # With the pipelined wire commit, cycle wall latency no longer
+        # carries the wire's health (the cycle ends at enqueue): flush
+        # latency is its own overload signal, observed by its own
+        # ladder instance.  Effects (prewarm pause, diagnosis shed,
+        # period stretch, /healthz) read the COMBINED rung — see the
+        # `rung` property.
+        self.flush_watchdog = CycleWatchdog(
+            period=self.config.watchdog_period,
+            engage_after=self.config.watchdog_overruns,
+            recover_after=self.config.watchdog_recovery,
+            factor=self.config.watchdog_factor,
+        )
         self.breaker: CircuitBreaker | None = None
         self._guarded: GuardedBackend | None = None
         self._cache = None  # quiesce target once a backend is guarded
+        self._commit = None  # CommitPipeline once attach_commit wires one
         #: True while the scheduler's current snapshot shapes require
         #: a program the HBM-ceiling admission refused — the solve is
         #: paused, so /healthz floors at "degraded".
@@ -198,6 +211,15 @@ class Guardrails:
             attempts=self.config.backoff_attempts,
         )
 
+    def attach_commit(self, pipeline) -> None:
+        """Wire the asynchronous commit pipeline: pre_cycle drains it
+        while the breaker is not closed (trip-open drains then
+        quiesces — every queued op fails fast via BreakerOpen into the
+        resync queue, so an open breaker means ZERO in-flight wire
+        writes), and its per-cycle flush latency should be fed to
+        `observe_flush` by the pipeline's on_flush callback."""
+        self._commit = pipeline
+
     # -- /healthz publication -------------------------------------------
     def _publish_health(self) -> None:
         """The /healthz body is the ladder rung FLOORED at "degraded"
@@ -205,7 +227,7 @@ class Guardrails:
         or the HBM ceiling is blocking the solve): a dead backend or a
         paused solve is degradation regardless of how fast the skipped
         cycles run, and probes/runbooks must not read "ok" mid-outage."""
-        rung = self.watchdog.rung
+        rung = self.rung
         if self._hbm_blocked or (
             self.breaker is not None
             and self.breaker.state != CircuitBreaker.CLOSED
@@ -271,6 +293,17 @@ class Guardrails:
         breaker = self.breaker
         if breaker is None or breaker.state == CircuitBreaker.CLOSED:
             return
+        if self._commit is not None:
+            # Trip-open drains then quiesces: every queued flush op
+            # fails fast (BreakerOpen never touches the wire) into the
+            # resync queue, so by the time scheduling is quiesced the
+            # pipeline holds zero in-flight writes.  Runs on the
+            # scheduler thread — never from a flush worker.
+            if not self._commit.drain(timeout=30.0):
+                log.warning(
+                    "commit pipeline still draining with the breaker "
+                    "open (depth %d)", self._commit.depth,
+                )
         if not breaker.allow():
             return  # still inside the open window
         inner = self._guarded.inner if self._guarded is not None else None
@@ -311,37 +344,75 @@ class Guardrails:
         changed = self.watchdog.observe(cycle_s, period=period)
         if changed is None:
             return
-        state = RUNGS[self.watchdog.rung]
+        self._ladder_transition(
+            "cycle watchdog", changed, cycle_s,
+            self.watchdog, cache=cache, period=period,
+        )
+
+    def observe_flush(self, flush_s: float, cache=None,
+                      period: float | None = None) -> None:
+        """Feed one cycle-batch's commit-flush latency (enqueue of its
+        first op → ack of its last) to the FLUSH watchdog — with the
+        pipelined commit this is where a slow or dying wire shows up,
+        because the cycle itself now ends at enqueue.  Called from a
+        flush worker via the pipeline's on_flush callback, and by the
+        scheduler with 0.0 on cycles where the pipeline sat idle (an
+        idle flush IS healthy — without that, a recovered daemon with
+        nothing to commit could never walk the flush ladder back
+        down)."""
+        changed = self.flush_watchdog.observe(flush_s, period=period)
+        if changed is None:
+            return
+        self._ladder_transition(
+            "commit-flush watchdog", changed, flush_s,
+            self.flush_watchdog, cache=cache, period=period,
+        )
+
+    def _ladder_transition(self, who, changed, latency_s, watchdog,
+                           cache=None, period=None) -> None:
+        state = RUNGS[self.rung]
+        # The gauge carries the COMBINED rung (the watchdog instance
+        # published its own; with two ladders the facade's max wins).
+        metrics.guardrail_state.set(float(self.rung))
         self._publish_health()
-        if self.watchdog.rung > changed[0]:
+        if watchdog.rung > changed[0]:
             log.error(
-                "cycle watchdog: %d consecutive overruns (last %.3fs "
-                "vs period %.3fs); degradation ladder → %r (growth "
-                "prewarm paused%s)",
-                self.config.watchdog_overruns, cycle_s,
-                self.watchdog.effective_period(period), state,
+                "%s: %d consecutive overruns (last %.3fs vs period "
+                "%.3fs); degradation ladder → %r (growth prewarm "
+                "paused%s)",
+                who, self.config.watchdog_overruns, latency_s,
+                watchdog.effective_period(period), state,
                 "; diagnosis skipped, period stretched"
-                if self.watchdog.rung >= 2 else "",
+                if self.rung >= 2 else "",
             )
         else:
             log.warning(
-                "cycle watchdog: %d consecutive healthy cycles; "
-                "recovery → %r", self.config.watchdog_recovery, state,
+                "%s: %d consecutive healthy cycles; recovery → %r",
+                who, self.config.watchdog_recovery, state,
             )
         if cache is not None:
             cache.record_event(
                 "Scheduler", "watchdog", "GuardrailStateChanged",
-                f"degradation ladder {RUNGS[changed[0]]} -> {state}",
+                f"degradation ladder ({who}) {RUNGS[changed[0]]} -> "
+                f"{RUNGS[watchdog.rung]}",
             )
 
     # -- ladder effect queries ------------------------------------------
     @property
     def rung(self) -> int:
-        return self.watchdog.rung
+        """Combined degradation rung: the worse of cycle latency and
+        commit-flush latency — either signal alone is overload."""
+        return max(self.watchdog.rung, self.flush_watchdog.rung)
+
+    @property
+    def max_rung_seen(self) -> int:
+        return max(
+            self.watchdog.max_rung_seen, self.flush_watchdog.max_rung_seen
+        )
 
     @property
     def state(self) -> str:
-        return RUNGS[self.watchdog.rung]
+        return RUNGS[self.rung]
 
     def pause_prewarm(self) -> bool:
         """rung ≥ 1: background next-bucket compiles pause — an
@@ -349,20 +420,20 @@ class Guardrails:
         is behind (they resume on recovery; the boundary cycle then
         joins or pays the compile, which is the pre-guardrail
         behavior, not a new failure mode)."""
-        return self.watchdog.rung >= 1
+        return self.rung >= 1
 
     def skip_diagnosis(self) -> bool:
         """rung ≥ 2: the per-pod why-unschedulable diagnosis fan-out
         (events + conditions, O(pending) host work) is optional
         observability and the first work shed when overloaded."""
-        return self.watchdog.rung >= 2
+        return self.rung >= 2
 
     def period_multiplier(self) -> float:
         """rung ≥ 2: the daemon loop stretches its effective period —
         scheduling less often batches more work per cycle, the direct
         analog of the reference's serial shedding (pods simply stay
         Pending past the period)."""
-        return 2.0 if self.watchdog.rung >= 2 else 1.0
+        return 2.0 if self.rung >= 2 else 1.0
 
     def breaker_state(self) -> str:
         return self.breaker.state if self.breaker is not None \

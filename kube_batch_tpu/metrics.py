@@ -250,10 +250,42 @@ cycle_phase_latency = REGISTRY.register(Histogram(
     "Within-cycle phase attribution (VERDICT r4 #4): dispatch = "
     "enqueueing the fused solve; solve_d2h = device compute wait + the "
     "batched D2H read; evict_commit = landing victim evictions; "
-    "bind_dispatch = gang-gated bind fan-out; diagnosis = "
-    "why-unschedulable tallies; status_writeback = PodGroup status "
-    "recompute + writes.  Pack time is snapshot_pack_latency.",
+    "bind_dispatch = gang-gated bind fan-out (with the pipelined wire "
+    "commit this is ENQUEUE time — wire RTTs land in "
+    "commit_flush_latency_seconds); diagnosis = why-unschedulable "
+    "tallies; status_writeback = PodGroup status recompute + writes.  "
+    "Pack time is snapshot_pack_latency.",
     labels=("phase",),
+))
+
+# -- pipelined wire commit (framework/commit.py) -----------------------------
+commit_queue_depth = REGISTRY.register(Gauge(
+    "commit_queue_depth",
+    "Flush ops queued+running in the asynchronous commit pipeline "
+    "(bounded by --commit-inflight-max; submissions past the bound "
+    "pause the solve).",
+))
+commit_flush_latency = REGISTRY.register(Histogram(
+    "commit_flush_latency_seconds",
+    "Per-op latency from commit enqueue to wire ack (bind / status / "
+    "event flushes through the commit pipeline).",
+    labels=("verb",),
+))
+cycle_overlap_ratio = REGISTRY.register(Gauge(
+    "cycle_overlap_ratio",
+    "Fraction of commit-flush busy time that overlapped in-cycle "
+    "compute (cycle N's wire RTTs hidden behind cycle N+1's pack + "
+    "solve); 0 = fully serialized, 1 = fully hidden.",
+))
+commit_backpressure_waits = REGISTRY.register(Counter(
+    "commit_backpressure_waits_total",
+    "Commit submissions that blocked on the --commit-inflight-max "
+    "bound (the solve paused instead of the queue growing).",
+))
+commit_flush_errors = REGISTRY.register(Counter(
+    "commit_flush_errors_total",
+    "Flush ops that raised past the cache's own failure funnels "
+    "(bugs; the worker survives and logs the stack).",
 ))
 
 # -- guardrail subsystem (kube_batch_tpu/guardrails/) ------------------------

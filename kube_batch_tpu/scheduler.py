@@ -133,6 +133,11 @@ class Scheduler:
         # (mid-relist / breaker open): such cycles bypass the overrun
         # watchdog — their near-zero latency is not evidence of health.
         self._cycle_quiesced = False
+        # Commit-pipeline flush-health bookkeeping: batches completed
+        # as of the last cycle, so a cycle during which the pipeline
+        # sat idle (no batch landed, nothing queued) can feed the
+        # flush watchdog a healthy observation — see run_once.
+        self._flush_batches_seen = 0
         # Armed by run() (the daemon loop) — a bare run_once() caller
         # (tests, one-shot tools) must not spawn background compiles
         # that outlive it: a compile thread alive at interpreter
@@ -942,9 +947,18 @@ class Scheduler:
         self.guardrails.pre_cycle()
         started = time.monotonic()
         self._cycle_quiesced = False
+        commit = getattr(self.cache, "commit", None)
+        if commit is not None:
+            # Seal the previous cycle's flush batch (its latency feeds
+            # the flush watchdog when its last ack lands) and mark this
+            # cycle's compute window for the overlap ratio.
+            commit.begin_cycle()
+            commit.note_solve(True)
         try:
             return self._cycle_once()
         finally:
+            if commit is not None:
+                commit.note_solve(False)
             if not self._cycle_quiesced:
                 # Quiesced skips (mid-relist, breaker open) return in
                 # microseconds and are NOT evidence of health: feeding
@@ -956,6 +970,19 @@ class Scheduler:
                     time.monotonic() - started, cache=self.cache,
                     period=self.schedule_period,
                 )
+                if commit is not None:
+                    # A cycle across which the pipeline stayed idle (no
+                    # batch landed, nothing queued) is a HEALTHY flush
+                    # observation — without it, a recovered daemon with
+                    # nothing left to commit could never walk the flush
+                    # ladder back down.
+                    done = commit.batches_completed
+                    if done == self._flush_batches_seen and commit.idle():
+                        self.guardrails.observe_flush(
+                            0.0, cache=self.cache,
+                            period=self.schedule_period,
+                        )
+                    self._flush_batches_seen = done
 
     def _cycle_once(self) -> Session | None:
         with metrics.e2e_latency.time():
@@ -983,14 +1010,24 @@ class Scheduler:
                 )
             except CacheResyncing:
                 # Watch-gap recovery is replaying a LIST into the
-                # mirror (cli.py · reconnect_once); scheduling against
-                # the half-replayed view would overcommit nodes.  The
-                # snapshot guard raises under the cache lock, so this
-                # skip is race-free; the replay's journal marks force a
-                # full re-pack on the next real cycle.
+                # mirror (cli.py · reconnect_once), or the wire breaker
+                # is open; scheduling against the quiesced view would
+                # overcommit nodes.  The snapshot guard raises under
+                # the cache lock, so this skip is race-free; the
+                # replay's journal marks force a full re-pack on the
+                # next real cycle.  Quiesce also drains the commit
+                # pipeline: with the breaker open every queued op fails
+                # fast into the resync queue (zero in-flight wire
+                # writes while quiesced — the chaos invariant).
                 logging.info("cache mid-relist; skipping cycle")
                 metrics.schedule_attempts.inc("resync")
                 self._cycle_quiesced = True
+                commit = getattr(self.cache, "commit", None)
+                if commit is not None and not commit.drain(timeout=30.0):
+                    logging.warning(
+                        "commit pipeline still draining through the "
+                        "quiesced skip (depth %d)", commit.depth,
+                    )
                 return None
             if self._cycle is not None:
                 self._execute_fused(ssn)
@@ -1045,6 +1082,16 @@ class Scheduler:
             return self._run_loop(stop, max_cycles, on_cycle)
         finally:
             self.disarm_growth_prewarm()
+            # Same every-exit-path discipline for the commit pipeline:
+            # the final cycle's binds/status writes get a bounded
+            # chance to land before the owner (CLI/chaos harness)
+            # closes it and the wire goes away.
+            commit = getattr(self.cache, "commit", None)
+            if commit is not None and not commit.drain(timeout=30.0):
+                logging.warning(
+                    "commit pipeline still draining at loop exit "
+                    "(depth %d)", commit.depth,
+                )
 
     def arm_growth_prewarm(self) -> None:
         """Enable background next-bucket compiles.  run() arms this

@@ -1,0 +1,46 @@
+#!/usr/bin/env python
+"""JSON self-check of the bench daemon-phase artifact (make
+bench-smoke): the FINAL stdout line must be one `json.loads`-able
+object carrying the phase evidence the driver parses — the r05 lesson
+(an unparseable tail zeroes the whole scoreboard) turned into a CI
+gate.  Also asserts the pipelined-commit acceptance figure: >=1.5x
+steady-state cycles/sec against the simulated 68 ms-RTT backend."""
+
+import json
+import sys
+
+
+def main() -> int:
+    lines = [ln for ln in sys.stdin.read().splitlines() if ln.strip()]
+    assert lines, "bench-smoke produced no stdout"
+    artifact = json.loads(lines[-1])  # the driver reads the LAST line
+    assert isinstance(artifact, dict), artifact
+
+    for key in ("first_cycle_ms", "e2e_cycle_ms_p50", "commit_pipeline"):
+        assert key in artifact, (
+            f"artifact missing {key!r}; keys: {sorted(artifact)}"
+        )
+    assert isinstance(artifact["first_cycle_ms"], (int, float))
+
+    cmp_ = artifact["commit_pipeline"]
+    assert "error" not in cmp_, cmp_
+    speedup = cmp_.get("speedup")
+    assert speedup is not None and speedup >= 1.5, (
+        f"pipelined commit speedup {speedup} < 1.5x vs sync at "
+        f"{cmp_.get('rtt_ms')}ms RTT: {cmp_}"
+    )
+    stats = cmp_.get("pipeline_stats") or {}
+    assert stats.get("order_violations", 0) == 0, stats
+    assert stats.get("flush_errors", 0) == 0, stats
+
+    print(
+        "bench-smoke artifact: ok — first_cycle "
+        f"{artifact['first_cycle_ms']}ms, steady p50 "
+        f"{artifact['e2e_cycle_ms_p50']}ms, pipelined commit "
+        f"{speedup}x vs sync at {cmp_.get('rtt_ms')}ms RTT"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,52 @@
+#!/usr/bin/env python
+"""Assert the pipelined-commit chaos acceptance criteria over two
+same-seed guardrail runs (make chaos):
+
+* both runs completed with zero invariant violations;
+* same seed ⇒ same trace hash (the pipelined overlap does not perturb
+  the per-tick decision sets — the drain barrier is the determinism
+  boundary, and the logged binds ARE the commit acks);
+* the commit pipeline drained fully (depth 0), preserved per-pod
+  wire-write order, and leaked zero writes onto the wire while the
+  breaker was fully open — the trip-open drains-then-quiesces
+  contract;
+* the breaker actually tripped and healed (the scenario's blackhole
+  window exercised the path being asserted).
+"""
+
+import json
+import sys
+
+
+def main(path_a: str, path_b: str) -> int:
+    with open(path_a, encoding="utf-8") as f:
+        a = json.load(f)
+    with open(path_b, encoding="utf-8") as f:
+        b = json.load(f)
+    for name, run in (("run1", a), ("run2", b)):
+        assert run["ok"], f"{name} violations: {run['violations']}"
+        commit = run["commit"]
+        assert commit["mode"] == "pipelined", commit
+        assert commit["depth"] == 0, f"{name} undrained: {commit}"
+        assert commit["order_violations"] == 0, commit
+        assert commit["flush_errors"] == 0, commit
+        assert commit["writes_while_open"] == 0, \
+            f"{name} leaked writes through an open breaker: {commit}"
+        rails = run["guardrail"]
+        assert rails["breaker_opened"] >= 1, rails
+        assert rails["breaker_closed"] >= 1, rails
+    assert a["trace_hash"] == b["trace_hash"], (
+        f"same-seed pipelined runs diverged: "
+        f"{a['trace_hash']} != {b['trace_hash']}"
+    )
+    print(
+        "chaos pipelined: ok — same-seed hash "
+        f"{a['trace_hash'][:16]}… reproduced; breaker tripped "
+        f"{a['guardrail']['breaker_opened']}x and drained to zero "
+        "in-flight writes; per-pod wire order preserved"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1], sys.argv[2]))

@@ -111,6 +111,7 @@ def test_same_seed_identical_trace_and_assignment(tmp_path):
     recorded = read_trace(str(trace))
     assert recorded[0] == {
         "tick": -1, "op": "meta", "seed": 3,
+        "wire_commit": "sync",
         **{k: getattr(FAULTS, k) for k in _META_FAULT_FIELDS},
     }
     replay = ChaosEngine(
@@ -141,6 +142,7 @@ def test_corrupted_tick_is_caught_and_dumped(tmp_path):
     ), "flight recorder lost the corrupted tick"
 
 
+@pytest.mark.slow  # soak-scale on the tier-1 host; plain `pytest tests/` still runs it
 def test_faults_recover_and_converge():
     result = _engine(seed=5, ticks=27).run()
     assert result.ok, result.violations
@@ -227,6 +229,7 @@ def test_checker_accepts_clean_gang_bind():
 
 # -- the CLI -----------------------------------------------------------
 
+@pytest.mark.slow  # soak-scale on the tier-1 host; plain `pytest tests/` still runs it
 def test_cli_exit_codes(tmp_path, capsys):
     from kube_batch_tpu.chaos.__main__ import main
 

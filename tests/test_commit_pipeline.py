@@ -1,0 +1,412 @@
+"""Pipelined wire commit (framework/commit.py + the cache/session/
+scheduler integration): per-key ordering, backpressure, drain on
+quiesce, failure funnels, and the enqueue-vs-flush latency split.
+
+The fake high-RTT backend is `cache.backend.FakeBinder(rtt_s=...)` /
+`FakeStatusUpdater(rtt_s=...)` with an injectable sleep, so ordering
+and backpressure are exercised deterministically on a fast wall
+clock; soak-scale variants ride behind the `slow` marker.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from kube_batch_tpu import metrics
+from kube_batch_tpu.api.types import TaskStatus
+from kube_batch_tpu.cache.backend import (
+    FakeBinder,
+    FakeEvictor,
+    FakeStatusUpdater,
+)
+from kube_batch_tpu.cache.cache import SchedulerCache
+from kube_batch_tpu.cache.cluster import PodGroup
+from kube_batch_tpu.framework.commit import CommitPipeline
+from kube_batch_tpu.models.workloads import DEFAULT_SPEC, GI, _node, _pod
+from kube_batch_tpu.scheduler import Scheduler
+
+GANG = 8
+
+
+def build_cache(binder=None, updater=None) -> SchedulerCache:
+    cache = SchedulerCache(
+        spec=DEFAULT_SPEC,
+        binder=binder if binder is not None else FakeBinder(),
+        evictor=FakeEvictor(),
+        status_updater=updater if updater is not None
+        else FakeStatusUpdater(),
+    )
+    for i in range(4):
+        cache.add_node(_node(f"n{i}", cpu_milli=32000, mem=128 * GI))
+    return cache
+
+
+def submit_gang(cache, name: str, n: int = GANG) -> None:
+    cache.add_pod_group(PodGroup(name=name, queue="default", min_member=n))
+    for k in range(n):
+        pod = _pod(f"{name}-{k}", cpu=250, mem=GI / 2)
+        pod.group = name
+        cache.add_pod(pod)
+
+
+def statuses(cache) -> set[str]:
+    with cache.lock():
+        return {p.status.name for p in cache._pods.values()}
+
+
+# ---------------------------------------------------------------------------
+# pipeline unit semantics
+# ---------------------------------------------------------------------------
+
+def test_per_key_fifo_ordering_across_concurrent_keys():
+    pipe = CommitPipeline(workers=8)
+    done: list[tuple[str, int]] = []
+    lock = threading.Lock()
+
+    def op(key, i):
+        def run():
+            time.sleep(0.001)
+            with lock:
+                done.append((key, i))
+        return run
+
+    for i in range(10):
+        for key in ("a", "b", "c", "d", "e"):
+            pipe.submit(key, op(key, i))
+    assert pipe.drain(10.0)
+    for key in "abcde":
+        seq = [i for k, i in done if k == key]
+        assert seq == sorted(seq), f"key {key} reordered: {seq}"
+    assert pipe.stats()["order_violations"] == 0
+    pipe.close(1.0)
+
+
+def test_unrelated_keys_flush_concurrently():
+    pipe = CommitPipeline(workers=4)
+    barrier = threading.Barrier(2, timeout=5.0)
+    # Two DIFFERENT keys must be in flight at once: each op blocks on
+    # the rendezvous, so a serialized pipeline would deadlock+timeout.
+    pipe.submit("a", barrier.wait)
+    pipe.submit("b", barrier.wait)
+    assert pipe.drain(5.0)
+    assert pipe.stats()["flush_errors"] == 0  # no BrokenBarrierError
+    pipe.close(1.0)
+
+
+def test_backpressure_blocks_submit_until_capacity():
+    gate = threading.Event()
+    pipe = CommitPipeline(workers=2, max_inflight=2)
+    pipe.submit("a", gate.wait)
+    pipe.submit("b", gate.wait)
+
+    landed = threading.Event()
+
+    def third():
+        pipe.submit("c", lambda: None)
+        landed.set()
+
+    t = threading.Thread(target=third, daemon=True)
+    t.start()
+    # Queue is at the bound and both ops are gated: the third submit
+    # must BLOCK (the solve pauses), not grow the queue.
+    assert not landed.wait(0.3)
+    gate.set()
+    assert landed.wait(5.0)
+    assert pipe.drain(5.0)
+    assert pipe.stats()["backpressure_waits"] >= 1
+    assert metrics.commit_backpressure_waits.value() >= 1
+    pipe.close(1.0)
+
+
+def test_drain_waits_for_inflight_and_close_runs_inline():
+    gate = threading.Event()
+    pipe = CommitPipeline(workers=2)
+    pipe.submit("a", gate.wait)
+    assert not pipe.drain(0.2)       # still gated
+    gate.set()
+    assert pipe.drain(5.0)
+    pipe.close(1.0)
+    ran = []
+    pipe.submit("late", lambda: ran.append(1))  # closed → inline, sync
+    assert ran == [1]
+
+
+def test_batch_flush_latency_reported_via_on_flush():
+    seen: list[float] = []
+    pipe = CommitPipeline(workers=2, on_flush=seen.append)
+    pipe.begin_cycle()
+    pipe.submit("a", lambda: time.sleep(0.05))
+    pipe.begin_cycle()                # seals the batch
+    assert pipe.drain(5.0)
+    deadline = time.monotonic() + 5.0
+    while not seen and time.monotonic() < deadline:
+        time.sleep(0.005)
+    assert seen and seen[0] >= 0.04
+    pipe.close(1.0)
+
+
+# ---------------------------------------------------------------------------
+# cache + session integration
+# ---------------------------------------------------------------------------
+
+def test_scheduler_cycle_returns_before_flush_and_binds_land():
+    rtt = 0.05
+    binder = FakeBinder(rtt_s=rtt)
+    cache = build_cache(binder=binder)
+    commit = CommitPipeline(cache=cache, max_inflight=64)
+    cache.commit = commit
+    s = Scheduler(cache, schedule_period=0.0)
+    # Base load parks the task count deep inside one padding bucket so
+    # the timed cycle below never pays a shape recompile (5×8 = 40
+    # pods → bucket 64; +8 stays under it).
+    for i in range(5):
+        submit_gang(cache, f"warm-{i}")
+    s.run_once()                      # pays the jit compile
+    assert commit.drain(10.0)
+    assert statuses(cache) == {"BOUND"}
+
+    submit_gang(cache, "g2")
+    t0 = time.perf_counter()
+    ssn = s.run_once()
+    wall = time.perf_counter() - t0
+    # 8 serial RTTs would cost ≥0.4 s; the pipelined cycle ends at
+    # enqueue.  Bound list counts the DISPATCHED gang either way.
+    assert wall < 0.35, wall
+    assert len(ssn.bound) == GANG
+    assert commit.drain(10.0)
+    assert statuses(cache) == {"BOUND"}
+    assert {n for n, _node_ in binder.binds} >= {
+        f"g2-{k}" for k in range(GANG)
+    }
+    assert commit.stats()["order_violations"] == 0
+    commit.close(1.0)
+
+
+def test_bind_dispatch_phase_reports_enqueue_time_not_flush_time():
+    rtt = 0.1
+    cache = build_cache(binder=FakeBinder(rtt_s=rtt))
+    commit = CommitPipeline(cache=cache, max_inflight=64)
+    cache.commit = commit
+    s = Scheduler(cache, schedule_period=0.0)
+    # 3×8 = 24 pods pad to bucket 32; the timed gang lands exactly at
+    # 32, so the measured cycle replays the warm executable.
+    for i in range(3):
+        submit_gang(cache, f"warm-{i}")
+    s.run_once()
+    assert commit.drain(10.0)
+
+    dispatch_sum0 = metrics.cycle_phase_latency.sum("bind_dispatch")
+    flush_cnt0 = metrics.commit_flush_latency.count("bind")
+    flush_sum0 = metrics.commit_flush_latency.sum("bind")
+    submit_gang(cache, "g2")
+    s.run_once()
+    dispatch_s = (
+        metrics.cycle_phase_latency.sum("bind_dispatch") - dispatch_sum0
+    )
+    assert commit.drain(10.0)
+    # Enqueue time: well under one RTT even for the whole gang.
+    assert dispatch_s < rtt, dispatch_s
+    # The RTTs are visible where they now happen: the flush histogram.
+    assert metrics.commit_flush_latency.count("bind") - flush_cnt0 == GANG
+    assert (
+        metrics.commit_flush_latency.sum("bind") - flush_sum0
+    ) >= rtt
+    commit.close(1.0)
+
+
+def test_failed_flush_bind_rolls_back_resyncs_and_retries():
+    binder = FakeBinder()
+    binder.fail_once = {"g1-0"}       # first attempt only
+    cache = build_cache(binder=binder)
+    commit = CommitPipeline(cache=cache)
+    cache.commit = commit
+    s = Scheduler(cache, schedule_period=0.0)
+    submit_gang(cache, "g1", 4)
+    s.run_once()
+    assert commit.drain(10.0)
+    with cache.lock():
+        failed = next(
+            p for p in cache._pods.values() if p.name == "g1-0"
+        )
+        assert failed.status == TaskStatus.PENDING
+    # The rollback queued the pod for resync; the next cycle rebinds.
+    s.run_once()
+    assert commit.drain(10.0)
+    assert any(n == "g1-0" for n, _ in binder.binds)
+    assert statuses(cache) == {"BOUND"}
+    assert any(
+        "bind-failed" in str(e)
+        for e in cache.events_for("Pod", "g1-0")
+    )
+    commit.close(1.0)
+
+
+def test_task_scheduling_latency_observed_at_wire_ack():
+    rtt = 0.08
+    cache = build_cache(binder=FakeBinder(rtt_s=rtt))
+    commit = CommitPipeline(cache=cache)
+    cache.commit = commit
+    s = Scheduler(cache, schedule_period=0.0)
+    cnt0 = metrics.task_scheduling_latency.count()
+    submit_gang(cache, "g1", 4)
+    s.run_once()
+    assert commit.drain(10.0)
+    # One observation per bound pod, recorded when the ack landed.
+    assert metrics.task_scheduling_latency.count() - cnt0 == 4
+    commit.close(1.0)
+
+
+def test_status_and_event_flushes_route_through_pipeline():
+    class Sink:
+        def __init__(self):
+            self.events = []
+            self.threads = set()
+
+        def record_event(self, kind, name, reason, message,
+                         count=1, namespace="default"):
+            self.threads.add(threading.current_thread().name)
+            self.events.append((kind, name, reason))
+
+    updater = FakeStatusUpdater()
+    cache = build_cache(updater=updater)
+    sink = Sink()
+    cache.event_sink = sink
+    commit = CommitPipeline(cache=cache)
+    cache.commit = commit
+    s = Scheduler(cache, schedule_period=0.0)
+    submit_gang(cache, "g1", 4)
+    s.run_once()
+    assert commit.drain(10.0)
+    # PodGroup status writes flushed off-thread, and the sink saw the
+    # Bound events — all on commit-flush workers.
+    assert any(g.name == "g1" for g in updater.updates)
+    assert ("Pod", "g1-0", "Bound") in sink.events
+    assert all(t.startswith("commit-flush") for t in sink.threads)
+    commit.close(1.0)
+
+
+# ---------------------------------------------------------------------------
+# quiesce / breaker drain paths
+# ---------------------------------------------------------------------------
+
+def test_quiesced_cycle_drains_pipeline():
+    gate = threading.Event()
+    released = []
+
+    class GatedBinder(FakeBinder):
+        def bind(self, pod, node_name):
+            gate.wait(5.0)
+            released.append(pod.name)
+            super().bind(pod, node_name)
+
+    cache = build_cache(binder=GatedBinder())
+    commit = CommitPipeline(cache=cache)
+    cache.commit = commit
+    s = Scheduler(cache, schedule_period=0.0)
+    submit_gang(cache, "g1", 4)
+    s.run_once()                      # binds enqueued, gated in flight
+    assert commit.depth > 0
+    # Release the gate shortly after the quiesced skip starts waiting.
+    threading.Timer(0.1, gate.set).start()
+    cache.begin_resync()
+    try:
+        assert s.run_once() is None   # CacheResyncing skip...
+        assert commit.depth == 0      # ...drained the pipeline
+    finally:
+        cache.end_resync()
+    assert len(released) == 4
+    commit.close(1.0)
+
+
+def test_breaker_trip_drains_queue_without_touching_wire():
+    from kube_batch_tpu.guardrails.breaker import (
+        Backoff,
+        CircuitBreaker,
+        GuardedBackend,
+    )
+
+    class DeadBinder:
+        def __init__(self):
+            self.attempts = 0
+
+        def bind(self, pod, node_name):
+            self.attempts += 1
+            raise ConnectionError("wire is dead")
+
+    dead = DeadBinder()
+    guarded = GuardedBackend(
+        dead,
+        breaker=CircuitBreaker(trip_after=3, reset_after=1e9),
+        backoff=Backoff(base=0.001, cap=0.002, attempts=1),
+        sleep=lambda _s: None,
+    )
+    cache = build_cache(binder=guarded)
+    # Single worker: deterministic failure count before the trip.
+    commit = CommitPipeline(cache=cache, workers=1)
+    cache.commit = commit
+    for k in range(10):
+        pod = _pod(f"dead-{k}", cpu=100, mem=GI / 4)
+        pod.group = None
+        cache.add_pod(pod)
+        assert cache.begin_bind(pod.uid, "n0")
+        commit.submit_bind(pod.uid, "n0")
+    assert commit.drain(10.0)
+    # Trip after 3; the remaining 7 failed fast via BreakerOpen with
+    # ZERO further wire touches, and every pod drained into resync.
+    assert dead.attempts == 3
+    assert len(cache.drain_resync()) == 10
+    assert statuses(cache) == {"PENDING"}
+    assert commit.stats()["flush_errors"] == 0
+    commit.close(1.0)
+
+
+# ---------------------------------------------------------------------------
+# soak-scale variants (slow marker; tier-1 keeps the fast ones above)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_pipelined_multi_cycle_churn_no_double_bind():
+    binder = FakeBinder(rtt_s=0.01)
+    cache = build_cache(binder=binder)
+    commit = CommitPipeline(cache=cache, max_inflight=128)
+    cache.commit = commit
+    s = Scheduler(cache, schedule_period=0.0)
+    submit_gang(cache, "base-0")
+    s.run_once()
+    for i in range(30):
+        submit_gang(cache, f"churn-{i}", 4)
+        s.run_once()
+    assert commit.drain(30.0)
+    # Every pod bound exactly once across 30 overlapped cycles.
+    names = [n for n, _ in binder.binds]
+    assert len(names) == len(set(names))
+    assert statuses(cache) == {"BOUND"}
+    assert commit.stats()["order_violations"] == 0
+    commit.close(1.0)
+
+
+@pytest.mark.slow
+def test_chaos_pipelined_guardrail_same_seed_same_hash():
+    from tests.test_chaos_guardrails import FAULTS, SCENARIO
+
+    from kube_batch_tpu.chaos import ChaosEngine
+
+    def run():
+        return ChaosEngine(
+            seed=11, ticks=32, scenario=SCENARIO, faults=FAULTS,
+            drain=40, wire_commit="pipelined",
+        ).run()
+
+    a, b = run(), run()
+    assert a.ok, [v.as_dict() for v in a.violations]
+    assert b.ok, [v.as_dict() for v in b.violations]
+    assert a.trace_hash == b.trace_hash
+    for r in (a, b):
+        assert r.commit["depth"] == 0
+        assert r.commit["order_violations"] == 0
+        assert r.commit["writes_while_open"] == 0
+        assert r.guardrail["breaker_opened"] >= 1
+        assert r.guardrail["breaker_closed"] >= 1

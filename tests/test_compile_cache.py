@@ -11,7 +11,7 @@ from __future__ import annotations
 import jax
 import pytest
 
-from kube_batch_tpu.compile_cache import enable_compile_cache
+from kube_batch_tpu.compile_cache import enable_compile_cache, host_fingerprint
 
 
 @pytest.fixture(autouse=True)
@@ -29,9 +29,20 @@ def _restore_jax_config():
 def test_enable_points_jax_at_directory(tmp_path):
     target = tmp_path / "xla-cache"
     got = enable_compile_cache(str(target))
-    assert got == str(target)
-    assert target.is_dir()  # created on demand
-    assert jax.config.jax_compilation_cache_dir == str(target)
+    # Host/backend-fingerprinted subdirectory: a cache dir shared
+    # across heterogeneous hosts must not replay another machine's
+    # CPU-AOT executables (cpu_aot_loader warning floods / SIGILL).
+    expect = target / f"hw-{host_fingerprint()}"
+    assert got == str(expect)
+    assert expect.is_dir()  # created on demand
+    assert jax.config.jax_compilation_cache_dir == str(expect)
+
+
+def test_host_fingerprint_is_stable_and_short():
+    a, b = host_fingerprint(), host_fingerprint()
+    assert a == b
+    assert len(a) == 12
+    int(a, 16)  # hex
 
 
 def test_empty_disables():
@@ -41,7 +52,8 @@ def test_empty_disables():
 def test_env_var_override(tmp_path, monkeypatch):
     target = tmp_path / "from-env"
     monkeypatch.setenv("KB_TPU_COMPILE_CACHE", str(target))
-    assert enable_compile_cache() == str(target)
+    got = enable_compile_cache()
+    assert got == str(target / f"hw-{host_fingerprint()}")
     assert target.is_dir()
 
 
@@ -52,4 +64,6 @@ def test_cli_flag_reaches_config(tmp_path):
         ["--compile-cache-dir", str(tmp_path / "cli-cache")]
     )
     got = enable_compile_cache(args.compile_cache_dir)
-    assert got == str(tmp_path / "cli-cache")
+    assert got == str(
+        tmp_path / "cli-cache" / f"hw-{host_fingerprint()}"
+    )

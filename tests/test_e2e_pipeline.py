@@ -95,6 +95,7 @@ def test_oversubscribed_priorities_converge():
     assert sum(running.values()) == 32, running
 
 
+@pytest.mark.slow  # soak-scale on the tier-1 host; plain `pytest tests/` still runs it
 def test_besteffort_backfills_after_preemption_settles():
     cache, sim = make_world(SPEC)
     for i in range(2):

@@ -82,6 +82,7 @@ def test_subset_matches_full_on_affinity_worlds(seed):
     _compare(cache, max_rows=2048)
 
 
+@pytest.mark.slow  # soak-scale on the tier-1 host; plain `pytest tests/` still runs it
 def test_subset_truncation_window():
     """A window smaller than the pending backlog still matches full on
     the covered prefix (ascending order, same as diagnose_pending)."""

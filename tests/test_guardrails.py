@@ -764,6 +764,7 @@ def test_hbm_ceiling_admits_refuses_and_counts():
     assert ceiling.admit(OpaqueExe())[0]
 
 
+@pytest.mark.slow  # soak-scale on the tier-1 host; plain `pytest tests/` still runs it
 def test_scheduler_growth_prewarm_refuses_over_ceiling(tmp_path):
     """The acceptance path: a 1-byte ceiling refuses the next-bucket
     program at adoption (previous program keeps serving), records the

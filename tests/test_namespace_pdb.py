@@ -269,6 +269,7 @@ def test_multi_pdb_allows_eviction_when_all_floors_permit():
     assert ssn.evicted[0][0].startswith("web")
 
 
+@pytest.mark.slow  # soak-scale on the tier-1 host; plain `pytest tests/` still runs it
 def test_multi_pdb_eviction_divergence_surfaced_in_k8s_mode():
     """Upstream's eviction API refuses ANY eviction of a pod covered
     by >1 budget; this scheduler allows it when every floor survives

@@ -140,7 +140,12 @@ def _random_world(seed: int, mode: str):
     return cache, sim
 
 
-@pytest.mark.parametrize("seed", range(30))
+# Seeds measured heaviest on the tier-1 host (~8 s each) ride behind
+# the `slow` marker; plain `pytest tests/` still sweeps all of them.
+@pytest.mark.parametrize("seed", [
+    pytest.param(s, marks=pytest.mark.slow) if s in (2, 21) else s
+    for s in range(30)
+])
 def test_preempt_fuzz_parity(seed):
     cache, _sim = _random_world(seed, "preempt")
     k_pre, k_vpj, snap, meta, _ = _kernel_outcome(cache, make_preempt_solver)
@@ -149,7 +154,10 @@ def test_preempt_fuzz_parity(seed):
     assert k_vpj == o_vpj, (seed, k_vpj, o_vpj)
 
 
-@pytest.mark.parametrize("seed", range(30, 55))
+@pytest.mark.parametrize("seed", [
+    pytest.param(s, marks=pytest.mark.slow) if s == 42 else s
+    for s in range(30, 55)
+])
 def test_reclaim_fuzz_parity(seed):
     cache, _sim = _random_world(seed, "reclaim")
     k_pre, k_vpj, snap, meta, _ = _kernel_outcome(cache, make_reclaim_solver)

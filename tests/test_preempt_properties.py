@@ -149,7 +149,12 @@ def test_preempt_properties(seed):
     _check_properties(snap, meta, state0, out, "preempt", seed)
 
 
-@pytest.mark.parametrize("seed", range(30, 55))
+# Seed 43 is the sweep's heaviest world on the tier-1 host (~8 s);
+# it rides behind `slow`, the other 24 seeds stay tier-1.
+@pytest.mark.parametrize("seed", [
+    pytest.param(s, marks=pytest.mark.slow) if s == 43 else s
+    for s in range(30, 55)
+])
 def test_reclaim_properties(seed):
     cache, _sim = _random_world(seed, "reclaim")
     snap, meta, state0, out = _solve(cache, make_reclaim_solver)

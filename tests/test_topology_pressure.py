@@ -9,6 +9,8 @@ by `predicate.*PressureEnable` Arguments.
 
 import dataclasses
 
+import pytest
+
 from kube_batch_tpu.actions import BUILTIN_ACTIONS  # noqa: F401
 from kube_batch_tpu.api.resource import ResourceSpec
 from kube_batch_tpu.cache.cluster import Node, Pod, PodGroup
@@ -92,6 +94,7 @@ def test_zone_level_affinity_colocates_across_nodes():
     assert web_node.split("-")[0] == db_zone  # same zone, any node
 
 
+@pytest.mark.slow  # soak-scale on the tier-1 host; plain `pytest tests/` still runs it
 def test_zone_level_affinity_blocks_other_zone():
     """With the anchor in zone 0 and zone 0 FULL, a zone-affine pod
     must stay pending rather than land in zone 1."""
@@ -273,6 +276,7 @@ def test_zone_anti_spread_one_per_zone_at_width():
     assert len(set(zones)) == 8, binds
 
 
+@pytest.mark.slow  # soak-scale on the tier-1 host; plain `pytest tests/` still runs it
 def test_topology_scoped_soft_preference_spreads_to_zone():
     """'zone:app=cache' as a SOFT preference (pod_prefs) steers the pod
     into the cache pod's ZONE even when (a) the cache node itself is
