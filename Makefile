@@ -60,6 +60,14 @@ run-example:
 # asserts zero violations, same seed ⇒ same trace hash across the two
 # runs, per-pod wire-write order preserved, and the breaker trip
 # draining to zero in-flight writes.
+# The fifth and sixth runs are the FAILOVER scenario
+# (doc/design/failover-fencing.md): a leader crash mid-commit, a
+# second elector instance taking over at a higher epoch, a zombie-
+# flush window through the dead connection (every stale-epoch write
+# must be REJECTED), and the takeover reconciliation classifying the
+# frozen BINDING pods — scripts/check_chaos_failover.py asserts zero
+# violations, ≥1 rejected zombie write, zero accepted, epoch
+# monotonicity, reconcile classification, and same seed ⇒ same hash.
 chaos:
 	JAX_PLATFORMS=cpu $(PY) -m kube_batch_tpu.chaos --seed 7 --ticks 200
 	JAX_PLATFORMS=cpu $(PY) -m kube_batch_tpu.chaos --seed 11 --ticks 32 \
@@ -72,6 +80,14 @@ chaos:
 	    --quiet > /tmp/kb-chaos-pipelined-2.json
 	$(PY) scripts/check_chaos_pipelined.py /tmp/kb-chaos-pipelined-1.json \
 	    /tmp/kb-chaos-pipelined-2.json
+	JAX_PLATFORMS=cpu $(PY) -m kube_batch_tpu.chaos --seed 13 --ticks 24 \
+	    --scenario examples/chaos-failover.json --wire-commit pipelined \
+	    --quiet > /tmp/kb-chaos-failover-1.json
+	JAX_PLATFORMS=cpu $(PY) -m kube_batch_tpu.chaos --seed 13 --ticks 24 \
+	    --scenario examples/chaos-failover.json --wire-commit pipelined \
+	    --quiet > /tmp/kb-chaos-failover-2.json
+	$(PY) scripts/check_chaos_failover.py /tmp/kb-chaos-failover-1.json \
+	    /tmp/kb-chaos-failover-2.json
 
 profile:
 	$(PY) -m kube_batch_tpu --workload 2 --cycles 3 --schedule-period 0 \

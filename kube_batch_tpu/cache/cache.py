@@ -312,6 +312,16 @@ class SchedulerCache:
                 )
         return ev
 
+    @staticmethod
+    def _is_stale_epoch(exc: BaseException) -> bool:
+        """True when a write failed EPOCH FENCING — this process's
+        leadership is gone (stand-down raced an in-flight flush, or
+        the cluster rejected a zombie).  Lazy import: client.adapter
+        imports this module at load time."""
+        from kube_batch_tpu.client.adapter import StaleEpochError
+
+        return isinstance(exc, StaleEpochError)
+
     def _send_event(self, kind, name, reason, message, count,
                     namespace) -> None:
         """Forward one event through the sink (outside the lock — sinks
@@ -322,6 +332,15 @@ class SchedulerCache:
                 count=count, namespace=namespace,
             )
         except Exception as exc:  # noqa: BLE001 — classified below
+            if self._is_stale_epoch(exc):
+                # Deposed mid-flush: the successor narrates the world
+                # from here on; this event dies with the old epoch
+                # (the in-process ring still holds it).
+                logging.warning(
+                    "event write fenced (leadership lost): %s %s %s",
+                    kind, name, reason,
+                )
+                return
             # Events are fire-and-forget; the in-process ring already
             # holds the record.  Same posture as update_job_status:
             # transport failures (including an OPEN guardrail breaker,
@@ -883,6 +902,16 @@ class SchedulerCache:
         try:
             self.status_updater.update_pod_group(group)
         except Exception as exc:  # noqa: BLE001 — classified below
+            if self._is_stale_epoch(exc):
+                # Fenced: a deposed leader must NOT keep retrying this
+                # write (no _status_retry mark) — the SUCCESSOR owns
+                # the PodGroup's status now and its takeover
+                # reconciliation refreshes every live job.
+                logging.warning(
+                    "podgroup %s status write fenced (leadership "
+                    "lost); the successor repairs it", group.name,
+                )
+                return
             # Status writes are advisory observability; a dead wire —
             # the guardrail breaker quiescing it (BreakerOpen is a
             # ConnectionError), or an apiserver answering 429/5xx
@@ -902,7 +931,7 @@ class SchedulerCache:
                 "cycle): %s", group.name, exc,
             )
 
-    def refresh_job_statuses(self, names=None) -> None:
+    def refresh_job_statuses(self, names=None) -> int:
         """Recompute PodGroup statuses for `names` — or EVERY live job
         when None — under the cache lock (event handlers may be
         mutating job.tasks from an adapter thread; ≙ job_updater.go
@@ -910,7 +939,9 @@ class SchedulerCache:
         that actually CHANGED — each write is an apiserver round trip
         on the stream backend.  None must mean the cache's jobs, not a
         snapshot's: snapshot-excluded orphans (unknown/deleted queue)
-        still need their phases corrected."""
+        still need their phases corrected.  Returns the number of
+        statuses actually (re-)written — the takeover reconciler
+        reports it as its repair count."""
         with self._lock:
             targets = list(self._jobs) if names is None else [
                 n for n in names if n in self._jobs
@@ -921,6 +952,7 @@ class SchedulerCache:
                 )
                 for n in targets
             ]
+        written = 0
         for group, changed in groups:
             if changed or group.name in self._status_retry:
                 # A group whose last write was swallowed (transient
@@ -929,6 +961,31 @@ class SchedulerCache:
                 # the retry survives repeated outcycles.
                 self._status_retry.discard(group.name)
                 self.update_job_status(group)
+                written += 1
+        return written
+
+    def pods_in_status(self, status: TaskStatus) -> dict[str, tuple]:
+        """uid → (name, namespace, group, node) of every pod currently
+        in `status` — the takeover reconciler's census of pods a dead
+        leadership epoch left frozen in BINDING
+        (client/failover.py · reconcile_takeover)."""
+        with self._lock:
+            return {
+                uid: (pod.name, pod.namespace, pod.group, pod.node)
+                for uid, pod in self._pods.items()
+                if pod.status == status
+            }
+
+    def pod_placements(self, uids) -> dict[str, tuple]:
+        """uid → (status, node) for the given uids, missing ones
+        omitted — the takeover reconciler's post-relist classification
+        read (a frozen-BINDING pod absent here VANISHED during the
+        failover window)."""
+        with self._lock:
+            return {
+                u: (self._pods[u].status, self._pods[u].node)
+                for u in uids if u in self._pods
+            }
 
     def has_pending_work(self) -> bool:
         """True when a scheduling cycle could possibly act: any pod is

@@ -103,10 +103,12 @@ BLACKHOLE_WIRE_TIMEOUT = 1.5
 #: FaultSpec fields that must survive the trace round trip because
 #: they change run behavior outside the inline event schedule (curse
 #: decisions, Guardrails wiring, blackhole wire timeout, slow-fault
-#: delay).  Written into the trace's meta header; adopted on replay.
+#: delay, the zombie-window size).  Written into the trace's meta
+#: header; adopted on replay.
 _META_FAULT_FIELDS = (
     "bind_fail_pct", "slow_at", "slow_ticks", "slow_response_s",
     "blackhole_at", "blackhole_ticks", "hbm_pressure_at",
+    "leader_crash_at", "zombie_writes",
 )
 
 #: Commit-pipeline drain bound per tick (wall seconds): under a
@@ -135,6 +137,11 @@ class ChaosResult:
     #: pipeline's own stats — max depth, order violations (must be 0),
     #: flush errors (must be 0), final depth after drain (must be 0).
     commit: dict | None = None
+    #: Failover observability (None unless a leader-crash ran): the
+    #: crashed/successor epochs, zombie-window accounting (attempted /
+    #: rejected / accepted — accepted MUST be 0), the takeover
+    #: reconcile summary, and the cluster's stale-rejection count.
+    failover: dict | None = None
 
     def summary(self) -> dict:
         return {
@@ -149,6 +156,7 @@ class ChaosResult:
             "flight_recorder": self.dump_path,
             "guardrail": self.guardrail,
             "commit": self.commit,
+            "failover": self.failover,
         }
 
 
@@ -246,6 +254,15 @@ class ChaosEngine:
         self._pending_gap = False
         self._have_lease = False
         self._lease_lost = False
+        # -- leadership fencing state (leader-crash fault) -------------
+        self._holder = LEASE_HOLDER          # current elector identity
+        self._epoch: int | None = None       # current fencing epoch
+        self._incarnation = 0                # bumped per leader-crash
+        self._zombie_attempted = 0
+        self._zombie_accepted = 0            # MUST stay 0 (invariant)
+        self._crash_epochs: tuple[int, int] | None = None  # (old, new)
+        self._reconcile_summary: dict | None = None
+        self._forged: dict | None = None     # forged BINDING census
         # Guardrail wiring: any guardrail fault in the spec makes the
         # driven scheduler carry a Guardrails instance, its breaker
         # clocked off the TICK counter (reset windows count ticks, not
@@ -285,6 +302,7 @@ class ChaosEngine:
         self.cache: SchedulerCache | None = None
         self._socks: list[socket.socket] = []
         self._cluster_sock: socket.socket | None = None
+        self._sched_sock: socket.socket | None = None
         self._decision_cursor = 0
         # Decision log folded into the trace hash (sorted per tick).
         self._decisions: list[dict] = []
@@ -315,6 +333,7 @@ class ChaosEngine:
         adapter.start()
         self._socks.extend((a, b))
         self._cluster_sock = a
+        self._sched_sock = b  # the zombie sever targets this side
         self.adapter = adapter
 
     def _sever_stream(self) -> None:
@@ -392,6 +411,10 @@ class ChaosEngine:
             self.cluster.blackhole = False
             self.recovery_counts["blackhole-healed"] += 1
             metrics.chaos_recoveries.inc("blackhole-healed")
+        elif kind == "leader-crash":
+            self._leader_crash(detail)
+            self.fault_counts[kind] += 1
+            metrics.chaos_faults_injected.inc(kind)
         elif kind == "hbm-pressure":
             # Compile ONE next-bucket program through the real
             # compile-then-admit path under a 1-byte ceiling: the HBM
@@ -417,6 +440,171 @@ class ChaosEngine:
             raise ChaosEngineError(f"unknown fault kind {kind!r}")
         rec.setdefault("faults", []).append(detail)
 
+    # -- leader crash + zombie-flush window -----------------------------
+    def _forge_frozen_binding(self) -> dict:
+        """Recreate the crashed leader's in-memory wreckage: pods its
+        commit pipeline had marked BINDING whose flush outcome the
+        successor cannot know.  Two deterministic specimens — one
+        whose bind DID land (the cluster holds it Bound: reconcile
+        must ADOPT it) and one whose bind never landed (the cluster
+        still holds it Pending: reconcile must roll it back) — picked
+        from sorted cluster state, so same-seed runs forge the same
+        wreckage."""
+        forged = {"adopted": 0, "rolled_back": 0}
+        with self.cluster._lock:
+            bound = sorted(
+                uid for uid, p in self.cluster.pods.items()
+                if p.status in (TaskStatus.BOUND, TaskStatus.RUNNING)
+                and p.node is not None
+            )
+            pending = sorted(
+                uid for uid, p in self.cluster.pods.items()
+                if p.status == TaskStatus.PENDING
+            )
+            nodes = sorted(self.cluster.nodes)
+            landed_node = (
+                self.cluster.pods[bound[0]].node if bound else None
+            )
+        if bound:
+            # The bind landed on the wire but the ack/echo died with
+            # the leader: locally the pod is frozen BINDING.
+            self.cache.update_pod_status(
+                bound[0], TaskStatus.BINDING, node=landed_node
+            )
+            forged["adopted"] += 1
+        if pending and nodes:
+            # The bind was enqueued but never reached the wire.
+            self.cache.update_pod_status(
+                pending[0], TaskStatus.BINDING, node=nodes[0]
+            )
+            forged["rolled_back"] += 1
+        return forged
+
+    def _zombie_window(self, zombie, detail: dict) -> None:
+        """The dead incarnation's flush workers fire AFTER the
+        successor leads: deterministic data-plane writes through the
+        still-open old connection, stamped with the dead epoch.  Every
+        one must come back StaleEpoch — an accepted zombie bind is a
+        double-bind across leaders, the corruption this whole PR
+        exists to prevent."""
+        from kube_batch_tpu.client.adapter import StaleEpochError
+        from kube_batch_tpu.client.codec import encode_pod_group
+
+        with self.cluster._lock:
+            bound = sorted(
+                (uid, p.node) for uid, p in self.cluster.pods.items()
+                if p.status in (TaskStatus.BOUND, TaskStatus.RUNNING)
+                and p.node is not None
+            )
+            nodes = sorted(self.cluster.nodes)
+            groups = sorted(self.cluster.groups)
+        writes: list[dict] = []
+        if bound and len(nodes) >= 2:
+            # The nastiest zombie: re-bind an ALREADY-PLACED pod to a
+            # different node (a retried flush overtaking the crash).
+            uid, node = bound[0]
+            other = next(n for n in nodes if n != node)
+            writes.append({"verb": "bind", "pod": uid, "node": other})
+        if groups:
+            with self.cluster._lock:
+                group = self.cluster.groups[groups[0]]
+            writes.append({
+                "verb": "updatePodGroup",
+                "object": encode_pod_group(group),
+            })
+        writes = writes[: max(self.faults.zombie_writes, 0)]
+        rejected = 0
+        for payload in writes:
+            self._zombie_attempted += 1
+            try:
+                zombie._call(payload)
+                self._zombie_accepted += 1  # invariant violation
+            except StaleEpochError:
+                rejected += 1
+            except Exception as exc:  # noqa: BLE001 — a dead zombie
+                # wire is a harness bug (the crash keeps it open)
+                raise ChaosEngineError(
+                    f"zombie write failed outside the fence: {exc}"
+                ) from exc
+        detail["zombie"] = {
+            "attempted": len(writes), "rejected": rejected,
+            "accepted": self._zombie_accepted,
+        }
+
+    def _leader_crash(self, detail: dict) -> None:
+        """Kill the leader mid-commit and take over as a second
+        elector instance, end to end through the real wire stack:
+
+        1. forge the crashed leader's frozen-BINDING wreckage;
+        2. the lease EXPIRES cluster-side (renewals stopped) — no
+           release, exactly like a real crash;
+        3. the engine restarts as a fresh elector identity on a fresh
+           connection; the dead incarnation's connection stays OPEN;
+        4. the successor wins the lease at a strictly higher epoch;
+        5. the zombie-flush window fires through the dead connection
+           and must be rejected write-for-write;
+        6. the successor runs the SHARED takeover reconciliation
+           (client/failover.py — the same helper the CLI recontend
+           path runs) and the scheduler re-arms."""
+        from kube_batch_tpu.client.failover import reconcile_takeover
+
+        zombie = self.backend
+        zombie_epoch = self._epoch
+        zombie_sock = self._sched_sock
+        zombie_adapter = self.adapter
+        self._forged = self._forge_frozen_binding()
+        self.cluster.expire_lease()
+        self._have_lease = False
+        # Second elector instance: fresh holder, fresh connection,
+        # fresh StreamBackend (NOT backend.reconnect — the zombie must
+        # keep its correlation state so its flushes genuinely race).
+        self._incarnation += 1
+        self._holder = f"{LEASE_HOLDER}-r{self._incarnation}"
+        self.backend = None
+        self._connect(replay=False)
+        new_epoch = self.backend.acquire_lease(self._holder, LEASE_TTL)
+        self.backend.set_epoch(new_epoch)
+        self._epoch = new_epoch
+        self._have_lease = True
+        self._crash_epochs = (int(zombie_epoch or 0), int(new_epoch))
+        detail["old_epoch"], detail["new_epoch"] = self._crash_epochs
+        # Rewire the cache's write seams onto the successor's backend
+        # (the old seam would flush into the zombie connection).  The
+        # failover scenario runs guardrail-free; combining it with
+        # breaker faults would reset breaker counters here.
+        seam = self.backend
+        if self.guardrails is not None:
+            seam = self.guardrails.guard_backend(
+                self.backend, self.cache, name="chaos-wire",
+                clock=lambda: float(self.cluster.tick_now),
+            )
+        self.cache.binder = seam
+        self.cache.evictor = seam
+        self.cache.status_updater = seam
+        # Zombie-flush window BEFORE reconcile: the stale writes race
+        # the takeover, not the recovered steady state.
+        self._zombie_window(zombie, detail)
+        summary = reconcile_takeover(
+            self.cache, self.backend, self.adapter,
+            commit=self.commit, epoch=new_epoch,
+        )
+        self._reconcile_summary = summary
+        detail["reconcile"] = summary
+        self.scheduler.on_takeover()
+        self.recovery_counts["leader-takeover"] += 1
+        metrics.chaos_recoveries.inc("leader-takeover")
+        # Collect the corpse: sever the dead incarnation's connection.
+        try:
+            zombie_sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        deadline = time.monotonic() + self.quiesce_timeout
+        while not zombie_adapter.stopped.wait(0.01):
+            if time.monotonic() > deadline:
+                raise ChaosEngineError(
+                    "zombie adapter never stopped after its sever"
+                )
+
     def _maybe_force_gap(self) -> None:
         """A watch-gap fault needs the missed tail to be UNSERVABLE:
         guarantee the cluster moved past the adapter's RV (a benign
@@ -437,12 +625,17 @@ class ChaosEngine:
         """Synchronous per-tick renewal (the tick IS the clock).
         Returns True when this engine currently leads; a lost lease
         stands the scheduler down for the tick, re-acquiring as soon
-        as the usurper lets go — deterministic, no renewal thread."""
+        as the usurper lets go — deterministic, no renewal thread.
+        Every acquire adopts the minted fencing epoch onto the write
+        backend, so data-plane writes are epoch-stamped end to end."""
         try:
             if self._have_lease:
-                self.backend.renew_lease(LEASE_HOLDER, LEASE_TTL)
+                self.backend.renew_lease(self._holder, LEASE_TTL)
             else:
-                self.backend.acquire_lease(LEASE_HOLDER, LEASE_TTL)
+                self._epoch = self.backend.acquire_lease(
+                    self._holder, LEASE_TTL
+                )
+                self.backend.set_epoch(self._epoch)
                 self._have_lease = True
                 if self._lease_lost:
                     self._lease_lost = False
@@ -704,6 +897,8 @@ class ChaosEngine:
                     violations = self._check_guardrails(ticks_run)
                 if not violations and self.commit is not None:
                     violations = self._check_commit(ticks_run)
+                if not violations and self.faults.leader_crash_at:
+                    violations = self._check_failover(ticks_run)
         finally:
             self._teardown()
 
@@ -748,6 +943,7 @@ class ChaosEngine:
             dump_path=dump_path,
             guardrail=self._guardrail_summary(),
             commit=self._commit_summary(),
+            failover=self._failover_summary(),
         )
 
     # -- guardrail invariants ------------------------------------------
@@ -830,6 +1026,80 @@ class ChaosEngine:
         base.update(self.commit.stats())
         base["writes_while_open"] = self._open_tick_writes()
         return base
+
+    # -- failover invariants -------------------------------------------
+    def _check_failover(self, tick: int) -> list[Violation]:
+        """Post-run assertions for the leader-crash scenario: the
+        zombie window was actually exercised (≥1 stale-epoch write
+        ATTEMPTED AND REJECTED), no stale write was accepted, the
+        successor's epoch is strictly higher, and the takeover
+        reconciliation classified the forged wreckage exactly.  The
+        no-double-bind-across-leaders invariant needs no extra check:
+        the wire-log replay spans both leaderships, so an accepted
+        zombie bind already fails the per-tick double-bind check."""
+        out: list[Violation] = []
+        if self.fault_counts.get("leader-crash", 0) < 1:
+            out.append(Violation(
+                "leader-crash-not-fired", tick,
+                "leader_crash_at configured but the crash never fired",
+            ))
+            return out
+        if self.cluster.stale_epoch_rejections < 1:
+            out.append(Violation(
+                "zombie-window-not-exercised", tick,
+                "leader-crash ran but no stale-epoch write was "
+                "attempted and rejected — the fencing path went "
+                "untested",
+            ))
+        if self._zombie_accepted:
+            out.append(Violation(
+                "stale-epoch-write-accepted", tick,
+                f"{self._zombie_accepted} zombie write(s) from the "
+                "dead epoch were ACCEPTED — single-writer-per-epoch "
+                "broken",
+            ))
+        if self._crash_epochs is not None and \
+                self._crash_epochs[1] <= self._crash_epochs[0]:
+            out.append(Violation(
+                "epoch-not-monotonic", tick,
+                f"successor epoch {self._crash_epochs[1]} is not "
+                f"higher than the crashed epoch {self._crash_epochs[0]}",
+            ))
+        if self._reconcile_summary is None:
+            out.append(Violation(
+                "failover-not-reconciled", tick,
+                "the successor never ran the takeover reconciliation",
+            ))
+        elif self._forged is not None and (
+            self._reconcile_summary["adopted"] != self._forged["adopted"]
+            or self._reconcile_summary["rolled_back"]
+            != self._forged["rolled_back"]
+        ):
+            out.append(Violation(
+                "failover-reconcile-mismatch", tick,
+                f"reconcile classified {self._reconcile_summary} but "
+                f"the forged wreckage was {self._forged} — a frozen "
+                "BINDING pod was mis-adopted or mis-rolled-back",
+            ))
+        return out
+
+    def _failover_summary(self) -> dict | None:
+        if not self.faults.leader_crash_at:
+            return None
+        old, new = self._crash_epochs or (0, 0)
+        return {
+            "crashes": self.fault_counts.get("leader-crash", 0),
+            "old_epoch": old,
+            "new_epoch": new,
+            "stale_rejections": self.cluster.stale_epoch_rejections,
+            "zombie_attempted": self._zombie_attempted,
+            "zombie_accepted": self._zombie_accepted,
+            "reconcile": self._reconcile_summary,
+            "epoch_holders": {
+                str(k): v
+                for k, v in sorted(self.cluster.epoch_holders.items())
+            },
+        }
 
     def _check_guardrails(self, tick: int) -> list[Violation]:
         """Post-run assertions that the self-protection layer actually
@@ -922,7 +1192,7 @@ class ChaosEngine:
                 pass
         try:
             if self._have_lease and self.backend is not None:
-                self.backend.release_lease(LEASE_HOLDER)
+                self.backend.release_lease(self._holder)
         except Exception:  # noqa: BLE001 — best effort on the way down
             pass
         for sock in self._socks:

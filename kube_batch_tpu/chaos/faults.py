@@ -87,6 +87,23 @@ class FaultSpec:
     #: program must keep serving.  0 disables.
     hbm_pressure_at: int = 0
 
+    # -- failover faults (doc/design/failover-fencing.md) --------------
+    #: Tick the LEADER CRASHES: its lease expires on the cluster
+    #: without a release, pods it was mid-committing are left frozen
+    #: in BINDING, and the engine restarts as a SECOND elector
+    #: instance (fresh connection, fresh holder identity) that wins a
+    #: strictly higher epoch and runs the takeover reconciliation —
+    #: while the dead incarnation's connection stays OPEN and fires
+    #: the zombie-flush window below.  0 disables.
+    leader_crash_at: int = 0
+    #: Size of the zombie-flush window: data-plane writes the DEAD
+    #: incarnation attempts (through its still-open connection, with
+    #: its stale epoch) AFTER the successor took over — deterministic
+    #: stand-ins for the 16 flush workers that outlive a real crash's
+    #: leadership.  Every one of them must be rejected StaleEpoch;
+    #: one accepted zombie bind is a double-bind across leaders.
+    zombie_writes: int = 2
+
     @classmethod
     def none(cls) -> "FaultSpec":
         return cls(stream_drop_every=0, gap_every=0, bind_fail_pct=0,
@@ -145,6 +162,11 @@ def plan_faults(spec: FaultSpec, seed: int, ticks: int) -> list[dict]:
         events.append({
             "tick": spec.hbm_pressure_at, "op": "fault",
             "kind": "hbm-pressure",
+        })
+    if spec.leader_crash_at:
+        events.append({
+            "tick": spec.leader_crash_at, "op": "fault",
+            "kind": "leader-crash",
         })
     events.sort(key=lambda e: e["tick"])
     return events
@@ -210,6 +232,11 @@ class ChaosCluster(ExternalCluster):
         #: flush leaking through an open breaker is the same bug.
         self.write_requests_by_tick: collections.Counter = \
             collections.Counter()
+        # The fencing epoch of the request CURRENTLY dispatching
+        # (stashed under the cluster lock around super()._handle so
+        # accepted bind/evict log entries carry the epoch that wrote
+        # them — the single-writer-per-epoch invariant's evidence).
+        self._req_epoch: int | None = None
 
     def _handle(self, writer, msg: dict) -> None:
         verb = msg.get("verb")
@@ -225,11 +252,40 @@ class ChaosCluster(ExternalCluster):
             import time
 
             time.sleep(self.response_delay)
-        super()._handle(writer, msg)
+        # RLock: reentrant with super()._handle's own acquisition —
+        # the stash and the dispatch must be atomic against the 16-way
+        # flush fan-out's concurrent requests.
+        with self._lock:
+            self._req_epoch = msg.get("epoch")
+            try:
+                super()._handle(writer, msg)
+            finally:
+                self._req_epoch = None
+
+    # -- epoch instrumentation (ExternalCluster hooks) ------------------
+    def _on_epoch_advance(self, epoch: int, holder: str) -> None:
+        """Every mint rides the wire log (deterministic: acquires are
+        engine-sequenced), so the invariant checker can replay which
+        epoch was current when each write was accepted."""
+        self._log({"op": "epoch-advance", "epoch": epoch,
+                   "holder": holder})
+
+    def _on_stale_reject(self, msg: dict) -> None:
+        """A zombie write was fenced.  Logged (the engine's zombie
+        window fires deterministically, so these entries hash stably)
+        and counted — the failover invariants assert the window was
+        actually exercised."""
+        self._log({
+            "op": "stale-reject",
+            "verb": msg.get("verb") or "k8s",
+            "epoch": msg.get("epoch"),
+        })
 
     # -- structured log -------------------------------------------------
     def _log(self, entry: dict) -> None:
         entry["tick"] = self.tick_now
+        if self._req_epoch is not None and "epoch" not in entry:
+            entry["epoch"] = self._req_epoch
         self.wire_log.append(entry)
 
     # -- bind sabotage + instrumentation -------------------------------
@@ -318,13 +374,18 @@ class ChaosCluster(ExternalCluster):
 
     def steal_lease(self, usurper: str = "chaos-monkey") -> str | None:
         """A rogue holder takes the lease: the rightful holder's next
-        renewal is rejected and it must stand down."""
+        renewal is rejected and it must stand down.  The steal MINTS
+        an epoch — a new writer is a new epoch, so any in-flight
+        write from the deposed holder is fenced from this instant."""
         import time
 
         with self._lock:
             previous = self.lease_holder
             self.lease_holder = usurper
             self.lease_expires = time.monotonic() + 3600.0
+            self.lease_epoch += 1
+            self.epoch_holders[self.lease_epoch] = usurper
+            self._on_epoch_advance(self.lease_epoch, usurper)
             return previous
 
     def return_lease(self) -> None:

@@ -27,6 +27,13 @@ tick.  Checked invariants:
 5. **convergence** (engine-driven, `pending_after_deadline`) — after
    the scenario quiesces, no admissible pod may stay Pending past the
    drain deadline.
+6. **no-stale-epoch-write-accepted / single-writer-per-epoch** — the
+   log carries every lease-epoch mint (``epoch-advance`` entries) and
+   every accepted write's stamping epoch: an accepted bind/evict whose
+   epoch is not the one current AT ACCEPTANCE means a deposed
+   leader's zombie write mutated the world — the split-brain
+   corruption the epoch fence exists to prevent.  ``stale-reject``
+   entries are the fence WORKING and replay as no-ops.
 
 Violations are values, not exceptions: the engine decides to dump the
 flight recorder and exit non-zero.
@@ -64,6 +71,9 @@ class InvariantChecker:
         self._placed: dict[str, str] = {}
         # group → uids ever placed (for gang first-wave detection).
         self._group_placed: dict[str, set[str]] = {}
+        # The lease epoch current at this point of the log replay
+        # (advanced by epoch-advance entries; 0 = no lease yet).
+        self._epoch = 0
 
     # -- per-tick -------------------------------------------------------
     def check_tick(self, tick: int) -> list[Violation]:
@@ -99,6 +109,24 @@ class InvariantChecker:
         first_wave: set[str] = set()
         for e in entries:
             op, uid, group = e["op"], e.get("uid"), e.get("group")
+            if op == "epoch-advance":
+                self._epoch = int(e["epoch"])
+                continue
+            if op == "stale-reject":
+                continue  # the fence working: rejected, nothing mutated
+            if op in ("bind", "evict") and e.get("epoch") is not None \
+                    and int(e["epoch"]) != self._epoch:
+                # An ACCEPTED write stamped with a non-current epoch:
+                # a zombie from a deposed leadership mutated the world
+                # (the log is appended under the cluster lock, so the
+                # epoch current at acceptance is exactly the last
+                # epoch-advance replayed before this entry).
+                violations.append(Violation(
+                    "stale-epoch-write-accepted", tick,
+                    f"{op} of pod {uid} accepted with epoch "
+                    f"{e['epoch']} while epoch {self._epoch} was "
+                    "current — single-writer-per-epoch broken",
+                ))
             if op in ("bind", "bind-fault") and group is not None:
                 attempts[group] = attempts.get(group, 0) + 1
                 if placed_before.get(group, 0) == 0 and \

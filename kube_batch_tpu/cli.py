@@ -57,7 +57,20 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--leader-elect", action="store_true",
                    help="block on --lock-file until leadership acquired")
     p.add_argument("--lock-file", default="/tmp/kube-batch-tpu.lock",
-                   help="leader-election lock file")
+                   help="leader-election lock file (a fencing-epoch "
+                        "counter persists beside it at <lock-file>"
+                        ".epoch)")
+    p.add_argument("--on-lease-lost", choices=("recontend", "exit"),
+                   default="exit",
+                   help="deposed-leader policy after stand-down "
+                        "(write path fenced, scheduling quiesced, "
+                        "commit tail failed fast): 'exit' (default) "
+                        "returns to the supervisor like RunOrDie's "
+                        "OnStoppedLeading; 'recontend' stays up as a "
+                        "standby, re-acquires at a higher epoch, and "
+                        "runs the takeover reconciliation before "
+                        "scheduling resumes "
+                        "(doc/design/failover-fencing.md)")
     p.add_argument("--workload", default=None,
                    help="world spec: a BASELINE config number (1-5) or a "
                         "YAML file of nodes/queues/jobs")
@@ -193,6 +206,36 @@ def build_commit_pipeline(args, cache, guardrails):
         "KB_TPU_WIRE_COMMIT=sync opts out)", args.commit_inflight_max,
     )
     return commit
+
+
+def drain_write_path_then_release(commit, elector, backend=None,
+                                  commit_timeout: float = 10.0,
+                                  event_timeout: float = 5.0) -> None:
+    """Shutdown ordering contract, shared by every wire run mode and
+    pinned by tests/test_cli.py: EVERY asynchronous write path drains
+    BEFORE the lease is released —
+
+        1. commit pipeline (queued bind/status/event flushes),
+        2. the session bind fan-out pool,
+        3. the backend's async event flusher (k8s dialects),
+        4. only then `elector.release()`.
+
+    Releasing first would invite a successor to start solving while
+    the old leader's flushes are still in flight: the epoch fence
+    makes those flushes REJECTABLE, but the clean path should never
+    need the fence — the successor acquires a world with no writes in
+    flight."""
+    if commit is not None:
+        commit.close(timeout=commit_timeout)
+    from kube_batch_tpu.framework.session import shutdown_bind_pool
+
+    shutdown_bind_pool()
+    if backend is not None:
+        drain = getattr(backend, "drain_events", None)
+        if callable(drain):
+            drain(event_timeout)
+    if elector is not None:
+        elector.release()
 
 
 def load_world(spec_arg: str | None, default_queue: str,
@@ -347,6 +390,11 @@ def run_external(args) -> int:
         StreamBackend,
         resume_session,
     )
+    from kube_batch_tpu.client.failover import (
+        reconcile_takeover,
+        resume_leadership,
+        stand_down,
+    )
     from kube_batch_tpu.client.k8s import K8sWatchAdapter
 
     host, _, port = args.cluster_stream.rpartition(":")
@@ -464,6 +512,45 @@ def run_external(args) -> int:
     threading.Thread(target=supervise, daemon=True).start()
 
     elector = None
+    run_state: dict = {}  # "scheduler" once constructed (on_lost races it)
+
+    def on_lease_lost() -> None:
+        """Deposed: stand down (the elector already fenced the write
+        path), then exit to the supervisor or re-contend at a higher
+        epoch per --on-lease-lost.  Runs on the dying renew thread."""
+        stand_down(cache, backend, commit)
+        guardrails.note_leadership("standby", 0, cache)
+        if args.on_lease_lost == "exit":
+            stop.set()
+            return
+        logging.info(
+            "re-contending for the cluster lease as %s", elector.holder
+        )
+        if not elector.acquire(stop):
+            stop.set()
+            return
+        try:
+            # The acquire stamped the NEW epoch onto the backend, so
+            # the reconcile's own status writes carry it; the dead
+            # epoch's leftovers were drained by stand_down.
+            reconcile_takeover(
+                cache, backend, state["adapter"], commit=commit,
+                epoch=elector.epoch,
+            )
+        except (TimeoutError, ConnectionError) as exc:
+            logging.error(
+                "takeover reconcile failed (%s); exiting to the "
+                "supervisor", exc,
+            )
+            stop.set()
+            return
+        resume_leadership(cache, backend, elector.epoch)
+        guardrails.note_leadership("leader", elector.epoch, cache)
+        scheduler = run_state.get("scheduler")
+        if scheduler is not None:
+            scheduler.on_takeover()
+        elector.start_renewing(on_lost=on_lease_lost)
+
     # Everything past a successful acquire runs under the release
     # finally — a sync timeout must not strand the lease until its TTL
     # expires (the next contender would wait out the full 15 s on every
@@ -473,13 +560,15 @@ def run_external(args) -> int:
             elector = LeaseElector(
                 backend, holder=f"{socket.gethostname()}-{os.getpid()}"
             )
+            guardrails.note_leadership("standby", 0)
             logging.info(
                 "contending for the cluster lease as %s", elector.holder
             )
             if not elector.acquire(stop):
                 logging.error("stream died while standing by for the lease")
                 return 1
-            elector.start_renewing(on_lost=stop.set)
+            guardrails.note_leadership("leader", elector.epoch, cache)
+            elector.start_renewing(on_lost=on_lease_lost)
 
         # Wait on whatever adapter is CURRENT: the stream may drop and
         # reconnect during the initial LIST replay, and the resumed
@@ -503,21 +592,18 @@ def run_external(args) -> int:
             profile_dir=args.profile_dir,
             guardrails=guardrails,
         )
+        run_state["scheduler"] = scheduler
         ran = scheduler.run(stop=stop, max_cycles=args.cycles)
         logging.info("stopped after %d cycles", ran)
     except KeyboardInterrupt:
         logging.info("interrupted; shutting down")
     finally:
-        # The final cycle's wire flushes land before the socket dies —
-        # the same drain-on-every-exit-path discipline as the growth
-        # compile threads and the bind fan-out pool.
-        if commit is not None:
-            commit.close(timeout=10.0)
-        from kube_batch_tpu.framework.session import shutdown_bind_pool
-
-        shutdown_bind_pool()
-        if elector is not None:
-            elector.release()
+        # The final cycle's wire flushes land before the socket dies
+        # AND before the lease releases — a successor must acquire a
+        # world with no old-epoch writes in flight (ordering pinned by
+        # tests/test_cli.py; epoch fencing is the backstop for the
+        # crash path, this is the clean path).
+        drain_write_path_then_release(commit, elector, backend)
         state["sock"].close()
     return 0
 
@@ -572,18 +658,56 @@ def run_http(args) -> int:
 
     elector = None
     stop = threading.Event()
+
+    def on_lease_lost() -> None:
+        """Deposed (the elector fenced the backend first): quiesce +
+        drain, then exit or re-contend per --on-lease-lost.  The HTTP
+        dialect's fence is client-side only (a real apiserver cannot
+        reject Binding POSTs by epoch without an admission webhook),
+        which makes the fast local fence the load-bearing half here."""
+        from kube_batch_tpu.client.failover import (
+            resume_leadership,
+            stand_down,
+        )
+
+        stand_down(cache, backend, commit)
+        guardrails.note_leadership("standby", 0, cache)
+        if args.on_lease_lost == "exit":
+            stop.set()
+            return
+        if not elector.acquire(stop):
+            stop.set()
+            return
+        # The HTTP reflectors re-list on their own; a takeover here
+        # re-syncs status truth via the first post-takeover cycle
+        # (Scheduler.on_takeover disarms the idle skip) — the
+        # relist-driven BINDING classification of the stream dialect
+        # has no equivalent trigger because the reflectors never
+        # dropped their LISTs.
+        resume_leadership(cache, backend, elector.epoch)
+        guardrails.note_leadership("leader", elector.epoch, cache)
+        cache.refresh_job_statuses(None)
+        scheduler = run_state.get("scheduler")
+        if scheduler is not None:
+            scheduler.on_takeover()
+        elector.start_renewing(on_lost=on_lease_lost)
+
+    run_state: dict = {}
     try:
         if args.leader_elect:
             elector = HttpLeaseElector(
-                client, holder=f"{socket.gethostname()}-{os.getpid()}"
+                client, holder=f"{socket.gethostname()}-{os.getpid()}",
+                fence_backend=backend,
             )
+            guardrails.note_leadership("standby", 0)
             logging.info(
                 "contending for Lease %s as %s",
                 elector.name, elector.holder,
             )
             if not elector.acquire(stop):
                 return 1
-            elector.start_renewing(on_lost=stop.set)
+            guardrails.note_leadership("leader", elector.epoch, cache)
+            elector.start_renewing(on_lost=on_lease_lost)
 
         if not adapter.wait_for_sync(120.0):
             logging.error("apiserver LIST never completed")
@@ -595,37 +719,63 @@ def run_http(args) -> int:
             profile_dir=args.profile_dir,
             guardrails=guardrails,
         )
+        run_state["scheduler"] = scheduler
         ran = scheduler.run(stop=stop, max_cycles=args.cycles)
         logging.info("stopped after %d cycles", ran)
     except KeyboardInterrupt:
         logging.info("interrupted; shutting down")
     finally:
         # The final cycle's events (evictions, unschedulable
-        # diagnoses) are still on the async flusher's queue; give them
-        # a bounded chance to land before the daemon thread dies.  The
-        # commit pipeline drains FIRST — its flushes feed the event
-        # funnel.
-        if commit is not None:
-            commit.close(timeout=10.0)
-        from kube_batch_tpu.framework.session import shutdown_bind_pool
-
-        shutdown_bind_pool()
-        backend.drain_events(5.0)
+        # diagnoses) are still on the async flusher's queue; every
+        # asynchronous write path drains BEFORE the lease releases
+        # (commit pipeline first — its flushes feed the event funnel),
+        # so a successor acquires a world with no in-flight writes.
+        drain_write_path_then_release(commit, elector, backend)
         mux.close()
-        if elector is not None:
-            elector.release()
     return 0
 
 
-def acquire_leadership(lock_file: str):
+class LocalLease:
+    """A held flock plus its fencing epoch — epoch parity with the
+    wire/HTTP leases so the simulator path exercises the same
+    single-writer discipline.  `close()` releases leadership (the
+    epoch file persists: the NEXT holder mints a higher one)."""
+
+    def __init__(self, file, epoch: int) -> None:
+        self.file = file
+        self.epoch = epoch
+
+    def close(self) -> None:
+        self.file.close()
+
+
+def acquire_leadership(lock_file: str) -> LocalLease:
     """Block until this process holds the flock (≙ leaderelection.
-    RunOrDie's acquire loop).  Returns the held file object — keep it
-    alive; dropping it releases leadership."""
+    RunOrDie's acquire loop).  Returns the held LocalLease — keep it
+    alive; `close()` (or process death) releases leadership.
+
+    Epoch parity with the cluster-side lease: a monotonic counter
+    persisted beside the lock (<lock-file>.epoch) is bumped WHILE
+    HOLDING the flock, so every acquisition observes a strictly
+    higher epoch than any predecessor's — the local-simulator analog
+    of `ExternalCluster._handle_lease` minting lease epochs."""
     f = open(lock_file, "a+")  # noqa: SIM115 — held for process lifetime
     logging.info("waiting for leadership on %s", lock_file)
     fcntl.flock(f, fcntl.LOCK_EX)
-    logging.info("leadership acquired")
-    return f
+    epoch_path = lock_file + ".epoch"
+    epoch = 0
+    try:
+        with open(epoch_path, "r", encoding="utf-8") as ef:
+            epoch = int(ef.read().strip() or 0)
+    except (OSError, ValueError):
+        epoch = 0  # first holder ever, or a corrupt counter: restart
+    epoch += 1
+    tmp_path = epoch_path + ".tmp"
+    with open(tmp_path, "w", encoding="utf-8") as ef:
+        ef.write(f"{epoch}\n")
+    os.replace(tmp_path, epoch_path)  # atomic: no torn counter
+    logging.info("leadership acquired (epoch %d)", epoch)
+    return LocalLease(f, epoch)
 
 
 def honor_jax_platforms() -> None:
@@ -686,8 +836,13 @@ def main(argv: list[str] | None = None) -> int:
     if args.leader_elect:
         # Single-host fallback: flock on a local file.  With a cluster
         # stream configured, leadership contends for the CLUSTER-side
-        # lease instead (see run_external) — cross-host HA.
+        # lease instead (see run_external) — cross-host HA.  The
+        # persisted epoch gives the simulator path fencing parity
+        # (/healthz shows role+epoch here too).
         lock = acquire_leadership(args.lock_file)
+        from kube_batch_tpu import metrics
+
+        metrics.set_leadership("leader", lock.epoch)
 
     cache, sim = load_world(
         args.workload, args.default_queue, args.scheduler_name

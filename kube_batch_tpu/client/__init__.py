@@ -13,18 +13,28 @@ without the Kubernetes dependency.
 
 from kube_batch_tpu.client.adapter import (
     LeaseElector,
+    StaleEpochError,
     StreamBackend,
     WatchAdapter,
     resume_session,
 )
 from kube_batch_tpu.client.external import ExternalCluster
+from kube_batch_tpu.client.failover import (
+    reconcile_takeover,
+    resume_leadership,
+    stand_down,
+)
 from kube_batch_tpu.client.k8s import K8sWatchAdapter
 
 __all__ = [
     "WatchAdapter",
+    "StaleEpochError",
     "StreamBackend",
     "ExternalCluster",
     "LeaseElector",
     "K8sWatchAdapter",
+    "reconcile_takeover",
+    "resume_leadership",
     "resume_session",
+    "stand_down",
 ]

@@ -15,6 +15,14 @@ duplex stream, and reacts to scheduler writes the way a cluster would:
   the reference's leader election (app/server.go · leaderelection.
   RunOrDie): the lock object lives on the CLUSTER, so standbys on
   other hosts contend for it over the wire (VERDICT r3 next #5).
+  Every acquire that changes hands (or revives an expired lease)
+  MINTS a monotonically increasing fencing EPOCH, returned in the
+  response (≙ the Lease's ``spec.leaseTransitions``); data-plane
+  writes carrying an ``epoch`` field are REJECTED with a structured
+  ``StaleEpoch`` error unless it matches the current epoch — a
+  deposed leader's in-flight flush workers can never land zombie
+  writes after a successor takes over
+  (doc/design/failover-fencing.md).
 
 Multiple scheduler sessions may attach (leader + standbys, like
 replicas sharing one apiserver); watch events broadcast to all of
@@ -100,6 +108,13 @@ class ExternalCluster:
         # -- the resourcelock (≙ resourcelock.LeaseLock on the apiserver)
         self.lease_holder: str | None = None
         self.lease_expires: float = 0.0
+        # Fencing epoch: bumped on every acquire that changes hands or
+        # revives an expired lease (≙ leaseTransitions), NEVER reset —
+        # a write stamped with an older epoch is a zombie from a
+        # deposed leader and is rejected below.
+        self.lease_epoch: int = 0
+        self.epoch_holders: dict[int, str] = {}  # audit: epoch → holder
+        self.stale_epoch_rejections = 0
         if reader is not None and writer is not None:
             self.attach(reader, writer)
 
@@ -157,11 +172,18 @@ class ExternalCluster:
                 self._emit_to(w, None, None, None, raw=msg)
 
     def _respond(
-        self, writer: IO[str], rid: int, ok: bool, error: str = ""
+        self, writer: IO[str], rid: int, ok: bool, error: str = "",
+        code: str | None = None, extra: dict | None = None,
     ) -> None:
         msg: dict = {"type": "RESPONSE", "id": rid, "ok": ok}
         if error:
             msg["error"] = error
+        if code:
+            # Structured error class (today: "StaleEpoch") so clients
+            # classify without parsing the human-readable message.
+            msg["code"] = code
+        if extra:
+            msg.update(extra)
         with self._lock:
             self._emit_to(writer, None, None, None, raw=msg)
 
@@ -302,6 +324,8 @@ class ExternalCluster:
             if self.lease_holder == holder:
                 self.lease_holder = None
                 self.lease_expires = 0.0
+                # The epoch is NOT reset: monotonicity is the fencing
+                # guarantee, and the next acquire mints a fresh one.
             self._respond(writer, rid, True)
             return
         ttl = float(msg.get("ttl", 15.0))
@@ -325,9 +349,73 @@ class ExternalCluster:
                 f"{self.lease_expires - now:.1f}s",
             )
             return
+        if verb == "acquireLease" and (
+            self.lease_holder != holder or expired or self.lease_epoch == 0
+        ):
+            # A change of hands (or reviving an expired lease — even by
+            # its previous holder: its pre-expiry in-flight writes are
+            # no longer trustworthy) mints the next epoch.  An
+            # idempotent re-acquire by the live current holder keeps
+            # its epoch.
+            self.lease_epoch += 1
+            self.epoch_holders[self.lease_epoch] = holder
+            self._on_epoch_advance(self.lease_epoch, holder)
         self.lease_holder = holder
         self.lease_expires = now + ttl
-        self._respond(writer, rid, True)
+        self._respond(writer, rid, True,
+                      extra={"epoch": self.lease_epoch})
+
+    def expire_lease(self) -> None:
+        """Force the current lease to expire NOW (≙ the holder's
+        renewals stopping and the TTL running out — a leader crash as
+        the cluster observes it): the next acquire by anyone succeeds
+        and mints a higher epoch.  The holder field is left as the
+        corpse's identity, exactly like a real resourcelock."""
+        with self._lock:
+            self.lease_expires = 0.0
+
+    # Hooks a subclass (chaos/faults.ChaosCluster) can instrument.
+    def _on_epoch_advance(self, epoch: int, holder: str) -> None:
+        pass
+
+    def _on_stale_reject(self, msg: dict) -> None:
+        pass
+
+    @property
+    def FENCED_VERBS(self):  # noqa: N802 — constant-shaped
+        """Data-plane verbs subject to epoch fencing — the ONE
+        canonical set, shared with the client's local fence
+        (client/adapter.py · FENCED_VERBS; lazy import: adapter
+        imports the cache at load time).  Watch/lease/list verbs and
+        the breaker's `ping` probe are NOT fenced: a standby must
+        keep ingesting and probing, and the elector itself is how a
+        deposed leader gets a NEW epoch."""
+        from kube_batch_tpu.client.adapter import FENCED_VERBS
+
+        return FENCED_VERBS
+
+    def _check_epoch(self, writer, msg: dict) -> bool:
+        """True when the request may proceed.  A data-plane write
+        stamped with a non-current epoch is a zombie — rejected with
+        the structured StaleEpoch code (no retry: the caller's
+        leadership is gone, not its wire)."""
+        epoch = msg.get("epoch")
+        if epoch is None:
+            return True  # unfenced caller (no leader election wired)
+        verb = msg.get("verb")
+        if "path" not in msg and verb not in self.FENCED_VERBS:
+            return True
+        if int(epoch) == self.lease_epoch:
+            return True
+        self.stale_epoch_rejections += 1
+        self._on_stale_reject(msg)
+        self._respond(
+            writer, msg["id"], False,
+            f"stale epoch {epoch} (current epoch "
+            f"{self.lease_epoch}, holder {self.lease_holder!r})",
+            code="StaleEpoch",
+        )
+        return False
 
     # -- apiserver-dialect writes (client/k8s_write.py shapes) ----------
     def _find_pod(self, namespace: str, name: str) -> Pod | None:
@@ -500,6 +588,8 @@ class ExternalCluster:
     def _handle(self, writer: IO[str], msg: dict) -> None:
         verb, rid = msg.get("verb"), msg["id"]
         with self._lock:
+            if not self._check_epoch(writer, msg):
+                return  # zombie write from a deposed epoch: rejected
             if "path" in msg:  # apiserver-dialect write
                 self._handle_k8s(writer, msg)
             elif verb == "watchResume":

@@ -516,6 +516,16 @@ class K8sHttpBackend:
 
     def __init__(self, client: _Client) -> None:
         self.client = client
+        # -- leadership fencing (doc/design/failover-fencing.md) --------
+        # A real apiserver cannot enforce fencing epochs on Binding
+        # POSTs without an admission webhook, so the HTTP dialect's
+        # fencing is CLIENT-side only: the epoch (mapped from the
+        # Lease's spec.leaseTransitions by _HttpLeaseLock) is tracked,
+        # and a local fence set at stand-down fails data-plane writes
+        # fast so a deposed leader's flush workers stop writing the
+        # moment the loss is observed.
+        self._epoch: int | None = None
+        self._fenced = False
         import collections
         import time
 
@@ -673,16 +683,43 @@ class K8sHttpBackend:
         """Cheapest possible round trip — the guardrail breaker's
         half-open probe (guardrails.Guardrails.pre_cycle).  GET
         /version touches no resources and answers on any live
-        apiserver; any response at all proves the wire recovered."""
+        apiserver; any response at all proves the wire recovered.
+        Never fenced: the probe is how a standby watches for heal."""
         self.client.request_json("GET", "/version")
 
+    # -- leadership fencing (same surface as StreamBackend) -------------
+    @property
+    def epoch(self) -> int | None:
+        return self._epoch
+
+    def set_epoch(self, epoch: int | None) -> None:
+        self._epoch = epoch
+        self._fenced = False
+
+    def fence(self) -> None:
+        self._fenced = True
+
+    def _check_fence(self) -> None:
+        if self._fenced:
+            from kube_batch_tpu import metrics
+            from kube_batch_tpu.client.adapter import StaleEpochError
+
+            metrics.stale_epoch_writes.inc()
+            raise StaleEpochError(
+                "write fenced locally: leadership lost (stand-down); "
+                "awaiting re-acquire"
+            )
+
     def bind(self, pod: Pod, node_name: str) -> None:
+        self._check_fence()
         self._issue(binding_request(pod, node_name))
 
     def evict(self, pod: Pod, reason: str) -> None:
+        self._check_fence()
         self._issue(evict_request(pod))
 
     def update_pod_group(self, group: PodGroup) -> None:
+        self._check_fence()
         self._issue(pod_group_status_request(
             group, api_version=self.pod_group_api_version(),
         ))
@@ -691,6 +728,12 @@ class K8sHttpBackend:
         self, kind: str, name: str, reason: str, message: str,
         count: int = 1, namespace: str = "default",
     ) -> None:
+        if self._fenced:
+            # Deposed: drop, same as K8sStreamBackend — the successor
+            # narrates the world from here on, and the HTTP dialect's
+            # fence is client-side only, so the async flusher must not
+            # keep POSTing a dead epoch's events.
+            return
         with self._event_lock:
             self._event_seq += 1
             seq = self._event_seq
@@ -733,6 +776,11 @@ class _HttpLeaseLock:
         )
         # (renewTime string last seen, local monotonic when first seen)
         self._observed: tuple[str | None, float] = (None, 0.0)
+        #: Fencing epoch of the last successful take: mapped onto the
+        #: Lease's spec.leaseTransitions (+1 so the first leader gets
+        #: epoch 1, matching the wire dialect) — a takeover bumps
+        #: transitions, so a re-contended epoch is strictly higher.
+        self.last_epoch: int | None = None
 
     @staticmethod
     def _now() -> str:
@@ -752,8 +800,17 @@ class _HttpLeaseLock:
             return False
         return _time.monotonic() - since > ttl
 
-    def _try_take(self, holder: str, ttl: float) -> bool:
-        """One CAS attempt; True when `holder` now holds the Lease."""
+    def _try_take(self, holder: str, ttl: float,
+                  renewal: bool = False) -> bool:
+        """One CAS attempt; True when `holder` now holds the Lease.
+        `renewal` distinguishes the renew loop's keep-alive (never
+        bumps leaseTransitions) from an ACQUIRE: an acquire that finds
+        the Lease still naming `holder` is a revival after a
+        stand-down (the elector only re-enters acquire after a
+        definitive loss), and must bump transitions — the wire
+        dialect mints a fresh epoch for a revived-expired lease even
+        by its previous holder, and the strictly-higher-epoch contract
+        holds across transports."""
         from kube_batch_tpu.client.adapter import FatalElectionError
 
         try:
@@ -782,6 +839,7 @@ class _HttpLeaseLock:
                 }
                 try:
                     self.client.request_json("POST", self.collection, body)
+                    self.last_epoch = 1  # transitions 0 → first epoch
                     return True
                 except HttpError as exc2:
                     if exc2.status == 409:
@@ -799,7 +857,10 @@ class _HttpLeaseLock:
                 "leaseDurationSeconds": int(ttl),
                 "renewTime": self._now(),
             })
-            if current != holder:
+            if current != holder or not renewal:
+                # Change of hands, OR a non-renewal take by the
+                # previous holder (revival after stand-down): new
+                # writer incarnation, new epoch.
                 spec["acquireTime"] = self._now()
                 spec["leaseTransitions"] = int(
                     spec.get("leaseTransitions") or 0
@@ -807,6 +868,9 @@ class _HttpLeaseLock:
             lease["spec"] = spec
             try:
                 self.client.request_json("PUT", self.path, lease)
+                self.last_epoch = int(
+                    spec.get("leaseTransitions") or 0
+                ) + 1
                 return True
             except HttpError as exc:
                 if exc.status == 409:
@@ -825,12 +889,13 @@ class _HttpLeaseLock:
             raise ConnectionError(str(exc)) from exc
 
     # -- the backend protocol LeaseElector consumes ---------------------
-    def acquire_lease(self, holder: str, ttl: float) -> None:
+    def acquire_lease(self, holder: str, ttl: float) -> int | None:
         if not self._try_take(holder, ttl):
             raise ConnectionError("lease held by the current leader")
+        return self.last_epoch
 
     def renew_lease(self, holder: str, ttl: float) -> None:
-        if not self._try_take(holder, ttl):
+        if not self._try_take(holder, ttl, renewal=True):
             # Definitive: another identity owns an unexpired Lease
             # (RuntimeError = the renew loop's stand-down signal).
             raise RuntimeError(f"lease lost by {holder}")
@@ -852,17 +917,23 @@ def HttpLeaseElector(
     namespace: str = "kube-system",
     ttl: float = 15.0,
     retry_period: float | None = None,
+    fence_backend=None,
 ):
     """Leader election on a coordination/v1 Lease: the shared
     `LeaseElector` machinery (acquire loop, renew deadline, stand-down,
     release) over the `_HttpLeaseLock` primitive — one election state
     machine for both transports, differing only in the resourcelock
-    (≙ client-go's leaderelection / resourcelock split)."""
+    (≙ client-go's leaderelection / resourcelock split).
+    `fence_backend` (a K8sHttpBackend) is stamped with the acquired
+    epoch (mapped from leaseTransitions) and fenced on loss — the lock
+    primitive here is NOT the write backend, unlike the stream
+    transport, so the pairing must be explicit."""
     from kube_batch_tpu.client.adapter import LeaseElector
 
     elector = LeaseElector(
         _HttpLeaseLock(client, name, namespace), holder,
         ttl=ttl, retry_period=retry_period,
+        fence_backend=fence_backend,
     )
     elector.name = name
     return elector

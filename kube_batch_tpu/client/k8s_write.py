@@ -238,6 +238,21 @@ class K8sStreamBackend(StreamBackend):
                 except (OSError, ValueError):
                     break  # stream dying; retry after reconnect
 
+    def drain_events(self, timeout: float = 5.0) -> bool:
+        """Best-effort blocking flush for teardown (same contract as
+        K8sHttpBackend.drain_events): the FINAL cycle's events must
+        get a bounded chance to land BEFORE the lease is released —
+        cli.drain_write_path_then_release's ordering.  Returns True
+        when the queue emptied in time (a closed stream returns False
+        immediately: nothing can flush)."""
+        deadline = time.monotonic() + timeout
+        self._event_ready.set()
+        while self._event_q and not self.closed.is_set() \
+                and time.monotonic() < deadline:
+            time.sleep(0.02)
+            self._event_ready.set()
+        return not self._event_q
+
     # -- the Binder/Evictor/StatusUpdater seam --------------------------
     def bind(self, pod: Pod, node_name: str) -> None:
         self._call(binding_request(pod, node_name))
@@ -269,7 +284,13 @@ class K8sStreamBackend(StreamBackend):
         stream never blocks the scheduling path here; bind/evict
         failures already surface through their own correlated calls.
         Queued even while the stream is down — the bounded queue
-        carries recent events across a reconnect."""
+        carries recent events across a reconnect.  Fenced writes are
+        dropped at the door, and queued events carry the epoch they
+        were RECORDED under (not the flush-time epoch), so an event
+        queued by a deposed leader is rejected by the cluster's epoch
+        check even if it flushes after a takeover."""
+        if self._fenced:
+            return  # deposed: the successor narrates from here on
         payload = event_request(
             kind, name, reason, message,
             count=count, namespace=namespace,
@@ -278,5 +299,7 @@ class K8sStreamBackend(StreamBackend):
         )
         payload["type"] = "REQUEST"
         payload["id"] = 0  # no waiter; consumer responses are dropped
+        if self._epoch is not None:
+            payload["epoch"] = self._epoch
         self._event_q.append(payload)
         self._event_ready.set()

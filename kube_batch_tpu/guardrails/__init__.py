@@ -242,6 +242,20 @@ class Guardrails:
             self._hbm_blocked = blocked
             self._publish_health()
 
+    def note_leadership(self, role: str, epoch: int | None,
+                        cache=None) -> None:
+        """Election hook: publish role ("leader" | "standby") + fencing
+        epoch to /healthz and the `leader_epoch` gauge, and event the
+        transition — failover runbooks read role+epoch before anything
+        else (doc/design/failover-fencing.md)."""
+        metrics.set_leadership(role, epoch or 0)
+        log.info("leadership: %s (epoch %s)", role, epoch)
+        if cache is not None:
+            cache.record_event(
+                "Scheduler", "election", "LeadershipChanged",
+                f"now {role} at epoch {epoch or 0}",
+            )
+
     @property
     def hbm_blocked(self) -> bool:
         """True while the ceiling is pausing the solve — the scheduler

@@ -333,15 +333,43 @@ hbm_blocked_cycles = REGISTRY.register(Counter(
     "action).",
 ))
 
-# -- /healthz state (set by the guardrail watchdog) --------------------------
+# -- leadership fencing + failover (doc/design/failover-fencing.md) ----------
+leader_epoch = REGISTRY.register(Gauge(
+    "leader_epoch",
+    "Fencing epoch of this process's current leadership (0 = standby "
+    "or no leader election wired); bumps monotonically on every "
+    "change of hands, mirrored by /healthz.",
+))
+# Exposed from process start, same rationale as guardrail_state: a
+# second elector/Scheduler constructed in-process must never erase a
+# live daemon's published epoch — transitions publish via
+# set_leadership only.
+leader_epoch.set(0.0)
+stale_epoch_writes = REGISTRY.register(Counter(
+    "stale_epoch_writes_total",
+    "Data-plane writes rejected by epoch fencing (cluster-side "
+    "StaleEpoch answers plus locally-fenced fast-fails): each one is "
+    "a zombie write from a deposed leadership epoch that was "
+    "PREVENTED from mutating the cluster.",
+))
+failover_recovery = REGISTRY.register(Histogram(
+    "failover_recovery_seconds",
+    "Takeover reconciliation latency: new leadership epoch acquired "
+    "-> relisted world reconciled (BINDING pods classified, PodGroup "
+    "statuses repaired) and scheduling eligible to resume.",
+))
+
+# -- /healthz state (set by the guardrail watchdog + the elector) ------------
 _health_lock = threading.Lock()
 _health_state = "ok"
+_health_role = "standby"
+_health_epoch = 0
 
 
 def set_health_state(state: str) -> None:
-    """Transition the /healthz body (ok | degraded | overloaded) —
-    the watchdog's rung, externally observable without scraping
-    metrics (load-balancers and runbooks read this)."""
+    """Transition the /healthz body's `state` (ok | degraded |
+    overloaded) — the watchdog's rung, externally observable without
+    scraping metrics (load-balancers and runbooks read this)."""
     global _health_state
     with _health_lock:
         _health_state = state
@@ -350,6 +378,39 @@ def set_health_state(state: str) -> None:
 def health_state() -> str:
     with _health_lock:
         return _health_state
+
+
+def set_leadership(role: str, epoch: int) -> None:
+    """Publish this process's election role ("leader" | "standby")
+    and fencing epoch to /healthz and the `leader_epoch` gauge — the
+    runbook's first question after a failover is "who leads, and at
+    what epoch" (doc/design/failover-fencing.md)."""
+    global _health_role, _health_epoch
+    with _health_lock:
+        _health_role = role
+        _health_epoch = int(epoch)
+    leader_epoch.set(float(epoch))
+
+
+def leadership() -> tuple[str, int]:
+    with _health_lock:
+        return _health_role, _health_epoch
+
+
+def health_body() -> bytes:
+    """The /healthz response body: one JSON object carrying the
+    guardrail ladder state plus election role + fencing epoch.
+    (Plain-text "ok" grew fields in the failover PR; probes matching
+    the old body should switch to `.state`.)"""
+    import json
+
+    with _health_lock:
+        body = {
+            "state": _health_state,
+            "role": _health_role,
+            "epoch": _health_epoch,
+        }
+    return json.dumps(body, sort_keys=True).encode()
 
 
 def serve(address: str = ":8080") -> threading.Thread:
@@ -369,9 +430,10 @@ def serve(address: str = ":8080") -> threading.Thread:
                 # probes see degradation without scraping /metrics.
                 # Always 200: a degraded daemon is still the leader
                 # and must not be LB-evicted into a failover storm.
-                body = health_state().encode()
+                # Body: {"state": ..., "role": ..., "epoch": N}.
+                body = health_body()
                 self.send_response(200)
-                self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)

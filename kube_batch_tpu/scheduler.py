@@ -898,6 +898,15 @@ class Scheduler:
                         by=float(len(ssn.evicted) - before)
                     )
 
+    def on_takeover(self) -> None:
+        """Arm the first post-failover cycle: a new leadership epoch
+        must always solve and refresh statuses, never idle-skip — the
+        takeover reconcile (client/failover.py) rebuilt the mirror,
+        and the idle early-out's armed state belongs to the previous
+        epoch's view of the world."""
+        self._idle_armed = False
+        self._idle_refreshed_version = 0
+
     # -- idle early-out (≙ runOnce being near-free on an idle cluster) --
     def _skip_idle(self) -> bool:
         """True when the solve dispatch can be skipped outright: the

@@ -1,0 +1,70 @@
+#!/usr/bin/env python
+"""Assert the leader-crash failover chaos acceptance criteria over two
+same-seed runs (make chaos):
+
+* both runs completed with zero invariant violations and converged;
+* the zombie-flush window was actually EXERCISED: at least one
+  stale-epoch write was attempted through the dead incarnation's
+  still-open connection and REJECTED by the cluster's epoch fence,
+  and ZERO zombie writes were accepted (single-writer-per-epoch /
+  no-double-bind-across-leaders);
+* the successor's epoch is strictly higher than the crashed epoch and
+  the takeover reconciliation classified the crashed leader's frozen
+  BINDING pods (bind landed → adopted, never landed → rolled back);
+* same seed ⇒ same trace hash across the two runs — the failover
+  dance (crash, second elector, zombie window, relist reconcile) is
+  fully deterministic;
+* the pipelined commit queue drained to zero through the crash.
+"""
+
+import json
+import sys
+
+
+def main(path_a: str, path_b: str) -> int:
+    with open(path_a, encoding="utf-8") as f:
+        a = json.load(f)
+    with open(path_b, encoding="utf-8") as f:
+        b = json.load(f)
+    for name, run in (("run1", a), ("run2", b)):
+        assert run["ok"], f"{name} violations: {run['violations']}"
+        fo = run["failover"]
+        assert fo is not None, f"{name}: no failover summary"
+        assert fo["crashes"] >= 1, fo
+        assert fo["stale_rejections"] >= 1, \
+            f"{name}: zombie window never exercised: {fo}"
+        assert fo["zombie_attempted"] >= 1, fo
+        assert fo["zombie_accepted"] == 0, \
+            f"{name}: a stale-epoch write was ACCEPTED: {fo}"
+        assert fo["new_epoch"] > fo["old_epoch"], fo
+        rec = fo["reconcile"]
+        assert rec is not None, f"{name}: takeover never reconciled"
+        # BOTH classification branches must run: a bind that landed is
+        # adopted, a bind that never landed rolls back to Pending.
+        assert rec["adopted"] >= 1, \
+            f"{name}: bind-landed branch not exercised: {rec}"
+        assert rec["rolled_back"] >= 1, \
+            f"{name}: bind-lost branch not exercised: {rec}"
+        commit = run["commit"]
+        if commit.get("mode") == "pipelined":
+            assert commit["depth"] == 0, f"{name} undrained: {commit}"
+            assert commit["order_violations"] == 0, commit
+            assert commit["flush_errors"] == 0, commit
+    assert a["trace_hash"] == b["trace_hash"], (
+        f"same-seed failover runs diverged: "
+        f"{a['trace_hash']} != {b['trace_hash']}"
+    )
+    fo = a["failover"]
+    print(
+        "chaos failover: ok — same-seed hash "
+        f"{a['trace_hash'][:16]}… reproduced; epoch "
+        f"{fo['old_epoch']}→{fo['new_epoch']} takeover rejected "
+        f"{fo['stale_rejections']} zombie write(s), reconcile adopted "
+        f"{fo['reconcile']['adopted']} / rolled back "
+        f"{fo['reconcile']['rolled_back']}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1], sys.argv[2]))

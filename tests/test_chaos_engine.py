@@ -93,6 +93,8 @@ def test_trace_applies_to_in_process_sim():
 
 # -- the three headline properties -------------------------------------
 
+@pytest.mark.slow  # double engine run (determinism class); plain
+# `pytest tests/` and `make verify` still run it
 def test_same_seed_identical_trace_and_assignment(tmp_path):
     trace = tmp_path / "scenario.jsonl"
     r1 = _engine(trace_path=str(trace)).run()

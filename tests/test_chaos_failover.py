@@ -1,0 +1,121 @@
+"""Chaos × failover: leader crash, epoch fencing, zombie-flush window
+and takeover reconciliation through the REAL wire stack.
+
+One seeded scenario kills the leader mid-commit (its lease expires
+un-released, pods frozen in BINDING), restarts the engine as a SECOND
+elector instance that wins a strictly higher epoch, fires a
+zombie-flush window through the dead incarnation's still-open
+connection (every stale-epoch write must be rejected — one accepted
+zombie bind is a double-bind across leaders), and runs the shared
+takeover reconciliation (client/failover.py — the identical helper the
+CLI recontend path uses).
+
+The engine asserts the failover invariants itself
+(engine._check_failover: zombie-window-exercised, zero accepted stale
+writes, epoch monotonicity, reconcile classification) plus the
+per-tick wire-log epoch replay (invariants.py:
+stale-epoch-write-accepted / single-writer-per-epoch), so `result.ok`
+carries them all; the tests below pin the observable summary and
+same-seed reproducibility.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from kube_batch_tpu.chaos import ChaosEngine, FaultSpec, ScenarioSpec
+
+# Overcommitted little world: arrivals outrun capacity slightly
+# (target_utilization > 1) so a Pending backlog exists at the crash
+# tick — the reconcile must exercise BOTH branches (a bind that landed
+# AND one that never did).
+SCENARIO = ScenarioSpec(
+    nodes=4,
+    arrival_rate=2.5,
+    burst_every=6,
+    burst_size=3,
+    gang_max=3,
+    lifetime_mean=8.0,
+    node_churn_every=0,
+    target_utilization=1.1,
+)
+FAULTS = FaultSpec(
+    stream_drop_every=0, gap_every=0, bind_fail_pct=10,
+    node_vanish_every=0, lease_steal_every=0,
+    leader_crash_at=10, zombie_writes=2,
+)
+
+
+def _run(seed: int = 13, wire_commit: str = "pipelined"):
+    return ChaosEngine(
+        seed=seed, ticks=18, scenario=SCENARIO, faults=FAULTS,
+        drain=40, wire_commit=wire_commit,
+    ).run()
+
+
+def test_leader_crash_fenced_takeover_and_reconcile():
+    result = _run()
+    # ok folds in the engine's failover invariants AND the wire-log
+    # epoch replay: zombie-window-not-exercised,
+    # stale-epoch-write-accepted, epoch-not-monotonic,
+    # failover-reconcile-mismatch, double-bind (across leaders),
+    # commit-not-drained all land in violations.
+    assert result.ok, [v.as_dict() for v in result.violations]
+    fo = result.failover
+    assert fo is not None
+    assert fo["crashes"] == 1
+    # The zombie window fired through the dead connection and EVERY
+    # stale-epoch write was attempted-and-rejected; none accepted.
+    assert fo["zombie_attempted"] >= 1
+    assert fo["stale_rejections"] >= 1
+    assert fo["zombie_accepted"] == 0
+    # The successor's epoch is strictly higher, under a new identity.
+    assert fo["new_epoch"] > fo["old_epoch"]
+    assert len(set(fo["epoch_holders"].values())) == 2
+    # The takeover reconciliation classified the crashed leader's
+    # frozen BINDING pods — both branches.
+    rec = fo["reconcile"]
+    assert rec["adopted"] >= 1, rec
+    assert rec["rolled_back"] >= 1, rec
+    # The successor converged the full workload (all gangs placed)
+    # with the pipeline drained — clean takeover, no zombie damage.
+    assert result.converged_tick is not None
+    assert result.commit["depth"] == 0
+    assert result.commit["order_violations"] == 0
+    assert result.commit["flush_errors"] == 0
+    assert result.recoveries.get("leader-takeover") == 1
+
+
+def test_leader_crash_meta_fields_survive_replay():
+    """leader_crash_at / zombie_writes change run behavior (the crash
+    dance + window size are not derivable from the inline schedule),
+    so they ride the trace meta header and are adopted on replay."""
+    meta = {"tick": -1, "op": "meta", "seed": 13, "bind_fail_pct": 10,
+            "leader_crash_at": 10, "zombie_writes": 3}
+    eng = ChaosEngine(seed=13, ticks=18, events=[meta])
+    assert eng.faults.leader_crash_at == 10
+    assert eng.faults.zombie_writes == 3
+    assert eng.guardrails is None  # failover needs no guardrail wiring
+
+
+@pytest.mark.slow  # double engine run; kept out of the tier-1 budget
+def test_failover_same_seed_same_hash():
+    """The whole failover dance — crash, second elector, zombie
+    rejections, relist reconcile — is deterministic: same seed ⇒ same
+    trace hash (epoch-advance and stale-reject entries included) and
+    same final assignment."""
+    a, b = _run(), _run()
+    assert a.ok and b.ok
+    assert a.trace_hash == b.trace_hash
+    assert a.final_assignment == b.final_assignment
+    assert a.failover["new_epoch"] == b.failover["new_epoch"]
+
+
+@pytest.mark.slow  # sync-mode run on top of the tier-1 pipelined one
+def test_failover_survives_sync_commit_mode_too():
+    """The fence is commit-mode-agnostic: the sync path's inline binds
+    carry epochs the same way the pipelined flush workers do."""
+    result = _run(wire_commit="sync")
+    assert result.ok, [v.as_dict() for v in result.violations]
+    assert result.failover["zombie_accepted"] == 0
+    assert result.failover["stale_rejections"] >= 1

@@ -54,6 +54,9 @@ def _run(seed: int = 11):
     ).run()
 
 
+@pytest.mark.slow  # soak-scale (~37 s) and fully covered by `make
+# chaos`, which runs the identical scenario twice plus the pipelined
+# check script; plain `pytest tests/` still runs it
 def test_guardrail_scenario_ladder_breaker_and_ceiling():
     from kube_batch_tpu import metrics
 
@@ -105,7 +108,8 @@ def test_replayed_trace_meta_restores_guardrail_fault_spec():
     meta = {"tick": -1, "op": "meta", "seed": 11, "bind_fail_pct": 0,
             "slow_at": 5, "slow_ticks": 8, "slow_response_s": 0.4,
             "blackhole_at": 18, "blackhole_ticks": 6,
-            "hbm_pressure_at": 27}
+            "hbm_pressure_at": 27, "leader_crash_at": 0,
+            "zombie_writes": 2}
     eng = ChaosEngine(seed=11, ticks=32, events=[meta])
     for field in _META_FAULT_FIELDS:
         assert getattr(eng.faults, field) == meta[field]

@@ -90,6 +90,65 @@ def test_leader_election_blocks_second_acquirer(tmp_path):
     again.close()
 
 
+def test_flock_elector_epoch_parity(tmp_path):
+    """The local flock elector mints monotonically increasing epochs
+    (persisted beside the lock file) — fencing parity with the wire
+    and HTTP leases, so the simulator path exercises the same
+    single-writer discipline."""
+    lock_path = str(tmp_path / "leader.lock")
+    first = acquire_leadership(lock_path)
+    assert first.epoch == 1
+    first.close()
+    second = acquire_leadership(lock_path)
+    assert second.epoch == 2  # strictly higher than any predecessor
+    second.close()
+    # The counter survives as a file beside the lock.
+    assert (tmp_path / "leader.lock.epoch").read_text().strip() == "2"
+    # A corrupt counter restarts rather than crashing the daemon.
+    (tmp_path / "leader.lock.epoch").write_text("not-a-number")
+    third = acquire_leadership(lock_path)
+    assert third.epoch == 1
+    third.close()
+
+
+def test_shutdown_drains_write_paths_before_release(monkeypatch):
+    """The shutdown ordering contract: commit pipeline, bind pool and
+    the async event flusher ALL drain BEFORE the lease releases — a
+    successor acquires a world with no in-flight writes from the old
+    epoch (cli.drain_write_path_then_release; run_external and
+    run_http both route through it)."""
+    from kube_batch_tpu.cli import drain_write_path_then_release
+
+    order: list[str] = []
+
+    class FakeCommit:
+        def close(self, timeout=None):
+            order.append("commit")
+
+    class FakeBackend:
+        def drain_events(self, timeout=None):
+            order.append("events")
+
+    class FakeElector:
+        def release(self):
+            order.append("release")
+
+    import kube_batch_tpu.framework.session as session_mod
+
+    monkeypatch.setattr(
+        session_mod, "shutdown_bind_pool",
+        lambda: order.append("bind-pool"),
+    )
+    drain_write_path_then_release(FakeCommit(), FakeElector(),
+                                  FakeBackend())
+    assert order == ["commit", "bind-pool", "events", "release"]
+
+    # Degenerate wirings keep the same order with the pieces present.
+    order.clear()
+    drain_write_path_then_release(None, FakeElector(), object())
+    assert order == ["bind-pool", "release"]
+
+
 def test_cluster_stream_mode_end_to_end():
     """`--cluster-stream HOST:PORT --leader-elect` drives a remote
     cluster over real TCP: LIST replay builds the cache, binds flow

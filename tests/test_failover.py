@@ -1,0 +1,242 @@
+"""Stand-down + crash-failover reconciliation (client/failover.py).
+
+The three acts of a leadership change, pinned at tier-1:
+
+* stand-down — a deposed leader fences, quiesces, and fails its
+  queued commit tail fast (no wire RTT per op, no zombie mutation);
+* reconciliation, bind-LANDED case — a pod frozen in BINDING whose
+  bind reached the cluster before the crash is ADOPTED as bound from
+  the relisted truth, never re-placed;
+* reconciliation, bind-LOST case — a frozen BINDING pod whose bind
+  never landed rolls back to Pending with an event and a fresh
+  scheduling-latency clock.
+"""
+
+from __future__ import annotations
+
+import socket
+import time
+
+from kube_batch_tpu import metrics
+from kube_batch_tpu.actions import BUILTIN_ACTIONS  # noqa: F401
+from kube_batch_tpu.api.resource import ResourceSpec
+from kube_batch_tpu.api.types import TaskStatus
+from kube_batch_tpu.cache.cache import CacheResyncing, SchedulerCache
+from kube_batch_tpu.cache.cluster import Node, Pod, PodGroup
+from kube_batch_tpu.client import (
+    ExternalCluster,
+    StreamBackend,
+    WatchAdapter,
+    reconcile_takeover,
+    resume_leadership,
+    stand_down,
+)
+from kube_batch_tpu.framework.commit import CommitPipeline
+from kube_batch_tpu.models.workloads import GI
+from kube_batch_tpu.plugins import BUILTIN_PLUGINS  # noqa: F401
+
+SPEC = ResourceSpec(("cpu", "memory", "pods", "accelerator"))
+
+
+def _world(pods: int = 4):
+    """One cluster (nodes + a gang) and one attached wire session."""
+    cluster = ExternalCluster().start()
+    for i in range(2):
+        cluster.add_node(Node(
+            name=f"n{i}",
+            allocatable={"cpu": 8000, "memory": 16 * GI, "pods": 110},
+        ))
+    cluster.submit(
+        PodGroup(name="gang", queue="default", min_member=pods),
+        [Pod(name=f"p{i}", uid=f"uid-p{i}",
+             request={"cpu": 1000, "memory": GI, "pods": 1})
+         for i in range(pods)],
+    )
+    a, b = socket.socketpair()
+    cl_r = a.makefile("r", encoding="utf-8")
+    cl_w = a.makefile("w", encoding="utf-8")
+    sch_r = b.makefile("r", encoding="utf-8")
+    sch_w = b.makefile("w", encoding="utf-8")
+    cluster.attach(cl_r, cl_w)
+    cluster.replay(cl_w)
+    backend = StreamBackend(sch_w, timeout=5.0)
+    cache = SchedulerCache(
+        SPEC, binder=backend, evictor=backend, status_updater=backend
+    )
+    adapter = WatchAdapter(cache, sch_r, backend=backend).start()
+    assert adapter.wait_for_sync(5.0)
+    return cluster, backend, cache, adapter
+
+
+def test_stand_down_fails_queued_tail_fast_and_quiesces():
+    """A deposed leader with a queued pipelined-commit tail: fence +
+    quiesce + drain completes in well under one wire timeout — each
+    fenced op fails locally into the cache's rollback/resync funnels
+    (pods back to Pending, zero cluster mutations) and the mirror is
+    unschedulable until leadership resumes."""
+    import pytest
+
+    cluster, backend, cache, _adapter = _world(pods=4)
+    commit = CommitPipeline(cache=cache)
+    cache.commit = commit
+    try:
+        backend.set_epoch(backend.acquire_lease("old", ttl=30.0))
+        backend.fence()  # what the elector does the moment renewal fails
+
+        # The dead epoch's enqueued-but-unflushed commit tail.
+        for i in range(4):
+            assert cache.begin_bind(f"uid-p{i}", "n0")
+            commit.submit_bind(f"uid-p{i}", "n0")
+
+        t0 = time.monotonic()
+        assert stand_down(cache, backend, commit)
+        took = time.monotonic() - t0
+        assert took < 4.0, f"stand-down drain took {took:.1f}s"
+
+        assert commit.idle()
+        assert cluster.binds == []  # no fenced op touched the wire
+        with cache.lock():
+            assert all(
+                cache._pods[f"uid-p{i}"].status == TaskStatus.PENDING
+                for i in range(4)
+            )
+        assert sorted(cache.drain_resync()) == [
+            f"uid-p{i}" for i in range(4)
+        ]
+        with pytest.raises(CacheResyncing):
+            cache.snapshot()  # quiesced: a non-leader must not solve
+
+        # Re-acquire at a higher epoch lifts the fence and the hold.
+        epoch = backend.acquire_lease("old", ttl=30.0)
+        resume_leadership(cache, backend, epoch)
+        cache.snapshot()  # no raise
+        assert metrics.leadership() == ("leader", epoch)
+    finally:
+        commit.close(timeout=5.0)
+
+
+def test_reconcile_adopts_landed_bind_and_rolls_back_lost_one():
+    """Takeover reconciliation over the relisted world: the dead
+    epoch's bind that LANDED is adopted (pod Bound, never re-placed),
+    the one that never landed rolls back to Pending with an event and
+    a fresh latency clock; stale PodGroup statuses are recomputed."""
+    cluster, backend, cache, adapter = _world(pods=4)
+    before = metrics.failover_recovery.count()
+
+    # The dead leader's last acts: p0's bind LANDED on the cluster but
+    # the ack died with the leader; p1's bind never reached the wire.
+    backend.set_epoch(backend.acquire_lease("dead-leader", ttl=0.01))
+    backend.bind(Pod(name="p0", uid="uid-p0", request={}), "n0")
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        with cache.lock():
+            if cache._pods["uid-p0"].status == TaskStatus.BOUND:
+                break
+        time.sleep(0.01)
+    # Freeze BOTH in BINDING — the successor's inherited view.
+    cache.update_pod_status("uid-p0", TaskStatus.BINDING, node="n0")
+    cache.update_pod_status("uid-p1", TaskStatus.BINDING, node="n1")
+
+    # The successor takes over at a higher epoch and reconciles.
+    time.sleep(0.05)  # the dead lease expires
+    epoch = backend.acquire_lease("successor", ttl=30.0)
+    backend.set_epoch(epoch)
+    summary = reconcile_takeover(
+        cache, backend, adapter, epoch=epoch
+    )
+    assert summary["adopted"] == 1
+    assert summary["rolled_back"] == 1
+    assert summary["vanished"] == 0
+    # Repairs count actual status RE-WRITES (the full sweep ran, but
+    # only changed groups cost a wire round trip).
+    assert summary["repaired_groups"] >= 0
+
+    with cache.lock():
+        p0, p1 = cache._pods["uid-p0"], cache._pods["uid-p1"]
+        assert p0.status == TaskStatus.BOUND and p0.node == "n0"
+        assert p1.status == TaskStatus.PENDING and p1.node is None
+        # The rolled-back pod restarts its scheduling-latency clock.
+        assert "uid-p1" in cache._arrival_ts
+    assert not cache.is_resyncing()  # relist hold released
+    assert cache.events_for("Pod", "p0")[-1].reason == "FailoverAdopted"
+    assert cache.events_for("Pod", "p1")[-1].reason == "FailoverRolledBack"
+    assert metrics.failover_recovery.count() == before + 1
+
+    # The classification events survive; a second reconcile (fresh
+    # leader, nothing frozen) classifies nothing.
+    summary2 = reconcile_takeover(cache, backend, adapter, epoch=epoch)
+    assert summary2["adopted"] == summary2["rolled_back"] == 0
+
+
+def test_reconcile_counts_vanished_pods():
+    """A frozen BINDING pod the relisted world no longer contains
+    (deleted during the failover window) classifies as vanished —
+    neither adopted nor rolled back.  The ghost lives only in the
+    crashed leader's inherited mirror, so the classification is
+    deterministic (no watch race)."""
+    _cluster, backend, cache, adapter = _world(pods=2)
+    backend.set_epoch(backend.acquire_lease("dead", ttl=0.01))
+    cache.add_pod(Pod(name="ghost", uid="uid-ghost", group="gang",
+                      request={"cpu": 1000, "pods": 1}))
+    cache.update_pod_status("uid-ghost", TaskStatus.BINDING, node="n0")
+    time.sleep(0.05)
+    epoch = backend.acquire_lease("successor", ttl=30.0)
+    backend.set_epoch(epoch)
+    summary = reconcile_takeover(cache, backend, adapter, epoch=epoch)
+    assert summary["vanished"] == 1
+    assert summary["adopted"] == summary["rolled_back"] == 0
+    with cache.lock():
+        assert "uid-ghost" not in cache._pods
+
+
+def test_stale_epoch_is_app_level_for_the_breaker():
+    """StaleEpoch is 'the wire answered': the guardrail layer must
+    NOT retry it (a zombie write retried is still a zombie write) and
+    must count it as breaker SUCCESS — a deposed leader's rejections
+    must never trip the breaker open over a healthy wire."""
+    import pytest
+
+    from kube_batch_tpu.client.adapter import StaleEpochError
+    from kube_batch_tpu.guardrails import (
+        Backoff,
+        CircuitBreaker,
+        GuardedBackend,
+        is_transient,
+    )
+
+    assert not is_transient(StaleEpochError("stale epoch 1"))
+
+    class Fenced:
+        calls = 0
+
+        def bind(self, pod, node):
+            self.calls += 1
+            raise StaleEpochError("stale epoch 1 (current 2)")
+
+        def ping(self):
+            pass
+
+    inner = Fenced()
+    breaker = CircuitBreaker(trip_after=1)  # hair trigger
+    guarded = GuardedBackend(
+        inner, breaker=breaker,
+        backoff=Backoff(attempts=3, base=0.001), sleep=lambda s: None,
+    )
+    with pytest.raises(StaleEpochError):
+        guarded.bind(object(), "n0")
+    assert inner.calls == 1           # never retried
+    assert breaker.state == CircuitBreaker.CLOSED  # counted as success
+
+
+def test_scheduler_on_takeover_disarms_idle_skip():
+    """The first post-takeover cycle must always solve — the idle
+    early-out's armed state belongs to the previous epoch's view."""
+    from kube_batch_tpu.models.workloads import build_config
+    from kube_batch_tpu.scheduler import Scheduler
+
+    cache, _sim = build_config(1)
+    scheduler = Scheduler(cache)
+    scheduler.run_once()
+    assert scheduler._idle_armed
+    scheduler.on_takeover()
+    assert not scheduler._idle_armed

@@ -171,6 +171,192 @@ def test_standby_takeover_after_leader_death_mid_cycle():
     assert all(n.startswith("b-") for n in b_binds)
 
 
+def test_lease_epoch_minted_monotonic():
+    """Every change of hands (or revival of an expired lease) mints a
+    strictly higher epoch; an idempotent re-acquire by the live holder
+    keeps its epoch (doc/design/failover-fencing.md)."""
+    cluster = ExternalCluster().start()
+    a, *_ = _session(cluster)
+    b, *_ = _session(cluster)
+
+    assert a.acquire_lease("host-a", ttl=5.0) == 1
+    assert a.acquire_lease("host-a", ttl=5.0) == 1  # idempotent: same
+    a.release_lease("host-a")
+    assert b.acquire_lease("host-b", ttl=5.0) == 2  # handover: higher
+    b.release_lease("host-b")
+    assert a.acquire_lease("host-a", ttl=5.0) == 3
+    assert cluster.epoch_holders == {1: "host-a", 2: "host-b",
+                                     3: "host-a"}
+
+
+def test_stale_epoch_write_rejected_no_mutation():
+    """The fencing tentpole: once a successor holds a higher epoch,
+    the deposed leader's data-plane writes are rejected StaleEpoch —
+    no retry (app-level, breaker 'wire answered'), no mutation — while
+    unfenced sessions (no election wired) keep writing."""
+    import pytest
+
+    from kube_batch_tpu.client.adapter import StaleEpochError
+
+    cluster = ExternalCluster().start()
+    cluster.add_node(Node(
+        name="n0", allocatable={"cpu": 8000, "memory": 16 * GI, "pods": 110},
+    ))
+    cluster.submit(
+        PodGroup(name="g", queue="default", min_member=1),
+        [Pod(name="p0", uid="uid-p0",
+             request={"cpu": 1000, "memory": GI, "pods": 1})],
+    )
+    old, *_ = _session(cluster)
+    new, *_ = _session(cluster)
+
+    old.set_epoch(old.acquire_lease("leader-old", ttl=0.01))
+    time.sleep(0.05)  # the old leader's lease expires (crash analog)
+    new.set_epoch(new.acquire_lease("leader-new", ttl=30.0))
+    assert new.epoch > old.epoch
+
+    # The zombie write: rejected, counted, and NOTHING moved.
+    with pytest.raises(StaleEpochError):
+        old.bind(Pod(name="p0", uid="uid-p0", request={}), "n0")
+    assert cluster.stale_epoch_rejections == 1
+    assert cluster.binds == []
+    assert cluster.pods["uid-p0"].node is None
+
+    # The current epoch binds fine; so does an UNFENCED session.
+    new.bind(Pod(name="p0", uid="uid-p0", request={}), "n0")
+    assert cluster.binds == [("p0", "n0")]
+
+    unfenced, *_ = _session(cluster)
+    unfenced.evict(Pod(name="p0", uid="uid-p0", request={}), "test")
+    assert cluster.evictions == [("p0", "test")]
+
+
+def test_local_fence_fails_fast_without_wire():
+    """`fence()` fails data-plane writes locally (stand-down's fast
+    path for the queued commit tail) while lease verbs stay live —
+    re-acquiring is how the fence lifts."""
+    import pytest
+
+    from kube_batch_tpu.client.adapter import StaleEpochError
+
+    cluster = ExternalCluster().start()
+    backend, *_ = _session(cluster)
+    backend.set_epoch(backend.acquire_lease("h", ttl=5.0))
+    backend.fence()
+    writes_before = len(cluster.k8s_writes) + len(cluster.binds)
+    with pytest.raises(StaleEpochError):
+        backend.bind(Pod(name="x", uid="uid-x", request={}), "n0")
+    with pytest.raises(StaleEpochError):
+        backend.update_pod_group(PodGroup(name="g", queue="q"))
+    assert len(cluster.k8s_writes) + len(cluster.binds) == writes_before
+    backend.release_lease("h")  # lease verbs pass the fence
+    backend.set_epoch(backend.acquire_lease("h", ttl=5.0))  # lifts it
+    assert backend.epoch is not None
+
+
+class _FlakyLock:
+    """Fake resourcelock: scripted renew outcomes for the elector's
+    transient-vs-lost classification test."""
+
+    def __init__(self, outcomes) -> None:
+        self.outcomes = list(outcomes)
+        self.renews = 0
+        self.epoch = 0
+
+    def acquire_lease(self, holder, ttl):
+        self.epoch += 1
+        return self.epoch
+
+    def renew_lease(self, holder, ttl):
+        self.renews += 1
+        outcome = self.outcomes.pop(0) if self.outcomes else None
+        if outcome is not None:
+            raise outcome
+
+    def release_lease(self, holder):
+        pass
+
+
+def test_renewal_transient_retries_within_ttl_budget():
+    """Slow/dropped renewals (ConnectionError/TimeoutError) RETRY —
+    one hiccup must not stand a healthy leader down; renewals keep
+    going and on_lost never fires while successes land inside the TTL
+    (≙ RenewDeadline)."""
+    lock = _FlakyLock([
+        ConnectionError("blip"), TimeoutError("slow"), None, None,
+    ])
+    elector = LeaseElector(lock, "h", ttl=5.0, retry_period=0.02)
+    assert elector.acquire()
+    lost = threading.Event()
+    elector.start_renewing(on_lost=lost.set)
+    deadline = time.monotonic() + 5.0
+    while lock.renews < 4 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert lock.renews >= 4, "renew loop stalled"
+    assert not lost.is_set()
+    elector._stop.set()
+    elector._thread.join(5.0)
+
+
+def test_renewal_rejected_fires_on_lost_exactly_once():
+    """A definitive rejection (RuntimeError: another holder owns it)
+    fires on_lost EXACTLY once and the renew loop exits; the fence
+    backend is fenced BEFORE on_lost observes the loss."""
+    class _Fenceable(_FlakyLock):
+        def __init__(self, outcomes):
+            super().__init__(outcomes)
+            self.fenced_at: list[str] = []
+
+        def set_epoch(self, epoch):
+            pass
+
+        def fence(self):
+            self.fenced_at.append("fence")
+
+    lock = _Fenceable([None, RuntimeError("lease lost (held by 'b')")])
+    losses: list[str] = []
+    elector = LeaseElector(lock, "h", ttl=5.0, retry_period=0.02)
+    assert elector.fence_backend is lock  # auto-paired: lock IS backend
+    assert elector.acquire()
+    elector.start_renewing(
+        on_lost=lambda: losses.append(
+            "lost-after-fence" if lock.fenced_at else "lost-unfenced"
+        )
+    )
+    deadline = time.monotonic() + 5.0
+    while not losses and time.monotonic() < deadline:
+        time.sleep(0.01)
+    elector._thread.join(5.0)
+    assert losses == ["lost-after-fence"]  # once, and fence came first
+    assert lock.renews == 2  # the loop exited on the rejection
+
+
+def test_recontend_after_loss_acquires_higher_epoch():
+    """A deposed leader that re-contends wins a strictly HIGHER epoch
+    than it lost — the successor's (and its own old) writes can never
+    be confused across the takeover."""
+    cluster = ExternalCluster().start()
+    a, *_ = _session(cluster)
+    b, *_ = _session(cluster)
+
+    elector_a = LeaseElector(a, "host-a", ttl=0.2, retry_period=0.05)
+    assert elector_a.acquire()
+    first_epoch = elector_a.epoch
+    assert a.epoch == first_epoch  # stamped onto the write backend
+
+    time.sleep(0.3)  # a's lease expires un-renewed (crash analog)
+    assert b.acquire_lease("host-b", ttl=0.2) == first_epoch + 1
+
+    lost = threading.Event()
+    elector_a.start_renewing(on_lost=lost.set)
+    assert lost.wait(5.0)
+
+    time.sleep(0.3)  # b's lease expires too; a re-contends
+    assert elector_a.acquire()
+    assert elector_a.epoch > first_epoch + 1
+    assert a.epoch == elector_a.epoch  # fence lifted at the new epoch
+
+
 def test_dead_stream_fails_calls_immediately():
     """Once the stream is gone, EVERY pending and future backend call
     fails at once — a cycle mid-way through dispatching thousands of

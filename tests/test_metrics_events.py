@@ -50,7 +50,14 @@ def test_metrics_http_endpoint():
         health = urllib.request.urlopen(
             f"http://127.0.0.1:{port}/healthz", timeout=5
         ).read().decode()
-        assert health == "ok"
+        # JSON since the failover PR: guardrail ladder state + election
+        # role + fencing epoch (doc/design/failover-fencing.md).
+        import json
+
+        body = json.loads(health)
+        assert body["state"] == "ok"
+        assert body["role"] in ("leader", "standby")
+        assert isinstance(body["epoch"], int)
     finally:
         thread.server.shutdown()
 

@@ -262,6 +262,7 @@ def test_multi_pdb_intersection_blocks_eviction():
     assert ssn.evicted == []
 
 
+@pytest.mark.slow  # soak-scale on the tier-1 host; plain `pytest tests/` still runs it
 def test_multi_pdb_allows_eviction_when_all_floors_permit():
     cache, _sim = _running_world_with_two_pdbs(floor_a=1, floor_b=1)
     ssn = run_cycle(cache, ["allocate", "preempt"])

@@ -36,6 +36,7 @@ def node_of(sim, pod_name):
     return dict(sim.binds).get(pod_name)
 
 
+@pytest.mark.slow  # soak-scale on the tier-1 host; plain `pytest tests/` still runs it
 def test_required_affinity_colocates():
     cache, sim = _world()
     sim.submit(
@@ -202,6 +203,7 @@ def test_preempt_never_evicts_its_own_affinity_anchor():
     assert all(not v.startswith("db") for v, _ in ssn.evicted), ssn.evicted
 
 
+@pytest.mark.slow  # soak-scale on the tier-1 host; plain `pytest tests/` still runs it
 def test_preferred_pod_affinity_steers_scoring():
     cache, sim = _world(n_nodes=3)
     sim.submit(

@@ -143,7 +143,7 @@ def _random_world(seed: int, mode: str):
 # Seeds measured heaviest on the tier-1 host (~8 s each) ride behind
 # the `slow` marker; plain `pytest tests/` still sweeps all of them.
 @pytest.mark.parametrize("seed", [
-    pytest.param(s, marks=pytest.mark.slow) if s in (2, 21) else s
+    pytest.param(s, marks=pytest.mark.slow) if s in (1, 2, 6, 21) else s
     for s in range(30)
 ])
 def test_preempt_fuzz_parity(seed):

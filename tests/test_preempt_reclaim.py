@@ -165,6 +165,7 @@ def test_preempt_rolls_back_when_joint_evictions_would_break_gang():
     )
 
 
+@pytest.mark.slow  # soak-scale on the tier-1 host; plain `pytest tests/` still runs it
 def test_preempt_priority_beats_drf_share_gap():
     """Tier-1 (gang/conformance) is the decisive veto tier under the
     default conf; DRF's tier-2 share veto must NOT bind, or a

@@ -104,6 +104,7 @@ def test_bad_conf_keeps_previous_policy(tmp_path):
     s.run_once()  # recovers once conf is fixed
 
 
+@pytest.mark.slow  # soak-scale on the tier-1 host; plain `pytest tests/` still runs it
 def test_conf_hot_reload_prewarms_asynchronously(tmp_path):
     """An edited conf compiles on a background thread while the OLD
     policy keeps serving; the swap lands in a later cycle once warm —
