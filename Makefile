@@ -60,6 +60,14 @@ run-example:
 # asserts zero violations, same seed ⇒ same trace hash across the two
 # runs, per-pod wire-write order preserved, and the breaker trip
 # draining to zero in-flight writes.
+# The flaky runs are the NODE-HEALTH scenario
+# (doc/design/node-health.md): one seeded node intermittently refuses
+# binds (answered — the breaker must NOT trip) and flaps NotReady
+# below the vanish threshold; the health ledger must quarantine it
+# (zero placements on cordoned ticks), gang-atomically drain its
+# PodGroups, and re-admit it through canary-capped probation after the
+# heal — scripts/check_chaos_flaky.py asserts all of it plus same
+# seed ⇒ same hash across the two runs.
 # The fifth and sixth runs are the FAILOVER scenario
 # (doc/design/failover-fencing.md): a leader crash mid-commit, a
 # second elector instance taking over at a higher epoch, a zombie-
@@ -88,6 +96,14 @@ chaos:
 	    --quiet > /tmp/kb-chaos-failover-2.json
 	$(PY) scripts/check_chaos_failover.py /tmp/kb-chaos-failover-1.json \
 	    /tmp/kb-chaos-failover-2.json
+	JAX_PLATFORMS=cpu $(PY) -m kube_batch_tpu.chaos --seed 17 --ticks 32 \
+	    --scenario examples/chaos-flaky.json --wire-commit pipelined \
+	    --quiet > /tmp/kb-chaos-flaky-1.json
+	JAX_PLATFORMS=cpu $(PY) -m kube_batch_tpu.chaos --seed 17 --ticks 32 \
+	    --scenario examples/chaos-flaky.json --wire-commit pipelined \
+	    --quiet > /tmp/kb-chaos-flaky-2.json
+	$(PY) scripts/check_chaos_flaky.py /tmp/kb-chaos-flaky-1.json \
+	    /tmp/kb-chaos-flaky-2.json
 
 profile:
 	$(PY) -m kube_batch_tpu --workload 2 --cycles 3 --schedule-period 0 \

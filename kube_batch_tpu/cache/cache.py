@@ -121,6 +121,14 @@ class HostSnapshot:
     pdbs: dict[str, PodDisruptionBudget] = dataclasses.field(
         default_factory=dict
     )
+    # -- node-health view (kube_batch_tpu/health/) ----------------------
+    # Quarantined node names (masked out of new placements via the
+    # packed node_ready bit; residents stay) and, for probation nodes,
+    # the remaining canary placements (clamped into the pod-slot idle
+    # at pack time).  Filled from the attached ledger at snapshot time;
+    # empty when no ledger is wired.
+    cordoned: frozenset = frozenset()
+    canary_pods: dict = dataclasses.field(default_factory=dict)
 
 
 class SchedulerCache:
@@ -204,6 +212,14 @@ class SchedulerCache:
         # RTT.  None (the default, and the in-process simulator path)
         # keeps every commit synchronous and inline.
         self.commit = None
+        # Per-node health ledger (kube_batch_tpu/health/), attached by
+        # Scheduler/CLI wiring via attach_health().  The commit funnel
+        # feeds it node-attributed bind failures (transport ANSWERED —
+        # wire deaths stay the breaker's business), update_node feeds
+        # it condition flaps, and snapshot()/the packers read its
+        # cordon/canary view.  None = subsystem disabled (every hook
+        # below is a no-op).
+        self.health = None
         # True when scheduling decisions leave the process in apiserver
         # dialect (--write-format k8s / --kube-api): known divergences
         # from upstream API semantics are then surfaced per decision —
@@ -214,6 +230,15 @@ class SchedulerCache:
         self.k8s_write_format = False
 
         self.add_queue(Queue(name=default_queue, weight=1.0))
+
+    # -- node-health wiring (kube_batch_tpu/health/) --------------------
+
+    def attach_health(self, ledger) -> None:
+        """Wire a NodeHealthLedger into the cache's funnels (and give
+        the ledger its journal/event callbacks).  Idempotent."""
+        self.health = ledger
+        if ledger is not None:
+            ledger.attach_cache(self)
 
     # -- incremental-pack change journal --------------------------------
 
@@ -498,7 +523,13 @@ class SchedulerCache:
         """Replace a node's API object (readiness/labels/taints/
         allocatable changes from the adapter; ≙ UpdateNode).  Capacity
         accounting is re-derived: allocatable may have changed, and
-        idle = allocatable − used must track it.  Unknown node → add."""
+        idle = allocatable − used must track it.  Unknown node → add.
+
+        Degradation signals observed here feed the health ledger
+        (OUTSIDE the lock — the ledger fires cache callbacks of its
+        own): a Ready condition turning false, or a pressure condition
+        turning on, is a flap the quarantine score accrues."""
+        flaps: list[str] = []
         with self._lock:
             info = self._nodes.get(node.name)
             if info is None:
@@ -509,17 +540,34 @@ class SchedulerCache:
                 info.node = node
                 info.allocatable = self.spec.vec(node.allocatable)
                 info.idle = info.allocatable - info.used
+                if old.is_ready and not node.is_ready:
+                    flaps.append("NotReady")
+                for kind, was, now in (
+                    ("MemoryPressure", old.memory_pressure,
+                     node.memory_pressure),
+                    ("DiskPressure", old.disk_pressure,
+                     node.disk_pressure),
+                    ("PIDPressure", old.pid_pressure, node.pid_pressure),
+                ):
+                    if now and not was:
+                        flaps.append(kind)
                 # Label/taint changes shift vocabularies (and topology
-                # domains); a readiness flip changes the packed node SET
-                # (snapshot filters unready nodes) — both need a rebuild.
+                # domains); an effective-readiness flip changes the
+                # packed node SET (snapshot filters unready nodes) —
+                # both need a rebuild.  An unschedulable (cordon) or
+                # pressure flip is row-local: the node stays packed,
+                # only its node_ready / node_pressure row changes.
                 if (
                     dict(old.labels) != dict(node.labels)
                     or set(old.taints) != set(node.taints)
-                    or old.ready != node.ready
+                    or old.is_ready != node.is_ready
                 ):
                     self._mark_full("node-object-changed")
                 else:
                     self._mark_node(node.name)
+        if flaps and self.health is not None:
+            for kind in flaps:
+                self.health.note_flap(node.name, kind)
 
     def delete_node(self, name: str) -> None:
         with self._lock:
@@ -535,6 +583,11 @@ class SchedulerCache:
                     # (same rule as update_pod_status -> PENDING).
                     self._arrival_ts.setdefault(pod.uid, time.monotonic())
                 self._mark_full("node-deleted")
+        if info is not None and self.health is not None:
+            # A deleted node's health record dies with it (outside the
+            # lock — the ledger touches metrics): a decommissioned
+            # cordoned node must not count as quarantined forever.
+            self.health.forget(name)
 
     def add_pod_group(self, group: PodGroup) -> None:
         with self._lock:
@@ -693,6 +746,16 @@ class SchedulerCache:
                     "cache mirror is quiesced (mid-relist or breaker "
                     "open); skip this cycle"
                 )
+            # One ledger read per snapshot: quarantined nodes mask out
+            # of new placements via the packed node_ready bit (they
+            # STAY in the snapshot — residents keep their accounting);
+            # probation nodes get their pod-slot idle clamped to the
+            # remaining canary.  pack_view touches only ledger state,
+            # so taking it under the cache lock is lock-order safe.
+            if self.health is not None:
+                cordoned, canary = self.health.pack_view()
+            else:
+                cordoned, canary = frozenset(), {}
             if shared:
                 jobs = {
                     name: job.clone()
@@ -702,7 +765,7 @@ class SchedulerCache:
                 nodes = {
                     name: info.clone()
                     for name, info in self._nodes.items()
-                    if info.node.ready
+                    if info.node.is_ready
                 }
                 queues = {
                     name: QueueInfo(queue=q.queue)
@@ -717,6 +780,8 @@ class SchedulerCache:
                     storage_classes=dict(self._storage_classes),
                     namespaces=dict(self._namespaces),
                     pdbs=dict(self._pdbs),
+                    cordoned=cordoned,
+                    canary_pods=dict(canary),
                 )
             # copy.copy, not dataclasses.replace: replace re-runs
             # __init__/__post_init__ per pod (measured ~0.2 s for 50k
@@ -731,7 +796,7 @@ class SchedulerCache:
             nodes = {
                 name: info.clone(pod_map)
                 for name, info in self._nodes.items()
-                if info.node.ready
+                if info.node.is_ready
             }
             queues = {name: QueueInfo(queue=q.queue) for name, q in self._queues.items()}
             return HostSnapshot(
@@ -743,6 +808,8 @@ class SchedulerCache:
                 storage_classes=dict(self._storage_classes),
                 namespaces=dict(self._namespaces),
                 pdbs=dict(self._pdbs),
+                cordoned=cordoned,
+                canary_pods=dict(canary),
             )
 
     # -- commit funnel (≙ cache.go · Bind / Evict) -----------------------
@@ -763,6 +830,7 @@ class SchedulerCache:
         pod BINDING on its node.  Returns False (with resync + event
         for a vanished node) when there is nothing to flush — the pod
         was deleted between decision and commit, or the node is gone."""
+        health = self.health
         with self._lock:
             pod = self._pods.get(pod_uid)
             if pod is None:
@@ -777,7 +845,24 @@ class SchedulerCache:
                     namespace=pod.namespace,
                 )
                 return False
+            if health is not None and not health.schedulable(node_name):
+                # The node quarantined between snapshot and commit: a
+                # placement decided against the pre-cordon pack must
+                # not land on it — resync, the next cycle's (masked)
+                # pack re-places the pod elsewhere.
+                self._resync.append(pod_uid)
+                self.record_event(
+                    "Pod", pod.name, "BindFailed",
+                    f"bind-refused: node {node_name} is cordoned",
+                    namespace=pod.namespace,
+                )
+                return False
             self.update_pod_status(pod_uid, TaskStatus.BINDING, node=node_name)
+        if health is not None:
+            # Canary accounting at COMMIT time (not wire ack): two
+            # in-flight flushes must not both look like the first
+            # canary placement on a probation node.
+            health.note_placement(node_name)
         return True
 
     def finish_bind(self, pod_uid: str, node_name: str) -> bool:
@@ -790,7 +875,10 @@ class SchedulerCache:
         if pod is None:
             # Deleted while the flush was queued (the relist path
             # drains the pipeline BEFORE clearing the mirror, so this
-            # is a plain racing delete): nothing to bind or roll back.
+            # is a plain racing delete): nothing to bind or roll back
+            # — and the committed canary slot returns with it.
+            if self.health is not None:
+                self.health.note_placement_failed(node_name)
             return False
         try:
             # Volumes first (≙ cache.go binding VolumeBinder.AllocateVolumes
@@ -806,6 +894,24 @@ class SchedulerCache:
             self.record_event("Pod", pod.name, "BindFailed",
                               f"bind-failed: {exc}",
                               namespace=pod.namespace)
+            # Failure ATTRIBUTION (doc/design/node-health.md): a
+            # rejection whose transport ANSWERED is the node (or the
+            # request) refusing — that is per-node health evidence,
+            # never wire-death evidence, so it feeds the ledger and
+            # NOT the breaker's streak (GuardedBackend already counts
+            # app-level answers as breaker success).  Transient wire
+            # errors (timeouts, BreakerOpen, 5xx) stay global: one
+            # dead wire must not cordon the fleet node by node.  A
+            # StaleEpochError is neither — leadership is gone, the
+            # successor owns the pod.
+            if self.health is not None:
+                if not is_transient(exc) and not self._is_stale_epoch(exc):
+                    self.health.note_bind_failure(node_name, str(exc))
+                else:
+                    # The placement never ran on the node (wire died /
+                    # leadership moved): return its probation canary
+                    # slot — a blip must not burn trust untested.
+                    self.health.note_placement_failed(node_name)
             return False
         with self._lock:
             # The successful bind consumes the stamp.  update_pod_status
@@ -818,6 +924,8 @@ class SchedulerCache:
             self.update_pod_status(pod_uid, TaskStatus.BOUND)
         if ts is not None:
             metrics.task_scheduling_latency.observe(time.monotonic() - ts)
+        if self.health is not None:
+            self.health.note_bind_success(node_name)
         metrics.pods_bound.inc()
         self.record_event("Pod", pod.name, "Bound", f"bound -> {node_name}",
                           namespace=pod.namespace)

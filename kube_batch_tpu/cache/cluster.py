@@ -160,7 +160,42 @@ class Node:
     memory_pressure: bool = False
     disk_pressure: bool = False
     pid_pressure: bool = False
+    # ≙ core/v1 Node spec.unschedulable (kubectl cordon): the node
+    # keeps its residents but admits no new placements.  Folded into
+    # the packed node_ready bit alongside the health ledger's
+    # quarantine mask (cache/packer.py), NOT into `ready` — a
+    # cordoned node is healthy and must stay in the snapshot so its
+    # accounting holds.
+    unschedulable: bool = False
+    # ≙ node.status.conditions as a type → status map ({"Ready":
+    # False, "MemoryPressure": True, ...}).  The pressure booleans
+    # above remain the fast-path mirror the packer consumes; this map
+    # carries the full condition set so dialects that speak
+    # conditions round-trip them (and `is_ready` folds an explicit
+    # Ready=False in even when the bare `ready` bool was left True).
+    conditions: Mapping[str, bool] = dataclasses.field(default_factory=dict)
     uid: str = dataclasses.field(default_factory=lambda: _new_uid("node"))
+
+    @property
+    def is_ready(self) -> bool:
+        """Effective readiness: the bare `ready` bool AND any explicit
+        Ready condition.  The snapshot's node filter consumes this, so
+        a NotReady condition makes the node unschedulable even before
+        the health ledger quarantines it."""
+        return self.ready and bool(self.conditions.get("Ready", True))
+
+    def schedulable(self, cordoned: frozenset = frozenset()) -> bool:
+        """May NEW placements target this node — ready, not cordoned
+        (neither by spec.unschedulable nor by the health ledger's
+        `cordoned` set)?  The ONE definition of the packed node_ready
+        bit: the full pack, the incremental row patch, its verify
+        check, and the drain's target filter all call this — a fourth
+        mask term added here reaches every consumer at once."""
+        return (
+            self.is_ready
+            and not self.unschedulable
+            and self.name not in cordoned
+        )
 
 
 @dataclasses.dataclass

@@ -194,8 +194,9 @@ class IncrementalPacker:
         for uid in d.status_pods:
             self._patch_status(uid, changed)
         if d.nodes:
+            view = self._health_view()
             for name in d.nodes:
-                self._patch_node(name, changed)
+                self._patch_node(name, changed, view)
             real_n = len(ints.node_names)
             a["cluster_total"] = (
                 a["node_cap"][:real_n].sum(axis=0).astype(np.float32)
@@ -401,16 +402,43 @@ class IncrementalPacker:
 
     # -- nodes ----------------------------------------------------------
 
-    def _patch_node(self, name: str, changed: set[str]) -> None:
+    def _health_view(self) -> tuple[frozenset, dict, int | None]:
+        """(cordoned names, probation canary remaining, pods-dim index)
+        from the cache's attached health ledger — the incremental
+        twin of the full pack reading HostSnapshot.cordoned/
+        canary_pods.  Empty views when no ledger is wired."""
+        health = getattr(self.cache, "health", None)
+        if health is not None:
+            cordoned, canary = health.pack_view()
+        else:
+            cordoned, canary = frozenset(), {}
+        names = self.cache.spec.names
+        pods_ix = names.index("pods") if "pods" in names else None
+        return cordoned, canary, pods_ix
+
+    def _patch_node(self, name: str, changed: set[str],
+                    view: tuple | None = None) -> None:
         row = self._node_row.get(name)
         if row is None:
             return  # unready/deleted: excluded from the pack
         info = self.cache._nodes.get(name)
         if info is None:
             return
+        cordoned, canary, pods_ix = (
+            view if view is not None else self._health_view()
+        )
         a = self._ints.arrays
         a["node_cap"][row] = info.allocatable
         a["node_idle"][row] = info.idle
+        # Same health masking as the full pack: cordons (ledger +
+        # spec.unschedulable) fold into node_ready; a probation node's
+        # pod-slot idle clamps to its remaining canary.
+        a["node_ready"][row] = info.node.schedulable(cordoned)
+        cap = canary.get(name)
+        if cap is not None and pods_ix is not None:
+            a["node_idle"][row, pods_ix] = min(
+                a["node_idle"][row, pods_ix], float(cap)
+            )
         a["node_releasing"][row] = info.releasing
         a["node_pressure"][row] = (
             info.node.memory_pressure,
@@ -427,7 +455,7 @@ class IncrementalPacker:
                 raise _FullRebuild("vocab-growth:port")
             a["node_ports"][row, i] = 1.0
         changed.update(("node_cap", "node_idle", "node_releasing",
-                        "node_pressure", "node_ports"))
+                        "node_pressure", "node_ports", "node_ready"))
 
     # -- host-side reads ------------------------------------------------
 
@@ -502,16 +530,33 @@ class IncrementalPacker:
                         f"pod {pod.name}: packed pdb[{bname}] bit "
                         f"{bool(a['task_pdbs'][row, bi])} != live {member}"
                     )
+            cordoned, canary, pods_ix = self._health_view()
             for nname, row in self._node_row.items():
                 info = self.cache._nodes.get(nname)
                 assert info is not None, f"packed node {nname} vanished"
+                expected_idle = info.idle
+                cap = canary.get(nname)
+                if cap is not None and pods_ix is not None:
+                    # The pack deliberately clamps a probation node's
+                    # pod-slot idle to its remaining canary.
+                    expected_idle = expected_idle.copy()
+                    expected_idle[pods_ix] = min(
+                        expected_idle[pods_ix], float(cap)
+                    )
                 # rtol covers the f32 quantization of f64 byte counts.
                 np.testing.assert_allclose(
-                    a["node_idle"][row], info.idle, rtol=1e-5, err_msg=nname
+                    a["node_idle"][row], expected_idle, rtol=1e-5,
+                    err_msg=nname,
                 )
                 np.testing.assert_allclose(
                     a["node_releasing"][row], info.releasing, rtol=1e-5,
                     err_msg=nname,
+                )
+                want_ready = info.node.schedulable(cordoned)
+                assert bool(a["node_ready"][row]) == want_ready, (
+                    f"node {nname}: packed ready bit "
+                    f"{bool(a['node_ready'][row])} != live {want_ready} "
+                    "(cordon/unschedulable mask out of sync)"
                 )
             for jname, row in self._job_row.items():
                 job = self.cache._jobs.get(jname)

@@ -332,6 +332,29 @@ def pack_snapshot_full(
         ).astype(np.float32)
     else:
         node_cap = node_idle = node_rel = np.zeros((0, spec.num), np.float32)
+    # -- node-health view (kube_batch_tpu/health/) ----------------------
+    # Quarantined and externally-cordoned (spec.unschedulable) nodes
+    # fold into the node_ready bit: still IN the snapshot (residents
+    # keep their accounting, preempt can still evict them) but masked
+    # out of every placement, pipelining and preemption target — the
+    # predicates plugin, ops/preemption and fit_errors all consume
+    # this one bit.  Probation nodes re-admit canary-capped: their
+    # visible pod-slot idle is clamped to the remaining canary, so the
+    # solver can place at most that many new pods per pack.
+    cordoned = host.cordoned
+    node_ready_np = np.array(
+        [host.nodes[n].node.schedulable(cordoned) for n in node_names],
+        dtype=bool,
+    ) if node_names else np.zeros(0, bool)
+    canary = host.canary_pods
+    if canary and node_names and "pods" in spec.names:
+        pods_ix = spec.index("pods")
+        for ni, n in enumerate(node_names):
+            cap = canary.get(n)
+            if cap is not None:
+                node_idle[ni, pods_ix] = min(
+                    node_idle[ni, pods_ix], float(cap)
+                )
     node_labels = _multi_hot(
         [
             [lab_idx[f"{k}={v}"] for k, v in host.nodes[n].node.labels.items()]
@@ -542,11 +565,7 @@ def pack_snapshot_full(
         "node_labels": pad_rows(node_labels, Np),
         "node_taints": pad_rows(node_taints, Np),
         "node_ports": pad_rows(node_ports, Np),
-        "node_ready": pad_rows(
-            np.array([host.nodes[n].node.ready for n in node_names], dtype=bool),
-            Np,
-            False,
-        ),
+        "node_ready": pad_rows(node_ready_np, Np, False),
         "node_pressure": pad_rows(node_pressure, Np),
         "node_mask": pad_rows(np.ones(N, bool), Np, False),
         "queue_weight": pad_rows(queue_weight, Qp),

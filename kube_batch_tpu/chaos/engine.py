@@ -109,7 +109,19 @@ _META_FAULT_FIELDS = (
     "bind_fail_pct", "slow_at", "slow_ticks", "slow_response_s",
     "blackhole_at", "blackhole_ticks", "hbm_pressure_at",
     "leader_crash_at", "zombie_writes",
+    "flaky_at", "flaky_ticks", "flaky_fail_pct", "flaky_flap_every",
+    "flaky_drain_budget",
 )
+
+# -- node-health fault tuning (active only when FaultSpec.flaky_at is
+#    set; see faults.FaultSpec.health_faults) ---------------------------
+#: Quarantine threshold in suspicion points: low enough that a short
+#: flaky window cordons within a few ticks of refusals/flaps.
+HEALTH_QUARANTINE_THRESHOLD = 3.0
+#: Clean ticks per probation stage — small so cordon → probation → ok
+#: completes inside the scenario's drain window.
+HEALTH_PROBATION_TICKS = 4
+HEALTH_PROBATION_CANARY = 2
 
 #: Commit-pipeline drain bound per tick (wall seconds): under a
 #: blackhole each queued op burns its wire timeout × retry attempts
@@ -137,6 +149,11 @@ class ChaosResult:
     #: pipeline's own stats — max depth, order violations (must be 0),
     #: flush errors (must be 0), final depth after drain (must be 0).
     commit: dict | None = None
+    #: Node-health observability (None unless the flaky fault ran):
+    #: cordon/probation-failure counts, refused binds, placements that
+    #: leaked onto cordoned nodes (must be 0), canary overruns (must
+    #: be 0), drain evictions, final ledger states.
+    health: dict | None = None
     #: Failover observability (None unless a leader-crash ran): the
     #: crashed/successor epochs, zombie-window accounting (attempted /
     #: rejected / accepted — accepted MUST be 0), the takeover
@@ -157,6 +174,7 @@ class ChaosResult:
             "guardrail": self.guardrail,
             "commit": self.commit,
             "failover": self.failover,
+            "health": self.health,
         }
 
 
@@ -263,12 +281,36 @@ class ChaosEngine:
         self._crash_epochs: tuple[int, int] | None = None  # (old, new)
         self._reconcile_summary: dict | None = None
         self._forged: dict | None = None     # forged BINDING census
+        # -- node-health state (flaky-node fault) ----------------------
+        # The flaky fault drives the scheduler with a NodeHealthLedger
+        # (clocked in cycles == ticks, deterministic) AND a Guardrails
+        # instance: the breaker must be LIVE so the run asserts that a
+        # flaky node's answered refusals never trip it.
+        self.health = None
+        self._flaky_victim: str | None = None
+        self._health_by_tick: dict[int, dict] = {}
+        self._cordoned_placements = 0
+        self._canary_overruns = 0
+        if self.faults.health_faults:
+            from kube_batch_tpu.health import (
+                NodeHealthConfig,
+                NodeHealthLedger,
+            )
+
+            self.health = NodeHealthLedger(NodeHealthConfig(
+                quarantine_threshold=HEALTH_QUARANTINE_THRESHOLD,
+                probation_ticks=HEALTH_PROBATION_TICKS,
+                probation_canary=HEALTH_PROBATION_CANARY,
+                drain_cordoned=self.faults.flaky_drain_budget > 0,
+                drain_budget=self.faults.flaky_drain_budget,
+            ))
         # Guardrail wiring: any guardrail fault in the spec makes the
         # driven scheduler carry a Guardrails instance, its breaker
         # clocked off the TICK counter (reset windows count ticks, not
-        # wall seconds — same-seed runs stay reproducible).
+        # wall seconds — same-seed runs stay reproducible).  Health
+        # faults wire one too (see above).
         self.guardrails = None
-        if self.faults.guardrail_faults:
+        if self.faults.guardrail_faults or self.faults.health_faults:
             from kube_batch_tpu.guardrails import (
                 GuardrailConfig,
                 Guardrails,
@@ -415,6 +457,40 @@ class ChaosEngine:
             self._leader_crash(detail)
             self.fault_counts[kind] += 1
             metrics.chaos_faults_injected.inc(kind)
+        elif kind == "flaky-node":
+            # Victim resolved at fire time from the SORTED live node
+            # set — deterministic, like the vanish target.
+            with self.cluster._lock:
+                names = sorted(self.cluster.nodes)
+            if not names:
+                detail["skipped"] = True
+            else:
+                self._flaky_victim = names[0]
+                self.cluster.set_flaky(
+                    self._flaky_victim, self.faults.flaky_fail_pct,
+                )
+                detail["node"] = self._flaky_victim
+                self.fault_counts[kind] += 1
+                metrics.chaos_faults_injected.inc(kind)
+        elif kind == "flaky-heal":
+            self.cluster.set_flaky(None)
+            self.recovery_counts["flaky-healed"] += 1
+            metrics.chaos_recoveries.inc("flaky-healed")
+        elif kind == "flaky-flap":
+            if self._flaky_victim is None:
+                detail["skipped"] = True
+            else:
+                self.cluster.flap_node(self._flaky_victim, down=True)
+                detail["node"] = self._flaky_victim
+                self.fault_counts[kind] += 1
+                metrics.chaos_faults_injected.inc(kind)
+        elif kind == "flaky-flap-heal":
+            if self._flaky_victim is None:
+                detail["skipped"] = True
+            else:
+                self.cluster.flap_node(self._flaky_victim, down=False)
+                self.recovery_counts["flap-healed"] += 1
+                metrics.chaos_recoveries.inc("flap-healed")
         elif kind == "hbm-pressure":
             # Compile ONE next-bucket program through the real
             # compile-then-admit path under a 1-byte ceiling: the HBM
@@ -686,6 +762,12 @@ class ChaosEngine:
             metrics.chaos_faults_injected.inc(
                 "bind-fault", by=float(injected)
             )
+        flaky = sum(1 for e in tail if e["op"] == "flaky-bind-fault")
+        if flaky:
+            self.fault_counts["flaky-bind-fault"] += flaky
+            metrics.chaos_faults_injected.inc(
+                "flaky-bind-fault", by=float(flaky)
+            )
 
     # -- the run --------------------------------------------------------
     def run(self) -> ChaosResult:
@@ -791,7 +873,7 @@ class ChaosEngine:
             raise ChaosEngineError("initial LIST replay never synced")
         scheduler = Scheduler(
             self.cache, conf_path=self.conf_path, schedule_period=0.0,
-            guardrails=self.guardrails,
+            guardrails=self.guardrails, health=self.health,
         )
         self.scheduler = scheduler
         checker = InvariantChecker(self.cluster)
@@ -857,7 +939,22 @@ class ChaosEngine:
                     "state": self.guardrails.state,
                     "breaker": state,
                 }
+            if self.health is not None:
+                # End-of-tick ledger sample: feeds the recorder and
+                # the per-tick health invariants (a tick is "fully
+                # cordoned" for a node when both its boundaries say
+                # so, same convention as the breaker-open window).
+                # NOT part of the trace hash.
+                self._health_by_tick[t] = self.health.sample()
+                rec["health"] = {
+                    "states": self._health_by_tick[t]["states"],
+                    "cordons": self._health_by_tick[t]["cordons_total"],
+                }
             found = checker.check_tick(t)
+            if self.health is not None:
+                found = found + self._check_health_tick(
+                    t, rec.get("decisions", ())
+                )
             if found:
                 rec["violations"] = [v.as_dict() for v in found]
                 for v in found:
@@ -881,11 +978,14 @@ class ChaosEngine:
                     violations = one_tick(t, active=False)
                     if violations:
                         break
-                    if self._all_settled() and self._rails_recovered():
+                    if self._all_settled() and self._rails_recovered() \
+                            and self._health_recovered():
                         # Guardrail runs also drain until the ladder
-                        # descends and the breaker closes: "converged"
-                        # means the workload settled AND the daemon is
-                        # back to full service.
+                        # descends and the breaker closes; health runs
+                        # until every quarantined node re-admitted
+                        # through probation: "converged" means the
+                        # workload settled AND the daemon is back to
+                        # full service on full capacity.
                         converged_tick = extra
                         metrics.chaos_convergence_ticks.set(float(extra))
                         break
@@ -899,6 +999,8 @@ class ChaosEngine:
                     violations = self._check_commit(ticks_run)
                 if not violations and self.faults.leader_crash_at:
                     violations = self._check_failover(ticks_run)
+                if not violations and self.faults.health_faults:
+                    violations = self._check_flaky(ticks_run)
         finally:
             self._teardown()
 
@@ -944,6 +1046,7 @@ class ChaosEngine:
             guardrail=self._guardrail_summary(),
             commit=self._commit_summary(),
             failover=self._failover_summary(),
+            health=self._health_summary(),
         )
 
     # -- guardrail invariants ------------------------------------------
@@ -1099,6 +1202,155 @@ class ChaosEngine:
                 str(k): v
                 for k, v in sorted(self.cluster.epoch_holders.items())
             },
+        }
+
+    # -- node-health invariants ----------------------------------------
+    def _health_recovered(self) -> bool:
+        """Full capacity restored: no node still cordoned or stuck in
+        probation (suspect-with-decaying-score is schedulable and
+        counts as recovered)."""
+        if self.health is None:
+            return True
+        states = self.health.sample()["states"]
+        return not any(
+            s in ("cordoned", "probation") for s in states.values()
+        )
+
+    def _check_health_tick(self, tick: int, decisions) -> list[Violation]:
+        """Per-tick health invariants, checked against this tick's
+        drained wire-log decisions and the ledger samples at both tick
+        boundaries:
+
+        * **no-placement-on-cordoned** — zero accepted binds on a node
+          cordoned at the END of both this tick and the previous one
+          (a mid-tick cordon can race binds already dispatched; a
+          FULLY cordoned tick cannot — same windowing as the
+          breaker-open invariant);
+        * **probation-canary-bounded** — binds accepted on a probation
+          node never exceed the canary slots remaining at the start of
+          the tick;
+        * **gang-atomic-drain** — after a tick with drain evictions
+          for a gang, no member of that gang may remain placed on any
+          cordoned node (drain never strands part of a gang on the
+          quarantined hardware)."""
+        out: list[Violation] = []
+        prev = self._health_by_tick.get(tick - 1, {})
+        now = self._health_by_tick.get(tick, {})
+        prev_states = prev.get("states", {})
+        now_states = now.get("states", {})
+        binds_by_node = collections.Counter(
+            e.get("node") for e in decisions if e["op"] == "bind"
+        )
+        for n in sorted(prev_states):
+            if prev_states[n] == "cordoned" and \
+                    now_states.get(n) == "cordoned":
+                c = binds_by_node.get(n, 0)
+                if c:
+                    self._cordoned_placements += c
+                    out.append(Violation(
+                        "placement-on-cordoned", tick,
+                        f"{c} bind(s) accepted on node {n} during a "
+                        "fully cordoned tick — the quarantine mask "
+                        "leaked",
+                    ))
+        for n, remaining in sorted(prev.get(
+            "canary_remaining", {},
+        ).items()):
+            if now_states.get(n) != "probation":
+                # The node left probation DURING this tick (promoted
+                # to OK at on_cycle — the clamp lifted before the
+                # pack — or re-cordoned by a failure): last tick's
+                # remaining no longer bounds this tick's binds.  Same
+                # both-boundaries windowing as the cordon check.
+                continue
+            c = binds_by_node.get(n, 0)
+            if c > remaining:
+                self._canary_overruns += c - remaining
+                out.append(Violation(
+                    "probation-canary-exceeded", tick,
+                    f"{c} bind(s) accepted on probation node {n} with "
+                    f"only {remaining} canary slot(s) remaining",
+                ))
+        drained_groups = sorted({
+            e.get("group") for e in decisions
+            if e["op"] == "evict"
+            and e.get("reason") == "drain-cordoned" and e.get("group")
+        })
+        if drained_groups:
+            cordoned_now = {
+                n for n, s in now_states.items() if s == "cordoned"
+            }
+            with self.cluster._lock:
+                for g in drained_groups:
+                    stuck = sorted(
+                        p.name for p in self.cluster.pods.values()
+                        if p.group == g and p.node in cordoned_now
+                        and p.status in (TaskStatus.BOUND,
+                                         TaskStatus.RUNNING)
+                    )
+                    if stuck:
+                        out.append(Violation(
+                            "gang-partial-drain", tick,
+                            f"gang {g} drained this tick but "
+                            f"member(s) {stuck} remain placed on "
+                            "cordoned node(s) — drain was not "
+                            "gang-atomic",
+                        ))
+        return out
+
+    def _check_flaky(self, tick: int) -> list[Violation]:
+        """Post-run assertions for the flaky-node scenario: quarantine
+        actually engaged, the (live) wire breaker never tripped on the
+        node's ANSWERED refusals while healthy-node binds flowed, and
+        the node re-admitted through probation before the drain ended
+        (convergence-after-heal)."""
+        out: list[Violation] = []
+        if self.fault_counts.get("flaky-node", 0) < 1:
+            out.append(Violation(
+                "flaky-never-fired", tick,
+                "flaky_at configured but the flaky window never opened",
+            ))
+            return out
+        if self.health.cordons_total < 1:
+            out.append(Violation(
+                "quarantine-never-engaged", tick,
+                "flaky node refused binds / flapped NotReady but the "
+                "health ledger never cordoned it",
+            ))
+        breaker = self.guardrails.breaker if self.guardrails else None
+        if breaker is not None and breaker.opened_count:
+            out.append(Violation(
+                "flaky-tripped-breaker", tick,
+                "the wire breaker tripped during the flaky window — "
+                "node-level refusals (answered by the transport) "
+                "leaked into the global failure streak",
+            ))
+        if not self._health_recovered():
+            states = self.health.sample()["states"]
+            out.append(Violation(
+                "health-not-recovered", tick,
+                f"scenario drained but node(s) remain quarantined: "
+                f"{states} — probation never re-admitted the healed "
+                "hardware",
+            ))
+        return out
+
+    def _health_summary(self) -> dict | None:
+        if self.health is None:
+            return None
+        s = self.health.sample()
+        return {
+            "cordons": s["cordons_total"],
+            "probation_failures": s["probation_failures_total"],
+            "final_states": s["states"],
+            "flaky_bind_faults": self.cluster.flaky_bind_failures,
+            "cordoned_placements": self._cordoned_placements,
+            "canary_overruns": self._canary_overruns,
+            "drain_evictions": sum(
+                1 for e in self._decisions
+                if e["op"] == "evict"
+                and e.get("reason") == "drain-cordoned"
+            ),
         }
 
     def _check_guardrails(self, tick: int) -> list[Violation]:

@@ -87,6 +87,30 @@ class FaultSpec:
     #: program must keep serving.  0 disables.
     hbm_pressure_at: int = 0
 
+    # -- node-health faults (kube_batch_tpu/health/) -------------------
+    #: Tick one seeded node turns FLAKY: it stays on the wire and
+    #: keeps answering, but a deterministic fraction of binds targeted
+    #: at it are REFUSED (app-level answer — the transport lives, so
+    #: the wire breaker must NOT trip) and its Ready condition flaps
+    #: on a cadence, all below the vanish threshold.  The health
+    #: ledger must quarantine the node, mask it out of placements,
+    #: optionally drain its gangs, and re-admit it through probation
+    #: after the heal at flaky_at + flaky_ticks.  0 disables.
+    flaky_at: int = 0
+    flaky_ticks: int = 12
+    #: Percentage of bind attempts at the flaky node that get refused
+    #: (hash of (seed, uid, attempt) — deterministic under the bind
+    #: fan-out's thread order, and a retry can fail again: that is the
+    #: point).
+    flaky_fail_pct: int = 85
+    #: NotReady condition flap cadence within the flaky window (the
+    #: node recovers the following tick each time); 0 disables flaps.
+    flaky_flap_every: int = 4
+    #: Drain budget for the driven scheduler (gangs migrated per
+    #: cycle); > 0 turns --drain-cordoned semantics on for the run so
+    #: the gang-atomic-drain invariant is exercised.  0 = drain off.
+    flaky_drain_budget: int = 0
+
     # -- failover faults (doc/design/failover-fencing.md) --------------
     #: Tick the LEADER CRASHES: its lease expires on the cluster
     #: without a release, pods it was mid-committing are left frozen
@@ -115,6 +139,14 @@ class FaultSpec:
         scheduler with a Guardrails instance wired for tick time."""
         return bool(self.slow_at or self.blackhole_at
                     or self.hbm_pressure_at)
+
+    @property
+    def health_faults(self) -> bool:
+        """The flaky-node fault configured — the engine then drives
+        the scheduler with a NodeHealthLedger (and a Guardrails
+        instance, so the no-breaker-trip classification is actually
+        asserted against a LIVE breaker)."""
+        return bool(self.flaky_at)
 
 
 def plan_faults(spec: FaultSpec, seed: int, ticks: int) -> list[dict]:
@@ -163,6 +195,27 @@ def plan_faults(spec: FaultSpec, seed: int, ticks: int) -> list[dict]:
             "tick": spec.hbm_pressure_at, "op": "fault",
             "kind": "hbm-pressure",
         })
+    if spec.flaky_at:
+        events.append({
+            "tick": spec.flaky_at, "op": "fault", "kind": "flaky-node",
+        })
+        if spec.flaky_flap_every:
+            # Ready-condition flaps within the window, each healing
+            # the following tick — degradation, never a vanish.
+            t = spec.flaky_at + spec.flaky_flap_every
+            while t < spec.flaky_at + spec.flaky_ticks:
+                events.append({
+                    "tick": t, "op": "fault", "kind": "flaky-flap",
+                })
+                events.append({
+                    "tick": t + 1, "op": "fault",
+                    "kind": "flaky-flap-heal",
+                })
+                t += spec.flaky_flap_every
+        events.append({
+            "tick": spec.flaky_at + spec.flaky_ticks, "op": "fault",
+            "kind": "flaky-heal",
+        })
     if spec.leader_crash_at:
         events.append({
             "tick": spec.leader_crash_at, "op": "fault",
@@ -178,6 +231,19 @@ def cursed(seed: int, uid: str, pct: int) -> bool:
     if pct <= 0:
         return False
     digest = hashlib.sha256(f"chaos-bind-{seed}:{uid}".encode()).digest()
+    return digest[0] % 100 < pct
+
+
+def flaky_cursed(seed: int, uid: str, attempt: int, pct: int) -> bool:
+    """True iff the flaky node refuses THIS bind attempt — a pure hash
+    of (seed, uid, attempt number), so retries can fail again (the
+    whole point of a flaky node) while staying independent of the
+    bind fan-out's thread order."""
+    if pct <= 0:
+        return False
+    digest = hashlib.sha256(
+        f"chaos-flaky-{seed}:{uid}:{attempt}".encode()
+    ).digest()
     return digest[0] % 100 < pct
 
 
@@ -220,6 +286,13 @@ class ChaosCluster(ExternalCluster):
         #: (the slow-backend fault; responses still land, just late).
         self.response_delay = 0.0
         self.blackholed_requests = 0
+        # -- flaky-node fault state (engine-toggled) -------------------
+        #: While set, bind requests targeting this node are refused
+        #: per flaky_cursed (an ANSWERED app-level failure — the wire
+        #: lives, the NODE is sick; the breaker must not trip).
+        self.flaky_node: str | None = None
+        self.flaky_fail_pct = 0
+        self.flaky_bind_failures = 0
         #: tick -> bind requests RECEIVED (answered or swallowed):
         #: the breaker-open invariant asserts this is zero for every
         #: tick the breaker spent fully open.
@@ -295,6 +368,26 @@ class ChaosCluster(ExternalCluster):
             return
         self.bind_attempts[pod.uid] += 1
         first = self.bind_attempts[pod.uid] == 1
+        if (
+            self.flaky_node is not None
+            and node_name == self.flaky_node
+            and flaky_cursed(self.seed, pod.uid,
+                             self.bind_attempts[pod.uid],
+                             self.flaky_fail_pct)
+        ):
+            # The flaky kubelet refuses the bind but the apiserver
+            # ANSWERED: app-level failure, per-node health evidence —
+            # logged under its own op so the commit-order invariant
+            # (which keys on first-attempt-only bind-faults) is not
+            # confused by a refusal that may hit any attempt.
+            self.flaky_bind_failures += 1
+            self._log({
+                "op": "flaky-bind-fault", "uid": pod.uid,
+                "group": pod.group, "node": node_name,
+            })
+            self._respond(writer, rid, False,
+                          "chaos: flaky kubelet refused bind")
+            return
         if first and cursed(self.seed, pod.uid, self.bind_fail_pct):
             self.injected_bind_failures += 1
             self._log({
@@ -352,25 +445,53 @@ class ChaosCluster(ExternalCluster):
     # -- fault primitives the engine fires ------------------------------
     def vanish_node(self, rng: random.Random) -> dict | None:
         """Abruptly kill one live node (rng-chosen over the SORTED name
-        set — deterministic), returning its spec for the later heal."""
+        set — deterministic), returning its FULL encoded spec for the
+        later heal.  The full codec round trip matters: a node healing
+        without its labels/taints/conditions would silently drop
+        scheduling constraints (topology domains, toleration gates)
+        the vanish never meant to remove."""
+        from kube_batch_tpu.client.codec import encode_node
+
         with self._lock:
             names = sorted(self.nodes)
             if not names:
                 return None
             name = rng.choice(names)
-            node = self.nodes[name]
-            spec = {"name": name,
-                    "allocatable": dict(node.allocatable),
-                    "uid": node.uid}
+            spec = encode_node(self.nodes[name])
             self.delete_node(name)
             return spec
 
     def heal_node(self, spec: dict) -> None:
-        from kube_batch_tpu.cache.cluster import Node
+        """Restore a vanished node from its full encoded spec (same
+        capacity, same name, same labels/taints/conditions/cordon
+        state — codec parity with vanish_node)."""
+        from kube_batch_tpu.client.codec import decode_node
 
-        self.add_node(Node(name=spec["name"],
-                           allocatable=spec["allocatable"],
-                           uid=spec["uid"]))
+        self.add_node(decode_node(spec))
+
+    # -- flaky-node primitives (engine-fired) ---------------------------
+    def set_flaky(self, name: str | None, pct: int = 0) -> None:
+        """Turn the flaky window on (name + refusal pct) or off
+        (None).  The node stays fully on the wire either way."""
+        with self._lock:
+            self.flaky_node = name
+            self.flaky_fail_pct = pct if name is not None else 0
+
+    def flap_node(self, name: str, down: bool) -> None:
+        """Flip the node's Ready condition (kubelet flap) — a
+        MODIFIED event, never a DELETE: degradation below the vanish
+        threshold, exactly what the health ledger scores as a flap."""
+        from kube_batch_tpu.client.codec import encode_node
+
+        with self._lock:
+            node = self.nodes.get(name)
+            if node is None:
+                return
+            node.ready = not down
+            conds = dict(node.conditions)
+            conds["Ready"] = not down
+            node.conditions = conds
+            self._emit("MODIFIED", "Node", encode_node(node))
 
     def steal_lease(self, usurper: str = "chaos-monkey") -> str | None:
         """A rogue holder takes the lease: the rightful holder's next

@@ -27,7 +27,15 @@ tick.  Checked invariants:
 5. **convergence** (engine-driven, `pending_after_deadline`) — after
    the scenario quiesces, no admissible pod may stay Pending past the
    drain deadline.
-6. **no-stale-epoch-write-accepted / single-writer-per-epoch** — the
+6. **node health** (engine-driven, `engine._check_health_tick` /
+   `_check_flaky` — they need the per-tick ledger samples this module
+   does not hold): no-placement-on-cordoned,
+   probation-canary-bounded, gang-atomic-drain, quarantine-engages
+   and convergence-after-heal.  This module's only contribution is
+   counting ``flaky-bind-fault`` entries as gang ATTEMPTS for the
+   first-wave check — a refusal is the backend's doing, not a gang
+   gate leak.
+7. **no-stale-epoch-write-accepted / single-writer-per-epoch** — the
    log carries every lease-epoch mint (``epoch-advance`` entries) and
    every accepted write's stamping epoch: an accepted bind/evict whose
    epoch is not the one current AT ACCEPTANCE means a deposed
@@ -127,7 +135,11 @@ class InvariantChecker:
                     f"{e['epoch']} while epoch {self._epoch} was "
                     "current — single-writer-per-epoch broken",
                 ))
-            if op in ("bind", "bind-fault") and group is not None:
+            if op in ("bind", "bind-fault", "flaky-bind-fault") and \
+                    group is not None:
+                # Refusals count as gang ATTEMPTS (the scheduler did
+                # dispatch min_member placements; the backend — cursed
+                # or flaky — refused them); only accepted binds place.
                 attempts[group] = attempts.get(group, 0) + 1
                 if placed_before.get(group, 0) == 0 and \
                         group not in first_wave:

@@ -24,7 +24,10 @@ Event grammar (all events carry ``tick`` and ``op``)::
 
     {"tick": -1, "op": "meta",       "seed": s, "bind_fail_pct": p,
      "slow_at": t, "slow_ticks": n, "slow_response_s": d,
-     "blackhole_at": t, "blackhole_ticks": n, "hbm_pressure_at": t}
+     "blackhole_at": t, "blackhole_ticks": n, "hbm_pressure_at": t,
+     "leader_crash_at": t, "zombie_writes": n,
+     "flaky_at": t, "flaky_ticks": n, "flaky_fail_pct": p,
+     "flaky_flap_every": n, "flaky_drain_budget": n}
     {"tick": 0, "op": "add-queue",   "name": q, "weight": w}
     {"tick": 0, "op": "add-node",    "node": {<codec NODE_KEYS dict>}}
     {"tick": t, "op": "remove-node", "name": n}

@@ -153,6 +153,36 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--breaker-reset", type=float, default=15.0,
                    help="seconds an open breaker waits before a "
                         "half-open probe of the backend")
+    # -- node health (kube_batch_tpu/health/; doc/design/node-health.md)
+    p.add_argument("--quarantine-threshold", type=float, default=5.0,
+                   help="suspicion score (node-attributed bind "
+                        "failures, NotReady/pressure flaps, unexpected "
+                        "pod deaths, with per-cycle decay) at which a "
+                        "node is CORDONED out of new placements "
+                        "(running pods stay); 0 disables the "
+                        "node-health ledger entirely")
+    p.add_argument("--probation-ticks", type=int, default=30,
+                   help="consecutive clean cycles a cordoned node "
+                        "needs before canary-capped probation, and a "
+                        "probation node before full re-admission")
+    p.add_argument("--probation-canary", type=int, default=2,
+                   help="max new placements a probation node may "
+                        "receive before it has proven out (enforced "
+                        "via the packed pod-slot idle clamp)")
+    p.add_argument("--drain-cordoned", action="store_true",
+                   help="opt-in: migrate PodGroups off cordoned nodes "
+                        "GANG-ATOMICALLY — members are evicted only "
+                        "once a full re-placement is proven on "
+                        "healthy capacity (PDB-respecting, "
+                        "budget-limited per cycle)")
+    p.add_argument("--drain-budget", type=int, default=1,
+                   help="max PodGroups migrated per cycle under "
+                        "--drain-cordoned")
+    p.add_argument("--cordon-nodes", default="",
+                   help="comma-separated node names to cordon "
+                        "MANUALLY at startup (never auto-released; "
+                        "maps onto spec.unschedulable in the k8s "
+                        "write dialects)")
     p.add_argument("--version", action="store_true")
     return p
 
@@ -179,6 +209,29 @@ def build_guardrails(args):
         breaker_failures=args.breaker_failures,
         breaker_reset_s=args.breaker_reset,
     ))
+
+
+def build_health(args, cordon_sink=None):
+    """The node-health ledger from CLI flags (doc/design/node-health.md),
+    or None when --quarantine-threshold 0 disables the subsystem.
+    Shared by every run mode; the k8s write dialects additionally pass
+    a `cordon_sink` so ledger cordons mirror onto spec.unschedulable."""
+    if args.quarantine_threshold <= 0:
+        return None
+    from kube_batch_tpu.health import NodeHealthConfig, NodeHealthLedger
+
+    ledger = NodeHealthLedger(NodeHealthConfig(
+        quarantine_threshold=args.quarantine_threshold,
+        probation_ticks=args.probation_ticks,
+        probation_canary=args.probation_canary,
+        drain_cordoned=args.drain_cordoned,
+        drain_budget=args.drain_budget,
+    ))
+    ledger.cordon_sink = cordon_sink
+    for name in filter(None, (n.strip() for n in
+                              args.cordon_nodes.split(","))):
+        ledger.cordon(name, reason="manual (--cordon-nodes)")
+    return ledger
 
 
 def build_commit_pipeline(args, cache, guardrails):
@@ -446,6 +499,18 @@ def run_external(args) -> int:
     adapter = K8sWatchAdapter(
         cache, reader, backend=backend, scheduler_name=args.scheduler_name
     ).start()
+    # Node-health ledger: bind-failure attribution + quarantine.  In
+    # the k8s dialect, ledger cordons mirror onto spec.unschedulable
+    # (kubectl and other controllers then see them too).  Built AFTER
+    # the adapter starts: a manual --cordon-nodes entry fires its
+    # cordon PATCH immediately, and the response rides the watch
+    # stream the adapter's read loop delivers.
+    health = build_health(
+        args,
+        cordon_sink=(
+            guarded.cordon_node if args.write_format == "k8s" else None
+        ),
+    )
 
     stop = threading.Event()
     state = {"sock": sock, "adapter": adapter}
@@ -591,6 +656,7 @@ def run_external(args) -> int:
             schedule_period=args.schedule_period,
             profile_dir=args.profile_dir,
             guardrails=guardrails,
+            health=health,
         )
         run_state["scheduler"] = scheduler
         ran = scheduler.run(stop=stop, max_cycles=args.cycles)
@@ -650,6 +716,11 @@ def run_http(args) -> int:
     cache.event_sink = guarded
     cache.k8s_write_format = True  # HTTP writes ARE the apiserver dialect
     commit = build_commit_pipeline(args, cache, guardrails)
+    # HTTP IS the apiserver dialect: ledger cordons PATCH the node's
+    # spec.unschedulable so the rest of the cluster sees them —
+    # through the guarded seam, so an open breaker fails the mirror
+    # write fast (the ledger's pending retry re-pushes after heal).
+    health = build_health(args, cordon_sink=guarded.cordon_node)
     mux = HttpWatchMux(client).start()
     backend.follow_served_versions(mux)
     adapter = K8sWatchAdapter(
@@ -718,6 +789,7 @@ def run_http(args) -> int:
             schedule_period=args.schedule_period,
             profile_dir=args.profile_dir,
             guardrails=guardrails,
+            health=health,
         )
         run_state["scheduler"] = scheduler
         ran = scheduler.run(stop=stop, max_cycles=args.cycles)
@@ -852,9 +924,11 @@ def main(argv: list[str] | None = None) -> int:
         conf_path=args.scheduler_conf,
         schedule_period=args.schedule_period,
         profile_dir=args.profile_dir,
-        # Sim mode has no wire to break, but the watchdog ladder and
-        # the HBM-ceiling admission apply the same.
+        # Sim mode has no wire to break, but the watchdog ladder, the
+        # HBM-ceiling admission and the node-health ledger apply the
+        # same (no cordon sink: the simulator has no spec to patch).
         guardrails=build_guardrails(args),
+        health=build_health(args),
     )
     try:
         ran = scheduler.run(

@@ -61,6 +61,7 @@ POD_KEYS = frozenset({
 NODE_KEYS = frozenset({
     "uid", "name", "allocatable", "labels", "taints", "ready",
     "memoryPressure", "diskPressure", "pidPressure",
+    "unschedulable", "conditions",
 })
 CLAIM_KEYS = frozenset({"uid", "name", "storageClass", "boundNode"})
 STORAGE_CLASS_KEYS = frozenset({"uid", "name", "allowedNodeLabels"})
@@ -111,6 +112,8 @@ def encode_node(node: Node) -> dict[str, Any]:
         "memoryPressure": node.memory_pressure,
         "diskPressure": node.disk_pressure,
         "pidPressure": node.pid_pressure,
+        "unschedulable": node.unschedulable,
+        "conditions": dict(node.conditions),
     }
 
 
@@ -127,6 +130,11 @@ def decode_node(d: dict[str, Any]) -> Node:
         memory_pressure=bool(d.get("memoryPressure", False)),
         disk_pressure=bool(d.get("diskPressure", False)),
         pid_pressure=bool(d.get("pidPressure", False)),
+        unschedulable=bool(d.get("unschedulable", False)),
+        conditions={
+            str(k): bool(v)
+            for k, v in (d.get("conditions") or {}).items()
+        },
         **kwargs,
     )
 

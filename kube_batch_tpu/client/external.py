@@ -539,6 +539,28 @@ class ExternalCluster:
                           "" if group is not None else "podgroup not found")
             return
 
+        m = re.fullmatch(r"/api/v1/nodes/([^/]+)", path)
+        if m and verb in ("patch", "update"):
+            # ≙ kubectl cordon/uncordon: spec.unschedulable PATCH from
+            # the health ledger's cordon sink.  The cluster mutates the
+            # node and broadcasts MODIFIED, so every attached session
+            # (and the writer itself, symmetrically) observes the
+            # cordon on the watch stream.
+            node = self.nodes.get(m.group(1))
+            if node is None:
+                self._respond(writer, rid, False,
+                              f"node {m.group(1)} not found")
+                return
+            spec = obj.get("spec") or {}
+            if "unschedulable" not in spec:
+                self._respond(writer, rid, False,
+                              "patch carries no spec.unschedulable")
+                return
+            node.unschedulable = bool(spec["unschedulable"])
+            self._respond(writer, rid, True)
+            self._emit("MODIFIED", "Node", encode_node(node))
+            return
+
         m = re.fullmatch(r"/api/v1/namespaces/([^/]+)/events", path)
         if m and verb == "create":
             if obj.get("kind") != "Event" or "involvedObject" not in obj:

@@ -512,7 +512,10 @@ class K8sHttpBackend:
     trips, or a 47.5k-pod gang commit at tunnel latencies serializes
     right back to the hour the pool exists to prevent."""
 
-    _METHODS = {"create": "POST", "delete": "DELETE", "update": "PUT"}
+    _METHODS = {
+        "create": "POST", "delete": "DELETE", "update": "PUT",
+        "patch": "PATCH",
+    }
 
     def __init__(self, client: _Client) -> None:
         self.client = client
@@ -636,9 +639,14 @@ class K8sHttpBackend:
         method = self._METHODS[req["verb"]]
         path = self.client.prefix + req["path"]
         payload = json.dumps(req["object"])
-        headers = self.client._headers(
-            {"Content-Type": "application/json"}
-        )
+        headers = self.client._headers({
+            # PATCH carries a merge patch (the cordon write's partial
+            # spec); everything else posts/puts whole objects.
+            "Content-Type": (
+                "application/merge-patch+json"
+                if method == "PATCH" else "application/json"
+            ),
+        })
         for attempt in (1, 2):
             conn, fresh = self._conn_get()
             try:
@@ -723,6 +731,16 @@ class K8sHttpBackend:
         self._issue(pod_group_status_request(
             group, api_version=self.pod_group_api_version(),
         ))
+
+    def cordon_node(self, name: str, unschedulable: bool) -> None:
+        """Mirror a ledger/manual cordon onto the node's
+        spec.unschedulable with a merge PATCH (≙ kubectl cordon)."""
+        from kube_batch_tpu.client.k8s_write import (
+            node_unschedulable_request,
+        )
+
+        self._check_fence()
+        self._issue(node_unschedulable_request(name, unschedulable))
 
     def record_event(
         self, kind: str, name: str, reason: str, message: str,

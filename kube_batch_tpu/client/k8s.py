@@ -383,10 +383,15 @@ class K8sDecoder:
             self.spec, status.get("allocatable") or status.get("capacity")
         )
         conds = {
-            c.get("type"): c.get("status") == "True"
+            str(c.get("type")): c.get("status") == "True"
             for c in status.get("conditions", [])
         }
-        ready = conds.get("Ready", True) and not spec.get("unschedulable")
+        # spec.unschedulable (kubectl cordon) is carried as its OWN
+        # field, not folded into `ready`: a cordoned-but-healthy node
+        # stays in the snapshot with its residents accounted and is
+        # masked out of new placements via the packed node_ready bit
+        # (cache/packer.py) — symmetric with the health ledger's own
+        # cordons and with the cordon writes this scheduler issues.
         kwargs = {"uid": meta["uid"]} if meta.get("uid") else {}
         return Node(
             name=meta["name"],
@@ -394,10 +399,12 @@ class K8sDecoder:
             labels={str(k): str(v)
                     for k, v in (meta.get("labels") or {}).items()},
             taints=frozenset(_taint_str(t) for t in spec.get("taints", [])),
-            ready=ready,
+            ready=conds.get("Ready", True),
             memory_pressure=conds.get("MemoryPressure", False),
             disk_pressure=conds.get("DiskPressure", False),
             pid_pressure=conds.get("PIDPressure", False),
+            unschedulable=bool(spec.get("unschedulable")),
+            conditions=conds,
             **kwargs,
         )
 
@@ -604,6 +611,25 @@ class K8sWatchAdapter(WatchAdapter):
             known = uid in cache._pods
         if decoded is None:
             if known:  # adopted earlier, now foreign/Failed: drop it
+                if obj.get("status", {}).get("phase") == "Failed":
+                    # An adopted pod going FAILED while placed is the
+                    # classic flaky-hardware signal (a dying kubelet
+                    # killing containers) — attribute it to the node's
+                    # health ledger before the record disappears.
+                    death_node = None
+                    with cache.lock():
+                        prior = cache._pods.get(uid)
+                        if (
+                            prior is not None
+                            and prior.node is not None
+                            and prior.status in (
+                                TaskStatus.BOUND, TaskStatus.RUNNING,
+                            )
+                        ):
+                            death_node = prior.node
+                    health = getattr(cache, "health", None)
+                    if death_node is not None and health is not None:
+                        health.note_pod_death(death_node)
                 cache.delete_pod(uid)
             else:
                 self.ignored_pods += 1

@@ -135,6 +135,23 @@ def pod_group_status_request(
     }
 
 
+def node_unschedulable_request(name: str, unschedulable: bool) -> dict[str, Any]:
+    """≙ kubectl cordon/uncordon: PATCH the node's spec.unschedulable.
+    The health ledger's cordon sink issues these so a quarantine this
+    scheduler decides is visible to kubectl and every other controller
+    (doc/design/node-health.md)."""
+    return {
+        "verb": "patch",
+        "path": f"/api/v1/nodes/{name}",
+        "object": {
+            "apiVersion": "v1",
+            "kind": "Node",
+            "metadata": {"name": name},
+            "spec": {"unschedulable": bool(unschedulable)},
+        },
+    }
+
+
 def event_request(
     kind: str,
     name: str,
@@ -268,6 +285,12 @@ class K8sStreamBackend(StreamBackend):
         self._call(pod_group_status_request(
             group, api_version=self.pod_group_api_version,
         ))
+
+    def cordon_node(self, name: str, unschedulable: bool) -> None:
+        """Mirror a ledger/manual cordon onto spec.unschedulable (≙
+        kubectl cordon).  A fenced path write like every data-plane
+        verb — a deposed leader must not keep cordoning nodes."""
+        self._call(node_unschedulable_request(name, unschedulable))
 
     # -- EventSink (cache.record_event forwarding) ----------------------
     def record_event(

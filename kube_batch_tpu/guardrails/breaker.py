@@ -25,6 +25,13 @@ backoff; here the equivalent is explicit:
   propagate.  While open, calls raise `BreakerOpen` WITHOUT touching
   the wire.
 
+Attribution contract with the node-health ledger
+(doc/design/node-health.md): the breaker's evidence is the WIRE,
+never a node.  An answered bind refusal propagates out of here as
+breaker success and is classified into the per-node health ledger by
+the cache's commit funnel (`cache.finish_bind`) — so one flaky node
+can never trip this breaker, and a dead wire can never cordon nodes.
+
 The breaker's open/close callbacks are where scheduling quiesces: the
 `Guardrails` facade wires them to `cache.begin_resync()` /
 `end_resync()`, so open-state cycles skip via the same CacheResyncing
@@ -273,4 +280,17 @@ class GuardedBackend:
             "updatePodGroup",
             lambda: self.inner.update_pod_group(group),
             key=getattr(group, "name", ""),
+        )
+
+    def cordon_node(self, name: str, unschedulable: bool) -> None:
+        """The health ledger's spec.unschedulable mirror write (k8s
+        dialects).  Guarded like every data-plane write — and with the
+        breaker OPEN it fails FAST, so a quarantine crossing the
+        threshold mid-outage cannot stall the noting thread (watch
+        adapter / commit flush worker) on wire timeouts; the ledger's
+        pending-sink retry re-pushes it once the wire heals."""
+        return self._guarded(
+            "cordonNode",
+            lambda: self.inner.cordon_node(name, unschedulable),
+            key=name,
         )

@@ -1,0 +1,56 @@
+"""Node-health subsystem: flaky-hardware quarantine + gang-safe drain.
+
+The reference scheduler treats nodes as present-or-gone: a Node object
+either answers the informer (and is packed, scored, and bound to) or it
+was DELETED.  Real TPU fleets degrade *partially* — a node with a
+failing chip or a flapping kubelet keeps answering the wire, accepts
+some binds, and silently kills the gangs placed on it.  Left alone, the
+scheduler hot-loops that node: every cycle's solve re-selects it (it
+looks idle precisely BECAUSE its binds keep dying), the failed binds
+resync, and the same doomed placement repeats forever.
+
+This package gives the scheduler a per-node memory of that misbehavior:
+
+* `ledger.NodeHealthLedger` — a suspicion score per node, fed by the
+  cache's commit funnel (bind failures whose transport ANSWERED —
+  node-level refusals, never wire death, which stays the circuit
+  breaker's business), by watch-observed `NotReady`/pressure condition
+  flaps, and by unexpected pod deaths; scores decay per cycle, and
+  crossing the quarantine threshold CORDONS the node through the state
+  machine ``ok → suspect → cordoned → probation → ok``
+  (doc/design/node-health.md).
+* `drain.drain_cordoned_gangs` — the opt-in ``--drain-cordoned`` mode:
+  PodGroups resident on cordoned nodes are migrated GANG-ATOMICALLY —
+  a gang's affected members are evicted only once a conservative
+  host-side placement proof shows a full re-placement exists on
+  healthy nodes (all-or-nothing, PDB-respecting, rate-limited by a
+  per-cycle drain budget), reusing the preempt/reclaim eviction funnel
+  so the rebind rides the normal cycle (and, in wire mode, the commit
+  pipeline).
+
+Enforcement is tensor-native: cordoned nodes (ledger state, manual
+cordons, and externally-observed ``spec.unschedulable``) fold into the
+packed ``node_ready`` bit — the SAME bit the predicates plugin, the
+preemption pipeline and the fit-error diagnosis already consume — on
+both the full-rebuild and incremental pack paths, so no placement,
+pipelining or preemption target can land on a quarantined node.
+Probation re-admits with a canary cap by clamping the node's visible
+pod-slot idle, so a rehabilitating node proves itself on a bounded
+number of placements before full service returns.
+"""
+
+from kube_batch_tpu.health.drain import drain_cordoned_gangs
+from kube_batch_tpu.health.ledger import (
+    STATE_VALUES,
+    NodeHealthConfig,
+    NodeHealthLedger,
+    NodeState,
+)
+
+__all__ = [
+    "NodeHealthConfig",
+    "NodeHealthLedger",
+    "NodeState",
+    "STATE_VALUES",
+    "drain_cordoned_gangs",
+]

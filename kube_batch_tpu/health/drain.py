@@ -1,0 +1,239 @@
+"""Gang-atomic drain of cordoned nodes (the ``--drain-cordoned`` mode).
+
+A cordoned node's RUNNING pods are deliberately left alone by the
+quarantine mask — killing work on suspicion would convert a flaky chip
+into an outage.  Drain is the opt-in escalation: migrate the affected
+PodGroups to healthy nodes, but only under the gang contract —
+
+* **all-or-nothing**: a gang's affected members are evicted only once
+  a placement PROOF shows ALL of them can re-place on healthy nodes
+  simultaneously.  The proof is a conservative host-side first-fit
+  over live idle capacity with the static per-node predicates
+  (selector ⊆ labels, taints tolerated, host ports free, resource
+  fit): if the greedy fit succeeds a feasible placement exists; if it
+  fails the gang simply stays put (a complete solver could prove
+  more — documented conservatism, never a wrong eviction).  Gangs
+  carrying inter-pod affinity terms or volume claims are skipped
+  outright: their feasibility is not provable host-side.
+* **PDB-respecting**: the plan charges each planned eviction against
+  every matching PodDisruptionBudget's current headroom (healthy
+  members above the effective floor, resolved against the live
+  matched count exactly like the packer does) and skips any gang that
+  would overdraw a budget.
+* **rate-limited**: at most ``drain_budget`` gangs migrate per cycle,
+  so a mass cordon never converts into a mass eviction storm.
+
+Evictions reuse `cache.evict` — the same funnel preempt/reclaim land
+on (wire write, rollback-on-failure, events); the evicted members
+return Pending and the NEXT cycle's real solver re-places them, with
+the rebind riding the commit pipeline in wire mode.  The chaos
+engine's gang-atomic-drain invariant holds this to account: after a
+drain tick, no member of a drained gang may remain placed on any
+cordoned node.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from kube_batch_tpu import metrics
+from kube_batch_tpu.api.resource import less_equal_vec
+from kube_batch_tpu.api.types import TaskStatus
+
+log = logging.getLogger(__name__)
+
+#: Statuses counting as "healthy members" for PDB headroom.
+_PLACED = (TaskStatus.BOUND, TaskStatus.RUNNING)
+#: Statuses eligible for drain EVICTION: RUNNING only.  A pod bound
+#: this very cycle may still be mid-flush (BINDING→BOUND races the
+#: commit pipeline's ack), and planning against an unsettled state
+#: would make the drain's decisions depend on flush-thread timing —
+#: the chaos engine's same-seed hash would diverge.  A just-bound
+#: member simply migrates one cycle later, once it is RUNNING.
+_DRAINABLE = (TaskStatus.RUNNING,)
+
+
+def _node_feasible(pod, info, reserved_ports: set[int]) -> bool:
+    """Static per-node predicates, host-side (the subset that is
+    provable without the tensor solve): selector, taints, host ports."""
+    node = info.node
+    labels = {f"{k}={v}" for k, v in node.labels.items()}
+    if any(f"{k}={v}" not in labels for k, v in pod.selector.items()):
+        return False
+    if any(t not in pod.tolerations for t in node.taints):
+        return False
+    if pod.ports:
+        occupied = set(reserved_ports)
+        for resident in info.tasks.values():
+            occupied.update(resident.ports)
+        if pod.ports & occupied:
+            return False
+    return True
+
+
+def plan_drain(cache, ledger, view=None) -> list[tuple[str, list[str]]]:
+    """[(group name, [pod uids to evict])] for this cycle, under the
+    cache lock.  Deterministic: jobs and pods iterate in sorted order,
+    and the caller may pass the ledger `view` (cordoned set + canary
+    map) it captured at CYCLE START — a cordon landing mid-cycle (a
+    flush worker's refusal crossing the threshold while the plan
+    runs) then takes effect next cycle in every run identically,
+    instead of racing the plan."""
+    cfg = ledger.config
+    budget = max(int(cfg.drain_budget), 0)
+    if budget == 0:
+        return []
+    plans: list[tuple[str, list[str]]] = []
+    with cache.lock():
+        cordoned, canary = view if view is not None else ledger.pack_view()
+        if not cordoned:
+            return []
+        spec = cache.spec
+        pods_ix = (
+            spec.names.index("pods") if "pods" in spec.names else None
+        )
+        # Healthy targets: packed-schedulable nodes only, with a
+        # mutable idle copy the proof reserves against.  A probation
+        # node's pod-slot idle is clamped to its remaining canary —
+        # the proof must never rely on capacity the clamped solver
+        # will refuse to use.
+        targets = []
+        for name in sorted(cache._nodes):
+            info = cache._nodes[name]
+            if not info.node.schedulable(cordoned):
+                continue
+            avail = info.idle.copy()
+            cap = canary.get(name)
+            if cap is not None and pods_ix is not None:
+                avail[pods_ix] = min(avail[pods_ix], float(cap))
+            targets.append([info, avail, set()])
+        # PDB headroom: healthy matched members above each budget's
+        # effective floor (dynamic forms resolve against the live
+        # matched count, same as the packer).
+        headroom: dict[str, float] = {}
+        pdbs = {
+            n: b for n, b in cache._pdbs.items() if b.selector
+        }
+        for bname, pdb in pdbs.items():
+            matched = [p for p in cache._pods.values() if pdb.matches(p)]
+            healthy = sum(1 for p in matched if p.status in _PLACED)
+            headroom[bname] = healthy - pdb.effective_floor(len(matched))
+
+        for jname in sorted(cache._jobs):
+            if len(plans) >= budget:
+                break
+            job = cache._jobs[jname]
+            resident = [
+                p for p in job.tasks.values() if p.node in cordoned
+            ]
+            affected = sorted(
+                (p for p in resident if p.status in _DRAINABLE),
+                key=lambda p: p.creation,
+            )
+            if not affected:
+                continue
+            if any(p.status is not TaskStatus.RELEASING
+                   and p.status not in _DRAINABLE for p in resident):
+                # A cordoned-resident member is still BOUND/BINDING
+                # (bound just before the quarantine crossed): draining
+                # only the RUNNING members would split the gang across
+                # the migration — defer the WHOLE gang one cycle until
+                # every member is settled (gang-atomicity over speed).
+                log.info(
+                    "drain: gang %s deferred — member(s) on cordoned "
+                    "node(s) not yet settled (BOUND/BINDING)", jname,
+                )
+                continue
+            if any(
+                p.affinity or p.anti_affinity or p.claims
+                for p in affected
+            ):
+                log.info(
+                    "drain: gang %s skipped — affinity/volume "
+                    "constraints are not provable host-side", jname,
+                )
+                continue
+            # PDB check: charge every planned eviction against every
+            # matching budget's headroom.
+            charges: dict[str, int] = {}
+            for p in affected:
+                for bname, pdb in pdbs.items():
+                    if pdb.matches(p):
+                        charges[bname] = charges.get(bname, 0) + 1
+            if any(headroom[b] < n for b, n in charges.items()):
+                log.info(
+                    "drain: gang %s deferred — eviction would breach "
+                    "PodDisruptionBudget floor(s) %s", jname,
+                    sorted(b for b, n in charges.items()
+                           if headroom[b] < n),
+                )
+                continue
+            # Placement proof: greedy first-fit of EVERY affected pod
+            # onto the healthy targets' remaining idle.
+            reservations: list[tuple[list, object, frozenset]] = []
+            proved = True
+            for p in affected:
+                req = spec.pod_vec(p)
+                placed = False
+                for entry in targets:
+                    info, avail, rports = entry
+                    if not _node_feasible(p, info, rports):
+                        continue
+                    if not less_equal_vec(req, avail, spec.eps):
+                        continue
+                    entry[1] = avail - req
+                    if p.ports:
+                        rports.update(p.ports)
+                    reservations.append((entry, req, p.ports))
+                    placed = True
+                    break
+                if not placed:
+                    proved = False
+                    break
+            if not proved:
+                # Unwind this gang's reservations — capacity AND port
+                # holds — so a failed gang cannot shadow-block a later
+                # gang's feasibility in the same pass; it stays put.
+                for entry, req, ports in reservations:
+                    entry[1] = entry[1] + req
+                    if ports:
+                        entry[2].difference_update(ports)
+                log.info(
+                    "drain: gang %s stays — no full re-placement "
+                    "provable on healthy capacity this cycle", jname,
+                )
+                continue
+            for bname, n in charges.items():
+                headroom[bname] -= n
+            plans.append((jname, [p.uid for p in affected]))
+    return plans
+
+
+def drain_cordoned_gangs(cache, ledger, view=None) -> int:
+    """Plan + execute this cycle's drain; returns evictions landed.
+    Eviction goes through `cache.evict` (the preempt/reclaim funnel:
+    wire write, rollback-on-failure, Evicted event).  A wire failure
+    mid-gang is loud — the rolled-back member keeps its node and the
+    next cycle's plan retries the remainder."""
+    plans = plan_drain(cache, ledger, view=view)
+    landed = 0
+    for jname, uids in plans:
+        cache.record_event(
+            "PodGroup", jname, "DrainMigrating",
+            f"migrating {len(uids)} member(s) off cordoned node(s); "
+            "full re-placement proven on healthy capacity",
+        )
+        failed = 0
+        for uid in uids:
+            if cache.evict(uid, "drain-cordoned"):
+                landed += 1
+                metrics.drain_evictions.inc()
+            else:
+                failed += 1
+        if failed:
+            log.error(
+                "drain: gang %s partially evicted (%d/%d failed) — "
+                "retrying the remainder next cycle", jname, failed,
+                len(uids),
+            )
+    return landed

@@ -1,0 +1,472 @@
+"""Per-node health ledger + quarantine state machine.
+
+State machine (doc/design/node-health.md)::
+
+    ok ──suspicion──▶ suspect ──score ≥ threshold──▶ cordoned
+     ▲                   │ decay to 0                    │ clean window
+     │                   ▼                               ▼
+     └──────────────── ok ◀──clean window──────────  probation
+                                                         │ any failure
+                                                         ▼
+                                      cordoned (threshold × escalation)
+
+Suspicion sources (weights configurable):
+
+* bind/finish-bind failures ATTRIBUTED to the node — app-level
+  refusals whose transport answered (the cache's commit funnel
+  classifies; transient wire errors stay the circuit breaker's
+  business and never touch this ledger);
+* watch-delivered condition flaps (`NotReady`, memory/disk/PID
+  pressure turning on) observed by `cache.update_node`;
+* unexpected pod deaths (an adopted pod going Failed while placed).
+
+Scores decay multiplicatively every scheduler cycle, so a node
+trickling one failure an hour never quarantines, while a burst does.
+Time is measured in CYCLES, not wall seconds — `on_cycle()` is the
+only clock — which keeps the chaos engine's same-seed runs
+deterministic (the breaker made the same choice with its tick clock).
+
+Cordoned nodes keep their residents (running pods stay; the packer
+keeps the node IN the snapshot so accounting holds) but are masked out
+of every new placement via the packed ``node_ready`` bit.  After
+``probation_ticks`` clean cycles a cordoned node re-admits on
+PROBATION with a canary cap: at most ``probation_canary`` new
+placements (enforced by clamping the node's visible pod-slot idle at
+pack time) until another clean window promotes it back to OK.  Any
+suspicion during probation re-cordons at an ESCALATED threshold — a
+repeat offender takes more evidence to trust again.
+
+Concurrency: suspicion arrives from commit-flush worker threads, the
+adapter thread (condition flaps) and the cycle thread.  All state
+mutates under one ledger lock; cache callbacks (journal marks, events,
+metrics, the cordon sink) fire AFTER the lock is released, so the
+ledger can never participate in a lock-order cycle with the cache
+mutex (which itself calls into the ledger from `snapshot()`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+
+from kube_batch_tpu import metrics
+
+log = logging.getLogger(__name__)
+
+
+class NodeState:
+    """Ledger states (string constants, k8s-condition flavored)."""
+
+    OK = "ok"
+    SUSPECT = "suspect"
+    CORDONED = "cordoned"
+    PROBATION = "probation"
+
+
+#: Gauge encoding for node_health_state{node}.
+STATE_VALUES = {
+    NodeState.OK: 0.0,
+    NodeState.SUSPECT: 1.0,
+    NodeState.CORDONED: 2.0,
+    NodeState.PROBATION: 3.0,
+}
+
+#: Scores below this decay to exactly zero (float dust must not keep a
+#: node SUSPECT forever).
+_SCORE_FLOOR = 0.05
+
+
+@dataclasses.dataclass(frozen=True)
+class NodeHealthConfig:
+    """Knobs for the ledger + drain (CLI flags / chaos)."""
+
+    #: Suspicion score at which a node CORDONS; <= 0 disables the
+    #: whole subsystem (the CLI then wires no ledger at all).
+    quarantine_threshold: float = 5.0
+    #: Multiplicative per-cycle suspicion decay (0.9 ≈ half-life of
+    #: ~6.6 cycles).
+    decay: float = 0.9
+    #: Suspicion per node-attributed bind failure (the transport
+    #: answered; wire deaths feed the breaker, not this).
+    bind_failure_weight: float = 1.0
+    #: Suspicion per NotReady/pressure condition flap off the watch.
+    flap_weight: float = 1.0
+    #: Suspicion per unexpected pod death on the node.
+    pod_death_weight: float = 2.0
+    #: Clean cycles a cordoned node must string together before
+    #: probation, and a probation node before full OK.
+    probation_ticks: int = 30
+    #: Max NEW placements a probation node may receive before it has
+    #: proven out (enforced via the packed pod-slot idle clamp).
+    probation_canary: int = 2
+    #: Threshold multiplier growth per probation failure (a repeat
+    #: offender needs more evidence to trust), capped below.
+    escalation: float = 2.0
+    max_escalation: float = 8.0
+    #: Opt-in gang-atomic migration of PodGroups off cordoned nodes
+    #: (health/drain.py), rate-limited to `drain_budget` gangs/cycle.
+    drain_cordoned: bool = False
+    drain_budget: int = 1
+
+
+@dataclasses.dataclass
+class _Record:
+    state: str = NodeState.OK
+    score: float = 0.0
+    clean_cycles: int = 0
+    multiplier: float = 1.0
+    canary_used: int = 0
+    #: Manual cordons (CLI) never auto-uncordon through probation.
+    manual: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class _Transition:
+    node: str
+    old: str
+    new: str
+    reason: str
+
+
+class NodeHealthLedger:
+    """One per scheduler process; consulted every cycle."""
+
+    def __init__(self, config: NodeHealthConfig | None = None) -> None:
+        self.config = config or NodeHealthConfig()
+        self._lock = threading.Lock()
+        self._records: dict[str, _Record] = {}
+        #: The cache whose journal/events mirror ledger transitions
+        #: (set by SchedulerCache.attach_health); plain ref — the
+        #: cache owns the ledger's lifetime, not the reverse.
+        self._cache = None
+        #: Optional callable(name, unschedulable: bool) pushing cordon
+        #: state out as ``spec.unschedulable`` (k8s write dialects).
+        #: Failures are logged, never raised — the LOCAL mask is the
+        #: enforcement; the cluster-side bit is a mirror.  Failed
+        #: pushes stay PENDING and retry every cycle until they land:
+        #: an uncordon PATCH lost to a wire blip must not leave
+        #: spec.unschedulable=true masking a healed node forever.
+        self.cordon_sink = None
+        #: node → desired unschedulable bit not yet acked by the sink.
+        self._sink_pending: dict[str, bool] = {}
+        # -- observability counters (chaos summaries read these) -------
+        self.cordons_total = 0
+        self.probation_failures_total = 0
+
+    # -- wiring ---------------------------------------------------------
+    def attach_cache(self, cache) -> None:
+        self._cache = cache
+
+    # -- suspicion sources ----------------------------------------------
+    def note_bind_failure(self, node: str, reason: str = "") -> None:
+        """A bind the NODE refused (transport answered).  Wire deaths
+        must not come here — they are the breaker's evidence, and
+        attributing them per-node would let one dead wire cordon the
+        whole fleet one node at a time."""
+        self._suspect(node, self.config.bind_failure_weight,
+                      f"bind-failure{': ' + reason if reason else ''}")
+
+    def note_flap(self, node: str, kind: str) -> None:
+        """A NotReady or pressure condition turned ON for the node."""
+        self._suspect(node, self.config.flap_weight, f"flap:{kind}")
+
+    def note_pod_death(self, node: str) -> None:
+        """An adopted pod died unexpectedly (went Failed) while placed
+        on the node."""
+        self._suspect(node, self.config.pod_death_weight, "pod-death")
+
+    def note_placement(self, node: str) -> None:
+        """A bind to this node was COMMITTED (begin_bind) — probation
+        canary accounting happens at commit, not at wire ack, so two
+        in-flight flushes cannot both look like the first canary."""
+        with self._lock:
+            rec = self._records.get(node)
+            if rec is not None and rec.state == NodeState.PROBATION:
+                rec.canary_used += 1
+
+    def note_placement_failed(self, node: str) -> None:
+        """A committed placement never RAN on the node — the flush
+        died on a transient wire error (or leadership moved) and the
+        pod rolled back to Pending.  Return the canary slot: a wire
+        blip must not burn probation trust the node never got to
+        spend.  (An ANSWERED refusal is a probation FAILURE and goes
+        through note_bind_failure instead.)"""
+        with self._lock:
+            rec = self._records.get(node)
+            if (
+                rec is not None
+                and rec.state == NodeState.PROBATION
+                and rec.canary_used > 0
+            ):
+                rec.canary_used -= 1
+
+    def note_bind_success(self, node: str) -> None:
+        """A bind on this node ACKED — present for symmetry and future
+        scoring refinements; probation exit is driven by the clean
+        window (a node can prove out even when no work routes to it)."""
+
+    # -- manual / external cordons --------------------------------------
+    def cordon(self, node: str, reason: str = "manual") -> None:
+        """Operator cordon: masked like a quarantine but never
+        auto-released (no probation) — only `uncordon` lifts it."""
+        fire = []
+        with self._lock:
+            rec = self._records.setdefault(node, _Record())
+            old = rec.state
+            rec.manual = True
+            rec.clean_cycles = 0
+            if rec.state != NodeState.CORDONED:
+                rec.state = NodeState.CORDONED
+                self.cordons_total += 1
+                fire.append(_Transition(node, old, rec.state, reason))
+        self._fire(fire)
+
+    def uncordon(self, node: str) -> None:
+        """Operator uncordon: straight back to OK (score cleared)."""
+        fire = []
+        with self._lock:
+            rec = self._records.get(node)
+            if rec is None:
+                return
+            old = rec.state
+            if old in (NodeState.CORDONED, NodeState.PROBATION):
+                self._reset(rec)
+                fire.append(_Transition(node, old, rec.state, "uncordon"))
+        self._fire(fire)
+
+    def forget(self, node: str) -> None:
+        """The node left the cluster (DELETED / vanished): drop its
+        record and clear its gauges — a decommissioned node must not
+        inflate `quarantined_nodes` / the /healthz count forever, and
+        under churn the record map must not grow without bound.  A
+        same-named node rejoining later starts with a clean slate."""
+        with self._lock:
+            rec = self._records.pop(node, None)
+            self._sink_pending.pop(node, None)
+        if rec is None:
+            return
+        metrics.node_health_state.set(STATE_VALUES[NodeState.OK], node)
+        count = self.quarantined_count()
+        metrics.quarantined_nodes.set(float(count))
+        metrics.set_quarantined(count)
+
+    # -- the per-cycle clock --------------------------------------------
+    def on_cycle(self) -> None:
+        """Decay suspicion and advance clean windows — the ledger's
+        only clock (cycles, not wall seconds: chaos determinism)."""
+        cfg = self.config
+        fire: list[_Transition] = []
+        with self._lock:
+            for name, rec in self._records.items():
+                rec.score *= cfg.decay
+                if rec.score < _SCORE_FLOOR:
+                    rec.score = 0.0
+                if rec.state == NodeState.SUSPECT and rec.score == 0.0:
+                    rec.state = NodeState.OK
+                    fire.append(_Transition(
+                        name, NodeState.SUSPECT, NodeState.OK, "decayed",
+                    ))
+                elif rec.state == NodeState.CORDONED and not rec.manual:
+                    rec.clean_cycles += 1
+                    if rec.clean_cycles >= cfg.probation_ticks:
+                        rec.state = NodeState.PROBATION
+                        rec.clean_cycles = 0
+                        rec.canary_used = 0
+                        rec.score = 0.0
+                        fire.append(_Transition(
+                            name, NodeState.CORDONED,
+                            NodeState.PROBATION,
+                            f"clean for {cfg.probation_ticks} cycles; "
+                            f"canary cap {cfg.probation_canary}",
+                        ))
+                elif rec.state == NodeState.PROBATION:
+                    rec.clean_cycles += 1
+                    if rec.clean_cycles >= cfg.probation_ticks:
+                        old = rec.state
+                        self._reset(rec)
+                        fire.append(_Transition(
+                            name, old, NodeState.OK, "proved out",
+                        ))
+        self._fire(fire)
+        self._flush_sink()
+
+    # -- queries --------------------------------------------------------
+    def state_of(self, node: str) -> str:
+        with self._lock:
+            rec = self._records.get(node)
+            return rec.state if rec is not None else NodeState.OK
+
+    def schedulable(self, node: str) -> bool:
+        """False only while CORDONED (probation admits, canary-capped)."""
+        return self.state_of(node) != NodeState.CORDONED
+
+    def quarantined_count(self) -> int:
+        with self._lock:
+            return sum(
+                1 for r in self._records.values()
+                if r.state == NodeState.CORDONED
+            )
+
+    def pack_view(self) -> tuple[frozenset[str], dict[str, float]]:
+        """(cordoned node names, probation node → remaining canary) —
+        the packer's one read per pack.  Touches nothing but ledger
+        state (lock-order safe under the cache mutex)."""
+        with self._lock:
+            cordoned = frozenset(
+                n for n, r in self._records.items()
+                if r.state == NodeState.CORDONED
+            )
+            canary = {
+                n: float(max(
+                    self.config.probation_canary - r.canary_used, 0,
+                ))
+                for n, r in self._records.items()
+                if r.state == NodeState.PROBATION
+            }
+        return cordoned, canary
+
+    def sample(self) -> dict:
+        """Chaos/debug snapshot: states + counters (stable ordering)."""
+        with self._lock:
+            states = {
+                n: r.state for n, r in sorted(self._records.items())
+                if r.state != NodeState.OK or r.score > 0
+            }
+            canary = {
+                n: self.config.probation_canary - r.canary_used
+                for n, r in sorted(self._records.items())
+                if r.state == NodeState.PROBATION
+            }
+        return {
+            "states": states,
+            "canary_remaining": canary,
+            "cordons_total": self.cordons_total,
+            "probation_failures_total": self.probation_failures_total,
+        }
+
+    # -- internals ------------------------------------------------------
+    def _reset(self, rec: _Record) -> None:
+        rec.state = NodeState.OK
+        rec.score = 0.0
+        rec.clean_cycles = 0
+        rec.multiplier = 1.0
+        rec.canary_used = 0
+        rec.manual = False
+
+    def _suspect(self, node: str, weight: float, reason: str) -> None:
+        cfg = self.config
+        fire: list[_Transition] = []
+        with self._lock:
+            rec = self._records.setdefault(node, _Record())
+            rec.clean_cycles = 0
+            old = rec.state
+            if old == NodeState.PROBATION:
+                # Any failure during probation re-cordons at a HIGHER
+                # threshold: the node burned its canary trust.
+                rec.state = NodeState.CORDONED
+                rec.multiplier = min(
+                    rec.multiplier * cfg.escalation, cfg.max_escalation,
+                )
+                rec.score = 0.0
+                self.cordons_total += 1
+                self.probation_failures_total += 1
+                metrics.probation_failures.inc()
+                fire.append(_Transition(
+                    node, old, NodeState.CORDONED,
+                    f"probation failure ({reason}); threshold now "
+                    f"×{rec.multiplier:g}",
+                ))
+            elif old == NodeState.CORDONED:
+                pass  # already masked; the clean-window reset above
+                #       is the whole effect
+            else:
+                rec.score += weight
+                if rec.score >= cfg.quarantine_threshold * rec.multiplier:
+                    rec.state = NodeState.CORDONED
+                    self.cordons_total += 1
+                    fire.append(_Transition(
+                        node, old, NodeState.CORDONED,
+                        f"suspicion {rec.score:g} ≥ threshold "
+                        f"{cfg.quarantine_threshold * rec.multiplier:g} "
+                        f"({reason})",
+                    ))
+                elif old == NodeState.OK:
+                    rec.state = NodeState.SUSPECT
+                    fire.append(_Transition(
+                        node, old, NodeState.SUSPECT, reason,
+                    ))
+        self._fire(fire)
+
+    _EVENT_REASONS = {
+        NodeState.SUSPECT: "NodeSuspect",
+        NodeState.CORDONED: "NodeCordoned",
+        NodeState.PROBATION: "NodeProbation",
+        NodeState.OK: "NodeUncordoned",
+    }
+
+    def _fire(self, transitions: list[_Transition]) -> None:
+        """Publish state changes (OUTSIDE the ledger lock): metrics,
+        /healthz count, the cache's pack journal + event ring, and the
+        cordon sink.  A cordon/uncordon only changes one node ROW
+        (node_ready / the canary idle clamp), so the journal mark is
+        per-node — both pack paths pick it up."""
+        if not transitions:
+            return
+        for t in transitions:
+            metrics.node_health_state.set(STATE_VALUES[t.new], t.node)
+            level = (
+                logging.WARNING
+                if t.new in (NodeState.CORDONED, NodeState.SUSPECT)
+                else logging.INFO
+            )
+            log.log(level, "node %s: %s -> %s (%s)",
+                    t.node, t.old, t.new, t.reason)
+        count = self.quarantined_count()
+        metrics.quarantined_nodes.set(float(count))
+        metrics.set_quarantined(count)
+        cache = self._cache
+        for t in transitions:
+            if cache is not None:
+                with cache.lock():
+                    cache._mark_node(t.node)
+                cache.record_event(
+                    "Node", t.node, self._EVENT_REASONS[t.new],
+                    f"{t.old} -> {t.new}: {t.reason}",
+                )
+            if self.cordon_sink is not None and (
+                t.new == NodeState.CORDONED
+                or t.old == NodeState.CORDONED
+            ):
+                with self._lock:
+                    self._sink_pending[t.node] = \
+                        t.new == NodeState.CORDONED
+        self._flush_sink()
+
+    def _flush_sink(self) -> None:
+        """Push pending spec.unschedulable writes; failures stay
+        PENDING and retry from on_cycle — an uncordon lost to a wire
+        blip (or a breaker fast-fail: the CLI wires the sink through
+        the GuardedBackend) must not mask a healed node forever.  The
+        local pack mask is the enforcement either way; this mirror is
+        what kubectl and other controllers see."""
+        sink = self.cordon_sink
+        if sink is None:
+            return
+        with self._lock:
+            pending = list(self._sink_pending.items())
+        for node, unschedulable in pending:
+            try:
+                sink(node, unschedulable)
+            except Exception as exc:  # noqa: BLE001 — retried next cycle
+                log.warning(
+                    "cordon sink write for %s pending (local mask "
+                    "still enforced; retrying next cycle): %s",
+                    node, exc,
+                )
+                continue
+            with self._lock:
+                # Only clear if no NEWER desired state superseded it
+                # while the write was in flight.
+                if self._sink_pending.get(node) == unschedulable:
+                    self._sink_pending.pop(node, None)

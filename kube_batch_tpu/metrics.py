@@ -333,6 +333,31 @@ hbm_blocked_cycles = REGISTRY.register(Counter(
     "action).",
 ))
 
+# -- node-health subsystem (kube_batch_tpu/health/) --------------------------
+node_health_state = REGISTRY.register(Gauge(
+    "node_health_state",
+    "Health-ledger state per node (0 ok, 1 suspect, 2 cordoned, "
+    "3 probation); transitions also emit Node events.",
+    labels=("node",),
+))
+quarantined_nodes = REGISTRY.register(Gauge(
+    "quarantined_nodes",
+    "Nodes currently CORDONED by the health ledger (masked out of new "
+    "placements; running pods stay) — mirrored by the /healthz body's "
+    "`quarantined` count.",
+))
+quarantined_nodes.set(0.0)
+drain_evictions = REGISTRY.register(Counter(
+    "drain_evictions_total",
+    "Pods evicted by the gang-atomic --drain-cordoned migration "
+    "(each one had a proven re-placement on healthy capacity).",
+))
+probation_failures = REGISTRY.register(Counter(
+    "probation_failures_total",
+    "Probation nodes re-cordoned by a failure during their canary "
+    "window (the quarantine threshold escalates each time).",
+))
+
 # -- leadership fencing + failover (doc/design/failover-fencing.md) ----------
 leader_epoch = REGISTRY.register(Gauge(
     "leader_epoch",
@@ -364,6 +389,7 @@ _health_lock = threading.Lock()
 _health_state = "ok"
 _health_role = "standby"
 _health_epoch = 0
+_health_quarantined = 0
 
 
 def set_health_state(state: str) -> None:
@@ -397,11 +423,26 @@ def leadership() -> tuple[str, int]:
         return _health_role, _health_epoch
 
 
+def set_quarantined(count: int) -> None:
+    """Publish the health ledger's cordoned-node count to /healthz —
+    a fleet runbook's "is degraded hardware masked right now" read,
+    without scraping /metrics (doc/design/node-health.md)."""
+    global _health_quarantined
+    with _health_lock:
+        _health_quarantined = int(count)
+
+
+def quarantined() -> int:
+    with _health_lock:
+        return _health_quarantined
+
+
 def health_body() -> bytes:
     """The /healthz response body: one JSON object carrying the
-    guardrail ladder state plus election role + fencing epoch.
-    (Plain-text "ok" grew fields in the failover PR; probes matching
-    the old body should switch to `.state`.)"""
+    guardrail ladder state, election role + fencing epoch, and the
+    health ledger's quarantined-node count.  (Plain-text "ok" grew
+    fields in the failover PR; probes matching the old body should
+    switch to `.state`.)"""
     import json
 
     with _health_lock:
@@ -409,6 +450,7 @@ def health_body() -> bytes:
             "state": _health_state,
             "role": _health_role,
             "epoch": _health_epoch,
+            "quarantined": _health_quarantined,
         }
     return json.dumps(body, sort_keys=True).encode()
 

@@ -51,10 +51,20 @@ class Scheduler:
         schedule_period: float = DEFAULT_SCHEDULE_PERIOD,
         profile_dir: str | None = None,
         guardrails: Guardrails | None = None,
+        health=None,
     ) -> None:
         self.cache = cache
         self.conf_path = conf_path
         self.schedule_period = schedule_period
+        # Node-health ledger (kube_batch_tpu/health/): per-node
+        # suspicion scoring + the quarantine state machine the loop
+        # clocks every cycle (on_cycle decays scores and advances
+        # probation windows) and the opt-in gang-atomic drain of
+        # cordoned nodes.  None disables the subsystem entirely —
+        # the cache hooks and the pack masks all no-op.
+        self.health = health
+        if health is not None:
+            cache.attach_health(health)
         # Self-protection layer (kube_batch_tpu/guardrails/): the loop
         # consults it every cycle — half-open breaker probing before,
         # watchdog latency observation after, HBM-ceiling admission
@@ -907,6 +917,28 @@ class Scheduler:
         self._idle_armed = False
         self._idle_refreshed_version = 0
 
+    def _maybe_drain_cordoned(self, view=None) -> None:
+        """Run the opt-in gang-atomic drain (health/drain.py) when a
+        ledger with drain_cordoned is wired and the mirror is not
+        quiesced.  `view` is the ledger state captured at CYCLE START
+        (the same settled view the pack saw) — a cordon landing
+        mid-cycle drains next cycle, deterministically, instead of
+        racing this plan against in-flight commit flushes."""
+        if (
+            self.health is None
+            or not self.health.config.drain_cordoned
+            or self.cache.is_resyncing()
+        ):
+            return
+        from kube_batch_tpu.health import drain_cordoned_gangs
+
+        drained = drain_cordoned_gangs(self.cache, self.health, view=view)
+        if drained:
+            logging.info(
+                "drain-cordoned: %d member eviction(s) landed this "
+                "cycle", drained,
+            )
+
     # -- idle early-out (≙ runOnce being near-free on an idle cluster) --
     def _skip_idle(self) -> bool:
         """True when the solve dispatch can be skipped outright: the
@@ -1003,6 +1035,18 @@ class Scheduler:
             resync = self.cache.drain_resync()
             if resync:
                 logging.info("retrying %d failed binds", len(resync))
+            health_view = None
+            if self.health is not None:
+                # The ledger's clock: decay suspicion, advance clean
+                # windows (cordoned → probation → ok).  Runs on idle
+                # cycles too — an idle cluster must still rehabilitate
+                # its nodes.  The view captured HERE — the same one
+                # this cycle's pack will observe — drives the drain
+                # plan below, so a cordon landing mid-cycle (a flush
+                # worker's refusal crossing the threshold) takes
+                # effect next cycle instead of racing the plan.
+                self.health.on_cycle()
+                health_view = self.health.pack_view()
             if self._skip_idle():
                 metrics.idle_cycles_skipped.inc()
                 metrics.schedule_attempts.inc("idle")
@@ -1011,6 +1055,11 @@ class Scheduler:
                 # was blocking, the blocked rows are gone — lift the
                 # /healthz floor.
                 self.guardrails.note_hbm_block(False)
+                # Cordoned nodes may still host whole gangs while the
+                # cluster is otherwise idle — drain runs on idle
+                # cycles too (its evictions become next cycle's
+                # pending work).
+                self._maybe_drain_cordoned(health_view)
                 return None
             try:
                 ssn = open_session(
@@ -1056,6 +1105,14 @@ class Scheduler:
             # The pack drained the journal; idle-refresh marks restart.
             self._idle_refreshed_version = 0
             self._maybe_prewarm_growth(ssn)
+            # Gang-atomic migration off cordoned nodes (budget-limited;
+            # health/drain.py), at END of cycle: the evictions settle
+            # over the wire (watch echoes ingest between cycles) and
+            # the NEXT cycle's pack deterministically sees the members
+            # Pending and re-places them on healthy capacity — an
+            # in-cycle drain would race its own echo and re-place
+            # nondeterministically.
+            self._maybe_drain_cordoned(health_view)
         if ssn.bound or ssn.evicted:
             result = "scheduled"
         elif np.any(

@@ -1,0 +1,69 @@
+#!/usr/bin/env python
+"""Assert the flaky-node chaos acceptance criteria over two same-seed
+runs (make chaos; doc/design/node-health.md):
+
+* both runs completed with zero invariant violations and converged
+  (the per-tick no-placement-on-cordoned, probation-canary-bounded
+  and gang-atomic-drain invariants all held, and the ledger walked
+  ok → cordoned → probation → ok before the drain deadline);
+* quarantine actually ENGAGED: at least one cordon, driven by the
+  node's answered bind refusals and NotReady flaps;
+* zero placements leaked onto cordoned nodes and zero canary
+  overruns;
+* the LIVE wire circuit breaker never tripped: a flaky node's
+  refusals are answered app-level failures and must stay per-node
+  health evidence, while healthy-node binds keep flowing (the run
+  bound a real workload throughout);
+* same seed ⇒ same trace hash across the two runs — quarantine,
+  drain and probation are fully deterministic.
+"""
+
+import json
+import sys
+
+
+def main(path_a: str, path_b: str) -> int:
+    with open(path_a, encoding="utf-8") as f:
+        a = json.load(f)
+    with open(path_b, encoding="utf-8") as f:
+        b = json.load(f)
+    for name, run in (("run1", a), ("run2", b)):
+        assert run["ok"], f"{name} violations: {run['violations']}"
+        assert run["converged_after_drain_ticks"] is not None, \
+            f"{name} never converged"
+        health = run["health"]
+        assert health is not None, f"{name}: no health summary"
+        assert health["cordons"] >= 1, \
+            f"{name}: quarantine never engaged: {health}"
+        assert health["flaky_bind_faults"] >= 1, \
+            f"{name}: the flaky node never refused a bind: {health}"
+        assert health["cordoned_placements"] == 0, \
+            f"{name}: placements leaked onto cordoned nodes: {health}"
+        assert health["canary_overruns"] == 0, \
+            f"{name}: probation canary cap exceeded: {health}"
+        assert health["final_states"] == {}, \
+            f"{name}: ledger did not fully recover: {health}"
+        rails = run["guardrail"]
+        assert rails is not None and rails["breaker_opened"] == 0, (
+            f"{name}: the wire breaker tripped on node-level "
+            f"refusals: {rails}"
+        )
+        assert run["bound_pods"] >= 1, \
+            f"{name}: no healthy-node binds landed"
+    assert a["trace_hash"] == b["trace_hash"], (
+        f"same-seed flaky runs diverged: "
+        f"{a['trace_hash']} != {b['trace_hash']}"
+    )
+    h = a["health"]
+    print(
+        "chaos flaky: ok — same-seed hash "
+        f"{a['trace_hash'][:16]}… reproduced; {h['cordons']} cordon(s) "
+        f"after {h['flaky_bind_faults']} refused bind(s), breaker "
+        "stayed closed, 0 cordoned placements, "
+        f"{h['drain_evictions']} drain eviction(s), ledger recovered"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1], sys.argv[2]))

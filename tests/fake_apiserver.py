@@ -29,6 +29,7 @@ class FakeApiServer:
         self.bindings: list[dict] = []
         self.deletes: list[str] = []          # paths
         self.status_puts: list[dict] = []
+        self.node_patches: list[dict] = []    # cordon/uncordon merge PATCHes
         self.events: list[dict] = []
         self.force_gone = False               # next watches answer 410
         self.missing_kinds: set[str] = set()  # "CRD not installed": 404s
@@ -73,6 +74,9 @@ class FakeApiServer:
 
             def do_DELETE(self):  # noqa: N802
                 server._serve_write(self, "DELETE")
+
+            def do_PATCH(self):  # noqa: N802
+                server._serve_write(self, "PATCH")
 
         self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
         self.port = self.httpd.server_address[1]
@@ -295,6 +299,24 @@ class FakeApiServer:
             with self._lock:
                 self.status_puts.append({"path": path, "object": body})
             handler._json(200, body)
+            return
+
+        m = re.fullmatch(r"/api/v1/nodes/([^/]+)", path)
+        if m and method == "PATCH":
+            # ≙ kubectl cordon/uncordon: merge-PATCH of
+            # spec.unschedulable (the health ledger's cordon sink).
+            with self._lock:
+                self.node_patches.append({"path": path, "object": body})
+                node = self.objects.get("Node", {}).get(m.group(1))
+            if node is None:
+                handler._json(404, {"kind": "Status", "code": 404})
+                return
+            node = json.loads(json.dumps(node))
+            node.setdefault("spec", {})["unschedulable"] = bool(
+                (body.get("spec") or {}).get("unschedulable")
+            )
+            self.upsert("Node", node)
+            handler._json(200, node)
             return
 
         if re.fullmatch(r"/api/v1/namespaces/[^/]+/events", path) \

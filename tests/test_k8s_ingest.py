@@ -478,7 +478,10 @@ def test_node_modified_updates_conditions_and_capacity():
         assert info.node.memory_pressure
         assert info.allocatable[0] == 8000.0  # re-derived, cores→milli
 
-    # unschedulable spec flips readiness off
+    # spec.unschedulable (kubectl cordon) is carried as its OWN field
+    # since the node-health PR — the node stays READY (and in the
+    # snapshot, so residents keep their accounting) but is masked out
+    # of new placements via the packed node_ready bit.
     cordoned = dict(k8s_node("n0", cpu="8"))
     cordoned["spec"]["unschedulable"] = True
     reader = io.StringIO(json.dumps(
@@ -487,7 +490,10 @@ def test_node_modified_updates_conditions_and_capacity():
     adapter = K8sWatchAdapter(cache, reader)
     adapter.start(); adapter.join(10)
     with cache.lock():
-        assert not cache._nodes["n0"].node.ready
+        assert cache._nodes["n0"].node.unschedulable
+        assert cache._nodes["n0"].node.ready
+    snap = cache.snapshot()
+    assert "n0" in snap.nodes  # masked, not dropped
 
 
 def test_podgroup_modified_updates_min_member():

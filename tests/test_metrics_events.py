@@ -58,8 +58,46 @@ def test_metrics_http_endpoint():
         assert body["state"] == "ok"
         assert body["role"] in ("leader", "standby")
         assert isinstance(body["epoch"], int)
+        # Node-health subsystem surface (doc/design/node-health.md):
+        # the quarantined-node count rides the /healthz body.
+        assert isinstance(body["quarantined"], int)
     finally:
         thread.server.shutdown()
+
+
+def test_node_health_metrics_and_healthz_quarantined():
+    """Ledger transitions publish node_health_state{node} /
+    quarantined_nodes / probation_failures_total, and /healthz's
+    `quarantined` count tracks cordons (satellite of the node-health
+    PR; doc/design/node-health.md)."""
+    import json
+
+    from kube_batch_tpu.health import NodeHealthConfig, NodeHealthLedger
+
+    ledger = NodeHealthLedger(NodeHealthConfig(
+        quarantine_threshold=2.0, decay=1.0, probation_ticks=1,
+    ))
+    before_probation = metrics.probation_failures.value()
+    ledger.note_bind_failure("m-quarantine", "refused")
+    assert metrics.node_health_state.value("m-quarantine") == 1.0  # suspect
+    ledger.note_bind_failure("m-quarantine", "refused")
+    assert metrics.node_health_state.value("m-quarantine") == 2.0  # cordoned
+    assert metrics.quarantined_nodes.value() == 1.0
+    assert json.loads(metrics.health_body())["quarantined"] == 1
+    ledger.on_cycle()   # clean window → probation
+    assert metrics.node_health_state.value("m-quarantine") == 3.0
+    assert metrics.quarantined_nodes.value() == 0.0
+    assert json.loads(metrics.health_body())["quarantined"] == 0
+    ledger.note_bind_failure("m-quarantine", "refused")  # probation failure
+    assert metrics.node_health_state.value("m-quarantine") == 2.0
+    assert metrics.probation_failures.value() - before_probation == 1.0
+    # drain_evictions_total increments through the drain funnel.
+    before_drain = metrics.drain_evictions.value()
+    metrics.drain_evictions.inc()
+    assert metrics.drain_evictions.value() - before_drain == 1.0
+    # Leave the process-global /healthz count clean for other tests.
+    ledger.uncordon("m-quarantine")
+    assert json.loads(metrics.health_body())["quarantined"] == 0
 
 
 def test_unschedulable_event_names_the_shortfall():

@@ -1,0 +1,743 @@
+"""Node-health subsystem tests (kube_batch_tpu/health/).
+
+Coverage map (doc/design/node-health.md):
+
+* the ledger state machine — suspicion accrual/decay, quarantine at
+  threshold, clean-window probation, canary accounting, probation
+  failure escalation, manual cordon/uncordon;
+* tensor enforcement on BOTH pack paths — a cordoned node's
+  node_ready bit masks placements (full rebuild AND incremental row
+  patch), externally-cordoned (spec.unschedulable) nodes are
+  respected symmetrically, and a probation node's pod-slot idle is
+  clamped to its remaining canary;
+* the previously-dead condition wiring — an explicit Ready=False
+  condition makes a node unschedulable even when the bare `ready`
+  bool was left True (regression: parsed-and-ignored);
+* breaker failure attribution — bind failures whose transport
+  ANSWERED feed the node's ledger and can never trip the global wire
+  circuit breaker, while transient wire deaths feed the breaker and
+  never the ledger;
+* gang-atomic drain — all-or-nothing member migration with a
+  host-side placement proof, PDB floors and the per-cycle budget;
+* chaos parity — vanish/heal round-trips the FULL node spec;
+* the k8s dialect cordon write — spec.unschedulable PATCH.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from kube_batch_tpu.actions import BUILTIN_ACTIONS  # noqa: F401
+from kube_batch_tpu.api.resource import ResourceSpec
+from kube_batch_tpu.api.types import TaskStatus
+from kube_batch_tpu.cache.cache import SchedulerCache
+from kube_batch_tpu.cache.cluster import (
+    Node,
+    Pod,
+    PodDisruptionBudget,
+    PodGroup,
+)
+from kube_batch_tpu.cache.incremental import IncrementalPacker
+from kube_batch_tpu.guardrails.breaker import CircuitBreaker, GuardedBackend
+from kube_batch_tpu.health import (
+    NodeHealthConfig,
+    NodeHealthLedger,
+    NodeState,
+    drain_cordoned_gangs,
+)
+from kube_batch_tpu.models.workloads import GI
+from kube_batch_tpu.plugins import BUILTIN_PLUGINS  # noqa: F401
+from kube_batch_tpu.sim.simulator import make_world
+
+from kube_batch_tpu.framework import PluginConf, SchedulerConf, TierConf
+
+from tests.test_allocate_gang import run_one_cycle
+
+SPEC = ResourceSpec(("cpu", "memory", "pods", "accelerator"))
+
+# The quarantine mask is carried by the packed node_ready bit, which
+# the predicates plugin consumes (the default production conf includes
+# it; cache.begin_bind's cordon refusal is the backstop for confs that
+# don't).
+CONF = SchedulerConf(
+    actions=("allocate",),
+    tiers=(
+        TierConf(plugins=(PluginConf("priority"), PluginConf("gang"))),
+        TierConf(plugins=(PluginConf("predicates"),
+                          PluginConf("nodeorder"))),
+    ),
+)
+
+
+def _node(name, cpu=4000.0, pods=110.0, **kw):
+    return Node(
+        name=name,
+        allocatable={"cpu": cpu, "memory": 8 * GI, "pods": pods},
+        **kw,
+    )
+
+
+def _gang(sim, name, n=1, cpu=1000.0, labels=None, min_member=None):
+    group = PodGroup(name=name, queue="default",
+                     min_member=min_member or n)
+    pods = [
+        Pod(name=f"{name}-{i}",
+            request={"cpu": cpu, "memory": GI, "pods": 1},
+            labels=dict(labels or {}))
+        for i in range(n)
+    ]
+    sim.submit(group, pods)
+    return pods
+
+
+# ---------------------------------------------------------------------------
+# ledger state machine
+# ---------------------------------------------------------------------------
+
+def test_suspicion_decays_back_to_ok():
+    ledger = NodeHealthLedger(NodeHealthConfig(
+        quarantine_threshold=5.0, decay=0.5,
+    ))
+    ledger.note_bind_failure("n", "refused")
+    assert ledger.state_of("n") == NodeState.SUSPECT
+    assert ledger.schedulable("n")  # suspect still schedules
+    for _ in range(8):
+        ledger.on_cycle()
+    assert ledger.state_of("n") == NodeState.OK
+
+
+def test_threshold_cordons_then_probation_then_ok():
+    ledger = NodeHealthLedger(NodeHealthConfig(
+        quarantine_threshold=3.0, decay=1.0, probation_ticks=2,
+        probation_canary=2,
+    ))
+    for _ in range(3):
+        ledger.note_bind_failure("n")
+    assert ledger.state_of("n") == NodeState.CORDONED
+    assert not ledger.schedulable("n")
+    cordoned, canary = ledger.pack_view()
+    assert cordoned == frozenset({"n"})
+    # Clean window → probation with the full canary.
+    ledger.on_cycle()
+    ledger.on_cycle()
+    assert ledger.state_of("n") == NodeState.PROBATION
+    assert ledger.schedulable("n")
+    cordoned, canary = ledger.pack_view()
+    assert cordoned == frozenset()
+    assert canary == {"n": 2.0}
+    # Placements consume canary slots at commit time.
+    ledger.note_placement("n")
+    assert ledger.pack_view()[1] == {"n": 1.0}
+    # Another clean window → full OK, canary forgotten.
+    ledger.on_cycle()
+    ledger.on_cycle()
+    assert ledger.state_of("n") == NodeState.OK
+    assert ledger.pack_view() == (frozenset(), {})
+
+
+def test_probation_failure_recordons_at_escalated_threshold():
+    ledger = NodeHealthLedger(NodeHealthConfig(
+        quarantine_threshold=2.0, decay=1.0, probation_ticks=1,
+        escalation=2.0,
+    ))
+    ledger.note_bind_failure("n")
+    ledger.note_bind_failure("n")
+    assert ledger.state_of("n") == NodeState.CORDONED
+    ledger.on_cycle()
+    assert ledger.state_of("n") == NodeState.PROBATION
+    # Any failure during probation re-cordons immediately...
+    ledger.note_bind_failure("n")
+    assert ledger.state_of("n") == NodeState.CORDONED
+    assert ledger.probation_failures_total == 1
+    # ...and the NEXT quarantine needs threshold × escalation points:
+    # after rehabilitation, 3 failures (< 2 × 2.0) must not cordon.
+    ledger.on_cycle()          # → probation
+    ledger.on_cycle()          # → ok (multiplier survives until reset)
+    assert ledger.state_of("n") == NodeState.OK
+    ledger2 = NodeHealthLedger(NodeHealthConfig(
+        quarantine_threshold=2.0, decay=1.0, probation_ticks=10,
+        escalation=2.0,
+    ))
+    ledger2.note_bind_failure("m")
+    ledger2.note_bind_failure("m")
+    ledger2._records["m"].multiplier = 2.0
+    ledger2._records["m"].state = NodeState.SUSPECT
+    ledger2._records["m"].score = 2.0
+    ledger2.note_bind_failure("m")   # 3.0 < 2.0 × 2.0: stays suspect
+    assert ledger2.state_of("m") == NodeState.SUSPECT
+    ledger2.note_bind_failure("m")   # 4.0 ≥ 4.0: cordons
+    assert ledger2.state_of("m") == NodeState.CORDONED
+
+
+def test_manual_cordon_never_auto_releases():
+    ledger = NodeHealthLedger(NodeHealthConfig(probation_ticks=1))
+    ledger.cordon("n")
+    for _ in range(10):
+        ledger.on_cycle()
+    assert ledger.state_of("n") == NodeState.CORDONED
+    ledger.uncordon("n")
+    assert ledger.state_of("n") == NodeState.OK
+    assert ledger.schedulable("n")
+
+
+# ---------------------------------------------------------------------------
+# pack enforcement (full + incremental)
+# ---------------------------------------------------------------------------
+
+def test_cordoned_node_masked_running_pods_stay():
+    cache, sim = make_world(SPEC)
+    sim.add_node(_node("flaky"))
+    sim.add_node(_node("healthy"))
+    ledger = NodeHealthLedger(NodeHealthConfig(quarantine_threshold=1.0))
+    cache.attach_health(ledger)
+    # A pod already running on the soon-cordoned node.
+    _gang(sim, "resident")
+    ssn = run_one_cycle(cache, CONF)
+    (res_name, res_node), = ssn.bound
+    sim.tick()
+    ledger.cordon(res_node)
+    other = "healthy" if res_node == "flaky" else "flaky"
+    # New work must land on the OTHER node; the resident stays.
+    _gang(sim, "newcomer")
+    ssn2 = run_one_cycle(cache, CONF)
+    assert ssn2.bound == [("newcomer-0", other)]
+    snap = cache.snapshot()
+    assert res_node in snap.nodes          # still in the snapshot
+    assert snap.cordoned == frozenset({res_node})
+    with cache.lock():
+        resident = next(
+            p for p in cache._pods.values() if p.name == res_name
+        )
+        assert resident.node == res_node   # running pod untouched
+        assert resident.status == TaskStatus.RUNNING
+
+
+def test_incremental_pack_patches_cordon_row():
+    cache, sim = make_world(SPEC)
+    sim.add_node(_node("a"))
+    sim.add_node(_node("b"))
+    ledger = NodeHealthLedger(NodeHealthConfig(quarantine_threshold=1.0))
+    cache.attach_health(ledger)
+    packer = IncrementalPacker(cache)
+    snap, meta = packer.pack()
+    row = meta.node_names.index("a")
+    assert bool(np.asarray(snap.node_ready)[row])
+    # Cordon marks the node row in the journal; the next pack must be
+    # INCREMENTAL and flip node_ready without a rebuild.
+    ledger.cordon("a")
+    snap2, meta2 = packer.pack()
+    assert packer.last_mode.startswith("incremental")
+    assert not bool(np.asarray(snap2.node_ready)[row])
+    # Uncordon patches it back.
+    ledger.uncordon("a")
+    snap3, _ = packer.pack()
+    assert packer.last_mode.startswith("incremental")
+    assert bool(np.asarray(snap3.node_ready)[row])
+    packer.verify_against_live()
+
+
+def test_external_unschedulable_respected_symmetrically():
+    """A spec.unschedulable cordon observed on the watch (another
+    controller / kubectl) masks placements exactly like a ledger
+    cordon — no ledger required."""
+    cache, sim = make_world(SPEC)
+    sim.add_node(_node("corded", unschedulable=True))
+    sim.add_node(_node("open"))
+    _gang(sim, "j")
+    ssn = run_one_cycle(cache, CONF)
+    assert ssn.bound == [("j-0", "open")]
+    # The cordoned node is IN the snapshot (residents would stay
+    # accounted), just masked.
+    assert "corded" in cache.snapshot().nodes
+
+
+def test_notready_condition_is_unschedulable():
+    """Regression (previously parsed-and-ignored): an explicit
+    Ready=False condition excludes the node even when the bare
+    `ready` bool was left True by the feed."""
+    cache, sim = make_world(SPEC)
+    sim.add_node(_node("sick", ready=True, conditions={"Ready": False}))
+    sim.add_node(_node("ok"))
+    _gang(sim, "j")
+    ssn = run_one_cycle(cache, CONF)
+    assert ssn.bound == [("j-0", "ok")]
+    assert "sick" not in cache.snapshot().nodes
+
+
+def test_probation_canary_clamps_placements():
+    cache, sim = make_world(SPEC)
+    sim.add_node(_node("prob", cpu=64000.0))
+    ledger = NodeHealthLedger(NodeHealthConfig(
+        quarantine_threshold=1.0, probation_ticks=1, probation_canary=1,
+    ))
+    cache.attach_health(ledger)
+    ledger.cordon("prob")
+    ledger._records["prob"].manual = False  # as if quarantined
+    ledger.on_cycle()
+    assert ledger.state_of("prob") == NodeState.PROBATION
+    # Three one-pod gangs, plenty of cpu — but only ONE canary slot:
+    # exactly one pod may land this cycle.
+    for i in range(3):
+        _gang(sim, f"j{i}")
+    ssn = run_one_cycle(cache, CONF)
+    assert len(ssn.bound) == 1
+    snap = cache.snapshot()
+    assert snap.canary_pods == {"prob": 0.0}
+
+
+def test_cordon_refused_at_begin_bind():
+    """A node quarantined between snapshot and commit refuses the bind
+    at the cache funnel (resync, not a landing on sick hardware)."""
+    cache, sim = make_world(SPEC)
+    sim.add_node(_node("n"))
+    ledger = NodeHealthLedger(NodeHealthConfig(quarantine_threshold=1.0))
+    cache.attach_health(ledger)
+    (pod,) = _gang(sim, "j")
+    ledger.cordon("n")
+    assert cache.bind(pod.uid, "n") is False
+    assert cache.drain_resync() == [pod.uid]
+    with cache.lock():
+        assert cache._pods[pod.uid].status == TaskStatus.PENDING
+
+
+# ---------------------------------------------------------------------------
+# breaker failure attribution (satellite: scope the streak)
+# ---------------------------------------------------------------------------
+
+class _NodeRefusingBinder:
+    """A backend whose transport always ANSWERS: binds to the flaky
+    node are refused app-level; healthy binds succeed."""
+
+    def __init__(self, flaky: str) -> None:
+        self.flaky = flaky
+        self.binds: list[tuple[str, str]] = []
+
+    def ping(self) -> None:
+        pass
+
+    def bind(self, pod, node_name: str) -> None:
+        if node_name == self.flaky:
+            raise RuntimeError("kubelet refused bind")
+        self.binds.append((pod.name, node_name))
+
+    def evict(self, pod, reason: str) -> None:
+        pass
+
+    def update_pod_group(self, group) -> None:
+        pass
+
+
+def test_flaky_node_feeds_ledger_not_breaker():
+    """One flaky node's answered refusals quarantine THAT node while
+    the global breaker stays closed and healthy-node binds flow."""
+    breaker = CircuitBreaker(trip_after=3, reset_after=99.0)
+    inner = _NodeRefusingBinder("flaky")
+    guarded = GuardedBackend(inner, breaker=breaker)
+    cache = SchedulerCache(
+        SPEC, binder=guarded, evictor=guarded, status_updater=None,
+    )
+    ledger = NodeHealthLedger(NodeHealthConfig(
+        quarantine_threshold=4.0, decay=1.0,
+    ))
+    cache.attach_health(ledger)
+    cache.add_node(_node("flaky"))
+    cache.add_node(_node("good"))
+    pods = []
+    for i in range(8):
+        p = Pod(name=f"p{i}", request={"cpu": 100, "memory": GI,
+                                       "pods": 1})
+        cache.add_pod(p)
+        pods.append(p)
+    # Far more consecutive refusals than trip_after: every one is an
+    # answered app-level failure → breaker success, ledger suspicion.
+    for p in pods[:4]:
+        assert cache.bind(p.uid, "flaky") is False
+    assert breaker.state == CircuitBreaker.CLOSED
+    assert breaker.opened_count == 0
+    assert ledger.state_of("flaky") == NodeState.CORDONED
+    # Healthy-node writes keep flowing in the same scenario.
+    assert cache.bind(pods[4].uid, "good") is True
+    assert inner.binds == [("p4", "good")]
+
+
+def test_wire_death_feeds_breaker_not_ledger():
+    """Transient transport failures are the BREAKER's evidence and
+    never accrue per-node suspicion — a dead wire must not cordon the
+    fleet one node at a time."""
+
+    class _DeadWire(_NodeRefusingBinder):
+        def bind(self, pod, node_name: str) -> None:
+            raise ConnectionError("wire gone")
+
+    breaker = CircuitBreaker(trip_after=2, reset_after=99.0)
+    guarded = GuardedBackend(_DeadWire(""), breaker=breaker)
+    cache = SchedulerCache(
+        SPEC, binder=guarded, evictor=guarded, status_updater=None,
+    )
+    ledger = NodeHealthLedger(NodeHealthConfig(quarantine_threshold=1.0))
+    cache.attach_health(ledger)
+    cache.add_node(_node("n"))
+    p = Pod(name="p", request={"cpu": 100, "memory": GI, "pods": 1})
+    cache.add_pod(p)
+    assert cache.bind(p.uid, "n") is False
+    assert breaker.state == CircuitBreaker.OPEN
+    assert ledger.state_of("n") == NodeState.OK
+
+
+# ---------------------------------------------------------------------------
+# gang-atomic drain
+# ---------------------------------------------------------------------------
+
+def _place_and_run(cache, sim, conf=None):
+    ssn = run_one_cycle(cache, conf or CONF)
+    sim.tick()
+    return ssn
+
+
+def test_drain_migrates_whole_gang_when_provable():
+    cache, sim = make_world(SPEC)
+    sim.add_node(_node("bad", cpu=8000.0))
+    sim.add_node(_node("spare", cpu=8000.0))
+    ledger = NodeHealthLedger(NodeHealthConfig(
+        quarantine_threshold=1.0, drain_cordoned=True, drain_budget=4,
+    ))
+    cache.attach_health(ledger)
+    # Force the gang onto "bad" by cordoning the spare first.
+    ledger.cordon("spare")
+    pods = _gang(sim, "g", n=2, cpu=2000.0)
+    _place_and_run(cache, sim)
+    with cache.lock():
+        assert all(cache._pods[p.uid].node == "bad" for p in pods)
+    ledger.uncordon("spare")
+    ledger.cordon("bad")
+    landed = drain_cordoned_gangs(cache, ledger)
+    assert landed == 2      # all-or-nothing: both members evicted
+    sim.tick()              # controller recreates them Pending
+    ssn = run_one_cycle(cache, CONF)
+    assert sorted(n for _, n in ssn.bound) == ["spare", "spare"]
+
+
+def test_drain_stays_put_without_provable_replacement():
+    cache, sim = make_world(SPEC)
+    sim.add_node(_node("bad", cpu=8000.0))
+    sim.add_node(_node("tiny", cpu=1000.0))   # cannot host the gang
+    ledger = NodeHealthLedger(NodeHealthConfig(
+        quarantine_threshold=1.0, drain_cordoned=True, drain_budget=4,
+    ))
+    cache.attach_health(ledger)
+    ledger.cordon("tiny")
+    pods = _gang(sim, "g", n=2, cpu=2000.0)
+    _place_and_run(cache, sim)
+    ledger.uncordon("tiny")
+    ledger.cordon("bad")
+    assert drain_cordoned_gangs(cache, ledger) == 0
+    with cache.lock():
+        assert all(
+            cache._pods[p.uid].status == TaskStatus.RUNNING
+            for p in pods
+        )
+
+
+def test_drain_respects_pdb_floor():
+    cache, sim = make_world(SPEC)
+    sim.add_node(_node("bad"))
+    sim.add_node(_node("spare"))
+    ledger = NodeHealthLedger(NodeHealthConfig(
+        quarantine_threshold=1.0, drain_cordoned=True, drain_budget=4,
+    ))
+    cache.attach_health(ledger)
+    ledger.cordon("spare")
+    pods = _gang(sim, "g", n=2, cpu=1000.0, labels={"app": "db"})
+    _place_and_run(cache, sim)
+    # Every member is budget-protected: evicting any would drop the
+    # healthy count below the floor.
+    sim.add_pdb(PodDisruptionBudget(
+        name="db", min_available=2, selector={"app": "db"},
+    ))
+    ledger.uncordon("spare")
+    ledger.cordon("bad")
+    assert drain_cordoned_gangs(cache, ledger) == 0
+    with cache.lock():
+        assert all(
+            cache._pods[p.uid].status == TaskStatus.RUNNING
+            for p in pods
+        )
+
+
+def test_drain_budget_limits_gangs_per_cycle():
+    cache, sim = make_world(SPEC)
+    sim.add_node(_node("bad", cpu=8000.0))
+    sim.add_node(_node("spare", cpu=16000.0))
+    ledger = NodeHealthLedger(NodeHealthConfig(
+        quarantine_threshold=1.0, drain_cordoned=True, drain_budget=1,
+    ))
+    cache.attach_health(ledger)
+    ledger.cordon("spare")
+    _gang(sim, "g1", n=2, cpu=1000.0)
+    _gang(sim, "g2", n=2, cpu=1000.0)
+    _place_and_run(cache, sim)
+    ledger.uncordon("spare")
+    ledger.cordon("bad")
+    assert drain_cordoned_gangs(cache, ledger) == 2   # ONE gang (2 pods)
+    assert drain_cordoned_gangs(cache, ledger) == 2   # the next, next cycle
+    assert drain_cordoned_gangs(cache, ledger) == 0
+
+
+def test_node_deletion_forgets_health_record():
+    """A decommissioned cordoned node must not count as quarantined
+    forever (metrics + /healthz), and records must not grow without
+    bound under node churn."""
+    import json
+
+    from kube_batch_tpu import metrics
+
+    cache, sim = make_world(SPEC)
+    sim.add_node(_node("doomed"))
+    ledger = NodeHealthLedger(NodeHealthConfig())
+    cache.attach_health(ledger)
+    ledger.cordon("doomed")
+    assert ledger.quarantined_count() == 1
+    sim.delete_node("doomed")
+    assert ledger.quarantined_count() == 0
+    assert ledger.state_of("doomed") == NodeState.OK  # clean slate
+    assert json.loads(metrics.health_body())["quarantined"] == 0
+
+
+def test_transient_flush_failure_returns_canary_slot():
+    """A wire blip rolling a committed placement back must not burn a
+    probation node's canary — the node never got tested."""
+
+    class _DeadWire:
+        def bind(self, pod, node_name):
+            raise ConnectionError("wire gone")
+
+        def evict(self, pod, reason):
+            pass
+
+    cache = SchedulerCache(
+        SPEC, binder=_DeadWire(), evictor=_DeadWire(),
+        status_updater=None,
+    )
+    ledger = NodeHealthLedger(NodeHealthConfig(
+        quarantine_threshold=1.0, probation_ticks=1, probation_canary=2,
+    ))
+    cache.attach_health(ledger)
+    cache.add_node(_node("prob"))
+    ledger.cordon("prob")
+    ledger._records["prob"].manual = False
+    ledger.on_cycle()
+    assert ledger.state_of("prob") == NodeState.PROBATION
+    p = Pod(name="p", request={"cpu": 100, "memory": GI, "pods": 1})
+    cache.add_pod(p)
+    assert cache.begin_bind(p.uid, "prob") is True
+    assert ledger.pack_view()[1] == {"prob": 1.0}  # slot committed
+    assert cache.finish_bind(p.uid, "prob") is False
+    # Transient failure: slot returned, node still probation (the
+    # blip is the WIRE's evidence, not the node's).
+    assert ledger.pack_view()[1] == {"prob": 2.0}
+    assert ledger.state_of("prob") == NodeState.PROBATION
+
+
+def test_drain_defers_gang_with_unsettled_members():
+    """A gang with a cordoned-resident member still BOUND (not yet
+    RUNNING) is deferred whole — draining only the RUNNING members
+    would split the gang across the migration."""
+    cache, sim = make_world(SPEC)
+    sim.add_node(_node("bad"))
+    sim.add_node(_node("spare"))
+    ledger = NodeHealthLedger(NodeHealthConfig(
+        quarantine_threshold=1.0, drain_cordoned=True, drain_budget=4,
+    ))
+    cache.attach_health(ledger)
+    ledger.cordon("spare")
+    pods = _gang(sim, "g", n=2, cpu=1000.0)
+    run_one_cycle(cache, CONF)
+    sim.tick()
+    # Regress ONE member to BOUND (as if bound just before the cordon).
+    cache.update_pod_status(pods[0].uid, TaskStatus.BOUND)
+    ledger.uncordon("spare")
+    ledger.cordon("bad")
+    assert drain_cordoned_gangs(cache, ledger) == 0
+    # Once it settles, the whole gang drains together.
+    cache.update_pod_status(pods[0].uid, TaskStatus.RUNNING)
+    assert drain_cordoned_gangs(cache, ledger) == 2
+
+
+def test_failed_proof_unwinds_port_reservations():
+    """Gang A's failed proof must not leave phantom host-port holds
+    that block gang B's genuinely feasible migration."""
+    cache, sim = make_world(SPEC)
+    sim.add_node(_node("bad", cpu=16000.0))
+    sim.add_node(_node("spare", cpu=2000.0, pods=4.0))
+    ledger = NodeHealthLedger(NodeHealthConfig(
+        quarantine_threshold=1.0, drain_cordoned=True, drain_budget=4,
+    ))
+    cache.attach_health(ledger)
+    ledger.cordon("spare")
+    # Gang a: two port-80 pods — the first reserves port 80 on spare,
+    # the second cannot land anywhere (port clash + no third node):
+    # proof fails, reservations must unwind.
+    ga = PodGroup(name="a", queue="default", min_member=2)
+    sim.submit(ga, [
+        Pod(name=f"a-{i}", request={"cpu": 500, "memory": GI, "pods": 1},
+            ports=frozenset({80}))
+        for i in range(2)
+    ])
+    # Gang b: ONE port-80 pod — feasible on spare iff gang a's failed
+    # proof released its phantom port hold.
+    gb = PodGroup(name="b", queue="default", min_member=1)
+    sim.submit(gb, [
+        Pod(name="b-0", request={"cpu": 500, "memory": GI, "pods": 1},
+            ports=frozenset({80})),
+    ])
+    run_one_cycle(cache, CONF)
+    sim.tick()
+    with cache.lock():
+        assert all(
+            p.node == "bad" for p in cache._pods.values()
+        ), {p.name: p.node for p in cache._pods.values()}
+    ledger.uncordon("spare")
+    ledger.cordon("bad")
+    landed = drain_cordoned_gangs(cache, ledger)
+    assert landed == 1      # gang b migrated; gang a stayed whole
+    with cache.lock():
+        assert cache._pods[
+            next(p.uid for p in [*cache._pods.values()]
+                 if p.name == "b-0")
+        ].status == TaskStatus.RELEASING
+        assert all(
+            cache._pods[p.uid].status == TaskStatus.RUNNING
+            for p in cache._pods.values() if p.name.startswith("a-")
+        )
+
+
+def test_unexpected_pod_death_accrues_suspicion():
+    """An adopted pod going Failed while placed (dying kubelet killing
+    containers) feeds the node's ledger through the k8s ingest path."""
+    import io
+    import json
+
+    from kube_batch_tpu.client.k8s import K8sWatchAdapter
+
+    cache, _sim = make_world(SPEC)
+    ledger = NodeHealthLedger(NodeHealthConfig(
+        quarantine_threshold=2.0, pod_death_weight=2.0,
+    ))
+    cache.attach_health(ledger)
+    cache.add_node(_node("n"))
+    pod = Pod(name="victim", request={"cpu": 100, "memory": GI,
+                                      "pods": 1},
+              status=TaskStatus.RUNNING, node="n", uid="uid-victim")
+    cache.add_pod(pod)
+    failed = {
+        "kind": "Pod",
+        "metadata": {"name": "victim", "uid": "uid-victim"},
+        "spec": {"nodeName": "n", "schedulerName": "kube-batch"},
+        "status": {"phase": "Failed"},
+    }
+    reader = io.StringIO(json.dumps(
+        {"type": "MODIFIED", "object": failed}
+    ) + "\n")
+    adapter = K8sWatchAdapter(cache, reader)
+    adapter.start()
+    adapter.join(10)
+    assert ledger.state_of("n") == NodeState.CORDONED
+    with cache.lock():
+        assert "uid-victim" not in cache._pods  # Failed pod dropped
+
+
+# ---------------------------------------------------------------------------
+# chaos parity + k8s dialect
+# ---------------------------------------------------------------------------
+
+def test_vanish_heal_round_trips_full_node_spec():
+    import random
+
+    from kube_batch_tpu.chaos.faults import ChaosCluster
+
+    cluster = ChaosCluster(seed=0)
+    original = Node(
+        name="rich",
+        allocatable={"cpu": 8000.0, "memory": 16 * GI, "pods": 110.0},
+        labels={"zone": "a", "disk": "ssd"},
+        taints=frozenset({"dedicated=batch:NoSchedule"}),
+        memory_pressure=True,
+        unschedulable=True,
+        conditions={"Ready": True, "MemoryPressure": True},
+    )
+    cluster.add_node(original)
+    spec = cluster.vanish_node(random.Random("x"))
+    assert spec["name"] == "rich"
+    assert "rich" not in cluster.nodes
+    cluster.heal_node(spec)
+    healed = cluster.nodes["rich"]
+    assert healed.labels == original.labels
+    assert healed.taints == original.taints
+    assert healed.memory_pressure is True
+    assert healed.unschedulable is True
+    assert dict(healed.conditions) == dict(original.conditions)
+    assert healed.uid == original.uid
+    assert healed.allocatable == original.allocatable
+
+
+def test_cordon_sink_patches_spec_unschedulable_over_the_wire():
+    import time
+
+    from kube_batch_tpu.client import ExternalCluster
+    from kube_batch_tpu.client.external import stream_pair
+    from kube_batch_tpu.client.k8s import K8sWatchAdapter
+    from kube_batch_tpu.client.k8s_write import K8sStreamBackend
+
+    cl_r, cl_w, sch_r, sch_w = stream_pair()
+    cluster = ExternalCluster(cl_r, cl_w).start()
+    backend = K8sStreamBackend(sch_w, timeout=5.0)
+    cache = SchedulerCache(
+        SPEC, binder=backend, evictor=backend, status_updater=backend,
+    )
+    adapter = K8sWatchAdapter(cache, sch_r, backend=backend).start()
+    cluster.add_node(_node("w1"))
+    cluster.sync()
+    assert adapter.wait_for_sync(5.0)
+    backend.cordon_node("w1", True)
+    assert cluster.nodes["w1"].unschedulable is True
+    verb, path, obj = cluster.k8s_writes[-1]
+    assert (verb, path) == ("patch", "/api/v1/nodes/w1")
+    assert obj["spec"] == {"unschedulable": True}
+    # The MODIFIED echo lands in the cache: external cordons observed
+    # on the watch are respected symmetrically by the pack mask.
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        with cache.lock():
+            info = cache._nodes.get("w1")
+            if info is not None and info.node.unschedulable:
+                break
+        time.sleep(0.01)
+    with cache.lock():
+        assert cache._nodes["w1"].node.unschedulable is True
+    backend.cordon_node("w1", False)
+    assert cluster.nodes["w1"].unschedulable is False
+
+
+def test_http_dialect_cordon_patches_spec_unschedulable():
+    """The --kube-api dialect's cordon write: a real merge PATCH of
+    the node's spec.unschedulable against an apiserver."""
+    from kube_batch_tpu.client.http_api import K8sHttpBackend, _Client
+
+    from tests.fake_apiserver import FakeApiServer
+    from tests.test_k8s_ingest import k8s_node
+
+    server = FakeApiServer()
+    try:
+        server.upsert("Node", k8s_node("h0"))
+        backend = K8sHttpBackend(_Client(server.url, timeout=10.0))
+        backend.cordon_node("h0", True)
+        (patch,) = server.node_patches
+        assert patch["path"] == "/api/v1/nodes/h0"
+        assert patch["object"]["spec"] == {"unschedulable": True}
+        assert server.objects["Node"]["h0"]["spec"]["unschedulable"] \
+            is True
+        backend.cordon_node("h0", False)
+        assert server.objects["Node"]["h0"]["spec"]["unschedulable"] \
+            is False
+    finally:
+        server.stop()
