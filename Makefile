@@ -27,6 +27,7 @@ bench-smoke:
 	KB_TPU_FORCE_CPU=1 $(PY) bench.py --_daemon --_daemon-config 1 \
 	    --_budget 420 > /tmp/kb-bench-smoke.out
 	$(PY) scripts/check_bench_smoke.py < /tmp/kb-bench-smoke.out
+	$(PY) scripts/check_pack_bench.py < /tmp/kb-bench-smoke.out
 
 # Pre-compile every hot-swappable conf at the flagship shape into the
 # persistent XLA cache, so daemon conf swaps replay in seconds instead
@@ -59,7 +60,12 @@ run-example:
 # asynchronous commit pipeline, twice — scripts/check_chaos_pipelined.py
 # asserts zero violations, same seed ⇒ same trace hash across the two
 # runs, per-pod wire-write order preserved, and the breaker trip
-# draining to zero in-flight writes.
+# draining to zero in-flight writes.  A fifth run repeats the same
+# seed under --pack-mode full (a from-scratch tensor pack every
+# cycle): the row-patched incremental pack must be decision-invisible,
+# so its hash must match the incremental runs exactly (the check
+# script also refuses a vacuous parity where the incremental runs
+# never actually patched).
 # The flaky runs are the NODE-HEALTH scenario
 # (doc/design/node-health.md): one seeded node intermittently refuses
 # binds (answered — the breaker must NOT trip) and flaps NotReady
@@ -86,8 +92,11 @@ chaos:
 	JAX_PLATFORMS=cpu $(PY) -m kube_batch_tpu.chaos --seed 11 --ticks 32 \
 	    --scenario examples/chaos-guardrail.json --wire-commit pipelined \
 	    --quiet > /tmp/kb-chaos-pipelined-2.json
+	JAX_PLATFORMS=cpu $(PY) -m kube_batch_tpu.chaos --seed 11 --ticks 32 \
+	    --scenario examples/chaos-guardrail.json --wire-commit pipelined \
+	    --pack-mode full --quiet > /tmp/kb-chaos-packfull.json
 	$(PY) scripts/check_chaos_pipelined.py /tmp/kb-chaos-pipelined-1.json \
-	    /tmp/kb-chaos-pipelined-2.json
+	    /tmp/kb-chaos-pipelined-2.json /tmp/kb-chaos-packfull.json
 	JAX_PLATFORMS=cpu $(PY) -m kube_batch_tpu.chaos --seed 13 --ticks 24 \
 	    --scenario examples/chaos-failover.json --wire-commit pipelined \
 	    --quiet > /tmp/kb-chaos-failover-1.json
@@ -112,6 +121,7 @@ profile:
 
 verify:
 	$(PY) -m pytest tests/ -q
+	JAX_PLATFORMS=cpu $(PY) scripts/check_pack_microbench.py
 	$(PY) -c "import __graft_entry__ as g; g.entry()"
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 	    $(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"

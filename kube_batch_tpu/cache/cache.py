@@ -75,7 +75,7 @@ class PackDirty:
 
     __slots__ = ("full", "full_reason", "status_pods", "nodes",
                  "added_pods", "deleted_pods", "added_jobs",
-                 "version", "groups", "__weakref__")
+                 "version", "groups", "reset_groups", "__weakref__")
 
     def __init__(self) -> None:
         self.clear()
@@ -98,6 +98,12 @@ class PackDirty:
         # draining the journal the next pack still needs.
         self.version: int = 0
         self.groups: set[str] = set()
+        # Groups whose task MEMBERSHIP changed (pod add/delete) — the
+        # vectorized full rebuild re-derives exactly these jobs' cached
+        # column blocks and reuses the rest (packer.JobBlock); status
+        # churn deliberately does NOT land here, its fields are re-read
+        # from the live pods on every pack anyway.
+        self.reset_groups: set[str] = set()
 
     def mark_full(self, reason: str) -> None:
         if not self.full:
@@ -129,6 +135,13 @@ class HostSnapshot:
     # empty when no ledger is wired.
     cordoned: frozenset = frozenset()
     canary_pods: dict = dataclasses.field(default_factory=dict)
+    # Monotone counter of node OBJECT changes (set membership, labels,
+    # taints, readiness — everything that shapes node_labels/
+    # node_taints/topology-domain geometry).  The vectorized packer
+    # reuses its cached node-geometry arrays across full rebuilds while
+    # this is unchanged; -1 (packer-less snapshots of unknown caches)
+    # disables the reuse.
+    node_version: int = -1
 
 
 class SchedulerCache:
@@ -220,6 +233,9 @@ class SchedulerCache:
         # cordon/canary view.  None = subsystem disabled (every hook
         # below is a no-op).
         self.health = None
+        # Node-geometry version for the packer's node-array cache (see
+        # HostSnapshot.node_version).
+        self._node_version = 0
         # True when scheduling decisions leave the process in apiserver
         # dialect (--write-format k8s / --kube-api): known divergences
         # from upstream API semantics are then surfaced per decision —
@@ -274,6 +290,7 @@ class SchedulerCache:
             d.version += 1
             if group:
                 d.groups.add(group)
+                d.reset_groups.add(group)
 
     def _mark_pod_deleted(self, uid: str, group: str | None = None) -> None:
         for d in self._dirty_listeners:
@@ -281,6 +298,7 @@ class SchedulerCache:
             d.version += 1
             if group:
                 d.groups.add(group)
+                d.reset_groups.add(group)
 
     def _mark_job_added(self, name: str) -> None:
         for d in self._dirty_listeners:
@@ -517,6 +535,7 @@ class SchedulerCache:
             if node.name in self._nodes:
                 raise ValueError(f"node {node.name} already cached")
             self._nodes[node.name] = NodeInfo(spec=self.spec, node=node)
+            self._node_version += 1
             self._mark_full("node-added")
 
     def update_node(self, node: Node) -> None:
@@ -534,6 +553,7 @@ class SchedulerCache:
             info = self._nodes.get(node.name)
             if info is None:
                 self._nodes[node.name] = NodeInfo(spec=self.spec, node=node)
+                self._node_version += 1
                 self._mark_full("node-added")
             else:
                 old = info.node
@@ -562,6 +582,7 @@ class SchedulerCache:
                     or set(old.taints) != set(node.taints)
                     or old.is_ready != node.is_ready
                 ):
+                    self._node_version += 1
                     self._mark_full("node-object-changed")
                 else:
                     self._mark_node(node.name)
@@ -573,6 +594,7 @@ class SchedulerCache:
         with self._lock:
             info = self._nodes.pop(name, None)
             if info is not None:
+                self._node_version += 1
                 # Residents lose their placement; they'll be rescheduled.
                 for pod in info.tasks.values():
                     pod.node = None
@@ -782,6 +804,7 @@ class SchedulerCache:
                     pdbs=dict(self._pdbs),
                     cordoned=cordoned,
                     canary_pods=dict(canary),
+                    node_version=self._node_version,
                 )
             # copy.copy, not dataclasses.replace: replace re-runs
             # __init__/__post_init__ per pod (measured ~0.2 s for 50k
@@ -810,6 +833,7 @@ class SchedulerCache:
                 pdbs=dict(self._pdbs),
                 cordoned=cordoned,
                 canary_pods=dict(canary),
+                node_version=self._node_version,
             )
 
     # -- commit funnel (≙ cache.go · Bind / Evict) -----------------------
@@ -1128,6 +1152,7 @@ class SchedulerCache:
             self._resync.clear()
             self._status_counts.clear()
             self._arrival_ts.clear()
+            self._node_version += 1
             self._mark_full("relist")
             self.add_queue(Queue(name=self.default_queue, weight=1.0))
 

@@ -7,10 +7,20 @@ construction over every pod) is ~0.5 s of host Python at 50k pods, the
 dominant cost of a steady-state cycle.  The cache is event-sourced, so
 the pack doesn't need to be O(cluster): this packer keeps the previous
 pack's padded numpy arrays plus intern tables (`PackInternals`) and, for
-each cycle, patches exactly the rows whose pods/nodes changed, re-
-uploading only the arrays it touched (unchanged device buffers are
-reused — the [T, vocab] multi-hots never leave the device in steady
-state).
+each cycle, patches exactly the rows whose pods/nodes changed.
+
+The DEVICE side is row-granular too: dirty rows are tracked per field,
+and a steady cycle ships only those rows through a jitted
+``lax.dynamic_update_slice``-style scatter (``buf.at[rows].set(vals)``
+over a batched row-update pytree, compiled once per row-count bucket
+and field combination) instead of re-uploading every touched array in
+full.  Whole-array upload remains the fallback once the dirty fraction
+of a field crosses ``ROW_PATCH_MAX_FRAC`` (a dense patch costs more
+than a fresh copy past that), and is what full rebuilds use.  The
+host-patch / upload split is observable via
+``cycle_phase_latency{pack_host_patch|pack_h2d}`` and the
+``pack_h2d_bytes_total`` counter; pack modes land in
+``pack_total{mode=full|incremental|row_patch}``.
 
 Patch vocabulary (drained from the cache's `PackDirty` journal, under
 the cache lock):
@@ -20,17 +30,27 @@ the cache lock):
   (real rows stay a contiguous prefix, the invariant every
   ``meta.num_real_tasks`` consumer relies on)
 * pod additions                → append a row, IF every string the pod
-  carries is already interned (vocabularies only ever grow on a full
+  carries — including topology-scoped affinity terms and volume-group
+  claims — is already interned (vocabularies only ever grow on a full
   rebuild — "rebuild fully only on vocab growth")
 * pod-group additions/updates  → append/patch a job row
 * node accounting changes      → per-node rows (idle/releasing/cap/
   pressure/ports) + cluster_total
 
+Topology-domain and volume-group GEOMETRY (node_key_domain,
+topo_term_*, domain_mask, vol_group_sel) is whole-cluster state, but
+every mutation that can change it (node object changes, claim /
+storage-class churn, a term outside the interned vocabularies) already
+forces a full rebuild — so a cluster that merely *has* affinity or
+volume constraints no longer pays the full-pack cliff every cycle: its
+steady status churn row-patches like everyone else's, and the geometry
+arrays ride along untouched.
+
 Everything else — object-set changes (nodes, queues, namespaces, PDBs,
-volumes), vocabulary growth, bucket overflow, topology domains or
-volume groups being present at all — falls back to a full
+volumes), vocabulary growth, bucket overflow — falls back to a full
 ``pack_snapshot_full`` rebuild.  Falling back is always safe: the
-rebuild ignores the half-patched arrays entirely.
+rebuild ignores the half-patched arrays entirely (and reuses the
+per-job column blocks of unchanged jobs, see packer.JobBlock).
 
 Row order note: a fresh full pack sorts tasks by (job, creation);
 swap-compaction perturbs that order.  Every kernel orders by explicit
@@ -54,16 +74,16 @@ import logging
 import os
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
-from kube_batch_tpu.api.snapshot import NONE_IDX
+from kube_batch_tpu import metrics
+from kube_batch_tpu.api.snapshot import NONE_IDX, SnapshotTensors, bucket
 from kube_batch_tpu.cache.cache import SchedulerCache
-from kube_batch_tpu.cache.cluster import Pod
 from kube_batch_tpu.cache.packer import (
     PackInternals,
     SnapshotMeta,
     pack_snapshot_full,
+    resolve_claims,
     split_topo_term,
 )
 
@@ -73,7 +93,9 @@ _TASK_FIELDS = (
     "task_req", "task_state", "task_job", "task_node", "task_prio",
     "task_order", "task_mask", "task_sel", "task_pref", "task_tol",
     "task_ports", "task_critical", "task_podlabels", "task_aff",
-    "task_anti", "task_podpref", "task_vol_node", "task_ns", "task_pdbs",
+    "task_anti", "task_podpref", "task_aff_topo", "task_anti_topo",
+    "task_podpref_topo", "task_vol_node", "task_vol_groups", "task_ns",
+    "task_pdbs",
 )
 # Padding fill per field (defaults to 0 / False via the array dtype).
 _TASK_FILL = {
@@ -90,8 +112,60 @@ class _FullRebuild(Exception):
         self.reason = reason
 
 
+class _RowChanges:
+    """Dirty-row ledger for one incremental pack: field → set of dirty
+    row indices, or None meaning the WHOLE array must re-upload."""
+
+    __slots__ = ("fields",)
+
+    def __init__(self) -> None:
+        self.fields: dict[str, set | None] = {}
+
+    def rows(self, field: str, *idx: int) -> None:
+        cur = self.fields.get(field, False)
+        if cur is False:
+            self.fields[field] = set(idx)
+        elif cur is not None:
+            cur.update(idx)
+
+    def whole(self, field: str) -> None:
+        self.fields[field] = None
+
+    def __bool__(self) -> bool:
+        return bool(self.fields)
+
+    def __len__(self) -> int:
+        return len(self.fields)
+
+
+_row_patch_jit = None
+
+
+def _row_patch(bufs: dict, rows: dict, vals: dict) -> dict:
+    """Jitted batched row scatter: for every field, write `vals[f]`
+    into `bufs[f]` at row indices `rows[f]` on device.  ONE dispatch
+    for the whole dirty set (the args ride the call's own transfer, so
+    a tunneled backend pays one RTT for k rows instead of re-shipping
+    the arrays).  Row counts are bucketed by the caller, so the XLA
+    compile set stays bounded: one executable per (field combination,
+    row bucket, buffer shape) — the same discipline as the cycle
+    program's shape buckets."""
+    global _row_patch_jit
+    if _row_patch_jit is None:
+        def _kernel(b, r, v):
+            return {f: b[f].at[r[f]].set(v[f]) for f in b}
+
+        _row_patch_jit = jax.jit(_kernel)
+    return _row_patch_jit(bufs, rows, vals)
+
+
 class IncrementalPacker:
     """One per scheduler (it owns a `PackDirty` journal on the cache)."""
+
+    #: Past this dirty fraction of a field's rows, ship the whole array
+    #: instead of a row patch (a dense scatter moves more bytes than a
+    #: fresh copy once indices + values approach the array itself).
+    ROW_PATCH_MAX_FRAC = 0.25
 
     def __init__(self, cache: SchedulerCache) -> None:
         self.cache = cache
@@ -106,7 +180,15 @@ class IncrementalPacker:
         self._ns_row: dict[str, int] = {}
         self.full_packs = 0
         self.incremental_packs = 0
+        self.row_patched_packs = 0
         self.last_mode = ""
+        # H2D bytes the LAST pack shipped (whole arrays + row patches);
+        # the bench's pack comparison and the H2D-bytes tests read it.
+        self.last_h2d_bytes = 0
+        # Operator escape hatch (--pack-mode full / chaos parity runs):
+        # every pack rebuilds from scratch; device state is identical
+        # either way, so same-seed chaos hashes must not move.
+        self.force_full = False
         # Why each full rebuild happened (journal full_reason or the
         # incremental path's bail-out reason): the soak bench reads
         # this to make fallback storms visible instead of silent.
@@ -140,8 +222,11 @@ class IncrementalPacker:
                 )
             d = self._dirty
             affected = set(d.groups)
-            if self._snap is None or d.full:
-                out = self._full(d.full_reason or "first-pack")
+            if self._snap is None or d.full or self.force_full:
+                reason = d.full_reason or (
+                    "first-pack" if self._snap is None else "forced"
+                )
+                out = self._full(reason)
                 self.last_groups = None  # object set changed: refresh all
             else:
                 try:
@@ -157,14 +242,39 @@ class IncrementalPacker:
     # -- full rebuild ---------------------------------------------------
 
     def _full(self, reason: str):
-        snap, meta, ints = pack_snapshot_full(self.cache.snapshot(shared=True))
+        d = self._dirty
+        # Only jobs whose MEMBERSHIP the journal touched (pod add/
+        # delete — incl. every pod of a relist replay) need their
+        # column blocks re-derived; status churn never invalidates a
+        # block (mutable fields are re-read from the live pods anyway).
+        invalid = frozenset(d.reset_groups)
+        # --pack-mode full is the corruption-diagnosis escape hatch: it
+        # must rebuild from NOTHING (no job blocks, no node/domain
+        # geometry), or a stale-cache bug would survive the very mode
+        # the runbook says flushes it — and the chaos pack-mode parity
+        # would compare the block cache against itself.
+        prev = None if self.force_full else self._ints
+        with metrics.cycle_phase_latency.time("pack_host_patch"):
+            _, meta, ints = pack_snapshot_full(
+                self.cache.snapshot(shared=True), device=False,
+                prev=prev, invalid_jobs=invalid,
+            )
+        # H2D split out of the host build so the pack_host_patch /
+        # pack_h2d attribution in cycle_phase_latency is real; one
+        # batched device_put for the whole pytree, as ever.
+        with metrics.cycle_phase_latency.time("pack_h2d"):
+            snap = SnapshotTensors(**jax.device_put(ints.arrays))
+        nbytes = sum(arr.nbytes for arr in ints.arrays.values())
+        self.last_h2d_bytes = nbytes
+        metrics.pack_h2d_bytes.inc(by=float(nbytes))
+        metrics.pack_total.inc("full")
         self._snap, self._meta, self._ints = snap, meta, ints
         self._task_row = {u: i for i, u in enumerate(ints.task_uids)}
         self._job_row = {n: i for i, n in enumerate(ints.job_names)}
         self._node_row = {n: i for i, n in enumerate(ints.node_names)}
         self._queue_row = {n: i for i, n in enumerate(ints.queue_names)}
         self._ns_row = {n: i for i, n in enumerate(ints.ns_names)}
-        self._dirty.clear()
+        d.clear()
         self.full_packs += 1
         self.fallback_reasons[reason] += 1
         self.last_mode = f"full:{reason}"
@@ -177,71 +287,121 @@ class IncrementalPacker:
     def _incremental(self):
         ints, d = self._ints, self._dirty
         a = ints.arrays
-        # Topology domains and volume groups are whole-cluster geometry,
-        # not row-local — their presence disables patching outright.
-        if a["task_aff_topo"].shape[1] or a["task_vol_groups"].shape[1]:
-            raise _FullRebuild("topo-or-volume-geometry-present")
 
-        changed: set[str] = set()
+        changed = _RowChanges()
         rows_changed = False
 
-        for name in d.added_jobs:
-            rows_changed |= self._upsert_job(name, changed)
-        for uid in d.deleted_pods:
-            rows_changed |= self._delete_row(uid, changed)
-        for uid in d.added_pods:
-            rows_changed |= self._append_pod(uid, changed)
-        for uid in d.status_pods:
-            self._patch_status(uid, changed)
-        if d.nodes:
-            view = self._health_view()
-            for name in d.nodes:
-                self._patch_node(name, changed, view)
-            real_n = len(ints.node_names)
-            a["cluster_total"] = (
-                a["node_cap"][:real_n].sum(axis=0).astype(np.float32)
-            )
-            changed.add("cluster_total")
+        with metrics.cycle_phase_latency.time("pack_host_patch"):
+            for name in d.added_jobs:
+                rows_changed |= self._upsert_job(name, changed)
+            for uid in d.deleted_pods:
+                rows_changed |= self._delete_row(uid, changed)
+            for uid in d.added_pods:
+                rows_changed |= self._append_pod(uid, changed)
+            for uid in d.status_pods:
+                self._patch_status(uid, changed)
+            if d.nodes:
+                view = self._health_view()
+                for name in d.nodes:
+                    self._patch_node(name, changed, view)
+                real_n = len(ints.node_names)
+                a["cluster_total"] = (
+                    a["node_cap"][:real_n].sum(axis=0).astype(np.float32)
+                )
+                changed.whole("cluster_total")
 
         if rows_changed:
-            self._meta = SnapshotMeta(
-                spec=self._meta.spec,
-                task_uids=tuple(ints.task_uids),
-                task_pods=tuple(ints.task_pods),
-                job_names=tuple(ints.job_names),
-                node_names=tuple(ints.node_names),
-                queue_names=tuple(ints.queue_names),
-                label_vocab=self._meta.label_vocab,
-                taint_vocab=self._meta.taint_vocab,
-                port_vocab=self._meta.port_vocab,
-                podlabel_vocab=self._meta.podlabel_vocab,
-            )
+            self._meta = self._meta.replace_rows(ints)
+        row_patched = False
         if changed:
             try:
-                # ONE batched H2D for every changed array: device_put
-                # on a pytree starts all copies before blocking, so the
-                # tunnel round trip is paid once per cycle, not once
-                # per field (the exact mirror of the fused cycle's
-                # batched device_get on the D2H side — a steady cycle
-                # touches ~10 task/job arrays, and per-array transfers
-                # made the upload a top steady-cycle term).
-                uploaded = jax.device_put({f: a[f] for f in changed})
-                self._snap = self._snap.replace(**uploaded)
+                with metrics.cycle_phase_latency.time("pack_h2d"):
+                    row_patched = self._upload(changed)
             except Exception:
                 # Device upload failed (e.g. OOM): the host arrays are
                 # patched but the device buffers are stale — force the
                 # next pack to rebuild rather than serve them.
                 d.mark_full("upload-failed")
                 raise
+        else:
+            self.last_h2d_bytes = 0
         # Drain the journal only once the device state is consistent.
         d.clear()
         self.incremental_packs += 1
+        if row_patched:
+            self.row_patched_packs += 1
+            metrics.pack_total.inc("row_patch")
+        else:
+            metrics.pack_total.inc("incremental")
         self.last_mode = f"incremental:{len(changed)}-arrays"
         return self._snap, self._meta
 
+    def _upload(self, changed: _RowChanges) -> bool:
+        """Ship this pack's dirty state to the device: row patches for
+        sparsely-dirty fields (one jitted scatter dispatch for all of
+        them), whole-array device_put for the rest.  Returns True when
+        at least one field went as a row patch.  Accounts every byte
+        in pack_h2d_bytes_total / last_h2d_bytes."""
+        a = self._ints.arrays
+        whole: dict[str, np.ndarray] = {}
+        patch: dict[str, np.ndarray] = {}
+        frac = self.ROW_PATCH_MAX_FRAC
+        for f, rows in changed.fields.items():
+            arr = a[f]
+            if rows is not None and arr.ndim:
+                # The patch payload as it will actually ship: indices
+                # padded to their bucket plus one row of values each.
+                row_nb = arr.dtype.itemsize * (
+                    int(np.prod(arr.shape[1:])) if arr.ndim > 1 else 1
+                )
+                payload = bucket(len(rows), minimum=2) * (4 + row_nb)
+            if (
+                rows is None
+                or arr.ndim == 0
+                or frac <= 0  # row patching disabled (bench comparisons)
+                or len(rows) > max(1, int(arr.shape[0] * frac))
+                # a "patch" bigger than the array is just a worse copy
+                # (small padded arrays with a handful of dirty rows)
+                or payload >= arr.nbytes
+            ):
+                whole[f] = arr
+            else:
+                patch[f] = np.fromiter(
+                    sorted(rows), np.int32, count=len(rows))
+        nbytes = sum(arr.nbytes for arr in whole.values())
+        patched: dict = {}
+        if patch:
+            bufs = {f: getattr(self._snap, f) for f in patch}
+            rows_d: dict[str, np.ndarray] = {}
+            vals_d: dict[str, np.ndarray] = {}
+            for f, ridx in patch.items():
+                # Bucket the row count so the scatter kernel compiles
+                # O(log max-churn) times, not once per distinct k; the
+                # pad rows repeat row 0 (same index, same value — an
+                # idempotent duplicate scatter).  Floor 2, not 8: the
+                # steady case is one or two dirty rows, and an 8-row
+                # floor would quadruple the payload the byte guard
+                # above just sized.
+                kp = bucket(len(ridx), minimum=2)
+                if kp != len(ridx):
+                    ridx = np.concatenate([
+                        ridx,
+                        np.full(kp - len(ridx), ridx[0], np.int32),
+                    ])
+                vals = a[f][ridx]
+                rows_d[f] = ridx
+                vals_d[f] = vals
+                nbytes += ridx.nbytes + vals.nbytes
+            patched = dict(_row_patch(bufs, rows_d, vals_d))
+        uploaded = jax.device_put(whole) if whole else {}
+        self._snap = self._snap.replace(**patched, **uploaded)
+        self.last_h2d_bytes = nbytes
+        metrics.pack_h2d_bytes.inc(by=float(nbytes))
+        return bool(patch)
+
     # -- jobs -----------------------------------------------------------
 
-    def _upsert_job(self, name: str, changed: set[str]) -> bool:
+    def _upsert_job(self, name: str, changed: _RowChanges) -> bool:
         job = self.cache._jobs.get(name)
         if job is None:
             return False  # deleted since (full rebuild already flagged)
@@ -257,7 +417,8 @@ class IncrementalPacker:
             self._job_row[name] = j
             a["job_queue"][j] = self._queue_row[job.queue]
             a["job_mask"][j] = True
-            changed.update(("job_queue", "job_mask"))
+            changed.rows("job_queue", j)
+            changed.rows("job_mask", j)
             # A group arriving AFTER its pods (shell job): its existing
             # tasks become visible now.
             for pod in sorted(job.tasks.values(), key=lambda p: p.creation):
@@ -265,16 +426,27 @@ class IncrementalPacker:
         a["job_min"][j] = job.min_available
         a["job_prio"][j] = job.priority
         a["job_order"][j] = job.pod_group.creation
-        changed.update(("job_min", "job_prio", "job_order"))
+        changed.rows("job_min", j)
+        changed.rows("job_prio", j)
+        changed.rows("job_order", j)
         return True
 
     # -- pods -----------------------------------------------------------
 
-    def _delete_row(self, uid: str, changed: set[str]) -> bool:
+    def _delete_row(self, uid: str, changed: _RowChanges) -> bool:
         row = self._task_row.pop(uid, None)
         if row is None:
             return False  # was never packed (unmanaged/shell/invisible)
         ints = self._ints
+        # Membership changed through the INCREMENTAL path: the cached
+        # column block no longer matches this job, and the journal mark
+        # that recorded it dies with this pack's d.clear() — drop the
+        # block now or a later full rebuild could revalidate a
+        # same-uid-set ghost (delete + re-add of one uid in one journal
+        # window) against stale pod data.
+        group = ints.task_pods[row].group
+        if group:
+            ints.job_blocks.pop(group, None)
         a = ints.arrays
         last = len(ints.task_uids) - 1
         if row != last:
@@ -286,12 +458,12 @@ class IncrementalPacker:
             self._task_row[moved_uid] = row
         for f in _TASK_FIELDS:
             a[f][last] = _TASK_FILL.get(f, 0)
+            changed.rows(f, row, last)
         ints.task_uids.pop()
         ints.task_pods.pop()
-        changed.update(_TASK_FIELDS)
         return True
 
-    def _append_pod(self, uid: str, changed: set[str]) -> bool:
+    def _append_pod(self, uid: str, changed: _RowChanges) -> bool:
         if uid in self._task_row:
             return False
         pod = self.cache._pods.get(uid)
@@ -307,15 +479,13 @@ class IncrementalPacker:
         t = len(ints.task_uids)
         if t >= a["task_state"].shape[0]:
             raise _FullRebuild("task-bucket-overflow")
-        if pod.claims:
-            raise _FullRebuild("pod-with-claims")
         ns = self._ns_row.get(pod.namespace)
         if ns is None:
             raise _FullRebuild("new-namespace")
 
-        lab, tnt, prt, pl = (
-            self._ints.lab_idx, self._ints.tnt_idx,
-            self._ints.prt_idx, self._ints.pl_idx,
+        lab, tnt, prt, pl, tt = (
+            ints.lab_idx, ints.tnt_idx, ints.prt_idx, ints.pl_idx,
+            ints.tt_idx,
         )
 
         def _intern(idx, keys, what):
@@ -336,21 +506,63 @@ class IncrementalPacker:
                          "podlabel")
 
         def _terms(terms, what):
-            ix = []
+            """Node-level terms → pod-label cols; topology-scoped terms
+            → topo-term cols (both against the PACKED vocabularies —
+            an uninterned term is vocabulary growth, exactly like a
+            fresh label)."""
+            node_ix, topo_ix = [], []
             for term in terms:
                 tk, labterm = split_topo_term(term)
-                if tk is not None:
-                    raise _FullRebuild("topo-term-on-new-pod")
+                if tk is None:
+                    i = pl.get(labterm)
+                    if i is None:
+                        raise _FullRebuild(f"vocab-growth:{what}")
+                    node_ix.append(i)
+                else:
+                    ti = tt.get((tk, labterm))
+                    if ti is None:
+                        raise _FullRebuild("vocab-growth:topo-term")
+                    topo_ix.append(ti)
+            return node_ix, topo_ix
+
+        aff_ix, aff_topo_ix = _terms(pod.affinity, "affinity")
+        anti_ix, anti_topo_ix = _terms(pod.anti_affinity, "anti-affinity")
+        ppref_node: list[tuple[int, float]] = []
+        ppref_topo: list[tuple[int, float]] = []
+        for term, w in pod.pod_prefs.items():
+            tk, labterm = split_topo_term(term)
+            if tk is None:
                 i = pl.get(labterm)
                 if i is None:
-                    raise _FullRebuild(f"vocab-growth:{what}")
-                ix.append(i)
-            return ix
+                    raise _FullRebuild("vocab-growth:pod-pref")
+                ppref_node.append((i, w))
+            else:
+                ti = tt.get((tk, labterm))
+                if ti is None:
+                    raise _FullRebuild("vocab-growth:topo-term")
+                if a["task_podpref_topo"].shape[1] == 0:
+                    # The packed snapshot statically skipped the soft
+                    # topo-pref matmul (zero width); widening it is a
+                    # shape change only a rebuild can make.
+                    raise _FullRebuild("soft-topo-pref-growth")
+                ppref_topo.append((ti, w))
 
-        aff_ix = _terms(pod.affinity, "affinity")
-        anti_ix = _terms(pod.anti_affinity, "anti-affinity")
-        ppref_ix = list(zip(_terms(pod.pod_prefs, "pod-pref"),
-                            pod.pod_prefs.values()))
+        # Volume feasibility for the new pod, against the PACKED volume
+        # groups (packer.resolve_claims — the one shared state
+        # machine): bound claims pin, constrained claims set their
+        # existing group bit, unknown claims/classes mark infeasible —
+        # a constrained claim missing from the packed group vocab is
+        # geometry growth (new vol_group_sel column → rebuild).
+        vol_node = NONE_IDX
+        vol_groups_ix: list[int] = []
+        if pod.claims:
+            vol_node, vol_groups_ix, grows = resolve_claims(
+                pod.claims, self.cache._claims,
+                self.cache._storage_classes, self._node_row.get,
+                ints.g_idx,
+            )
+            if grows:
+                raise _FullRebuild("vol-group-growth")
 
         a["task_req"][t] = self._meta.spec.pod_vec(pod)
         a["task_state"][t] = int(pod.status)
@@ -363,17 +575,22 @@ class IncrementalPacker:
         a["task_order"][t] = pod.creation
         a["task_mask"][t] = True
         a["task_critical"][t] = pod.critical
-        a["task_vol_node"][t] = NONE_IDX
+        a["task_vol_node"][t] = vol_node
         a["task_ns"][t] = ns
         for f, ixs in (("task_sel", sel_ix), ("task_tol", tol_ix),
                        ("task_ports", prt_ix), ("task_podlabels", own_ix),
-                       ("task_aff", aff_ix), ("task_anti", anti_ix)):
+                       ("task_aff", aff_ix), ("task_anti", anti_ix),
+                       ("task_aff_topo", aff_topo_ix),
+                       ("task_anti_topo", anti_topo_ix),
+                       ("task_vol_groups", vol_groups_ix)):
             for i in ixs:
                 a[f][t, i] = 1.0
         for i, w in zip(pref_ix, pod.preferences.values()):
             a["task_pref"][t, i] = w
-        for i, w in ppref_ix:
+        for i, w in ppref_node:
             a["task_podpref"][t, i] = w
+        for i, w in ppref_topo:
+            a["task_podpref_topo"][t, i] = w
         if pod.labels:
             for bi, bname in enumerate(self._ints.pdb_names):
                 pdb = self.cache._pdbs.get(bname)
@@ -382,10 +599,14 @@ class IncrementalPacker:
         ints.task_uids.append(uid)
         ints.task_pods.append(pod)
         self._task_row[uid] = t
-        changed.update(_TASK_FIELDS)
+        # Same discipline as _delete_row: this job's cached block is
+        # stale the moment a row is appended outside a full rebuild.
+        ints.job_blocks.pop(pod.group, None)
+        for f in _TASK_FIELDS:
+            changed.rows(f, t)
         return True
 
-    def _patch_status(self, uid: str, changed: set[str]) -> None:
+    def _patch_status(self, uid: str, changed: _RowChanges) -> None:
         row = self._task_row.get(uid)
         if row is None:
             return
@@ -398,7 +619,8 @@ class IncrementalPacker:
             self._node_row.get(pod.node, NONE_IDX)
             if pod.node is not None else NONE_IDX
         )
-        changed.update(("task_state", "task_node"))
+        changed.rows("task_state", row)
+        changed.rows("task_node", row)
 
     # -- nodes ----------------------------------------------------------
 
@@ -416,7 +638,7 @@ class IncrementalPacker:
         pods_ix = names.index("pods") if "pods" in names else None
         return cordoned, canary, pods_ix
 
-    def _patch_node(self, name: str, changed: set[str],
+    def _patch_node(self, name: str, changed: _RowChanges,
                     view: tuple | None = None) -> None:
         row = self._node_row.get(name)
         if row is None:
@@ -454,8 +676,9 @@ class IncrementalPacker:
             if i is None:
                 raise _FullRebuild("vocab-growth:port")
             a["node_ports"][row, i] = 1.0
-        changed.update(("node_cap", "node_idle", "node_releasing",
-                        "node_pressure", "node_ports", "node_ready"))
+        for f in ("node_cap", "node_idle", "node_releasing",
+                  "node_pressure", "node_ports", "node_ready"):
+            changed.rows(f, row)
 
     # -- host-side reads ------------------------------------------------
 
@@ -497,13 +720,17 @@ class IncrementalPacker:
     def verify_against_live(self) -> None:
         """Assert every MUTABLE packed field matches the LIVE cache:
         pod status/node rows, node accounting, job rows (min/prio/
-        order/queue), and PDB membership bits.  Called under the cache
-        lock this is trivially true — which is exactly the invariant:
-        any future code packing outside the lock, or mutating without
-        marking, fails here.  Enabled per-pack via KB_TPU_CHECK_PACK=1.
+        order/queue), PDB membership bits, and — now that affinity/
+        volume clusters pack incrementally — the volume pin/group and
+        topology-term rows of claim/affinity-bearing pods.  Called
+        under the cache lock this is trivially true — which is exactly
+        the invariant: any future code packing outside the lock, or
+        mutating without marking, fails here.  Enabled per-pack via
+        KB_TPU_CHECK_PACK=1.
         """
         with self.cache.lock():
             a = self._ints.arrays
+            tt = self._ints.tt_idx
             for uid, row in self._task_row.items():
                 pod = self.cache._pods.get(uid)
                 assert pod is not None, f"packed pod {uid} vanished"
@@ -530,6 +757,22 @@ class IncrementalPacker:
                         f"pod {pod.name}: packed pdb[{bname}] bit "
                         f"{bool(a['task_pdbs'][row, bi])} != live {member}"
                     )
+                if pod.claims:
+                    self._verify_vol_row(pod, row, a)
+                if pod.affinity or pod.anti_affinity:
+                    for attr, field in (("affinity", "task_aff_topo"),
+                                        ("anti_affinity",
+                                         "task_anti_topo")):
+                        want_cols = set()
+                        for term in getattr(pod, attr):
+                            tk, labterm = split_topo_term(term)
+                            if tk is not None:
+                                want_cols.add(tt[(tk, labterm)])
+                        got = set(np.nonzero(a[field][row])[0].tolist())
+                        assert got == want_cols, (
+                            f"pod {pod.name}: packed {field} cols {got} "
+                            f"!= live terms {want_cols}"
+                        )
             cordoned, canary, pods_ix = self._health_view()
             for nname, row in self._node_row.items():
                 info = self.cache._nodes.get(nname)
@@ -578,3 +821,23 @@ class IncrementalPacker:
                     f"job {jname}: packed queue row {a['job_queue'][row]}"
                     f" != live {want_q}"
                 )
+
+    def _verify_vol_row(self, pod, row: int, a: dict) -> None:
+        """Recompute the pod's volume pin/groups against the live
+        claim/storage-class maps and the PACKED group vocabulary,
+        through the same resolver the packs use."""
+        want_node, want_list, _grows = resolve_claims(
+            pod.claims, self.cache._claims,
+            self.cache._storage_classes, self._node_row.get,
+            self._ints.g_idx,
+        )
+        want_groups = set(want_list)
+        assert a["task_vol_node"][row] == want_node, (
+            f"pod {pod.name}: packed vol pin {a['task_vol_node'][row]} "
+            f"!= live {want_node}"
+        )
+        got = set(np.nonzero(a["task_vol_groups"][row])[0].tolist())
+        assert got == want_groups, (
+            f"pod {pod.name}: packed vol groups {got} != live "
+            f"{want_groups}"
+        )

@@ -71,6 +71,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "open, drained queue); default: the mode "
                         "recorded in a replayed trace's meta header, "
                         "else 'sync'")
+    p.add_argument("--pack-mode", choices=("incremental", "full"),
+                   default=None,
+                   help="tensor-pack strategy for the scheduler under "
+                        "test (default: adopt from a replayed trace's "
+                        "meta header, else incremental).  Pack mode is "
+                        "decision-invisible: the same seed must hash "
+                        "identically under both (make chaos pins it)")
     p.add_argument("--quiet", action="store_true",
                    help="suppress progress logging; print only the "
                         "summary JSON")
@@ -158,6 +165,7 @@ def main(argv: list[str] | None = None) -> int:
         dump_dir=args.dump_dir,
         corrupt_tick=args.corrupt_tick,
         wire_commit=args.wire_commit,
+        pack_mode=args.pack_mode,
     )
     try:
         result = engine.run()

@@ -159,6 +159,11 @@ class ChaosResult:
     #: rejected / accepted — accepted MUST be 0), the takeover
     #: reconcile summary, and the cluster's stale-rejection count.
     failover: dict | None = None
+    #: Pack-path observability: the run's pack mode plus the packer's
+    #: full/incremental/row-patched counters — a scenario that was
+    #: supposed to exercise incremental packs but full-packed every
+    #: cycle is visible here, and the pack-mode parity check reads it.
+    pack: dict | None = None
 
     def summary(self) -> dict:
         return {
@@ -175,6 +180,7 @@ class ChaosResult:
             "commit": self.commit,
             "failover": self.failover,
             "health": self.health,
+            "pack": self.pack,
         }
 
 
@@ -215,6 +221,7 @@ class ChaosEngine:
         quiesce_timeout: float = 30.0,
         wire_timeout: float | None = None,
         wire_commit: str | None = None,
+        pack_mode: str | None = None,
     ) -> None:
         self.seed = seed
         self.ticks = ticks
@@ -235,6 +242,24 @@ class ChaosEngine:
             raise ValueError(
                 f"wire_commit must be 'sync' or 'pipelined', got "
                 f"{self.wire_commit!r}"
+            )
+        # The pack-mode dimension (incremental row-patched packs vs a
+        # full rebuild every cycle) must be decision-invisible: device
+        # state is bit-identical either way, so the SAME seed must
+        # produce the SAME trace hash under both — `make chaos` pins
+        # it.  Like wire_commit it rides the meta header (excluded
+        # from the hash) and is adopted on replay unless overridden.
+        if pack_mode is None and events is not None:
+            meta = next(
+                (e for e in events if e.get("op") == "meta"), None
+            )
+            if meta is not None:
+                pack_mode = meta.get("pack_mode")
+        self.pack_mode = pack_mode or "incremental"
+        if self.pack_mode not in ("incremental", "full"):
+            raise ValueError(
+                f"pack_mode must be 'incremental' or 'full', got "
+                f"{self.pack_mode!r}"
             )
         self.commit = None  # CommitPipeline, created in run()
         if faults is None and events is not None:
@@ -799,6 +824,7 @@ class ChaosEngine:
             header = {
                 "tick": -1, "op": "meta", "seed": self.seed,
                 "wire_commit": self.wire_commit,
+                "pack_mode": self.pack_mode,
                 **{k: getattr(self.faults, k)
                    for k in _META_FAULT_FIELDS},
             }
@@ -874,6 +900,7 @@ class ChaosEngine:
         scheduler = Scheduler(
             self.cache, conf_path=self.conf_path, schedule_period=0.0,
             guardrails=self.guardrails, health=self.health,
+            pack_mode=self.pack_mode,
         )
         self.scheduler = scheduler
         checker = InvariantChecker(self.cluster)
@@ -1047,7 +1074,22 @@ class ChaosEngine:
             commit=self._commit_summary(),
             failover=self._failover_summary(),
             health=self._health_summary(),
+            pack=self._pack_summary(),
         )
+
+    def _pack_summary(self) -> dict | None:
+        packer = getattr(
+            getattr(self, "scheduler", None), "packer", None
+        )
+        if packer is None:
+            return None
+        return {
+            "mode": self.pack_mode,
+            "full_packs": packer.full_packs,
+            "incremental_packs": packer.incremental_packs,
+            "row_patched_packs": packer.row_patched_packs,
+            "fallback_reasons": dict(packer.fallback_reasons),
+        }
 
     # -- guardrail invariants ------------------------------------------
     def _rails_recovered(self) -> bool:

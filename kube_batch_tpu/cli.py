@@ -120,6 +120,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "resourceVersion, or a full in-process re-list "
                         "on a 410-style gap); 0 exits immediately to "
                         "the supervisor")
+    p.add_argument("--pack-mode", choices=("incremental", "full"),
+                   default=None,
+                   help="tensor-pack strategy: 'incremental' (default; "
+                        "patch the previous cycle's arrays, row-granular "
+                        "device upload) or 'full' (rebuild every cycle — "
+                        "the diagnosis/parity escape hatch, see "
+                        "doc/design/daemon-operations.md; env "
+                        "KB_TPU_PACK_MODE)")
     p.add_argument("--cycles", type=int, default=None,
                    help="stop after N cycles (default: run forever)")
     p.add_argument("--profile-dir", default=None,
@@ -657,6 +665,7 @@ def run_external(args) -> int:
             profile_dir=args.profile_dir,
             guardrails=guardrails,
             health=health,
+            pack_mode=args.pack_mode,
         )
         run_state["scheduler"] = scheduler
         ran = scheduler.run(stop=stop, max_cycles=args.cycles)
@@ -790,6 +799,7 @@ def run_http(args) -> int:
             profile_dir=args.profile_dir,
             guardrails=guardrails,
             health=health,
+            pack_mode=args.pack_mode,
         )
         run_state["scheduler"] = scheduler
         ran = scheduler.run(stop=stop, max_cycles=args.cycles)
@@ -924,6 +934,7 @@ def main(argv: list[str] | None = None) -> int:
         conf_path=args.scheduler_conf,
         schedule_period=args.schedule_period,
         profile_dir=args.profile_dir,
+        pack_mode=args.pack_mode,
         # Sim mode has no wire to break, but the watchdog ladder, the
         # HBM-ceiling admission and the node-health ledger apply the
         # same (no cordon sink: the simulator has no spec to patch).

@@ -215,6 +215,24 @@ snapshot_pack_latency = REGISTRY.register(Histogram(
     "snapshot_pack_latency_seconds",
     "HostSnapshot to device-tensor packing latency (H2D boundary).",
 ))
+pack_h2d_bytes = REGISTRY.register(Counter(
+    "pack_h2d_bytes_total",
+    "Host-to-device bytes shipped by the tensor packers: full-pack "
+    "pytree uploads, whole changed arrays, and row-patch payloads "
+    "(indices + dirty rows).  A steady cycle on the row-patch path "
+    "moves a few KB here; a sustained whole-array-sized rate signals "
+    "a pack regression (doc/design/daemon-operations.md).",
+))
+pack_total = REGISTRY.register(Counter(
+    "pack_total",
+    "Tensor packs by mode: full (rebuild, incl. fallbacks — see the "
+    "packer's fallback_reasons), row_patch (at least one changed "
+    "field shipped as dirty rows through the scatter kernel), "
+    "incremental (patched host arrays, but every changed field "
+    "re-uploaded whole — e.g. a churn burst past the dirty-fraction "
+    "threshold).",
+    labels=("mode",),
+))
 pending_tasks = REGISTRY.register(Gauge(
     "pending_tasks", "Tasks still pending at session close.",
 ))
@@ -253,8 +271,10 @@ cycle_phase_latency = REGISTRY.register(Histogram(
     "bind_dispatch = gang-gated bind fan-out (with the pipelined wire "
     "commit this is ENQUEUE time — wire RTTs land in "
     "commit_flush_latency_seconds); diagnosis = why-unschedulable "
-    "tallies; status_writeback = PodGroup status recompute + writes.  "
-    "Pack time is snapshot_pack_latency.",
+    "tallies; status_writeback = PodGroup status recompute + writes; "
+    "pack_host_patch = host-side array build/patch inside the pack; "
+    "pack_h2d = the pack's device upload (whole arrays + row patches). "
+    "Total pack time is snapshot_pack_latency.",
     labels=("phase",),
 ))
 

@@ -52,6 +52,7 @@ class Scheduler:
         profile_dir: str | None = None,
         guardrails: Guardrails | None = None,
         health=None,
+        pack_mode: str | None = None,
     ) -> None:
         self.cache = cache
         self.conf_path = conf_path
@@ -76,10 +77,25 @@ class Scheduler:
         # Event-driven tensor pack: the daemon patches the previous
         # cycle's arrays instead of rebuilding them (cache/incremental.py)
         # — the host-side work of a steady-state cycle is O(changes),
-        # not O(cluster).
+        # not O(cluster).  `pack_mode` ("incremental" default, "full" =
+        # rebuild every cycle; CLI --pack-mode / KB_TPU_PACK_MODE) is
+        # the operator escape hatch and the chaos-parity dimension —
+        # device state is bit-identical either way, so switching modes
+        # must never change a scheduling decision (pinned by `make
+        # chaos` running the same seed under both).
         from kube_batch_tpu.cache.incremental import IncrementalPacker
 
+        import os as _os
+
         self.packer = IncrementalPacker(cache)
+        mode = pack_mode or _os.environ.get(
+            "KB_TPU_PACK_MODE", "incremental"
+        )
+        if mode not in ("incremental", "full"):
+            raise ValueError(
+                f"pack_mode must be 'incremental' or 'full', got {mode!r}"
+            )
+        self.packer.force_full = mode == "full"
         # jax.profiler trace target (SURVEY §5 rebuild target): when
         # set, the SECOND cycle of run() is captured (the first pays
         # compilation and would swamp the trace).

@@ -18,7 +18,7 @@ import json
 import sys
 
 
-def main(path_a: str, path_b: str) -> int:
+def main(path_a: str, path_b: str, path_packfull: str | None = None) -> int:
     with open(path_a, encoding="utf-8") as f:
         a = json.load(f)
     with open(path_b, encoding="utf-8") as f:
@@ -39,14 +39,40 @@ def main(path_a: str, path_b: str) -> int:
         f"same-seed pipelined runs diverged: "
         f"{a['trace_hash']} != {b['trace_hash']}"
     )
+    if path_packfull is not None:
+        # Pack-mode parity: the SAME seed under --pack-mode full (a
+        # from-scratch rebuild every cycle) must reproduce the
+        # incremental runs' hash exactly — the row-patched device
+        # state is bit-identical to a fresh pack, so pack mode can
+        # never change a scheduling decision.
+        with open(path_packfull, encoding="utf-8") as f:
+            c = json.load(f)
+        assert c["ok"], f"pack-full run violations: {c['violations']}"
+        pack = c.get("pack") or {}
+        assert pack.get("mode") == "full", pack
+        assert pack.get("incremental_packs", 1) == 0, (
+            f"pack-full run still packed incrementally: {pack}"
+        )
+        assert c["trace_hash"] == a["trace_hash"], (
+            "pack-mode full diverged from incremental at the same "
+            f"seed: {c['trace_hash']} != {a['trace_hash']}"
+        )
+        incr_pack = a.get("pack") or {}
+        assert incr_pack.get("incremental_packs", 0) > 0, (
+            "incremental runs never took the patch path — the parity "
+            f"check is vacuous: {incr_pack}"
+        )
     print(
         "chaos pipelined: ok — same-seed hash "
-        f"{a['trace_hash'][:16]}… reproduced; breaker tripped "
-        f"{a['guardrail']['breaker_opened']}x and drained to zero "
-        "in-flight writes; per-pod wire order preserved"
+        f"{a['trace_hash'][:16]}… reproduced"
+        + (" (and under --pack-mode full)" if path_packfull else "")
+        + f"; breaker tripped {a['guardrail']['breaker_opened']}x "
+        "and drained to zero in-flight writes; per-pod wire order "
+        "preserved"
     )
     return 0
 
 
 if __name__ == "__main__":
-    sys.exit(main(sys.argv[1], sys.argv[2]))
+    sys.exit(main(sys.argv[1], sys.argv[2],
+                  sys.argv[3] if len(sys.argv) > 3 else None))

@@ -1,0 +1,72 @@
+#!/usr/bin/env python
+"""Gate on the bench artifact's `pack_compare` section (make
+bench-smoke): the pack-path overhaul's acceptance evidence must land
+in every daemon artifact and must not silently regress.
+
+Asserts, at config-3 scale (always present; the flagship scale rides
+along when the budget allowed it):
+
+* the section exists and carries no error;
+* a single-pod status change on the row-patch path ships < 5% of the
+  bytes the whole-array upload ships (the H2D acceptance pin);
+* the row-patched mode actually took the patch path every cycle (a
+  comparison where everything fell back to full packs is vacuous);
+* the block-cached vectorized rebuild is not slower than the frozen
+  loop baseline (the hard >=2x gate runs in make verify's microbench
+  with best-of-N discipline; this artifact-level check only refuses a
+  regression past parity).
+
+Reads the bench child's stdout on stdin (same plumbing as
+check_bench_smoke.py).
+"""
+
+import json
+import sys
+
+
+def main() -> int:
+    lines = [ln for ln in sys.stdin.read().splitlines() if ln.strip()]
+    assert lines, "bench produced no stdout"
+    artifact = json.loads(lines[-1])
+    pc = artifact.get("pack_compare") or (
+        artifact.get("daemon") or {}
+    ).get("pack_compare")
+    assert isinstance(pc, dict), (
+        f"artifact missing pack_compare; keys: {sorted(artifact)}"
+    )
+    assert "error" not in pc, f"pack_compare degraded: {pc['error']}"
+    s = pc.get("3")
+    assert isinstance(s, dict), (
+        f"pack_compare missing the config-3 entry; scales: {sorted(pc)}"
+    )
+
+    ratio = s.get("h2d_ratio")
+    assert ratio is not None and ratio < 0.05, (
+        f"single-pod status change shipped {ratio!r} of the whole-array "
+        f"upload (gate: < 0.05): {s}"
+    )
+    rp = s["modes"]["row_patch"]
+    assert rp["row_patched_packs"] >= rp["incremental_packs"] > 0, (
+        f"row-patch mode never took the patch path: {rp}"
+    )
+    full = s["modes"]["full"]
+    assert full["incremental_packs"] == 0 and full["full_packs"] > 1, (
+        f"full mode did not full-pack every cycle: {full}"
+    )
+    assert s["vec_rebuild_ms"] <= s["loop_full_ms"] * 1.1, (
+        f"vectorized rebuild ({s['vec_rebuild_ms']}ms) regressed past "
+        f"the loop baseline ({s['loop_full_ms']}ms)"
+    )
+
+    print(
+        "pack-compare artifact: ok — rebuild "
+        f"{s['rebuild_speedup']}x vs loop, single-pod H2D "
+        f"{s['row_patch_h2d_bytes']}B vs {s['whole_h2d_bytes']}B "
+        f"({ratio:.1%}), row-patched {rp['row_patched_packs']} of "
+        f"{rp['incremental_packs']} steady packs"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,110 @@
+#!/usr/bin/env python
+"""make verify's pack microbench gate (config-3 scale, CPU).
+
+Two hard assertions so pack performance can't silently regress:
+
+* the VECTORIZED full pack — measured on the production full-rebuild
+  path, i.e. with the previous pack's per-job column blocks warm
+  (packer.JobBlock; this is what every journal-forced rebuild runs) —
+  must be >= 2x the frozen per-pod loop baseline
+  (pack_snapshot_loop);
+* a single-pod status change through the IncrementalPacker must ship
+  < 5% of the bytes the whole-array upload would (the row-granular
+  device patch acceptance pin).
+
+Timing discipline: best-of-N for both sides, and one full re-measure
+before failing — a CI box under load must not flake the gate on one
+noisy window.  The equality of the two packers' OUTPUT is pinned
+separately (tests/test_pack_vectorized.py); this gate is purely about
+speed and bytes.
+"""
+
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Runnable as `python scripts/check_pack_microbench.py` from the repo
+# root (the Makefile's invocation): put the repo on the path.
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SPEEDUP_GATE = 2.0
+H2D_GATE = 0.05
+ITERS = 7
+
+
+def _best(f, iters: int = ITERS) -> float:
+    ts = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        f()
+        ts.append(time.perf_counter() - t0)
+    return min(ts)
+
+
+def measure_speedup() -> float:
+    from kube_batch_tpu.cache.packer import (
+        pack_snapshot_full,
+        pack_snapshot_loop,
+    )
+    from kube_batch_tpu.models.workloads import build_config
+
+    cache, _sim = build_config(3)
+    host = cache.snapshot()
+    _, _, ints = pack_snapshot_full(host, device=False)
+    loop_s = _best(lambda: pack_snapshot_loop(host, device=False))
+    vec_s = _best(
+        lambda: pack_snapshot_full(host, device=False, prev=ints))
+    return loop_s / vec_s
+
+
+def measure_h2d_ratio() -> tuple[int, int]:
+    from kube_batch_tpu.api.types import TaskStatus
+    from kube_batch_tpu.cache.incremental import IncrementalPacker
+    from kube_batch_tpu.models.workloads import build_config
+
+    def one(row_patch: bool) -> int:
+        cache, _sim = build_config(3)
+        packer = IncrementalPacker(cache)
+        if not row_patch:
+            packer.ROW_PATCH_MAX_FRAC = 0.0
+        packer.pack()
+        with cache.lock():
+            uid = next(iter(cache._pods))
+            node = next(iter(cache._nodes))
+        cache.update_pod_status(uid, TaskStatus.BOUND, node=node)
+        packer.pack()
+        assert packer.last_mode.startswith("incremental:"), \
+            packer.last_mode
+        return packer.last_h2d_bytes
+
+    return one(row_patch=True), one(row_patch=False)
+
+
+def main() -> int:
+    speedup = measure_speedup()
+    if speedup < SPEEDUP_GATE:  # one re-measure before failing
+        speedup = max(speedup, measure_speedup())
+    assert speedup >= SPEEDUP_GATE, (
+        f"vectorized full pack only {speedup:.2f}x over the loop "
+        f"baseline at config-3 scale (gate: >= {SPEEDUP_GATE}x)"
+    )
+
+    row_b, whole_b = measure_h2d_ratio()
+    ratio = row_b / whole_b
+    assert ratio < H2D_GATE, (
+        f"single-pod status change row-patch shipped {row_b}B vs "
+        f"{whole_b}B whole-array ({ratio:.1%}; gate: < {H2D_GATE:.0%})"
+    )
+
+    print(
+        f"pack microbench: ok — vectorized rebuild {speedup:.2f}x vs "
+        f"loop (gate >= {SPEEDUP_GATE}x); single-pod H2D {row_b}B vs "
+        f"{whole_b}B ({ratio:.1%}, gate < {H2D_GATE:.0%})"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -56,16 +56,29 @@ def _hot(row: np.ndarray, vocab) -> dict:
     return out
 
 
+def _topo_hot(row_arr, ints) -> dict:
+    """Topo-term multi-hot row -> {(key, label): weight}."""
+    inv = {i: t for t, i in ints.tt_idx.items()}
+    out = {}
+    for i in np.nonzero(np.asarray(row_arr))[0]:
+        i = int(i)
+        if i in inv:
+            out[inv[i]] = float(row_arr[i])
+    return out
+
+
 def _decode_tasks(snap_arrays, meta, ints) -> dict:
     """Device/host arrays -> {uid: facts dict} over real rows only."""
     a = snap_arrays
     out = {}
     node_names = ints.node_names
     job_names = ints.job_names
+    inv_g = {i: c for c, i in ints.g_idx.items()}
     for row, uid in enumerate(meta.task_uids):
         tn = int(a["task_node"][row])
         tj = int(a["task_job"][row])
         ns = int(a["task_ns"][row])
+        vn = int(a["task_vol_node"][row])
         out[uid] = {
             "req": tuple(np.asarray(a["task_req"][row]).tolist()),
             "state": int(a["task_state"][row]),
@@ -85,8 +98,53 @@ def _decode_tasks(snap_arrays, meta, ints) -> dict:
             "anti": _hot(a["task_anti"][row], meta.podlabel_vocab),
             "podpref": _hot(a["task_podpref"][row], meta.podlabel_vocab),
             "pdbs": _hot(a["task_pdbs"][row], ints.pdb_names),
+            # topology-scoped terms and volume feasibility: the
+            # previously cliff'd geometry, decoded per uid so the
+            # incremental patch path is held to the same differential
+            "aff_topo": _topo_hot(a["task_aff_topo"][row], ints),
+            "anti_topo": _topo_hot(a["task_anti_topo"][row], ints),
+            "ppref_topo": (
+                _topo_hot(a["task_podpref_topo"][row], ints)
+                if a["task_podpref_topo"].shape[1] else {}
+            ),
+            "vol_node": (
+                node_names[vn] if 0 <= vn < len(node_names)
+                else ("INFEASIBLE" if vn == -2 else None)
+            ),
+            "vol_groups": {
+                inv_g[int(i)]
+                for i in np.nonzero(a["task_vol_groups"][row])[0]
+                if int(i) in inv_g
+            },
         }
     return out
+
+
+def _domain_partitions(snap_arrays, ints) -> dict:
+    """node_key_domain -> {topo key: canonical node partition} —
+    domain IDS may legitimately differ between an incremental pack
+    (stale vocab) and a fresh full pack; the induced co-location
+    partition may not."""
+    nkd = np.asarray(snap_arrays["node_key_domain"])
+    out = {}
+    for key, ti in ints.tk_idx.items():
+        groups: dict[int, set] = {}
+        for ni, name in enumerate(ints.node_names):
+            groups.setdefault(int(nkd[ni, ti]), set()).add(name)
+        out[key] = frozenset(frozenset(v) for v in groups.values())
+    return out
+
+
+def _vol_group_selectors(snap_arrays, meta, ints) -> dict:
+    """vol_group_sel -> {claim: allowed node-label set}."""
+    sel = np.asarray(snap_arrays["vol_group_sel"])
+    return {
+        c: frozenset(
+            meta.label_vocab[int(li)]
+            for li in np.nonzero(sel[gi])[0]
+        )
+        for c, gi in ints.g_idx.items()
+    }
 
 
 def _decode_nodes(snap_arrays, meta, ints) -> dict:
@@ -177,6 +235,18 @@ def assert_pack_equivalent(packer: IncrementalPacker, cache) -> None:
         assert ji[name] == jf[name], (
             f"job {name} diverges: incr={ji[name]} full={jf[name]}"
         )
+
+    # geometry: topology-domain partitions and volume-group selectors
+    assert _domain_partitions(snap_i, ints_i) == _domain_partitions(
+        arr_f, ints_f
+    ), "topology-domain partitions diverge"
+    assert _vol_group_selectors(snap_i, meta_i, ints_i) ==         _vol_group_selectors(arr_f, meta_f, ints_f), (
+            "volume-group selectors diverge"
+        )
+    # topo_term_key/label must agree with the intern table they index
+    for (tk, lab), ti in ints_i.tt_idx.items():
+        assert int(snap_i["topo_term_key"][ti]) == ints_i.tk_idx[tk]
+        assert int(snap_i["topo_term_label"][ti]) ==             ints_i.pl_idx[lab]
 
     qi = {n: float(snap_i["queue_weight"][r])
           for r, n in enumerate(ints_i.queue_names)}
@@ -574,3 +644,301 @@ def test_listener_does_not_leak():
     live = IncrementalPacker(cache)
     live.pack()
     assert len(cache._dirty_listeners) == 1
+
+
+# ---------------------------------------------------------------------------
+# pack-path overhaul: topo/volume geometry without the full-pack cliff,
+# row-granular device patching, and the 200-step journal fuzz
+# ---------------------------------------------------------------------------
+
+
+def _build_geo_world(n_nodes=6, n_gangs=3, gang=3):
+    """A world that previously hit the per-cycle
+    `full:topo-or-volume-geometry-present` cliff: zone-labeled nodes,
+    a constrained StorageClass, and gangs carrying node-level AND
+    topology-scoped (anti-)affinity, soft topo prefs, and claims."""
+    from kube_batch_tpu.cache.cluster import Claim, StorageClass
+
+    cache, sim = make_world(DEFAULT_SPEC)
+    cache.add_storage_class(StorageClass(
+        name="local-ssd", allowed_node_labels=frozenset({"disk=ssd"})))
+    cache.add_claim(Claim(name="pvc-free", storage_class="local-ssd"))
+    cache.add_claim(Claim(name="pvc-bound", storage_class="local-ssd",
+                          bound_node="n1"))
+    for i in range(n_nodes):
+        sim.add_node(_node(
+            f"n{i}", cpu_milli=16000, mem=64 * GI,
+            labels={"zone": f"z{i % 3}",
+                    "disk": "ssd" if i % 2 else "hdd"},
+        ))
+    for j in range(n_gangs):
+        group = PodGroup(name=f"geo{j}", queue="default", min_member=gang)
+        pods = []
+        for i in range(gang):
+            kw = {}
+            if i == 0:
+                kw["labels"] = {"app": f"a{j}"}
+                kw["affinity"] = frozenset({f"zone:app=a{j}"})
+                kw["pod_prefs"] = {f"zone:app=a{j}": 2.0}
+            elif i == 1:
+                kw["labels"] = {"app": f"a{j}"}
+                kw["anti_affinity"] = frozenset({"zone:app=noisy",
+                                                 "app=noisy"})
+                kw["claims"] = frozenset({"pvc-free"})
+            pods.append(_pod(f"geo{j}-{i}", cpu=500, mem=GI, **kw))
+        sim.submit(group, pods)
+    # the "noisy" vocab entries must exist so anti terms intern
+    noisy = PodGroup(name="noisy", queue="default", min_member=1)
+    sim.submit(noisy, [
+        _pod("noisy-0", cpu=250, mem=GI, labels={"app": "noisy"},
+             claims=frozenset({"pvc-bound"})),
+    ])
+    return cache, sim
+
+
+def _assert_device_is_host(packer: IncrementalPacker) -> None:
+    """The row-patched DEVICE buffers must be bit-identical to the
+    packer's patched host arrays — the exact contract the scatter
+    kernel must preserve (a drifted row here is a solver reading
+    stale state)."""
+    for f, host_arr in packer._ints.arrays.items():
+        dev = np.asarray(getattr(packer._snap, f))
+        assert np.array_equal(dev, host_arr), (
+            f"device buffer {f} diverged from patched host array"
+        )
+
+
+def test_topo_volume_world_packs_incrementally():
+    """The cliff removal: status churn on an affinity/volume-bearing
+    world must take the patch path every cycle (previously it paid
+    `full:topo-or-volume-geometry-present` forever), with the device
+    state bit-identical to the host arrays and the live cache
+    (verify_against_live on every pack)."""
+    cache, _sim = _build_geo_world()
+    packer = IncrementalPacker(cache)
+    packer.check = True
+    packer.pack()
+    with cache.lock():
+        uids = list(cache._pods)
+        nodes = list(cache._nodes)
+    rng = random.Random(3)
+    for i in range(10):
+        uid = rng.choice(uids)
+        if rng.random() < 0.5:
+            cache.update_pod_status(uid, TaskStatus.BOUND,
+                                    node=rng.choice(nodes))
+        else:
+            cache.update_pod_status(uid, TaskStatus.PENDING)
+        packer.pack()
+        assert packer.last_mode.startswith("incremental:"), (
+            f"cycle {i}: topo/volume world fell back: {packer.last_mode}"
+        )
+        _assert_device_is_host(packer)
+        assert_pack_equivalent(packer, cache)
+    assert packer.row_patched_packs >= 8, packer.row_patched_packs
+    assert "topo-or-volume-geometry-present" not in \
+        packer.fallback_reasons
+
+
+def test_append_pod_with_interned_topo_and_claims():
+    """A late pod whose topo terms and claims are already interned
+    appends incrementally; NEW terms / constrained claims are
+    vocabulary growth and rebuild."""
+    cache, _sim = _build_geo_world()
+    packer = IncrementalPacker(cache)
+    packer.check = True
+    packer.pack()
+
+    late = _pod("late-topo", cpu=250, mem=GI, labels={"app": "a0"},
+                affinity=frozenset({"zone:app=a0"}),
+                pod_prefs={"zone:app=a0": 1.5},
+                claims=frozenset({"pvc-free"}))
+    late.group = "geo0"
+    cache.add_pod(late)
+    packer.pack()
+    assert packer.last_mode.startswith("incremental:"), packer.last_mode
+    assert_pack_equivalent(packer, cache)
+
+    # a bound-claim pod pins incrementally too
+    late2 = _pod("late-pin", cpu=250, mem=GI,
+                 claims=frozenset({"pvc-bound"}))
+    late2.group = "geo1"
+    cache.add_pod(late2)
+    packer.pack()
+    assert packer.last_mode.startswith("incremental:"), packer.last_mode
+    assert_pack_equivalent(packer, cache)
+
+    # an UNinterned topo term is vocab growth
+    late3 = _pod("late-new-term", cpu=250, mem=GI,
+                 anti_affinity=frozenset({"rack:app=a0"}))
+    late3.group = "geo1"
+    cache.add_pod(late3)
+    packer.pack()
+    assert packer.last_mode == "full:vocab-growth:topo-term", \
+        packer.last_mode
+    assert_pack_equivalent(packer, cache)
+
+    # a fresh constrained claim (new volume-group column) rebuilds
+    from kube_batch_tpu.cache.cluster import Claim
+
+    cache.add_claim(Claim(name="pvc-new", storage_class="local-ssd"))
+    packer.pack()  # claim add itself marks full
+    late4 = _pod("late-new-group", cpu=250, mem=GI,
+                 claims=frozenset({"pvc-new"}))
+    late4.group = "geo1"
+    cache.add_pod(late4)
+    packer.pack()
+    assert packer.last_mode.startswith("full:"), packer.last_mode
+    assert_pack_equivalent(packer, cache)
+
+
+def test_journal_fuzz_200_mutations_geo_world():
+    """The seeded 200-step journal fuzz: mixed add/delete/status/node/
+    topology mutations against the geometry-bearing world; after EVERY
+    pack the device state must be bit-identical to the patched host
+    arrays AND decode-identical to a from-scratch full pack — the
+    row-patched upload and the previously cliff'd topo/volume columns
+    included."""
+    rng = random.Random(20260804)
+    cache, sim = _build_geo_world()
+    packer = IncrementalPacker(cache)
+    packer.check = True  # verify_against_live every pack
+    packer.pack()
+    c = _Churn(cache, sim, rng)
+
+    def op_add_topo_pod(c):
+        groups = [g for g in c._groups() if g.startswith("geo")]
+        if groups:
+            c.next_id += 1
+            g = c.rng.choice(groups)
+            app = f"a{g[3:]}"
+            pod = _pod(f"fz-{c.next_id}", cpu=250, mem=GI,
+                       labels={"app": app},
+                       affinity=frozenset({f"zone:app={app}"}))
+            pod.group = g
+            c.cache.add_pod(pod)
+
+    def op_add_claim_pod(c):
+        groups = [g for g in c._groups() if g.startswith("geo")]
+        if groups:
+            c.next_id += 1
+            pod = _pod(f"fc-{c.next_id}", cpu=250, mem=GI,
+                       claims=frozenset({"pvc-free"}))
+            pod.group = c.rng.choice(groups)
+            c.cache.add_pod(pod)
+
+    ops = (
+        [c.op_bind] * 6 + [c.op_run] * 5 + [c.op_evict] * 3
+        + [c.op_delete_pod] * 2 + [c.op_add_pod] * 2
+        + [op_add_topo_pod] * 2 + [op_add_claim_pod] * 2
+        + [c.op_add_gang] + [c.op_update_min_member]
+        + [c.op_pressure_flip] + [c.op_add_node] + [c.op_add_pdb]
+    )
+    incremental_before = packer.incremental_packs
+    for step in range(200):
+        op = rng.choice(ops)
+        if op in (c.op_bind, c.op_run, c.op_evict, c.op_delete_pod,
+                  c.op_add_pod, c.op_add_gang, c.op_update_min_member,
+                  c.op_pressure_flip, c.op_add_node, c.op_add_pdb):
+            op()
+        else:
+            op(c)
+        packer.pack()
+        _assert_device_is_host(packer)
+        assert_pack_equivalent(packer, cache)
+    # the fuzz must exercise BOTH paths or it proves nothing
+    assert packer.incremental_packs - incremental_before >= 50, (
+        f"fuzz mostly full-packed: {dict(packer.fallback_reasons)}"
+    )
+    assert packer.row_patched_packs >= 25, packer.row_patched_packs
+    assert packer.full_packs >= 5, packer.full_packs
+    assert "topo-or-volume-geometry-present" not in \
+        packer.fallback_reasons
+
+
+def test_row_patch_h2d_bytes_under_5pct():
+    """Acceptance pin: a single-pod status-change cycle uploads only
+    dirty rows — < 5% of the bytes the whole-changed-array upload
+    ships at config-3 scale (and the patched device buffers stay
+    bit-identical to the host arrays)."""
+    from kube_batch_tpu.models.workloads import build_config
+
+    def one(row_patch: bool) -> tuple[int, "IncrementalPacker"]:
+        cache, _sim = build_config(3)
+        packer = IncrementalPacker(cache)
+        if not row_patch:
+            packer.ROW_PATCH_MAX_FRAC = 0.0
+        packer.pack()
+        with cache.lock():
+            uid = next(iter(cache._pods))
+            node = next(iter(cache._nodes))
+        cache.update_pod_status(uid, TaskStatus.BOUND, node=node)
+        packer.pack()
+        assert packer.last_mode.startswith("incremental:"), \
+            packer.last_mode
+        return packer.last_h2d_bytes, packer
+
+    row_bytes, row_packer = one(row_patch=True)
+    whole_bytes, _ = one(row_patch=False)
+    assert row_packer.row_patched_packs == 1
+    _assert_device_is_host(row_packer)
+    assert row_bytes < 0.05 * whole_bytes, (
+        f"single-pod change shipped {row_bytes}B row-patched vs "
+        f"{whole_bytes}B whole-array — not under 5%"
+    )
+
+
+def test_row_patch_falls_back_to_whole_array_past_threshold():
+    """A cycle that dirties more than ROW_PATCH_MAX_FRAC of a field's
+    rows ships the whole array (the dense-patch fallback), and the
+    device state stays exact either way."""
+    cache, sim = _build_world(n_nodes=2, n_gangs=4, gang=4)  # T=16
+    packer = IncrementalPacker(cache)
+    packer.check = True
+    packer.pack()
+    with cache.lock():
+        uids = list(cache._pods)
+    # dirty every task row (status-only; no node involved so only the
+    # two task arrays change): 16/16 > 25% of the padded 16-bucket
+    for uid in uids:
+        cache.update_pod_status(uid, TaskStatus.SUCCEEDED)
+    packer.pack()
+    assert packer.last_mode.startswith("incremental:")
+    assert packer.row_patched_packs == 0  # whole-array fallback
+    # the upload shipped the full arrays, not row payloads
+    a = packer._ints.arrays
+    assert packer.last_h2d_bytes >= (
+        a["task_state"].nbytes + a["task_node"].nbytes
+    )
+    _assert_device_is_host(packer)
+    assert_pack_equivalent(packer, cache)
+    # one more single flip goes back to the row patch
+    cache.update_pod_status(uids[0], TaskStatus.PENDING)
+    packer.pack()
+    assert packer.row_patched_packs == 1
+    _assert_device_is_host(packer)
+
+
+def test_forced_full_mode_matches_incremental_state():
+    """--pack-mode full: every pack rebuilds, and the resulting device
+    state decodes identically to the incremental packer's (the chaos
+    pack-mode parity in miniature)."""
+    cache_a, sim_a = _build_geo_world()
+    packer_a = IncrementalPacker(cache_a)
+    packer_a.pack()
+    packer_b = IncrementalPacker(cache_a)
+    packer_b.force_full = True
+    packer_b.pack()
+    with cache_a.lock():
+        uid = next(iter(cache_a._pods))
+        node = next(iter(cache_a._nodes))
+    cache_a.update_pod_status(uid, TaskStatus.BOUND, node=node)
+    sa, ma = packer_a.pack()
+    sb, mb = packer_b.pack()
+    assert packer_a.last_mode.startswith("incremental:")
+    assert packer_b.last_mode == "full:forced"
+    assert packer_b.incremental_packs == 0
+    ia, ib = packer_a._ints, packer_b._ints
+    ta = _decode_tasks(_snap_to_arrays(sa), ma, ia)
+    tb = _decode_tasks(_snap_to_arrays(sb), mb, ib)
+    assert ta == tb

@@ -1,0 +1,269 @@
+"""Vectorized pack differentials (pack-path overhaul).
+
+`pack_snapshot_full` (the production vectorized/block-cached pack) must
+reproduce `pack_snapshot_loop` (the frozen per-pod loop baseline)
+BIT-FOR-BIT — same arrays, same dtypes, same padding, same meta — on
+worlds exercising every feature family: selectors/preferences,
+taints/tolerations, host ports, pod labels + node-level and
+topology-scoped (anti-)affinity, soft co-location prefs, volume claims
+(bound pins, constrained groups, unknown claims/classes), PDBs,
+namespaces, cordons and forced growth buckets.  Also pins the per-job
+block cache (a warm rebuild must produce the same bytes as a cold one)
+and `SnapshotMeta.replace_rows`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from kube_batch_tpu.cache.cluster import (
+    Claim,
+    Namespace,
+    PodDisruptionBudget,
+    PodGroup,
+    Queue,
+    StorageClass,
+)
+from kube_batch_tpu.cache.packer import (
+    pack_snapshot_full,
+    pack_snapshot_loop,
+)
+from kube_batch_tpu.models.workloads import DEFAULT_SPEC, GI, _node, _pod
+from kube_batch_tpu.sim.simulator import make_world
+
+
+def _assert_same(sa, sb, ma, mb, label=""):
+    for f in dataclasses.fields(sa):
+        a, b = getattr(sa, f.name), getattr(sb, f.name)
+        assert a.dtype == b.dtype and a.shape == b.shape, (
+            f"{label}{f.name}: {a.dtype}{a.shape} != {b.dtype}{b.shape}"
+        )
+        assert np.array_equal(np.asarray(a), np.asarray(b)), (
+            f"{label}{f.name} diverges"
+        )
+    assert ma.task_uids == mb.task_uids, label
+    assert ma.job_names == mb.job_names, label
+    assert ma.node_names == mb.node_names, label
+    assert ma.queue_names == mb.queue_names, label
+    assert ma.label_vocab == mb.label_vocab, label
+    assert ma.taint_vocab == mb.taint_vocab, label
+    assert ma.port_vocab == mb.port_vocab, label
+    assert ma.podlabel_vocab == mb.podlabel_vocab, label
+
+
+def _rich_world():
+    """Every feature family in one cache."""
+    cache, sim = make_world(DEFAULT_SPEC)
+    cache.add_queue(Queue(name="gold", weight=3.0))
+    cache.add_namespace(Namespace(name="team-a", weight=2.0))
+    cache.add_pdb(PodDisruptionBudget(
+        name="web-pdb", min_available=1, selector={"app": "web"}))
+    cache.add_storage_class(StorageClass(
+        name="local-ssd", allowed_node_labels=frozenset({"disk=ssd"})))
+    cache.add_claim(Claim(name="pvc-bound", storage_class="local-ssd",
+                          bound_node="n1"))
+    cache.add_claim(Claim(name="pvc-free", storage_class="local-ssd"))
+    cache.add_claim(Claim(name="pvc-weird", storage_class="no-such-sc"))
+    for i in range(6):
+        sim.add_node(_node(
+            f"n{i}", cpu_milli=16000, mem=64 * GI,
+            labels={"zone": f"z{i % 3}",
+                    "disk": "ssd" if i % 2 else "hdd"},
+            taints=(frozenset({"dedicated=batch:NoSchedule"})
+                    if i == 5 else frozenset()),
+            unschedulable=(i == 4),
+        ))
+    g1 = PodGroup(name="web", queue="default", min_member=2)
+    sim.submit(g1, [
+        _pod("web-0", cpu=1000, mem=GI, labels={"app": "web"},
+             selector={"disk": "ssd"}, ports=frozenset({8080}),
+             preferences={"zone=z0": 2.0},
+             pod_prefs={"zone:app=web": 3.0, "app=web": 1.0}),
+        _pod("web-1", cpu=1000, mem=GI, labels={"app": "web"},
+             affinity=frozenset({"zone:app=web"}),
+             tolerations=frozenset({"dedicated=batch:NoSchedule"})),
+    ])
+    g2 = PodGroup(name="db", queue="gold", min_member=1, priority=100)
+    sim.submit(g2, [
+        _pod("db-0", cpu=2000, mem=4 * GI, labels={"app": "db"},
+             anti_affinity=frozenset({"zone:app=db", "app=web"}),
+             claims=frozenset({"pvc-free"}), namespace="team-a",
+             priority=100),
+        _pod("db-1", cpu=500, mem=GI, claims=frozenset({"pvc-bound"})),
+        _pod("db-2", cpu=500, mem=GI,
+             claims=frozenset({"pvc-weird", "pvc-missing"})),
+    ])
+    return cache, sim
+
+
+@pytest.mark.parametrize("min_buckets", [None, {"T": 64, "N": 32}])
+def test_vectorized_equals_loop_rich_world(min_buckets):
+    cache, _sim = _rich_world()
+    host = cache.snapshot()
+    sv, mv, _ = pack_snapshot_full(host, min_buckets=min_buckets,
+                                   device=False)
+    sl, ml, _ = pack_snapshot_loop(host, min_buckets=min_buckets,
+                                   device=False)
+    _assert_same(sv, sl, mv, ml)
+
+
+def test_vectorized_equals_loop_all_configs():
+    from kube_batch_tpu.models.workloads import build_config
+
+    for n in (1, 2, 3):
+        cache, _sim = build_config(n)
+        host = cache.snapshot()
+        sv, mv, _ = pack_snapshot_full(host, device=False)
+        sl, ml, _ = pack_snapshot_loop(host, device=False)
+        _assert_same(sv, sl, mv, ml, label=f"config{n}:")
+
+
+def test_warm_rebuild_equals_cold():
+    """A rebuild fed the previous pack's internals (block cache warm)
+    must produce the same bytes as a cold pack — through node churn
+    (invalidating node geometry), pod add/delete (invalidating one
+    job's block), and a status flip (invalidating nothing).  Shared
+    snapshots throughout, mirroring the IncrementalPacker's discipline
+    (blocks cache live Pod references)."""
+    from kube_batch_tpu.api.types import TaskStatus
+
+    cache, sim = _rich_world()
+    with cache.lock():
+        _, _, ints = pack_snapshot_full(
+            cache.snapshot(shared=True), device=False)
+
+    # status flip: blocks stay warm, mutable columns re-read
+    with cache.lock():
+        uid = next(iter(cache._pods))
+    cache.update_pod_status(uid, TaskStatus.BOUND, node="n0")
+    # membership change in one job
+    late = _pod("web-late", cpu=250, mem=GI, labels={"app": "web"})
+    late.group = "web"
+    cache.add_pod(late)
+    # node-geometry change
+    sim.add_node(_node("n9", cpu_milli=8000, mem=32 * GI,
+                       labels={"zone": "z9"}))
+
+    with cache.lock():
+        host2 = cache.snapshot(shared=True)
+        s_warm, m_warm, ints2 = pack_snapshot_full(
+            host2, device=False, prev=ints,
+            invalid_jobs=frozenset({"web"}))
+        s_cold, m_cold, _ = pack_snapshot_full(host2, device=False)
+    _assert_same(s_warm, s_cold, m_warm, m_cold, label="warm-vs-cold:")
+    # and both match the loop baseline
+    s_loop, m_loop, _ = pack_snapshot_loop(host2, device=False)
+    _assert_same(s_warm, s_loop, m_warm, m_loop, label="warm-vs-loop:")
+    # unchanged jobs reused their blocks; the touched one did not
+    assert ints2.job_blocks["db"] is ints.job_blocks["db"]
+    assert ints2.job_blocks["web"] is not ints.job_blocks["web"]
+
+
+def test_copied_snapshot_invalidates_blocks():
+    """Feeding prev internals across COPIED (shared=False) snapshots
+    must rebuild every block — the pod-identity spot check: copied
+    snapshots replace every Pod object, and reusing a block would
+    read mutable status/node through stale copies."""
+    from kube_batch_tpu.api.types import TaskStatus
+
+    cache, _sim = _rich_world()
+    host = cache.snapshot()  # copies
+    _, _, ints = pack_snapshot_full(host, device=False)
+    with cache.lock():
+        uid = next(iter(cache._pods))
+    cache.update_pod_status(uid, TaskStatus.BOUND, node="n0")
+    host2 = cache.snapshot()  # fresh copies carrying the new status
+    s_warm, m_warm, ints2 = pack_snapshot_full(
+        host2, device=False, prev=ints)
+    s_cold, m_cold, _ = pack_snapshot_full(host2, device=False)
+    _assert_same(s_warm, s_cold, m_warm, m_cold)
+    for jname, block in ints2.job_blocks.items():
+        assert block is not ints.job_blocks.get(jname), jname
+
+
+def test_block_cache_revalidates_membership_without_hint():
+    """Even WITHOUT an invalid_jobs hint, a job whose task-uid set
+    changed must rebuild its block (the membership check is the
+    belt; the journal hint is the braces)."""
+    cache, _sim = _rich_world()
+    host = cache.snapshot()
+    _, _, ints = pack_snapshot_full(host, device=False)
+    late = _pod("db-late", cpu=250, mem=GI)
+    late.group = "db"
+    cache.add_pod(late)
+    host2 = cache.snapshot()
+    s_warm, m_warm, _ = pack_snapshot_full(host2, device=False,
+                                           prev=ints)
+    s_cold, m_cold, _ = pack_snapshot_full(host2, device=False)
+    _assert_same(s_warm, s_cold, m_warm, m_cold)
+    assert "db-late" in {p.name for p in m_warm.task_pods}
+
+
+def test_meta_replace_rows_matches_fresh_pack():
+    """`SnapshotMeta.replace_rows` must rebuild a meta equal to a fresh
+    full pack's meta field-by-field — including any field it doesn't
+    name explicitly (dataclasses.replace carries the rest, so a future
+    SnapshotMeta field can't be silently dropped)."""
+    cache, _sim = _rich_world()
+    host = cache.snapshot()
+    _, meta, ints = pack_snapshot_full(host, device=False)
+    rebuilt = meta.replace_rows(ints)
+    fresh_snap, fresh_meta, _ = pack_snapshot_full(host, device=False)
+    for f in dataclasses.fields(fresh_meta):
+        assert getattr(rebuilt, f.name) == getattr(fresh_meta, f.name), (
+            f"replace_rows dropped/diverged meta field {f.name}"
+        )
+    # and it tracks row mutations: simulate a swap-compact
+    ints.task_uids[0], ints.task_uids[-1] = (
+        ints.task_uids[-1], ints.task_uids[0])
+    ints.task_pods[0], ints.task_pods[-1] = (
+        ints.task_pods[-1], ints.task_pods[0])
+    moved = meta.replace_rows(ints)
+    assert moved.task_uids == tuple(ints.task_uids)
+    assert moved.task_pods == tuple(ints.task_pods)
+    assert moved.label_vocab == meta.label_vocab
+
+
+def test_same_uid_respawn_through_incremental_invalidates_block():
+    """Review-confirmed regression: delete a pod and re-add a pod with
+    the SAME uid but a different spec in one journal window (absorbed
+    by an incremental pack, which drains the journal), then force a
+    full rebuild — the rebuild must NOT revalidate the job's cached
+    column block against the ghost uid-set and serve the dead pod's
+    request vector."""
+    from kube_batch_tpu.api.types import TaskStatus
+    from kube_batch_tpu.cache.incremental import IncrementalPacker
+    from kube_batch_tpu.models.workloads import _node, _pod
+    from kube_batch_tpu.sim.simulator import make_world
+    from kube_batch_tpu.models.workloads import DEFAULT_SPEC, GI
+
+    cache, sim = make_world(DEFAULT_SPEC)
+    sim.add_node(_node("n0", cpu_milli=64000, mem=256 * GI))
+    g = PodGroup(name="pg", queue="default", min_member=1)
+    pods = [_pod(f"p{i}", cpu=1000, mem=GI) for i in range(3)]
+    sim.submit(g, pods)
+    packer = IncrementalPacker(cache)
+    packer.check = True
+    packer.pack()
+
+    with cache.lock():
+        victim = cache._pods[list(cache._pods)[1]]
+    cache.delete_pod(victim.uid)
+    respawn = _pod("p-respawn", cpu=7777, mem=2 * GI)
+    respawn.uid = victim.uid  # same uid, different spec
+    respawn.group = "pg"
+    cache.add_pod(respawn)
+    packer.pack()  # incremental absorbs delete+re-add, drains journal
+    assert packer.last_mode.startswith("incremental:")
+
+    sim.add_node(_node("n9", cpu_milli=8000, mem=32 * GI))  # force full
+    snap, meta = packer.pack()
+    assert packer.last_mode == "full:node-added"
+    row = meta.task_uids.index(victim.uid)
+    req = np.asarray(snap.task_req)[row]
+    assert req[0] == 7777, (
+        f"full rebuild served the dead pod's request vector: {req}"
+    )
