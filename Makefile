@@ -74,6 +74,14 @@ run-example:
 # PodGroups, and re-admit it through canary-capped probation after the
 # heal — scripts/check_chaos_flaky.py asserts all of it plus same
 # seed ⇒ same hash across the two runs.
+# The restart runs are the DURABLE-STATE scenario
+# (doc/design/state-durability.md): the scheduler process crash-
+# restarts three times — mid-quarantine, mid-refusal and mid-breaker-
+# open — and every restart re-adopts the statestore journal:
+# scripts/check_chaos_restart.py asserts quarantine-survives-restart
+# (zero placements on pre-crash-cordoned nodes), refused-bucket-never-
+# recompiled, breaker-reopen-without-re-streak, journal compaction +
+# HA mirror exercised, and same seed ⇒ same hash across the two runs.
 # The fifth and sixth runs are the FAILOVER scenario
 # (doc/design/failover-fencing.md): a leader crash mid-commit, a
 # second elector instance taking over at a higher epoch, a zombie-
@@ -113,14 +121,28 @@ chaos:
 	    --quiet > /tmp/kb-chaos-flaky-2.json
 	$(PY) scripts/check_chaos_flaky.py /tmp/kb-chaos-flaky-1.json \
 	    /tmp/kb-chaos-flaky-2.json
+	JAX_PLATFORMS=cpu $(PY) -m kube_batch_tpu.chaos --seed 23 --ticks 26 \
+	    --scenario examples/chaos-restart.json --wire-commit pipelined \
+	    --quiet > /tmp/kb-chaos-restart-1.json
+	JAX_PLATFORMS=cpu $(PY) -m kube_batch_tpu.chaos --seed 23 --ticks 26 \
+	    --scenario examples/chaos-restart.json --wire-commit pipelined \
+	    --quiet > /tmp/kb-chaos-restart-2.json
+	$(PY) scripts/check_chaos_restart.py /tmp/kb-chaos-restart-1.json \
+	    /tmp/kb-chaos-restart-2.json
 
 profile:
 	$(PY) -m kube_batch_tpu --workload 2 --cycles 3 --schedule-period 0 \
 	    --listen-address "" --profile-dir /tmp/kube-batch-tpu-trace
 	@echo "trace in /tmp/kube-batch-tpu-trace (open with TensorBoard)"
 
+# The suite runs in two halves so the TIER-1 half's wall clock is a
+# measured, ENFORCED number (scripts/check_tier1_budget.py fails loudly
+# past 90% of the driver's 870 s timeout — slow-marker triage happens
+# here, not at PR time); the `slow` remainder runs separately, so total
+# coverage is unchanged.
 verify:
-	$(PY) -m pytest tests/ -q
+	$(PY) scripts/check_tier1_budget.py
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m slow
 	JAX_PLATFORMS=cpu $(PY) scripts/check_pack_microbench.py
 	$(PY) -c "import __graft_entry__ as g; g.entry()"
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \

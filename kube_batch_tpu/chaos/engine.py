@@ -111,6 +111,8 @@ _META_FAULT_FIELDS = (
     "leader_crash_at", "zombie_writes",
     "flaky_at", "flaky_ticks", "flaky_fail_pct", "flaky_flap_every",
     "flaky_drain_budget",
+    "crash_restart_at", "crash_restarts", "crash_restart_every",
+    "hbm_pin_at",
 )
 
 # -- node-health fault tuning (active only when FaultSpec.flaky_at is
@@ -128,6 +130,16 @@ HEALTH_PROBATION_CANARY = 2
 #: before the breaker trips and the rest fail fast, so the bound must
 #: cover a few serialized timeouts, not just the happy path.
 COMMIT_DRAIN_TIMEOUT = 60.0
+
+# -- crash-restart fault tuning (active only when
+#    FaultSpec.crash_restart_at is set) ---------------------------------
+#: Statestore compaction cadence in appended records: small, so the
+#: compaction + HA mirror fire INSIDE a ~30-tick scenario.
+STATESTORE_COMPACT_EVERY = 6
+#: --state-max-age-cycles for the driven scheduler's restore: large
+#: relative to the scenario, so in-scenario restores never stale-drop
+#: (tests/test_statestore.py pins the staleness decay itself).
+STATESTORE_MAX_AGE = 10_000
 
 
 @dataclasses.dataclass
@@ -164,6 +176,12 @@ class ChaosResult:
     #: supposed to exercise incremental packs but full-packed every
     #: cycle is visible here, and the pack-mode parity check reads it.
     pack: dict | None = None
+    #: Crash-restart observability (None unless the crash_restart
+    #: fault ran): per-restart restore records (pre/post quarantine
+    #: states, refusal pins, breaker state, adoption source, wire
+    #: writes during the restart window), the post-restart pin probe,
+    #: journal counters, and whether the HA mirror landed.
+    restart: dict | None = None
 
     def summary(self) -> dict:
         return {
@@ -181,6 +199,7 @@ class ChaosResult:
             "failover": self.failover,
             "health": self.health,
             "pack": self.pack,
+            "restart": self.restart,
         }
 
 
@@ -222,6 +241,7 @@ class ChaosEngine:
         wire_timeout: float | None = None,
         wire_commit: str | None = None,
         pack_mode: str | None = None,
+        state_dir: str | None = None,
     ) -> None:
         self.seed = seed
         self.ticks = ticks
@@ -306,52 +326,43 @@ class ChaosEngine:
         self._crash_epochs: tuple[int, int] | None = None  # (old, new)
         self._reconcile_summary: dict | None = None
         self._forged: dict | None = None     # forged BINDING census
+        # -- crash-restart state (statestore fault) --------------------
+        # A restart scenario journals the driven scheduler's
+        # operational state to a real StateStore in `state_dir`
+        # (auto-created temp dir unless the caller pins one — the
+        # cold-start/corrupt-journal parity tests do) and re-adopts it
+        # on every crash-restart.
+        self.state_dir = state_dir
+        #: Auto-created (and teardown-removed) lazily in
+        #: _build_statestore — an engine constructed but never run
+        #: must not leave an empty temp dir behind.
+        self._state_dir_owned = False
+        self.statestore = None
+        self._restarts: list[dict] = []
+        #: Persistent HBM-pin fault state: the ceiling settled between
+        #: the serving and the refused projection (survives restarts
+        #: via engine config, like the CLI's --hbm-ceiling-mb), and
+        #: the canonical shapes of the durably-pinned program.
+        self._pinned_ceiling: int | None = None
+        self._pinned_shapes: tuple | None = None
+        self._pin_probe: dict | None = None
         # -- node-health state (flaky-node fault) ----------------------
         # The flaky fault drives the scheduler with a NodeHealthLedger
         # (clocked in cycles == ticks, deterministic) AND a Guardrails
         # instance: the breaker must be LIVE so the run asserts that a
-        # flaky node's answered refusals never trip it.
-        self.health = None
+        # flaky node's answered refusals never trip it.  Restart
+        # scenarios build both too — they are the state under test.
         self._flaky_victim: str | None = None
         self._health_by_tick: dict[int, dict] = {}
         self._cordoned_placements = 0
         self._canary_overruns = 0
-        if self.faults.health_faults:
-            from kube_batch_tpu.health import (
-                NodeHealthConfig,
-                NodeHealthLedger,
-            )
-
-            self.health = NodeHealthLedger(NodeHealthConfig(
-                quarantine_threshold=HEALTH_QUARANTINE_THRESHOLD,
-                probation_ticks=HEALTH_PROBATION_TICKS,
-                probation_canary=HEALTH_PROBATION_CANARY,
-                drain_cordoned=self.faults.flaky_drain_budget > 0,
-                drain_budget=self.faults.flaky_drain_budget,
-            ))
+        self.health = self._build_health()
         # Guardrail wiring: any guardrail fault in the spec makes the
         # driven scheduler carry a Guardrails instance, its breaker
         # clocked off the TICK counter (reset windows count ticks, not
         # wall seconds — same-seed runs stay reproducible).  Health
-        # faults wire one too (see above).
-        self.guardrails = None
-        if self.faults.guardrail_faults or self.faults.health_faults:
-            from kube_batch_tpu.guardrails import (
-                GuardrailConfig,
-                Guardrails,
-            )
-
-            self.guardrails = Guardrails(GuardrailConfig(
-                hbm_ceiling_mb=None,
-                watchdog_overruns=GUARDRAIL_ENGAGE_AFTER,
-                watchdog_recovery=GUARDRAIL_RECOVER_AFTER,
-                watchdog_period=GUARDRAIL_WATCHDOG_PERIOD,
-                breaker_failures=GUARDRAIL_TRIP_AFTER,
-                breaker_reset_s=GUARDRAIL_RESET_TICKS,
-                backoff_base_s=0.01,
-                backoff_cap_s=0.04,
-                backoff_attempts=2,
-            ))
+        # and restart faults wire one too (see above).
+        self.guardrails = self._build_guardrails()
         if wire_timeout is None:
             wire_timeout = (
                 BLACKHOLE_WIRE_TIMEOUT if self.faults.blackhole_at
@@ -375,6 +386,119 @@ class ChaosEngine:
         self._decisions: list[dict] = []
 
     # -- wiring ---------------------------------------------------------
+    def _build_health(self):
+        """A fresh NodeHealthLedger for the driven scheduler (or None)
+        — called at boot AND by every crash-restart: the ledger object
+        dies with the 'process'; the statestore is what carries its
+        memory across."""
+        if not (self.faults.health_faults or self.faults.restart_faults):
+            return None
+        from kube_batch_tpu.health import NodeHealthConfig, NodeHealthLedger
+
+        return NodeHealthLedger(NodeHealthConfig(
+            quarantine_threshold=HEALTH_QUARANTINE_THRESHOLD,
+            probation_ticks=HEALTH_PROBATION_TICKS,
+            probation_canary=HEALTH_PROBATION_CANARY,
+            drain_cordoned=self.faults.flaky_drain_budget > 0,
+            drain_budget=self.faults.flaky_drain_budget,
+        ))
+
+    def _build_guardrails(self):
+        """A fresh Guardrails instance (or None) — same rebuild-at-
+        restart contract as `_build_health`.  The hbm-pin fault's
+        settled ceiling re-applies like the CLI's --hbm-ceiling-mb
+        flag would on a real restart (configuration survives; the PIN
+        must come back from the statestore)."""
+        if not (
+            self.faults.guardrail_faults
+            or self.faults.health_faults
+            or self.faults.restart_faults
+        ):
+            return None
+        from kube_batch_tpu.guardrails import GuardrailConfig, Guardrails
+
+        rails = Guardrails(GuardrailConfig(
+            hbm_ceiling_mb=None,
+            watchdog_overruns=GUARDRAIL_ENGAGE_AFTER,
+            watchdog_recovery=GUARDRAIL_RECOVER_AFTER,
+            watchdog_period=GUARDRAIL_WATCHDOG_PERIOD,
+            breaker_failures=GUARDRAIL_TRIP_AFTER,
+            breaker_reset_s=GUARDRAIL_RESET_TICKS,
+            backoff_base_s=0.01,
+            backoff_cap_s=0.04,
+            backoff_attempts=2,
+        ))
+        if self._pinned_ceiling is not None:
+            rails.hbm.ceiling_bytes = int(self._pinned_ceiling)
+        return rails
+
+    def _build_commit(self) -> None:
+        """The pipelined commit dimension's pipeline (no-op in sync
+        mode) — at boot and after every crash-restart (a new process
+        gets a new pipeline; the old one died with its workers)."""
+        if self.wire_commit != "pipelined":
+            return
+        from kube_batch_tpu.framework.commit import (
+            DEFAULT_WORKERS,
+            CommitPipeline,
+        )
+
+        on_flush = None
+        if self.guardrails is not None:
+            on_flush = lambda s: self.guardrails.observe_flush(  # noqa: E731
+                s, cache=self.cache,
+            )
+        workers = DEFAULT_WORKERS
+        if self.faults.slow_at:
+            # A slow-but-ALIVE backend serializes its delayed
+            # responses, so N concurrent sends see up to N×delay of
+            # queueing — clamp concurrency inside the wire timeout
+            # (doc/design/pipelined-commit.md · sizing).
+            workers = min(DEFAULT_WORKERS, max(1, int(
+                (self.wire_timeout * 0.5)
+                / max(self.faults.slow_response_s, 1e-6)
+            )))
+        self.commit = CommitPipeline(
+            cache=self.cache, on_flush=on_flush, workers=workers,
+        )
+        self.cache.commit = self.commit
+        if self.guardrails is not None:
+            self.guardrails.attach_commit(self.commit)
+
+    def _build_statestore(self):
+        """Open (or re-open, post-restart) the journal in state_dir —
+        the same path a new process on the same host would.  A restart
+        scenario with no caller-pinned dir gets a temp one here,
+        removed at teardown."""
+        if self.state_dir is None:
+            if not self.faults.restart_faults:
+                return None
+            self.state_dir = tempfile.mkdtemp(prefix="kb-chaos-state-")
+            self._state_dir_owned = True
+        from kube_batch_tpu.statestore import StateStore, journal_path
+
+        store = StateStore(
+            journal_path(self.state_dir),
+            compact_every=STATESTORE_COMPACT_EVERY,
+        )
+        store.mirror_sink = self._mirror_state
+        return store
+
+    def _mirror_state(self, payload: dict) -> None:
+        """The statestore's HA mirror through the live write seam
+        (breaker-guarded: fails fast while open).  Best-effort — the
+        journal already holds the truth.  putStateSnapshot is not a
+        hashed wire-log op, so the mirror is decision-invisible."""
+        seam = self.cache.binder if self.cache is not None else None
+        put = getattr(seam, "put_state_snapshot", None)
+        if not callable(put):
+            return
+        try:
+            put(payload)
+        except Exception as exc:  # noqa: BLE001 — re-mirrored at the
+            # next compaction
+            log.debug("chaos state mirror failed: %s", exc)
+
     def _connect(self, replay: bool) -> None:
         """One scheduler session over a fresh socketpair; the cluster
         side serves requests on its reader thread."""
@@ -482,6 +606,12 @@ class ChaosEngine:
             self._leader_crash(detail)
             self.fault_counts[kind] += 1
             metrics.chaos_faults_injected.inc(kind)
+        elif kind == "crash-restart":
+            self._crash_restart(detail)
+            self.fault_counts[kind] += 1
+            metrics.chaos_faults_injected.inc(kind)
+        elif kind == "hbm-pin":
+            self._fire_hbm_pin(detail)
         elif kind == "flaky-node":
             # Victim resolved at fire time from the SORTED live node
             # set — deterministic, like the vanish target.
@@ -706,6 +836,280 @@ class ChaosEngine:
                     "zombie adapter never stopped after its sever"
                 )
 
+    # -- crash-restart + durable-state adoption -------------------------
+    def _crash_restart(self, detail: dict) -> None:
+        """Kill and restart the scheduler PROCESS mid-quarantine /
+        mid-refusal / mid-outage, reusing the leader-crash restart
+        machinery end to end through the real wire stack:
+
+        1. capture pre-crash truth (quarantine states, refusal pins,
+           breaker state) for the survival invariants;
+        2. the crash: lease expires un-released, the journal gets NO
+           goodbye write (only end-of-cycle appends exist), the dead
+           incarnation's connection is severed, and every in-memory
+           world object — ledger, guardrails, commit pipeline,
+           Scheduler, StateStore handle — is thrown away;
+        3. the restart: fresh elector identity on a fresh connection
+           wins a strictly higher epoch, fresh subsystem objects are
+           built from CONFIG only, and the statestore journal is
+           re-opened and ADOPTED (peer mirror as fallback) — the
+           identical `adopt_state` path the CLI runs;
+        4. the PR-4 takeover reconciliation relists the world and the
+           scheduler re-arms.
+
+        The survival contract this exercises: a pre-crash-cordoned
+        node stays masked (zero post-restart placements), a refused
+        bucket is never recompiled, and an open breaker re-opens
+        WITHOUT a fresh failure streak against the same dead wire."""
+        from kube_batch_tpu.client.failover import reconcile_takeover
+        from kube_batch_tpu.guardrails import CircuitBreaker
+        from kube_batch_tpu.statestore import adopt_state
+
+        old_guard = self.guardrails
+        old_health = self.health
+        old_commit = self.commit
+        old_store = self.statestore
+        old_sched = self.scheduler
+        old_sock = self._sched_sock
+        old_adapter = self.adapter
+        # (1) pre-crash truth.
+        pre_states = (
+            dict(old_health.sample()["states"])
+            if old_health is not None else {}
+        )
+        pre_cordoned = sorted(
+            n for n, s in pre_states.items() if s == "cordoned"
+        )
+        pre_pins = (
+            sorted(map(str, old_sched.refusal_pin_shapes()))
+            if old_sched is not None else []
+        )
+        pre_breaker = (
+            old_guard.breaker_state() if old_guard is not None
+            else CircuitBreaker.CLOSED
+        )
+        with self.cluster._lock:
+            writes_before = sum(
+                self.cluster.write_requests_by_tick.values()
+            )
+        # (2) the crash.
+        self.cluster.expire_lease()
+        self._have_lease = False
+        if old_commit is not None:
+            # The per-tick barrier drained it last tick; stopping the
+            # workers keeps the corpse from flushing post-mortem.
+            old_commit.close(timeout=5.0)
+            self.commit = None
+            self.cache.commit = None
+        if (
+            old_guard is not None
+            and old_guard.breaker is not None
+            and old_guard.breaker.state != CircuitBreaker.CLOSED
+        ):
+            # The dead breaker's quiesce hold dies with the process (a
+            # real restart starts the cache's resync depth at zero);
+            # the RESTORED breaker re-arms its own hold below.
+            self.cache.end_resync()
+        if old_store is not None and old_store._f is not None \
+                and not old_store._f.closed:
+            # The kernel closes a dead process's fds — raw close, no
+            # final compaction, no fsync: a crash gets no goodbye.
+            old_store._f.close()
+        try:
+            old_sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        deadline = time.monotonic() + self.quiesce_timeout
+        while not old_adapter.stopped.wait(0.01):
+            if time.monotonic() > deadline:
+                raise ChaosEngineError(
+                    "crashed incarnation's adapter never stopped"
+                )
+        # (3) the restart.
+        self._incarnation += 1
+        self._holder = f"{LEASE_HOLDER}-r{self._incarnation}"
+        self.backend = None
+        self._connect(replay=False)
+        new_epoch = self.backend.acquire_lease(self._holder, LEASE_TTL)
+        self.backend.set_epoch(new_epoch)
+        self._epoch = new_epoch
+        self._have_lease = True
+        self.health = self._build_health()
+        self.guardrails = self._build_guardrails()
+        seam = self.backend
+        if self.guardrails is not None:
+            seam = self.guardrails.guard_backend(
+                self.backend, self.cache, name="chaos-wire",
+                clock=lambda: float(self.cluster.tick_now),
+            )
+        self.cache.binder = seam
+        self.cache.evictor = seam
+        self.cache.status_updater = seam
+        self.cache.attach_health(self.health)
+        self._build_commit()
+        scheduler = Scheduler(
+            self.cache, conf_path=self.conf_path, schedule_period=0.0,
+            guardrails=self.guardrails, health=self.health,
+            pack_mode=self.pack_mode,
+        )
+        self.scheduler = scheduler
+        self.statestore = self._build_statestore()
+        adopted = None
+        if self.statestore is not None:
+            scheduler.statestore = self.statestore
+            adopted = adopt_state(
+                self.statestore, backend=self.backend,
+                health=self.health, guardrails=self.guardrails,
+                scheduler=scheduler, max_age_cycles=STATESTORE_MAX_AGE,
+            )
+        # (4) takeover reconciliation — the shared PR-4 helper.
+        summary = reconcile_takeover(
+            self.cache, self.backend, self.adapter,
+            commit=self.commit, epoch=new_epoch,
+        )
+        scheduler.on_takeover()
+        with self.cluster._lock:
+            writes_after = sum(
+                self.cluster.write_requests_by_tick.values()
+            )
+        post_states = (
+            dict(self.health.sample()["states"])
+            if self.health is not None else {}
+        )
+        rec = {
+            "tick": self.cluster.tick_now,
+            "epoch": int(new_epoch or 0),
+            "source": adopted.get("source") if adopted else None,
+            "pre_states": pre_states,
+            "post_states": post_states,
+            "pre_cordoned": pre_cordoned,
+            "post_cordoned": sorted(
+                n for n, s in post_states.items() if s == "cordoned"
+            ),
+            "pins_pre": pre_pins,
+            "pins_post": sorted(
+                map(str, scheduler.refusal_pin_shapes())
+            ),
+            "breaker_pre": pre_breaker,
+            "breaker_post": (
+                self.guardrails.breaker_state()
+                if self.guardrails is not None
+                else CircuitBreaker.CLOSED
+            ),
+            "wire_writes_during_restart": writes_after - writes_before,
+            "reconcile": summary,
+        }
+        self._restarts.append(rec)
+        detail.update({
+            k: rec[k] for k in (
+                "epoch", "source", "pre_cordoned", "post_cordoned",
+                "breaker_pre", "breaker_post",
+            )
+        })
+        # Collect the corpse's sockets list entry is already handled
+        # by _connect's bookkeeping; the recovery is observable.
+        self.recovery_counts["crash-restart"] += 1
+        metrics.chaos_recoveries.inc("crash-restart")
+
+    def _fire_hbm_pin(self, detail: dict) -> None:
+        """Establish (first firing) or probe (post-restart firing) a
+        PERSISTENT HBM refusal pin.
+
+        Establish: compile one next-bucket program through the real
+        `warm_grown` compile-then-admit path under a 1-byte ceiling
+        (refused + pinned), then settle the ceiling midway between the
+        SERVING program's projection and the refused one — the pin
+        stays valid against the live ceiling, which is the state a
+        crash must carry across.
+
+        Probe: after the last restart, `warm_grown` for the same
+        growth must answer False from the RESTORED pin — without
+        compiling (a recompile would show up as a fresh refusal count
+        or a compiled executable at the pinned shapes)."""
+        from kube_batch_tpu.guardrails.hbm import projected_device_bytes
+
+        sched, rails = self.scheduler, self.guardrails
+        if sched is None or rails is None or sched._last_snap is None:
+            detail["skipped"] = True
+            return
+        # Grow the NODE axis: the restart scenarios run zero node
+        # churn, so the workload can never legitimately cross into the
+        # pinned bucket — the settled ceiling below refuses exactly
+        # one program (the grown one) and admits every serving shape
+        # the scenario's task/job churn produces.
+        grow = {"N": int(sched._last_snap.num_nodes) + 1}
+        if self._pinned_shapes is not None:
+            # The probe's strong form re-runs the EXACT pinned growth:
+            # warm_grown must answer False from the restored pin with
+            # ZERO compile work.  Possible only while the task/job
+            # buckets still match the establish-time snapshot; under
+            # bucket drift the probe falls back to presence +
+            # never-compiled (still the refused-bucket-never-
+            # recompiled contract, minus the live warm_grown answer).
+            from kube_batch_tpu.cache.packer import grown_avals
+
+            gsnap = grown_avals(sched._last_snap, grow)
+            probe_shapes = sched._pin_shapes(
+                sched._shape_key(sched._cycle, gsnap)[1:]
+            )
+            drifted = probe_shapes != self._pinned_shapes
+            refusals_before = rails.hbm.refusals
+            verdict = None if drifted else sched.warm_grown(grow)
+            self._pin_probe = {
+                "tick": self.cluster.tick_now,
+                "shape_drifted": drifted,
+                "verdict": verdict,
+                "pinned": self._pinned_shapes in
+                sched.refusal_pin_shapes(),
+                "recompiled_refusals":
+                    rails.hbm.refusals - refusals_before,
+                "compiled_refused_shape": any(
+                    sched._pin_shapes(k[1:]) == self._pinned_shapes
+                    for k in sched._compiled_shapes
+                ),
+            }
+            detail["probe"] = self._pin_probe
+            return
+        ceiling = rails.hbm
+        prev = ceiling.ceiling_bytes
+        ceiling.ceiling_bytes = 1
+        try:
+            verdict = sched.warm_grown(grow)
+        finally:
+            ceiling.ceiling_bytes = prev
+        if verdict is not False:
+            detail["skipped"] = True
+            return
+        pins = sched.export_refusal_pins()
+        pin = max(pins, key=lambda p: p["projected"])
+        projected = int(pin["projected"])
+        serving = 0
+        for exe in sched._compiled_shapes.values():
+            b = projected_device_bytes(exe)
+            if b:
+                serving = max(serving, int(b))
+        if serving >= projected or projected < 2:
+            # No gap to settle a ceiling into on this backend: the pin
+            # cannot stay persistently valid — skip (the scenario
+            # check script requires the establish to have fired).
+            detail["skipped"] = True
+            return
+        # Just below the refused projection: maximum admission headroom
+        # for the serving shapes' churn, refusal of exactly the pinned
+        # bucket.
+        settled = projected - 1
+        ceiling.ceiling_bytes = settled
+        self._pinned_ceiling = settled
+        self._pinned_shapes = sched._pin_shapes(
+            (n, tuple(s)) for n, s in pin["shapes"]
+        )
+        detail["pinned"] = {
+            "projected": projected, "serving": serving,
+            "ceiling": settled,
+        }
+        self.fault_counts["hbm-pin"] += 1
+        metrics.chaos_faults_injected.inc("hbm-pin")
+
     def _maybe_force_gap(self) -> None:
         """A watch-gap fault needs the missed tail to be UNSERVABLE:
         guarantee the cluster moved past the adapter's RV (a benign
@@ -856,45 +1260,13 @@ class ChaosEngine:
         self.cache.binder = seam
         self.cache.evictor = seam
         self.cache.status_updater = seam
-        if self.wire_commit == "pipelined":
-            # The pipelined dimension: binds/status writes flush on the
-            # commit pipeline between run_once and this tick's drain
-            # barrier — the overlap is real (concurrent flush against
-            # the live wire stack), the barrier keeps same-seed ⇒
-            # same-hash (the decision log is drained per tick with the
-            # pipeline empty, and the logged binds ARE the commit
-            # acks).
-            from kube_batch_tpu.framework.commit import (
-                DEFAULT_WORKERS,
-                CommitPipeline,
-            )
-
-            on_flush = None
-            if self.guardrails is not None:
-                on_flush = lambda s: self.guardrails.observe_flush(  # noqa: E731
-                    s, cache=self.cache,
-                )
-            workers = DEFAULT_WORKERS
-            if self.faults.slow_at:
-                # A slow-but-ALIVE backend serializes its delayed
-                # responses, so N concurrent sends see up to N×delay
-                # of queueing before their own answer — full flush
-                # concurrency would turn the slow window into timeout
-                # storms, and a timed-out-but-server-committed bind
-                # retried through resync is the double-bind ambiguity.
-                # Clamp concurrency so worst-case queueing stays well
-                # inside the wire timeout (production guidance:
-                # doc/design/pipelined-commit.md · sizing).
-                workers = min(DEFAULT_WORKERS, max(1, int(
-                    (self.wire_timeout * 0.5)
-                    / max(self.faults.slow_response_s, 1e-6)
-                )))
-            self.commit = CommitPipeline(
-                cache=self.cache, on_flush=on_flush, workers=workers,
-            )
-            self.cache.commit = self.commit
-            if self.guardrails is not None:
-                self.guardrails.attach_commit(self.commit)
+        # The pipelined dimension: binds/status writes flush on the
+        # commit pipeline between run_once and each tick's drain
+        # barrier — the overlap is real (concurrent flush against the
+        # live wire stack), the barrier keeps same-seed ⇒ same-hash
+        # (the decision log is drained per tick with the pipeline
+        # empty, and the logged binds ARE the commit acks).
+        self._build_commit()
         if not self.adapter.wait_for_sync(self.quiesce_timeout):
             raise ChaosEngineError("initial LIST replay never synced")
         scheduler = Scheduler(
@@ -903,6 +1275,20 @@ class ChaosEngine:
             pack_mode=self.pack_mode,
         )
         self.scheduler = scheduler
+        # Durable operational memory: journal end-of-cycle state and
+        # adopt whatever a pre-seeded state_dir holds (a cold dir and
+        # a corrupt journal must behave exactly like no statestore at
+        # all — the parity acceptance criterion).
+        self.statestore = self._build_statestore()
+        if self.statestore is not None:
+            from kube_batch_tpu.statestore import adopt_state
+
+            scheduler.statestore = self.statestore
+            adopt_state(
+                self.statestore, backend=self.backend,
+                health=self.health, guardrails=self.guardrails,
+                scheduler=scheduler, max_age_cycles=STATESTORE_MAX_AGE,
+            )
         checker = InvariantChecker(self.cluster)
         metrics.chaos_convergence_ticks.set(-1.0)
 
@@ -934,7 +1320,11 @@ class ChaosEngine:
                 rec["reconnect"] = self._reconnect()
                 self._quiesce()
             if lead:
-                scheduler.run_once()
+                # Via self: a crash-restart fault replaces the
+                # Scheduler (and its ledger/guardrails/statestore)
+                # mid-run — the loop must drive the live incarnation,
+                # not the closure-captured corpse.
+                self.scheduler.run_once()
                 if self.commit is not None:
                     # Tick barrier: every commit enqueued this cycle
                     # must land (or fail into resync) before the
@@ -948,6 +1338,10 @@ class ChaosEngine:
                             "commit pipeline never drained at the "
                             f"tick barrier (depth {self.commit.depth})"
                         )
+                # Re-journal AFTER the barrier: a breaker trip landing
+                # during the flush drain postdates run_once's own
+                # append, and a crash fault next tick must find it.
+                self.scheduler.journal_state()
             else:
                 rec["stood-down"] = True
             if self.corrupt_tick is not None and t == self.corrupt_tick:
@@ -1028,6 +1422,8 @@ class ChaosEngine:
                     violations = self._check_failover(ticks_run)
                 if not violations and self.faults.health_faults:
                     violations = self._check_flaky(ticks_run)
+                if not violations and self.faults.restart_faults:
+                    violations = self._check_restart(ticks_run)
         finally:
             self._teardown()
 
@@ -1075,6 +1471,7 @@ class ChaosEngine:
             failover=self._failover_summary(),
             health=self._health_summary(),
             pack=self._pack_summary(),
+            restart=self._restart_summary(),
         )
 
     def _pack_summary(self) -> dict | None:
@@ -1093,13 +1490,23 @@ class ChaosEngine:
 
     # -- guardrail invariants ------------------------------------------
     def _rails_recovered(self) -> bool:
-        """Full service restored: ladder at rung 0, breaker not open."""
+        """Full service restored: breaker not open, and — only when
+        the slow fault actually exercises the ladder — rung 0.  The
+        rung is WALL-clocked (a cold process's compile spikes overrun
+        the 50 ms reference period; a warm one's don't), so gating
+        convergence on it in scenarios that never inject slowness
+        would make the drain length — and with it the drain ticks'
+        pod-gone log entries, hence the trace hash — depend on compile
+        cache warmth instead of the seed."""
         if self.guardrails is None:
             return True
         from kube_batch_tpu.guardrails import CircuitBreaker
 
+        rung_recovered = (
+            self.guardrails.rung == 0 if self.faults.slow_at else True
+        )
         return (
-            self.guardrails.rung == 0
+            rung_recovered
             and self.guardrails.breaker_state() != CircuitBreaker.OPEN
         )
 
@@ -1360,7 +1767,11 @@ class ChaosEngine:
                 "health ledger never cordoned it",
             ))
         breaker = self.guardrails.breaker if self.guardrails else None
-        if breaker is not None and breaker.opened_count:
+        if breaker is not None and breaker.opened_count and \
+                not self.faults.blackhole_at:
+            # With a blackhole window ALSO configured (the restart
+            # scenario), the breaker legitimately trips on the dead
+            # wire; only a flaky-only run can assert it never opened.
             out.append(Violation(
                 "flaky-tripped-breaker", tick,
                 "the wire breaker tripped during the flaky window — "
@@ -1393,6 +1804,128 @@ class ChaosEngine:
                 if e["op"] == "evict"
                 and e.get("reason") == "drain-cordoned"
             ),
+        }
+
+    # -- crash-restart invariants --------------------------------------
+    def _check_restart(self, tick: int) -> list[Violation]:
+        """Post-run assertions for the crash-restart scenario — the
+        operational memory actually SURVIVED each restart:
+
+        * **state-adopted** — every restart adopted durable state
+          (journal or peer mirror; a cold adoption means the journal
+          machinery silently wrote nothing);
+        * **quarantine-survives-restart** — every node cordoned at a
+          crash is cordoned after the restore (the per-tick
+          placement-on-cordoned check then enforces ZERO post-restart
+          placements on it);
+        * **refusal-pin-survives / refused-bucket-never-recompiled** —
+          the post-restart probe answered from the restored pin, with
+          no fresh refusal count and no compiled executable at the
+          pinned shapes;
+        * **breaker-reopen-without-re-streak** — a breaker OPEN at the
+          crash is OPEN after the restore, with zero write requests
+          reaching the wire in between (the restored streak, not a
+          fresh one, re-opened it)."""
+        out: list[Violation] = []
+        if self.fault_counts.get("crash-restart", 0) < 1:
+            out.append(Violation(
+                "crash-restart-not-fired", tick,
+                "crash_restart_at configured but no restart fired",
+            ))
+            return out
+        for r in self._restarts:
+            if r["source"] is None:
+                out.append(Violation(
+                    "state-not-adopted", r["tick"],
+                    "restart adopted no durable state — journal and "
+                    "peer mirror both came back empty",
+                ))
+            lost = [
+                n for n in r["pre_cordoned"]
+                if r["post_states"].get(n) != "cordoned"
+            ]
+            if lost:
+                out.append(Violation(
+                    "quarantine-lost-across-restart", r["tick"],
+                    f"node(s) {lost} were cordoned at the crash but "
+                    "not after the restore — the restarted scheduler "
+                    "re-trusts known-bad hardware",
+                ))
+            pins_lost = [
+                s for s in r["pins_pre"] if s not in r["pins_post"]
+            ]
+            if pins_lost:
+                out.append(Violation(
+                    "refusal-pin-lost-across-restart", r["tick"],
+                    f"HBM refusal pin(s) {pins_lost} did not survive "
+                    "the restart",
+                ))
+            if r["breaker_pre"] == "open":
+                if r["breaker_post"] != "open":
+                    out.append(Violation(
+                        "breaker-not-reopened", r["tick"],
+                        "breaker was OPEN at the crash but not after "
+                        "the restore — the restarted daemon would "
+                        "re-fan-out into the dead wire",
+                    ))
+                if r["wire_writes_during_restart"]:
+                    out.append(Violation(
+                        "breaker-reopen-re-streak", r["tick"],
+                        f"{r['wire_writes_during_restart']} write "
+                        "request(s) reached the wire between the "
+                        "crash and the breaker re-opening — the "
+                        "restored breaker must open WITHOUT a fresh "
+                        "failure streak",
+                    ))
+        if self.faults.hbm_pin_at:
+            if self.fault_counts.get("hbm-pin", 0) < 1:
+                out.append(Violation(
+                    "hbm-pin-not-established", tick,
+                    "hbm_pin_at configured but no persistent refusal "
+                    "pin was established (no projection gap on this "
+                    "backend?)",
+                ))
+            elif self._pin_probe is None:
+                out.append(Violation(
+                    "hbm-pin-probe-not-fired", tick,
+                    "the post-restart pin probe never ran",
+                ))
+            else:
+                p = self._pin_probe
+                if not p["pinned"] or (
+                    not p["shape_drifted"] and p["verdict"] is not False
+                ):
+                    out.append(Violation(
+                        "refusal-pin-lost-across-restart", p["tick"],
+                        f"post-restart probe found no valid pin: {p}",
+                    ))
+                if p["compiled_refused_shape"] or (
+                    not p["shape_drifted"] and p["recompiled_refusals"]
+                ):
+                    out.append(Violation(
+                        "refused-bucket-recompiled", p["tick"],
+                        "the refused bucket was RECOMPILED after the "
+                        f"restart instead of answering from the pin: "
+                        f"{p}",
+                    ))
+        return out
+
+    def _restart_summary(self) -> dict | None:
+        if not self.faults.restart_faults:
+            return None
+        store = self.statestore
+        return {
+            "restarts": self.fault_counts.get("crash-restart", 0),
+            "sequence": list(self._restarts),
+            "pin_probe": self._pin_probe,
+            "cordoned_placements": self._cordoned_placements,
+            "mirrored": self.cluster.state_snapshot is not None,
+            "journal": None if store is None else {
+                "appends": store.appends,
+                "compactions": store.compactions,
+                "corrupt_dropped": store.corrupt_dropped,
+                "cycle": store.cycle,
+            },
         }
 
     def _check_guardrails(self, tick: int) -> list[Violation]:
@@ -1479,6 +2012,19 @@ class ChaosEngine:
             }
 
     def _teardown(self) -> None:
+        if self.statestore is not None:
+            try:
+                # Final compaction + mirror (the wire may already be
+                # down — the sink swallows).
+                self.statestore.close()
+            except Exception:  # noqa: BLE001 — best effort on the way down
+                pass
+        if self._state_dir_owned and self.state_dir is not None:
+            # The engine mkdtemp'd this journal dir; repeated chaos/CI
+            # runs must not accumulate stale state dirs in /tmp.
+            import shutil
+
+            shutil.rmtree(self.state_dir, ignore_errors=True)
         if self.commit is not None:
             try:
                 self.commit.close(timeout=COMMIT_DRAIN_TIMEOUT)

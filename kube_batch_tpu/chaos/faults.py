@@ -111,6 +111,28 @@ class FaultSpec:
     #: the gang-atomic-drain invariant is exercised.  0 = drain off.
     flaky_drain_budget: int = 0
 
+    # -- crash-restart faults (kube_batch_tpu/statestore/) --------------
+    #: Tick the scheduler PROCESS crash-restarts: the lease expires
+    #: un-released, the in-memory world objects (ledger, guardrails,
+    #: scheduler, commit pipeline) are thrown away, and the engine
+    #: restarts as a fresh elector identity that wins a higher epoch,
+    #: re-ADOPTS the durable statestore journal (quarantine, refusal
+    #: pins, breaker/watchdog state), and runs the PR-4 takeover
+    #: reconciliation — mid-quarantine / mid-refusal / mid-outage.
+    #: 0 disables.
+    crash_restart_at: int = 0
+    #: How many crash-restarts (at crash_restart_at + k·every).
+    crash_restarts: int = 1
+    crash_restart_every: int = 8
+    #: Tick a PERSISTENT HBM refusal pin is established: one
+    #: next-bucket program compiles through warm_grown under a 1-byte
+    #: ceiling (refused + pinned), then the ceiling settles between
+    #: the serving and the refused projection so the pin stays VALID —
+    #: the state a crash-restart must carry across (the engine probes
+    #: after the last restart that the pin survived WITHOUT a
+    #: recompile).  0 disables.
+    hbm_pin_at: int = 0
+
     # -- failover faults (doc/design/failover-fencing.md) --------------
     #: Tick the LEADER CRASHES: its lease expires on the cluster
     #: without a release, pods it was mid-committing are left frozen
@@ -139,6 +161,13 @@ class FaultSpec:
         scheduler with a Guardrails instance wired for tick time."""
         return bool(self.slow_at or self.blackhole_at
                     or self.hbm_pressure_at)
+
+    @property
+    def restart_faults(self) -> bool:
+        """Crash-restart configured — the engine then journals the
+        driven scheduler's operational state to a statestore and
+        exercises warm-restart adoption (+ the survival invariants)."""
+        return bool(self.crash_restart_at)
 
     @property
     def health_faults(self) -> bool:
@@ -221,6 +250,28 @@ def plan_faults(spec: FaultSpec, seed: int, ticks: int) -> list[dict]:
             "tick": spec.leader_crash_at, "op": "fault",
             "kind": "leader-crash",
         })
+    if spec.hbm_pin_at:
+        events.append({
+            "tick": spec.hbm_pin_at, "op": "fault", "kind": "hbm-pin",
+        })
+    if spec.crash_restart_at:
+        last = spec.crash_restart_at
+        for k in range(max(spec.crash_restarts, 1)):
+            last = spec.crash_restart_at + k * max(
+                spec.crash_restart_every, 1,
+            )
+            events.append({
+                "tick": last, "op": "fault", "kind": "crash-restart",
+            })
+        if spec.hbm_pin_at:
+            # Post-restart probe: the pin must answer from the RESTORED
+            # state, without a recompile.  Offset past the last restart
+            # so a restored-open breaker has quiesced, probed, healed
+            # and run at least one REAL cycle first (the probe needs a
+            # snapshot to grow from).
+            events.append({
+                "tick": last + 5, "op": "fault", "kind": "hbm-pin",
+            })
     events.sort(key=lambda e: e["tick"])
     return events
 
@@ -257,12 +308,16 @@ class ChaosCluster(ExternalCluster):
     """
 
     #: Verbs the blackhole swallows and the slow fault delays — the
-    #: scheduler's write path plus the breaker's half-open probe.  The
-    #: watch, LIST/resume and lease verbs stay live: a real "dead
-    #: backend" outage keeps the informer side up (that is what makes
-    #: heal observable), and the blackhole must not kill the engine's
-    #: own per-tick lease renewal.
-    WRITE_VERBS = frozenset({"bind", "evict", "updatePodGroup", "ping"})
+    #: scheduler's write path (the statestore's HA mirror included: a
+    #: dead wire must not accept data-plane writes of any kind) plus
+    #: the breaker's half-open probe.  The watch, LIST/resume and
+    #: lease verbs stay live: a real "dead backend" outage keeps the
+    #: informer side up (that is what makes heal observable), and the
+    #: blackhole must not kill the engine's own per-tick lease
+    #: renewal.
+    WRITE_VERBS = frozenset({
+        "bind", "evict", "updatePodGroup", "putStateSnapshot", "ping",
+    })
 
     def __init__(self, *, seed: int = 0, bind_fail_pct: int = 0,
                  **kwargs) -> None:

@@ -186,6 +186,22 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--drain-budget", type=int, default=1,
                    help="max PodGroups migrated per cycle under "
                         "--drain-cordoned")
+    # -- durable operational memory (kube_batch_tpu/statestore/)
+    p.add_argument("--state-dir", default=None,
+                   help="directory for the durable operational-state "
+                        "journal (CRC-framed JSONL; "
+                        "doc/design/state-durability.md): node-health "
+                        "ledger, HBM refusal pins, breaker/watchdog "
+                        "state survive a daemon restart instead of "
+                        "re-trusting known-bad hardware and "
+                        "re-compiling refused buckets (unset "
+                        "disables)")
+    p.add_argument("--state-max-age-cycles", type=int, default=10000,
+                   help="staleness horizon for restored node-health "
+                        "records, in scheduler cycles: persisted "
+                        "evidence older than this decays toward ok / "
+                        "is dropped at load instead of quarantining "
+                        "on ancient history")
     p.add_argument("--cordon-nodes", default="",
                    help="comma-separated node names to cordon "
                         "MANUALLY at startup (never auto-released; "
@@ -240,6 +256,63 @@ def build_health(args, cordon_sink=None):
                               args.cordon_nodes.split(","))):
         ledger.cordon(name, reason="manual (--cordon-nodes)")
     return ledger
+
+
+def build_statestore(args):
+    """The durable operational-state journal (or None when --state-dir
+    is unset).  Shared by every run mode; the wire modes additionally
+    attach a mirror sink so the compacted snapshot rides the commit
+    pipeline out for cross-host successor adoption."""
+    if not args.state_dir:
+        return None
+    from kube_batch_tpu.statestore import StateStore, journal_path
+
+    os.makedirs(args.state_dir, exist_ok=True)
+    store = StateStore(journal_path(args.state_dir))
+    logging.info("durable operational state: %s", store.path)
+    return store
+
+
+def wire_statestore(args, statestore, scheduler, health, guardrails,
+                    backend=None, commit=None) -> None:
+    """Adopt persisted/mirrored state into the live subsystems and arm
+    the end-of-cycle journal writes (+ the HA mirror in wire modes).
+    Adoption order: the local journal first (this host's own memory),
+    else the peer's mirrored snapshot read back through the wire
+    (state_adopted{source})."""
+    if statestore is None:
+        return
+    from kube_batch_tpu.statestore import adopt_state
+
+    scheduler.statestore = statestore
+    adopted = adopt_state(
+        statestore, backend=backend, health=health,
+        guardrails=guardrails, scheduler=scheduler,
+        max_age_cycles=args.state_max_age_cycles,
+    )
+    if adopted is None:
+        logging.info("operational state: cold start (no journal, no "
+                     "peer snapshot)")
+    if backend is not None and callable(
+        getattr(backend, "put_state_snapshot", None)
+    ):
+        def _mirror(payload):
+            def _push():
+                try:
+                    backend.put_state_snapshot(payload)
+                except Exception as exc:  # noqa: BLE001 — the journal
+                    # holds the truth; a dead wire / lost leadership
+                    # just means the next compaction re-mirrors
+                    logging.warning(
+                        "state mirror write failed (re-mirrored at "
+                        "the next compaction): %s", exc,
+                    )
+            if commit is not None:
+                commit.submit("state", _push, verb="state")
+            else:
+                _push()
+
+        statestore.mirror_sink = _mirror
 
 
 def build_commit_pipeline(args, cache, guardrails):
@@ -628,6 +701,7 @@ def run_external(args) -> int:
     # finally — a sync timeout must not strand the lease until its TTL
     # expires (the next contender would wait out the full 15 s on every
     # supervisor restart loop).
+    statestore = None
     try:
         if args.leader_elect:
             elector = LeaseElector(
@@ -668,11 +742,21 @@ def run_external(args) -> int:
             pack_mode=args.pack_mode,
         )
         run_state["scheduler"] = scheduler
+        # Durable operational memory: adopt journal/peer state BEFORE
+        # the first cycle (a restarted daemon must not re-trust the
+        # node that was killing gangs), then journal every cycle.
+        statestore = build_statestore(args)
+        wire_statestore(args, statestore, scheduler, health, guardrails,
+                        backend=guarded, commit=commit)
         ran = scheduler.run(stop=stop, max_cycles=args.cycles)
         logging.info("stopped after %d cycles", ran)
     except KeyboardInterrupt:
         logging.info("interrupted; shutting down")
     finally:
+        # Final journal compaction (fsync) + mirror enqueue BEFORE the
+        # write path drains — the shutdown mirror rides the same drain.
+        if statestore is not None:
+            statestore.close()
         # The final cycle's wire flushes land before the socket dies
         # AND before the lease releases — a successor must acquire a
         # world with no old-epoch writes in flight (ordering pinned by
@@ -773,6 +857,7 @@ def run_http(args) -> int:
         elector.start_renewing(on_lost=on_lease_lost)
 
     run_state: dict = {}
+    statestore = None
     try:
         if args.leader_elect:
             elector = HttpLeaseElector(
@@ -802,11 +887,16 @@ def run_http(args) -> int:
             pack_mode=args.pack_mode,
         )
         run_state["scheduler"] = scheduler
+        statestore = build_statestore(args)
+        wire_statestore(args, statestore, scheduler, health, guardrails,
+                        backend=guarded, commit=commit)
         ran = scheduler.run(stop=stop, max_cycles=args.cycles)
         logging.info("stopped after %d cycles", ran)
     except KeyboardInterrupt:
         logging.info("interrupted; shutting down")
     finally:
+        if statestore is not None:
+            statestore.close()
         # The final cycle's events (evictions, unschedulable
         # diagnoses) are still on the async flusher's queue; every
         # asynchronous write path drains BEFORE the lease releases
@@ -929,18 +1019,24 @@ def main(argv: list[str] | None = None) -> int:
     cache, sim = load_world(
         args.workload, args.default_queue, args.scheduler_name
     )
+    # Sim mode has no wire to break, but the watchdog ladder, the
+    # HBM-ceiling admission and the node-health ledger apply the
+    # same (no cordon sink: the simulator has no spec to patch) —
+    # and so does the durable statestore (journal only; no HA mirror
+    # without a wire).
+    guardrails = build_guardrails(args)
+    health = build_health(args)
     scheduler = Scheduler(
         cache,
         conf_path=args.scheduler_conf,
         schedule_period=args.schedule_period,
         profile_dir=args.profile_dir,
         pack_mode=args.pack_mode,
-        # Sim mode has no wire to break, but the watchdog ladder, the
-        # HBM-ceiling admission and the node-health ledger apply the
-        # same (no cordon sink: the simulator has no spec to patch).
-        guardrails=build_guardrails(args),
-        health=build_health(args),
+        guardrails=guardrails,
+        health=health,
     )
+    statestore = build_statestore(args)
+    wire_statestore(args, statestore, scheduler, health, guardrails)
     try:
         ran = scheduler.run(
             max_cycles=args.cycles,
@@ -950,6 +1046,8 @@ def main(argv: list[str] | None = None) -> int:
     except KeyboardInterrupt:
         logging.info("interrupted; shutting down")
     finally:
+        if statestore is not None:
+            statestore.close()
         if lock is not None:
             lock.close()
     return 0

@@ -55,8 +55,14 @@ class StaleEpochError(RuntimeError):
 #: of the wire (ExternalCluster.FENCED_VERBS resolves to this, so the
 #: client's local fast-fail and the cluster's authoritative check can
 #: never disagree).  The apiserver dialect is fenced by its "path"
-#: key instead.
-FENCED_VERBS = frozenset({"bind", "evict", "updatePodGroup"})
+#: key instead.  putStateSnapshot (the statestore's HA mirror) is
+#: fenced like every data-plane write: a deposed leader must not keep
+#: overwriting the snapshot its successor is adopting; the READ verb
+#: (getStateSnapshot) stays unfenced — a contender adopting state is
+#: not yet the leader.
+FENCED_VERBS = frozenset({
+    "bind", "evict", "updatePodGroup", "putStateSnapshot",
+})
 
 
 class StreamBackend:
@@ -217,6 +223,24 @@ class StreamBackend:
         cluster state; a response at all proves the request/response
         path is live again."""
         self._call({"verb": "ping"})
+
+    # -- operational-state mirror (kube_batch_tpu/statestore/) ----------
+    def put_state_snapshot(self, payload: dict) -> None:
+        """Mirror the statestore's compacted snapshot cluster-side so
+        a successor on a DIFFERENT host adopts the dead leader's
+        ledger instead of starting blind (doc/design/
+        state-durability.md).  Epoch-fenced like every data-plane
+        write — rides the commit pipeline, so a dead leader's queued
+        mirror cannot clobber the successor's."""
+        self._call({"verb": "putStateSnapshot", "object": payload})
+
+    def get_state_snapshot(self) -> dict | None:
+        """The last mirrored operational-state snapshot, or None when
+        no leader ever mirrored one.  Unfenced read: adoption happens
+        BEFORE the adopter's first cycle."""
+        resp = self._call({"verb": "getStateSnapshot"})
+        obj = resp.get("object")
+        return obj if isinstance(obj, dict) else None
 
     # -- watch lifecycle verbs (≙ reflector LIST / re-WATCH calls) ------
     def watch_resume(self, since: int) -> None:

@@ -115,6 +115,11 @@ class ExternalCluster:
         self.lease_epoch: int = 0
         self.epoch_holders: dict[int, str] = {}  # audit: epoch → holder
         self.stale_epoch_rejections = 0
+        # The leader's mirrored operational-state snapshot (statestore
+        # HA adoption): last-write-wins, epoch-fenced on write like
+        # every data-plane verb, readable by any contender.  The k8s
+        # dialect lands here too (ConfigMap-shaped write).
+        self.state_snapshot: dict | None = None
         if reader is not None and writer is not None:
             self.attach(reader, writer)
 
@@ -561,6 +566,45 @@ class ExternalCluster:
             self._emit("MODIFIED", "Node", encode_node(node))
             return
 
+        m = re.fullmatch(
+            r"/api/v1/namespaces/([^/]+)/configmaps/([^/]+)", path
+        )
+        if m and verb in ("create", "update", "patch"):
+            from kube_batch_tpu.client.k8s_write import (
+                STATE_CONFIGMAP_NAME,
+                STATE_CONFIGMAP_NAMESPACE,
+            )
+
+            if m.groups() != (STATE_CONFIGMAP_NAMESPACE,
+                              STATE_CONFIGMAP_NAME):
+                # Only the statestore's dedicated object routes here —
+                # an unrelated ConfigMap write must not clobber the
+                # snapshot a successor will adopt.
+                self._respond(writer, rid, False,
+                              f"unhandled k8s request {verb} {path}")
+                return
+            # The statestore's HA mirror in apiserver dialect: a
+            # ConfigMap whose data.state carries the compacted
+            # operational snapshot (epoch-fenced by path above).
+            import json as _json
+
+            raw = (obj.get("data") or {}).get("state")
+            if obj.get("kind") != "ConfigMap" or not isinstance(raw, str):
+                self._respond(writer, rid, False,
+                              "malformed state ConfigMap")
+                return
+            try:
+                payload = _json.loads(raw)
+            except _json.JSONDecodeError:
+                self._respond(writer, rid, False,
+                              "state ConfigMap data.state is not JSON")
+                return
+            self.state_snapshot = (
+                payload if isinstance(payload, dict) else None
+            )
+            self._respond(writer, rid, True)
+            return
+
         m = re.fullmatch(r"/api/v1/namespaces/([^/]+)/events", path)
         if m and verb == "create":
             if obj.get("kind") != "Event" or "involvedObject" not in obj:
@@ -635,6 +679,16 @@ class ExternalCluster:
                 # Health probe (the wire breaker's half-open check):
                 # answer, touch nothing.
                 self._respond(writer, rid, True)
+            elif verb == "putStateSnapshot":
+                # The statestore's HA mirror (epoch-fenced above):
+                # last-write-wins, no watch event — control-plane
+                # metadata, not cluster state.
+                obj = msg.get("object")
+                self.state_snapshot = obj if isinstance(obj, dict) else None
+                self._respond(writer, rid, True)
+            elif verb == "getStateSnapshot":
+                self._respond(writer, rid, True,
+                              extra={"object": self.state_snapshot})
             elif verb == "updatePodGroup":
                 from kube_batch_tpu.client.codec import decode_pod_group
 

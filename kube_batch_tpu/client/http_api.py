@@ -695,6 +695,48 @@ class K8sHttpBackend:
         Never fenced: the probe is how a standby watches for heal."""
         self.client.request_json("GET", "/version")
 
+    # -- operational-state mirror (kube_batch_tpu/statestore/) ----------
+    def put_state_snapshot(self, payload: dict) -> None:
+        """The statestore's HA mirror as a real ConfigMap write: PUT
+        the named object, falling back to a collection POST when it
+        does not exist yet (k8s update-then-create).  Client-side
+        fenced like the other HTTP writes (a real apiserver cannot
+        reject by epoch without a webhook)."""
+        from kube_batch_tpu.client.k8s_write import (
+            STATE_CONFIGMAP_NAMESPACE,
+            state_snapshot_request,
+        )
+
+        self._check_fence()
+        req = state_snapshot_request(payload)
+        try:
+            self._issue(req)
+        except HttpError as exc:
+            if exc.status != 404:
+                raise
+            self._issue({
+                "verb": "create",
+                "path": (
+                    f"/api/v1/namespaces/{STATE_CONFIGMAP_NAMESPACE}"
+                    "/configmaps"
+                ),
+                "object": req["object"],
+            })
+
+    def get_state_snapshot(self) -> dict | None:
+        """The mirrored snapshot read back from the ConfigMap, or None
+        when absent/unparsable (a cold mirror means 'start blind',
+        never a crash — the caller treats None as no peer state)."""
+        from kube_batch_tpu.client.k8s_write import STATE_CONFIGMAP_PATH
+
+        try:
+            obj = self.client.request_json("GET", STATE_CONFIGMAP_PATH)
+            raw = (obj.get("data") or {}).get("state")
+            payload = json.loads(raw) if isinstance(raw, str) else None
+        except (HttpError, json.JSONDecodeError):
+            return None
+        return payload if isinstance(payload, dict) else None
+
     # -- leadership fencing (same surface as StreamBackend) -------------
     @property
     def epoch(self) -> int | None:

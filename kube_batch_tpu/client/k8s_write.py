@@ -152,6 +152,38 @@ def node_unschedulable_request(name: str, unschedulable: bool) -> dict[str, Any]
     }
 
 
+#: Where the statestore's HA mirror lives in apiserver dialect — a
+#: ConfigMap any successor replica can read back at takeover
+#: (doc/design/state-durability.md).
+STATE_CONFIGMAP_NAMESPACE = "kube-system"
+STATE_CONFIGMAP_NAME = "kube-batch-tpu-operational-state"
+STATE_CONFIGMAP_PATH = (
+    f"/api/v1/namespaces/{STATE_CONFIGMAP_NAMESPACE}"
+    f"/configmaps/{STATE_CONFIGMAP_NAME}"
+)
+
+
+def state_snapshot_request(payload: dict) -> dict[str, Any]:
+    """The statestore mirror as an apiserver-shaped ConfigMap update:
+    ``data.state`` carries the compacted operational snapshot as one
+    JSON string (ConfigMap values are strings)."""
+    import json as _json
+
+    return {
+        "verb": "update",
+        "path": STATE_CONFIGMAP_PATH,
+        "object": {
+            "apiVersion": "v1",
+            "kind": "ConfigMap",
+            "metadata": {
+                "name": STATE_CONFIGMAP_NAME,
+                "namespace": STATE_CONFIGMAP_NAMESPACE,
+            },
+            "data": {"state": _json.dumps(payload, sort_keys=True)},
+        },
+    }
+
+
 def event_request(
     kind: str,
     name: str,
@@ -291,6 +323,12 @@ class K8sStreamBackend(StreamBackend):
         kubectl cordon).  A fenced path write like every data-plane
         verb — a deposed leader must not keep cordoning nodes."""
         self._call(node_unschedulable_request(name, unschedulable))
+
+    def put_state_snapshot(self, payload: dict) -> None:
+        """The statestore's HA mirror in apiserver dialect: an
+        epoch-fenced ConfigMap update (path writes are fenced by the
+        epoch check like every data-plane write)."""
+        self._call(state_snapshot_request(payload))
 
     # -- EventSink (cache.record_event forwarding) ----------------------
     def record_event(

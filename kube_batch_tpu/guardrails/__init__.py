@@ -220,6 +220,76 @@ class Guardrails:
         `observe_flush` by the pipeline's on_flush callback."""
         self._commit = pipeline
 
+    # -- durable operational memory (kube_batch_tpu/statestore/) --------
+    def export_state(self) -> dict:
+        """JSON-serializable guardrail state for the end-of-cycle
+        journal write: both watchdog rungs and the breaker's state +
+        failure streak (the backoff's position — deterministic jitter
+        keys on the attempt count, so the streak IS the backoff
+        state)."""
+        breaker = None
+        if self.breaker is not None:
+            breaker = {
+                "state": self.breaker.state,
+                "failures": self.breaker.failures,
+            }
+        return {
+            "rung": self.watchdog.rung,
+            "flush_rung": self.flush_watchdog.rung,
+            "breaker": breaker,
+        }
+
+    def restore_state(self, state: dict) -> dict:
+        """Warm-restart adoption: the ladders resume their rungs (and
+        walk down through normal hysteresis), and a persisted
+        open/half-open breaker RE-OPENS immediately — quiescing
+        scheduling without granting the dead wire a fresh trip_after
+        failure streak.  Returns a small summary."""
+        def _rung(key: str) -> int:
+            try:
+                return int(state.get(key, 0) or 0)
+            except (TypeError, ValueError):
+                return 0   # malformed rung: resume at ok
+
+        self.watchdog.restore(_rung("rung"))
+        self.flush_watchdog.restore(_rung("flush_rung"))
+        metrics.guardrail_state.set(float(self.rung))
+        reopened = False
+        b = state.get("breaker") or None
+        if b is not None and self.breaker is not None:
+            if b.get("state") in (CircuitBreaker.OPEN,
+                                  CircuitBreaker.HALF_OPEN):
+                # HALF_OPEN restores as OPEN: the probe in flight at
+                # the crash died with the process; a fresh reset
+                # window and a fresh probe are the honest resumption.
+                try:
+                    failures = int(
+                        b.get("failures", self.breaker.trip_after) or 0
+                    )
+                except (TypeError, ValueError):
+                    failures = self.breaker.trip_after
+                self.breaker.reopen(failures=failures)
+                reopened = True
+                log.warning(
+                    "wire breaker restored OPEN from durable state — "
+                    "scheduling stays quiesced until a half-open "
+                    "probe proves the wire healed (no fresh failure "
+                    "streak required)"
+                )
+            else:
+                # A closed breaker's streak resumes too: a wire that
+                # was 4 failures from tripping must not get a fresh
+                # trip_after allowance just because the daemon
+                # restarted mid-outage-onset.
+                try:
+                    self.breaker.restore_streak(
+                        int(b.get("failures", 0) or 0)
+                    )
+                except (TypeError, ValueError):
+                    pass   # malformed streak: fresh allowance
+        self._publish_health()
+        return {"rung": self.rung, "breaker_reopened": reopened}
+
     # -- /healthz publication -------------------------------------------
     def _publish_health(self) -> None:
         """The /healthz body is the ladder rung FLOORED at "degraded"

@@ -137,6 +137,47 @@ class CircuitBreaker:
         with self._lock:
             return self._state
 
+    @property
+    def failures(self) -> int:
+        """Current consecutive-transport-failure streak (statestore
+        export: a restarted daemon resumes the streak it crashed with
+        instead of granting a dead wire a fresh allowance)."""
+        with self._lock:
+            return self._failures
+
+    def restore_streak(self, failures: int) -> None:
+        """Warm-restart adoption of a persisted CLOSED breaker's
+        consecutive-failure streak: a wire that was 4 failures from
+        tripping when the daemon crashed stays 1 failure from
+        tripping, instead of getting a fresh trip_after allowance.
+        No-op unless closed (open restores go through `reopen`)."""
+        with self._lock:
+            if self._state == self.CLOSED:
+                self._failures = max(int(failures), 0)
+
+    def reopen(self, failures: int | None = None) -> None:
+        """Restore a persisted OPEN state at warm restart: the breaker
+        opens NOW — without requiring a fresh trip_after failure
+        streak against the same dead wire — and fires `on_open` so
+        scheduling quiesces exactly like a live trip.  The reset
+        window restarts from now; the half-open probe remains the only
+        heal path.  No-op when already open."""
+        fire = None
+        with self._lock:
+            if self._state == self.OPEN:
+                return
+            self._failures = (
+                self.trip_after if failures is None
+                else max(int(failures), 1)
+            )
+            self._probe_out = False
+            self._set_state(self.OPEN)
+            self._opened_at = self._clock()
+            self.opened_count += 1
+            fire = self._on_open
+        if fire is not None:
+            fire(self.name)
+
     def _set_state(self, state: str) -> None:
         self._state = state
         metrics.breaker_state.set(self._STATE_VALUE[state], self.name)
@@ -280,6 +321,18 @@ class GuardedBackend:
             "updatePodGroup",
             lambda: self.inner.update_pod_group(group),
             key=getattr(group, "name", ""),
+        )
+
+    def put_state_snapshot(self, payload: dict) -> None:
+        """The statestore's HA mirror write, guarded like every
+        data-plane verb: with the breaker OPEN it fails fast instead
+        of stalling a compaction on wire timeouts — the local journal
+        already holds the truth, and the next compaction re-mirrors
+        once the wire heals."""
+        return self._guarded(
+            "putStateSnapshot",
+            lambda: self.inner.put_state_snapshot(payload),
+            key="state",
         )
 
     def cordon_node(self, name: str, unschedulable: bool) -> None:

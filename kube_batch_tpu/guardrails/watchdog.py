@@ -68,6 +68,19 @@ class CycleWatchdog:
     def enabled(self) -> bool:
         return self.engage_after > 0
 
+    def restore(self, rung: int) -> None:
+        """Warm-restart adoption of a persisted ladder rung: a daemon
+        that crashed while degraded resumes degraded (prewarm paused,
+        diagnosis shed per the rung) and must walk back down through
+        the normal recover_after hysteresis — a restart is not
+        evidence of health.  The facade publishes the combined gauge
+        and /healthz after restoring both ladders."""
+        with self._lock:
+            self.rung = min(max(int(rung), 0), len(RUNGS) - 1)
+            self.max_rung_seen = max(self.max_rung_seen, self.rung)
+            self._overruns = 0
+            self._healthy = 0
+
     def effective_period(self, period: float | None = None) -> float:
         p = self.period if self.period is not None else period
         return p if p is not None else 0.0

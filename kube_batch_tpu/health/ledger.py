@@ -119,6 +119,11 @@ class _Record:
     canary_used: int = 0
     #: Manual cordons (CLI) never auto-uncordon through probation.
     manual: bool = False
+    #: Ledger-clock cycle of the last fresh EVIDENCE (suspicion,
+    #: cordon, canary spend) — the statestore's staleness age.  Decay
+    #: transitions deliberately do not re-stamp: they move toward ok,
+    #: which is where stale records decay anyway.
+    updated: int = 0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -150,6 +155,10 @@ class NodeHealthLedger:
         self.cordon_sink = None
         #: node → desired unschedulable bit not yet acked by the sink.
         self._sink_pending: dict[str, bool] = {}
+        #: The ledger's clock (on_cycle ticks it) — cycles, never wall
+        #: seconds; stamped onto records for the statestore's
+        #: age-scaled staleness decay at warm restart.
+        self.cycle = 0
         # -- observability counters (chaos summaries read these) -------
         self.cordons_total = 0
         self.probation_failures_total = 0
@@ -184,6 +193,7 @@ class NodeHealthLedger:
             rec = self._records.get(node)
             if rec is not None and rec.state == NodeState.PROBATION:
                 rec.canary_used += 1
+                rec.updated = self.cycle
 
     def note_placement_failed(self, node: str) -> None:
         """A committed placement never RAN on the node — the flush
@@ -216,6 +226,7 @@ class NodeHealthLedger:
             old = rec.state
             rec.manual = True
             rec.clean_cycles = 0
+            rec.updated = self.cycle
             if rec.state != NodeState.CORDONED:
                 rec.state = NodeState.CORDONED
                 self.cordons_total += 1
@@ -258,6 +269,7 @@ class NodeHealthLedger:
         cfg = self.config
         fire: list[_Transition] = []
         with self._lock:
+            self.cycle += 1
             for name, rec in self._records.items():
                 rec.score *= cfg.decay
                 if rec.score < _SCORE_FLOOR:
@@ -345,6 +357,133 @@ class NodeHealthLedger:
             "probation_failures_total": self.probation_failures_total,
         }
 
+    # -- durable operational memory (kube_batch_tpu/statestore/) --------
+    def export_state(self) -> dict:
+        """JSON-serializable snapshot of everything a warm restart
+        needs: non-trivial records (states, scores, probation
+        counters, escalation multipliers, manual flags, evidence
+        stamps), pending cordon-mirror retries, and the counters —
+        written from the cycle thread at end-of-cycle."""
+        with self._lock:
+            records = {
+                n: {
+                    "state": r.state,
+                    "score": round(r.score, 6),
+                    "clean": r.clean_cycles,
+                    "mult": r.multiplier,
+                    "canary": r.canary_used,
+                    "manual": r.manual,
+                    "updated": r.updated,
+                }
+                for n, r in sorted(self._records.items())
+                if r.state != NodeState.OK or r.score > 0.0
+            }
+            return {
+                "cycle": self.cycle,
+                "records": records,
+                "sink_pending": {
+                    n: bool(v)
+                    for n, v in sorted(self._sink_pending.items())
+                },
+                "cordons_total": self.cordons_total,
+                "probation_failures_total": self.probation_failures_total,
+            }
+
+    def restore_state(self, state: dict,
+                      max_age_cycles: int = 10_000) -> dict:
+        """Warm-restart adoption with age-scaled staleness decay:
+        a record's age is measured in the LEDGER's own cycle clock
+        against the journal's last write — records older than
+        `max_age_cycles` are dropped (ancient evidence must not
+        quarantine a node forever across a long outage), younger ones
+        re-apply with the missed decay folded into their score.  A
+        node that already has fresh evidence THIS boot (e.g. a manual
+        --cordon-nodes entry) wins over its persisted record.  Returns
+        ``{"restored": n, "dropped_stale": n, "dropped_malformed": n}``
+        — malformed/unknown-state records count SEPARATELY from
+        staleness, so the staleness metric never sends an operator
+        tuning --state-max-age-cycles at what is actually
+        corruption."""
+        cfg = self.config
+        try:
+            now = int(state.get("cycle", 0))
+        except (TypeError, ValueError):
+            now = 0
+        restored: list[tuple[str, str]] = []
+        stale = malformed = 0
+        with self._lock:
+            self.cycle = max(self.cycle, now)
+            self.cordons_total = max(
+                self.cordons_total, int(state.get("cordons_total", 0) or 0)
+            )
+            self.probation_failures_total = max(
+                self.probation_failures_total,
+                int(state.get("probation_failures_total", 0) or 0),
+            )
+            for name, raw in (state.get("records") or {}).items():
+                live = self._records.get(name)
+                if live is not None and (
+                    live.state != NodeState.OK or live.score > 0.0
+                ):
+                    continue  # this boot's evidence wins
+                try:
+                    st = str(raw.get("state", NodeState.OK))
+                    age = max(now - int(raw.get("updated", 0)), 0)
+                    score = float(raw.get("score", 0.0))
+                    rec = _Record(
+                        state=st,
+                        score=score,
+                        clean_cycles=int(raw.get("clean", 0)),
+                        multiplier=float(raw.get("mult", 1.0)),
+                        canary_used=int(raw.get("canary", 0)),
+                        manual=bool(raw.get("manual", False)),
+                        updated=self.cycle,
+                    )
+                except (TypeError, ValueError, AttributeError):
+                    malformed += 1   # e.g. a non-dict record payload
+                    continue
+                if st not in STATE_VALUES:
+                    malformed += 1
+                    continue
+                if age > max(int(max_age_cycles), 0):
+                    stale += 1
+                    continue
+                rec.score *= cfg.decay ** age
+                if rec.score < _SCORE_FLOOR:
+                    rec.score = 0.0
+                if st == NodeState.SUSPECT and rec.score == 0.0:
+                    stale += 1   # decayed clean across the downtime
+                    continue
+                if st == NodeState.OK and rec.score == 0.0:
+                    continue     # nothing worth keeping; not stale
+                self._records[name] = rec
+                restored.append((name, st))
+            pending = state.get("sink_pending")
+            if self.cordon_sink is not None and isinstance(pending, dict):
+                for node, want in pending.items():
+                    self._sink_pending.setdefault(str(node), bool(want))
+        # Publish OUTSIDE the lock, like _fire: gauges, the /healthz
+        # count, and per-node journal marks so the next pack masks
+        # restored cordons / clamps restored probation immediately.
+        cache = self._cache
+        for name, st in restored:
+            metrics.node_health_state.set(STATE_VALUES[st], name)
+            if cache is not None:
+                with cache.lock():
+                    cache._mark_node(name)
+        if restored:
+            count = self.quarantined_count()
+            metrics.quarantined_nodes.set(float(count))
+            metrics.set_quarantined(count)
+            log.warning(
+                "node-health ledger restored from durable state: %s "
+                "(%d stale, %d malformed record(s) dropped)",
+                ", ".join(f"{n}={s}" for n, s in restored), stale,
+                malformed,
+            )
+        return {"restored": len(restored), "dropped_stale": stale,
+                "dropped_malformed": malformed}
+
     # -- internals ------------------------------------------------------
     def _reset(self, rec: _Record) -> None:
         rec.state = NodeState.OK
@@ -360,6 +499,7 @@ class NodeHealthLedger:
         with self._lock:
             rec = self._records.setdefault(node, _Record())
             rec.clean_cycles = 0
+            rec.updated = self.cycle
             old = rec.state
             if old == NodeState.PROBATION:
                 # Any failure during probation re-cordons at a HIGHER

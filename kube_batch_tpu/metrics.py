@@ -378,6 +378,39 @@ probation_failures = REGISTRY.register(Counter(
     "window (the quarantine threshold escalates each time).",
 ))
 
+# -- durable operational memory (kube_batch_tpu/statestore/) -----------------
+statestore_records = REGISTRY.register(Gauge(
+    "statestore_records",
+    "Records currently in the operational-state journal (appends since "
+    "the last compaction, plus the header and compacted snapshot); a "
+    "monotonically growing value here means compaction stopped firing.",
+))
+statestore_compactions = REGISTRY.register(Counter(
+    "statestore_compactions_total",
+    "Operational-state journal compactions (the file is rewritten down "
+    "to the latest snapshot, fsynced, and — in HA mode — mirrored "
+    "through the wire dialect for successor adoption).",
+))
+statestore_load_corrupt = REGISTRY.register(Counter(
+    "statestore_load_corrupt_total",
+    "Journal records dropped at load because their CRC frame, JSON "
+    "body, or header failed to validate (the loader recovers the "
+    "longest valid prefix and never raises).",
+))
+statestore_load_dropped_stale = REGISTRY.register(Counter(
+    "statestore_load_dropped_stale_total",
+    "Persisted node-health records dropped at load by the "
+    "--state-max-age-cycles staleness decay (older evidence decays "
+    "toward ok instead of quarantining on ancient history).",
+))
+state_adopted = REGISTRY.register(Counter(
+    "state_adopted_total",
+    "Operational-state adoptions at startup/takeover by source: "
+    "'journal' (this host's --state-dir) or 'peer' (the dead leader's "
+    "mirrored snapshot read back through the wire dialect).",
+    labels=("source",),
+))
+
 # -- leadership fencing + failover (doc/design/failover-fencing.md) ----------
 leader_epoch = REGISTRY.register(Gauge(
     "leader_epoch",

@@ -53,6 +53,7 @@ class Scheduler:
         guardrails: Guardrails | None = None,
         health=None,
         pack_mode: str | None = None,
+        statestore=None,
     ) -> None:
         self.cache = cache
         self.conf_path = conf_path
@@ -155,6 +156,20 @@ class Scheduler:
         # the boundary stays imminent — mirroring the compile-cliff
         # conf-adoption refusal above.  Cleared on conf swap.
         self._growth_refused: dict[tuple, tuple[str, float]] = {}
+        # Refusal pins restored from the durable statestore, keyed by
+        # the SHAPE part of the key only (id(cycle) is process-local
+        # and cannot persist): shapes-tuple → (label, projected bytes).
+        # `_pin_blocks` adopts a matching entry into _growth_refused
+        # under the live cycle's key — re-validated against the LIVE
+        # ceiling exactly like an in-process pin — so a restarted
+        # daemon never recompiles (or executes) a bucket its dead
+        # predecessor already proved does not fit the chip.
+        self._restored_refused: dict[tuple, tuple[str, float]] = {}
+        # Durable operational memory (kube_batch_tpu/statestore/):
+        # when set, run_once appends the collected soft state (ledger,
+        # guardrail, pins) at end-of-cycle — cycle thread only, no
+        # wire, no fsync-per-record.
+        self.statestore = statestore
         # True while the CURRENT run_once is a quiesced skip
         # (mid-relist / breaker open): such cycles bypass the overrun
         # watchdog — their near-zero latency is not evidence of health.
@@ -224,6 +239,7 @@ class Scheduler:
         }
 
     def _adopt(self, built: dict) -> None:
+        first_load = self._conf is None
         for action in self._actions:
             action.uninitialize()
         self._conf = built["conf"]
@@ -242,6 +258,13 @@ class Scheduler:
             self._growth_queue.clear()
         self._growth_failed.clear()
         self._growth_refused.clear()
+        if not first_load:
+            # Statestore-restored pins measured the OLD policy's
+            # programs; a swapped conf compiles different programs at
+            # the same shapes, so they no longer prove anything.  The
+            # FIRST load must keep them — that is the restart path the
+            # pins exist to survive.
+            self._restored_refused.clear()
         # Seed the prewarmed executable (if the warm produced one):
         # without this the first real cycle re-lowers and recompiles,
         # and only CLI/bench runs (persistent cache on) get it cheap.
@@ -380,12 +403,95 @@ class Scheduler:
         program becomes warmable/compilable again."""
         refused = self._growth_refused.get(key)
         if refused is None:
+            # A durable pin from a previous incarnation?  Keyed by the
+            # shape part only (id(cycle) died with the old process);
+            # adopted under the live key if it still holds against the
+            # live ceiling, dropped otherwise — same validity rule.
+            shapes = self._pin_shapes(key[1:])
+            restored = self._restored_refused.get(shapes)
+            if restored is None:
+                return None
+            self._restored_refused.pop(shapes, None)
+            if self.guardrails.hbm.enabled and \
+                    restored[1] > self.guardrails.hbm.ceiling_bytes:
+                self._growth_refused[key] = restored
+                return restored
             return None
         if self.guardrails.hbm.enabled and \
                 refused[1] > self.guardrails.hbm.ceiling_bytes:
             return refused
         self._growth_refused.pop(key, None)
         return None
+
+    @staticmethod
+    def _pin_shapes(key_tail) -> tuple:
+        """Canonical, JSON-round-trippable form of a shape key's tail
+        (the persistable part — id(cycle) is process-local)."""
+        return tuple(
+            (str(name), tuple(int(d) for d in shape))
+            for name, shape in key_tail
+        )
+
+    def export_refusal_pins(self) -> list[dict]:
+        """Serializable HBM refusal pins for the statestore journal:
+        live pins plus restored-but-not-yet-revalidated ones (a pin
+        the daemon never re-touched must still survive the NEXT
+        restart)."""
+        pins: dict[tuple, tuple[str, float]] = {}
+        for shapes, val in self._restored_refused.items():
+            pins[self._pin_shapes(shapes)] = val
+        for key, val in self._growth_refused.items():
+            pins[self._pin_shapes(key[1:])] = val
+        return [
+            {
+                "shapes": [[n, list(s)] for n, s in shapes],
+                "label": str(label),
+                "projected": float(projected),
+            }
+            for shapes, (label, projected) in sorted(pins.items())
+        ]
+
+    def restore_refusal_pins(self, pins: list[dict]) -> dict:
+        """Adopt persisted refusal pins, re-validating each against
+        the LIVE ceiling exactly as today's in-process pins do: a pin
+        the ceiling has moved past (raised/disabled) is dropped here,
+        never blocking a program the current budget admits."""
+        restored = dropped = 0
+        for pin in pins:
+            try:
+                shapes = self._pin_shapes(
+                    (n, s) for n, s in pin.get("shapes", ())
+                )
+                projected = float(pin.get("projected", 0.0))
+                label = str(pin.get("label", "program"))
+            except (TypeError, ValueError, AttributeError):
+                dropped += 1   # e.g. a non-dict pin payload
+                continue
+            if not shapes:
+                dropped += 1
+                continue
+            if self.guardrails.hbm.enabled and \
+                    projected > self.guardrails.hbm.ceiling_bytes:
+                self._restored_refused[shapes] = (label, projected)
+                restored += 1
+            else:
+                dropped += 1
+        if restored:
+            logging.warning(
+                "%d HBM refusal pin(s) restored from durable state — "
+                "the once-refused bucket(s) will pause the solve, not "
+                "recompile, if the cluster crosses them again",
+                restored,
+            )
+        return {"restored": restored, "dropped": dropped}
+
+    def refusal_pin_shapes(self) -> set:
+        """Canonical shape tails of every held pin (live + restored) —
+        the chaos engine's restart invariants compare these across a
+        crash."""
+        out = {self._pin_shapes(s) for s in self._restored_refused}
+        out.update(self._pin_shapes(k[1:]) for k in self._growth_refused)
+        return out
 
     def _ensure_compiled(self, snap, state):
         """AOT-compile the fused cycle for `snap`'s shapes before its
@@ -781,6 +887,13 @@ class Scheduler:
         grow = grow or {"T": int(snap.num_tasks) + 1}
         gsnap = grown_avals(snap, grow)
         key = self._shape_key(cycle, gsnap)
+        if self._pin_blocks(key) is not None:
+            # A held (possibly statestore-restored) refusal pin covers
+            # exactly this program: recompiling it would burn the
+            # compile service only to be refused again — the pin IS
+            # the verdict.  This is the refused-bucket-never-
+            # recompiled contract a warm restart must keep.
+            return False
         exe = cycle.lower(gsnap, jax.eval_shape(init_state, gsnap)).compile()
         if self._admit_growth(key, exe, label=grow):
             self._compiled_shapes[key] = exe
@@ -1016,6 +1129,12 @@ class Scheduler:
         finally:
             if commit is not None:
                 commit.note_solve(False)
+            # Durable operational memory: one end-of-cycle journal
+            # append on the cycle thread (digest-deduped; no wire, no
+            # fsync — statestore.append never raises).  Runs on
+            # quiesced skips too: the breaker's open window is exactly
+            # the state a crash must not erase.
+            self.journal_state()
             if not self._cycle_quiesced:
                 # Quiesced skips (mid-relist, breaker open) return in
                 # microseconds and are NOT evidence of health: feeding
@@ -1040,6 +1159,19 @@ class Scheduler:
                             period=self.schedule_period,
                         )
                     self._flush_batches_seen = done
+
+    def journal_state(self) -> None:
+        """Append the current operational soft state to the durable
+        statestore (no-op without one).  run_once calls this at
+        end-of-cycle; the chaos engine calls it again after its
+        per-tick commit barrier — a breaker trip landing during the
+        flush drain postdates the in-cycle append and must still be
+        journaled before a crash fault fires."""
+        if self.statestore is None:
+            return
+        from kube_batch_tpu.statestore import collect_state
+
+        self.statestore.append(collect_state(self))
 
     def _cycle_once(self) -> Session | None:
         with metrics.e2e_latency.time():

@@ -1,0 +1,486 @@
+"""Durable operational memory: crash-surviving soft state.
+
+PR 4's takeover reconciliation recovers POD state (the BINDING census
+against relisted cluster truth), but every piece of hard-won
+OPERATIONAL memory was process-local and evaporated on restart: the
+node-health suspicion ledger and probation counters, HBM refusal pins,
+the wire breaker's open window, the watchdog's degradation rung, and
+pending ``spec.unschedulable`` mirror retries.  A crashlooping or
+redeployed daemon therefore re-trusted the flaky node that was killing
+gangs, re-compiled and re-OOMed against a refused bucket, and hammered
+a wire the breaker had opened — the repeat-known-failure loop a
+production scheduler must not have.
+
+This package closes it:
+
+* `journal` — the CRC-framed, versioned, append-only JSONL substrate
+  with corrupt-tail truncation recovery (load NEVER raises; the
+  longest valid prefix wins and drops are counted in
+  ``statestore_load_corrupt_total``).
+
+* `StateStore` — one journal of end-of-cycle state snapshots, written
+  from the CYCLE thread (no wire, no fsync-per-record; digest-deduped
+  so an idle daemon appends nothing), compacted every
+  ``compact_every`` appends down to the latest snapshot (fsync on
+  compaction and shutdown only).  A node the ledger ``forget``s simply
+  stops appearing in subsequent snapshots, so its persisted record is
+  PURGED at the next compaction — the journal stays bounded under node
+  churn.  In HA mode the compacted snapshot additionally mirrors
+  through the wire dialect (``mirror_sink`` — an epoch-fenced
+  ConfigMap-shaped write riding the commit pipeline), so a successor
+  on a DIFFERENT host adopts the dead leader's ledger instead of
+  starting blind.
+
+* `collect_state` / `restore_state` / `adopt_state` — the glue between
+  the journal payload and the live subsystems: the ledger restores
+  with age-scaled staleness decay (records older than
+  ``--state-max-age-cycles`` decay toward ok/dropped, counted in
+  ``statestore_load_dropped_stale_total``), HBM pins re-validate
+  against the LIVE ceiling exactly like in-process pins, the breaker
+  re-opens WITHOUT needing a fresh failure streak, and the watchdog
+  resumes its rung.  ``adopt_state`` prefers the local journal and
+  falls back to the peer mirror (``state_adopted{source}``).
+
+Time is CYCLES, not wall seconds: the journal's clock is the cycle
+counter (in chaos, the tick clock), which keeps seeded crash-restart
+scenarios byte-for-byte deterministic.
+
+Design doc: doc/design/state-durability.md.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import tempfile
+
+from kube_batch_tpu import metrics
+from kube_batch_tpu.statestore.journal import (
+    JOURNAL_NAME,
+    VERSION,
+    frame,
+    header_record,
+    journal_path,
+    read_journal,
+    read_journal_prefix,
+)
+
+__all__ = [
+    "DEFAULT_COMPACT_EVERY",
+    "DEFAULT_MAX_AGE_CYCLES",
+    "JOURNAL_NAME",
+    "StateStore",
+    "VERSION",
+    "adopt_state",
+    "collect_state",
+    "journal_path",
+    "read_journal",
+    "restore_state",
+]
+
+log = logging.getLogger(__name__)
+
+#: Appends between compactions — bounds the journal to roughly this
+#: many records regardless of uptime.
+DEFAULT_COMPACT_EVERY = 64
+#: Default --state-max-age-cycles: ledger records older than this (in
+#: the ledger's own cycle clock) decay toward ok/dropped at load.  At
+#: the 1 s default period this is ~3 hours of evidence.
+DEFAULT_MAX_AGE_CYCLES = 10_000
+
+
+def _digest(state: dict) -> str:
+    body = json.dumps(state, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(body.encode("utf-8")).hexdigest()
+
+
+def _dedupe_view(state: dict) -> dict:
+    """The state as the append dedupe sees it: the ledger's bare cycle
+    CLOCK is excluded (it ticks every cycle even when nothing about
+    the world changed — digesting it would journal an idle daemon
+    every cycle), while every record field, pin and guardrail state
+    stays in.  The clock still rides each WRITTEN record; the
+    heartbeat append bounds how far it can lag."""
+    ledger = state.get("ledger")
+    if isinstance(ledger, dict) and "cycle" in ledger:
+        state = {
+            **state,
+            "ledger": {k: v for k, v in ledger.items() if k != "cycle"},
+        }
+    return state
+
+
+class StateStore:
+    """One operational-state journal.  All I/O is best-effort: a full
+    disk degrades durability, never the scheduling cycle."""
+
+    def __init__(
+        self,
+        path: str,
+        compact_every: int = DEFAULT_COMPACT_EVERY,
+    ) -> None:
+        self.path = path
+        self.compact_every = max(int(compact_every), 1)
+        #: Journal clock: bumps on every ``append`` call (deduped or
+        #: not), restored from the last loaded record — cycles, so the
+        #: chaos engine's tick-driven runs journal deterministically.
+        self.cycle = 0
+        self._f = None
+        self._last_digest: str | None = None
+        self._last_state: dict | None = None
+        self._last_written_cycle = 0
+        #: True only when the path holds a NEWER format's journal that
+        #: could not be set aside: this incarnation neither reads nor
+        #: writes it (preserving the newer binary's memory).
+        self._disabled = False
+        self._records = 0          # records currently in the file
+        self._since_compact = 0
+        self._dirty_since_compact = False
+        # Set by load() when the file exists but NOTHING valid could
+        # be recovered (e.g. a corrupt header): the first append then
+        # REWRITES the file with a fresh header instead of appending
+        # records behind garbage no future load could ever read.
+        self._rewrite_on_open = False
+        #: Optional callable(payload) pushing the compacted snapshot
+        #: out through the wire dialect (HA adoption); payload is
+        #: ``{"v": VERSION, "cycle": N, "state": {...}}``.  Failures
+        #: are the sink's problem (it should swallow and retry at the
+        #: next compaction) — durability is the JOURNAL's job, the
+        #: mirror is a replica.
+        self.mirror_sink = None
+        # -- observability ------------------------------------------------
+        self.appends = 0
+        self.compactions = 0
+        self.corrupt_dropped = 0
+
+    # -- load -----------------------------------------------------------
+    def load(self) -> dict | None:
+        """The latest persisted state, or None (cold start).  Never
+        raises: corruption truncates to the longest valid prefix and
+        counts into ``statestore_load_corrupt_total``."""
+        records, dropped, valid_bytes, future_v = \
+            read_journal_prefix(self.path)
+        if future_v is not None:
+            # A NEWER binary's journal (version rollback in flight):
+            # refuse it WITHOUT destroying it — set it aside so the
+            # newer binary finds its memory when it returns, and start
+            # this incarnation blind on a fresh file.
+            side = f"{self.path}.refused-v{future_v}"
+            log.error(
+                "state journal %s is format v%d (> supported v%d); "
+                "preserving it at %s and starting blind",
+                self.path, future_v, VERSION, side,
+            )
+            try:
+                os.replace(self.path, side)
+            except OSError as exc:
+                # Can neither read nor safely write the path: disable
+                # journaling for this incarnation rather than append
+                # v1 frames behind a v2 header (which NEITHER version
+                # could then read) or destroy the newer binary's
+                # memory.
+                log.warning(
+                    "could not set the incompatible journal aside "
+                    "(%s); journaling DISABLED this run to preserve "
+                    "it", exc,
+                )
+                self._disabled = True
+            return None
+        if dropped:
+            self.corrupt_dropped += dropped
+            metrics.statestore_load_corrupt.inc(by=float(dropped))
+            log.warning(
+                "state journal %s: %d corrupt record(s) dropped; "
+                "recovered the longest valid prefix (%d record(s))",
+                self.path, dropped, len(records),
+            )
+            # Truncate the garbage NOW: appending a frame behind a
+            # torn line (no trailing newline) would merge into it and
+            # every later load would drop the new records too — up to
+            # a full compact_every window of post-crash evidence
+            # silently lost on the next crash.
+            try:
+                os.truncate(self.path, valid_bytes)
+            except OSError as exc:
+                log.warning(
+                    "could not truncate corrupt journal tail (the "
+                    "first append rewrites the file instead): %s", exc,
+                )
+                # Fallback: the first append rewrites the whole file
+                # (fresh header + the new record) instead of appending
+                # behind garbage no future load could read.
+                self._rewrite_on_open = True
+        states = [r for r in records if r.get("kind") == "state"]
+        # valid_bytes > 0 ⇔ a valid header survived (it is the first
+        # framed line), even when zero state records did — the gauge
+        # must count it.
+        self._records = len(records) + (1 if valid_bytes > 0 else 0)
+        self._since_compact = len(records)
+        metrics.statestore_records.set(float(self._records))
+        if not states:
+            return None
+        last = states[-1]
+        try:
+            self.cycle = int(last.get("cycle", 0))
+        except (TypeError, ValueError):
+            self.cycle = 0
+        self._last_written_cycle = self.cycle
+        state = last.get("state")
+        if not isinstance(state, dict):
+            return None
+        self._last_state = state
+        self._last_digest = _digest(_dedupe_view(state))
+        return state
+
+    # -- append (cycle thread, end-of-cycle) ----------------------------
+    def append(self, state: dict) -> None:
+        """Record this cycle's operational state.  Digest-deduped —
+        the digest excludes the ledger's bare clock, so an idle daemon
+        appends nothing — with a heartbeat append once the clock has
+        drifted a full ``compact_every`` past the last written record
+        (keeping restore-time staleness ages honest across long idle
+        stretches).  Compacts every ``compact_every`` appended
+        records.  Never raises."""
+        self.cycle += 1
+        if self._disabled:
+            return
+        try:
+            d = _digest(_dedupe_view(state))
+        except (TypeError, ValueError):
+            log.exception("unserializable operational state; not journaled")
+            return
+        if d == self._last_digest and (
+            self.cycle - self._last_written_cycle < self.compact_every
+        ):
+            return
+        try:
+            f = self._open()
+            f.write(frame({"kind": "state", "cycle": self.cycle,
+                           "state": state}))
+            f.flush()   # deliberately no fsync — see module docstring
+        except OSError as exc:
+            # The digest is NOT recorded: a state change whose write
+            # failed must retry next cycle, not be dedupe-suppressed
+            # into never persisting.
+            log.warning("state journal append failed (soft state not "
+                        "persisted this cycle; retried next): %s", exc)
+            return
+        self._last_state = state
+        self._last_digest = d
+        self._last_written_cycle = self.cycle
+        self.appends += 1
+        self._records += 1
+        self._since_compact += 1
+        self._dirty_since_compact = True
+        metrics.statestore_records.set(float(self._records))
+        if self._since_compact >= self.compact_every:
+            self.compact()
+
+    def _open(self):
+        if self._f is None or self._f.closed:
+            fresh = not os.path.exists(self.path) or \
+                os.path.getsize(self.path) == 0
+            if self._rewrite_on_open and not fresh:
+                # The whole file was unreadable at load: start over —
+                # appending behind a corrupt header would be writing
+                # records no future load could recover.
+                self._f = open(self.path, "wb")  # noqa: SIM115
+                fresh = True
+            else:
+                self._f = open(self.path, "ab")  # noqa: SIM115
+            self._rewrite_on_open = False
+            if fresh:
+                self._f.write(frame(header_record()))
+                self._f.flush()
+                self._records = 1
+        return self._f
+
+    # -- compaction (the only fsync sites, with close) ------------------
+    def compact(self) -> None:
+        """Rewrite the journal down to header + latest snapshot,
+        fsynced and atomically renamed; then mirror the snapshot out
+        (HA adoption).  Never raises."""
+        if self._last_state is None or self._disabled:
+            return
+        payload = {"kind": "state", "cycle": self.cycle,
+                   "state": self._last_state}
+        try:
+            d = os.path.dirname(self.path) or "."
+            fd, tmp = tempfile.mkstemp(
+                dir=d, prefix=os.path.basename(self.path) + ".",
+            )
+            try:
+                with os.fdopen(fd, "wb") as f:
+                    f.write(frame(header_record()))
+                    f.write(frame(payload))
+                    f.flush()
+                    os.fsync(f.fileno())
+                if self._f is not None and not self._f.closed:
+                    self._f.close()
+                os.replace(tmp, self.path)
+            except BaseException:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                raise
+            self._f = None   # reopened in append mode on next write
+        except OSError as exc:
+            log.warning("state journal compaction failed (journal keeps "
+                        "growing until the next attempt): %s", exc)
+            return
+        self.compactions += 1
+        self._records = 2
+        self._since_compact = 0
+        self._dirty_since_compact = False
+        metrics.statestore_compactions.inc()
+        metrics.statestore_records.set(float(self._records))
+        sink = self.mirror_sink
+        if sink is not None:
+            try:
+                sink({"v": VERSION, "cycle": self.cycle,
+                      "state": self._last_state})
+            except Exception as exc:  # noqa: BLE001 — the mirror is a
+                # replica; the journal already holds the truth
+                log.warning("state mirror sink failed (retried at the "
+                            "next compaction): %s", exc)
+
+    def close(self) -> None:
+        """Shutdown: final compaction (fsync + mirror), file closed."""
+        if self._dirty_since_compact or (
+            self._last_state is not None and self._records > 2
+        ):
+            self.compact()
+        if self._f is not None and not self._f.closed:
+            try:
+                self._f.flush()
+                os.fsync(self._f.fileno())
+            except OSError:
+                pass
+            self._f.close()
+        self._f = None
+
+
+# -- subsystem glue ---------------------------------------------------------
+
+def collect_state(scheduler) -> dict:
+    """One journal payload from the live subsystems — called on the
+    cycle thread at end-of-cycle, touches no wire."""
+    state: dict = {}
+    if scheduler.health is not None:
+        state["ledger"] = scheduler.health.export_state()
+    state["guardrails"] = scheduler.guardrails.export_state()
+    pins = scheduler.export_refusal_pins()
+    if pins:
+        state["hbm_pins"] = pins
+    return state
+
+
+def restore_state(
+    state: dict,
+    *,
+    health=None,
+    guardrails=None,
+    scheduler=None,
+    max_age_cycles: int = DEFAULT_MAX_AGE_CYCLES,
+    source: str = "journal",
+) -> dict:
+    """Adopt a loaded/mirrored payload into the live subsystems.
+    Returns a summary dict; counts ``state_adopted{source}`` and the
+    ledger's staleness drops."""
+    summary: dict = {"source": source}
+    # Each subsystem restores independently, and a malformed payload
+    # (the peer mirror arrives over the WIRE) degrades that subsystem
+    # to a cold start — never a startup crash: a garbage ConfigMap
+    # must not crash-loop every successor replica.
+    ledger_state = state.get("ledger")
+    if health is not None and isinstance(ledger_state, dict):
+        try:
+            out = health.restore_state(ledger_state,
+                                       max_age_cycles=max_age_cycles)
+        except Exception:  # noqa: BLE001 — start blind, never crash
+            log.exception("malformed ledger state; starting blind")
+            out = None
+        if out is not None:
+            summary["ledger"] = out
+            if out.get("dropped_stale"):
+                metrics.statestore_load_dropped_stale.inc(
+                    by=float(out["dropped_stale"])
+                )
+    rails_state = state.get("guardrails")
+    if guardrails is not None and isinstance(rails_state, dict):
+        try:
+            summary["guardrails"] = guardrails.restore_state(rails_state)
+        except Exception:  # noqa: BLE001 — start blind, never crash
+            log.exception("malformed guardrail state; starting blind")
+    pins = state.get("hbm_pins")
+    if scheduler is not None and isinstance(pins, list):
+        try:
+            summary["pins"] = scheduler.restore_refusal_pins(pins)
+        except Exception:  # noqa: BLE001 — start blind, never crash
+            log.exception("malformed refusal pins; starting blind")
+    metrics.state_adopted.inc(source)
+    log.info("operational state adopted from %s: %s", source, summary)
+    return summary
+
+
+def adopt_state(
+    statestore: StateStore | None,
+    *,
+    backend=None,
+    health=None,
+    guardrails=None,
+    scheduler=None,
+    max_age_cycles: int = DEFAULT_MAX_AGE_CYCLES,
+) -> dict | None:
+    """Startup/takeover adoption: the local journal first (this host's
+    own memory is freshest on a same-host restart), else the peer
+    mirror read back through the wire dialect (a successor on a
+    DIFFERENT host adopting the dead leader's ledger).  Returns the
+    restore summary, or None when both sources are cold."""
+    state = statestore.load() if statestore is not None else None
+    source = "journal"
+    if state is None and backend is not None:
+        get = getattr(backend, "get_state_snapshot", None)
+        if callable(get):
+            try:
+                payload = get()
+            except Exception as exc:  # noqa: BLE001 — a cold mirror or a
+                # dead wire both mean "start blind", never a crash
+                log.info("peer state snapshot unavailable: %s", exc)
+                payload = None
+            peer_version = 0
+            if isinstance(payload, dict):
+                try:
+                    peer_version = int(payload.get("v", 0) or 0)
+                except (TypeError, ValueError):
+                    peer_version = VERSION + 1  # unparsable: refuse
+            if isinstance(payload, dict) and peer_version > VERSION:
+                # Same rule as the journal's future-version header
+                # check: adopting a newer format's half-understood
+                # state is worse than starting blind.
+                log.warning(
+                    "peer state snapshot is format v%s (> supported "
+                    "v%s); starting blind instead of misreading it",
+                    payload.get("v"), VERSION,
+                )
+            elif isinstance(payload, dict) and \
+                    isinstance(payload.get("state"), dict):
+                state = payload["state"]
+                source = "peer"
+                if statestore is not None:
+                    try:
+                        statestore.cycle = max(
+                            statestore.cycle,
+                            int(payload.get("cycle", 0)),
+                        )
+                    except (TypeError, ValueError):
+                        pass
+    if not state:
+        return None
+    return restore_state(
+        state, health=health, guardrails=guardrails, scheduler=scheduler,
+        max_age_cycles=max_age_cycles, source=source,
+    )

@@ -1,0 +1,160 @@
+"""CRC-framed, versioned, append-only JSONL journal.
+
+The durability substrate of the operational statestore
+(doc/design/state-durability.md).  Every record is one line::
+
+    <crc32 of body, 8 hex digits> <body: compact sorted-keys JSON>\\n
+
+The CRC frame makes corruption DETECTABLE per record; the line framing
+makes it RECOVERABLE: a torn tail (crash mid-append), a bit-flipped
+record, or outright garbage truncates the journal at the last valid
+record instead of poisoning the load.  ``read_journal`` therefore
+never raises — it returns the longest valid prefix plus a count of
+dropped records, and the caller decides how loudly to complain.
+
+The first record is a version header (``{"kind": "header", "v": 1}``).
+A journal whose header is missing, unreadable, or from a FUTURE
+version is treated as wholly corrupt: adopting half-understood state
+is worse than starting blind, which is exactly what a cold start does.
+
+Write discipline (the cycle thread appends at end-of-cycle):
+
+* appends are ``write`` + ``flush`` — NO fsync per record; an append
+  lost to a power cut costs one cycle of soft state, not correctness;
+* ``compact()`` rewrites the file down to header + latest snapshot
+  through a temp file, fsyncs it, and atomically renames — the only
+  fsyncs are compaction and shutdown.
+
+Append/compact failures (full disk, yanked volume) are logged and
+swallowed: losing durability must never kill a scheduling cycle.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import zlib
+
+log = logging.getLogger(__name__)
+
+#: Journal format version; a record stream from a NEWER version is
+#: refused whole (treated as corrupt) rather than half-understood.
+VERSION = 1
+
+#: File name inside a ``--state-dir``.
+JOURNAL_NAME = "operational-state.jsonl"
+
+
+def journal_path(state_dir: str) -> str:
+    return os.path.join(state_dir, JOURNAL_NAME)
+
+
+def frame(payload: dict) -> bytes:
+    """One CRC-framed journal line for `payload`."""
+    body = json.dumps(payload, sort_keys=True, separators=(",", ":"))
+    raw = body.encode("utf-8")
+    crc = zlib.crc32(raw) & 0xFFFFFFFF
+    return f"{crc:08x} ".encode("ascii") + raw + b"\n"
+
+
+def _parse_line(raw: bytes) -> dict | None:
+    """Decode one framed line (WITHOUT its trailing newline) or None."""
+    if len(raw) < 10 or raw[8:9] != b" ":
+        return None
+    try:
+        crc = int(raw[:8], 16)
+    except ValueError:
+        return None
+    body = raw[9:]
+    if zlib.crc32(body) & 0xFFFFFFFF != crc:
+        return None
+    try:
+        obj = json.loads(body.decode("utf-8"))
+    except (UnicodeDecodeError, json.JSONDecodeError):
+        return None
+    return obj if isinstance(obj, dict) else None
+
+
+def header_record() -> dict:
+    return {"kind": "header", "v": VERSION}
+
+
+def _valid_header(rec: dict) -> bool:
+    try:
+        return rec.get("kind") == "header" and int(rec.get("v", 0)) <= VERSION
+    except (TypeError, ValueError):
+        return False
+
+
+def _future_version(rec: dict) -> int | None:
+    """The header's version IF it is a well-formed header from a
+    NEWER format, else None."""
+    try:
+        if rec.get("kind") == "header" and int(rec.get("v", 0)) > VERSION:
+            return int(rec["v"])
+    except (TypeError, ValueError):
+        pass
+    return None
+
+
+def read_journal(path: str) -> tuple[list[dict], int]:
+    """``(records, dropped)`` — the longest valid prefix of `path`
+    (header excluded from `records`) and how many records were dropped
+    to CORRUPTION (bad CRC/JSON, torn tail, missing header —
+    everything at and past the first invalid line counts as dropped).
+    A well-formed header from a FUTURE format version is refused whole
+    but is NOT corruption: the journal reads as empty with zero drops
+    (the file belongs to a newer binary and must be left intact).
+    NEVER raises; a missing/unreadable file is just an empty journal."""
+    records, dropped, _bytes, _future = read_journal_prefix(path)
+    return records, dropped
+
+
+def read_journal_prefix(
+    path: str,
+) -> tuple[list[dict], int, int, int | None]:
+    """`read_journal` plus the BYTE length of the valid prefix — the
+    offset a recovering writer must truncate to before appending (a
+    frame appended after a torn line with no trailing newline would
+    merge into the garbage and every later load would drop it too) —
+    and, when the stream was refused because its header is from a
+    NEWER format, that future version number (the caller must PRESERVE
+    the file, not truncate or append to it)."""
+    try:
+        with open(path, "rb") as f:
+            data = f.read()
+    except OSError:
+        return [], 0, 0, None
+    if not data:
+        return [], 0, 0, None
+    lines = data.split(b"\n")
+    tail = lines[-1]          # b"" iff the file ends with a newline
+    complete = lines[:-1]
+    records: list[dict] = []
+    dropped = 1 if tail else 0    # a torn final record is corruption
+    valid = True
+    valid_bytes = 0
+    for raw in complete:
+        if not valid:
+            dropped += 1
+            continue
+        rec = _parse_line(raw)
+        if rec is None:
+            valid = False
+            dropped += 1
+            continue
+        records.append(rec)
+        valid_bytes += len(raw) + 1
+    if records:
+        future = _future_version(records[0])
+        if future is not None:
+            # A NEWER binary's journal: refused (we cannot half-
+            # understand it) but NOT corrupt — report it intact so the
+            # caller preserves it for the newer binary's return.
+            return [], 0, len(data), future
+    if not records or not _valid_header(records[0]):
+        # No trustworthy header: refuse the whole stream.  (An empty
+        # prefix with a corrupt first line already counted above.)
+        return [], dropped + len(records), 0, None
+    return records[1:], dropped, valid_bytes, None

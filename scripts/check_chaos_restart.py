@@ -1,0 +1,102 @@
+#!/usr/bin/env python
+"""Assert the crash-restart durable-state chaos acceptance criteria
+over two same-seed runs (make chaos):
+
+* both runs completed with zero invariant violations and converged;
+* the scheduler crash-restarted at least once, and EVERY restart
+  adopted durable state (journal or peer mirror — never a blind cold
+  start while a journal existed);
+* quarantine survived: at least one restart happened mid-cordon, the
+  cordoned node came back cordoned, and ZERO placements landed on a
+  cordoned node in any post-restart tick (the engine's per-tick
+  placement-on-cordoned invariant, surfaced here as a count);
+* the refused bucket was never recompiled: the post-restart probe
+  answered False from the RESTORED pin with zero fresh refusals and no
+  compiled executable at the pinned shapes;
+* the breaker re-opened without a re-streak: at least one restart
+  happened with the breaker OPEN, it was OPEN after the restore, and
+  zero write requests reached the wire in between;
+* the journal actually worked: appends > 0, compactions > 0 (the
+  bounded-journal discipline), zero corrupt drops, and the HA mirror
+  landed cluster-side at least once;
+* same seed ⇒ same trace hash across the two runs — the whole
+  crash/adopt/reconcile dance is deterministic.
+"""
+
+import json
+import sys
+
+
+def main(path_a: str, path_b: str) -> int:
+    with open(path_a, encoding="utf-8") as f:
+        a = json.load(f)
+    with open(path_b, encoding="utf-8") as f:
+        b = json.load(f)
+    for name, run in (("run1", a), ("run2", b)):
+        assert run["ok"], f"{name} violations: {run['violations']}"
+        assert run["converged_after_drain_ticks"] is not None, \
+            f"{name}: never converged"
+        r = run["restart"]
+        assert r is not None, f"{name}: no restart summary"
+        assert r["restarts"] >= 1, r
+        seq = r["sequence"]
+        assert len(seq) == r["restarts"], r
+        assert all(s["source"] is not None for s in seq), \
+            f"{name}: a restart adopted no durable state: {seq}"
+        cordon_restores = [s for s in seq if s["pre_cordoned"]]
+        assert cordon_restores, \
+            f"{name}: no restart happened mid-quarantine: {seq}"
+        for s in cordon_restores:
+            missing = [
+                n for n in s["pre_cordoned"]
+                if n not in s["post_cordoned"]
+            ]
+            assert not missing, \
+                f"{name}: quarantine lost across restart: {s}"
+        assert r["cordoned_placements"] == 0, \
+            f"{name}: placements leaked onto cordoned nodes: {r}"
+        p = r["pin_probe"]
+        assert p is not None and p["pinned"], \
+            f"{name}: refusal pin did not survive: {p}"
+        assert not p["compiled_refused_shape"] and \
+            not p["recompiled_refusals"], \
+            f"{name}: refused bucket was recompiled: {p}"
+        open_restores = [s for s in seq if s["breaker_pre"] == "open"]
+        assert open_restores, \
+            f"{name}: no restart happened mid-breaker-open: {seq}"
+        for s in open_restores:
+            assert s["breaker_post"] == "open", \
+                f"{name}: breaker not re-opened after restore: {s}"
+            assert s["wire_writes_during_restart"] == 0, \
+                f"{name}: breaker re-opened only after a fresh " \
+                f"failure streak touched the wire: {s}"
+        j = r["journal"]
+        assert j and j["appends"] > 0 and j["compactions"] > 0, \
+            f"{name}: journal never exercised: {j}"
+        assert j["corrupt_dropped"] == 0, \
+            f"{name}: journal corruption during a clean run: {j}"
+        assert r["mirrored"], f"{name}: HA mirror never landed: {r}"
+        commit = run["commit"]
+        if commit.get("mode") == "pipelined":
+            assert commit["depth"] == 0, f"{name} undrained: {commit}"
+            assert commit["order_violations"] == 0, commit
+            assert commit["flush_errors"] == 0, commit
+    assert a["trace_hash"] == b["trace_hash"], (
+        f"same-seed crash-restart runs diverged: "
+        f"{a['trace_hash']} != {b['trace_hash']}"
+    )
+    r = a["restart"]
+    print(
+        "chaos restart: ok — same-seed hash "
+        f"{a['trace_hash'][:16]}… reproduced; {r['restarts']} "
+        f"restart(s), {len([s for s in r['sequence'] if s['pre_cordoned']])} "
+        f"mid-quarantine (0 cordoned placements), pin survived "
+        f"(0 recompiles), breaker re-opened without a re-streak, "
+        f"journal appends={r['journal']['appends']} "
+        f"compactions={r['journal']['compactions']}, mirror landed"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1], sys.argv[2]))

@@ -1,0 +1,64 @@
+#!/usr/bin/env python
+"""Run the tier-1 suite (the driver's exact command) with wall-clock
+timing and FAIL LOUDLY when it exceeds 90% of the 870 s budget.
+
+Why this exists: every PR so far has discovered tier-1 budget
+overruns AT PR TIME (the driver's timeout killing a green suite) and
+then scrambled to move the heaviest tests behind the `slow` marker.
+Wiring this into `make verify` surfaces the drift locally: the suite
+still runs exactly once (make verify runs the `slow` remainder
+separately), but the tier-1 wall time becomes a tracked, enforced
+number instead of a surprise.
+
+Exit codes: pytest's own non-zero rc passes through (test failures
+fail verify as before); rc 3 means the suite passed but blew the
+budget threshold — triage the slowest tests behind `slow` NOW, not at
+PR time (`--durations=15` output is printed for exactly that).
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+#: The driver's tier-1 timeout (ROADMAP.md · Tier-1 verify).
+BUDGET_S = 870.0
+#: Alarm threshold: fail verify while there is still headroom to fix.
+THRESHOLD = 0.90
+
+CMD = [
+    sys.executable, "-m", "pytest", "tests/", "-q",
+    "-m", "not slow",
+    "--continue-on-collection-errors",
+    "-p", "no:cacheprovider",
+    "--durations=15",
+]
+
+
+def main() -> int:
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    started = time.monotonic()
+    rc = subprocess.call(CMD, env=env)
+    elapsed = time.monotonic() - started
+    limit = BUDGET_S * THRESHOLD
+    print(
+        f"tier-1 wall clock: {elapsed:.0f}s of the {BUDGET_S:.0f}s "
+        f"budget ({elapsed / BUDGET_S:.0%}; alarm at {limit:.0f}s)"
+    )
+    if rc != 0:
+        return rc
+    if elapsed > limit:
+        print(
+            f"TIER-1 BUDGET ALARM: {elapsed:.0f}s exceeds "
+            f"{THRESHOLD:.0%} of the {BUDGET_S:.0f}s budget — move "
+            "the slowest tests above behind the `slow` marker before "
+            "this becomes a driver timeout at PR time",
+            file=sys.stderr,
+        )
+        return 3
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -14,9 +14,25 @@
 #   2. `python bench.py` — the full scoreboard, which fits its 480 s
 #      budget only with a warm cache.
 #
-# Usage:  nohup scripts/tpu_healthy_window_watcher.sh & 
-# Logs:   /tmp/watcher.log, /tmp/watcher_warm.log, /tmp/bench_final.*
-cd "$(dirname "$0")/.."
+# Usage:  nohup scripts/tpu_healthy_window_watcher.sh &
+#
+# Env knobs (warm_bench_programs.sh discipline): PYTHON (interpreter,
+# default python3), WATCHER_LOG (default /tmp/watcher.log),
+# WATCHER_WARM_LOG (default /tmp/watcher_warm.log), WATCHER_BENCH_OUT
+# (default /tmp/bench_final.json), WATCHER_PROBE_TIMEOUT (seconds,
+# default 120), WATCHER_WARM_TIMEOUT (seconds, default 2400).
+set -euo pipefail
+cd "$(dirname "$0")/.." || {
+  echo "tpu_healthy_window_watcher.sh: cannot cd to repo root" >&2
+  exit 1
+}
+PY="${PYTHON:-python3}"
+LOG="${WATCHER_LOG:-/tmp/watcher.log}"
+WARM_LOG="${WATCHER_WARM_LOG:-/tmp/watcher_warm.log}"
+BENCH_OUT="${WATCHER_BENCH_OUT:-/tmp/bench_final.json}"
+PROBE_T="${WATCHER_PROBE_TIMEOUT:-120}"
+WARM_T="${WATCHER_WARM_TIMEOUT:-2400}"
+
 PROBE='
 import jax, jax.numpy as jnp, time
 x = jnp.ones((8, 8)); assert float((x @ x).sum()) == 512.0
@@ -27,20 +43,23 @@ print("probe ok, compile", round(time.time() - t0, 1), "s")
 n=0
 while true; do
   n=$((n + 1))
-  if timeout 120 python -c "$PROBE" >>/tmp/watcher.log 2>&1; then
-    echo "$(date +%T) probe $n healthy - firing warm" >>/tmp/watcher.log
-    python -m kube_batch_tpu.warm --shape-configs 5 --timeout 2400 \
-      >>/tmp/watcher_warm.log 2>&1
-    rc=$?
-    echo "$(date +%T) warm rc=$rc" >>/tmp/watcher.log
-    if [ $rc -eq 0 ]; then
-      echo "$(date +%T) warm complete - firing bench" >>/tmp/watcher.log
-      python bench.py >/tmp/bench_final.json 2>/tmp/bench_final.err
-      echo "$(date +%T) bench rc=$? ALL DONE" >>/tmp/watcher.log
+  # Probe failure/hang must not abort the loop under set -e: tested in
+  # the `if` condition, never as a bare command.
+  if timeout "$PROBE_T" "$PY" -c "$PROBE" >>"$LOG" 2>&1; then
+    echo "$(date +%T) probe $n healthy - firing warm" >>"$LOG"
+    rc=0
+    "$PY" -m kube_batch_tpu.warm --shape-configs 5 --timeout "$WARM_T" \
+      >>"$WARM_LOG" 2>&1 || rc=$?
+    echo "$(date +%T) warm rc=$rc" >>"$LOG"
+    if [ "$rc" -eq 0 ]; then
+      echo "$(date +%T) warm complete - firing bench" >>"$LOG"
+      rc=0
+      "$PY" bench.py >"$BENCH_OUT" 2>"${BENCH_OUT%.json}.err" || rc=$?
+      echo "$(date +%T) bench rc=$rc ALL DONE" >>"$LOG"
       break
     fi
   else
-    echo "$(date +%T) probe $n failed/hung" >>/tmp/watcher.log
+    echo "$(date +%T) probe $n failed/hung" >>"$LOG"
   fi
   sleep 120
 done

@@ -1,0 +1,175 @@
+"""Chaos × durable operational memory: crash-restart-loop scenarios
+through the REAL wire stack (doc/design/state-durability.md).
+
+The scenario kills and restarts the scheduler process three times —
+mid-quarantine, mid-refusal and mid-breaker-open — rebuilding every
+in-memory world object from config and re-adopting the statestore
+journal each time (the identical `adopt_state` path the CLI runs).
+The engine asserts the survival invariants itself (`_check_restart`:
+state-adopted, quarantine-survives-restart, refusal-pin-survives /
+refused-bucket-never-recompiled, breaker-reopen-without-re-streak)
+plus the per-tick placement-on-cordoned check over the restored
+ledger, so `result.ok` carries them all; the tests below pin the
+observable summary, the cold-start/corrupt-journal parity acceptance
+criterion, and same-seed reproducibility.
+"""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+from kube_batch_tpu.chaos import ChaosEngine, FaultSpec, ScenarioSpec
+from kube_batch_tpu.statestore import journal_path
+
+# examples/chaos-restart.json, inlined (same workload as the flaky
+# scenario — modest churn, stable padding buckets).
+SCENARIO = ScenarioSpec(
+    nodes=5,
+    arrival_rate=1.0,
+    burst_every=8,
+    burst_size=2,
+    gang_max=3,
+    lifetime_mean=20.0,
+    node_churn_every=0,
+    target_utilization=0.6,
+)
+FAULTS = FaultSpec(
+    stream_drop_every=0, gap_every=0, bind_fail_pct=0,
+    node_vanish_every=0, lease_steal_every=0,
+    flaky_at=2, flaky_ticks=14, flaky_fail_pct=90, flaky_flap_every=3,
+    flaky_drain_budget=0,
+    hbm_pin_at=6,
+    crash_restart_at=9, crash_restarts=3, crash_restart_every=4,
+    blackhole_at=12, blackhole_ticks=6,
+)
+
+
+def _run(seed: int = 23, ticks: int = 26, faults: FaultSpec = FAULTS,
+         state_dir: str | None = None):
+    return ChaosEngine(
+        seed=seed, ticks=ticks, scenario=SCENARIO, faults=faults,
+        drain=60, wire_commit="pipelined", state_dir=state_dir,
+    ).run()
+
+
+def test_crash_restart_loop_state_survives():
+    result = _run()
+    # ok folds in _check_restart (state-adopted, quarantine/pin/
+    # breaker survival) AND the per-tick placement-on-cordoned check
+    # against the RESTORED ledger across all three incarnations.
+    assert result.ok, [v.as_dict() for v in result.violations]
+    r = result.restart
+    assert r is not None
+    assert r["restarts"] == 3
+    seq = r["sequence"]
+    # Every restart adopted durable state; epochs strictly climb.
+    assert all(s["source"] == "journal" for s in seq)
+    assert [s["epoch"] for s in seq] == sorted(
+        {s["epoch"] for s in seq}
+    )
+    # At least one restart mid-quarantine: the cordon came back, and
+    # zero placements landed on it afterward.
+    mid_cordon = [s for s in seq if s["pre_cordoned"]]
+    assert mid_cordon, seq
+    assert all(
+        s["pre_cordoned"] == s["post_cordoned"] for s in mid_cordon
+    )
+    assert r["cordoned_placements"] == 0
+    # At least one restart mid-breaker-open: re-opened from the
+    # journal with ZERO wire writes in between (no fresh streak).
+    mid_open = [s for s in seq if s["breaker_pre"] == "open"]
+    assert mid_open, seq
+    assert all(
+        s["breaker_post"] == "open"
+        and s["wire_writes_during_restart"] == 0
+        for s in mid_open
+    )
+    # The post-restart probe answered from the RESTORED pin without
+    # recompiling the refused bucket.
+    p = r["pin_probe"]
+    assert p["pinned"] and p["verdict"] is False
+    assert p["recompiled_refusals"] == 0
+    assert not p["compiled_refused_shape"]
+    # The journal machinery actually ran: appends, compactions, the
+    # HA mirror, and a clean load every restart.
+    assert r["journal"]["appends"] > 0
+    assert r["journal"]["compactions"] > 0
+    assert r["journal"]["corrupt_dropped"] == 0
+    assert r["mirrored"]
+    # The workload still converged whole through three crashes.
+    assert result.converged_tick is not None
+    assert result.commit["depth"] == 0
+    assert result.recoveries.get("crash-restart") == 3
+
+
+def test_cold_and_corrupt_state_dirs_match_stateless_run(tmp_path):
+    """Acceptance parity: a cold start (empty/missing state dir) and a
+    corrupt-journal start must reach the SAME converged final
+    assignment (and hash) as a run without any statestore — the
+    durability layer is decision-invisible when there is nothing to
+    restore, and a corrupt journal degrades to a cold start instead
+    of crashing or skewing decisions."""
+    faults = FaultSpec(
+        stream_drop_every=0, gap_every=0, bind_fail_pct=10,
+        node_vanish_every=0, lease_steal_every=0,
+    )
+    baseline = _run(seed=5, ticks=10, faults=faults)  # no statestore
+    assert baseline.ok
+
+    cold_dir = str(tmp_path / "cold")
+    os.makedirs(cold_dir)
+    cold = _run(seed=5, ticks=10, faults=faults, state_dir=cold_dir)
+
+    corrupt_dir = str(tmp_path / "corrupt")
+    os.makedirs(corrupt_dir)
+    with open(journal_path(corrupt_dir), "wb") as f:
+        f.write(b"\x00\xffgarbage not a journal\nffffffff {broken\n")
+    corrupt = _run(seed=5, ticks=10, faults=faults,
+                   state_dir=corrupt_dir)
+
+    for run in (cold, corrupt):
+        assert run.ok, [v.as_dict() for v in run.violations]
+        assert run.trace_hash == baseline.trace_hash
+        assert run.final_assignment == baseline.final_assignment
+    # The corrupt journal was detected, counted, and then OVERWRITTEN
+    # by the run's own valid appends.
+    assert corrupt.restart is None  # no restart faults in this spec
+    records_ok = journal_path(corrupt_dir)
+    from kube_batch_tpu.statestore import read_journal
+
+    records, dropped = read_journal(records_ok)
+    assert dropped == 0 or records  # post-run journal is readable
+
+
+def test_restart_meta_fields_survive_replay():
+    """crash_restart_* / hbm_pin_at change run behavior (the restart
+    dance is not derivable from the inline schedule), so they ride the
+    trace meta header and are adopted on replay."""
+    meta = {"tick": -1, "op": "meta", "seed": 23,
+            "crash_restart_at": 9, "crash_restarts": 3,
+            "crash_restart_every": 4, "hbm_pin_at": 6}
+    eng = ChaosEngine(seed=23, ticks=26, events=[meta])
+    assert eng.faults.crash_restart_at == 9
+    assert eng.faults.crash_restarts == 3
+    assert eng.faults.hbm_pin_at == 6
+    # Restart faults alone wire health + guardrails + (lazily, at
+    # run time) a statestore — a never-run engine leaves no temp dir.
+    assert eng.health is not None
+    assert eng.guardrails is not None
+    assert eng.faults.restart_faults and eng.state_dir is None
+
+
+@pytest.mark.slow  # double engine run; kept out of the tier-1 budget
+def test_restart_same_seed_same_hash():
+    """The whole crash-restart dance — three restarts, journal
+    adoption, reconcile, breaker restore — is deterministic: same
+    seed ⇒ same trace hash and final assignment (journal timestamps
+    come from the tick clock)."""
+    a, b = _run(), _run()
+    assert a.ok and b.ok
+    assert a.trace_hash == b.trace_hash
+    assert a.final_assignment == b.final_assignment
+    assert [s["epoch"] for s in a.restart["sequence"]] == \
+        [s["epoch"] for s in b.restart["sequence"]]

@@ -63,6 +63,31 @@ def test_main_runs_cycles_on_config1(tmp_path):
     assert rc == 0
 
 
+def test_state_dir_journals_and_warm_restarts(tmp_path):
+    """--state-dir end to end through the CLI: run 1 journals a manual
+    cordon; run 2 (no --cordon-nodes) ADOPTS it from the journal and
+    keeps journaling it — the warm-restart contract
+    (doc/design/state-durability.md)."""
+    from kube_batch_tpu.statestore import journal_path, read_journal
+
+    state_dir = str(tmp_path / "state")
+    base = ["--workload", "1", "--cycles", "2", "--schedule-period",
+            "0", "--listen-address", "", "--state-dir", state_dir]
+    assert main(base + ["--cordon-nodes", "flaky-a"]) == 0
+    records, dropped = read_journal(journal_path(state_dir))
+    assert dropped == 0 and records
+    rec = records[-1]["state"]["ledger"]["records"]["flaky-a"]
+    assert rec["state"] == "cordoned" and rec["manual"] is True
+
+    # Restart WITHOUT the flag: the quarantine must come back from
+    # the journal (and ride into the new incarnation's own appends).
+    assert main(base) == 0
+    records, dropped = read_journal(journal_path(state_dir))
+    assert dropped == 0 and records
+    rec = records[-1]["state"]["ledger"]["records"]["flaky-a"]
+    assert rec["state"] == "cordoned" and rec["manual"] is True
+
+
 def test_leader_election_blocks_second_acquirer(tmp_path):
     lock_path = str(tmp_path / "leader.lock")
     holder = acquire_leadership(lock_path)

@@ -483,24 +483,63 @@ def test_drain_budget_limits_gangs_per_cycle():
     assert drain_cordoned_gangs(cache, ledger) == 0
 
 
-def test_node_deletion_forgets_health_record():
+def test_node_deletion_forgets_health_record(tmp_path):
     """A decommissioned cordoned node must not count as quarantined
-    forever (metrics + /healthz), and records must not grow without
-    bound under node churn."""
+    forever (metrics + /healthz), records must not grow without bound
+    under node churn — and neither must the DURABLE journal: a
+    forgotten node's persisted record is purged at the next
+    compaction, so the file does not grow monotonically across
+    add/delete cycles (doc/design/state-durability.md)."""
     import json
+    import os
 
     from kube_batch_tpu import metrics
+    from kube_batch_tpu.scheduler import Scheduler
+    from kube_batch_tpu.statestore import (
+        StateStore,
+        collect_state,
+        journal_path,
+        read_journal,
+    )
 
     cache, sim = make_world(SPEC)
     sim.add_node(_node("doomed"))
     ledger = NodeHealthLedger(NodeHealthConfig())
     cache.attach_health(ledger)
+    scheduler = Scheduler(cache)
+    scheduler.health = ledger
+    store = StateStore(journal_path(str(tmp_path)), compact_every=6)
     ledger.cordon("doomed")
     assert ledger.quarantined_count() == 1
+    store.append(collect_state(scheduler))
+    assert b"doomed" in open(store.path, "rb").read()
     sim.delete_node("doomed")
     assert ledger.quarantined_count() == 0
     assert ledger.state_of("doomed") == NodeState.OK  # clean slate
     assert json.loads(metrics.health_body())["quarantined"] == 0
+    # cache.delete_node -> ledger.forget also purged the node's
+    # PERSISTED record at the next compaction.
+    store.append(collect_state(scheduler))
+    store.compact()
+    assert b"doomed" not in open(store.path, "rb").read()
+    # Bounded under churn: the journal's size is set by compact_every,
+    # not by how many nodes ever came and went.
+    sizes = []
+    for i in range(40):
+        name = f"churn-{i}"
+        sim.add_node(_node(name))
+        ledger.cordon(name)
+        store.append(collect_state(scheduler))
+        sim.delete_node(name)
+        store.append(collect_state(scheduler))
+        sizes.append(os.path.getsize(store.path))
+    assert min(sizes[-6:]) < max(sizes)     # compaction shrank it back
+    store.compact()
+    # Compacted down to header + one snapshot — a fraction of the
+    # churn peak; a monotonically growing journal would fail this.
+    assert os.path.getsize(store.path) * 2 < max(sizes)
+    records, dropped = read_journal(store.path)
+    assert dropped == 0 and len(records) <= 8
 
 
 def test_transient_flush_failure_returns_canary_slot():

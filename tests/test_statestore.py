@@ -1,0 +1,712 @@
+"""Durable operational memory tests (kube_batch_tpu/statestore/).
+
+Coverage map (doc/design/state-durability.md):
+
+* the CRC-framed journal — roundtrip, digest-deduped appends,
+  compaction down to header + latest snapshot (fsync sites), and the
+  corruption contract: truncation at EVERY byte boundary and seeded
+  bit flips must never raise, must recover the longest valid prefix,
+  and must count drops in ``statestore_load_corrupt_total``;
+* ledger export/restore — quarantine/probation/manual records survive
+  a restart, staleness decay drops records older than
+  ``--state-max-age-cycles`` (counted), missed decay folds into the
+  restored score, this boot's fresh evidence wins over the journal,
+  pending cordon-mirror retries re-arm;
+* guardrail export/restore — an OPEN breaker re-opens WITHOUT a fresh
+  failure streak (quiescing scheduling via on_open), the watchdog
+  resumes its rung and walks down through normal hysteresis;
+* HBM refusal pins — persisted by shape, re-validated against the
+  LIVE ceiling at restore, adopted by `_pin_blocks` under the live
+  key, and `warm_grown` answers from the pin without recompiling;
+* bounded journal under node churn — `ledger.forget` (via
+  `cache.delete_node`) purges the node's persisted record at the next
+  compaction and the file does not grow monotonically;
+* HA adoption — `adopt_state` prefers the local journal and falls
+  back to the peer mirror, and the mirror round-trips through the
+  wire dialect (putStateSnapshot/getStateSnapshot, epoch-fenced).
+"""
+
+from __future__ import annotations
+
+import os
+import random
+
+from kube_batch_tpu import metrics
+from kube_batch_tpu.guardrails import (
+    CircuitBreaker,
+    GuardrailConfig,
+    Guardrails,
+)
+from kube_batch_tpu.health import NodeHealthConfig, NodeHealthLedger, NodeState
+from kube_batch_tpu.statestore import (
+    StateStore,
+    adopt_state,
+    journal_path,
+    read_journal,
+    restore_state,
+)
+
+
+def _store(tmp_path, **kw) -> StateStore:
+    return StateStore(journal_path(str(tmp_path)), **kw)
+
+
+# -- journal basics ---------------------------------------------------------
+
+def test_journal_roundtrip_and_dedupe(tmp_path):
+    s = _store(tmp_path)
+    assert s.load() is None                      # cold start
+    s.append({"a": 1})
+    s.append({"a": 1})                           # digest-deduped
+    s.append({"a": 2})
+    assert s.appends == 2
+    assert s.cycle == 3                          # every call ticks the clock
+    s.close()
+    s2 = _store(tmp_path)
+    assert s2.load() == {"a": 2}
+    assert s2.cycle == 3
+    assert s2.corrupt_dropped == 0
+
+
+def test_idle_ledger_clock_dedupes_with_heartbeat(tmp_path):
+    """The ledger's bare clock ticks every cycle; an otherwise-idle
+    daemon must NOT journal it per cycle — but a heartbeat append once
+    per compact_every window keeps restore-time staleness ages honest
+    across long idle stretches."""
+    s = _store(tmp_path, compact_every=8)
+
+    def state(c):
+        return {
+            "ledger": {"cycle": c, "records": {
+                "ops": {"state": "cordoned", "manual": True,
+                        "updated": 1},
+            }},
+            "guardrails": {"rung": 0},
+        }
+
+    for c in range(1, 9):
+        s.append(state(c))
+    assert s.appends == 1            # clock-only changes deduped
+    s.append(state(9))               # drift hits compact_every
+    assert s.appends == 2            # ...heartbeat persisted the clock
+    s.close()
+    s2 = _store(tmp_path)
+    assert s2.load() == state(9)     # ages computed against cycle 9
+
+
+def test_failed_append_retries_instead_of_dedupe_suppressing(tmp_path):
+    """A state change whose append hit an IO error must persist on the
+    NEXT append — recording the digest before the write succeeded
+    would dedupe-suppress it forever."""
+    s = _store(tmp_path)
+    s.append({"a": 1})
+
+    def boom():
+        raise OSError("disk full")
+
+    s._open = boom                   # shadow the bound method
+    s.append({"a": 2})               # swallowed, NOT marked written
+    del s.__dict__["_open"]
+    s.append({"a": 2})               # same state again: must write now
+    s.close()
+    assert _store(tmp_path).load() == {"a": 2}
+
+
+def test_compaction_bounds_the_journal(tmp_path):
+    s = _store(tmp_path, compact_every=4)
+    for i in range(20):
+        s.append({"i": i})
+    assert s.compactions >= 4
+    records, dropped = read_journal(s.path)
+    assert dropped == 0
+    # Bounded: at most compact_every live records since the last
+    # compaction (plus the compacted snapshot itself).
+    assert len(records) <= 5
+    assert s.load() == {"i": 19}
+
+
+def test_close_compacts_and_fsyncs(tmp_path):
+    s = _store(tmp_path, compact_every=1000)
+    for i in range(9):
+        s.append({"i": i})
+    s.close()
+    records, dropped = read_journal(s.path)
+    assert dropped == 0
+    assert len(records) == 1                     # header excluded
+    assert records[0]["state"] == {"i": 8}
+
+
+def test_truncation_at_every_byte_boundary_never_raises(tmp_path):
+    s = _store(tmp_path)
+    for i in range(6):
+        s.append({"i": i, "blob": "x" * 17})
+    s.close()
+    data = open(s.path, "rb").read()
+    assert len(data) > 100
+    before = metrics.statestore_load_corrupt.value()
+    recovered = 0
+    for cut in range(len(data) + 1):
+        with open(s.path, "wb") as f:
+            f.write(data[:cut])
+        t = StateStore(s.path)
+        state = t.load()                         # must never raise
+        if state is not None:
+            recovered += 1
+            assert set(state) == {"i", "blob"}   # a real valid prefix
+    assert recovered > 0
+    # Truncations that tore a record counted their drops.
+    assert metrics.statestore_load_corrupt.value() > before
+
+
+def test_bit_flip_fuzz_recovers_longest_valid_prefix(tmp_path):
+    s = _store(tmp_path)
+    for i in range(8):
+        s.append({"i": i})
+    s.close()
+    data = open(s.path, "rb").read()
+    rng = random.Random(20260804)
+    for _ in range(200):
+        pos = rng.randrange(len(data))
+        bit = 1 << rng.randrange(8)
+        corrupt = data[:pos] + bytes([data[pos] ^ bit]) + data[pos + 1:]
+        with open(s.path, "wb") as f:
+            f.write(corrupt)
+        t = StateStore(s.path)
+        state = t.load()                         # must never raise
+        if state is not None:
+            # Whatever survived is a CRC-valid prefix record.
+            assert state == {"i": state["i"]}
+    # Outright garbage header: everything drops, still no raise.
+    with open(s.path, "wb") as f:
+        f.write(b"\x00\xff" * 64 + b"\n" + data)
+    t = StateStore(s.path)
+    assert t.load() is None
+    assert t.corrupt_dropped > 0
+
+
+def test_torn_tail_truncated_so_new_appends_stay_readable(tmp_path):
+    """A crash mid-append leaves a torn (newline-less) last line.  The
+    recovering load must TRUNCATE it: a frame appended behind the torn
+    bytes would merge into them, and every later load would silently
+    drop all post-crash records — up to a full compact_every window of
+    quarantine/breaker/pin evidence lost on the next crash."""
+    s = _store(tmp_path, compact_every=1000)
+    s.append({"a": 1})
+    s.append({"a": 2})
+    s.close()
+    with open(s.path, "ab") as f:
+        f.write(b"f00dface {\"kind\": \"state\", torn mid-wri")  # no \n
+    s2 = _store(tmp_path, compact_every=1000)
+    assert s2.load() == {"a": 2}
+    assert s2.corrupt_dropped == 1
+    s2.append({"a": 3})
+    s2.append({"a": 4})
+    s2._f.close()                    # crash again: no close/compact
+    s3 = _store(tmp_path)
+    assert s3.load() == {"a": 4}     # post-crash appends SURVIVED
+    assert s3.corrupt_dropped == 0
+
+
+def test_wholly_corrupt_journal_rewritten_on_first_append(tmp_path):
+    """A journal whose HEADER is garbage is unreadable forever — the
+    first append must rewrite the file fresh instead of appending
+    records behind garbage no future load could recover."""
+    s = _store(tmp_path)
+    with open(s.path, "wb") as f:
+        f.write(b"garbage header, not a frame\n")
+    assert s.load() is None
+    assert s.corrupt_dropped == 1
+    s.append({"a": 1})
+    s.close()
+    s2 = _store(tmp_path)
+    assert s2.load() == {"a": 1}
+    assert s2.corrupt_dropped == 0
+
+
+def test_future_version_journal_preserved_not_destroyed(tmp_path):
+    """A version rollback must not ERASE the newer binary's memory:
+    the future-format journal is refused (cold start) but set aside
+    intact, and this incarnation journals to a fresh file."""
+    from kube_batch_tpu.statestore import frame
+
+    s = _store(tmp_path)
+    v2 = frame({"kind": "header", "v": 2}) + \
+        frame({"kind": "state", "cycle": 9, "state": {"from": "v2"}})
+    with open(s.path, "wb") as f:
+        f.write(v2)
+    before = metrics.statestore_load_corrupt.value()
+    assert s.load() is None                      # refused, cold start
+    # NOT corruption: no drops counted, bytes preserved verbatim.
+    assert metrics.statestore_load_corrupt.value() == before
+    side = s.path + ".refused-v2"
+    assert open(side, "rb").read() == v2
+    s.append({"from": "v1"})                     # fresh v1 journal
+    s.close()
+    assert _store(tmp_path).load() == {"from": "v1"}
+    assert open(side, "rb").read() == v2         # still intact
+
+
+def test_malformed_peer_state_starts_blind_never_crashes(tmp_path):
+    """The peer mirror arrives over the WIRE: garbage nested payloads
+    (non-dict records, string pins, junk rungs) must degrade to a
+    cold start — a bad ConfigMap must not crash-loop every successor
+    replica."""
+    garbage = {
+        "ledger": {
+            "cycle": 5,
+            "records": {"n": "cordoned", "m": 7},   # not dicts
+            "sink_pending": ["not", "a", "dict"],
+        },
+        "guardrails": {"rung": "overloaded", "breaker": {
+            "state": "open", "failures": "many",
+        }},
+        "hbm_pins": ["not-a-pin", {"shapes": "nope"}],
+    }
+    health = _ledger()
+    rails, cache, wire = _rails()
+    sched = _scheduler_with_ceiling(1000)
+    cold = StateStore(journal_path(str(tmp_path)))
+    out = adopt_state(
+        cold, backend=_PeerBackend({"v": 1, "state": garbage}),
+        health=health, guardrails=rails, scheduler=sched,
+    )
+    # Adoption survived; every malformed piece dropped or defaulted.
+    assert out is not None and out["source"] == "peer"
+    assert health.sample()["states"] == {}
+    assert out["ledger"]["dropped_malformed"] == 2
+    assert out["pins"] == {"restored": 0, "dropped": 2}
+    # A malformed breaker dict with state "open" still re-opens (the
+    # STATE string is valid; only the streak count was junk) — fail
+    # safe toward quiesce, with the probe as the heal path.
+    assert rails.breaker.state == CircuitBreaker.OPEN
+    assert wire.calls == []
+    # A newer-format peer snapshot is refused whole, like the journal
+    # header rule.
+    h2 = _ledger()
+    assert adopt_state(
+        StateStore(journal_path(str(tmp_path)) + ".2"),
+        backend=_PeerBackend({"v": 99, "state": {"ledger": {}}}),
+        health=h2,
+    ) is None
+
+
+def test_append_and_compact_never_raise_on_io_failure(tmp_path):
+    s = _store(tmp_path)
+    s.append({"i": 0})
+    s.close()
+    # Point the store at an unwritable path: appends/compactions must
+    # degrade to warnings, never kill the cycle thread.
+    s2 = StateStore(os.path.join(str(tmp_path), "no-such-dir", "j.jsonl"))
+    s2.append({"i": 1})
+    s2.compact()
+    s2.close()
+
+
+# -- ledger export / restore ------------------------------------------------
+
+def _ledger(**kw) -> NodeHealthLedger:
+    return NodeHealthLedger(NodeHealthConfig(
+        quarantine_threshold=2.0, probation_ticks=3, **kw,
+    ))
+
+
+def test_ledger_quarantine_survives_restore():
+    a = _ledger()
+    a.note_bind_failure("bad")
+    a.note_bind_failure("bad")
+    assert a.state_of("bad") == NodeState.CORDONED
+    a.cordon("ops", reason="manual")
+    state = a.export_state()
+
+    b = _ledger()
+    out = b.restore_state(state, max_age_cycles=100)
+    assert out == {"restored": 2, "dropped_stale": 0,
+                   "dropped_malformed": 0}
+    assert b.state_of("bad") == NodeState.CORDONED
+    assert not b.schedulable("bad")
+    assert b.state_of("ops") == NodeState.CORDONED
+    assert b._records["ops"].manual is True     # never auto-released
+    assert b.cordons_total >= a.cordons_total
+    # The clean window resumes where it left off, not from zero.
+    for _ in range(3):
+        b.on_cycle()
+    assert b.state_of("bad") == NodeState.PROBATION
+    assert b.state_of("ops") == NodeState.CORDONED  # manual stays
+
+
+def test_ledger_restore_stale_records_drop_toward_ok():
+    rec = {"state": "cordoned", "score": 0.0, "clean": 1, "mult": 1.0,
+           "canary": 0, "manual": False}
+    state = {
+        "cycle": 100,
+        "records": {
+            # Last evidence 95 cycles before the journal's final write:
+            # past a 10-cycle staleness horizon, ancient quarantine
+            # must decay toward ok instead of masking the node forever.
+            "old": {**rec, "updated": 5},
+            "fresh": {**rec, "updated": 99},
+        },
+        "sink_pending": {},
+    }
+    before = metrics.statestore_load_dropped_stale.value()
+    b = _ledger()
+    summary = restore_state(
+        {"ledger": state}, health=b, max_age_cycles=10,
+    )
+    assert b.state_of("old") == NodeState.OK        # stale: dropped
+    assert b.state_of("fresh") == NodeState.CORDONED
+    assert summary["ledger"] == {
+        "restored": 1, "dropped_stale": 1, "dropped_malformed": 0,
+    }
+    assert metrics.statestore_load_dropped_stale.value() == before + 1
+
+
+def test_ledger_restore_folds_missed_decay_into_score():
+    a = _ledger(decay=0.5)
+    a.note_bind_failure("n")                    # suspect, score 1.0
+    for _ in range(4):
+        a.on_cycle()                            # ages without export
+    state = a.export_state()
+    b = _ledger(decay=0.5)
+    b.restore_state(state, max_age_cycles=100)
+    # 1.0 × 0.5^4 = 0.0625 ≥ floor… score decayed below the floor
+    # drops the suspect record entirely (decayed clean).
+    assert b.state_of("n") == NodeState.OK
+
+
+def test_ledger_restore_this_boot_evidence_wins():
+    a = _ledger()
+    a.note_bind_failure("n")
+    a.note_bind_failure("n")                    # cordoned in the journal
+    state = a.export_state()
+    b = _ledger()
+    b.cordon("n", reason="manual (--cordon-nodes)")
+    b.restore_state(state, max_age_cycles=100)
+    assert b._records["n"].manual is True       # the manual cordon held
+
+
+def test_ledger_restore_rearms_pending_cordon_mirror():
+    a = _ledger()
+    a.cordon_sink = lambda n, u: (_ for _ in ()).throw(
+        ConnectionError("wire down")
+    )
+    a.note_bind_failure("n")
+    a.note_bind_failure("n")                    # cordon; mirror PENDING
+    state = a.export_state()
+    assert state["sink_pending"] == {"n": True}
+
+    pushed = []
+    b = _ledger()
+    b.cordon_sink = lambda n, u: pushed.append((n, u))
+    b.restore_state(state, max_age_cycles=100)
+    b.on_cycle()                                # the retry clock
+    assert ("n", True) in pushed
+
+
+# -- guardrail export / restore ---------------------------------------------
+
+class _Wire:
+    def __init__(self):
+        self.calls = []
+
+    def bind(self, pod, node):
+        self.calls.append("bind")
+        raise ConnectionError("dead")
+
+    def evict(self, pod, reason):
+        pass
+
+    def update_pod_group(self, group):
+        pass
+
+    def ping(self):
+        self.calls.append("ping")
+
+
+class _Quiesce:
+    def __init__(self):
+        self.holds = 0
+
+    def begin_resync(self):
+        self.holds += 1
+
+    def end_resync(self):
+        self.holds -= 1
+
+    def record_event(self, *a, **k):
+        pass
+
+
+def _rails() -> tuple[Guardrails, _Quiesce, _Wire]:
+    rails = Guardrails(GuardrailConfig(
+        breaker_failures=3, breaker_reset_s=60.0,
+        backoff_attempts=1,
+    ))
+    cache = _Quiesce()
+    wire = _Wire()
+    rails.guard_backend(wire, cache, sleep=lambda s: None)
+    return rails, cache, wire
+
+
+def test_breaker_reopens_without_re_streak():
+    a, cache_a, wire_a = _rails()
+    for _ in range(3):
+        try:
+            a._guarded.bind(object(), "n")
+        except ConnectionError:
+            pass
+    assert a.breaker.state == CircuitBreaker.OPEN
+    state = a.export_state()
+    assert state["breaker"]["state"] == "open"
+
+    b, cache_b, wire_b = _rails()
+    out = b.restore_state(state)
+    # Re-opened with ZERO wire touches and ZERO fresh failures —
+    # scheduling is quiesced again (on_open fired), /healthz floors.
+    assert out["breaker_reopened"] is True
+    assert b.breaker.state == CircuitBreaker.OPEN
+    assert wire_b.calls == []
+    assert cache_b.holds == 1
+    assert metrics.health_state() != "ok"
+
+
+def test_restore_streak_survives_into_closed_breaker():
+    """A wire 1 failure from tripping at the crash stays 1 failure
+    from tripping after the restart — no fresh trip_after allowance."""
+    a, _, _ = _rails()
+    for _ in range(2):
+        try:
+            a._guarded.bind(object(), "n")
+        except ConnectionError:
+            pass
+    assert a.breaker.state == CircuitBreaker.CLOSED
+    state = a.export_state()
+    assert state["breaker"] == {"state": "closed", "failures": 2}
+    b, cache_b, _ = _rails()
+    b.restore_state(state)
+    assert b.breaker.state == CircuitBreaker.CLOSED
+    assert b.breaker.failures == 2
+    try:
+        b._guarded.bind(object(), "n")   # the 3rd consecutive failure
+    except ConnectionError:
+        pass
+    assert b.breaker.state == CircuitBreaker.OPEN
+    assert cache_b.holds == 1
+
+
+def test_closed_breaker_and_rung_restore():
+    a, _, _ = _rails()
+    a.watchdog.restore(2)
+    a.flush_watchdog.restore(1)
+    state = a.export_state()
+    assert state == {
+        "rung": 2, "flush_rung": 1,
+        "breaker": {"state": "closed", "failures": 0},
+    }
+    b, cache_b, wire_b = _rails()
+    out = b.restore_state(state)
+    assert out == {"rung": 2, "breaker_reopened": False}
+    assert b.rung == 2 and b.pause_prewarm() and b.skip_diagnosis()
+    assert b.breaker.state == CircuitBreaker.CLOSED
+    assert cache_b.holds == 0
+    # Normal hysteresis walks it back down.
+    for _ in range(20):
+        b.observe_cycle(0.0, period=1.0)
+        b.observe_flush(0.0, period=1.0)
+    assert b.rung == 0
+
+
+# -- HBM refusal pins -------------------------------------------------------
+
+def _scheduler_with_ceiling(ceiling_bytes):
+    from kube_batch_tpu.api.resource import ResourceSpec
+    from kube_batch_tpu.cache.cache import SchedulerCache
+    from kube_batch_tpu.scheduler import Scheduler
+
+    cache = SchedulerCache(
+        spec=ResourceSpec(), binder=None, evictor=None,
+        status_updater=None,
+    )
+    rails = Guardrails(GuardrailConfig(hbm_ceiling_mb=None))
+    rails.hbm.ceiling_bytes = ceiling_bytes
+    return Scheduler(cache, guardrails=rails)
+
+
+def test_refusal_pins_roundtrip_and_live_key_adoption():
+    a = _scheduler_with_ceiling(1000)
+    key = (12345, ("task_req", (32, 4)), ("node_cap", (8, 4)))
+    a._growth_refused[key] = ("T=32", 5000.0)
+    pins = a.export_refusal_pins()
+    assert pins == [{
+        "shapes": [["task_req", [32, 4]], ["node_cap", [8, 4]]],
+        "label": "T=32", "projected": 5000.0,
+    }]
+
+    b = _scheduler_with_ceiling(1000)
+    out = b.restore_refusal_pins(pins)
+    assert out == {"restored": 1, "dropped": 0}
+    # A DIFFERENT process's key (new id(cycle)) adopts the restored
+    # pin by its shape tail, under the live key.
+    live_key = (99999,) + key[1:]
+    assert b._pin_blocks(live_key) == ("T=32", 5000.0)
+    assert live_key in b._growth_refused
+    # Round-trips again (the next journal write must keep carrying it).
+    assert b.export_refusal_pins() == pins
+
+
+def test_restored_pin_revalidates_against_live_ceiling():
+    a = _scheduler_with_ceiling(1000)
+    a._growth_refused[(1, ("task_req", (32, 4)))] = ("T=32", 5000.0)
+    pins = a.export_refusal_pins()
+    # The operator raised the ceiling past the projection: the pin is
+    # dropped at restore, never blocking an admitted program.
+    b = _scheduler_with_ceiling(10_000)
+    assert b.restore_refusal_pins(pins) == {"restored": 0, "dropped": 1}
+    assert b._pin_blocks((2, ("task_req", (32, 4)))) is None
+
+
+def test_collect_state_shape(tmp_path):
+    from kube_batch_tpu.statestore import collect_state
+
+    sched = _scheduler_with_ceiling(1000)
+    sched.health = NodeHealthLedger(NodeHealthConfig())
+    sched.health.cordon("n")
+    sched._growth_refused[(1, ("task_req", (8, 4)))] = ("T=8", 9000.0)
+    state = collect_state(sched)
+    assert state["ledger"]["records"]["n"]["state"] == "cordoned"
+    assert state["guardrails"]["rung"] == 0
+    assert state["hbm_pins"][0]["projected"] == 9000.0
+    # And it journals + restores end to end.
+    s = _store(tmp_path)
+    s.append(state)
+    s.close()
+    s2 = _store(tmp_path)
+    loaded = s2.load()
+    fresh = _scheduler_with_ceiling(1000)
+    fresh.health = NodeHealthLedger(NodeHealthConfig())
+    summary = restore_state(
+        loaded, health=fresh.health, guardrails=fresh.guardrails,
+        scheduler=fresh,
+    )
+    assert fresh.health.state_of("n") == NodeState.CORDONED
+    assert summary["pins"] == {"restored": 1, "dropped": 0}
+
+
+# -- bounded journal under churn + forget purge -----------------------------
+
+def test_forgotten_node_purged_at_next_compaction(tmp_path):
+    ledger = _ledger()
+    sched = _scheduler_with_ceiling(None)
+    sched.health = ledger
+    s = _store(tmp_path, compact_every=4)
+    from kube_batch_tpu.statestore import collect_state
+
+    ledger.note_bind_failure("doomed")
+    ledger.note_bind_failure("doomed")          # cordoned
+    s.append(collect_state(sched))
+    assert b"doomed" in open(s.path, "rb").read()
+    ledger.forget("doomed")                     # cache.delete_node path
+    s.append(collect_state(sched))
+    s.compact()
+    data = open(s.path, "rb").read()
+    assert b"doomed" not in data                # purged with the history
+
+
+# -- HA adoption (journal first, peer mirror fallback) ----------------------
+
+class _PeerBackend:
+    def __init__(self, payload):
+        self.payload = payload
+
+    def get_state_snapshot(self):
+        return self.payload
+
+
+def test_adopt_state_prefers_journal_then_peer(tmp_path):
+    before_j = metrics.state_adopted.value("journal")
+    before_p = metrics.state_adopted.value("peer")
+    ledger_state = _ledger()
+    ledger_state.note_bind_failure("bad")
+    ledger_state.note_bind_failure("bad")
+    payload = {"ledger": ledger_state.export_state()}
+
+    s = _store(tmp_path)
+    s.append(payload)
+    s.close()
+    # Journal present: adopted from it even with a peer available.
+    h1 = _ledger()
+    out = adopt_state(
+        _store(tmp_path), backend=_PeerBackend({"state": payload}),
+        health=h1,
+    )
+    assert out["source"] == "journal"
+    assert h1.state_of("bad") == NodeState.CORDONED
+    # Cold journal: the peer mirror wins (a successor on another host).
+    h2 = _ledger()
+    cold = StateStore(journal_path(str(tmp_path)) + ".cold")
+    out = adopt_state(
+        cold, backend=_PeerBackend({"cycle": 7, "state": payload}),
+        health=h2,
+    )
+    assert out["source"] == "peer"
+    assert h2.state_of("bad") == NodeState.CORDONED
+    # Both cold: no adoption, no crash.
+    cold2 = StateStore(journal_path(str(tmp_path)) + ".cold2")
+    assert adopt_state(cold2, backend=_PeerBackend(None)) is None
+    assert metrics.state_adopted.value("journal") == before_j + 1
+    assert metrics.state_adopted.value("peer") == before_p + 1
+
+
+def test_state_snapshot_wire_roundtrip_is_epoch_fenced():
+    """putStateSnapshot is a fenced data-plane write; getStateSnapshot
+    is an unfenced read — through the REAL wire protocol."""
+    import socket
+
+    from kube_batch_tpu.api.resource import ResourceSpec
+    from kube_batch_tpu.cache.cache import SchedulerCache
+    from kube_batch_tpu.client.adapter import (
+        StaleEpochError,
+        StreamBackend,
+        WatchAdapter,
+    )
+    from kube_batch_tpu.client.external import ExternalCluster
+
+    a, b = socket.socketpair()
+    cl_r = a.makefile("r", encoding="utf-8")
+    cl_w = a.makefile("w", encoding="utf-8")
+    sch_r = b.makefile("r", encoding="utf-8")
+    sch_w = b.makefile("w", encoding="utf-8")
+    cluster = ExternalCluster(cl_r, cl_w).start()
+    backend = StreamBackend(sch_w, timeout=5.0)
+    cache = SchedulerCache(spec=ResourceSpec(), binder=backend,
+                           evictor=backend, status_updater=backend)
+    adapter = WatchAdapter(cache, sch_r, backend=backend).start()
+    try:
+        epoch = backend.acquire_lease("h1", 60.0)
+        backend.set_epoch(epoch)
+        assert backend.get_state_snapshot() is None
+        payload = {"v": 1, "cycle": 42, "state": {"ledger": {}}}
+        backend.put_state_snapshot(payload)
+        assert backend.get_state_snapshot() == payload
+        assert cluster.state_snapshot == payload
+        # A deposed epoch's mirror write is rejected cluster-side.
+        with cluster._lock:
+            cluster.lease_epoch += 1  # another leader took over
+        try:
+            backend.put_state_snapshot({"v": 1, "state": {}})
+            raised = False
+        except StaleEpochError:
+            raised = True
+        assert raised
+        assert cluster.state_snapshot == payload  # unclobbered
+        # The read still serves a contender adopting state.
+        assert backend.get_state_snapshot() == payload
+    finally:
+        # shutdown (not close): unblocks both read loops without
+        # contending for the file-object locks.
+        for s in (a, b):
+            try:
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+        adapter.join(2.0)
