@@ -74,6 +74,17 @@ run-example:
 # PodGroups, and re-admit it through canary-capped probation after the
 # heal — scripts/check_chaos_flaky.py asserts all of it plus same
 # seed ⇒ same hash across the two runs.
+# Every pinned scenario also runs ONCE under --ingest-mode event (the
+# per-event differential baseline of the batched watch-ingest
+# pipeline, doc/design/ingest-batching.md): the check scripts assert
+# hash parity — coalescing, one-lock bulk apply and the diff relist
+# must be decision-invisible.  The ingest runs are the EVENT-STORM
+# scenario: seeded bursts of MODIFIED churn plus one mid-storm relist;
+# scripts/check_chaos_ingest.py asserts no event lost (mirror parity
+# vs the serially-applied cluster oracle), real coalescing, the
+# mid-storm relist recovering through the diff path, the cycle thread
+# never starved past the watchdog ladder, and same seed ⇒ same hash
+# across both batched runs AND the event-mode run.
 # The restart runs are the DURABLE-STATE scenario
 # (doc/design/state-durability.md): the scheduler process crash-
 # restarts three times — mid-quarantine, mid-refusal and mid-breaker-
@@ -103,32 +114,56 @@ chaos:
 	JAX_PLATFORMS=cpu $(PY) -m kube_batch_tpu.chaos --seed 11 --ticks 32 \
 	    --scenario examples/chaos-guardrail.json --wire-commit pipelined \
 	    --pack-mode full --quiet > /tmp/kb-chaos-packfull.json
+	JAX_PLATFORMS=cpu $(PY) -m kube_batch_tpu.chaos --seed 11 --ticks 32 \
+	    --scenario examples/chaos-guardrail.json --wire-commit pipelined \
+	    --ingest-mode event --quiet > /tmp/kb-chaos-ingestevent.json
 	$(PY) scripts/check_chaos_pipelined.py /tmp/kb-chaos-pipelined-1.json \
-	    /tmp/kb-chaos-pipelined-2.json /tmp/kb-chaos-packfull.json
+	    /tmp/kb-chaos-pipelined-2.json /tmp/kb-chaos-packfull.json \
+	    /tmp/kb-chaos-ingestevent.json
 	JAX_PLATFORMS=cpu $(PY) -m kube_batch_tpu.chaos --seed 13 --ticks 24 \
 	    --scenario examples/chaos-failover.json --wire-commit pipelined \
 	    --quiet > /tmp/kb-chaos-failover-1.json
 	JAX_PLATFORMS=cpu $(PY) -m kube_batch_tpu.chaos --seed 13 --ticks 24 \
 	    --scenario examples/chaos-failover.json --wire-commit pipelined \
 	    --quiet > /tmp/kb-chaos-failover-2.json
+	JAX_PLATFORMS=cpu $(PY) -m kube_batch_tpu.chaos --seed 13 --ticks 24 \
+	    --scenario examples/chaos-failover.json --wire-commit pipelined \
+	    --ingest-mode event --quiet > /tmp/kb-chaos-failover-e.json
 	$(PY) scripts/check_chaos_failover.py /tmp/kb-chaos-failover-1.json \
-	    /tmp/kb-chaos-failover-2.json
+	    /tmp/kb-chaos-failover-2.json /tmp/kb-chaos-failover-e.json
 	JAX_PLATFORMS=cpu $(PY) -m kube_batch_tpu.chaos --seed 17 --ticks 32 \
 	    --scenario examples/chaos-flaky.json --wire-commit pipelined \
 	    --quiet > /tmp/kb-chaos-flaky-1.json
 	JAX_PLATFORMS=cpu $(PY) -m kube_batch_tpu.chaos --seed 17 --ticks 32 \
 	    --scenario examples/chaos-flaky.json --wire-commit pipelined \
 	    --quiet > /tmp/kb-chaos-flaky-2.json
+	JAX_PLATFORMS=cpu $(PY) -m kube_batch_tpu.chaos --seed 17 --ticks 32 \
+	    --scenario examples/chaos-flaky.json --wire-commit pipelined \
+	    --ingest-mode event --quiet > /tmp/kb-chaos-flaky-e.json
 	$(PY) scripts/check_chaos_flaky.py /tmp/kb-chaos-flaky-1.json \
-	    /tmp/kb-chaos-flaky-2.json
+	    /tmp/kb-chaos-flaky-2.json /tmp/kb-chaos-flaky-e.json
 	JAX_PLATFORMS=cpu $(PY) -m kube_batch_tpu.chaos --seed 23 --ticks 26 \
 	    --scenario examples/chaos-restart.json --wire-commit pipelined \
 	    --quiet > /tmp/kb-chaos-restart-1.json
 	JAX_PLATFORMS=cpu $(PY) -m kube_batch_tpu.chaos --seed 23 --ticks 26 \
 	    --scenario examples/chaos-restart.json --wire-commit pipelined \
 	    --quiet > /tmp/kb-chaos-restart-2.json
+	JAX_PLATFORMS=cpu $(PY) -m kube_batch_tpu.chaos --seed 23 --ticks 26 \
+	    --scenario examples/chaos-restart.json --wire-commit pipelined \
+	    --ingest-mode event --quiet > /tmp/kb-chaos-restart-e.json
 	$(PY) scripts/check_chaos_restart.py /tmp/kb-chaos-restart-1.json \
-	    /tmp/kb-chaos-restart-2.json
+	    /tmp/kb-chaos-restart-2.json /tmp/kb-chaos-restart-e.json
+	JAX_PLATFORMS=cpu $(PY) -m kube_batch_tpu.chaos --seed 29 --ticks 24 \
+	    --scenario examples/chaos-ingest.json --wire-commit pipelined \
+	    --quiet > /tmp/kb-chaos-ingest-1.json
+	JAX_PLATFORMS=cpu $(PY) -m kube_batch_tpu.chaos --seed 29 --ticks 24 \
+	    --scenario examples/chaos-ingest.json --wire-commit pipelined \
+	    --quiet > /tmp/kb-chaos-ingest-2.json
+	JAX_PLATFORMS=cpu $(PY) -m kube_batch_tpu.chaos --seed 29 --ticks 24 \
+	    --scenario examples/chaos-ingest.json --wire-commit pipelined \
+	    --ingest-mode event --quiet > /tmp/kb-chaos-ingest-e.json
+	$(PY) scripts/check_chaos_ingest.py /tmp/kb-chaos-ingest-1.json \
+	    /tmp/kb-chaos-ingest-2.json /tmp/kb-chaos-ingest-e.json
 
 profile:
 	$(PY) -m kube_batch_tpu --workload 2 --cycles 3 --schedule-period 0 \
@@ -144,6 +179,7 @@ verify:
 	$(PY) scripts/check_tier1_budget.py
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m slow
 	JAX_PLATFORMS=cpu $(PY) scripts/check_pack_microbench.py
+	JAX_PLATFORMS=cpu $(PY) scripts/check_ingest_microbench.py
 	$(PY) -c "import __graft_entry__ as g; g.entry()"
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 	    $(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"

@@ -245,7 +245,162 @@ class SchedulerCache:
         # plugins/pdb.py · "Known divergence").
         self.k8s_write_format = False
 
+        # Batched-ingest state (apply_batch): while a batch is applying
+        # under ONE lock hold, journal marks collect into `_batch_marks`
+        # (merged into every listener once, at the end) and hooks that
+        # must not run under the cache lock (health-ledger callbacks —
+        # they can reach the wire via the cordon sink) defer into
+        # `_batch_hooks`.  Both are None outside a batch; they are only
+        # ever set/cleared by the thread holding the lock, so mutators
+        # observing them non-None are INSIDE that thread's hold.
+        self._batch_marks: PackDirty | None = None
+        self._batch_hooks: list | None = None
+
         self.add_queue(Queue(name=default_queue, weight=1.0))
+
+    # -- batched ingest (client/adapter.py; doc/design/ingest-batching.md)
+
+    def apply_batch(self, ops) -> None:
+        """Apply a batch of mutation closures under ONE lock
+        acquisition — the watch adapter's batched-ingest funnel.  The
+        per-event mutators below still run unchanged (the RLock makes
+        their own acquires free re-entries), but their journal marks
+        collect into one buffer that is merged into every registered
+        PackDirty listener ONCE, and their out-of-lock hooks (health
+        flaps, ledger forgets) run after the hold releases.  One bad
+        op is logged and skipped, same as the per-event dispatch."""
+        hooks: list = []
+        with self._lock:
+            buf = PackDirty()
+            buf.clear()  # __init__ arms full=True ("never packed"); an
+            #              empty BUFFER must start clean instead
+            self._batch_marks = buf
+            self._batch_hooks = hooks
+            try:
+                for op in ops:
+                    try:
+                        op()
+                    except Exception:  # noqa: BLE001 — one bad event
+                        # must not kill the batch (same posture as the
+                        # per-event dispatch)
+                        logging.exception("batched ingest op failed")
+            finally:
+                self._batch_marks = None
+                self._batch_hooks = None
+                self._merge_marks(buf)
+        for hook in hooks:
+            try:
+                hook()
+            except Exception:  # noqa: BLE001 — ledger hooks are
+                logging.exception("deferred ingest hook failed")
+
+    def _merge_marks(self, buf: PackDirty) -> None:
+        """Fan one batch's collected journal marks out to every
+        listener in a single pass (caller holds the lock).  Within-
+        category order is preserved (added/deleted are lists); the
+        packer never relies on CROSS-category order — it drains
+        added_jobs, deleted_pods, added_pods, status_pods as separate
+        passes."""
+        if not (buf.version or buf.full or buf.nodes):
+            return
+        for d in self._dirty_listeners:
+            if buf.full:
+                d.mark_full(buf.full_reason)
+            d.status_pods |= buf.status_pods
+            d.nodes |= buf.nodes
+            d.added_pods.extend(buf.added_pods)
+            d.deleted_pods.extend(buf.deleted_pods)
+            d.added_jobs.extend(buf.added_jobs)
+            d.groups |= buf.groups
+            d.reset_groups |= buf.reset_groups
+            d.version += buf.version
+
+    def _mark_targets(self):
+        """The journals a mutator's marks land in: the batch buffer
+        while an apply_batch hold is active (this thread's — see
+        apply_batch), every registered listener otherwise."""
+        b = self._batch_marks
+        return (b,) if b is not None else self._dirty_listeners
+
+    def _after_lock(self, fn) -> None:
+        """Run `fn` now, or — inside an apply_batch hold — after the
+        batch releases the cache lock.  Ledger hooks go through here:
+        they fire cache/wire callbacks of their own and must never run
+        under the batch's hold.  The deferral decision is made UNDER
+        the lock: a batch holds the mutex for its whole apply, so a
+        thread that observes `_batch_hooks` non-None there can only
+        be the batch's own (re-entrant) ops — any other thread blocks
+        until the batch cleared it and runs `fn` directly."""
+        with self._lock:
+            hooks = self._batch_hooks
+            if hooks is not None:
+                hooks.append(fn)
+                return
+        fn()
+
+    def sweep_unlisted(self, seen) -> dict[str, int]:
+        """Delete every mirrored object a full LIST replay did NOT
+        re-list — the diff half of the batched relist fast path
+        (client/adapter.py · begin_relist_diff): instead of clear()
+        + rebuilding every object, the populated mirror absorbs the
+        replay as cheap upserts and this sweep removes what the
+        cluster no longer has.  `seen` maps kind -> the set of keys
+        the replay delivered (Pod -> uid, everything else -> name).
+        End state matches clear()+replay exactly: the default queue
+        survives (clear() re-adds it), and a job whose PodGroup
+        object vanished but whose pods were re-listed demotes to a
+        shell (queue "") — the same shell add_pod would have created.
+        Caller holds the lock (the adapter runs this as the final op
+        of the SYNC batch).  Returns per-kind deletion counts."""
+        counts: dict[str, int] = {}
+
+        def _sweep(kind: str, live, delete) -> None:
+            keys = seen.get(kind, frozenset())
+            gone = [k for k in live if k not in keys]
+            for k in gone:
+                delete(k)
+            if gone:
+                counts[kind] = len(gone)
+
+        _sweep("Pod", list(self._pods), self.delete_pod)
+        # Jobs AFTER pods: a listed pod naming an unlisted group must
+        # keep a shell job, not dangle.
+        job_keys = seen.get("PodGroup", frozenset())
+        for name in [n for n in self._jobs if n not in job_keys]:
+            job = self._jobs[name]
+            if job.tasks:
+                if job.queue:
+                    job.pod_group = PodGroup(name=name, queue="")
+                    job.queue = ""
+                    self._mark_full("job-deleted")
+                    counts["PodGroup"] = counts.get("PodGroup", 0) + 1
+            else:
+                self.delete_pod_group(name)
+                counts["PodGroup"] = counts.get("PodGroup", 0) + 1
+        _sweep("Node", list(self._nodes), self.delete_node)
+        _sweep(
+            "Queue",
+            [n for n in self._queues if n != self.default_queue],
+            self.delete_queue,
+        )
+        _sweep("PersistentVolumeClaim", list(self._claims),
+               self.delete_claim)
+        _sweep("StorageClass", list(self._storage_classes),
+               self.delete_storage_class)
+        _sweep("Namespace", list(self._namespaces), self.delete_namespace)
+        _sweep("PodDisruptionBudget", list(self._pdbs), self.delete_pdb)
+        return counts
+
+    def restamp_arrival(self, uids) -> None:
+        """Restart the scheduling-latency clock for `uids` — the
+        takeover reconciler's rolled-back pods re-queue NOW, and the
+        diff relist (which never dropped the mirror) would otherwise
+        keep their pre-failover arrival stamps."""
+        with self._lock:
+            now = time.monotonic()
+            for uid in uids:
+                if uid in self._pods:
+                    self._arrival_ts[uid] = now
 
     # -- node-health wiring (kube_batch_tpu/health/) --------------------
 
@@ -268,11 +423,11 @@ class SchedulerCache:
             return d
 
     def _mark_full(self, reason: str) -> None:
-        for d in self._dirty_listeners:
+        for d in self._mark_targets():
             d.mark_full(reason)
 
     def _mark_status(self, uid: str, group: str | None = None) -> None:
-        for d in self._dirty_listeners:
+        for d in self._mark_targets():
             d.status_pods.add(uid)
             d.version += 1
             if group:
@@ -281,11 +436,11 @@ class SchedulerCache:
     def _mark_node(self, name: str | None) -> None:
         if name is None:
             return
-        for d in self._dirty_listeners:
+        for d in self._mark_targets():
             d.nodes.add(name)
 
     def _mark_pod_added(self, uid: str, group: str | None = None) -> None:
-        for d in self._dirty_listeners:
+        for d in self._mark_targets():
             d.added_pods.append(uid)
             d.version += 1
             if group:
@@ -293,7 +448,7 @@ class SchedulerCache:
                 d.reset_groups.add(group)
 
     def _mark_pod_deleted(self, uid: str, group: str | None = None) -> None:
-        for d in self._dirty_listeners:
+        for d in self._mark_targets():
             d.deleted_pods.append(uid)
             d.version += 1
             if group:
@@ -301,7 +456,7 @@ class SchedulerCache:
                 d.reset_groups.add(group)
 
     def _mark_job_added(self, name: str) -> None:
-        for d in self._dirty_listeners:
+        for d in self._mark_targets():
             d.added_jobs.append(name)
             d.version += 1
             d.groups.add(name)
@@ -587,8 +742,13 @@ class SchedulerCache:
                 else:
                     self._mark_node(node.name)
         if flaps and self.health is not None:
-            for kind in flaps:
-                self.health.note_flap(node.name, kind)
+            # Deferred past an apply_batch hold: the ledger fires
+            # cache/wire callbacks of its own (cordon sink) and must
+            # not run under the batch's cache lock.
+            health, name = self.health, node.name
+            self._after_lock(
+                lambda: [health.note_flap(name, k) for k in flaps]
+            )
 
     def delete_node(self, name: str) -> None:
         with self._lock:
@@ -607,9 +767,10 @@ class SchedulerCache:
                 self._mark_full("node-deleted")
         if info is not None and self.health is not None:
             # A deleted node's health record dies with it (outside the
-            # lock — the ledger touches metrics): a decommissioned
-            # cordoned node must not count as quarantined forever.
-            self.health.forget(name)
+            # lock — the ledger touches metrics; deferred past an
+            # apply_batch hold): a decommissioned cordoned node must
+            # not count as quarantined forever.
+            self._after_lock(lambda: self.health.forget(name))
 
     def add_pod_group(self, group: PodGroup) -> None:
         with self._lock:

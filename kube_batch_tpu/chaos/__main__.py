@@ -78,6 +78,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "meta header, else incremental).  Pack mode is "
                         "decision-invisible: the same seed must hash "
                         "identically under both (make chaos pins it)")
+    p.add_argument("--ingest-mode", choices=("batched", "event"),
+                   default=None,
+                   help="watch-ingest dimension for the driven "
+                        "adapter: 'batched' (coalesced one-lock "
+                        "batches + diff relist) or 'event' (the "
+                        "per-event differential baseline).  Ingest "
+                        "mode is decision-invisible: the same seed "
+                        "must hash identically under both (make chaos "
+                        "pins it).  Default: adopt from a replayed "
+                        "trace's meta header, else 'batched'")
     p.add_argument("--quiet", action="store_true",
                    help="suppress progress logging; print only the "
                         "summary JSON")
@@ -166,6 +176,7 @@ def main(argv: list[str] | None = None) -> int:
         corrupt_tick=args.corrupt_tick,
         wire_commit=args.wire_commit,
         pack_mode=args.pack_mode,
+        ingest_mode=args.ingest_mode,
     )
     try:
         result = engine.run()

@@ -113,6 +113,7 @@ _META_FAULT_FIELDS = (
     "flaky_drain_budget",
     "crash_restart_at", "crash_restarts", "crash_restart_every",
     "hbm_pin_at",
+    "storm_at", "storm_ticks", "storm_events",
 )
 
 # -- node-health fault tuning (active only when FaultSpec.flaky_at is
@@ -182,6 +183,11 @@ class ChaosResult:
     #: writes during the restart window), the post-restart pin probe,
     #: journal counters, and whether the HA mirror landed.
     restart: dict | None = None
+    #: Ingest observability: the run's ingest mode plus (batched runs)
+    #: events/batches/coalesced totals across every adapter
+    #: incarnation, and — event-storm runs — the emitted-storm count
+    #: and the final mirror-parity verdict.
+    ingest: dict | None = None
 
     def summary(self) -> dict:
         return {
@@ -200,6 +206,7 @@ class ChaosResult:
             "health": self.health,
             "pack": self.pack,
             "restart": self.restart,
+            "ingest": self.ingest,
         }
 
 
@@ -242,6 +249,7 @@ class ChaosEngine:
         wire_commit: str | None = None,
         pack_mode: str | None = None,
         state_dir: str | None = None,
+        ingest_mode: str | None = None,
     ) -> None:
         self.seed = seed
         self.ticks = ticks
@@ -281,6 +289,24 @@ class ChaosEngine:
                 f"pack_mode must be 'incremental' or 'full', got "
                 f"{self.pack_mode!r}"
             )
+        # The ingest-mode dimension (batched coalesced apply vs the
+        # per-event baseline) must be decision-invisible like pack
+        # mode: same seed ⇒ same trace hash under both — `make chaos`
+        # pins it for the guardrail/failover/flaky/restart scenarios.
+        # Rides the meta header (excluded from the hash), adopted on
+        # replay unless overridden.
+        if ingest_mode is None and events is not None:
+            meta = next(
+                (e for e in events if e.get("op") == "meta"), None
+            )
+            if meta is not None:
+                ingest_mode = meta.get("ingest_mode")
+        from kube_batch_tpu.client.adapter import resolve_ingest_mode
+
+        self.ingest_mode = resolve_ingest_mode(ingest_mode)
+        #: Ingest observability accumulated across every adapter
+        #: incarnation (reconnects/restarts replace the adapter).
+        self._ingest_stats = {"events": 0, "batches": 0, "coalesced": 0}
         self.commit = None  # CommitPipeline, created in run()
         if faults is None and events is not None:
             # A recorded trace carries the recording's run-time fault
@@ -413,6 +439,7 @@ class ChaosEngine:
             self.faults.guardrail_faults
             or self.faults.health_faults
             or self.faults.restart_faults
+            or self.faults.ingest_faults
         ):
             return None
         from kube_batch_tpu.guardrails import GuardrailConfig, Guardrails
@@ -517,10 +544,12 @@ class ChaosEngine:
             self.backend = StreamBackend(sch_w, timeout=self.wire_timeout)
         else:
             self.backend.reconnect(sch_w)
-        adapter = WatchAdapter(self.cache, sch_r, backend=self.backend)
+        adapter = WatchAdapter(self.cache, sch_r, backend=self.backend,
+                               ingest_mode=self.ingest_mode)
         if old is not None:
             adapter.resource_versions.update(old.resource_versions)
             adapter.list_rv = old.list_rv
+            self._harvest_ingest(old)
         adapter.start()
         self._socks.extend((a, b))
         self._cluster_sock = a
@@ -646,6 +675,14 @@ class ChaosEngine:
                 self.cluster.flap_node(self._flaky_victim, down=False)
                 self.recovery_counts["flap-healed"] += 1
                 metrics.chaos_recoveries.inc("flap-healed")
+        elif kind == "event-storm":
+            emitted = self.cluster.emit_storm(self.faults.storm_events)
+            detail["events"] = emitted
+            if emitted:
+                self.fault_counts[kind] += 1
+                metrics.chaos_faults_injected.inc(kind)
+            else:
+                detail["skipped"] = True
         elif kind == "hbm-pressure":
             # Compile ONE next-bucket program through the real
             # compile-then-admit path under a 1-byte ceiling: the HBM
@@ -1229,6 +1266,7 @@ class ChaosEngine:
                 "tick": -1, "op": "meta", "seed": self.seed,
                 "wire_commit": self.wire_commit,
                 "pack_mode": self.pack_mode,
+                "ingest_mode": self.ingest_mode,
                 **{k: getattr(self.faults, k)
                    for k in _META_FAULT_FIELDS},
             }
@@ -1424,6 +1462,8 @@ class ChaosEngine:
                     violations = self._check_flaky(ticks_run)
                 if not violations and self.faults.restart_faults:
                     violations = self._check_restart(ticks_run)
+                if not violations and self.faults.ingest_faults:
+                    violations = self._check_ingest(ticks_run)
         finally:
             self._teardown()
 
@@ -1472,6 +1512,7 @@ class ChaosEngine:
             health=self._health_summary(),
             pack=self._pack_summary(),
             restart=self._restart_summary(),
+            ingest=self._ingest_summary(),
         )
 
     def _pack_summary(self) -> dict | None:
@@ -1928,6 +1969,96 @@ class ChaosEngine:
             },
         }
 
+    # -- batched-ingest invariants --------------------------------------
+    def _harvest_ingest(self, adapter) -> None:
+        """Fold one (dying) adapter incarnation's ingest counters into
+        the run totals."""
+        s = self._ingest_stats
+        s["events"] += getattr(adapter, "events_seen", 0)
+        s["batches"] += getattr(adapter, "batches_applied", 0)
+        s["coalesced"] += getattr(adapter, "coalesced_events", 0)
+
+    def _mirror_divergence(self) -> list[str]:
+        """(uid, field) mismatches between the scheduler's mirror and
+        the authoritative cluster — the serially-applied oracle the
+        no-event-lost / latest-wins invariants compare against.  Empty
+        when every pod the cluster holds is mirrored with the same
+        (status, node) and nothing extra lingers.  Memoized: the
+        post-run world is static, and both the check and the summary
+        read it."""
+        if getattr(self, "_mirror_div_memo", None) is not None:
+            return self._mirror_div_memo
+        with self.cluster._lock:
+            truth = {
+                uid: (p.status.name, p.node)
+                for uid, p in self.cluster.pods.items()
+            }
+        with self.cache.lock():
+            mirror = {
+                uid: (p.status.name, p.node)
+                for uid, p in self.cache._pods.items()
+            }
+        out = []
+        for uid in sorted(set(truth) | set(mirror)):
+            t, m = truth.get(uid), mirror.get(uid)
+            if t != m:
+                out.append(f"{uid}: cluster={t} mirror={m}")
+        self._mirror_div_memo = out
+        return out
+
+    def _check_ingest(self, tick: int) -> list[Violation]:
+        """Post-run assertions for the event-storm scenario: the storm
+        actually fired, no event was lost and latest-wins coalescing
+        preserved semantics (the quiesced end state mirrors the
+        cluster exactly — the cluster IS the serially-applied oracle),
+        and the storm + mid-storm relist never SUSTAINEDLY starved the
+        cycle thread: reaching OVERLOADED is only a violation when the
+        ladder is still engaged after the drain.  (The rungs are
+        WALL-clocked — a cold compile or a loaded CI host can spike
+        one transiently, the PR-8 lesson — while real ingest
+        starvation keeps overrunning and never walks back down.  The
+        hard liveness backstops are the per-tick quiesce timeout and
+        the convergence deadline, which a wedged ingest thread fails
+        outright.)"""
+        out: list[Violation] = []
+        if self.fault_counts.get("event-storm", 0) < 1:
+            out.append(Violation(
+                "storm-never-fired", tick,
+                "storm_at configured but no event-storm burst fired",
+            ))
+            return out
+        diverged = self._mirror_divergence()
+        if diverged:
+            out.append(Violation(
+                "ingest-mirror-divergence", tick,
+                f"{len(diverged)} pod(s) diverged from the cluster "
+                f"after the storm (events lost or mis-coalesced): "
+                f"{'; '.join(diverged[:5])}",
+            ))
+        if self.guardrails is not None and \
+                self.guardrails.max_rung_seen >= 2 and \
+                self.guardrails.rung > 0:
+            out.append(Violation(
+                "ingest-starved-cycle", tick,
+                "the cycle watchdog reached OVERLOADED during the "
+                "event-storm run and was STILL degraded after the "
+                "drain — ingest lock traffic starved the cycle thread",
+            ))
+        return out
+
+    def _ingest_summary(self) -> dict | None:
+        base = {"mode": self.ingest_mode}
+        base.update(self._ingest_stats)
+        if self.faults.ingest_faults:
+            base["storm_bursts"] = self.fault_counts.get(
+                "event-storm", 0,
+            )
+            base["mirror_divergence"] = len(self._mirror_divergence())
+            if self.guardrails is not None:
+                base["max_rung_seen"] = self.guardrails.max_rung_seen
+                base["final_rung"] = self.guardrails.rung
+        return base
+
     def _check_guardrails(self, tick: int) -> list[Violation]:
         """Post-run assertions that the self-protection layer actually
         engaged, quiesced, and recovered — violations ride the same
@@ -2012,6 +2143,8 @@ class ChaosEngine:
             }
 
     def _teardown(self) -> None:
+        if self.adapter is not None:
+            self._harvest_ingest(self.adapter)
         if self.statestore is not None:
             try:
                 # Final compaction + mirror (the wire may already be

@@ -133,6 +133,19 @@ class FaultSpec:
     #: recompile).  0 disables.
     hbm_pin_at: int = 0
 
+    # -- batched-ingest faults (doc/design/ingest-batching.md) ----------
+    #: Tick the EVENT STORM opens: every tick of the window the
+    #: cluster re-emits `storm_events` MODIFIED pod events (seeded
+    #: round-robin over the SORTED live pod set — benign latest-wins
+    #: churn carrying each pod's current truth), and one watch-gap
+    #: fires mid-window so a relist must recover THROUGH the storm.
+    #: The engine then asserts no event was lost (mirror parity vs
+    #: the serially-authoritative cluster) and that ingest never
+    #: starved the cycle thread past the watchdog ladder.  0 disables.
+    storm_at: int = 0
+    storm_ticks: int = 6
+    storm_events: int = 60
+
     # -- failover faults (doc/design/failover-fencing.md) --------------
     #: Tick the LEADER CRASHES: its lease expires on the cluster
     #: without a release, pods it was mid-committing are left frozen
@@ -168,6 +181,14 @@ class FaultSpec:
         driven scheduler's operational state to a statestore and
         exercises warm-restart adoption (+ the survival invariants)."""
         return bool(self.crash_restart_at)
+
+    @property
+    def ingest_faults(self) -> bool:
+        """The event-storm fault configured — the engine then wires a
+        Guardrails instance so the never-starved-past-the-watchdog
+        invariant is asserted against a LIVE ladder, and runs the
+        mirror-parity (no-event-lost / latest-wins) check."""
+        return bool(self.storm_at)
 
     @property
     def health_faults(self) -> bool:
@@ -244,6 +265,18 @@ def plan_faults(spec: FaultSpec, seed: int, ticks: int) -> list[dict]:
         events.append({
             "tick": spec.flaky_at + spec.flaky_ticks, "op": "fault",
             "kind": "flaky-heal",
+        })
+    if spec.storm_at:
+        for t in range(spec.storm_at, spec.storm_at + spec.storm_ticks):
+            events.append({
+                "tick": t, "op": "fault", "kind": "event-storm",
+            })
+        # One relist THROUGH the storm: the gap fires after the same
+        # tick's storm burst (stable sort keeps plan order), so the
+        # recovery replays a cluster still being churned.
+        events.append({
+            "tick": spec.storm_at + spec.storm_ticks // 2,
+            "op": "fault", "kind": "watch-gap",
         })
     if spec.leader_crash_at:
         events.append({
@@ -523,6 +556,26 @@ class ChaosCluster(ExternalCluster):
         from kube_batch_tpu.client.codec import decode_node
 
         self.add_node(decode_node(spec))
+
+    # -- event-storm primitive (engine-fired) ---------------------------
+    def emit_storm(self, count: int) -> int:
+        """Re-emit `count` MODIFIED events round-robin over the SORTED
+        live pod set — each carries the pod's CURRENT truth, so the
+        storm is pure ingest pressure (latest-wins coalescing fodder)
+        with zero semantic state change; deterministic given the tick
+        boundary's settled cluster state.  The events ride the history
+        ring like any churn, so a mid-storm relist/resume replays
+        them too.  Returns the number emitted."""
+        from kube_batch_tpu.client.codec import encode_pod
+
+        with self._lock:
+            uids = sorted(self.pods)
+            if not uids:
+                return 0
+            for i in range(count):
+                pod = self.pods[uids[i % len(uids)]]
+                self._emit("MODIFIED", "Pod", encode_pod(pod))
+            return count
 
     # -- flaky-node primitives (engine-fired) ---------------------------
     def set_flaky(self, name: str | None, pct: int = 0) -> None:

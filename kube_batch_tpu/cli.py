@@ -128,6 +128,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "the diagnosis/parity escape hatch, see "
                         "doc/design/daemon-operations.md; env "
                         "KB_TPU_PACK_MODE)")
+    p.add_argument("--ingest-mode", choices=("batched", "event"),
+                   default=None,
+                   help="watch-ingest strategy: 'batched' (default; "
+                        "drain the stream into coalesced bounded "
+                        "batches, bulk-decode off-lock, apply each "
+                        "batch under ONE cache-lock hold, diff-relist "
+                        "recovery) or 'event' (the legacy one-decode-"
+                        "one-lock-per-event path — the differential "
+                        "baseline; env KB_TPU_INGEST_MODE; "
+                        "doc/design/ingest-batching.md)")
     p.add_argument("--cycles", type=int, default=None,
                    help="stop after N cycles (default: run forever)")
     p.add_argument("--profile-dir", default=None,
@@ -578,7 +588,9 @@ def run_external(args) -> int:
         # eviction API would refuse them outright; see plugins/pdb.py).
         cache.k8s_write_format = True
     adapter = K8sWatchAdapter(
-        cache, reader, backend=backend, scheduler_name=args.scheduler_name
+        cache, reader, backend=backend,
+        scheduler_name=args.scheduler_name,
+        ingest_mode=args.ingest_mode,
     ).start()
     # Node-health ledger: bind-failure attribution + quarantine.  In
     # the k8s dialect, ledger cordons mirror onto spec.unschedulable
@@ -607,6 +619,7 @@ def run_external(args) -> int:
             nadapter = K8sWatchAdapter(
                 cache, nreader, backend=backend,
                 scheduler_name=args.scheduler_name,
+                ingest_mode=args.ingest_mode,
             )
             nadapter.resource_versions.update(old.resource_versions)
             nadapter.list_rv = old.list_rv
@@ -817,7 +830,8 @@ def run_http(args) -> int:
     mux = HttpWatchMux(client).start()
     backend.follow_served_versions(mux)
     adapter = K8sWatchAdapter(
-        cache, mux, scheduler_name=args.scheduler_name
+        cache, mux, scheduler_name=args.scheduler_name,
+        ingest_mode=args.ingest_mode,
     ).start()
 
     elector = None

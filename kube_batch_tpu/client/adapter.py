@@ -24,9 +24,12 @@ path works unchanged.
 
 from __future__ import annotations
 
+import collections
 import itertools
 import json
 import logging
+import os
+import re
 import threading
 import time
 from typing import IO
@@ -37,6 +40,93 @@ from kube_batch_tpu.cache.cluster import Pod, PodGroup
 from kube_batch_tpu.client.codec import DECODERS, encode_pod_group
 
 log = logging.getLogger(__name__)
+
+
+# -- batched ingest tuning (doc/design/ingest-batching.md) -------------------
+#: Events per coalesced apply batch once the stream is synced (one
+#: cache-lock acquisition each; bounds how long a batch can hold the
+#: lock against the cycle thread).
+INGEST_BATCH_MAX = 512
+#: Pre-SYNC (LIST replay / resume tail) batch bound: scheduling is not
+#: running against the replay yet, so much larger batches are safe and
+#: collapse a whole re-list into O(1) lock holds.
+INGEST_SYNC_BATCH_MAX = 65536
+#: Max age of a non-empty batch while events KEEP arriving — the
+#: greedy drain never waits for more input (an empty queue flushes
+#: immediately), this only stops a firehose from deferring applies
+#: forever.
+INGEST_BATCH_WAIT_S = 0.05
+#: Reader→applier handoff bound: past this the reader sleeps until
+#: the applier catches up (TCP backpressure onto the cluster), so the
+#: buffer never grows without bound.  The handoff itself is a plain
+#: deque (append/popleft are GIL-atomic) plus an Event wakeup — a
+#: locking queue.Queue was measured to cost more per event than the
+#: whole scan+coalesce+apply pipeline.
+INGEST_QUEUE_MAX = 65536
+
+
+def resolve_ingest_mode(mode: str | None = None) -> str:
+    """The ingest-mode knob: explicit argument > KB_TPU_INGEST_MODE >
+    'batched' (the default pipeline).  'event' keeps the legacy
+    one-decode-one-lock-per-event path as the differential baseline."""
+    mode = mode or os.environ.get("KB_TPU_INGEST_MODE") or "batched"
+    if mode not in ("batched", "event"):
+        raise ValueError(
+            f"ingest mode must be 'batched' or 'event', got {mode!r}"
+        )
+    return mode
+
+
+# Fast envelope sniff for the canonical native wire encoding
+# (json.dumps of {"type", "kind", "object"}; codec.encode_* puts "uid"
+# first in every object).  Sniffing lets the batched pipeline coalesce
+# — and, for already-mirrored pods, APPLY — without a full JSON parse
+# per event, which is the dominant per-event cost.  A line any regex
+# here does not match falls back to json.loads, so a differently-
+# formatted producer is slower, never wrong; `[^"\\]` excludes escaped
+# strings outright (an embedded quote or backslash in a uid/node name
+# must not sniff a truncated value — full parse handles it).
+_SNIFF_HEAD = re.compile(
+    r'^\{"type": "(ADDED|MODIFIED|DELETED)", "kind": "([A-Za-z]+)", '
+    r'"object": \{"uid": "([^"\\]*)"'
+)
+#: Pod tail: the REAL status/node/creation are the last keys of
+#: encode_pod, so an end-anchored match can never be fooled by a label
+#: or request dimension named "status"/"node" earlier in the object.
+_POD_TAIL = re.compile(
+    r', "status": "([A-Z]+)", "node": (null|"[^"\\]*"), '
+    r'"creation": -?\d+\}(?:, "resourceVersion": (-?\d+))?\}$'
+)
+_TAIL_RV = re.compile(r', "resourceVersion": (-?\d+)\}$')
+
+
+class _Scanned:
+    """One watch event after the light scan: either a fully parsed
+    `msg`, or (native fast path) just the sniffed envelope fields with
+    the raw line kept for a lazy full parse."""
+
+    __slots__ = ("ts", "raw", "msg", "mtype", "kind", "key",
+                 "mergeable", "uid", "status", "node", "rv", "tail")
+
+    def __init__(self, ts, raw=None, msg=None, mtype=None, kind=None,
+                 key=None, mergeable=True, uid=None, status=None,
+                 node=None, rv=None):
+        self.ts = ts
+        self.raw = raw
+        self.msg = msg
+        self.mtype = mtype
+        self.kind = kind
+        self.key = key
+        self.mergeable = mergeable
+        self.uid = uid
+        self.status = status
+        self.node = node
+        self.rv = rv
+        # The LAST MODIFIED coalesced into this record (None = none):
+        # the record's own object stays the apply BASIS — a serial
+        # chain only ever takes status/node from later events, so the
+        # newest object's spec fields must never replace the first's.
+        self.tail = None
 
 
 class StaleEpochError(RuntimeError):
@@ -470,7 +560,14 @@ def resume_session(
                 "commit pipeline still draining before relist "
                 "(depth %d)", commit.depth,
             )
-        cache.clear()
+        # Batched ingest keeps the mirror and DIFFS the replay into it
+        # (known objects absorb as cheap upserts, a SYNC-time sweep
+        # removes the unlisted remainder) — recovery cost stops scaling
+        # with per-event lock traffic, and the pack journal sees row
+        # marks instead of the clear()'s forced full rebuild.  The
+        # per-event baseline keeps the legacy clear()+rebuild.
+        if not adapter.begin_relist_diff():
+            cache.clear()
         backend.request_list()
         mode = "relisted"
     if not adapter.wait_for_sync(sync_timeout):
@@ -485,10 +582,17 @@ def resume_session(
 class WatchAdapter:
     """Reads the watch stream and drives the cache's event handlers.
 
-    ≙ the informer goroutines + cache/event_handlers.go.  One thread; on
-    EOF (cluster hung up) it stops, leaving the cache intact — a
-    reconnecting caller just re-lists (stateless recovery: drop the
-    cache, rebuild from the stream's initial ADDED burst).
+    ≙ the informer goroutines + cache/event_handlers.go + DeltaFIFO's
+    batch pop.  In the default BATCHED mode (doc/design/
+    ingest-batching.md) a reader thread hands raw lines to an applier
+    thread that coalesces per-object latest-wins, decodes off-lock,
+    and applies bounded batches under one cache-lock hold each;
+    `--ingest-mode event` keeps the legacy one-thread
+    one-decode-one-lock-per-event path as the differential baseline.
+    On EOF (cluster hung up) it stops — after the applier drains what
+    was received — leaving the cache intact: a reconnecting caller
+    re-lists (batched: diffing the replay into the live mirror; event
+    mode: dropping the cache and rebuilding from the ADDED burst).
     """
 
     def __init__(
@@ -496,6 +600,7 @@ class WatchAdapter:
         cache: SchedulerCache,
         reader: IO[str],
         backend: StreamBackend | None = None,
+        ingest_mode: str | None = None,
     ) -> None:
         self.cache = cache
         self._reader = reader
@@ -510,38 +615,110 @@ class WatchAdapter:
         # lastSyncResourceVersion): a reconnecting session resumes the
         # watch from max over kinds.  Fed by event envelopes' top-level
         # "resourceVersion" (native dialect) and by SYNC markers (the
-        # LIST's collection RV).
+        # LIST's collection RV).  In batched mode RVs advance only
+        # AFTER the carrying batch applied — "caught up to rv" always
+        # means "applied through rv".
         self.resource_versions: dict[str, int] = {}
         self.list_rv = 0
+        # -- batched ingest (doc/design/ingest-batching.md) ------------
+        self.ingest_mode = resolve_ingest_mode(ingest_mode)
+        self._ingest_buf: collections.deque | None = (
+            collections.deque() if self.ingest_mode == "batched" else None
+        )
+        self._ingest_wake = threading.Event()
+        self._ingest_eof = False
+        self._ingest_thread: threading.Thread | None = None
+        # Relist differ state (begin_relist_diff): while armed, every
+        # ADDED/MODIFIED key is collected per kind, and the SYNC batch
+        # ends with a cache.sweep_unlisted of everything the LIST did
+        # not re-deliver.  Only the ingest thread touches `_relist_seen`
+        # once armed.
+        self._relist_diff = False
+        self._relist_seen: dict[str, set] = {}
+        # Observability (read by the chaos engine's ingest summary).
+        self.events_seen = 0
+        self.batches_applied = 0
+        self.coalesced_events = 0
 
     # -- lifecycle (≙ cache.Run / WaitForCacheSync) ---------------------
     def start(self) -> "WatchAdapter":
+        if self._ingest_buf is not None:
+            self._ingest_thread = threading.Thread(
+                target=self._ingest_loop, daemon=True
+            )
+            self._ingest_thread.start()
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
         return self
 
     def wait_for_sync(self, timeout: float = 10.0) -> bool:
         """Block until the cluster's initial LIST replay is complete
-        (the stream sends a SYNC marker after its ADDED burst)."""
+        AND applied (the stream sends a SYNC marker after its ADDED
+        burst; the batched pipeline sets the gate only once the burst
+        landed in the cache)."""
         return self.synced.wait(timeout)
 
     def join(self, timeout: float | None = None) -> None:
         if self._thread is not None:
             self._thread.join(timeout)
+        if self._ingest_thread is not None:
+            self._ingest_thread.join(timeout)
+
+    # -- relist fast path (consumed by resume_session / failover) -------
+    def begin_relist_diff(self) -> bool:
+        """Arm the batched relist differ for the next LIST replay:
+        the populated mirror is NOT dropped — re-listed objects absorb
+        as cheap upserts (known pods without even a JSON parse, via
+        the envelope sniff), and at SYNC one sweep deletes whatever
+        the cluster no longer has (cache.sweep_unlisted).  Returns
+        False in event mode, where the caller keeps the legacy
+        clear()+rebuild recovery."""
+        if self.ingest_mode != "batched":
+            return False
+        self._relist_seen = {}
+        self._relist_diff = True
+        return True
 
     # -- the read loop --------------------------------------------------
     def _run(self) -> None:
+        buf = self._ingest_buf
+        wake = self._ingest_wake
         try:
             for line in self._reader:
                 line = line.strip()
                 if not line:
                     continue
-                try:
-                    msg = json.loads(line)
-                except json.JSONDecodeError:
-                    log.warning("undecodable watch line: %.120s", line)
+                if buf is None:
+                    try:
+                        msg = json.loads(line)
+                    except json.JSONDecodeError:
+                        log.warning("undecodable watch line: %.120s", line)
+                        continue
+                    self._dispatch(msg)
                     continue
-                self._dispatch(msg)
+                # Batched mode: RESPONSES are delivered immediately —
+                # a commit-flush worker blocked on its correlated
+                # answer must never wait behind a queued event batch.
+                # Everything else hands off to the ingest thread raw;
+                # it parses (or sniffs) off the cache lock.
+                if line.startswith('{"type": "RESPONSE"'):
+                    if self._backend is not None:
+                        try:
+                            self._backend.deliver_response(json.loads(line))
+                        except json.JSONDecodeError:
+                            log.warning(
+                                "undecodable response line: %.120s", line
+                            )
+                    continue
+                buf.append((time.monotonic(), line))
+                if not wake.is_set():
+                    wake.set()
+                if len(buf) > INGEST_QUEUE_MAX:
+                    # Backpressure: stop reading (and so stop ACKing
+                    # the TCP window) until the applier half-drains.
+                    while (len(buf) > INGEST_QUEUE_MAX // 2
+                           and not self.stopped.is_set()):
+                        time.sleep(0.001)
         except (OSError, ValueError):
             pass  # stream closed under us — treated as EOF
         finally:
@@ -550,7 +727,431 @@ class WatchAdapter:
             # landed yet (generation-guarded for late deaths besides).
             if self._backend is not None:
                 self._backend.mark_closed(self._backend_gen)
+            if buf is not None:
+                self._ingest_eof = True
+                wake.set()  # the ingest thread drains, then stops
+            else:
+                self.stopped.set()
+
+    # -- the batched applier thread -------------------------------------
+    def _ingest_loop(self) -> None:
+        """Drain the reader's handoff buffer greedily into bounded
+        batches and apply each under one cache-lock hold.  The drain
+        never WAITS for more input — an empty buffer flushes what is
+        in hand — so batching adds no idle latency; the size/time caps
+        only bound how much a sustained burst can defer its apply."""
+        buf = self._ingest_buf
+        wake = self._ingest_wake
+        try:
+            while True:
+                try:
+                    item = buf.popleft()
+                except IndexError:
+                    if self._ingest_eof:
+                        break
+                    # clear-then-recheck: an append racing the clear
+                    # re-sets the event, so no wakeup is ever lost;
+                    # the timeout is belt-and-braces only.
+                    wake.clear()
+                    if buf or self._ingest_eof:
+                        continue
+                    wake.wait(0.05)
+                    continue
+                batch = [item]
+                t0 = time.monotonic()
+                cap = (
+                    INGEST_BATCH_MAX if self.synced.is_set()
+                    else INGEST_SYNC_BATCH_MAX
+                )
+                yielded = False
+                while len(batch) < cap:
+                    try:
+                        batch.append(buf.popleft())
+                    except IndexError:
+                        if yielded or self._ingest_eof:
+                            break
+                        # One GIL yield, not a wait: a reader actively
+                        # mid-burst gets a slice to top the buffer up,
+                        # so contended runs flush real batches instead
+                        # of degenerate size-1 ones; an idle stream
+                        # returns immediately and flushes what's in
+                        # hand.
+                        yielded = True
+                        time.sleep(0)
+                        continue
+                    if time.monotonic() - t0 >= INGEST_BATCH_WAIT_S:
+                        break
+                try:
+                    self._process_items(batch)
+                except Exception:  # noqa: BLE001 — one bad batch must
+                    # not kill ingest (same posture as the per-event
+                    # dispatch loop)
+                    log.exception("batched ingest failed for one batch")
+        finally:
             self.stopped.set()
+
+    def _process_items(self, items: list) -> None:
+        """Scan a raw batch, split at SYNC markers, flush each chunk."""
+        chunk: list[_Scanned] = []
+        for ts, payload in items:
+            try:
+                rec = self._scan(ts, payload)
+            except Exception:  # noqa: BLE001 — one bad line ≠ dead ingest
+                log.warning("unscannable watch line: %.120s", payload)
+                continue
+            if rec is None:
+                continue  # consumed during scan (decoder-state events)
+            if rec.mtype == "RESPONSE":
+                # Sniff-missed response (non-canonical formatting):
+                # deliver late rather than never.
+                if self._backend is not None and rec.msg is not None:
+                    self._backend.deliver_response(rec.msg)
+                continue
+            if rec.mtype == "SYNC":
+                self._flush(chunk, sync=rec)
+                chunk = []
+                continue
+            chunk.append(rec)
+        if chunk:
+            self._flush(chunk, sync=None)
+
+    def _flush(self, records: list[_Scanned], sync: _Scanned | None) -> None:
+        """Coalesce one chunk, decode the survivors off-lock, apply
+        them under a single cache-lock hold, then publish RVs/metrics.
+        A SYNC terminator additionally runs the armed relist sweep
+        inside the same hold and only then opens the sync gate."""
+        from kube_batch_tpu import metrics
+
+        survivors, coalesced = self._coalesce(records)
+        if self._relist_diff:
+            seen = self._relist_seen
+            for rec in records:
+                entry = self._seen_entry(rec)
+                if entry is not None:
+                    seen.setdefault(entry[0], set()).add(entry[1])
+        ops = []
+        for rec in survivors:
+            op = self._prepare_op(rec)
+            if op is not None:
+                ops.append(op)
+        swept = None
+        if sync is not None and self._relist_diff:
+            seen = self._relist_seen
+            result: dict = {}
+            ops.append(lambda: result.update(
+                self.cache.sweep_unlisted(seen)
+            ))
+            swept = result
+        if ops:
+            with metrics.ingest_apply_latency.time():
+                self.cache.apply_batch(ops)
+        if records:
+            metrics.ingest_lag.observe(
+                max(0.0, time.monotonic() - records[-1].ts)
+            )
+            metrics.ingest_batch_size.observe(float(len(records)))
+            if coalesced:
+                metrics.ingest_coalesced.inc(by=float(coalesced))
+            self.batches_applied += 1
+            self.events_seen += len(records)
+            self.coalesced_events += coalesced
+            counts: dict[str, int] = {}
+            for rec in records:
+                counts[rec.kind or "unknown"] = (
+                    counts.get(rec.kind or "unknown", 0) + 1
+                )
+            for kind, n in counts.items():
+                metrics.ingest_events.inc(kind, by=float(n))
+        # RVs publish AFTER the apply: "caught up" must mean applied.
+        # Parsed records track individually; sniffed ones fold to the
+        # LAST one's tail rv — stream RVs are monotonic, so the last
+        # is the batch max, and latest_rv only ever consumes the max.
+        last_fast = None
+        for rec in records:
+            if rec.msg is not None:
+                self._track_msg(rec.msg)
+            else:
+                last_fast = rec
+        if last_fast is not None:
+            m = _TAIL_RV.search(last_fast.raw)
+            if m is not None:
+                self._track_rv(
+                    {"resourceVersion": int(m.group(1))}, last_fast.kind
+                )
+        if sync is not None:
+            if swept:
+                log.info("relist diff swept unlisted objects: %s", swept)
+            self._relist_diff = False
+            self._relist_seen = {}
+            if sync.msg is not None:
+                self._track_rv(sync.msg, None)
+            self.synced.set()
+
+    # -- scanning / coalescing ------------------------------------------
+    def _scan(self, ts: float, payload) -> _Scanned | None:
+        """One queue item → a _Scanned record.  Native fast path: the
+        canonical-envelope sniff classifies Pod events without a full
+        JSON parse (their status/node tail is sniffed later, for
+        coalescing SURVIVORS only); anything else — and any line the
+        sniff rejects — parses fully."""
+        if isinstance(payload, str):
+            m = _SNIFF_HEAD.match(payload)
+            if m is not None and m.group(2) == "Pod":
+                # Hand-rolled construction: this runs once per event
+                # on the hot path, and a kwargs __init__ costs more
+                # than both sniff regexes combined.
+                rec = _Scanned.__new__(_Scanned)
+                rec.ts = ts
+                rec.raw = payload
+                rec.msg = None
+                rec.mtype = m.group(1)
+                rec.kind = "Pod"
+                uid = m.group(3)
+                rec.key = ("Pod", uid)
+                rec.uid = uid
+                rec.mergeable = True
+                rec.status = rec.node = rec.rv = rec.tail = None
+                return rec
+            msg = json.loads(payload)
+            return self._scan_msg(ts, msg)
+        return self._scan_msg(ts, payload)
+
+    def _scan_msg(self, ts: float, msg: dict) -> _Scanned | None:
+        mtype = msg.get("type")
+        kind = msg.get("kind")
+        rec = _Scanned(ts, msg=msg, mtype=mtype, kind=kind)
+        if mtype in ("ADDED", "MODIFIED", "DELETED") and kind == "Pod":
+            uid = (msg.get("object") or {}).get("uid")
+            if uid is not None:
+                rec.key = ("Pod", uid)
+                rec.uid = uid
+        return rec
+
+    def _coalesce(
+        self, records: list[_Scanned]
+    ) -> tuple[list[_Scanned], int]:
+        """Per-object latest-wins within one batch: runs of MODIFIEDs
+        (or ADDED+MODIFIEDs) of one pod collapse to a single record —
+        the run's FIRST object stays the apply basis (a serial chain
+        applies spec fields only at the add; every later event
+        contributes status/node alone) with the run's LAST event
+        riding along as `tail` for exactly that (status, node) — and
+        anything pending for a pod is annihilated by its DELETED (the
+        delete survives — the object may predate the batch).  Exactly
+        serial-equivalent because both wire dialects carry the FULL
+        current (status, node) on every MODIFIED, and a placement is
+        only ever CLEARED by a PENDING transition (the native encoder
+        always emits pod.node; k8s pods never revert spec.nodeName).
+        Events flagged non-mergeable (k8s adoption-changing shapes)
+        act as barriers and keep their serial position."""
+        out: list[_Scanned | None] = []
+        last: dict[tuple, int] = {}
+        coalesced = 0
+        for rec in records:
+            key = rec.key
+            if key is None:
+                if not rec.mergeable:
+                    # A decoder-STATE event (k8s PriorityClass): no
+                    # object decode may move across it — close every
+                    # open merge window so later events start fresh
+                    # entries on its far side.
+                    last.clear()
+                out.append(rec)
+                continue
+            i = last.get(key)
+            prev = out[i] if i is not None else None
+            if prev is None:
+                out.append(rec)
+                last[key] = len(out) - 1
+                continue
+            if (
+                rec.mtype == "MODIFIED"
+                and prev.mtype in ("ADDED", "MODIFIED")
+                and rec.mergeable and prev.mergeable
+            ):
+                # The run's first object stays the basis; the newest
+                # event supplies the final (status, node) via `tail`.
+                prev.tail = rec
+                coalesced += 1
+            elif rec.mtype == "DELETED":
+                if prev.mtype == "DELETED":
+                    coalesced += 1  # delete of the already-deleted
+                elif prev.mergeable:
+                    out[i] = None  # annihilate the pending add/update
+                    coalesced += 1
+                    out.append(rec)
+                    last[key] = len(out) - 1
+                else:
+                    # A barrier (k8s Failed/deletion-stamped shape)
+                    # must still APPLY — its serial side effects
+                    # (death attribution to the health ledger) are the
+                    # reason it was flagged; the delete follows it.
+                    out.append(rec)
+                    last[key] = len(out) - 1
+            else:
+                out.append(rec)
+                last[key] = len(out) - 1
+        return [r for r in out if r is not None], coalesced
+
+    # -- batched op preparation (decode OFF the cache lock) -------------
+    def _prepare_op(self, rec: _Scanned):
+        """One scanned record → a zero-arg closure for apply_batch, or
+        None.  All JSON/object decoding happens HERE, on the ingest
+        thread, outside the lock; the closure only mutates.  A record
+        carrying a coalesced `tail` applies its own (basis) event and
+        then the tail's final status/node — the serial chain collapsed
+        to its first and last elements."""
+        if rec.msg is None and rec.kind == "Pod":
+            return self._prepare_pod_fast(rec)
+        msg = rec.msg
+        mtype, kind = rec.mtype, rec.kind
+        decode = DECODERS.get(kind)
+        if decode is None or mtype not in ("ADDED", "MODIFIED", "DELETED"):
+            log.warning("unknown watch message: type=%s kind=%s",
+                        mtype, kind)
+            return None
+        obj = msg.get("object", {})
+        decoded = None
+        if mtype != "DELETED" and not (kind == "Pod" and
+                                       mtype == "MODIFIED"):
+            try:
+                decoded = decode(obj)
+            except Exception:  # noqa: BLE001 — one bad object ≠ dead batch
+                log.exception("event decode failed: %s %s", mtype, kind)
+                return None
+        tail_obj = None
+        if rec.tail is not None:
+            tail = rec.tail
+            tail_obj = (
+                tail.msg.get("object", {}) if tail.msg is not None
+                else json.loads(tail.raw).get("object", {})
+            )
+        if tail_obj is None:
+            return lambda: self._apply(mtype, kind, obj, decode,
+                                       decoded=decoded)
+
+        def op() -> None:
+            self._apply(mtype, kind, obj, decode, decoded=decoded)
+            self._apply("MODIFIED", kind, tail_obj, decode)
+
+        return op
+
+    def _sniff_status_node(self, rec: _Scanned):
+        """(status, node, ok) for one pod record — from its parsed
+        object when available, else the end-anchored tail sniff of its
+        raw line (a miss means escaped strings / foreign encoder: the
+        caller falls back to the full parse)."""
+        if rec.msg is not None:
+            obj = rec.msg.get("object", {})
+            return obj.get("status", "PENDING"), obj.get("node"), True
+        raw = rec.raw
+        i = raw.rfind(', "status": "')
+        t = _POD_TAIL.match(raw, i) if i >= 0 else None
+        if t is None:
+            return None, None, False
+        node_g = t.group(2)
+        return t.group(1), (None if node_g == "null"
+                            else node_g[1:-1]), True
+
+    def _prepare_pod_fast(self, rec: _Scanned):
+        """A sniffed native Pod event: known pods apply straight from
+        the sniffed (status, node) tail without any JSON parse;
+        unknown ADDEDs parse+decode the run's BASIS object here,
+        off-lock (the coalesced `tail` only ever contributes the
+        final status/node — spec fields apply at the add, like the
+        serial chain).  The closure re-checks membership under the
+        hold — the ingest thread is the only pod-set writer in
+        batched mode, so the pre-check is a fast path, not a
+        correctness bet."""
+        cache = self.cache
+        if rec.mtype == "DELETED":
+            return lambda: cache.delete_pod(rec.uid)
+        raw = rec.raw
+        # The final (status, node), sniffed only now — from the run's
+        # LAST event — so coalesced-away intermediates never pay.
+        status, node, ok = self._sniff_status_node(rec.tail or rec)
+        if not ok:
+            try:
+                rec.msg = json.loads(raw)
+            except json.JSONDecodeError:
+                log.warning("undecodable watch line: %.120s", raw)
+                return None
+            return self._prepare_op(rec)
+        has_tail = rec.tail is not None
+        known = rec.uid in cache._pods  # GIL-atomic read; re-checked
+        if not known and rec.mtype == "ADDED":
+            obj = json.loads(raw).get("object", {})
+            try:
+                decoded = DECODERS["Pod"](obj)
+            except Exception:  # noqa: BLE001
+                log.exception("pod decode failed: %.120s", raw)
+                return None
+
+            def op_add() -> None:
+                if decoded.uid in cache._pods:
+                    cache.update_pod_status(
+                        decoded.uid, TaskStatus[status], node=node,
+                    )
+                    return
+                cache.add_pod(decoded)
+                if has_tail:
+                    cache.update_pod_status(
+                        decoded.uid, TaskStatus[status], node=node,
+                    )
+
+            return op_add
+        mtype, uid = rec.mtype, rec.uid
+
+        def op() -> None:
+            pod = cache._pods.get(uid)
+            if pod is not None:
+                # No-change skip: a re-list (or echo) delivering the
+                # (status, node) the mirror already holds writes the
+                # same values back in serial mode — skipping it is
+                # state-identical and turns an unchanged-world relist
+                # into pure reads.  Any difference takes the exact
+                # serial update.
+                if pod.status.name != status or pod.node != node:
+                    cache.update_pod_status(
+                        uid, TaskStatus[status], node=node,
+                    )
+            elif mtype == "ADDED":
+                # Raced out of the fast pre-check (or an event-order
+                # oddity): fall back to the full parse under the hold.
+                obj = json.loads(raw).get("object", {})
+                cache.add_pod(DECODERS["Pod"](obj))
+                if has_tail:
+                    cache.update_pod_status(
+                        uid, TaskStatus[status], node=node,
+                    )
+            # MODIFIED of an unknown pod: a no-op, same as the serial
+            # per-event path.
+
+        return op
+
+    def _track_msg(self, msg: dict) -> None:
+        """Post-apply RV bookkeeping for one parsed message (the k8s
+        adapter overrides the extraction)."""
+        self._track_rv(msg, msg.get("kind"))
+
+    def _seen_entry(self, rec: _Scanned) -> tuple[str, str] | None:
+        """(kind, key) the relist differ records for one delivered
+        event — must match cache.sweep_unlisted's keying: Pod by uid,
+        every other kind by name.  DELETEDs record nothing (a deleted
+        object must stay sweepable)."""
+        if rec.mtype == "DELETED":
+            return None
+        if rec.kind == "Pod" and rec.uid is not None:
+            return ("Pod", rec.uid)
+        msg = rec.msg
+        if msg is None or rec.kind is None:
+            return None
+        obj = msg.get("object") or {}
+        if rec.kind == "Pod":
+            uid = obj.get("uid")
+            return ("Pod", uid) if uid else None
+        name = obj.get("name")
+        return (rec.kind, name) if name else None
 
     @property
     def latest_rv(self) -> int:
@@ -595,8 +1196,16 @@ class WatchAdapter:
         except Exception:  # noqa: BLE001 — one bad event must not kill ingest
             log.exception("event handler failed: %s %s", mtype, kind)
 
-    def _apply(self, mtype: str, kind: str, obj: dict, decode) -> None:
+    def _apply(self, mtype: str, kind: str, obj: dict, decode,
+               decoded=None) -> None:
+        """Apply one event.  `decoded` is the pre-decoded object when
+        the batched pipeline already paid the decode off-lock; the
+        serial path leaves it None and decodes inline."""
         cache = self.cache
+
+        def _decoded():
+            return decoded if decoded is not None else decode(obj)
+
         if kind == "Pod":
             if mtype == "DELETED":
                 cache.delete_pod(obj["uid"])
@@ -608,7 +1217,7 @@ class WatchAdapter:
                 with cache.lock():
                     known = obj.get("uid") in cache._pods
                 if mtype == "ADDED" and not known:
-                    cache.add_pod(decode(obj))
+                    cache.add_pod(_decoded())
                 else:  # MODIFIED, or re-listed ADDED of a known pod
                     cache.update_pod_status(
                         obj["uid"],
@@ -619,34 +1228,34 @@ class WatchAdapter:
             if mtype == "DELETED":
                 cache.delete_node(obj["name"])
             else:  # update_node upserts unknown nodes
-                cache.update_node(decode(obj))
+                cache.update_node(_decoded())
         elif kind == "PodGroup":
             if mtype == "DELETED":
                 cache.delete_pod_group(obj["name"])
             else:
-                cache.add_pod_group(decode(obj))
+                cache.add_pod_group(_decoded())
         elif kind == "Queue":
             if mtype == "DELETED":
                 cache.delete_queue(obj["name"])
             else:
-                cache.add_queue(decode(obj))
+                cache.add_queue(_decoded())
         elif kind == "PersistentVolumeClaim":
             if mtype == "DELETED":
                 cache.delete_claim(obj["name"])
             else:
-                cache.add_claim(decode(obj))
+                cache.add_claim(_decoded())
         elif kind == "StorageClass":
             if mtype == "DELETED":
                 cache.delete_storage_class(obj["name"])
             else:
-                cache.add_storage_class(decode(obj))
+                cache.add_storage_class(_decoded())
         elif kind == "Namespace":
             if mtype == "DELETED":
                 cache.delete_namespace(obj["name"])
             else:
-                cache.add_namespace(decode(obj))
+                cache.add_namespace(_decoded())
         elif kind == "PodDisruptionBudget":
             if mtype == "DELETED":
                 cache.delete_pdb(obj["name"])
             else:
-                cache.add_pdb(decode(obj))
+                cache.add_pdb(_decoded())

@@ -140,11 +140,20 @@ def reconcile_takeover(
             "takeover reconcile: commit pipeline still draining "
             "before relist (depth %d)", commit.depth,
         )
-    cache.clear()
     # Re-arm the sync gate for THIS replay: the adapter's first SYNC
     # already fired long ago, and waiting on a set event would let the
-    # reconcile read a half-replayed mirror.
+    # reconcile read a half-replayed mirror.  Armed BEFORE the diff:
+    # the batched differ's sweep runs inside the SYNC batch that sets
+    # the gate.
     adapter.synced.clear()
+    # Batched ingest diffs the replay into the live mirror instead of
+    # dropping it (client/adapter.py · begin_relist_diff): the frozen
+    # BINDING pods absorb the cluster's verdict as plain status
+    # upserts, vanished ones fall to the SYNC-time sweep, and the
+    # classification below reads identical truth either way.  The
+    # per-event baseline keeps the legacy clear()+rebuild.
+    if not adapter.begin_relist_diff():
+        cache.clear()
     backend.request_list()
     if not adapter.wait_for_sync(sync_timeout):
         raise TimeoutError(
@@ -160,6 +169,7 @@ def reconcile_takeover(
     # bind landed.
     adopted = rolled_back = vanished = 0
     verdicts: list[tuple] = []
+    rolled_uids: list[str] = []
     relisted = cache.pod_placements(binding)
     for uid, (name, namespace, _group, node) in binding.items():
         placement = relisted.get(uid)
@@ -174,6 +184,13 @@ def reconcile_takeover(
         else:
             rolled_back += 1
             verdicts.append((False, name, namespace, node))
+            rolled_uids.append(uid)
+    if rolled_uids:
+        # Fresh scheduling-latency clocks, one lock hold: the pods
+        # re-queue NOW.  The clear()+rebuild relist restamped them
+        # implicitly; the batched diff relist (which keeps the mirror)
+        # must do it explicitly, so both modes report the same story.
+        cache.restamp_arrival(rolled_uids)
     # Events recorded OUTSIDE the cache lock: with a sync event sink
     # each record is a wire write, and holding the mutex across wire
     # RTTs would stall the adapter thread's ingest.

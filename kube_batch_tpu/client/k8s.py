@@ -56,9 +56,13 @@ from kube_batch_tpu.cache.cluster import (
     PodGroup,
     Queue,
 )
-from kube_batch_tpu.client.adapter import WatchAdapter
+from kube_batch_tpu.client.adapter import WatchAdapter, _Scanned
 
 log = logging.getLogger(__name__)
+
+#: Distinguishes "not pre-decoded" from a legitimate None decode
+#: result (a pod the adoption filter rejects).
+_UNSET = object()
 
 #: ≙ the reference's default --scheduler-name (options.go).
 DEFAULT_SCHEDULER_NAME = "kube-batch"
@@ -506,8 +510,9 @@ class K8sWatchAdapter(WatchAdapter):
         reader,
         backend=None,
         scheduler_name: str = DEFAULT_SCHEDULER_NAME,
+        ingest_mode: str | None = None,
     ) -> None:
-        super().__init__(cache, reader, backend)
+        super().__init__(cache, reader, backend, ingest_mode=ingest_mode)
         self.decoder = K8sDecoder(cache.spec, scheduler_name)
         self.ignored_pods = 0  # foreign/terminal pods filtered out
 
@@ -530,19 +535,143 @@ class K8sWatchAdapter(WatchAdapter):
             return
         super()._dispatch(msg)
 
+    # -- batched-ingest hooks (client/adapter.py pipeline) --------------
+    def _scan_msg(self, ts: float, msg: dict) -> _Scanned | None:
+        """k8s-dialect lines always parse fully (the envelope sniff is
+        native-only — metadata shapes vary by apiserver), but the
+        coalescing identity/mergeability come from the k8s object:
+        pods key by metadata uid (name fallback, matching the cache
+        keying), and adoption-changing shapes (Failed phase, a
+        deletionTimestamp) are barriers — they must keep their serial
+        position, never merge."""
+        obj = msg.get("object")
+        if not (isinstance(obj, dict) and "kind" in obj):
+            return super()._scan_msg(ts, msg)
+        kind = obj.get("kind")
+        rec = _Scanned(ts, msg=msg, mtype=msg.get("type"), kind=kind)
+        if kind == "PriorityClass":
+            # Decoder-state: a merge-window barrier (no pod decode may
+            # cross it — see WatchAdapter._coalesce).
+            rec.mergeable = False
+        if kind == "Pod":
+            meta = obj.get("metadata") or {}
+            uid = meta.get("uid") or meta.get("name")
+            if uid:
+                rec.key = ("Pod", uid)
+                rec.uid = uid
+            if (obj.get("status") or {}).get("phase") == "Failed" or \
+                    meta.get("deletionTimestamp"):
+                rec.mergeable = False
+        return rec
+
+    def _prepare_op(self, rec: _Scanned):
+        msg, obj = rec.msg, None
+        if msg is not None:
+            obj = msg.get("object")
+        if not (isinstance(obj, dict) and "kind" in obj):
+            return super()._prepare_op(rec)
+        mtype, kind = rec.mtype, obj.get("kind")
+        # Decoder-STATE events apply during prepare, in order: a
+        # PriorityClass observed here is visible to every later pod
+        # decode in the same batch, exactly like the serial dispatch.
+        if kind == "PriorityClass":
+            if mtype == "DELETED":
+                self.decoder.forget_priority_class(
+                    (obj.get("metadata") or {}).get("name")
+                )
+            else:
+                self.decoder.observe_priority_class(obj)
+            return None
+        decoded = _UNSET
+        try:
+            if mtype != "DELETED":
+                dec = self.decoder
+                if kind == "Pod":
+                    decoded = dec.pod(obj)
+                elif kind == "Node":
+                    decoded = dec.node(obj)
+                elif kind == "PodGroup":
+                    decoded = dec.pod_group(obj)
+                elif kind == "Queue":
+                    decoded = dec.queue(obj)
+                elif kind == "PodDisruptionBudget":
+                    decoded = dec.pdb(obj)
+                elif kind == "Namespace":
+                    decoded = dec.namespace(obj)
+        except Exception:  # noqa: BLE001 — one bad object ≠ dead batch
+            log.exception("k8s event decode failed: %s %s", mtype, kind)
+            return None
+        pre = decoded
+        # A coalesced run: the basis object above carries the add-time
+        # spec (serial chains apply spec only at the add); the tail
+        # contributes the run's final status/node as its own MODIFIED.
+        tail_obj = tail_pre = None
+        if rec.tail is not None and rec.tail.msg is not None:
+            tail_obj = rec.tail.msg.get("object")
+            if isinstance(tail_obj, dict):
+                try:
+                    tail_pre = self.decoder.pod(tail_obj)
+                except Exception:  # noqa: BLE001
+                    log.exception("k8s tail decode failed: %s", kind)
+                    tail_obj = None
+            else:
+                tail_obj = None
+
+        def op() -> None:
+            try:
+                self._apply_k8s(mtype, obj, decoded=pre)
+                if tail_obj is not None:
+                    self._apply_k8s("MODIFIED", tail_obj,
+                                    decoded=tail_pre)
+            except Exception:  # noqa: BLE001 — one bad event ≠ dead ingest
+                log.exception("k8s event handler failed: %s %s",
+                              mtype, kind)
+
+        return op
+
+    def _seen_entry(self, rec):
+        msg = rec.msg
+        obj = msg.get("object") if msg is not None else None
+        if not (isinstance(obj, dict) and "kind" in obj):
+            return super()._seen_entry(rec)
+        if rec.mtype == "DELETED":
+            return None
+        kind = obj.get("kind")
+        meta = obj.get("metadata") or {}
+        if kind == "Pod":
+            uid = meta.get("uid") or meta.get("name")
+            return ("Pod", uid) if uid else None
+        name = meta.get("name")
+        return (kind, name) if kind and name else None
+
+    def _track_msg(self, msg: dict) -> None:
+        obj = msg.get("object")
+        if isinstance(obj, dict) and "kind" in obj:
+            rv = (obj.get("metadata") or {}).get(
+                "resourceVersion", msg.get("resourceVersion")
+            )
+            if rv is not None:
+                self._track_rv({"resourceVersion": rv}, obj.get("kind"))
+            return
+        super()._track_msg(msg)
+
     # -- k8s-shaped event routing (≙ cache/event_handlers.go) -----------
-    def _apply_k8s(self, mtype: str, obj: dict) -> None:
+    def _apply_k8s(self, mtype: str, obj: dict, decoded=_UNSET) -> None:
+        """Route one k8s-shaped event.  `decoded` carries the batched
+        pipeline's off-lock decode; the serial path decodes inline."""
         kind = obj.get("kind")
         cache = self.cache
         dec = self.decoder
         meta = obj.get("metadata", {})
         if kind == "Pod":
-            self._apply_pod(mtype, obj)
+            self._apply_pod(mtype, obj, decoded=decoded)
         elif kind == "Node":
             if mtype == "DELETED":
                 cache.delete_node(meta["name"])
             else:  # ADDED/MODIFIED: upsert (re-list replays ADDED)
-                cache.update_node(dec.node(obj))
+                cache.update_node(
+                    dec.node(obj) if decoded is _UNSET else decoded
+                )
         elif kind == "PodGroup":
             if mtype == "DELETED":
                 cache.delete_pod_group(meta["name"])
@@ -550,7 +679,9 @@ class K8sWatchAdapter(WatchAdapter):
                 # the set must not grow without bound under churn).
                 dec._min_resources_warned.discard(meta["name"])
             else:
-                cache.add_pod_group(dec.pod_group(obj))
+                cache.add_pod_group(
+                    dec.pod_group(obj) if decoded is _UNSET else decoded
+                )
                 # Writes follow the version the cluster SPEAKS: a
                 # v1alpha2-ingested group gets v1alpha2-addressed
                 # status updates (the HTTP transport derives this from
@@ -571,7 +702,9 @@ class K8sWatchAdapter(WatchAdapter):
             if mtype == "DELETED":
                 cache.delete_queue(meta["name"])
             else:
-                cache.add_queue(dec.queue(obj))
+                cache.add_queue(
+                    dec.queue(obj) if decoded is _UNSET else decoded
+                )
         elif kind == "PriorityClass":
             if mtype == "DELETED":
                 dec.forget_priority_class(meta["name"])
@@ -581,12 +714,16 @@ class K8sWatchAdapter(WatchAdapter):
             if mtype == "DELETED":
                 cache.delete_pdb(meta["name"])
             else:
-                cache.add_pdb(dec.pdb(obj))
+                cache.add_pdb(
+                    dec.pdb(obj) if decoded is _UNSET else decoded
+                )
         elif kind == "Namespace":
             if mtype == "DELETED":
                 cache.delete_namespace(meta["name"])
             else:
-                cache.add_namespace(dec.namespace(obj))
+                cache.add_namespace(
+                    dec.namespace(obj) if decoded is _UNSET else decoded
+                )
         else:
             log.warning("unhandled k8s kind %s (%s)", kind, mtype)
 
@@ -599,14 +736,15 @@ class K8sWatchAdapter(WatchAdapter):
                 return
         self.cache.add_pod_group(PodGroup(name=group, queue="", min_member=1))
 
-    def _apply_pod(self, mtype: str, obj: dict) -> None:
+    def _apply_pod(self, mtype: str, obj: dict, decoded=_UNSET) -> None:
         cache = self.cache
         meta = obj.get("metadata", {})
         uid = meta.get("uid") or meta.get("name")
-        decoded = self.decoder.pod(obj)
         if mtype == "DELETED":
             cache.delete_pod(uid)
             return
+        if decoded is _UNSET:
+            decoded = self.decoder.pod(obj)
         with cache.lock():
             known = uid in cache._pods
         if decoded is None:
@@ -629,7 +767,11 @@ class K8sWatchAdapter(WatchAdapter):
                             death_node = prior.node
                     health = getattr(cache, "health", None)
                     if death_node is not None and health is not None:
-                        health.note_pod_death(death_node)
+                        # Deferred past an apply_batch hold (the ledger
+                        # fires wire callbacks); immediate when serial.
+                        cache._after_lock(
+                            lambda: health.note_pod_death(death_node)
+                        )
                 cache.delete_pod(uid)
             else:
                 self.ignored_pods += 1

@@ -72,11 +72,23 @@ def failure_counts(
     }
 
 
+def diag_window_rows(max_events: int | None) -> int:
+    """The gathered-window size a caller should pass for a given
+    consumer event cap: derived, not hand-picked, so raising
+    MAX_DIAG_EVENTS can never silently outgrow the window (the
+    ADVICE-round-5 cross-module invariant, enforced by derivation
+    instead of prose).  2x headroom keeps the window comfortably
+    above the cap while staying a power-of-two-ish bucket."""
+    if max_events is None:
+        return 2048
+    return max(2048, 2 * int(max_events))
+
+
 def failure_counts_subset(
     snap: SnapshotTensors,
     state: AllocState,
     policy,
-    max_rows: int = 2048,
+    max_rows: int | None = None,
     max_events: int | None = MAX_DIAG_EVENTS,
 ) -> dict[str, jnp.ndarray]:
     """failure_counts restricted to the (bounded) pending set, scattered
@@ -103,13 +115,19 @@ def failure_counts_subset(
 
     `max_events` is the CONSUMER's per-cycle event cap (diagnose_pending
     walks at most that many pending rows): the exactness argument above
-    requires it to stay below `max_rows`, and this function enforces
-    that in code instead of prose — shrinking `max_rows` below the cap
-    would silently scatter consumed rows back as all-zero tallies,
-    rendering as misleading "0/N nodes available:" events with no
-    reasons.  A caller that consumes rows by its own window rule (tests
-    probing small windows, benchmarks) opts out with `max_events=None`.
+    requires it to stay below `max_rows`, enforced in code instead of
+    prose — `max_rows` now DEFAULTS to `diag_window_rows(max_events)`
+    (derived from the cap, so a caller that only raises its event cap
+    can never silently outgrow the window), and an explicitly-passed
+    window that violates the invariant raises — shrinking `max_rows`
+    below the cap would silently scatter consumed rows back as
+    all-zero tallies, rendering as misleading "0/N nodes available:"
+    events with no reasons.  A caller that consumes rows by its own
+    window rule (tests probing small windows, benchmarks) opts out
+    with `max_events=None`.
     """
+    if max_rows is None:
+        max_rows = diag_window_rows(max_events)
     if max_events is not None and max_events >= max_rows:
         raise ValueError(
             f"failure_counts_subset: max_events={max_events} must stay "

@@ -278,6 +278,44 @@ cycle_phase_latency = REGISTRY.register(Histogram(
     labels=("phase",),
 ))
 
+# -- batched watch ingestion (client/adapter.py; doc/design/ingest-batching.md)
+ingest_events = REGISTRY.register(Counter(
+    "ingest_events_total",
+    "Watch events received by the batched ingest pipeline, by object "
+    "kind (counts every event as it arrives, including ones later "
+    "coalesced away).  The per-event differential baseline "
+    "(--ingest-mode event) deliberately does not feed these — it is "
+    "the unchanged legacy path.",
+    labels=("kind",),
+))
+ingest_batch_size = REGISTRY.register(Histogram(
+    "ingest_batch_size",
+    "Events per coalesced ingest batch (one cache-lock acquisition "
+    "each).  A steady stream of size-1 batches means the applier is "
+    "keeping up per event; large batches mean bursts are being "
+    "absorbed without per-event lock traffic.",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 4096, 16384,
+             65536),
+))
+ingest_coalesced = REGISTRY.register(Counter(
+    "ingest_coalesced_total",
+    "Watch events absorbed by per-object latest-wins coalescing "
+    "before any JSON/object decode or cache apply (N MODIFIEDs of one "
+    "pod in a batch -> one apply; ADDED+DELETED annihilate).",
+))
+ingest_apply_latency = REGISTRY.register(Histogram(
+    "ingest_apply_latency_seconds",
+    "Wall time of one batched cache apply (the single lock hold that "
+    "lands a whole ingest batch, including the relist sweep).",
+))
+ingest_lag = REGISTRY.register(Histogram(
+    "ingest_lag_seconds",
+    "Age of the NEWEST event in a batch at the moment its apply "
+    "lands — the freshness of the mirror behind the wire.  A growing "
+    "lag means ingest is falling behind the event rate "
+    "(doc/design/daemon-operations.md · ingest-lag runbook).",
+))
+
 # -- pipelined wire commit (framework/commit.py) -----------------------------
 commit_queue_depth = REGISTRY.register(Gauge(
     "commit_queue_depth",

@@ -1,0 +1,40 @@
+#!/usr/bin/env python
+"""Shared ingest-mode parity assertion for the chaos check scripts.
+
+Every pinned scenario runs once under `--ingest-mode event` (the
+per-event differential baseline of the batched watch-ingest pipeline,
+doc/design/ingest-batching.md); the SAME seed must reproduce the
+batched runs' hash exactly — coalescing, the one-lock bulk apply and
+the diff relist can never change a scheduling decision.  One rule,
+one place: each check script imports this (they all run as
+`python scripts/check_*.py`, which puts this directory on sys.path).
+"""
+
+import json
+
+
+def check_ingest_parity(batched_run: dict, path_event: str | None,
+                        what: str) -> str:
+    """Assert the event-mode run at `path_event` reproduces
+    `batched_run`'s hash (and that the batched runs actually exercised
+    the batched pipeline — a vacuous parity proves nothing).  Returns
+    a suffix for the check script's ok line; empty when no event-mode
+    file was supplied."""
+    if path_event is None:
+        return ""
+    with open(path_event, encoding="utf-8") as f:
+        e = json.load(f)
+    assert e["ok"], f"{what} event-mode run violations: {e['violations']}"
+    ing = e.get("ingest") or {}
+    assert ing.get("mode") == "event", ing
+    assert e["trace_hash"] == batched_run["trace_hash"], (
+        f"{what}: --ingest-mode event diverged from batched at the "
+        f"same seed: {e['trace_hash']} != {batched_run['trace_hash']}"
+    )
+    batched = batched_run.get("ingest") or {}
+    assert batched.get("mode") == "batched" and \
+        batched.get("events", 0) > 0, (
+        f"{what}: batched runs never exercised the batched pipeline — "
+        f"the parity check is vacuous: {batched}"
+    )
+    return " (and under --ingest-mode event)"

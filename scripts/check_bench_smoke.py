@@ -16,11 +16,21 @@ def main() -> int:
     artifact = json.loads(lines[-1])  # the driver reads the LAST line
     assert isinstance(artifact, dict), artifact
 
-    for key in ("first_cycle_ms", "e2e_cycle_ms_p50", "commit_pipeline"):
+    for key in ("first_cycle_ms", "e2e_cycle_ms_p50", "commit_pipeline",
+                "ingest_compare"):
         assert key in artifact, (
             f"artifact missing {key!r}; keys: {sorted(artifact)}"
         )
     assert isinstance(artifact["first_cycle_ms"], (int, float))
+
+    ing = artifact["ingest_compare"]
+    assert "error" not in ing, ing
+    # Presence + sanity only: the >=3x/>=2x speed gates live in
+    # scripts/check_ingest_microbench.py (make verify), where the
+    # timing runs best-of-N on an otherwise idle interpreter; the
+    # smoke just pins that every artifact RECORDS the ingest numbers.
+    assert ing.get("storm_speedup", 0) > 0, ing
+    assert ing.get("relist_speedup", 0) > 0, ing
 
     cmp_ = artifact["commit_pipeline"]
     assert "error" not in cmp_, cmp_
@@ -37,7 +47,9 @@ def main() -> int:
         "bench-smoke artifact: ok — first_cycle "
         f"{artifact['first_cycle_ms']}ms, steady p50 "
         f"{artifact['e2e_cycle_ms_p50']}ms, pipelined commit "
-        f"{speedup}x vs sync at {cmp_.get('rtt_ms')}ms RTT"
+        f"{speedup}x vs sync at {cmp_.get('rtt_ms')}ms RTT, ingest "
+        f"storm {ing.get('storm_speedup')}x / relist "
+        f"{ing.get('relist_speedup')}x vs per-event"
     )
     return 0
 

@@ -21,7 +21,10 @@ import json
 import sys
 
 
-def main(path_a: str, path_b: str) -> int:
+from chaos_parity import check_ingest_parity
+
+
+def main(path_a: str, path_b: str, path_event: str | None = None) -> int:
     with open(path_a, encoding="utf-8") as f:
         a = json.load(f)
     with open(path_b, encoding="utf-8") as f:
@@ -54,10 +57,11 @@ def main(path_a: str, path_b: str) -> int:
         f"same-seed failover runs diverged: "
         f"{a['trace_hash']} != {b['trace_hash']}"
     )
+    parity = check_ingest_parity(a, path_event, "failover")
     fo = a["failover"]
     print(
         "chaos failover: ok — same-seed hash "
-        f"{a['trace_hash'][:16]}… reproduced; epoch "
+        f"{a['trace_hash'][:16]}… reproduced" + parity + "; epoch "
         f"{fo['old_epoch']}→{fo['new_epoch']} takeover rejected "
         f"{fo['stale_rejections']} zombie write(s), reconcile adopted "
         f"{fo['reconcile']['adopted']} / rolled back "
@@ -67,4 +71,5 @@ def main(path_a: str, path_b: str) -> int:
 
 
 if __name__ == "__main__":
-    sys.exit(main(sys.argv[1], sys.argv[2]))
+    sys.exit(main(sys.argv[1], sys.argv[2],
+                  sys.argv[3] if len(sys.argv) > 3 else None))

@@ -22,7 +22,10 @@ import json
 import sys
 
 
-def main(path_a: str, path_b: str) -> int:
+from chaos_parity import check_ingest_parity
+
+
+def main(path_a: str, path_b: str, path_event: str | None = None) -> int:
     with open(path_a, encoding="utf-8") as f:
         a = json.load(f)
     with open(path_b, encoding="utf-8") as f:
@@ -54,10 +57,12 @@ def main(path_a: str, path_b: str) -> int:
         f"same-seed flaky runs diverged: "
         f"{a['trace_hash']} != {b['trace_hash']}"
     )
+    parity = check_ingest_parity(a, path_event, "flaky")
     h = a["health"]
     print(
         "chaos flaky: ok — same-seed hash "
-        f"{a['trace_hash'][:16]}… reproduced; {h['cordons']} cordon(s) "
+        f"{a['trace_hash'][:16]}… reproduced" + parity +
+        f"; {h['cordons']} cordon(s) "
         f"after {h['flaky_bind_faults']} refused bind(s), breaker "
         "stayed closed, 0 cordoned placements, "
         f"{h['drain_evictions']} drain eviction(s), ledger recovered"
@@ -66,4 +71,5 @@ def main(path_a: str, path_b: str) -> int:
 
 
 if __name__ == "__main__":
-    sys.exit(main(sys.argv[1], sys.argv[2]))
+    sys.exit(main(sys.argv[1], sys.argv[2],
+                  sys.argv[3] if len(sys.argv) > 3 else None))

@@ -1,0 +1,67 @@
+#!/usr/bin/env python
+"""Assert the event-storm chaos acceptance criteria (make chaos):
+
+* both batched runs completed with zero invariant violations and
+  converged — in particular the engine's post-run checks held: the
+  storm fired every scheduled burst, the quiesced end state mirrors
+  the authoritative cluster exactly (no event lost, latest-wins
+  coalescing semantics-preserving vs the serially-applied oracle —
+  including the mid-storm relist through the DIFF recovery path),
+  and the cycle watchdog never reached OVERLOADED (ingest never
+  starved the cycle thread);
+* the batched pipeline was actually exercised (events flowed through
+  real batches and at least one event was coalesced away — a storm
+  that never coalesced proves nothing);
+* same seed ⇒ same trace hash across the two batched runs, AND the
+  third run under --ingest-mode event (the per-event differential
+  baseline) reproduces the same hash — ingest mode is
+  decision-invisible.
+"""
+
+import json
+import sys
+
+from chaos_parity import check_ingest_parity
+
+
+def main(path_a: str, path_b: str, path_event: str) -> int:
+    with open(path_a, encoding="utf-8") as f:
+        a = json.load(f)
+    with open(path_b, encoding="utf-8") as f:
+        b = json.load(f)
+    for name, run in (("run1", a), ("run2", b)):
+        assert run["ok"], f"{name} violations: {run['violations']}"
+        assert run["converged_after_drain_ticks"] is not None, \
+            f"{name} never converged"
+        ing = run["ingest"]
+        assert ing is not None and ing["mode"] == "batched", ing
+        assert ing["storm_bursts"] >= 1, \
+            f"{name}: the event storm never fired: {ing}"
+        assert ing["mirror_divergence"] == 0, \
+            f"{name}: mirror diverged from the cluster: {ing}"
+        assert ing["events"] > 0 and ing["batches"] > 0, \
+            f"{name}: the batched pipeline never ran: {ing}"
+        assert ing["coalesced"] >= 1, \
+            f"{name}: the storm never coalesced a single event: {ing}"
+        assert run["recoveries"].get("relisted", 0) >= 1, \
+            f"{name}: the mid-storm relist never happened: " \
+            f"{run['recoveries']}"
+    assert a["trace_hash"] == b["trace_hash"], (
+        f"same-seed storm runs diverged: "
+        f"{a['trace_hash']} != {b['trace_hash']}"
+    )
+    check_ingest_parity(a, path_event, "ingest")
+    ing = a["ingest"]
+    print(
+        "chaos ingest: ok — same-seed hash "
+        f"{a['trace_hash'][:16]}… reproduced (incl. --ingest-mode "
+        f"event); {ing['storm_bursts']} storm burst(s), "
+        f"{ing['events']} events in {ing['batches']} batches "
+        f"({ing['coalesced']} coalesced), mid-storm relist recovered, "
+        "mirror parity exact, cycle thread never starved"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1], sys.argv[2], sys.argv[3]))

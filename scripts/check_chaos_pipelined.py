@@ -18,7 +18,8 @@ import json
 import sys
 
 
-def main(path_a: str, path_b: str, path_packfull: str | None = None) -> int:
+def main(path_a: str, path_b: str, path_packfull: str | None = None,
+         path_event: str | None = None) -> int:
     with open(path_a, encoding="utf-8") as f:
         a = json.load(f)
     with open(path_b, encoding="utf-8") as f:
@@ -62,10 +63,14 @@ def main(path_a: str, path_b: str, path_packfull: str | None = None) -> int:
             "incremental runs never took the patch path — the parity "
             f"check is vacuous: {incr_pack}"
         )
+    from chaos_parity import check_ingest_parity
+
+    parity = check_ingest_parity(a, path_event, "guardrail")
     print(
         "chaos pipelined: ok — same-seed hash "
         f"{a['trace_hash'][:16]}… reproduced"
         + (" (and under --pack-mode full)" if path_packfull else "")
+        + parity
         + f"; breaker tripped {a['guardrail']['breaker_opened']}x "
         "and drained to zero in-flight writes; per-pod wire order "
         "preserved"
@@ -75,4 +80,5 @@ def main(path_a: str, path_b: str, path_packfull: str | None = None) -> int:
 
 if __name__ == "__main__":
     sys.exit(main(sys.argv[1], sys.argv[2],
-                  sys.argv[3] if len(sys.argv) > 3 else None))
+                  sys.argv[3] if len(sys.argv) > 3 else None,
+                  sys.argv[4] if len(sys.argv) > 4 else None))

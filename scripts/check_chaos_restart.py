@@ -27,7 +27,10 @@ import json
 import sys
 
 
-def main(path_a: str, path_b: str) -> int:
+from chaos_parity import check_ingest_parity
+
+
+def main(path_a: str, path_b: str, path_event: str | None = None) -> int:
     with open(path_a, encoding="utf-8") as f:
         a = json.load(f)
     with open(path_b, encoding="utf-8") as f:
@@ -85,10 +88,12 @@ def main(path_a: str, path_b: str) -> int:
         f"same-seed crash-restart runs diverged: "
         f"{a['trace_hash']} != {b['trace_hash']}"
     )
+    parity = check_ingest_parity(a, path_event, "restart")
     r = a["restart"]
     print(
         "chaos restart: ok — same-seed hash "
-        f"{a['trace_hash'][:16]}… reproduced; {r['restarts']} "
+        f"{a['trace_hash'][:16]}… reproduced" + parity +
+        f"; {r['restarts']} "
         f"restart(s), {len([s for s in r['sequence'] if s['pre_cordoned']])} "
         f"mid-quarantine (0 cordoned placements), pin survived "
         f"(0 recompiles), breaker re-opened without a re-streak, "
@@ -99,4 +104,5 @@ def main(path_a: str, path_b: str) -> int:
 
 
 if __name__ == "__main__":
-    sys.exit(main(sys.argv[1], sys.argv[2]))
+    sys.exit(main(sys.argv[1], sys.argv[2],
+                  sys.argv[3] if len(sys.argv) > 3 else None))

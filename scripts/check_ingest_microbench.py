@@ -1,0 +1,79 @@
+#!/usr/bin/env python
+"""make verify's ingest microbench gate (config-3 scale, CPU).
+
+Two hard assertions so watch-ingest performance can't silently
+regress (doc/design/ingest-batching.md):
+
+* the BATCHED ingest pipeline must absorb a replayed event storm
+  (every pod's status flapping 16x, round-robin) >= 3x faster than
+  the per-event baseline — the coalesce-before-decode + one-lock
+  bulk-apply acceptance pin;
+* the batched DIFF relist (recovery timed through to the next tensor
+  pack) must beat the per-event clear()+rebuild recovery >= 2x — the
+  O(1)-lock relist acceptance pin.
+
+Timing discipline matches check_pack_microbench: bench.
+run_ingest_compare already takes best-of-N per side, and this gate
+re-measures once in full before failing — a CI box under load must
+not flake the gate on one noisy window.  Ingest-mode EQUIVALENCE
+(batched final state bit-identical to serial apply) is pinned
+separately in tests/test_ingest_batch.py; this gate is purely speed.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Runnable as `python scripts/check_ingest_microbench.py` from the
+# repo root (the Makefile's invocation): put the repo on the path.
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+STORM_GATE = 3.0
+RELIST_GATE = 2.0
+
+
+def measure() -> tuple[float, float, dict]:
+    from bench import run_ingest_compare
+
+    out = run_ingest_compare(scales=(3,), repeats=5)
+    return out["storm_speedup"], out["relist_speedup"], out
+
+
+def main() -> int:
+    storm, relist, out = measure()
+    if storm < STORM_GATE or relist < RELIST_GATE:
+        # One full re-measure before failing (noisy-window
+        # tolerance).  The gate judges ONE coherent run — keep
+        # whichever run passes (or margins better), so the printed
+        # detail always matches the numbers being asserted.
+        storm2, relist2, out2 = measure()
+        if (storm2 >= STORM_GATE and relist2 >= RELIST_GATE) or (
+            min(storm2 / STORM_GATE, relist2 / RELIST_GATE)
+            > min(storm / STORM_GATE, relist / RELIST_GATE)
+        ):
+            storm, relist, out = storm2, relist2, out2
+    detail = out["scales"]["3"]
+    assert storm >= STORM_GATE, (
+        f"batched ingest only {storm:.2f}x over per-event on the "
+        f"replayed storm at config-3 (gate: >= {STORM_GATE}x): {detail}"
+    )
+    assert relist >= RELIST_GATE, (
+        f"batched diff relist only {relist:.2f}x over the per-event "
+        f"clear()+rebuild recovery (gate: >= {RELIST_GATE}x): {detail}"
+    )
+    print(
+        f"ingest microbench: ok — storm {storm:.2f}x (gate >= "
+        f"{STORM_GATE}x, {detail['storm_events']} events, "
+        f"{detail['storm_coalesced']} coalesced, "
+        f"{detail['storm_events_per_sec_batched']}/s batched); relist "
+        f"{relist:.2f}x (gate >= {RELIST_GATE}x, "
+        f"{detail['relist_objects']} objects, "
+        f"{detail['relist_batched_ms']}ms batched vs "
+        f"{detail['relist_event_ms']}ms per-event)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

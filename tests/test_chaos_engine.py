@@ -114,6 +114,8 @@ def test_same_seed_identical_trace_and_assignment(tmp_path):
     assert recorded[0] == {
         "tick": -1, "op": "meta", "seed": 3,
         "wire_commit": "sync",
+        "pack_mode": "incremental",
+        "ingest_mode": "batched",
         **{k: getattr(FAULTS, k) for k in _META_FAULT_FIELDS},
     }
     replay = ChaosEngine(

@@ -1,0 +1,727 @@
+"""Batched watch ingestion (client/adapter.py · batched pipeline;
+doc/design/ingest-batching.md).
+
+The acceptance contract: coalescing is SEMANTICS-PRESERVING — the
+batched pipeline's final cache (and packed tensor) state is
+bit-identical to the serial per-event apply on a seeded event fuzz,
+including ADDED/DELETED annihilation and relist replay — and the diff
+relist over a populated cache reproduces a cold build exactly.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from kube_batch_tpu.api.resource import ResourceSpec
+from kube_batch_tpu.api.types import TaskStatus
+from kube_batch_tpu.cache.cache import SchedulerCache
+from kube_batch_tpu.cache.cluster import Node, Pod, PodGroup
+from kube_batch_tpu.cache.incremental import IncrementalPacker
+from kube_batch_tpu.cache.packer import pack_snapshot_full
+from kube_batch_tpu.client.adapter import (
+    WatchAdapter,
+    resolve_ingest_mode,
+)
+from kube_batch_tpu.client.codec import (
+    encode_node,
+    encode_pod,
+    encode_pod_group,
+)
+
+SPEC = ResourceSpec()
+
+
+def _fresh_cache() -> SchedulerCache:
+    c = SchedulerCache(spec=SPEC, binder=None, evictor=None)
+    c.register_dirty_listener()
+    return c
+
+
+def _world_lines(n_nodes=4, n_groups=3):
+    nodes = [
+        Node(name=f"n{i}", uid=f"uid-n{i}",
+             allocatable={"cpu": 16000.0, "memory": 64e9, "pods": 110.0})
+        for i in range(n_nodes)
+    ]
+    groups = [
+        PodGroup(name=f"g{i}", uid=f"uid-g{i}", queue="default",
+                 min_member=1, creation=i)
+        for i in range(n_groups)
+    ]
+    lines = [
+        json.dumps({"type": "ADDED", "kind": "Node",
+                    "object": encode_node(n)})
+        for n in nodes
+    ] + [
+        json.dumps({"type": "ADDED", "kind": "PodGroup",
+                    "object": encode_pod_group(g)})
+        for g in groups
+    ]
+    return nodes, groups, lines
+
+
+def _pod(i: int, group: str, status=TaskStatus.PENDING, node=None) -> Pod:
+    return Pod(
+        name=f"p{i}", uid=f"uid-p{i}", group=group,
+        request={"cpu": 250.0, "memory": 1e9, "pods": 1.0},
+        status=status, node=node, creation=1000 + i,
+    )
+
+
+def _feed(lines, mode: str, cache=None) -> SchedulerCache:
+    cache = cache if cache is not None else _fresh_cache()
+    a = WatchAdapter(cache, iter(lines), ingest_mode=mode).start()
+    a.join(60)
+    assert a.stopped.is_set()
+    return cache
+
+
+def _cache_fingerprint(cache: SchedulerCache) -> dict:
+    with cache.lock():
+        pods = {
+            uid: (p.name, p.group, p.status, p.node,
+                  tuple(sorted(p.labels.items())))
+            for uid, p in cache._pods.items()
+        }
+        nodes = {
+            name: (info.used.tolist(), info.idle.tolist(),
+                   sorted(info.tasks))
+            for name, info in cache._nodes.items()
+        }
+        jobs = {
+            name: (j.queue, sorted(j.tasks))
+            for name, j in cache._jobs.items()
+        }
+        counts = dict(cache._status_counts)
+    return {"pods": pods, "nodes": nodes, "jobs": jobs,
+            "counts": {k: v for k, v in counts.items() if v}}
+
+
+def _pack_arrays(cache: SchedulerCache) -> dict:
+    _snap, _meta, ints = pack_snapshot_full(
+        cache.snapshot(), device=False,
+    )
+    return ints.arrays
+
+
+# ---------------------------------------------------------------------------
+# the acceptance fuzz: batched ≡ serial, bit for bit
+# ---------------------------------------------------------------------------
+
+def test_seeded_fuzz_batched_state_bit_identical_to_serial():
+    """200 seeded steps of ADDED/MODIFIED/DELETED churn — including
+    same-step ADDED+DELETED annihilation fodder, node condition flaps
+    and a mid-fuzz full re-list replay over the populated mirror —
+    applied through the batched pipeline and the per-event baseline:
+    final cache state AND the packed tensors must be bit-identical."""
+    rng = random.Random(42)
+    nodes, groups, lines = _world_lines()
+    # `truth` mirrors what an authoritative cluster would hold; every
+    # MODIFIED re-encodes the FULL current object, the wire contract
+    # both dialects obey.
+    truth: dict[str, Pod] = {}
+    rv = 0
+    next_uid = 0
+
+    def emit(mtype: str, pod: Pod) -> None:
+        nonlocal rv
+        rv += 1
+        obj = (
+            {"uid": pod.uid, "name": pod.name} if mtype == "DELETED"
+            else encode_pod(pod)
+        )
+        lines.append(json.dumps({
+            "type": mtype, "kind": "Pod", "object": obj,
+            "resourceVersion": rv,
+        }))
+
+    statuses = (TaskStatus.PENDING, TaskStatus.BOUND,
+                TaskStatus.RUNNING, TaskStatus.SUCCEEDED,
+                TaskStatus.RELEASING)
+    for step in range(200):
+        op = rng.random()
+        if op < 0.3 or not truth:
+            pod = _pod(next_uid, rng.choice(groups).name)
+            next_uid += 1
+            truth[pod.uid] = pod
+            emit("ADDED", pod)
+        elif op < 0.75:
+            pod = truth[rng.choice(sorted(truth))]
+            pod.status = rng.choice(statuses)
+            # The wire contract both encoders obey: a placement is
+            # cleared only by PENDING; BOUND/RUNNING (re)assign; a
+            # terminal/releasing pod KEEPS its nodeName (k8s pods
+            # never revert spec.nodeName).  Latest-wins merging leans
+            # on this — see WatchAdapter._coalesce.
+            if pod.status in (TaskStatus.BOUND, TaskStatus.RUNNING):
+                pod.node = rng.choice(nodes).name
+            elif pod.status == TaskStatus.PENDING:
+                pod.node = None
+            if rng.random() < 0.2:
+                # Spec mutation mid-run (a label patch): serial apply
+                # IGNORES non-status fields of a MODIFIED — coalescing
+                # must too (the run's basis object is the add-time
+                # truth; see WatchAdapter._coalesce).
+                pod.labels = {"rev": str(step)}
+            emit("MODIFIED", pod)
+        elif op < 0.85:
+            uid = rng.choice(sorted(truth))
+            emit("DELETED", truth.pop(uid))
+        elif op < 0.92:
+            # Annihilation fodder: a pod born and deleted back to back
+            # (the batched pipeline must coalesce the pair away while
+            # preserving a delete for any pre-existing object).
+            pod = _pod(next_uid, rng.choice(groups).name)
+            next_uid += 1
+            emit("ADDED", pod)
+            emit("DELETED", pod)
+        else:
+            node = rng.choice(nodes)
+            node.memory_pressure = not node.memory_pressure
+            lines.append(json.dumps({
+                "type": "MODIFIED", "kind": "Node",
+                "object": encode_node(node), "resourceVersion": rv + 1,
+            }))
+            rv += 1
+        if step == 120:
+            # Mid-fuzz re-list: every live object replays as ADDED
+            # over the populated mirror (known pods become upserts).
+            for pod in truth.values():
+                emit("ADDED", pod)
+            rv += 1
+            lines.append(json.dumps({
+                "type": "SYNC", "resourceVersion": rv,
+            }))
+
+    serial = _feed(lines, "event")
+    batched = _feed(lines, "batched")
+    assert _cache_fingerprint(serial) == _cache_fingerprint(batched)
+    a, b = _pack_arrays(serial), _pack_arrays(batched)
+    assert sorted(a) == sorted(b)
+    for field in a:
+        assert np.array_equal(a[field], b[field]), field
+
+
+def test_k8s_dialect_batched_matches_serial():
+    """The k8s dialect through the batched pipeline: PriorityClass
+    decoder-state events keep their serial position relative to pod
+    decodes, Failed transitions stay barriers, and the final cache
+    matches the per-event baseline."""
+    from tests.test_k8s_ingest import k8s_node, k8s_pod, k8s_pod_group
+
+    from kube_batch_tpu.client.k8s import K8sWatchAdapter
+
+    events = [
+        {"type": "ADDED", "object": k8s_node("kn0")},
+        {"type": "ADDED", "object": {
+            "kind": "PriorityClass", "metadata": {"name": "high"},
+            "value": 1000,
+        }},
+        {"type": "ADDED", "object": k8s_pod_group("kg0", 1)},
+        {"type": "ADDED", "object": k8s_pod(
+            "kp0", group="kg0", priority_class="high",
+        )},
+        {"type": "MODIFIED", "object": k8s_pod(
+            "kp0", group="kg0", priority_class="high", phase="Running",
+            node="kn0",
+        )},
+        {"type": "ADDED", "object": k8s_pod("kp1", group="kg0")},
+        {"type": "MODIFIED", "object": k8s_pod(
+            "kp1", group="kg0", phase="Failed", node="kn0",
+        )},
+    ]
+    lines = [json.dumps(e) for e in events]
+
+    def run(mode):
+        c = _fresh_cache()
+        a = K8sWatchAdapter(c, iter(lines), ingest_mode=mode).start()
+        a.join(30)
+        return c
+
+    serial, batched = run("event"), run("batched")
+    assert _cache_fingerprint(serial) == _cache_fingerprint(batched)
+    with batched.lock():
+        # The PriorityClass landed before kp0's decode in both modes.
+        assert batched._pods["uid-pod-kp0"].priority == 1000
+        assert "uid-pod-kp1" not in batched._pods  # Failed: dropped
+
+
+# ---------------------------------------------------------------------------
+# coalescing semantics
+# ---------------------------------------------------------------------------
+
+def _driven_adapter(cache, mode="batched"):
+    """An adapter whose batched pipeline is driven directly (no
+    threads): unit tests get deterministic batch boundaries."""
+    return WatchAdapter(cache, iter(()), ingest_mode=mode)
+
+
+def _items(lines):
+    now = time.monotonic()
+    return [(now, ln) for ln in lines]
+
+
+def test_added_deleted_same_batch_annihilate_without_row_leak():
+    """A pod born and deleted inside ONE batch must not leak a packed
+    row: the pair coalesces away before decode, the journal carries no
+    membership marks for it, and the incremental pack is untouched."""
+    nodes, groups, world = _world_lines()
+    cache = _fresh_cache()
+    _feed(world, "batched", cache)
+    for i in range(4):
+        cache.add_pod(_pod(i, "g0"))
+    packer = IncrementalPacker(cache)
+    packer.pack()
+
+    ghost = _pod(99, "g0")
+    adapter = _driven_adapter(cache)
+    lines = [
+        json.dumps({"type": "ADDED", "kind": "Pod",
+                    "object": encode_pod(ghost), "resourceVersion": 50}),
+        json.dumps({"type": "DELETED", "kind": "Pod",
+                    "object": {"uid": ghost.uid, "name": ghost.name},
+                    "resourceVersion": 51}),
+    ]
+    adapter._process_items(_items(lines))
+    assert adapter.coalesced_events == 1
+    with cache.lock():
+        assert ghost.uid not in cache._pods
+    d = packer._dirty
+    assert ghost.uid not in d.added_pods
+    assert ghost.uid not in d.deleted_pods
+    _snap, meta = packer.pack()
+    assert ghost.uid not in packer._task_row
+    assert meta.num_real_tasks == 4
+
+
+def test_modified_run_coalesces_to_latest_wins():
+    nodes, groups, world = _world_lines()
+    cache = _fresh_cache()
+    _feed(world, "batched", cache)
+    pod = _pod(0, "g0")
+    cache.add_pod(pod)
+    adapter = _driven_adapter(cache)
+    lines = []
+    for i, (status, node) in enumerate((
+        ("BOUND", "n0"), ("RUNNING", "n0"), ("PENDING", None),
+        ("BOUND", "n2"),
+    )):
+        obj = encode_pod(pod)
+        obj["status"], obj["node"] = status, node
+        lines.append(json.dumps({
+            "type": "MODIFIED", "kind": "Pod", "object": obj,
+            "resourceVersion": 60 + i,
+        }))
+    adapter._process_items(_items(lines))
+    assert adapter.coalesced_events == 3
+    with cache.lock():
+        p = cache._pods[pod.uid]
+        assert p.status == TaskStatus.BOUND and p.node == "n2"
+    assert adapter.latest_rv == 63  # RVs advance past coalesced events
+
+
+def test_added_modified_merge_keeps_basis_spec_and_final_status():
+    """An unknown pod's ADDED merged with later MODIFIEDs must apply
+    the ADD-TIME spec (serial chains never apply a MODIFIED's
+    labels/requests) with the run's FINAL status/node — not the newest
+    object wholesale."""
+    nodes, groups, world = _world_lines()
+    cache = _fresh_cache()
+    _feed(world, "batched", cache)
+    pod = _pod(0, "g0")
+    pod.labels = {"rev": "v1"}
+    first = encode_pod(pod)
+    pod.labels = {"rev": "v2"}  # a label patch inside the batch window
+    pod.status, pod.node = TaskStatus.BOUND, "n1"
+    second = encode_pod(pod)
+    adapter = _driven_adapter(cache)
+    adapter._process_items(_items([
+        json.dumps({"type": "ADDED", "kind": "Pod", "object": first}),
+        json.dumps({"type": "MODIFIED", "kind": "Pod",
+                    "object": second}),
+    ]))
+    assert adapter.coalesced_events == 1
+    with cache.lock():
+        p = cache._pods[pod.uid]
+        assert p.labels == {"rev": "v1"}  # basis spec, like serial
+        assert p.status == TaskStatus.BOUND and p.node == "n1"
+
+
+def test_delete_then_readd_same_batch_keeps_both_ops():
+    """DELETED followed by a re-ADDED of the same uid must NOT
+    annihilate — the recreate survives, like the serial apply."""
+    nodes, groups, world = _world_lines()
+    cache = _fresh_cache()
+    _feed(world, "batched", cache)
+    pod = _pod(0, "g0")
+    cache.add_pod(pod)
+    reborn = _pod(0, "g1")  # same uid, new group
+    adapter = _driven_adapter(cache)
+    lines = [
+        json.dumps({"type": "DELETED", "kind": "Pod",
+                    "object": {"uid": pod.uid, "name": pod.name}}),
+        json.dumps({"type": "ADDED", "kind": "Pod",
+                    "object": encode_pod(reborn)}),
+    ]
+    adapter._process_items(_items(lines))
+    with cache.lock():
+        assert cache._pods[pod.uid].group == "g1"
+
+
+def test_failed_barrier_survives_deleted_in_same_batch():
+    """A k8s Failed-phase MODIFIED followed by its DELETED in ONE
+    batch: the Failed event is a coalescing BARRIER and must still
+    APPLY — its side effect (death attribution to the health ledger)
+    is the reason it exists; a DELETED must not annihilate it."""
+    from tests.test_k8s_ingest import k8s_node, k8s_pod
+
+    from kube_batch_tpu.client.k8s import K8sWatchAdapter
+
+    deaths = []
+
+    class Ledger:
+        def attach_cache(self, c):
+            pass
+
+        def note_pod_death(self, node):
+            deaths.append(node)
+
+    def run(mode):
+        deaths.clear()
+        c = _fresh_cache()
+        c.attach_health(Ledger())
+        events = [
+            {"type": "ADDED", "object": k8s_node("kn0")},
+            {"type": "ADDED", "object": k8s_pod(
+                "kp0", node="kn0", phase="Running",
+            )},
+        ]
+        lines = [json.dumps(e) for e in events]
+        a = K8sWatchAdapter(c, iter(lines), ingest_mode=mode).start()
+        a.join(30)
+        burst = [
+            json.dumps({"type": "MODIFIED", "object": k8s_pod(
+                "kp0", node="kn0", phase="Failed",
+            )}),
+            json.dumps({"type": "DELETED", "object": k8s_pod(
+                "kp0", node="kn0", phase="Failed",
+            )}),
+        ]
+        if mode == "batched":
+            drv = K8sWatchAdapter(c, iter(()), ingest_mode="batched")
+            now = time.monotonic()
+            drv._process_items([(now, ln) for ln in burst])
+        else:
+            a2 = K8sWatchAdapter(c, iter(burst),
+                                 ingest_mode="event").start()
+            a2.join(30)
+        with c.lock():
+            assert "uid-pod-kp0" not in c._pods
+        return list(deaths)
+
+    assert run("event") == ["kn0"]
+    assert run("batched") == ["kn0"]  # the barrier applied, then the delete
+    """A uid (or node name) carrying JSON escapes must not be sniffed
+    into a truncated value — the line falls back to the full parse and
+    still applies exactly."""
+    nodes, groups, world = _world_lines()
+    cache = _fresh_cache()
+    _feed(world, "batched", cache)
+    weird = Pod(
+        name='we"ird', uid='uid-we"ird\\x', group="g0",
+        request={"cpu": 100.0, "pods": 1.0}, creation=7,
+    )
+    lines = [json.dumps({
+        "type": "ADDED", "kind": "Pod", "object": encode_pod(weird),
+        "resourceVersion": 9,
+    })]
+    adapter = _driven_adapter(cache)
+    adapter._process_items(_items(lines))
+    with cache.lock():
+        assert weird.uid in cache._pods
+    # And a weird NODE NAME on a known pod's tail: full-parse fallback.
+    weird.node = 'no"de'
+    weird.status = TaskStatus.RUNNING
+    adapter._process_items(_items([json.dumps({
+        "type": "MODIFIED", "kind": "Pod", "object": encode_pod(weird),
+        "resourceVersion": 10,
+    })]))
+    with cache.lock():
+        assert cache._pods[weird.uid].status == TaskStatus.RUNNING
+
+
+# ---------------------------------------------------------------------------
+# relist: the diff fast path
+# ---------------------------------------------------------------------------
+
+def _listing_lines(nodes, groups, pods, rv=500):
+    lines = [
+        json.dumps({"type": "ADDED", "kind": "Node",
+                    "object": encode_node(n)})
+        for n in nodes
+    ] + [
+        json.dumps({"type": "ADDED", "kind": "PodGroup",
+                    "object": encode_pod_group(g)})
+        for g in groups
+    ] + [
+        json.dumps({"type": "ADDED", "kind": "Pod",
+                    "object": encode_pod(p)})
+        for p in pods
+    ]
+    lines.append(json.dumps({"type": "SYNC", "resourceVersion": rv}))
+    return lines
+
+
+@pytest.mark.parametrize("mode", ["batched", "event"])
+def test_relist_over_populated_cache_matches_cold_build(mode):
+    """The satellite acceptance pin: a full re-list replaying ADDED
+    over a LIVE cache — including stale objects the cluster no longer
+    has (a pod, a node, a whole group) and a placement that moved
+    during the gap — must produce a packed snapshot byte-identical to
+    a fresh cold build, in BOTH ingest modes (batched takes the diff
+    fast path with the SYNC-time sweep; event mode the legacy
+    clear()+rebuild)."""
+    nodes, groups, world = _world_lines()
+    live_pods = [
+        _pod(0, "g0", TaskStatus.RUNNING, "n0"),
+        _pod(1, "g0"),
+        _pod(2, "g1", TaskStatus.BOUND, "n1"),
+    ]
+    # The populated mirror: live objects + stale ones a watch gap hid
+    # the deletion of, and p2 still thought placed on n1.
+    cache = _fresh_cache()
+    _feed(world, mode, cache)
+    import copy
+
+    for p in live_pods:
+        cache.add_pod(copy.copy(p))
+    cache.add_pod(_pod(7, "g1", TaskStatus.RUNNING, "n2"))  # stale pod
+    cache.add_node(Node(name="gone-n", uid="uid-gone-n",
+                        allocatable={"cpu": 1000.0, "pods": 10.0}))
+    cache.add_pod_group(PodGroup(name="gone-g", uid="uid-gone-g",
+                                 queue="default"))
+    # The cluster truth the LIST will replay: p2 moved to n3 during
+    # the gap, the stale objects are gone.
+    moved = copy.copy(live_pods[2])
+    moved.node = "n3"
+    listing = _listing_lines(nodes, groups,
+                             [live_pods[0], live_pods[1], moved])
+
+    cache.begin_relist()
+    adapter = WatchAdapter(cache, iter(listing), ingest_mode=mode)
+    if not adapter.begin_relist_diff():
+        cache.clear()
+    adapter.start()
+    assert adapter.wait_for_sync(30)
+    adapter.join(10)
+    cache.end_relist()
+
+    cold = _fresh_cache()
+    _feed(world, mode, cold)
+    _feed(listing, mode, cold)
+
+    assert _cache_fingerprint(cache) == _cache_fingerprint(cold)
+    a, b = _pack_arrays(cache), _pack_arrays(cold)
+    for field in a:
+        assert np.array_equal(a[field], b[field]), field
+    with cache.lock():
+        assert "uid-p7" not in cache._pods
+        assert "gone-n" not in cache._nodes
+        assert "gone-g" not in cache._jobs
+        assert cache._pods["uid-p2"].node == "n3"
+
+
+def test_relist_diff_sweep_demotes_job_with_live_pods_to_shell():
+    """A LIST that re-delivers a group's pods but not its PodGroup
+    object (the group vanished during the gap) must leave a SHELL job
+    — exactly what the clear()+rebuild path produces via add_pod."""
+    nodes, groups, world = _world_lines()
+    cache = _fresh_cache()
+    _feed(world, "batched", cache)
+    pod = _pod(0, "g0")
+    cache.add_pod(pod)
+    cache.begin_relist()
+    listing = _listing_lines(nodes, [g for g in groups
+                                     if g.name != "g0"], [pod])
+    adapter = WatchAdapter(cache, iter(listing), ingest_mode="batched")
+    assert adapter.begin_relist_diff()
+    adapter.start()
+    assert adapter.wait_for_sync(30)
+    adapter.join(10)
+    cache.end_relist()
+    with cache.lock():
+        job = cache._jobs["g0"]
+        assert job.queue == ""  # shell: invisible to scheduling
+        assert pod.uid in job.tasks
+    # Parity against the cold build of the same LIST (the clear()+
+    # replay recovery: the unlisted group's shell reappears via
+    # add_pod, exactly what the demotion left).
+    cold = _fresh_cache()
+    _feed(listing, "batched", cold)
+    assert _cache_fingerprint(cache) == _cache_fingerprint(cold)
+
+
+def test_relist_diff_leaves_pack_journal_incremental():
+    """The structural recovery win: an unchanged world's diff relist
+    leaves the pack journal empty (no-op upserts skip), so the next
+    pack is INCREMENTAL — the event-mode clear() forces a full
+    rebuild.  This is what the bench's relist >= 2x gate measures."""
+    nodes, groups, world = _world_lines()
+    cache = _fresh_cache()
+    _feed(world, "batched", cache)
+    pods = [_pod(i, "g0") for i in range(6)]
+    for p in pods:
+        cache.add_pod(p)
+    packer = IncrementalPacker(cache)
+    packer.pack()
+    listing = _listing_lines(nodes, groups, pods)
+    cache.begin_relist()
+    adapter = WatchAdapter(cache, iter(listing), ingest_mode="batched")
+    assert adapter.begin_relist_diff()
+    adapter.start()
+    assert adapter.wait_for_sync(30)
+    adapter.join(10)
+    cache.end_relist()
+    packer.pack()
+    assert packer.last_mode.startswith("incremental"), packer.last_mode
+
+
+# ---------------------------------------------------------------------------
+# plumbing
+# ---------------------------------------------------------------------------
+
+def test_grouped_marks_match_serial_journal():
+    """apply_batch merges its mark buffer into the listener exactly
+    once, and the journal a batch leaves is equivalent to the serial
+    per-event one (same sets, same within-category order, same
+    version delta)."""
+    serial, batched = _fresh_cache(), _fresh_cache()
+    for c in (serial, batched):
+        c.add_node(Node(name="n0",
+                        allocatable={"cpu": 1000.0, "pods": 10.0}))
+        c.add_pod_group(PodGroup(name="g0", queue="default"))
+    ds = serial.register_dirty_listener()
+    db = batched.register_dirty_listener()
+    pods = [_pod(i, "g0") for i in range(3)]
+
+    def ops_for(c):
+        import copy
+
+        mine = [copy.copy(p) for p in pods]
+        return [
+            lambda: c.add_pod(mine[0]),
+            lambda: c.add_pod(mine[1]),
+            lambda: c.update_pod_status(
+                mine[0].uid, TaskStatus.BOUND, node="n0",
+            ),
+            lambda: c.add_pod(mine[2]),
+            lambda: c.delete_pod(mine[1].uid),
+        ]
+
+    for op in ops_for(serial):
+        op()
+    batched.apply_batch(ops_for(batched))
+    assert ds.status_pods == db.status_pods
+    assert ds.added_pods == db.added_pods
+    assert ds.deleted_pods == db.deleted_pods
+    assert ds.added_jobs == db.added_jobs
+    assert ds.groups == db.groups
+    assert ds.reset_groups == db.reset_groups
+    assert ds.version == db.version
+    assert ds.nodes == db.nodes
+    assert ds.full == db.full
+
+
+def test_apply_batch_defers_health_hooks_past_the_lock():
+    """Health-ledger callbacks fired by batched ops (node flaps,
+    delete_node forgets) run AFTER the batch's lock hold releases —
+    the ledger may touch the wire via its cordon sink."""
+    cache = _fresh_cache()
+    node = Node(name="n0", allocatable={"cpu": 1000.0, "pods": 10.0})
+    cache.add_node(node)
+    seen = []
+
+    class Ledger:
+        def attach_cache(self, c):
+            pass
+
+        def note_flap(self, name, kind):
+            seen.append(("flap", name, kind,
+                         cache._lock.acquire(blocking=False)))
+            cache._lock.release()
+
+        def forget(self, name):
+            seen.append(("forget", name,
+                         cache._lock.acquire(blocking=False)))
+            cache._lock.release()
+
+    cache.attach_health(Ledger())
+    flapped = Node(name="n0",
+                   allocatable={"cpu": 1000.0, "pods": 10.0},
+                   ready=True, memory_pressure=True)
+    cache.apply_batch([
+        lambda: cache.update_node(flapped),
+        lambda: cache.delete_node("n0"),
+    ])
+    # Both hooks ran, after the hold (the non-blocking acquire
+    # succeeded — had they run under the batch hold from another
+    # thread's perspective this would be False... the real assertion
+    # is ordering: hooks fire once the batch is fully applied).
+    assert [s[:2] for s in seen] == [("flap", "n0"), ("forget", "n0")]
+    with cache.lock():
+        assert "n0" not in cache._nodes
+
+
+def test_response_lines_bypass_the_batch_queue():
+    """RESPONSE messages are delivered by the reader thread the moment
+    they arrive — a blocked commit worker must never wait behind a
+    queued event batch."""
+    delivered = threading.Event()
+
+    class FakeBackend:
+        generation = 0
+
+        def deliver_response(self, msg):
+            if msg.get("id") == 7:
+                delivered.set()
+
+        def mark_closed(self, gen=None):
+            pass
+
+    gate = threading.Event()
+
+    def line_stream():
+        yield json.dumps({"type": "ADDED", "kind": "Pod",
+                          "object": encode_pod(_pod(0, None))})
+        yield json.dumps({"type": "RESPONSE", "id": 7, "ok": True})
+        # Hold the stream open: the response must not need EOF.
+        gate.wait(10)
+
+    cache = _fresh_cache()
+    adapter = WatchAdapter(cache, line_stream(),
+                           backend=FakeBackend(),
+                           ingest_mode="batched").start()
+    assert delivered.wait(5.0)
+    gate.set()
+    adapter.join(10)
+
+
+def test_ingest_mode_resolution():
+    assert resolve_ingest_mode(None) == "batched"
+    assert resolve_ingest_mode("event") == "event"
+    import os
+
+    os.environ["KB_TPU_INGEST_MODE"] = "event"
+    try:
+        assert resolve_ingest_mode(None) == "event"
+        assert resolve_ingest_mode("batched") == "batched"  # arg wins
+    finally:
+        del os.environ["KB_TPU_INGEST_MODE"]
+    with pytest.raises(ValueError):
+        resolve_ingest_mode("bogus")
