@@ -180,6 +180,7 @@ verify:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m slow
 	JAX_PLATFORMS=cpu $(PY) scripts/check_pack_microbench.py
 	JAX_PLATFORMS=cpu $(PY) scripts/check_ingest_microbench.py
+	JAX_PLATFORMS=cpu $(PY) scripts/check_trace_overhead.py
 	$(PY) -c "import __graft_entry__ as g; g.entry()"
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 	    $(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"

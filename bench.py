@@ -733,6 +733,20 @@ def _run_daemon_phases(jax, n, cache, sim, conf_path, steady_cycles) -> dict:
         out["ingest_compare"] = {"error": str(exc)[:300]}
     emit_partial(ingest_compare=out["ingest_compare"])
 
+    # -- always-on tracing overhead (kube_batch_tpu/trace/) -------------
+    # Every daemon artifact records the observability tax — the <3%
+    # GATE lives in scripts/check_trace_overhead.py (make verify);
+    # here the number just rides the artifact so the trajectory shows
+    # any creep.  Cheap (seconds); a tight budget drops the scale, not
+    # the section.
+    try:
+        out["trace_overhead"] = run_trace_overhead(
+            config=3 if _budget_left() > 120.0 else 1
+        )
+    except Exception as exc:  # noqa: BLE001 — degrade, never die
+        out["trace_overhead"] = {"error": str(exc)[:300]}
+    emit_partial(trace_overhead=out["trace_overhead"])
+
     # -- sustained-churn soak (VERDICT r4 next #7) ----------------------
     # Budget degradation ladder: full 50 cycles, then a shorter soak,
     # then skip only when there is genuinely nothing left — the
@@ -1250,6 +1264,25 @@ def run_ingest_compare(scales=(3,), churn: int = 16,
     out["storm_speedup"] = first["storm_speedup"]
     out["relist_speedup"] = first["relist_speedup"]
     return out
+
+
+def run_trace_overhead(config: int = 3, rounds: int = 2) -> dict:
+    """Tracing-on vs tracing-off steady-cycle medians — the same
+    measurement `scripts/check_trace_overhead.py` gates in make
+    verify, loaded from the script so the artifact's number and the
+    gate's number can never diverge in method."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "check_trace_overhead",
+        os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "scripts", "check_trace_overhead.py",
+        ),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.measure_overhead(config=config, rounds=rounds)
 
 
 def _text(b) -> str:

@@ -26,7 +26,7 @@ import threading
 import time
 import weakref
 
-from kube_batch_tpu import metrics
+from kube_batch_tpu import metrics, trace
 from kube_batch_tpu.api.resource import ResourceSpec
 from kube_batch_tpu.api.types import TaskStatus
 from kube_batch_tpu.cache.backend import (
@@ -1029,6 +1029,9 @@ class SchedulerCache:
                     f"bind-failed: unknown node {node_name}",
                     namespace=pod.namespace,
                 )
+                self._note_bind_refused(
+                    pod, f"unknown node {node_name}"
+                )
                 return False
             if health is not None and not health.schedulable(node_name):
                 # The node quarantined between snapshot and commit: a
@@ -1041,6 +1044,9 @@ class SchedulerCache:
                     f"bind-refused: node {node_name} is cordoned",
                     namespace=pod.namespace,
                 )
+                self._note_bind_refused(
+                    pod, f"node {node_name} is cordoned"
+                )
                 return False
             self.update_pod_status(pod_uid, TaskStatus.BINDING, node=node_name)
         if health is not None:
@@ -1049,6 +1055,18 @@ class SchedulerCache:
             # canary placement on a probation node.
             health.note_placement(node_name)
         return True
+
+    @staticmethod
+    def _note_bind_refused(pod, reason: str) -> None:
+        """Commit-time bind refusal → the pod's decision story (no-op
+        while tracing is disabled)."""
+        dlog = trace.decision_log()
+        if dlog is not None:
+            dlog.note_pod(
+                pod.uid, "bind-refused", trace.current_cycle(),
+                name=pod.name, namespace=pod.namespace, group=pod.group,
+                reason=reason,
+            )
 
     def finish_bind(self, pod_uid: str, node_name: str) -> bool:
         """Phase 2, wire side: the backend round trip plus its
@@ -1079,6 +1097,19 @@ class SchedulerCache:
             self.record_event("Pod", pod.name, "BindFailed",
                               f"bind-failed: {exc}",
                               namespace=pod.namespace)
+            # Wire ring + decision story: this funnel is shared by the
+            # sync path and the pipelined flush workers, so every bind
+            # outcome lands in the flight recorder exactly once.
+            trace.note_wire("bind", pod.name, False, node=node_name,
+                            error=str(exc)[:200])
+            dlog = trace.decision_log()
+            if dlog is not None:
+                dlog.note_pod(
+                    pod.uid, "bind-failed", trace.current_cycle(),
+                    name=pod.name, namespace=pod.namespace,
+                    group=pod.group, node=node_name,
+                    error=str(exc)[:200],
+                )
             # Failure ATTRIBUTION (doc/design/node-health.md): a
             # rejection whose transport ANSWERED is the node (or the
             # request) refusing — that is per-node health evidence,
@@ -1111,6 +1142,7 @@ class SchedulerCache:
             metrics.task_scheduling_latency.observe(time.monotonic() - ts)
         if self.health is not None:
             self.health.note_bind_success(node_name)
+        trace.note_wire("bind", pod.name, True, node=node_name)
         metrics.pods_bound.inc()
         self.record_event("Pod", pod.name, "Bound", f"bound -> {node_name}",
                           namespace=pod.namespace)
@@ -1135,6 +1167,8 @@ class SchedulerCache:
             self.record_event("Pod", pod.name, "EvictFailed",
                               f"evict-failed: {exc}",
                               namespace=pod.namespace)
+            trace.note_wire("evict", pod.name, False,
+                            error=str(exc)[:200])
             return False
         if len(budgets) > 1:
             # Upstream divergence, surfaced per decision: Kubernetes'
@@ -1162,6 +1196,7 @@ class SchedulerCache:
             )
         self.record_event("Pod", pod.name, "Evicted", f"evicted: {reason}",
                           namespace=pod.namespace)
+        trace.note_wire("evict", pod.name, True, reason=reason)
         return True
 
     def _matching_budgets(self, pod) -> list[str]:

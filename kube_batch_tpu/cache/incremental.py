@@ -76,7 +76,7 @@ import os
 import jax
 import numpy as np
 
-from kube_batch_tpu import metrics
+from kube_batch_tpu import metrics, trace
 from kube_batch_tpu.api.snapshot import NONE_IDX, SnapshotTensors, bucket
 from kube_batch_tpu.cache.cache import SchedulerCache
 from kube_batch_tpu.cache.packer import (
@@ -254,7 +254,8 @@ class IncrementalPacker:
         # the runbook says flushes it — and the chaos pack-mode parity
         # would compare the block cache against itself.
         prev = None if self.force_full else self._ints
-        with metrics.cycle_phase_latency.time("pack_host_patch"):
+        with metrics.cycle_phase_latency.time("pack_host_patch"), \
+                trace.span("pack_host_patch", mode="full"):
             _, meta, ints = pack_snapshot_full(
                 self.cache.snapshot(shared=True), device=False,
                 prev=prev, invalid_jobs=invalid,
@@ -262,7 +263,8 @@ class IncrementalPacker:
         # H2D split out of the host build so the pack_host_patch /
         # pack_h2d attribution in cycle_phase_latency is real; one
         # batched device_put for the whole pytree, as ever.
-        with metrics.cycle_phase_latency.time("pack_h2d"):
+        with metrics.cycle_phase_latency.time("pack_h2d"), \
+                trace.span("pack_h2d", mode="full"):
             snap = SnapshotTensors(**jax.device_put(ints.arrays))
         nbytes = sum(arr.nbytes for arr in ints.arrays.values())
         self.last_h2d_bytes = nbytes
@@ -291,7 +293,8 @@ class IncrementalPacker:
         changed = _RowChanges()
         rows_changed = False
 
-        with metrics.cycle_phase_latency.time("pack_host_patch"):
+        with metrics.cycle_phase_latency.time("pack_host_patch"), \
+                trace.span("pack_host_patch", mode="incremental"):
             for name in d.added_jobs:
                 rows_changed |= self._upsert_job(name, changed)
             for uid in d.deleted_pods:
@@ -315,7 +318,8 @@ class IncrementalPacker:
         row_patched = False
         if changed:
             try:
-                with metrics.cycle_phase_latency.time("pack_h2d"):
+                with metrics.cycle_phase_latency.time("pack_h2d"), \
+                        trace.span("pack_h2d", mode="incremental"):
                     row_patched = self._upload(changed)
             except Exception:
                 # Device upload failed (e.g. OOM): the host arrays are

@@ -88,6 +88,19 @@ def build_parser() -> argparse.ArgumentParser:
                         "must hash identically under both (make chaos "
                         "pins it).  Default: adopt from a replayed "
                         "trace's meta header, else 'batched'")
+    p.add_argument("--trace", choices=("on", "off"), default="on",
+                   dest="trace_obs",
+                   help="always-on observability dimension "
+                        "(kube_batch_tpu/trace/): 'on' (default — the "
+                        "production posture) runs the scenario with "
+                        "span tracing, decision records and the "
+                        "anomaly-triggered flight recorder live, and "
+                        "breaker-tripping scenarios ASSERT the "
+                        "auto-dump fired on the trip tick; 'off' is "
+                        "the parity baseline — tracing is decision-"
+                        "invisible, so the same seed must hash "
+                        "identically either way (pinned by "
+                        "tests/test_chaos_trace.py)")
     p.add_argument("--quiet", action="store_true",
                    help="suppress progress logging; print only the "
                         "summary JSON")
@@ -177,6 +190,7 @@ def main(argv: list[str] | None = None) -> int:
         wire_commit=args.wire_commit,
         pack_mode=args.pack_mode,
         ingest_mode=args.ingest_mode,
+        trace_obs=args.trace_obs,
     )
     try:
         result = engine.run()

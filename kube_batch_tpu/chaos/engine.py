@@ -52,6 +52,7 @@ import tempfile
 import time
 
 from kube_batch_tpu import metrics
+from kube_batch_tpu import trace as trace_obs_mod
 from kube_batch_tpu.api.resource import ResourceSpec
 from kube_batch_tpu.api.types import TaskStatus
 from kube_batch_tpu.cache.cache import SchedulerCache
@@ -188,6 +189,11 @@ class ChaosResult:
     #: incarnation, and — event-storm runs — the emitted-storm count
     #: and the final mirror-parity verdict.
     ingest: dict | None = None
+    #: Always-on observability (kube_batch_tpu/trace/): whether the
+    #: run traced, which flight-recorder triggers auto-dumped (and at
+    #: what cycle), and the span/decision-record volumes — the
+    #: tracing-parity and trip-dump check scripts read this.
+    trace: dict | None = None
 
     def summary(self) -> dict:
         return {
@@ -207,6 +213,7 @@ class ChaosResult:
             "pack": self.pack,
             "restart": self.restart,
             "ingest": self.ingest,
+            "trace": self.trace,
         }
 
 
@@ -250,6 +257,7 @@ class ChaosEngine:
         pack_mode: str | None = None,
         state_dir: str | None = None,
         ingest_mode: str | None = None,
+        trace_obs: str | None = None,
     ) -> None:
         self.seed = seed
         self.ticks = ticks
@@ -307,6 +315,25 @@ class ChaosEngine:
         #: Ingest observability accumulated across every adapter
         #: incarnation (reconnects/restarts replace the adapter).
         self._ingest_stats = {"events": 0, "batches": 0, "coalesced": 0}
+        # The always-on observability dimension (kube_batch_tpu/trace/):
+        # scenarios run with tracing ON by default — the production
+        # default — and the tracing-parity tests pin that the SAME
+        # seed hashes identically with it off (tracing is recording,
+        # never a decision input, so it must be invisible to the
+        # hashed schedule).  Deliberately NOT in the trace meta header:
+        # replay parity across the dimension is exactly what the
+        # parity tests assert.
+        self.trace_obs = trace_obs or "on"
+        if self.trace_obs not in ("on", "off"):
+            raise ValueError(
+                f"trace_obs must be 'on' or 'off', got {self.trace_obs!r}"
+            )
+        self._trace_dump_dir: str | None = None
+        self._trace_summary: dict | None = None
+        #: tick -> flight-recorder auto-dump count at END of tick; the
+        #: breaker-trip invariant asserts the dump landed ON the trip
+        #: tick, not eventually.
+        self._trace_dumps_by_tick: dict[int, int] = {}
         self.commit = None  # CommitPipeline, created in run()
         if faults is None and events is not None:
             # A recorded trace carries the recording's run-time fault
@@ -1272,6 +1299,17 @@ class ChaosEngine:
             }
             write_trace(self.trace_path, [header] + events + fault_events)
 
+        # Always-on observability: ON is the production default; the
+        # engine owns a temp dump dir (removed at teardown — repeated
+        # chaos/CI runs must not accumulate post-mortems in /tmp).
+        if self.trace_obs == "on":
+            self._trace_dump_dir = tempfile.mkdtemp(
+                prefix="kb-chaos-trace-"
+            )
+            trace_obs_mod.enable(dump_dir=self._trace_dump_dir)
+        else:
+            trace_obs_mod.disable()
+
         self.cluster = ChaosCluster(
             seed=self.seed, bind_fail_pct=self.faults.bind_fail_pct,
             history=4096,
@@ -1398,6 +1436,13 @@ class ChaosEngine:
                     "state": self.guardrails.state,
                     "breaker": state,
                 }
+            tracer = trace_obs_mod.get()
+            if tracer is not None:
+                # End-of-tick auto-dump census: the breaker-trip
+                # invariant asserts the post-mortem landed ON the trip
+                # tick.  NOT part of the trace hash.
+                self._trace_dumps_by_tick[t] = \
+                    len(tracer.recorder.dumps)
             if self.health is not None:
                 # End-of-tick ledger sample: feeds the recorder and
                 # the per-tick health invariants (a tick is "fully
@@ -1513,6 +1558,7 @@ class ChaosEngine:
             pack=self._pack_summary(),
             restart=self._restart_summary(),
             ingest=self._ingest_summary(),
+            trace=self._trace_summary,
         )
 
     def _pack_summary(self) -> dict | None:
@@ -2093,6 +2139,13 @@ class ChaosEngine:
                     "during fully-open breaker ticks — scheduling did "
                     "not quiesce",
                 ))
+        if (
+            self.faults.blackhole_at
+            and self.trace_obs == "on"
+            and breaker is not None
+            and breaker.opened_count >= 1
+        ):
+            out.extend(self._check_flight_dump(tick))
         if self.faults.hbm_pressure_at and \
                 self.fault_counts.get("hbm-pressure", 0) < 1:
             out.append(Violation(
@@ -2107,6 +2160,55 @@ class ChaosEngine:
                 f"scenario drained but the daemon is still degraded "
                 f"(rung {rails.rung} {rails.state!r}, breaker "
                 f"{rails.breaker_state()!r})",
+            ))
+        return out
+
+    def _check_flight_dump(self, tick: int) -> list[Violation]:
+        """The always-on flight recorder must have auto-dumped a
+        post-mortem ON the tick the breaker tripped, and the dump must
+        name the triggering transition — the production promise the
+        chaos run exists to prove (doc/design/observability.md)."""
+        out: list[Violation] = []
+        # The live tracer (the checks run before teardown harvests
+        # it); the summary is the fallback for post-teardown callers.
+        tracer = trace_obs_mod.get()
+        all_dumps = (
+            list(tracer.recorder.dumps) if tracer is not None
+            else (self._trace_summary or {}).get("dumps", ())
+        )
+        dumps = [
+            d for d in all_dumps if d.get("trigger") == "breaker-open"
+        ]
+        if not dumps:
+            out.append(Violation(
+                "flight-dump-missed-trip", tick,
+                "the wire breaker tripped open but the always-on "
+                "flight recorder never auto-dumped a 'breaker-open' "
+                "post-mortem",
+            ))
+            return out
+        # The trip tick: first end-of-tick sample where the breaker
+        # reads open after a non-open tick (the sample convention the
+        # breaker-open invariant already uses).
+        trip = None
+        prev = "closed"
+        for t in sorted(self._breaker_by_tick):
+            state = self._breaker_by_tick[t]
+            if state == "open" and prev != "open":
+                trip = t
+                break
+            prev = state
+        if trip is None:
+            return out  # opened and re-closed within one tick: no
+            #             stable trip tick to pin the dump against
+        before = self._trace_dumps_by_tick.get(trip - 1, 0)
+        at = self._trace_dumps_by_tick.get(trip)
+        if at is not None and at <= before:
+            out.append(Violation(
+                "flight-dump-missed-trip", tick,
+                f"breaker tripped at tick {trip} but the flight "
+                f"recorder's auto-dump count did not advance that tick "
+                f"({before} -> {at})",
             ))
         return out
 
@@ -2143,6 +2245,28 @@ class ChaosEngine:
             }
 
     def _teardown(self) -> None:
+        tracer = trace_obs_mod.get()
+        if self.trace_obs == "on" and tracer is not None:
+            # Harvest BEFORE disabling: the summary (incl. which
+            # triggers auto-dumped, and when) survives into the
+            # ChaosResult after the dump files themselves are removed
+            # with the engine-owned temp dir below.
+            self._trace_summary = {
+                "enabled": True,
+                "dumps": [dict(d) for d in tracer.recorder.dumps],
+                "spans_recorded":
+                    tracer.spans.stats()["spans_recorded"],
+                "decision_records":
+                    tracer.decisions.stats()["records_total"],
+                "transitions": len(tracer.recorder.transitions),
+            }
+        elif self._trace_summary is None:
+            self._trace_summary = {"enabled": False}
+        trace_obs_mod.disable()
+        if self._trace_dump_dir is not None:
+            import shutil
+
+            shutil.rmtree(self._trace_dump_dir, ignore_errors=True)
         if self.adapter is not None:
             self._harvest_ingest(self.adapter)
         if self.statestore is not None:

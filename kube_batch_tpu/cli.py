@@ -148,6 +148,27 @@ def build_parser() -> argparse.ArgumentParser:
                         "daemon skips the first-cycle recompile "
                         "(default: KB_TPU_COMPILE_CACHE or a tmp dir; "
                         "empty string disables)")
+    # -- always-on observability (kube_batch_tpu/trace/;
+    #    doc/design/observability.md)
+    p.add_argument("--flight-recorder-cycles", type=int, default=256,
+                   help="always-on flight recorder: keep the last N "
+                        "cycle summaries (+ wire ops + subsystem "
+                        "transitions) and auto-dump a post-mortem "
+                        "JSON on breaker open / watchdog escalation / "
+                        "StaleEpoch write / quarantine cordon / "
+                        "statestore corruption, and on SIGUSR2 or "
+                        "GET /debug/dump; 0 disables the whole "
+                        "tracing subsystem (spans, /debug, recorder)")
+    p.add_argument("--flight-recorder-dir", default=None,
+                   help="directory for flight-recorder post-mortem "
+                        "dumps (default: the system temp dir)")
+    p.add_argument("--trace-dir", default=None,
+                   help="continuous span capture: rotate Chrome "
+                        "trace-event JSON chunks (Perfetto-loadable) "
+                        "of the per-cycle span tree into this "
+                        "directory (last 8 x 128-cycle chunks kept); "
+                        "unset serves spans on demand at /debug/trace "
+                        "only")
     # -- guardrails (kube_batch_tpu/guardrails/; doc/design/guardrails.md)
     p.add_argument("--hbm-ceiling-mb", type=float, default=None,
                    help="HBM-ceiling admission: refuse growth-prewarm "
@@ -998,12 +1019,45 @@ def main(argv: list[str] | None = None) -> int:
     if cache_dir:
         logging.info("persistent XLA compile cache: %s", cache_dir)
 
+    # Always-on observability (kube_batch_tpu/trace/): span tracing +
+    # per-pod decision records + the anomaly-triggered flight
+    # recorder, in EVERY run mode — production's window into "why is
+    # my pod pending" and "what happened before the breaker opened".
+    # Decision-invisible and <3% overhead (scripts/
+    # check_trace_overhead.py); --flight-recorder-cycles 0 opts out.
+    if args.flight_recorder_cycles > 0:
+        from kube_batch_tpu import trace
+
+        tracer = trace.enable(
+            flight_cycles=args.flight_recorder_cycles,
+            dump_dir=args.flight_recorder_dir,
+            trace_dir=args.trace_dir,
+        )
+        tracer.recorder.install_signal_handler()
+        logging.info(
+            "observability: tracing on (flight ring %d cycles, "
+            "dumps -> %s%s; SIGUSR2 or GET /debug/dump for an "
+            "on-demand post-mortem)",
+            args.flight_recorder_cycles,
+            tracer.recorder.dump_dir,
+            f", span chunks -> {args.trace_dir}" if args.trace_dir
+            else "",
+        )
+
     # Metrics listener first: it serves in EVERY mode, including the
     # real-cluster stream path below.
     if args.listen_address:
         from kube_batch_tpu import metrics
 
-        metrics.serve(args.listen_address)
+        try:
+            metrics.serve(args.listen_address)
+        except RuntimeError as exc:
+            # A bound port is a deployment error (usually a second
+            # daemon instance): fail LOUD and non-zero instead of
+            # leaking a raw traceback — the supervisor's restart loop
+            # should see a clean, attributable exit.
+            logging.error("%s", exc)
+            return 1
 
     if args.kube_api:
         if args.workload or args.cluster_stream:

@@ -242,9 +242,14 @@ class StreamBackend:
     def _call(self, payload: dict) -> dict:
         if self._is_fenced_payload(payload):
             if self._fenced:
-                from kube_batch_tpu import metrics
+                from kube_batch_tpu import metrics, trace
 
                 metrics.stale_epoch_writes.inc()
+                trace.note_transition(
+                    "stale-epoch", where="local-fence",
+                    verb=str(payload.get("verb")
+                             or payload.get("path")),
+                )
                 raise StaleEpochError(
                     "write fenced locally: leadership lost "
                     "(stand-down); awaiting re-acquire"
@@ -283,9 +288,14 @@ class StreamBackend:
                 # Loud + counted — a zombie write REACHING the wire
                 # means stand-down raced in-flight flushes, which is
                 # exactly what the fence exists to absorb.
-                from kube_batch_tpu import metrics
+                from kube_batch_tpu import metrics, trace
 
                 metrics.stale_epoch_writes.inc()
+                trace.note_transition(
+                    "stale-epoch", where="cluster-reject",
+                    verb=str(payload.get("verb")
+                             or payload.get("path")),
+                )
                 log.error(
                     "write rejected by epoch fencing (%s): %s",
                     payload.get("verb") or payload.get("path"),
@@ -843,12 +853,17 @@ class WatchAdapter:
             ))
             swept = result
         if ops:
-            with metrics.ingest_apply_latency.time():
+            from kube_batch_tpu import trace
+
+            with metrics.ingest_apply_latency.time(), \
+                    trace.span("ingest-apply", events=len(records)):
                 self.cache.apply_batch(ops)
         if records:
-            metrics.ingest_lag.observe(
-                max(0.0, time.monotonic() - records[-1].ts)
-            )
+            lag = max(0.0, time.monotonic() - records[-1].ts)
+            metrics.ingest_lag.observe(lag)
+            # /healthz carries the freshest lag so probes see backlog
+            # pressure without scraping (and parsing) /metrics.
+            metrics.set_ingest_lag(lag)
             metrics.ingest_batch_size.observe(float(len(records)))
             if coalesced:
                 metrics.ingest_coalesced.inc(by=float(coalesced))

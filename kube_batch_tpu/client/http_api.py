@@ -751,10 +751,11 @@ class K8sHttpBackend:
 
     def _check_fence(self) -> None:
         if self._fenced:
-            from kube_batch_tpu import metrics
+            from kube_batch_tpu import metrics, trace
             from kube_batch_tpu.client.adapter import StaleEpochError
 
             metrics.stale_epoch_writes.inc()
+            trace.note_transition("stale-epoch", where="http-local-fence")
             raise StaleEpochError(
                 "write fenced locally: leadership lost (stand-down); "
                 "awaiting re-acquire"

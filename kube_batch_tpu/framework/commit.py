@@ -76,7 +76,7 @@ import logging
 import threading
 import time
 
-from kube_batch_tpu import metrics
+from kube_batch_tpu import metrics, trace
 
 log = logging.getLogger(__name__)
 
@@ -94,14 +94,20 @@ _worker_tls = threading.local()
 
 
 class _Op:
-    __slots__ = ("key", "verb", "fn", "enqueued_at", "batch")
+    __slots__ = ("key", "verb", "fn", "enqueued_at", "batch",
+                 "trace_cycle")
 
-    def __init__(self, key, verb, fn, enqueued_at, batch):
+    def __init__(self, key, verb, fn, enqueued_at, batch, trace_cycle=0):
         self.key = key
         self.verb = verb
         self.fn = fn
         self.enqueued_at = enqueued_at
         self.batch = batch
+        # The scheduler cycle that ENQUEUED this op: flush spans are
+        # attributed to it (not to whatever cycle is running when the
+        # worker finally lands the RTT), so a Perfetto view shows
+        # cycle N's commit tail overlapping cycle N+1's solve.
+        self.trace_cycle = trace_cycle
 
 
 class CommitPipeline:
@@ -194,7 +200,8 @@ class CommitPipeline:
                 if b["first"] is None:
                     b["first"] = now
                 b["pending"] += 1
-                op = _Op(key, verb, fn, now, self._batch_seq)
+                op = _Op(key, verb, fn, now, self._batch_seq,
+                         trace.current_cycle())
                 q = self._queues.get(key)
                 if q is None:
                     q = self._queues[key] = collections.deque()
@@ -240,16 +247,29 @@ class CommitPipeline:
                     self.order_violations += 1
             started = time.monotonic()
             overlapped = self._solving
+            flush_ok = True
             try:
-                op.fn()
+                with trace.span(
+                    "flush:" + op.verb, cycle=op.trace_cycle,
+                    key=op.key,
+                ):
+                    op.fn()
             except Exception:  # noqa: BLE001 — the flushed funnels own
                 # their failure semantics (rollback/resync/_status_retry);
                 # anything escaping is a bug, but the worker must survive.
+                flush_ok = False
                 self.flush_errors += 1
                 metrics.commit_flush_errors.inc()
                 log.exception(
                     "commit flush op (%s %s) raised unexpectedly",
                     op.verb, op.key,
+                )
+            if op.verb != "bind":
+                # Bind outcomes land in the wire ring from the cache's
+                # own finish_bind funnel (shared with the sync path);
+                # recording them here too would double-count.
+                trace.note_wire(
+                    op.verb, op.key, flush_ok, cycle=op.trace_cycle,
                 )
             done = time.monotonic()
             metrics.commit_flush_latency.observe(

@@ -256,12 +256,26 @@ def diagnose_pending(
             policy._diagnose_jit = diag
         counts = jax.device_get(dict(diag(snap, state)))
     out: list[tuple[str, str, str]] = []
+    # Decision records (kube_batch_tpu/trace/): the rendered fit-error
+    # reasons ARE each pending pod's "refused" story entry — the
+    # /debug/pods/<uid> answer reuses this diagnosis pass verbatim
+    # instead of compiling a second device program.
+    from kube_batch_tpu import trace
+
+    dlog = trace.decision_log()
+    cyc = trace.current_cycle()
     for t in pending[:max_events]:
         pod = ssn.meta.task_pods[t]
-        out.append((
-            pod.name, pod.namespace,
-            render_fit_error(pod.name, counts, t, ssn.meta.spec.names),
-        ))
+        message = render_fit_error(
+            pod.name, counts, t, ssn.meta.spec.names
+        )
+        out.append((pod.name, pod.namespace, message))
+        if dlog is not None:
+            dlog.note_pod(
+                pod.uid, "refused", cyc,
+                name=pod.name, namespace=pod.namespace, group=pod.group,
+                reasons=message,
+            )
     if pending.size > max_events:
         out.append((
             "", "default",

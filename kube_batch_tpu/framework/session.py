@@ -23,7 +23,7 @@ from typing import Sequence
 
 import numpy as np
 
-from kube_batch_tpu import metrics
+from kube_batch_tpu import metrics, trace
 from kube_batch_tpu.api.types import TaskStatus
 from kube_batch_tpu.cache.cache import SchedulerCache
 from kube_batch_tpu.cache.packer import pack_snapshot
@@ -266,13 +266,23 @@ class Session:
     def commit_evictions(self, victim_idx: Sequence[int], reason: str) -> None:
         """Land evictions decided by preempt/reclaim (≙ Statement.Commit
         replaying Evict through the cache)."""
+        dlog = trace.decision_log()
+        cyc = trace.current_cycle()
         for t in victim_idx:
             pod = self.meta.task_pods[int(t)]
+            # The victim's node, read BEFORE the eviction mutates it:
+            # the decision record's vacated-node entry is what
+            # attributes the later beneficiary placement.
+            node = pod.node
             if self.cache.evict(pod.uid, reason):
                 self.evicted.append((pod.name, reason))
                 if self._refresh_groups is not None and pod.group:
                     self._refresh_groups.add(pod.group)
                 metrics.pods_evicted.inc(reason)
+                if dlog is not None:
+                    dlog.note_eviction(
+                        pod.uid, pod.name, pod.group, node, reason, cyc,
+                    )
 
     #: Bind fan-out width (≙ the reference's async bind goroutines /
     #: its 16-worker helper pools): each bind through a wire backend is
@@ -316,17 +326,33 @@ class Session:
         # shape, a compile) this cycle deliberately avoided.
         ready = self.job_ready() if newly_idx.size else None
         to_bind: list[tuple[object, str]] = []
+        # Decision records (kube_batch_tpu/trace/): gang-gated drops
+        # per job and landed placements, recorded only while tracing is
+        # enabled — `gated is None` keeps the disabled path free of
+        # bookkeeping.
+        gated: dict[int, int] | None = {} if trace.enabled() else None
         for t in newly_idx:
             if t >= self.meta.num_real_tasks:
                 continue
             j = task_job[t]
             if j < 0 or not ready[j]:
+                if gated is not None and j >= 0:
+                    gated[int(j)] = gated.get(int(j), 0) + 1
                 continue  # gang gate: unready job's placements are dropped
             to_bind.append((
                 self.meta.task_pods[t],
                 self.meta.node_names[task_node[t]],
             ))
+        if gated:
+            dlog = trace.decision_log()
+            cyc = trace.current_cycle()
+            for j, dropped in gated.items():
+                dlog.note_group(
+                    self.meta.job_names[j], "gang-gated", cyc,
+                    placements_dropped=dropped,
+                )
 
+        placed: list = []
         commit = getattr(self.cache, "commit", None)
         if commit is not None:
             # Pipelined: the cache mutation is the cycle's commit; the
@@ -338,8 +364,10 @@ class Session:
                     continue
                 commit.submit_bind(pod.uid, node_name)
                 self.bound.append((pod.name, node_name))
+                placed.append((pod, node_name))
                 if self._refresh_groups is not None and pod.group:
                     self._refresh_groups.add(pod.group)
+            self._note_placed(placed)
             return self.bound
         if len(to_bind) > self._BIND_POOL_THRESHOLD:
             results = list(_bind_pool().map(
@@ -352,9 +380,25 @@ class Session:
         for (pod, node_name), ok in zip(to_bind, results):
             if ok:
                 self.bound.append((pod.name, node_name))
+                placed.append((pod, node_name))
                 if self._refresh_groups is not None and pod.group:
                     self._refresh_groups.add(pod.group)
+        self._note_placed(placed)
         return self.bound
+
+    @staticmethod
+    def _note_placed(placed: list) -> None:
+        """Feed landed binds to the decision log (victim→beneficiary
+        attribution happens inside note_placed when the node was
+        recently vacated by an eviction)."""
+        if not placed:
+            return
+        dlog = trace.decision_log()
+        if dlog is None:
+            return
+        cyc = trace.current_cycle()
+        for pod, node_name in placed:
+            dlog.note_placed(pod.uid, pod.name, pod.group, node_name, cyc)
 
     # -- introspection for plugins' close hooks ------------------------
     def snapshot_ready_counts(self) -> np.ndarray:
@@ -405,10 +449,12 @@ def close_session(ssn: Session, diagnose: bool = True) -> None:
     conditions), write back job status."""
     from kube_batch_tpu.framework.fit_errors import diagnose_pending
 
-    with metrics.cycle_phase_latency.time("bind_dispatch"):
+    with metrics.cycle_phase_latency.time("bind_dispatch"), \
+            trace.span("dispatch"):
         ssn.dispatch_binds()
     if diagnose:
-        with metrics.cycle_phase_latency.time("diagnosis"):
+        with metrics.cycle_phase_latency.time("diagnosis"), \
+                trace.span("diagnosis"):
             for pod_name, namespace, message in diagnose_pending(ssn):
                 ssn.cache.record_event(
                     "Pod" if pod_name else "Scheduler",
@@ -429,7 +475,8 @@ def close_session(ssn: Session, diagnose: bool = True) -> None:
     # a job orphaned by queue deletion leaves the snapshot but still
     # needs its phase corrected (Inqueue → Pending) on the full-rebuild
     # cycle the deletion forces.
-    with metrics.cycle_phase_latency.time("status_writeback"):
+    with metrics.cycle_phase_latency.time("status_writeback"), \
+            trace.span("status_writeback"):
         ssn.cache.refresh_job_statuses(ssn._refresh_groups)
     metrics.pending_tasks.set(
         float(

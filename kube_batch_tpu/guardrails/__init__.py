@@ -43,7 +43,7 @@ import logging
 import os
 import time
 
-from kube_batch_tpu import metrics
+from kube_batch_tpu import metrics, trace
 from kube_batch_tpu.guardrails.breaker import (
     Backoff,
     BreakerOpen,
@@ -344,6 +344,13 @@ class Guardrails:
             "half-open probe succeeds)",
             name, self.config.breaker_failures,
         )
+        # Flight-recorder trigger: the post-mortem of the cycles that
+        # LED to the trip is exactly what the outage runbook starts
+        # from (doc/design/observability.md).
+        trace.note_transition(
+            "breaker-open", backend=name,
+            failures=self.config.breaker_failures,
+        )
         self._publish_health()
         if self._cache is not None:
             self._cache.begin_resync()
@@ -359,6 +366,7 @@ class Guardrails:
             "wire breaker %r CLOSED (half-open probe succeeded); "
             "scheduling resumes", name,
         )
+        trace.note_transition("breaker-close", backend=name)
         self._publish_health()
         if self._cache is not None:
             self._cache.end_resync()
@@ -460,6 +468,13 @@ class Guardrails:
         metrics.guardrail_state.set(float(self.rung))
         self._publish_health()
         if watchdog.rung > changed[0]:
+            # Flight-recorder trigger: an ESCALATION (not the walk
+            # back down) dumps the cycles that overloaded the daemon.
+            trace.note_transition(
+                "watchdog-escalation", who=str(who),
+                rung_from=int(changed[0]), rung_to=int(watchdog.rung),
+                state=RUNGS[watchdog.rung],
+            )
             log.error(
                 "%s: %d consecutive overruns (last %.3fs vs period "
                 "%.3fs); degradation ladder → %r (growth prewarm "

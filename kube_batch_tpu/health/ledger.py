@@ -553,8 +553,23 @@ class NodeHealthLedger:
         per-node — both pack paths pick it up."""
         if not transitions:
             return
+        from kube_batch_tpu import trace
+
         for t in transitions:
             metrics.node_health_state.set(STATE_VALUES[t.new], t.node)
+            if t.new == NodeState.CORDONED:
+                # Flight-recorder trigger: a quarantine cordon means
+                # real placements were failing — the post-mortem holds
+                # the evidence window that crossed the threshold.
+                trace.note_transition(
+                    "quarantine-cordon", node=t.node,
+                    from_state=t.old, reason=t.reason,
+                )
+            else:
+                trace.note_transition(
+                    "node-health", node=t.node,
+                    from_state=t.old, to_state=t.new, reason=t.reason,
+                )
             level = (
                 logging.WARNING
                 if t.new in (NodeState.CORDONED, NodeState.SUSPECT)

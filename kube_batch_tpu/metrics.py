@@ -481,6 +481,7 @@ _health_state = "ok"
 _health_role = "standby"
 _health_epoch = 0
 _health_quarantined = 0
+_health_ingest_lag = 0.0
 
 
 def set_health_state(state: str) -> None:
@@ -528,6 +529,16 @@ def quarantined() -> int:
         return _health_quarantined
 
 
+def set_ingest_lag(seconds: float) -> None:
+    """Publish the freshest ingest lag (age of the newest applied
+    watch event) to /healthz — probes see backlog pressure without
+    scraping and parsing the `ingest_lag_seconds` histogram.  Set by
+    the batched ingest applier on every applied batch."""
+    global _health_ingest_lag
+    with _health_lock:
+        _health_ingest_lag = float(seconds)
+
+
 def health_body() -> bytes:
     """The /healthz response body: one JSON object carrying the
     guardrail ladder state, election role + fencing epoch, and the
@@ -542,18 +553,52 @@ def health_body() -> bytes:
             "role": _health_role,
             "epoch": _health_epoch,
             "quarantined": _health_quarantined,
+            # Backlog-pressure reads for probes: the freshest applied-
+            # batch ingest lag and the commit pipeline's current
+            # queued+running depth — both already exist as /metrics
+            # series; here they are one cheap GET away for a liveness
+            # probe or a runbook's first look.
+            "ingest_lag_seconds": round(_health_ingest_lag, 3),
         }
+    body["commit_queue_depth"] = int(commit_queue_depth.value())
     return json.dumps(body, sort_keys=True).encode()
 
 
 def serve(address: str = ":8080") -> threading.Thread:
-    """Serve /metrics on `address` (≙ --listen-address), daemon thread."""
+    """Serve /metrics (+ /healthz and the /debug observability
+    surface) on `address` (≙ --listen-address), daemon thread.
+
+    Raises RuntimeError with a clear, flag-naming message when the
+    port cannot be bound (most commonly: another daemon instance is
+    already serving on it) — the old behavior was a raw OSError
+    traceback out of the listener setup, which cost operators a
+    debugging round trip to map back to --listen-address."""
     host, _, port = address.rpartition(":")
 
     registry = REGISTRY
 
     class Handler(http.server.BaseHTTPRequestHandler):
         def do_GET(self):  # noqa: N802 (stdlib API)
+            if self.path.startswith("/debug"):
+                # Always-on observability (kube_batch_tpu/trace/):
+                # per-pod decision stories, cycle summaries, the
+                # flight-recorder dump and the Chrome span trace.
+                # Lazy import: metrics must stay importable without
+                # the trace package loaded.
+                import json as _json
+
+                from kube_batch_tpu import trace as _trace
+
+                status, payload = _trace.debug_http(self.path)
+                body = _json.dumps(
+                    payload, sort_keys=True, default=str
+                ).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
             if self.path == "/healthz":
                 # Liveness for supervisors/load-balancers (the
                 # deployment runbook's systemd watchdog target): the
@@ -584,7 +629,18 @@ def serve(address: str = ":8080") -> threading.Thread:
         def log_message(self, *args):  # silence per-request stderr noise
             return
 
-    server = http.server.ThreadingHTTPServer((host or "", int(port)), Handler)
+    try:
+        server = http.server.ThreadingHTTPServer(
+            (host or "", int(port)), Handler
+        )
+    except OSError as exc:
+        raise RuntimeError(
+            f"metrics listener could not bind --listen-address "
+            f"{address!r}: {exc} (most likely another kube-batch-tpu "
+            "instance — or some other process — is already serving on "
+            "this port; pick a different --listen-address, or pass an "
+            "empty one to disable the listener)"
+        ) from exc
     thread = threading.Thread(target=server.serve_forever, daemon=True)
     thread.server = server  # type: ignore[attr-defined] — for tests/shutdown
     thread.start()

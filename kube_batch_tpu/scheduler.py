@@ -21,7 +21,7 @@ import time
 
 import numpy as np
 
-from kube_batch_tpu import metrics
+from kube_batch_tpu import metrics, trace
 from kube_batch_tpu.actions import factory as _action_factory  # noqa: F401
 from kube_batch_tpu.api.types import TaskStatus
 from kube_batch_tpu.cache.cache import CacheResyncing, SchedulerCache
@@ -932,6 +932,14 @@ class Scheduler:
         )
         metrics.hbm_blocked_cycles.inc()
         self.guardrails.note_hbm_block(True)
+        # Non-trigger transition: the pause shows in /debug/cycles and
+        # in every pending pod's story context, without dumping a
+        # post-mortem per blocked cycle.
+        trace.note_transition(
+            "hbm-blocked", label=str(label),
+            projected_mb=round(projected / 1e6, 1),
+            ceiling_mb=round(ceiling_mb, 1),
+        )
         # The incremental packer never SHRINKS padded buckets on its
         # own — without this, one crossing would pin the refused shape
         # (and the pause) forever, even after completions brought the
@@ -967,7 +975,7 @@ class Scheduler:
             self._hbm_blocked_cycle(ssn)
             return
         self.guardrails.note_hbm_block(False)
-        with metrics.action_latency.time("fused"):
+        with metrics.action_latency.time("fused"), trace.span("solve"):
             with metrics.cycle_phase_latency.time("dispatch"):
                 state, evict_payload, job_ready, diag = exe(
                     ssn.snap, ssn.state
@@ -1117,6 +1125,13 @@ class Scheduler:
         self.guardrails.pre_cycle()
         started = time.monotonic()
         self._cycle_quiesced = False
+        # Always-on observability (kube_batch_tpu/trace/): open this
+        # cycle's span tree + stamp for the flight recorder.  A None
+        # tracer (tracing disabled) keeps every trace call below a
+        # bare flag check — the hot path carries the instrumentation
+        # permanently, the <3% overhead gate keeps it honest.
+        tracer = trace.begin_cycle()
+        ssn: Session | None = None
         commit = getattr(self.cache, "commit", None)
         if commit is not None:
             # Seal the previous cycle's flush batch (its latency feeds
@@ -1125,7 +1140,8 @@ class Scheduler:
             commit.begin_cycle()
             commit.note_solve(True)
         try:
-            return self._cycle_once()
+            ssn = self._cycle_once()
+            return ssn
         finally:
             if commit is not None:
                 commit.note_solve(False)
@@ -1159,6 +1175,34 @@ class Scheduler:
                             period=self.schedule_period,
                         )
                     self._flush_batches_seen = done
+            if tracer is not None:
+                self._trace_end_cycle(tracer, ssn, started)
+
+    def _trace_end_cycle(self, tracer, ssn, started: float) -> None:
+        """Close the cycle's span tree with a flight-recorder summary.
+        Purely observational (never raises into the cycle); the
+        summary is what /debug/cycles serves and what an auto-dumped
+        post-mortem's "ticks" ring holds."""
+        try:
+            summary = {
+                "dur_ms": round((time.monotonic() - started) * 1e3, 3),
+                "quiesced": self._cycle_quiesced,
+                "skipped": ssn is None and not self._cycle_quiesced,
+                "bound": len(ssn.bound) if ssn is not None else 0,
+                "evicted": len(ssn.evicted) if ssn is not None else 0,
+                "rung": self.guardrails.rung,
+                "breaker": self.guardrails.breaker_state(),
+                "hbm_blocked": self.guardrails.hbm_blocked,
+            }
+            if ssn is not None:
+                summary["pending"] = int(np.sum(
+                    ssn.host_task_state()[: ssn.meta.num_real_tasks]
+                    == _PENDING
+                ))
+            tracer.end_cycle(summary)
+        except Exception:  # noqa: BLE001 — observability must never
+            # kill the cycle it observes
+            logging.exception("cycle trace summary failed")
 
     def journal_state(self) -> None:
         """Append the current operational soft state to the durable

@@ -191,6 +191,16 @@ class StateStore:
         if dropped:
             self.corrupt_dropped += dropped
             metrics.statestore_load_corrupt.inc(by=float(dropped))
+            # Flight-recorder trigger: dropped journal records mean an
+            # unclean shutdown (or disk corruption) just ate
+            # operational memory — worth a post-mortem even though the
+            # load itself recovered.
+            from kube_batch_tpu import trace
+
+            trace.note_transition(
+                "statestore-corrupt", path=self.path,
+                dropped=int(dropped), recovered=len(records),
+            )
             log.warning(
                 "state journal %s: %d corrupt record(s) dropped; "
                 "recovered the longest valid prefix (%d record(s))",

@@ -1,0 +1,296 @@
+"""Always-on observability: span tracing, decision records, flight
+recorder — behind ONE process-global facade, decision-invisible.
+
+Three tiers (doc/design/observability.md):
+
+1. **Cycle span tracing** (trace/spans.py) — a per-cycle span tree
+   threaded through the scheduler loop, the pack path, the fused
+   solve, bind dispatch, the commit pipeline's flush workers and the
+   batched ingest applier; exported on demand as Chrome trace-event
+   JSON (GET /debug/trace, Perfetto-loadable) and continuously via
+   ``--trace-dir`` rotated chunks.
+2. **Per-pod decision records** (trace/decisions.py) — each pod's
+   scheduling story (placed / preempted-with-beneficiary / refused
+   with fit-error reasons / gang-gated), queryable live via
+   /debug/pods/<uid>, /debug/groups/<name>, /debug/cycles and offline
+   via ``python -m kube_batch_tpu.trace explain``.
+3. **Anomaly-triggered flight recorder** (trace/recorder.py) — a
+   bounded ring of cycle summaries + wire ops + subsystem transitions
+   that auto-dumps a post-mortem on breaker open, watchdog rung
+   escalation, StaleEpoch write, quarantine cordon or statestore
+   corruption-drop, and on demand via SIGUSR2 / GET /debug/dump.
+
+Contract with the hot path: when disabled (`enable()` never called, or
+`disable()`d), every facade function below is a flag check returning a
+shared no-op — the instrumented call sites stay in the code
+permanently.  When enabled, recording is bounded-memory appends only;
+nothing here is ever READ by a scheduling decision, so tracing on vs
+off must produce bit-identical decisions (pinned by the chaos
+tracing-parity runs) and `scripts/check_trace_overhead.py` gates the
+overhead under 3% of steady-cycle latency.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+
+from kube_batch_tpu.trace.decisions import DecisionLog
+from kube_batch_tpu.trace.recorder import TRIGGERS, FlightRecorder
+from kube_batch_tpu.trace.spans import SpanRecorder
+
+__all__ = [
+    "DecisionLog",
+    "FlightRecorder",
+    "SpanRecorder",
+    "TRIGGERS",
+    "Tracer",
+    "begin_cycle",
+    "current_cycle",
+    "debug_http",
+    "decision_log",
+    "disable",
+    "enable",
+    "enabled",
+    "end_cycle",
+    "get",
+    "note_transition",
+    "note_wire",
+    "span",
+]
+
+log = logging.getLogger(__name__)
+
+
+class _NoopSpan:
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NOOP = _NoopSpan()
+
+
+class Tracer:
+    """One process's observability state (spans + decisions + flight
+    ring + the monotone cycle counter every record is stamped with)."""
+
+    def __init__(
+        self,
+        span_cycles: int = 256,
+        flight_cycles: int = 256,
+        dump_dir: str | None = None,
+        trace_dir: str | None = None,
+    ) -> None:
+        self.spans = SpanRecorder(keep_cycles=span_cycles)
+        self.decisions = DecisionLog()
+        self.recorder = FlightRecorder(
+            keep_cycles=flight_cycles, dump_dir=dump_dir,
+            decisions=self.decisions,
+        )
+        self.trace_dir = trace_dir
+        self.cycle = 0
+        self._cycle_open = False
+
+    # -- cycle bracketing (scheduler.run_once) ---------------------------
+    def begin_cycle(self) -> int:
+        self.cycle += 1
+        self._cycle_open = True
+        self.spans.begin_cycle(self.cycle)
+        return self.cycle
+
+    def end_cycle(self, summary: dict) -> None:
+        summary.setdefault("cycle", self.cycle)
+        self.recorder.note_cycle(summary)
+        self.spans.end_cycle()
+        self._cycle_open = False
+        if self.trace_dir:
+            self.spans.maybe_rotate(self.trace_dir, self.cycle)
+
+    def stats(self) -> dict:
+        return {
+            "cycle": self.cycle,
+            "spans": self.spans.stats(),
+            "decisions": self.decisions.stats(),
+            "recorder": self.recorder.stats(),
+        }
+
+
+_LOCK = threading.Lock()
+_TRACER: Tracer | None = None
+
+
+def enable(
+    span_cycles: int = 256,
+    flight_cycles: int = 256,
+    dump_dir: str | None = None,
+    trace_dir: str | None = None,
+) -> Tracer:
+    """Turn the subsystem on (idempotent per process: a second enable
+    replaces the tracer — chaos restarts and tests rely on a clean
+    slate).  ``flight_cycles`` <= 0 disables instead."""
+    global _TRACER
+    if flight_cycles is not None and int(flight_cycles) <= 0:
+        disable()
+        return None  # type: ignore[return-value]
+    with _LOCK:
+        _TRACER = Tracer(
+            span_cycles=span_cycles, flight_cycles=flight_cycles,
+            dump_dir=dump_dir, trace_dir=trace_dir,
+        )
+        return _TRACER
+
+
+def disable() -> None:
+    global _TRACER
+    with _LOCK:
+        _TRACER = None
+
+
+def enabled() -> bool:
+    return _TRACER is not None
+
+
+def get() -> Tracer | None:
+    return _TRACER
+
+
+# -- hot-path helpers (flag check first, always) -------------------------
+
+def span(name: str, cycle: int | None = None, **args):
+    """A timed region context manager; a shared no-op when disabled.
+    ``cycle`` attributes a cross-thread span (commit flush, ingest
+    apply) to the cycle that caused it; the default is the current
+    cycle."""
+    t = _TRACER
+    if t is None:
+        return _NOOP
+    return t.spans.span(
+        name, t.cycle if cycle is None else cycle, args or None
+    )
+
+
+def begin_cycle() -> "Tracer | None":
+    """Open the next cycle's span tree; returns the Tracer (so the
+    scheduler ends the SAME tracer it began, even if a concurrent
+    enable() swapped the global mid-cycle) or None when disabled."""
+    t = _TRACER
+    if t is not None:
+        t.begin_cycle()
+    return t
+
+
+def end_cycle(summary: dict) -> None:
+    t = _TRACER
+    if t is not None:
+        t.end_cycle(summary)
+
+
+def current_cycle() -> int:
+    t = _TRACER
+    return t.cycle if t is not None else 0
+
+
+def decision_log() -> DecisionLog | None:
+    """The live DecisionLog, or None when disabled.  (Named
+    decision_log, not decisions — `trace.decisions` is the
+    submodule.)"""
+    t = _TRACER
+    return t.decisions if t is not None else None
+
+
+def note_wire(verb: str, target: str, ok: bool,
+              cycle: int | None = None, **detail) -> None:
+    t = _TRACER
+    if t is None:
+        return
+    t.recorder.note_wire({
+        "cycle": t.cycle if cycle is None else cycle,
+        "verb": verb, "target": target, "ok": bool(ok), **detail,
+    })
+
+
+def note_transition(kind: str, **detail) -> None:
+    """Record one subsystem transition; trigger kinds (TRIGGERS)
+    auto-dump a post-mortem.  Never raises — observability must not
+    kill the transition that tripped it."""
+    t = _TRACER
+    if t is None:
+        return
+    try:
+        # Stamp the CURRENT cycle (like note_wire and the decision
+        # records) — the recorder's own clock only advances at
+        # end_cycle, which would date a mid-cycle breaker trip one
+        # cycle before the wire failures that caused it.
+        t.recorder.note_transition(kind, detail, cycle=t.cycle)
+    except Exception:  # noqa: BLE001
+        log.exception("flight-recorder transition note failed (%s)", kind)
+
+
+# -- the /debug HTTP surface (served by metrics.serve) -------------------
+
+def debug_http(path: str) -> tuple[int, dict]:
+    """Route one GET /debug/... request.  Returns (status, JSON body).
+    404 bodies explain what exists, so an operator probing blind gets
+    a map instead of silence."""
+    t = _TRACER
+    if t is None:
+        return 503, {
+            "error": "tracing disabled (the daemon enables it by "
+                     "default; --flight-recorder-cycles 0 turns it off)"
+        }
+    if path.startswith("/debug/pods/"):
+        uid = path[len("/debug/pods/"):]
+        story = t.decisions.pod_story(uid)
+        if story is None:
+            return 404, {
+                "error": f"no decision records for pod uid {uid!r} "
+                         "(untouched yet, or rotated out of the "
+                         "bounded ring)",
+            }
+        story["cycle_now"] = t.cycle
+        # The latest cycle summary gives the pod's answer its CONTEXT:
+        # a pending pod during an HBM pause or a breaker quiesce is
+        # pending because of the cycle, not the pod.
+        if t.recorder.cycles:
+            story["last_cycle"] = t.recorder.cycles[-1]
+        return 200, story
+    if path.startswith("/debug/groups/"):
+        name = path[len("/debug/groups/"):]
+        story = t.decisions.group_story(name)
+        if story is None:
+            return 404, {
+                "error": f"no decision records for group {name!r}",
+            }
+        return 200, story
+    if path == "/debug/cycles":
+        return 200, {
+            "cycle_now": t.cycle,
+            "cycles": list(t.recorder.cycles),
+            "transitions": list(t.recorder.transitions),
+        }
+    if path == "/debug/dump":
+        return 200, t.recorder.dump_body(trigger="debug-endpoint")
+    if path == "/debug/trace":
+        return 200, {"traceEvents": t.spans.chrome_events()}
+    if path == "/debug/stats" or path == "/debug" or path == "/debug/":
+        return 200, {
+            "endpoints": [
+                "/debug/pods/<uid>", "/debug/groups/<name>",
+                "/debug/cycles", "/debug/dump", "/debug/trace",
+                "/debug/stats",
+            ],
+            **t.stats(),
+        }
+    return 404, {
+        "error": f"unknown debug path {path!r}",
+        "endpoints": [
+            "/debug/pods/<uid>", "/debug/groups/<name>",
+            "/debug/cycles", "/debug/dump", "/debug/trace",
+            "/debug/stats",
+        ],
+    }

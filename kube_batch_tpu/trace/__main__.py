@@ -1,0 +1,133 @@
+"""`python -m kube_batch_tpu.trace` — offline triage over dumped
+flight-recorder post-mortems.
+
+The daemon's flight recorder (trace/recorder.py) writes its dumps as
+self-contained JSON: cycle summaries, wire ops, subsystem transitions
+and a bounded decision-log export.  This CLI answers the two support
+questions offline, against a dump, with no live daemon:
+
+    python -m kube_batch_tpu.trace explain --dump kb-flight-*.json \\
+        --pod <uid>          # why is/was this pod pending / evicted
+    python -m kube_batch_tpu.trace explain --dump ... --group <name>
+    python -m kube_batch_tpu.trace explain --dump ...   # the overview
+
+Exit codes: 0 = answered; 1 = the subject is not in the dump; 2 = the
+dump is unreadable.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _fmt_record(rec: dict) -> str:
+    cycle = rec.get("cycle", "?")
+    kind = rec.get("kind", "?")
+    rest = {k: v for k, v in rec.items() if k not in ("cycle", "kind")}
+    tail = " ".join(f"{k}={v}" for k, v in rest.items())
+    return f"  cycle {cycle:>8}: {kind:<14} {tail}".rstrip()
+
+
+def _explain_pod(dump: dict, uid: str) -> int:
+    pods = (dump.get("decisions") or {}).get("pods") or {}
+    entry = pods.get(uid)
+    if entry is None:
+        # Fall back to a name match: operators usually have the pod
+        # NAME in hand, the uid only after a kubectl round trip.
+        matches = [
+            (u, e) for u, e in pods.items() if e.get("name") == uid
+        ]
+        if len(matches) == 1:
+            uid, entry = matches[0]
+        elif matches:
+            print(f"ambiguous name {uid!r}: uids "
+                  f"{sorted(u for u, _ in matches)}", file=sys.stderr)
+            return 1
+    if entry is None:
+        print(f"pod {uid!r} not in this dump's decision export "
+              f"({len(pods)} pods held)", file=sys.stderr)
+        return 1
+    print(f"pod {entry.get('name')} (uid {uid}, group "
+          f"{entry.get('group')}, namespace {entry.get('namespace')}):")
+    for rec in entry.get("records", ()):
+        print(_fmt_record(rec))
+    group = entry.get("group")
+    groups = (dump.get("decisions") or {}).get("groups") or {}
+    if group and group in groups:
+        print(f"group {group}:")
+        for rec in groups[group].get("records", ()):
+            print(_fmt_record(rec))
+    return 0
+
+
+def _explain_group(dump: dict, name: str) -> int:
+    groups = (dump.get("decisions") or {}).get("groups") or {}
+    g = groups.get(name)
+    if g is None:
+        print(f"group {name!r} not in this dump ({len(groups)} groups "
+              "held)", file=sys.stderr)
+        return 1
+    print(f"group {name} ({len(g.get('pods', ()))} pods):")
+    for rec in g.get("records", ()):
+        print(_fmt_record(rec))
+    return 0
+
+
+def _overview(dump: dict) -> int:
+    meta = dump.get("meta") or {}
+    print(f"trigger: {meta.get('trigger')}  cycle: {meta.get('cycle')}")
+    if meta.get("transition"):
+        print(f"transition: {meta['transition']}")
+    ticks = dump.get("ticks") or []
+    print(f"{len(ticks)} cycle summaries, "
+          f"{len(dump.get('wire') or [])} wire ops, "
+          f"{len(dump.get('transitions') or [])} transitions")
+    for t in dump.get("transitions") or []:
+        print(_fmt_record(t))
+    if ticks:
+        print("last cycles:")
+        for summary in ticks[-8:]:
+            cyc = summary.get("cycle", "?")
+            rest = " ".join(
+                f"{k}={v}" for k, v in summary.items() if k != "cycle"
+            )
+            print(f"  cycle {cyc:>8}: {rest}")
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m kube_batch_tpu.trace",
+        description="Offline triage over flight-recorder dumps.",
+    )
+    sub = p.add_subparsers(dest="cmd", required=True)
+    ex = sub.add_parser(
+        "explain",
+        help="explain a pod/group's scheduling story from a dump",
+    )
+    ex.add_argument("--dump", required=True,
+                    help="a flight-recorder post-mortem JSON "
+                         "(auto-dumped, SIGUSR2, or GET /debug/dump)")
+    ex.add_argument("--pod", default=None,
+                    help="pod uid (or unique pod name) to explain")
+    ex.add_argument("--group", default=None,
+                    help="PodGroup name to explain")
+    args = p.parse_args(argv)
+
+    try:
+        with open(args.dump, "r", encoding="utf-8") as f:
+            dump = json.load(f)
+    except (OSError, json.JSONDecodeError) as exc:
+        print(f"unreadable dump {args.dump}: {exc}", file=sys.stderr)
+        return 2
+    if args.pod:
+        return _explain_pod(dump, args.pod)
+    if args.group:
+        return _explain_group(dump, args.group)
+    return _overview(dump)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,221 @@
+"""Per-pod decision records: each pod's scheduling story, bounded.
+
+The #1 operator question at gang-scheduler scale is "why is my pod
+still pending" — and the second is "which gang's preemption evicted
+it".  The metrics answer neither (counters have no subject), and the
+event ring answers only with rendered strings.  This log keeps a small
+structured ring PER POD (and per PodGroup) of the decisions that
+touched it:
+
+* ``placed``     — bound (node, cycle), cross-linked to any eviction
+                   that vacated the node (victim → beneficiary
+                   attribution through the eviction funnel);
+* ``preempted``  — evicted (reason, node, cycle) with the later
+                   ``beneficiary`` record appended when a pod lands on
+                   the vacated node within the attribution window;
+* ``refused``    — the top-K fit-error reasons from the why-
+                   unschedulable diagnosis pass
+                   (framework/fit_errors.py), verbatim;
+* ``bind-refused`` — a commit-time refusal (cordoned/vanished node);
+* ``gang-gated`` — (group-level) placements dropped by the gang
+                   all-or-nothing gate this cycle.
+
+Bounded everywhere: at most MAX_PODS pod stories (LRU — a 50k-pod
+world keeps the RECENTLY TOUCHED stories, which is what support looks
+at), PER_POD records each, MAX_GROUPS × PER_GROUP for groups, and one
+vacated-node entry per node for the attribution map.  All appends are
+O(1) dict/deque operations under one short lock — the decision log is
+recorded FROM the decision path but never read by it
+(decision-invisible; pinned by the chaos tracing-on/off hash parity).
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+
+MAX_PODS = 4096
+PER_POD = 32
+MAX_GROUPS = 1024
+PER_GROUP = 32
+#: Cycles a vacated node remembers its eviction batch: a pod placed on
+#: the node within this window is attributed as the beneficiary.
+ATTRIBUTION_WINDOW = 64
+
+
+class DecisionLog:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        #: uid -> {"meta": {...}, "records": deque}
+        self._pods: collections.OrderedDict[str, dict] = \
+            collections.OrderedDict()
+        #: group name -> {"records": deque, "pods": set}
+        self._groups: collections.OrderedDict[str, dict] = \
+            collections.OrderedDict()
+        #: node -> (cycle, [(uid, name, group), ...]) of the most
+        #: recent eviction batch that vacated it.
+        self._vacated: dict[str, tuple[int, list[tuple]]] = {}
+        self.records_total = 0
+
+    # -- write side ------------------------------------------------------
+    def _pod_entry(self, uid: str, name: str | None,
+                   namespace: str | None, group: str | None) -> dict:
+        entry = self._pods.get(uid)
+        if entry is None:
+            entry = {
+                "meta": {"name": name, "namespace": namespace,
+                         "group": group},
+                "records": collections.deque(maxlen=PER_POD),
+            }
+            self._pods[uid] = entry
+            while len(self._pods) > MAX_PODS:
+                self._pods.popitem(last=False)
+        else:
+            self._pods.move_to_end(uid)
+            if name is not None:
+                entry["meta"]["name"] = name
+            if group is not None:
+                entry["meta"]["group"] = group
+        if group:
+            g = self._group_entry(group)
+            g["pods"].add(uid)
+        return entry
+
+    def _group_entry(self, name: str) -> dict:
+        g = self._groups.get(name)
+        if g is None:
+            g = {
+                "records": collections.deque(maxlen=PER_GROUP),
+                "pods": set(),
+            }
+            self._groups[name] = g
+            while len(self._groups) > MAX_GROUPS:
+                self._groups.popitem(last=False)
+        else:
+            self._groups.move_to_end(name)
+        return g
+
+    def note_pod(self, uid: str, kind: str, cycle: int, *,
+                 name: str | None = None, namespace: str | None = None,
+                 group: str | None = None, **detail) -> None:
+        with self._lock:
+            entry = self._pod_entry(uid, name, namespace, group)
+            entry["records"].append(
+                {"cycle": cycle, "kind": kind, **detail}
+            )
+            self.records_total += 1
+
+    def note_group(self, name: str, kind: str, cycle: int,
+                   **detail) -> None:
+        with self._lock:
+            g = self._group_entry(name)
+            g["records"].append({"cycle": cycle, "kind": kind, **detail})
+            self.records_total += 1
+
+    def note_placed(self, uid: str, name: str, group: str | None,
+                    node: str, cycle: int, **detail) -> None:
+        """A bind landed: record it, and if an eviction vacated this
+        node within the attribution window, cross-link the stories —
+        the victims learn their beneficiary, the beneficiary learns
+        whose capacity it inherited."""
+        with self._lock:
+            rec = {"cycle": cycle, "kind": "placed", "node": node,
+                   **detail}
+            vac = self._vacated.get(node)
+            if vac is not None:
+                vcycle, victims = vac
+                if cycle - vcycle <= ATTRIBUTION_WINDOW:
+                    rec["after_eviction_of"] = [
+                        v_name for _u, v_name, _g in victims
+                    ]
+                    for v_uid, _v_name, v_group in victims:
+                        ventry = self._pods.get(v_uid)
+                        if ventry is not None:
+                            ventry["records"].append({
+                                "cycle": cycle, "kind": "beneficiary",
+                                "pod": name, "group": group,
+                                "node": node,
+                            })
+                else:
+                    self._vacated.pop(node, None)
+            entry = self._pod_entry(uid, name, None, group)
+            entry["records"].append(rec)
+            self.records_total += 1
+
+    def note_eviction(self, uid: str, name: str, group: str | None,
+                      node: str | None, reason: str,
+                      cycle: int) -> None:
+        """A victim eviction landed: record it and remember the
+        vacated node so the next placement there is attributed."""
+        with self._lock:
+            entry = self._pod_entry(uid, name, None, group)
+            entry["records"].append({
+                "cycle": cycle, "kind": "preempted", "reason": reason,
+                "node": node,
+            })
+            self.records_total += 1
+            if node:
+                prev = self._vacated.get(node)
+                if prev is not None and prev[0] == cycle:
+                    prev[1].append((uid, name, group))
+                else:
+                    self._vacated[node] = (cycle, [(uid, name, group)])
+
+    # -- read side (the /debug endpoints + the explain CLI) --------------
+    def pod_story(self, uid: str) -> dict | None:
+        with self._lock:
+            entry = self._pods.get(uid)
+            if entry is None:
+                return None
+            story = {
+                "uid": uid,
+                **entry["meta"],
+                "records": list(entry["records"]),
+            }
+            group = entry["meta"].get("group")
+            if group and group in self._groups:
+                story["group_records"] = list(
+                    self._groups[group]["records"]
+                )
+            return story
+
+    def group_story(self, name: str) -> dict | None:
+        with self._lock:
+            g = self._groups.get(name)
+            if g is None:
+                return None
+            return {
+                "group": name,
+                "records": list(g["records"]),
+                "pods": sorted(g["pods"]),
+            }
+
+    def export(self, max_pods: int = 512) -> dict:
+        """Serializable snapshot for the flight-recorder dump: the
+        most-recently-touched pod stories (bounded — a dump is a
+        post-mortem, not a database) plus every group story."""
+        with self._lock:
+            uids = list(self._pods)[-max_pods:]
+            return {
+                "pods": {
+                    uid: {
+                        **self._pods[uid]["meta"],
+                        "records": list(self._pods[uid]["records"]),
+                    }
+                    for uid in uids
+                },
+                "groups": {
+                    name: {"records": list(g["records"]),
+                           "pods": sorted(g["pods"])}
+                    for name, g in self._groups.items()
+                },
+            }
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "pods_tracked": len(self._pods),
+                "groups_tracked": len(self._groups),
+                "records_total": self.records_total,
+                "vacated_nodes": len(self._vacated),
+            }

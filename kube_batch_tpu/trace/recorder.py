@@ -1,0 +1,215 @@
+"""Anomaly-triggered flight recorder: always-on, bounded, auto-dumping.
+
+The chaos engine proved the shape at test time (chaos/engine.py ·
+FlightRecorder: a bounded ring of per-tick records dumped the moment
+an invariant fails).  Production needs the same thing always on: when
+the breaker opens at 03:00, the operator wants the last N cycles —
+summaries, wire ops, guardrail/health/failover/ingest transitions —
+already written to disk, not a request to re-run the workload under
+the chaos engine.
+
+Three bounded rings:
+
+* ``cycles``      — one summary per scheduler cycle (result, bound/
+                    evicted/pending counts, durations, quiesce state);
+* ``wire``        — recent wire-op outcomes (bind/evict/status/event
+                    flushes: verb, target, ok, cycle);
+* ``transitions`` — guardrail/health/failover/ingest state changes.
+
+Auto-dump TRIGGERS (each writes a post-mortem JSON in the same
+``{"meta": ..., "ticks": [...]}`` shape as the chaos flight
+recorder, with the triggering transition named in the meta):
+breaker open, watchdog rung escalation, a StaleEpoch write, a
+quarantine cordon, a statestore corruption-drop.  On-demand dumps:
+SIGUSR2 (installed by the CLI) and GET /debug/dump.
+
+Dump writes happen on the calling thread but are rare (rate-limited
+per trigger kind) and small (three bounded rings); every I/O failure
+degrades to a log line — observability must never kill the cycle that
+tripped it.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import logging
+import os
+import tempfile
+import threading
+import time
+
+log = logging.getLogger(__name__)
+
+#: The transition kinds that auto-dump a post-mortem.
+TRIGGERS = frozenset({
+    "breaker-open",
+    "watchdog-escalation",
+    "stale-epoch",
+    "quarantine-cordon",
+    "statestore-corrupt",
+})
+#: Per-kind dump rate limit (cycles): a storm of StaleEpoch rejections
+#: during one failover window produces ONE post-mortem, not hundreds.
+DUMP_COOLDOWN_CYCLES = 256
+#: Process-lifetime cap on AUTO-dumps — a pathological flap cannot
+#: fill the disk with post-mortems.  On-demand dumps (SIGUSR2,
+#: /debug/dump) have their own bound: each trigger kind overwrites ONE
+#: fixed file, so they never consume this budget nor accumulate files.
+MAX_DUMPS = 64
+WIRE_RING = 1024
+TRANSITION_RING = 256
+
+
+class FlightRecorder:
+    def __init__(self, keep_cycles: int = 256,
+                 dump_dir: str | None = None,
+                 decisions=None) -> None:
+        self.keep_cycles = max(int(keep_cycles), 1)
+        self.dump_dir = dump_dir or tempfile.gettempdir()
+        self._decisions = decisions   # DecisionLog for dump enrichment
+        self._lock = threading.Lock()
+        self.cycles: collections.deque = collections.deque(
+            maxlen=self.keep_cycles
+        )
+        self.wire: collections.deque = collections.deque(maxlen=WIRE_RING)
+        self.transitions: collections.deque = collections.deque(
+            maxlen=TRANSITION_RING
+        )
+        #: Completed dumps: [{"trigger", "cycle", "path"}], bounded —
+        #: a probe polling /debug/dump forever must not grow it.  The
+        #: auto-dump budget is its own counter, NOT len(dumps): manual
+        #: dumps never starve the anomaly triggers out of MAX_DUMPS.
+        self.dumps: collections.deque[dict] = collections.deque(
+            maxlen=2 * MAX_DUMPS
+        )
+        self._auto_dumps = 0
+        self._last_dump_cycle: dict[str, int] = {}
+        self._cycle = 0
+
+    # -- write side ------------------------------------------------------
+    def note_cycle(self, summary: dict) -> None:
+        with self._lock:
+            self._cycle = int(summary.get("cycle", self._cycle))
+            self.cycles.append(summary)
+
+    def note_wire(self, entry: dict) -> None:
+        with self._lock:
+            self.wire.append(entry)
+
+    def note_transition(self, kind: str, detail: dict,
+                        cycle: int | None = None) -> dict | None:
+        """Record one subsystem transition; a trigger kind auto-dumps
+        (rate-limited).  Returns the dump record when one was written."""
+        with self._lock:
+            c = self._cycle if cycle is None else int(cycle)
+            entry = {"cycle": c, "kind": kind, **detail}
+            self.transitions.append(entry)
+            if kind not in TRIGGERS:
+                return None
+            last = self._last_dump_cycle.get(kind)
+            if last is not None and c - last < DUMP_COOLDOWN_CYCLES:
+                return None
+            if self._auto_dumps >= MAX_DUMPS:
+                return None
+            # Reserve the budget slot + cooldown BEFORE the (unlocked)
+            # file write — a racing trigger storm gets one dump.
+            self._auto_dumps += 1
+            self._last_dump_cycle[kind] = c
+        return self.dump(trigger=kind, transition=entry)
+
+    # -- dumping ---------------------------------------------------------
+    def dump(self, trigger: str = "manual",
+             transition: dict | None = None,
+             path: str | None = None) -> dict | None:
+        """Write the post-mortem JSON.  Same top-level shape as the
+        chaos flight recorder ({"meta": ..., "ticks": [...]}) so the
+        same triage tooling reads both; the always-on version adds the
+        wire/transition rings and a bounded decision-log export."""
+        with self._lock:
+            cycle = (
+                int(transition["cycle"]) if transition is not None
+                else self._cycle
+            )
+            body = {
+                "meta": {
+                    "trigger": trigger,
+                    "transition": transition,
+                    "cycle": cycle,
+                    "wall_time": time.time(),
+                },
+                "ticks": list(self.cycles),
+                "wire": list(self.wire),
+                "transitions": list(self.transitions),
+            }
+        if self._decisions is not None:
+            body["decisions"] = self._decisions.export()
+        if path is None:
+            if trigger in TRIGGERS:
+                name = f"kb-flight-{trigger}-c{cycle:08d}.json"
+            else:
+                # On-demand (sigusr2 / debug-endpoint / manual): one
+                # fixed file per kind, overwritten — "give me the
+                # current state", not an archive; a polling probe
+                # cannot accumulate files.
+                name = f"kb-flight-{trigger}.json"
+            path = os.path.join(self.dump_dir, name)
+        try:
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            with open(path, "w", encoding="utf-8") as f:
+                json.dump(body, f, indent=1, sort_keys=True, default=str)
+                f.write("\n")
+        except OSError as exc:
+            log.warning("flight-recorder dump failed (%s): %s",
+                        trigger, exc)
+            return None
+        rec = {"trigger": trigger, "cycle": cycle, "path": path}
+        with self._lock:
+            self.dumps.append(rec)
+        log.warning(
+            "flight recorder: %s at cycle %d — post-mortem dumped to %s",
+            trigger, cycle, path,
+        )
+        return rec
+
+    def dump_body(self, trigger: str = "manual") -> dict:
+        """The post-mortem as an in-memory dict (the /debug/dump
+        endpoint's response body) — also written to disk."""
+        rec = self.dump(trigger=trigger)
+        with self._lock:
+            return {
+                "meta": {
+                    "trigger": trigger,
+                    "cycle": self._cycle,
+                    "path": rec["path"] if rec else None,
+                },
+                "ticks": list(self.cycles),
+                "wire": list(self.wire),
+                "transitions": list(self.transitions),
+            }
+
+    def install_signal_handler(self) -> bool:
+        """SIGUSR2 → on-demand dump.  Main-thread only (the CLI calls
+        this); returns whether installation succeeded."""
+        import signal
+
+        def _on_usr2(_signum, _frame) -> None:
+            try:
+                self.dump(trigger="sigusr2")
+            except Exception:  # noqa: BLE001 — never kill the daemon
+                log.exception("SIGUSR2 flight dump failed")
+
+        try:
+            signal.signal(signal.SIGUSR2, _on_usr2)
+            return True
+        except (ValueError, OSError):  # not the main thread / platform
+            return False
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "cycles_held": len(self.cycles),
+                "wire_held": len(self.wire),
+                "transitions_held": len(self.transitions),
+                "dumps": list(self.dumps),
+            }

@@ -1,0 +1,233 @@
+"""Cycle span tracing: a lock-light per-cycle span tree.
+
+The scheduler's phases already have *aggregate* attribution
+(``cycle_phase_latency`` histograms), but a histogram cannot answer
+"what did cycle 48291 spend its 312 ms on" — the question every slow-
+cycle investigation starts with.  This recorder keeps the last N
+cycles' spans as a tree (cycle → pack_host_patch / pack_h2d / solve /
+dispatch / diagnosis / status_writeback, plus commit-flush spans
+attributed to the cycle that ENQUEUED them and ingest-apply spans from
+the adapter thread) and exports them on demand as Chrome trace-event
+JSON — loadable directly in Perfetto / chrome://tracing.
+
+Hot-path discipline (the <3% overhead gate in
+scripts/check_trace_overhead.py):
+
+* recording is a ``perf_counter_ns`` pair plus one small dict append —
+  no locks on the cycle thread's common path (the cycle thread owns
+  its span list; cross-thread spans land through one short mutex);
+* when tracing is disabled the facade (kube_batch_tpu/trace/__init__)
+  short-circuits to a shared no-op context manager before any of this
+  module runs;
+* everything is bounded: last ``keep_cycles`` cycles, at most
+  ``MAX_SPANS_PER_CYCLE`` spans each (a pathological cycle truncates
+  its tail and says so, instead of growing without bound).
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import logging
+import os
+import threading
+import time
+
+log = logging.getLogger(__name__)
+
+#: Per-cycle span cap: a cycle that somehow emits more (e.g. a huge
+#: flush batch) drops the overflow and marks itself truncated.
+MAX_SPANS_PER_CYCLE = 512
+#: Cross-thread spans (commit flush workers, the ingest applier) whose
+#: cycle has already rotated out of the ring are dropped; this bounds
+#: how long a straggler flush may trail its cycle and still land.
+#: --trace-dir rotation: cycles per chunk file, and chunks kept.
+ROTATE_CYCLES = 128
+ROTATE_KEEP = 8
+
+
+class Span:
+    """One timed region.  ``ns0`` is perf_counter_ns at entry."""
+
+    __slots__ = ("name", "cycle", "tid", "ns0", "dur_ns", "args")
+
+    def __init__(self, name: str, cycle: int, tid: str, ns0: int,
+                 args: dict | None) -> None:
+        self.name = name
+        self.cycle = cycle
+        self.tid = tid
+        self.ns0 = ns0
+        self.dur_ns = 0
+        self.args = args
+
+
+class _SpanCtx:
+    """Context manager handed out by SpanRecorder.span()."""
+
+    __slots__ = ("_rec", "_span")
+
+    def __init__(self, rec: "SpanRecorder", span: Span) -> None:
+        self._rec = rec
+        self._span = span
+
+    def __enter__(self) -> "_SpanCtx":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        s = self._span
+        s.dur_ns = time.perf_counter_ns() - s.ns0
+        self._rec._commit(s)
+        return False
+
+
+class SpanRecorder:
+    """Bounded ring of per-cycle span lists.
+
+    The CYCLE thread appends to ``_current`` without a lock (it is the
+    only writer between begin_cycle and end_cycle); flush workers and
+    the ingest applier attribute their spans by explicit cycle id and
+    land them through ``_lock`` into the ring (or ``_current`` when
+    the cycle is still open).
+    """
+
+    def __init__(self, keep_cycles: int = 256) -> None:
+        self.keep_cycles = max(int(keep_cycles), 1)
+        self._lock = threading.Lock()
+        #: cycle id -> list[Span] of CLOSED cycles, newest last.
+        self._ring: collections.OrderedDict[int, list[Span]] = \
+            collections.OrderedDict()
+        self._current: list[Span] | None = None
+        self._current_cycle = -1
+        self.truncated_cycles = 0
+        self.spans_truncated = 0
+        self.spans_recorded = 0
+        #: Cycles already counted in truncated_cycles; pruned as their
+        #: cycles rotate out of the ring, so it stays bounded.
+        self._truncated: set[int] = set()
+        # --trace-dir rotation state.
+        self._chunk_files: collections.deque[str] = collections.deque()
+        self._last_rotated = -1
+
+    # -- recording -------------------------------------------------------
+    def begin_cycle(self, cycle: int) -> None:
+        with self._lock:
+            self._current = []
+            self._current_cycle = cycle
+
+    def end_cycle(self) -> None:
+        with self._lock:
+            if self._current is not None:
+                self._ring[self._current_cycle] = self._current
+                while len(self._ring) > self.keep_cycles:
+                    rotated, _ = self._ring.popitem(last=False)
+                    self._truncated.discard(rotated)
+            self._current = None
+
+    def span(self, name: str, cycle: int, args: dict | None = None):
+        return _SpanCtx(self, Span(
+            name, cycle, threading.current_thread().name,
+            time.perf_counter_ns(), args,
+        ))
+
+    def _commit(self, span: Span) -> None:
+        with self._lock:
+            if span.cycle == self._current_cycle and \
+                    self._current is not None:
+                target = self._current
+            else:
+                target = self._ring.get(span.cycle)
+                if target is None:
+                    return  # cycle rotated out: drop the straggler
+            if len(target) >= MAX_SPANS_PER_CYCLE:
+                self.spans_truncated += 1
+                if span.cycle not in self._truncated:
+                    self._truncated.add(span.cycle)
+                    self.truncated_cycles += 1
+                return
+            target.append(span)
+            self.spans_recorded += 1
+
+    # -- export ----------------------------------------------------------
+    def chrome_events(self, cycles: list[int] | None = None) -> list[dict]:
+        """Chrome trace-event JSON objects ("X" complete events, ts in
+        µs since an arbitrary process origin) for the requested cycles
+        (default: everything in the ring), Perfetto-loadable as-is."""
+        with self._lock:
+            items = [
+                (c, list(spans)) for c, spans in self._ring.items()
+                if cycles is None or c in cycles
+            ]
+            if self._current is not None and (
+                cycles is None or self._current_cycle in cycles
+            ):
+                items.append((self._current_cycle, list(self._current)))
+        events: list[dict] = []
+        tids: dict[str, int] = {}
+        for _cycle, spans in items:
+            for s in spans:
+                tid = tids.setdefault(s.tid, len(tids) + 1)
+                ev = {
+                    "name": s.name,
+                    "ph": "X",
+                    "ts": s.ns0 / 1e3,
+                    "dur": max(s.dur_ns, 1) / 1e3,
+                    "pid": 1,
+                    "tid": tid,
+                    "args": {"cycle": s.cycle, **(s.args or {})},
+                }
+                events.append(ev)
+        # Thread-name metadata so Perfetto labels the tracks.
+        for name, tid in tids.items():
+            events.append({
+                "name": "thread_name", "ph": "M", "pid": 1, "tid": tid,
+                "args": {"name": name},
+            })
+        return events
+
+    def write_chrome(self, path: str,
+                     cycles: list[int] | None = None) -> str:
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump({"traceEvents": self.chrome_events(cycles)}, f)
+            f.write("\n")
+        return path
+
+    # -- continuous rotated capture (--trace-dir) ------------------------
+    def maybe_rotate(self, trace_dir: str, cycle: int) -> str | None:
+        """Write a chunk of the last ROTATE_CYCLES cycles' spans every
+        ROTATE_CYCLES cycles, keeping the newest ROTATE_KEEP chunk
+        files (older chunks are deleted).  Called from end-of-cycle on
+        the cycle thread; any I/O failure degrades to a warning —
+        observability must never kill a cycle."""
+        if cycle - self._last_rotated < ROTATE_CYCLES:
+            return None
+        lo = self._last_rotated + 1
+        self._last_rotated = cycle
+        try:
+            os.makedirs(trace_dir, exist_ok=True)
+            path = os.path.join(
+                trace_dir, f"trace-c{lo:08d}-c{cycle:08d}.json"
+            )
+            self.write_chrome(
+                path, cycles=list(range(lo, cycle + 1))
+            )
+            self._chunk_files.append(path)
+            while len(self._chunk_files) > ROTATE_KEEP:
+                old = self._chunk_files.popleft()
+                try:
+                    os.unlink(old)
+                except OSError:
+                    pass
+            return path
+        except OSError as exc:
+            log.warning("trace-dir rotation failed (tracing continues "
+                        "in memory): %s", exc)
+            return None
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "cycles_held": len(self._ring),
+                "spans_recorded": self.spans_recorded,
+                "spans_truncated": self.spans_truncated,
+                "truncated_cycles": self.truncated_cycles,
+            }

@@ -17,11 +17,18 @@ def main() -> int:
     assert isinstance(artifact, dict), artifact
 
     for key in ("first_cycle_ms", "e2e_cycle_ms_p50", "commit_pipeline",
-                "ingest_compare"):
+                "ingest_compare", "trace_overhead"):
         assert key in artifact, (
             f"artifact missing {key!r}; keys: {sorted(artifact)}"
         )
     assert isinstance(artifact["first_cycle_ms"], (int, float))
+
+    # Presence + sanity only: the <3% gate lives in
+    # scripts/check_trace_overhead.py (make verify); the smoke pins
+    # that every artifact RECORDS the observability tax.
+    tro = artifact["trace_overhead"]
+    assert "error" not in tro, tro
+    assert "overhead_pct" in tro, tro
 
     ing = artifact["ingest_compare"]
     assert "error" not in ing, ing

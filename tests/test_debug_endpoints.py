@@ -1,0 +1,182 @@
+"""The /debug observability surface on metrics.serve, the loud
+listener-bind failure, and the /healthz backlog-pressure fields.
+
+The pinned responses are the ISSUE's acceptance shape: a pending pod's
+/debug/pods/<uid> answer names concrete fit-error reasons; a preempted
+pod's answer names the beneficiary that inherited its node.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kube_batch_tpu import metrics, trace
+
+
+@pytest.fixture(autouse=True)
+def _clean_tracer():
+    trace.disable()
+    yield
+    trace.disable()
+
+
+@pytest.fixture()
+def server():
+    thread = metrics.serve(":0")
+    try:
+        yield thread.server.server_address[1]
+    finally:
+        thread.server.shutdown()
+
+
+def _get(port: int, path: str) -> tuple[int, dict]:
+    try:
+        resp = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=5
+        )
+        return resp.status, json.loads(resp.read().decode())
+    except urllib.error.HTTPError as exc:
+        return exc.code, json.loads(exc.read().decode())
+
+
+def test_debug_disabled_answers_503(server):
+    status, body = _get(server, "/debug/cycles")
+    assert status == 503 and "disabled" in body["error"]
+
+
+def test_unknown_debug_path_maps_the_surface(server, tmp_path):
+    trace.enable(dump_dir=str(tmp_path))
+    status, body = _get(server, "/debug/wat")
+    assert status == 404
+    assert "/debug/pods/<uid>" in body["endpoints"]
+
+
+def test_pending_pod_story_names_fit_errors(server, tmp_path):
+    """A pod the solve refused answers with the rendered fit-error
+    reasons — the 'why is my pod pending' acceptance pin."""
+    from kube_batch_tpu.api.resource import ResourceSpec
+    from kube_batch_tpu.cache.cluster import Node, Pod, PodGroup
+    from kube_batch_tpu.scheduler import Scheduler
+    from kube_batch_tpu.sim.simulator import make_world
+
+    trace.enable(dump_dir=str(tmp_path))
+    cache, sim = make_world(ResourceSpec(("cpu", "memory", "pods")))
+    sim.add_node(Node(name="n0", allocatable={
+        "cpu": 1000, "memory": 2 << 30, "pods": 10,
+    }))
+    sim.submit(
+        PodGroup(name="big", queue="default", min_member=1),
+        [Pod(name="big-0",
+             request={"cpu": 64000, "memory": 1 << 30, "pods": 1})],
+    )
+    Scheduler(cache, schedule_period=0.0).run_once()
+    with cache.lock():
+        uid = next(iter(cache._pods))
+
+    status, story = _get(server, f"/debug/pods/{uid}")
+    assert status == 200
+    assert story["name"] == "big-0"
+    refused = [r for r in story["records"] if r["kind"] == "refused"]
+    assert refused, story
+    assert "Insufficient cpu" in refused[0]["reasons"]
+    assert "0/1 nodes are available" in refused[0]["reasons"]
+    # Cycle context rides along so "pending because the CYCLE is
+    # paused/quiesced" is visible from the same answer.
+    assert "last_cycle" in story and story["last_cycle"]["pending"] == 1
+
+    status, _ = _get(server, "/debug/pods/no-such-uid")
+    assert status == 404
+
+
+def test_preempted_pod_story_names_beneficiary(server, tmp_path):
+    """A preemption victim's answer carries the victim→beneficiary
+    attribution through the vacated node."""
+    trace.enable(dump_dir=str(tmp_path))
+    d = trace.decision_log()
+    d.note_eviction("v-uid", "victim-0", "low-prio-gang", "n3",
+                    "preempted", 40)
+    d.note_placed("w-uid", "winner-0", "high-prio-gang", "n3", 41)
+
+    status, story = _get(server, "/debug/pods/v-uid")
+    assert status == 200
+    kinds = {r["kind"] for r in story["records"]}
+    assert {"preempted", "beneficiary"} <= kinds
+    ben = next(
+        r for r in story["records"] if r["kind"] == "beneficiary"
+    )
+    assert ben["pod"] == "winner-0"
+    assert ben["group"] == "high-prio-gang"
+
+    status, wstory = _get(server, "/debug/pods/w-uid")
+    assert wstory["records"][0]["after_eviction_of"] == ["victim-0"]
+
+    status, gstory = _get(server, "/debug/groups/high-prio-gang")
+    assert status == 200 and gstory["pods"] == ["w-uid"]
+
+
+def test_cycles_dump_and_trace_endpoints(server, tmp_path):
+    trace.enable(dump_dir=str(tmp_path))
+    trace.begin_cycle()
+    with trace.span("solve"):
+        pass
+    trace.end_cycle({"bound": 3})
+    trace.note_transition("node-health", node="n1")
+
+    status, body = _get(server, "/debug/cycles")
+    assert status == 200
+    assert body["cycles"][-1]["bound"] == 3
+    assert body["transitions"][0]["kind"] == "node-health"
+
+    status, dump = _get(server, "/debug/dump")
+    assert status == 200
+    assert dump["meta"]["trigger"] == "debug-endpoint"
+    assert dump["ticks"][-1]["bound"] == 3
+    # The on-demand dump also landed on disk.
+    assert trace.get().recorder.dumps[0]["trigger"] == "debug-endpoint"
+
+    status, chrome = _get(server, "/debug/trace")
+    assert status == 200
+    assert any(
+        e.get("name") == "solve" for e in chrome["traceEvents"]
+    )
+
+    status, stats = _get(server, "/debug/stats")
+    assert status == 200 and stats["cycle"] == 1
+
+
+def test_listen_address_conflict_fails_loud(server):
+    """The satellite pin: a bound port answers with a clear error
+    naming --listen-address, not a raw OSError traceback."""
+    with pytest.raises(RuntimeError, match="--listen-address"):
+        metrics.serve(f":{server}")
+
+
+def test_cli_exits_nonzero_on_bound_port(server):
+    from kube_batch_tpu.cli import main
+
+    rc = main([
+        "--listen-address", f":{server}",
+        "--workload", "1", "--cycles", "0",
+    ])
+    assert rc == 1
+
+
+def test_healthz_carries_backlog_pressure(server):
+    """/healthz gains ingest_lag_seconds + commit_queue_depth so
+    probes see backlog pressure without scraping /metrics."""
+    metrics.set_ingest_lag(1.25)
+    metrics.commit_queue_depth.set(7.0)
+    try:
+        status, body = _get(server, "/healthz")
+        assert status == 200
+        assert body["ingest_lag_seconds"] == 1.25
+        assert body["commit_queue_depth"] == 7
+        assert body["state"] in ("ok", "degraded", "overloaded")
+    finally:
+        # Process-global /healthz state: leave it clean.
+        metrics.set_ingest_lag(0.0)
+        metrics.commit_queue_depth.set(0.0)

@@ -1,0 +1,189 @@
+"""deployment/ manifests cross-checked against the code they deploy.
+
+The RBAC role is only correct relative to the verbs the HTTP backend
+actually issues — and those drift as PRs add wire verbs (the cordon
+PATCH, the statestore ConfigMap mirror).  So the test derives the
+required (apiGroup, resource, verb) set FROM the request builders and
+reflector tables (client/http_api.py, client/k8s_write.py) and asserts
+deployment/rbac.yaml covers every one; a new verb landing without its
+RBAC row fails here, not in the cluster.
+"""
+
+from __future__ import annotations
+
+import os
+
+import yaml
+
+from kube_batch_tpu.cache.cluster import Pod, PodGroup
+from kube_batch_tpu.client.http_api import (
+    ALT_RESOURCE_PATHS,
+    DEFAULT_RESOURCES,
+)
+from kube_batch_tpu.client.k8s_write import (
+    binding_request,
+    event_request,
+    evict_request,
+    node_unschedulable_request,
+    pod_group_status_request,
+    state_snapshot_request,
+)
+
+DEPLOY_DIR = os.path.join(os.path.dirname(__file__), "..", "deployment")
+
+
+def _load_all(name: str) -> list[dict]:
+    with open(os.path.join(DEPLOY_DIR, name), "r", encoding="utf-8") as f:
+        return [d for d in yaml.safe_load_all(f) if d]
+
+
+def _parse_api_path(path: str) -> tuple[str, str]:
+    """(apiGroup, resource[/subresource]) from a request path."""
+    parts = [p for p in path.strip("/").split("/") if p]
+    if parts[0] == "api":          # core group: /api/v1/...
+        group, rest = "", parts[2:]
+    else:                          # /apis/<group>/<version>/...
+        group, rest = parts[1], parts[3:]
+    if rest and rest[0] == "namespaces" and len(rest) > 2:
+        rest = rest[2:]
+    resource = rest[0]
+    if len(rest) > 2:              # <resource>/<name>/<subresource>
+        resource = f"{resource}/{rest[2]}"
+    return group, resource
+
+
+_VERB_BY_BUILDER = {"create": "create", "delete": "delete",
+                    "update": "update", "patch": "patch"}
+
+
+def required_rbac_tuples() -> set[tuple[str, str, str]]:
+    """Every (apiGroup, resource, verb) the daemon's wire surface
+    issues, derived from the actual request builders + reflector
+    tables — no hand-maintained list to rot."""
+    required: set[tuple[str, str, str]] = set()
+    # The watch feed: every reflector LISTs then WATCHes its path
+    # (get rides along for the re-list probes).
+    watch_paths = [p for _k, p in DEFAULT_RESOURCES]
+    for alts in ALT_RESOURCE_PATHS.values():
+        watch_paths.extend(alts)
+    for p in watch_paths:
+        group, resource = _parse_api_path(p)
+        for verb in ("get", "list", "watch"):
+            required.add((group, resource, verb))
+    # The write verbs, from the builders themselves.
+    pod = Pod(uid="u", name="p", namespace="default")
+    group_obj = PodGroup(name="g", queue="q")
+    for req in (
+        binding_request(pod, "n1"),
+        evict_request(pod),
+        pod_group_status_request(group_obj),
+        node_unschedulable_request("n1", True),
+        event_request("Pod", "p", "Bound", "m"),
+        state_snapshot_request({"v": 1}),
+    ):
+        g, resource = _parse_api_path(req["path"])
+        required.add((g, resource, _VERB_BY_BUILDER[req["verb"]]))
+    # put_state_snapshot's create-on-404 fallback and
+    # get_state_snapshot's read (client/http_api.py).
+    required.add(("", "configmaps", "create"))
+    required.add(("", "configmaps", "get"))
+    # Leader election over coordination.k8s.io Leases
+    # (_HttpLeaseLock: GET, POST on absent, PUT on renew/steal).
+    for verb in ("get", "create", "update"):
+        required.add(("coordination.k8s.io", "leases", verb))
+    return required
+
+
+def test_rbac_covers_every_backend_verb():
+    docs = _load_all("rbac.yaml")
+    kinds = {d["kind"] for d in docs}
+    assert {"ServiceAccount", "ClusterRole", "ClusterRoleBinding"} <= kinds
+    role = next(d for d in docs if d["kind"] == "ClusterRole")
+    allowed: set[tuple[str, str, str]] = set()
+    non_resource: set[tuple[str, str]] = set()
+    for rule in role["rules"]:
+        for verb in rule.get("verbs", ()):
+            for url in rule.get("nonResourceURLs", ()):
+                non_resource.add((url, verb))
+            for g in rule.get("apiGroups", ()):
+                for r in rule.get("resources", ()):
+                    allowed.add((g, r, verb))
+
+    missing = {
+        t for t in required_rbac_tuples()
+        if t not in allowed
+        and (t[0], "*", t[2]) not in allowed
+        and ("*", "*", "*") not in allowed
+    }
+    assert not missing, (
+        f"deployment/rbac.yaml is missing rules for verbs the HTTP "
+        f"backend issues: {sorted(missing)}"
+    )
+    # The breaker's half-open probe (GET /version) needs its
+    # nonResourceURL row.
+    assert ("/version", "get") in non_resource
+
+
+def test_rbac_binding_points_at_the_role():
+    docs = _load_all("rbac.yaml")
+    sa = next(d for d in docs if d["kind"] == "ServiceAccount")
+    role = next(d for d in docs if d["kind"] == "ClusterRole")
+    binding = next(d for d in docs if d["kind"] == "ClusterRoleBinding")
+    assert binding["roleRef"]["name"] == role["metadata"]["name"]
+    assert any(
+        s["kind"] == "ServiceAccount"
+        and s["name"] == sa["metadata"]["name"]
+        and s["namespace"] == sa["metadata"]["namespace"]
+        for s in binding["subjects"]
+    )
+
+
+def test_crds_serve_every_version_the_reflector_probes():
+    """The reflector probes v1alpha1 then v1alpha2 for the CRD kinds
+    (ALT_RESOURCE_PATHS); the shipped CRDs must actually serve every
+    probed version or the fallback dance 404s forever."""
+    docs = _load_all("crds.yaml")
+    served: set[tuple[str, str, str]] = set()
+    for d in docs:
+        assert d["kind"] == "CustomResourceDefinition"
+        spec = d["spec"]
+        for v in spec["versions"]:
+            if v.get("served"):
+                served.add((
+                    spec["group"], spec["names"]["plural"], v["name"]
+                ))
+        # Exactly one storage version per CRD (apiserver requirement).
+        assert sum(
+            1 for v in spec["versions"] if v.get("storage")
+        ) == 1
+
+    probed: set[tuple[str, str, str]] = set()
+    for _kind, path in DEFAULT_RESOURCES:
+        if "incubator" not in path:
+            continue
+        parts = path.strip("/").split("/")
+        probed.add((parts[1], parts[3], parts[2]))
+    for alts in ALT_RESOURCE_PATHS.values():
+        for path in alts:
+            parts = path.strip("/").split("/")
+            probed.add((parts[1], parts[3], parts[2]))
+    missing = probed - served
+    assert not missing, (
+        f"deployment/crds.yaml does not serve versions the reflector "
+        f"probes: {sorted(missing)}"
+    )
+
+
+def test_podgroup_status_subresource_declared():
+    """The status writeback PUTs .../podgroups/<n>/status — without
+    `subresources: {status: {}}` on the CRD the apiserver 404s it."""
+    docs = _load_all("crds.yaml")
+    pg = next(
+        d for d in docs
+        if d["spec"]["names"]["plural"] == "podgroups"
+    )
+    for v in pg["spec"]["versions"]:
+        assert "status" in (v.get("subresources") or {}), (
+            f"podgroups version {v['name']} lacks the status "
+            "subresource the writeback PUTs to"
+        )

@@ -61,6 +61,10 @@ def test_metrics_http_endpoint():
         # Node-health subsystem surface (doc/design/node-health.md):
         # the quarantined-node count rides the /healthz body.
         assert isinstance(body["quarantined"], int)
+        # Backlog-pressure surface (observability PR): probes read
+        # ingest lag + commit depth without scraping /metrics.
+        assert isinstance(body["ingest_lag_seconds"], (int, float))
+        assert isinstance(body["commit_queue_depth"], int)
     finally:
         thread.server.shutdown()
 

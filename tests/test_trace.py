@@ -1,0 +1,427 @@
+"""Unit tests for the always-on observability subsystem
+(kube_batch_tpu/trace/): span recorder, decision log, flight recorder,
+triggers, boundedness, and the offline explain CLI.
+
+Decision-invisibility (tracing on/off chaos hash parity) is pinned in
+tests/test_chaos_trace.py; the /debug HTTP surface in
+tests/test_debug_endpoints.py.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import threading
+
+import numpy as np
+import pytest
+
+from kube_batch_tpu import trace
+from kube_batch_tpu.trace import decisions as decisions_mod
+from kube_batch_tpu.trace import recorder as recorder_mod
+from kube_batch_tpu.trace import spans as spans_mod
+
+
+@pytest.fixture(autouse=True)
+def _clean_tracer():
+    """Every test starts and ends with the process-global tracer off —
+    it is process state like the metrics registry."""
+    trace.disable()
+    yield
+    trace.disable()
+
+
+# -- facade ----------------------------------------------------------------
+
+def test_disabled_facade_is_noop():
+    assert not trace.enabled()
+    with trace.span("anything", foo=1):
+        pass
+    trace.note_wire("bind", "p", True)
+    trace.note_transition("breaker-open", backend="x")
+    assert trace.decision_log() is None
+    assert trace.begin_cycle() is None
+    trace.end_cycle({})            # must not raise
+    assert trace.current_cycle() == 0
+    status, body = trace.debug_http("/debug/cycles")
+    assert status == 503 and "disabled" in body["error"]
+
+
+def test_enable_zero_flight_cycles_disables():
+    trace.enable(flight_cycles=0)
+    assert not trace.enabled()
+
+
+# -- span recorder ---------------------------------------------------------
+
+def test_span_ring_bounded_and_chrome_export(tmp_path):
+    t = trace.enable(span_cycles=4, dump_dir=str(tmp_path))
+    for _ in range(10):
+        trace.begin_cycle()
+        with trace.span("solve"):
+            pass
+        with trace.span("dispatch", pods=3):
+            pass
+        trace.end_cycle({})
+    assert t.spans.stats()["cycles_held"] == 4     # ring bound
+    events = t.spans.chrome_events()
+    spans = [e for e in events if e["ph"] == "X"]
+    metas = [e for e in events if e["ph"] == "M"]
+    assert len(spans) == 4 * 2
+    assert all(e["dur"] > 0 and "cycle" in e["args"] for e in spans)
+    assert {e["args"]["name"] for e in metas}      # thread names
+    # Perfetto-loadable file shape.
+    path = t.spans.write_chrome(str(tmp_path / "t.json"))
+    loaded = json.load(open(path))
+    assert isinstance(loaded["traceEvents"], list)
+
+
+def test_cross_thread_span_lands_in_its_cycle(tmp_path):
+    """A commit-flush span attributed to an earlier (closed) cycle
+    lands in that cycle's list; one whose cycle rotated out is
+    dropped, not misfiled."""
+    t = trace.enable(span_cycles=3, dump_dir=str(tmp_path))
+    for _ in range(3):
+        trace.begin_cycle()
+        trace.end_cycle({})
+    done = threading.Event()
+
+    def worker():
+        with trace.span("flush:bind", cycle=1, key="pod:x"):
+            pass
+        with trace.span("flush:bind", cycle=-99, key="pod:y"):
+            pass                                   # unknown cycle
+        done.set()
+
+    threading.Thread(target=worker).start()
+    assert done.wait(5)
+    by_cycle = {
+        e["args"]["cycle"]
+        for e in t.spans.chrome_events() if e["ph"] == "X"
+    }
+    assert 1 in by_cycle
+    assert -99 not in by_cycle
+
+
+def test_span_cap_truncates_pathological_cycles(tmp_path):
+    t = trace.enable(dump_dir=str(tmp_path))
+    trace.begin_cycle()
+    for _ in range(spans_mod.MAX_SPANS_PER_CYCLE + 10):
+        with trace.span("s"):
+            pass
+    trace.end_cycle({})
+    stats = t.spans.stats()
+    assert stats["spans_truncated"] == 10
+    assert stats["truncated_cycles"] == 1
+    assert stats["spans_recorded"] == spans_mod.MAX_SPANS_PER_CYCLE
+    held = [
+        e for e in t.spans.chrome_events() if e["ph"] == "X"
+    ]
+    assert len(held) == spans_mod.MAX_SPANS_PER_CYCLE
+
+
+def test_trace_dir_rotation_keeps_newest_chunks(tmp_path, monkeypatch):
+    monkeypatch.setattr(spans_mod, "ROTATE_CYCLES", 4)
+    monkeypatch.setattr(spans_mod, "ROTATE_KEEP", 2)
+    tdir = tmp_path / "chunks"
+    trace.enable(span_cycles=16, trace_dir=str(tdir),
+                 dump_dir=str(tmp_path))
+    for _ in range(24):
+        trace.begin_cycle()
+        with trace.span("solve"):
+            pass
+        trace.end_cycle({})
+    chunks = sorted(os.listdir(tdir))
+    assert len(chunks) == 2, chunks                # KEEP enforced
+    body = json.load(open(tdir / chunks[-1]))
+    assert body["traceEvents"]
+
+
+# -- decision log ----------------------------------------------------------
+
+def test_pod_and_group_stories():
+    trace.enable()
+    d = trace.decision_log()
+    d.note_group("g1", "gang-gated", 3, placements_dropped=4)
+    d.note_pod("u1", "refused", 3, name="p1", namespace="ns",
+               group="g1", reasons="0/8 nodes are available: ...")
+    story = d.pod_story("u1")
+    assert story["name"] == "p1" and story["group"] == "g1"
+    assert story["records"][0]["kind"] == "refused"
+    assert story["group_records"][0]["kind"] == "gang-gated"
+    g = d.group_story("g1")
+    assert g["pods"] == ["u1"]
+    assert d.pod_story("nope") is None
+    assert d.group_story("nope") is None
+
+
+def test_victim_beneficiary_attribution():
+    trace.enable()
+    d = trace.decision_log()
+    d.note_eviction("v1", "victim-1", "gv", "node-a", "preempted", 10)
+    d.note_eviction("v2", "victim-2", "gv", "node-a", "preempted", 10)
+    d.note_placed("b1", "winner-1", "gw", "node-a", 12)
+    v = d.pod_story("v1")
+    kinds = [r["kind"] for r in v["records"]]
+    assert kinds == ["preempted", "beneficiary"]
+    assert v["records"][1]["pod"] == "winner-1"
+    assert v["records"][1]["group"] == "gw"
+    b = d.pod_story("b1")
+    assert b["records"][0]["after_eviction_of"] == [
+        "victim-1", "victim-2"
+    ]
+
+
+def test_attribution_window_expires():
+    trace.enable()
+    d = trace.decision_log()
+    d.note_eviction("v1", "victim-1", "gv", "node-a", "preempted", 10)
+    d.note_placed(
+        "b1", "late-1", "gw", "node-a",
+        10 + decisions_mod.ATTRIBUTION_WINDOW + 1,
+    )
+    v = d.pod_story("v1")
+    assert [r["kind"] for r in v["records"]] == ["preempted"]
+    assert "after_eviction_of" not in d.pod_story("b1")["records"][0]
+
+
+def test_pod_lru_bound(monkeypatch):
+    monkeypatch.setattr(decisions_mod, "MAX_PODS", 4)
+    trace.enable()
+    d = trace.decision_log()
+    for i in range(10):
+        d.note_pod(f"u{i}", "placed", i, name=f"p{i}")
+    assert d.stats()["pods_tracked"] == 4
+    assert d.pod_story("u0") is None               # oldest evicted
+    assert d.pod_story("u9") is not None
+    # Per-pod ring bound: PER_POD records max.
+    for i in range(decisions_mod.PER_POD + 7):
+        d.note_pod("u9", "refused", i)
+    assert len(d.pod_story("u9")["records"]) == decisions_mod.PER_POD
+
+
+# -- flight recorder -------------------------------------------------------
+
+def test_trigger_dump_names_transition(tmp_path):
+    t = trace.enable(dump_dir=str(tmp_path))
+    trace.begin_cycle()
+    trace.end_cycle({"bound": 2})
+    trace.note_wire("bind", "p1", True, node="n1")
+    trace.note_transition("breaker-open", backend="wire", failures=5)
+    dumps = t.recorder.dumps
+    assert len(dumps) == 1 and dumps[0]["trigger"] == "breaker-open"
+    body = json.load(open(dumps[0]["path"]))
+    # Same top-level shape as the chaos flight recorder.
+    assert set(body) >= {"meta", "ticks"}
+    assert body["meta"]["trigger"] == "breaker-open"
+    assert body["meta"]["transition"]["kind"] == "breaker-open"
+    assert body["meta"]["transition"]["backend"] == "wire"
+    assert body["ticks"][-1]["bound"] == 2
+    assert body["wire"][0]["verb"] == "bind"
+    assert "decisions" in body
+
+
+def test_trigger_cooldown_rate_limits(tmp_path):
+    t = trace.enable(dump_dir=str(tmp_path))
+    trace.note_transition("stale-epoch", where="a")
+    trace.note_transition("stale-epoch", where="b")   # within cooldown
+    assert len(t.recorder.dumps) == 1
+    # A DIFFERENT trigger kind still dumps.
+    trace.note_transition("quarantine-cordon", node="n1")
+    assert len(t.recorder.dumps) == 2
+    # Non-trigger transitions record but never dump.
+    trace.note_transition("node-health", node="n1")
+    assert len(t.recorder.dumps) == 2
+    assert len(t.recorder.transitions) == 4
+
+
+def test_breaker_open_guardrail_hook_dumps(tmp_path):
+    """The real Guardrails breaker-open callback fires the trigger —
+    the unit-level pin of what the chaos guardrail scenario asserts
+    end-to-end (flight-dump-missed-trip invariant)."""
+    from kube_batch_tpu.guardrails import Guardrails
+
+    t = trace.enable(dump_dir=str(tmp_path))
+    Guardrails()._on_breaker_open("unit-wire")
+    assert [d["trigger"] for d in t.recorder.dumps] == ["breaker-open"]
+
+
+def test_statestore_corruption_drop_triggers(tmp_path):
+    from kube_batch_tpu.statestore import StateStore, journal_path
+
+    t = trace.enable(dump_dir=str(tmp_path / "dumps"))
+    sdir = tmp_path / "state"
+    os.makedirs(sdir)
+    store = StateStore(journal_path(str(sdir)))
+    store.append({"ledger": {"clock": 1, "records": {}}})
+    store.close()
+    with open(store.path, "ab") as f:
+        f.write(b"garbage-tail-no-frame\n")
+    StateStore(journal_path(str(sdir))).load()
+    assert [d["trigger"] for d in t.recorder.dumps] == \
+        ["statestore-corrupt"]
+
+
+def test_sigusr2_dumps_on_demand(tmp_path):
+    t = trace.enable(dump_dir=str(tmp_path))
+    assert t.recorder.install_signal_handler()
+    try:
+        signal.raise_signal(signal.SIGUSR2)
+        assert [d["trigger"] for d in t.recorder.dumps] == ["sigusr2"]
+    finally:
+        signal.signal(signal.SIGUSR2, signal.SIG_DFL)
+
+
+def test_on_demand_dumps_never_starve_the_auto_budget(tmp_path):
+    """A probe polling /debug/dump (or an operator mashing SIGUSR2)
+    must not consume the MAX_DUMPS auto-dump budget, accumulate files
+    on disk, or grow the dump-record list without bound — else the
+    03:00 breaker-open post-mortem silently never fires."""
+    t = trace.enable(dump_dir=str(tmp_path))
+    for _ in range(recorder_mod.MAX_DUMPS + 10):
+        t.recorder.dump_body(trigger="debug-endpoint")
+    # One fixed file per on-demand kind, overwritten each poll.
+    assert os.listdir(tmp_path) == ["kb-flight-debug-endpoint.json"]
+    assert len(t.recorder.dumps) <= 2 * recorder_mod.MAX_DUMPS
+    # The anomaly budget is untouched: a real trigger still dumps.
+    trace.note_transition("breaker-open", backend="wire")
+    assert t.recorder.dumps[-1]["trigger"] == "breaker-open"
+    assert os.path.basename(t.recorder.dumps[-1]["path"]).startswith(
+        "kb-flight-breaker-open-c"
+    )
+
+
+def test_transitions_stamp_the_open_cycle(tmp_path):
+    """A mid-cycle trigger (the breaker opens DURING cycle N) must be
+    stamped N — like the wire ops and decision records of the same
+    cycle — not the last completed cycle, or the triage read order
+    shows the trip one cycle before its own evidence."""
+    t = trace.enable(dump_dir=str(tmp_path))
+    trace.begin_cycle()
+    trace.end_cycle({})
+    trace.begin_cycle()                       # cycle 2 is OPEN
+    trace.note_transition("breaker-open", backend="wire")
+    assert t.recorder.transitions[-1]["cycle"] == 2
+    assert t.recorder.dumps[-1]["cycle"] == 2
+
+
+def test_flight_ring_bounded_under_churn_soak(tmp_path, monkeypatch):
+    """500 scheduler cycles of steady churn: every trace-side ring
+    stays at its bound — the always-on recorder can never become the
+    leak that kills a long-lived daemon."""
+    from kube_batch_tpu.api.resource import ResourceSpec
+    from kube_batch_tpu.cache.cluster import Node, Pod, PodGroup
+    from kube_batch_tpu.scheduler import Scheduler
+    from kube_batch_tpu.sim.simulator import make_world
+
+    monkeypatch.setattr(decisions_mod, "MAX_PODS", 64)
+    monkeypatch.setattr(recorder_mod, "WIRE_RING", 128)
+    t = trace.enable(span_cycles=16, flight_cycles=32,
+                     dump_dir=str(tmp_path))
+    cache, sim = make_world(ResourceSpec(("cpu", "memory", "pods")))
+    sim.add_node(Node(name="n0", allocatable={
+        "cpu": 10_000_000, "memory": 1 << 50, "pods": 100_000,
+    }))
+    s = Scheduler(cache, schedule_period=0.0)
+    for i in range(500):
+        sim.submit(
+            PodGroup(name=f"soak-{i}", queue="", min_member=1),
+            [Pod(name=f"soak-{i}-0",
+                 request={"cpu": 10, "memory": 1 << 20, "pods": 1})],
+        )
+        s.run_once()
+        sim.tick()
+    assert t.cycle == 500
+    rec = t.recorder.stats()
+    assert rec["cycles_held"] <= 32
+    assert rec["wire_held"] <= 128
+    assert rec["transitions_held"] <= recorder_mod.TRANSITION_RING
+    assert t.decisions.stats()["pods_tracked"] <= 64
+    assert t.spans.stats()["cycles_held"] <= 16
+    assert not t.recorder.dumps        # healthy soak: no anomaly fired
+
+
+# -- the explain CLI -------------------------------------------------------
+
+def test_explain_cli_over_a_dump(tmp_path, capsys):
+    from kube_batch_tpu.trace.__main__ import main as explain_main
+
+    t = trace.enable(dump_dir=str(tmp_path))
+    d = trace.decision_log()
+    trace.begin_cycle()
+    d.note_pod("u1", "refused", 1, name="p1", group="g1",
+               reasons="0/4 nodes are available: 4 Insufficient cpu")
+    d.note_group("g1", "gang-gated", 1, placements_dropped=2)
+    trace.end_cycle({"pending": 1})
+    rec = t.recorder.dump(trigger="manual")
+    assert rec is not None
+
+    assert explain_main(["explain", "--dump", rec["path"],
+                         "--pod", "u1"]) == 0
+    out = capsys.readouterr().out
+    assert "Insufficient cpu" in out and "gang-gated" in out
+
+    # Name-based lookup resolves to the uid.
+    assert explain_main(["explain", "--dump", rec["path"],
+                         "--pod", "p1"]) == 0
+    assert "refused" in capsys.readouterr().out
+
+    assert explain_main(["explain", "--dump", rec["path"],
+                         "--group", "g1"]) == 0
+    assert "placements_dropped" in capsys.readouterr().out
+
+    assert explain_main(["explain", "--dump", rec["path"]]) == 0
+    assert "manual" in capsys.readouterr().out
+
+    assert explain_main(["explain", "--dump", rec["path"],
+                         "--pod", "missing"]) == 1
+    assert explain_main(["explain", "--dump",
+                         str(tmp_path / "nope.json")]) == 2
+
+
+# -- scheduler integration -------------------------------------------------
+
+def test_scheduler_cycle_summaries_and_refused_story(tmp_path):
+    """A real cycle records its summary + spans, and a pod that can't
+    fit gets a 'refused' story carrying the rendered fit-error
+    reasons (the /debug answer's substance)."""
+    from kube_batch_tpu.api.resource import ResourceSpec
+    from kube_batch_tpu.cache.cluster import Node, Pod, PodGroup
+    from kube_batch_tpu.scheduler import Scheduler
+    from kube_batch_tpu.sim.simulator import make_world
+
+    t = trace.enable(dump_dir=str(tmp_path))
+    cache, sim = make_world(ResourceSpec(("cpu", "memory", "pods")))
+    sim.add_node(Node(name="n0", allocatable={
+        "cpu": 1000, "memory": 2 << 30, "pods": 10,
+    }))
+    sim.submit(
+        PodGroup(name="big", queue="default", min_member=1),
+        [Pod(name="big-0",
+             request={"cpu": 64000, "memory": 1 << 30, "pods": 1})],
+    )
+    sim.submit(
+        PodGroup(name="ok", queue="default", min_member=1),
+        [Pod(name="ok-0",
+             request={"cpu": 100, "memory": 1 << 20, "pods": 1})],
+    )
+    Scheduler(cache, schedule_period=0.0).run_once()
+    summary = t.recorder.cycles[-1]
+    assert summary["bound"] == 1 and summary["pending"] == 1
+    span_names = {
+        e["name"] for e in t.spans.chrome_events() if e["ph"] == "X"
+    }
+    assert {"solve", "dispatch", "diagnosis",
+            "status_writeback"} <= span_names
+    with cache.lock():
+        uid = next(
+            u for u, p in cache._pods.items() if p.name == "big-0"
+        )
+    story = t.decisions.pod_story(uid)
+    refused = [r for r in story["records"] if r["kind"] == "refused"]
+    assert refused and "Insufficient cpu" in refused[0]["reasons"]
+    # The landed bind is in the wire ring and the placed pod's story.
+    assert any(
+        w["verb"] == "bind" and w["ok"] for w in t.recorder.wire
+    )
