@@ -85,6 +85,17 @@ run-example:
 # mid-storm relist recovering through the diff path, the cycle thread
 # never starved past the watchdog ladder, and same seed ⇒ same hash
 # across both batched runs AND the event-mode run.
+# The compile runs are the COMPILE-CLIFF scenario
+# (doc/design/compile-artifacts.md): the workload crosses padding
+# buckets (each crossing compiles a new fused-cycle program, banked +
+# mirrored cluster-side via putCompileArtifact), then the leader
+# crash-restarts with its LOCAL bank wiped (peer mode — a successor
+# on a different matching host): the successor must adopt every
+# program through the getCompileArtifact wire mirror and serve with
+# ZERO inline compiles, no cycle blocked on compilation —
+# scripts/check_chaos_compile.py asserts all of it, same seed ⇒ same
+# hash across the two bank-on runs AND the --compile-bank off parity
+# run (adopting an artifact is decision-invisible).
 # The restart runs are the DURABLE-STATE scenario
 # (doc/design/state-durability.md): the scheduler process crash-
 # restarts three times — mid-quarantine, mid-refusal and mid-breaker-
@@ -164,6 +175,17 @@ chaos:
 	    --ingest-mode event --quiet > /tmp/kb-chaos-ingest-e.json
 	$(PY) scripts/check_chaos_ingest.py /tmp/kb-chaos-ingest-1.json \
 	    /tmp/kb-chaos-ingest-2.json /tmp/kb-chaos-ingest-e.json
+	JAX_PLATFORMS=cpu $(PY) -m kube_batch_tpu.chaos --seed 31 --ticks 12 \
+	    --scenario examples/chaos-compile.json --wire-commit pipelined \
+	    --quiet > /tmp/kb-chaos-compile-1.json
+	JAX_PLATFORMS=cpu $(PY) -m kube_batch_tpu.chaos --seed 31 --ticks 12 \
+	    --scenario examples/chaos-compile.json --wire-commit pipelined \
+	    --quiet > /tmp/kb-chaos-compile-2.json
+	JAX_PLATFORMS=cpu $(PY) -m kube_batch_tpu.chaos --seed 31 --ticks 12 \
+	    --scenario examples/chaos-compile.json --wire-commit pipelined \
+	    --compile-bank off --quiet > /tmp/kb-chaos-compile-b.json
+	$(PY) scripts/check_chaos_compile.py /tmp/kb-chaos-compile-1.json \
+	    /tmp/kb-chaos-compile-2.json /tmp/kb-chaos-compile-b.json
 
 profile:
 	$(PY) -m kube_batch_tpu --workload 2 --cycles 3 --schedule-period 0 \
@@ -181,6 +203,7 @@ verify:
 	JAX_PLATFORMS=cpu $(PY) scripts/check_pack_microbench.py
 	JAX_PLATFORMS=cpu $(PY) scripts/check_ingest_microbench.py
 	JAX_PLATFORMS=cpu $(PY) scripts/check_trace_overhead.py
+	JAX_PLATFORMS=cpu $(PY) scripts/check_compile_artifacts.py
 	$(PY) -c "import __graft_entry__ as g; g.entry()"
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 	    $(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"

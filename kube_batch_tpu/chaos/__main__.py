@@ -101,6 +101,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "invisible, so the same seed must hash "
                         "identically either way (pinned by "
                         "tests/test_chaos_trace.py)")
+    p.add_argument("--compile-bank", choices=("auto", "on", "off"),
+                   default="auto",
+                   help="AOT compile-artifact bank dimension "
+                        "(doc/design/compile-artifacts.md): 'auto' "
+                        "(default) follows the scenario's "
+                        "faults.compile_bank; 'off' is the decision-"
+                        "invisibility parity run — adopting a banked "
+                        "executable and compiling it fresh are the "
+                        "same program, so the same seed must hash "
+                        "identically either way (make chaos pins it)")
     p.add_argument("--quiet", action="store_true",
                    help="suppress progress logging; print only the "
                         "summary JSON")
@@ -152,15 +162,10 @@ def main(argv: list[str] | None = None) -> int:
     from kube_batch_tpu.cli import honor_jax_platforms
 
     honor_jax_platforms()
-    from kube_batch_tpu.compile_cache import enable_compile_cache
-
-    # Same persistent-cache policy as the daemon CLI: a rerun of the
-    # same scenario shapes replays its fused-cycle compiles from disk.
-    enable_compile_cache()
-
     events, scenario, faults = (None, None, None)
     if args.scenario:
         events, scenario, faults = _load_scenario(args.scenario)
+
     if args.no_faults:
         faults = FaultSpec.none()
         if events is not None:
@@ -168,6 +173,31 @@ def main(argv: list[str] | None = None) -> int:
             # "no faults" must strip those too, not just zero the
             # bind-curse percentage.
             events = [e for e in events if e.get("op") != "fault"]
+    # Resolved AFTER --no-faults: a fault-stripped replay of a
+    # compile-bank scenario runs bank-less, so it should keep the
+    # persistent cache too (the cache-replays-are-not-bankable rule
+    # only matters when something is banking).
+    bank_on = args.compile_bank == "on" or (
+        args.compile_bank == "auto"
+        and faults is not None and faults.compile_bank
+    )
+    if bank_on:
+        # The artifact-bank scenario needs TRUE compiles: an
+        # executable REPLAYED from the persistent XLA cache cannot be
+        # re-serialized (XLA drops the AOT symbol table on the load
+        # path), so a warm cache would leave the bank empty and the
+        # adoption invariants vacuous.  The scenario's few tiny-shape
+        # compiles cost seconds.
+        logging.info("compile-bank scenario: persistent XLA compile "
+                     "cache disabled for this run (cache replays are "
+                     "not bankable)")
+    else:
+        from kube_batch_tpu.compile_cache import enable_compile_cache
+
+        # Same persistent-cache policy as the daemon CLI: a rerun of
+        # the same scenario shapes replays its fused-cycle compiles
+        # from disk.
+        enable_compile_cache()
     seed = args.seed
     if seed is None:
         meta = next(
@@ -191,6 +221,7 @@ def main(argv: list[str] | None = None) -> int:
         pack_mode=args.pack_mode,
         ingest_mode=args.ingest_mode,
         trace_obs=args.trace_obs,
+        compile_bank=args.compile_bank,
     )
     try:
         result = engine.run()

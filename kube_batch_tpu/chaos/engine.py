@@ -113,7 +113,7 @@ _META_FAULT_FIELDS = (
     "flaky_at", "flaky_ticks", "flaky_fail_pct", "flaky_flap_every",
     "flaky_drain_budget",
     "crash_restart_at", "crash_restarts", "crash_restart_every",
-    "hbm_pin_at",
+    "hbm_pin_at", "compile_bank",
     "storm_at", "storm_ticks", "storm_events",
 )
 
@@ -142,6 +142,13 @@ STATESTORE_COMPACT_EVERY = 6
 #: relative to the scenario, so in-scenario restores never stale-drop
 #: (tests/test_statestore.py pins the staleness decay itself).
 STATESTORE_MAX_AGE = 10_000
+
+#: cycle-blocked-on-compile budget for the artifact-bank scenario
+#: (wall seconds; the engine drives period-0 cycles, so "1 period" is
+#: floored at the production default): a POST-restart cycle spending
+#: longer than this inside compilation means the successor did not
+#: adopt — it paid the cliff live.
+COMPILE_BLOCK_BUDGET_S = 1.0
 
 
 @dataclasses.dataclass
@@ -189,6 +196,12 @@ class ChaosResult:
     #: incarnation, and — event-storm runs — the emitted-storm count
     #: and the final mirror-parity verdict.
     ingest: dict | None = None
+    #: Compile-artifact-bank observability (None unless the bank
+    #: dimension ran): cumulative compile counters across every
+    #: scheduler incarnation, the POST-restart incarnation's own
+    #: counters (inline must be 0 — artifacts adopted), bank/mirror
+    #: evidence, and the worst per-tick compile-blocked wall time.
+    compile: dict | None = None
     #: Always-on observability (kube_batch_tpu/trace/): whether the
     #: run traced, which flight-recorder triggers auto-dumped (and at
     #: what cycle), and the span/decision-record volumes — the
@@ -214,6 +227,7 @@ class ChaosResult:
             "restart": self.restart,
             "ingest": self.ingest,
             "trace": self.trace,
+            "compile": self.compile,
         }
 
 
@@ -258,6 +272,7 @@ class ChaosEngine:
         state_dir: str | None = None,
         ingest_mode: str | None = None,
         trace_obs: str | None = None,
+        compile_bank: str | None = None,
     ) -> None:
         self.seed = seed
         self.ticks = ticks
@@ -392,6 +407,32 @@ class ChaosEngine:
         self._state_dir_owned = False
         self.statestore = None
         self._restarts: list[dict] = []
+        # -- AOT compile-artifact bank dimension -----------------------
+        # (doc/design/compile-artifacts.md) Resolved from the fault
+        # spec (scenario JSON / replayed meta header) with a CLI
+        # override (`--compile-bank off` is the decision-invisibility
+        # parity run: the same seed must hash identically with the
+        # bank on and off — adopting an artifact and compiling it
+        # fresh are the same program).
+        if compile_bank not in (None, "auto", "on", "off"):
+            raise ValueError(
+                f"compile_bank must be auto/on/off, got {compile_bank!r}"
+            )
+        if compile_bank == "on":
+            self.compile_bank_mode = self.faults.compile_bank or 1
+        elif compile_bank == "off":
+            self.compile_bank_mode = 0
+        else:
+            self.compile_bank_mode = self.faults.compile_bank
+        self.compile_bank = None   # ArtifactBank, built in run()
+        #: Cumulative compile-path evidence across every scheduler
+        #: incarnation (harvested at each crash + at the end).
+        self._compile_totals: collections.Counter = collections.Counter()
+        #: Final (post-last-restart) incarnation's compile_stats.
+        self._compile_final: dict | None = None
+        #: tick -> seconds that tick's cycle spent blocked on
+        #: compilation (the cycle-blocked-on-compile invariant).
+        self._compile_wait_by_tick: dict[int, float] = {}
         #: Persistent HBM-pin fault state: the ceiling settled between
         #: the serving and the refused projection (survives restarts
         #: via engine config, like the CLI's --hbm-ceiling-mb), and
@@ -537,6 +578,54 @@ class ChaosEngine:
         )
         store.mirror_sink = self._mirror_state
         return store
+
+    def _build_compile_bank(self):
+        """The AOT artifact bank (or None) under the engine's state
+        dir — same directory discipline as the CLI (--state-dir/
+        compile_artifacts), rebuilt per incarnation like every other
+        world object; the DIRECTORY is what survives a same-host
+        crash.  Mode 2 (peer adoption) wipes the directory at each
+        crash instead, so the successor must adopt through the wire
+        mirror alone."""
+        if not self.compile_bank_mode:
+            return None
+        if self.state_dir is None:
+            self.state_dir = tempfile.mkdtemp(prefix="kb-chaos-state-")
+            self._state_dir_owned = True
+        from kube_batch_tpu.compile_cache import (
+            ARTIFACT_DIRNAME,
+            ArtifactBank,
+        )
+
+        bank = ArtifactBank(os.path.join(self.state_dir,
+                                         ARTIFACT_DIRNAME))
+        bank.mirror_sink = self._mirror_artifact
+        return bank
+
+    def _mirror_artifact(self, payload: dict) -> None:
+        """One bank entry through the live write seam
+        (breaker-guarded, epoch-fenced).  Best-effort — the local
+        bank holds the truth; putCompileArtifact is not a hashed
+        wire-log op, so the mirror is decision-invisible."""
+        seam = self.cache.binder if self.cache is not None else None
+        put = getattr(seam, "put_compile_artifact", None)
+        if not callable(put):
+            return
+        try:
+            put(payload)
+        except Exception as exc:  # noqa: BLE001 — re-mirrored by the
+            # next put (or the successor's own compiles)
+            log.debug("chaos artifact mirror failed: %s", exc)
+
+    def _harvest_compile(self, scheduler, final: bool = False) -> None:
+        """Fold one (dying or finished) incarnation's compile counters
+        into the run totals; the last incarnation's stats additionally
+        pin the post-restart zero-inline-compile invariant."""
+        if scheduler is None or not self.compile_bank_mode:
+            return
+        self._compile_totals.update(scheduler.compile_stats)
+        if final:
+            self._compile_final = dict(scheduler.compile_stats)
 
     def _mirror_state(self, payload: dict) -> None:
         """The statestore's HA mirror through the live write seam
@@ -956,6 +1045,10 @@ class ChaosEngine:
             writes_before = sum(
                 self.cluster.write_requests_by_tick.values()
             )
+        # Compile-path evidence dies with the incarnation; fold it
+        # into the run totals first (zero-inline is asserted on the
+        # SUCCESSOR's own counters).
+        self._harvest_compile(old_sched)
         # (2) the crash.
         self.cluster.expire_lease()
         self._have_lease = False
@@ -1011,10 +1104,19 @@ class ChaosEngine:
         self.cache.status_updater = seam
         self.cache.attach_health(self.health)
         self._build_commit()
+        if self.compile_bank is not None and self.compile_bank_mode == 2:
+            # Peer-adoption mode: the 'successor' runs on a DIFFERENT
+            # (matching-fingerprint) host — the dead leader's local
+            # bank directory is not there; only the cluster-side
+            # mirror is.
+            import shutil
+
+            shutil.rmtree(self.compile_bank.dir, ignore_errors=True)
+        self.compile_bank = self._build_compile_bank()
         scheduler = Scheduler(
             self.cache, conf_path=self.conf_path, schedule_period=0.0,
             guardrails=self.guardrails, health=self.health,
-            pack_mode=self.pack_mode,
+            pack_mode=self.pack_mode, compile_bank=self.compile_bank,
         )
         self.scheduler = scheduler
         self.statestore = self._build_statestore()
@@ -1025,6 +1127,13 @@ class ChaosEngine:
                 self.statestore, backend=self.backend,
                 health=self.health, guardrails=self.guardrails,
                 scheduler=scheduler, max_age_cycles=STATESTORE_MAX_AGE,
+            )
+        artifacts_peer = 0
+        if self.compile_bank is not None:
+            from kube_batch_tpu.compile_cache import adopt_artifacts
+
+            artifacts_peer = adopt_artifacts(
+                self.compile_bank, self.backend
             )
         # (4) takeover reconciliation — the shared PR-4 helper.
         summary = reconcile_takeover(
@@ -1061,6 +1170,7 @@ class ChaosEngine:
                 else CircuitBreaker.CLOSED
             ),
             "wire_writes_during_restart": writes_after - writes_before,
+            "artifacts_peer_adopted": artifacts_peer,
             "reconcile": summary,
         }
         self._restarts.append(rec)
@@ -1345,10 +1455,11 @@ class ChaosEngine:
         self._build_commit()
         if not self.adapter.wait_for_sync(self.quiesce_timeout):
             raise ChaosEngineError("initial LIST replay never synced")
+        self.compile_bank = self._build_compile_bank()
         scheduler = Scheduler(
             self.cache, conf_path=self.conf_path, schedule_period=0.0,
             guardrails=self.guardrails, health=self.health,
-            pack_mode=self.pack_mode,
+            pack_mode=self.pack_mode, compile_bank=self.compile_bank,
         )
         self.scheduler = scheduler
         # Durable operational memory: journal end-of-cycle state and
@@ -1365,6 +1476,10 @@ class ChaosEngine:
                 health=self.health, guardrails=self.guardrails,
                 scheduler=scheduler, max_age_cycles=STATESTORE_MAX_AGE,
             )
+        if self.compile_bank is not None:
+            from kube_batch_tpu.compile_cache import adopt_artifacts
+
+            adopt_artifacts(self.compile_bank, self.backend)
         checker = InvariantChecker(self.cluster)
         metrics.chaos_convergence_ticks.set(-1.0)
 
@@ -1418,6 +1533,15 @@ class ChaosEngine:
                 # during the flush drain postdates run_once's own
                 # append, and a crash fault next tick must find it.
                 self.scheduler.journal_state()
+                if self.compile_bank_mode:
+                    # Per-tick compile evidence: the wall seconds this
+                    # cycle spent blocked on compilation (the
+                    # cycle-blocked-on-compile invariant) + the live
+                    # counters for the recorder.  NOT part of the
+                    # trace hash.
+                    self._compile_wait_by_tick[t] = \
+                        self.scheduler._last_compile_wait_s
+                    rec["compile"] = dict(self.scheduler.compile_stats)
             else:
                 rec["stood-down"] = True
             if self.corrupt_tick is not None and t == self.corrupt_tick:
@@ -1509,6 +1633,8 @@ class ChaosEngine:
                     violations = self._check_restart(ticks_run)
                 if not violations and self.faults.ingest_faults:
                     violations = self._check_ingest(ticks_run)
+                if not violations and self.compile_bank_mode:
+                    violations = self._check_compile(ticks_run)
         finally:
             self._teardown()
 
@@ -1559,6 +1685,7 @@ class ChaosEngine:
             restart=self._restart_summary(),
             ingest=self._ingest_summary(),
             trace=self._trace_summary,
+            compile=self._compile_summary(),
         )
 
     def _pack_summary(self) -> dict | None:
@@ -2015,6 +2142,121 @@ class ChaosEngine:
             },
         }
 
+    # -- compile-artifact-bank invariants -------------------------------
+    def _check_compile(self, tick: int) -> list[Violation]:
+        """Post-run assertions for the compile-cliff scenario
+        (doc/design/compile-artifacts.md) — the initialization cost
+        actually became horizontal background work:
+
+        * **compile-growth-not-exercised** — the run banked ≥ 2
+          distinct programs (the base bucket plus a crossed growth
+          bucket); anything less and the adoption checks are vacuous;
+        * **artifact-not-mirrored** — the cluster-side mirror holds
+          ≥ 1 entry (putCompileArtifact landed through the live wire);
+        * **post-restart-inline-compile** — the successor incarnation
+          compiled NOTHING inline: every program it served came from
+          the bank (or the peer mirror in wipe mode);
+        * **artifact-not-adopted** — the successor adopted ≥ 1 banked
+          executable (and in peer mode, merged ≥ 1 entry from the
+          wire mirror);
+        * **cycle-blocked-on-compile** — no post-restart cycle spent
+          more than COMPILE_BLOCK_BUDGET_S wall seconds inside
+          compilation."""
+        out: list[Violation] = []
+        self._harvest_compile(self.scheduler, final=True)
+        if self.faults.restart_faults and \
+                self.fault_counts.get("crash-restart", 0) < 1:
+            return out  # _check_restart already reports the no-fire
+        banked = self._compile_totals.get("banked", 0)
+        if banked < 2:
+            out.append(Violation(
+                "compile-growth-not-exercised", tick,
+                f"only {banked} program(s) banked — the scenario "
+                "never crossed a padding bucket, so the adoption "
+                "invariants prove nothing",
+            ))
+        with self.cluster._lock:
+            mirrored = len(self.cluster.compile_artifacts)
+        if mirrored < 1:
+            out.append(Violation(
+                "artifact-not-mirrored", tick,
+                "no compile artifact reached the cluster-side mirror "
+                "(putCompileArtifact never landed)",
+            ))
+        final = self._compile_final or {}
+        if self._restarts:
+            if final.get("inline", 0):
+                out.append(Violation(
+                    "post-restart-inline-compile", tick,
+                    f"the successor compiled {final['inline']} "
+                    "program(s) INLINE instead of adopting its "
+                    f"predecessor's artifacts: {final}",
+                ))
+            if not final.get("adopted", 0):
+                out.append(Violation(
+                    "artifact-not-adopted", tick,
+                    f"the successor adopted no banked executable: "
+                    f"{final}",
+                ))
+            if self.compile_bank_mode == 2 and not any(
+                r.get("artifacts_peer_adopted", 0)
+                for r in self._restarts
+            ):
+                out.append(Violation(
+                    "artifact-not-adopted", tick,
+                    "peer mode: no entry was merged from the wire "
+                    "mirror at any restart (the local bank was wiped "
+                    "— adoption MUST have come through "
+                    "getCompileArtifact)",
+                ))
+            restart_tick = self._restarts[0]["tick"]
+            worst = max(
+                ((t, w) for t, w in self._compile_wait_by_tick.items()
+                 if t > restart_tick),
+                key=lambda p: p[1], default=(None, 0.0),
+            )
+            if worst[1] > COMPILE_BLOCK_BUDGET_S:
+                out.append(Violation(
+                    "cycle-blocked-on-compile", worst[0],
+                    f"post-restart cycle spent {worst[1]:.2f}s blocked "
+                    f"on compilation (> {COMPILE_BLOCK_BUDGET_S:.1f}s) "
+                    "— the successor paid the compile cliff live",
+                ))
+        return out
+
+    def _compile_summary(self) -> dict | None:
+        if not self.compile_bank_mode:
+            return None
+        if self._compile_final is None and self.scheduler is not None:
+            self._harvest_compile(self.scheduler, final=True)
+        mirrored = 0
+        if self.cluster is not None:
+            with self.cluster._lock:
+                mirrored = len(self.cluster.compile_artifacts)
+        restart_tick = (
+            self._restarts[0]["tick"] if self._restarts else None
+        )
+        post = {
+            t: round(w, 4)
+            for t, w in sorted(self._compile_wait_by_tick.items())
+            if restart_tick is not None and t > restart_tick and w > 0
+        }
+        return {
+            "mode": self.compile_bank_mode,
+            "totals": dict(self._compile_totals),
+            "post_restart": self._compile_final,
+            "peer_adopted": sum(
+                r.get("artifacts_peer_adopted", 0)
+                for r in self._restarts
+            ),
+            "mirrored_entries": mirrored,
+            "bank_entries": getattr(self, "_bank_entries_final", 0),
+            "max_post_restart_compile_wait_s": round(
+                max(post.values(), default=0.0), 4
+            ),
+            "post_restart_compile_waits": post,
+        }
+
     # -- batched-ingest invariants --------------------------------------
     def _harvest_ingest(self, adapter) -> None:
         """Fold one (dying) adapter incarnation's ingest counters into
@@ -2269,6 +2511,10 @@ class ChaosEngine:
             shutil.rmtree(self._trace_dump_dir, ignore_errors=True)
         if self.adapter is not None:
             self._harvest_ingest(self.adapter)
+        if self.compile_bank is not None:
+            # Entry census BEFORE the owned state dir (and the bank
+            # under it) is removed below.
+            self._bank_entries_final = len(self.compile_bank.entries())
         if self.statestore is not None:
             try:
                 # Final compaction + mirror (the wire may already be

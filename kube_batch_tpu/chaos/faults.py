@@ -132,6 +132,18 @@ class FaultSpec:
     #: after the last restart that the pin survived WITHOUT a
     #: recompile).  0 disables.
     hbm_pin_at: int = 0
+    #: AOT compile-artifact bank dimension
+    #: (doc/design/compile-artifacts.md): 0 = off; 1 = the driven
+    #: scheduler banks every compile under the engine's state dir and
+    #: mirrors it cluster-side (putCompileArtifact), and a
+    #: crash-restart successor must ADOPT its predecessor's
+    #: executables with zero inline compiles; 2 = same, but the LOCAL
+    #: bank is wiped at each crash — simulating a successor on a
+    #: different (matching-fingerprint) host that must adopt through
+    #: the peer wire mirror alone.  The bank must be decision-
+    #: invisible: `make chaos` pins same seed ⇒ same hash with the
+    #: bank on and off.
+    compile_bank: int = 0
 
     # -- batched-ingest faults (doc/design/ingest-batching.md) ----------
     #: Tick the EVENT STORM opens: every tick of the window the
@@ -349,7 +361,8 @@ class ChaosCluster(ExternalCluster):
     #: blackhole must not kill the engine's own per-tick lease
     #: renewal.
     WRITE_VERBS = frozenset({
-        "bind", "evict", "updatePodGroup", "putStateSnapshot", "ping",
+        "bind", "evict", "updatePodGroup", "putStateSnapshot",
+        "putCompileArtifact", "ping",
     })
 
     def __init__(self, *, seed: int = 0, bind_fail_pct: int = 0,

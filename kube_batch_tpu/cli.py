@@ -148,6 +148,37 @@ def build_parser() -> argparse.ArgumentParser:
                         "daemon skips the first-cycle recompile "
                         "(default: KB_TPU_COMPILE_CACHE or a tmp dir; "
                         "empty string disables)")
+    # -- AOT compile-artifact bank + no-block compile ladder
+    #    (doc/design/compile-artifacts.md)
+    p.add_argument("--compile-artifacts", choices=("auto", "on", "off"),
+                   default="auto",
+                   help="AOT compile-artifact bank: serialize every "
+                        "compiled fused-cycle executable, keyed by "
+                        "(host fingerprint, conf digest, shape key), "
+                        "and adopt banked/mirrored executables instead "
+                        "of compiling — a failover successor or "
+                        "restarted daemon warm-starts with zero inline "
+                        "compiles.  'auto' (default) enables whenever "
+                        "a bank directory resolves (--compile-"
+                        "artifacts-dir, else under --state-dir); 'on' "
+                        "requires one; 'off' disables")
+    p.add_argument("--compile-artifacts-dir", default=None,
+                   help="bank directory (default: "
+                        "<--state-dir>/compile_artifacts, next to the "
+                        "statestore journal).  In wire modes the bank "
+                        "additionally mirrors cluster-side "
+                        "(putCompileArtifact / a ConfigMap in the k8s "
+                        "dialects) for cross-host successor adoption")
+    p.add_argument("--compile-budget", type=float, default=-1.0,
+                   help="no-block compile ladder: max seconds a cycle "
+                        "may wait on compilation when a fallback "
+                        "program exists — past it the compile keeps "
+                        "running in the BACKGROUND and the cycle "
+                        "serves the last compiled bucket with "
+                        "overflow rows held Pending (CompilePending "
+                        "event).  Default -1 = one schedule period; "
+                        "0 disables (block inline, the pre-ladder "
+                        "behavior); env KB_TPU_COMPILE_BUDGET")
     # -- always-on observability (kube_batch_tpu/trace/;
     #    doc/design/observability.md)
     p.add_argument("--flight-recorder-cycles", type=int, default=256,
@@ -344,6 +375,109 @@ def wire_statestore(args, statestore, scheduler, health, guardrails,
                 _push()
 
         statestore.mirror_sink = _mirror
+
+
+def resolve_compile_budget(args) -> float | None:
+    """The no-block compile budget in seconds, or None (disabled).
+    Flag default -1 means 'one schedule period'; 0 opts out; the env
+    var supplies the default when the flag is untouched."""
+    budget = args.compile_budget
+    if budget == -1.0:
+        env = os.environ.get("KB_TPU_COMPILE_BUDGET", "")
+        try:
+            budget = float(env) if env else -1.0
+        except ValueError:
+            logging.warning("unreadable KB_TPU_COMPILE_BUDGET %r; "
+                            "using one schedule period", env)
+            budget = -1.0
+    if budget == -1.0:
+        budget = max(float(args.schedule_period), 0.05)
+    return None if budget <= 0 else float(budget)
+
+
+def build_compile_bank(args):
+    """The AOT compile-artifact bank (compile_cache.ArtifactBank), or
+    None.  'auto' enables whenever a directory resolves — explicit
+    --compile-artifacts-dir, else next to the statestore journal under
+    --state-dir (doc/design/compile-artifacts.md)."""
+    if args.compile_artifacts == "off":
+        return None
+    from kube_batch_tpu.compile_cache import ARTIFACT_DIRNAME, ArtifactBank
+
+    path = args.compile_artifacts_dir or (
+        os.path.join(args.state_dir, ARTIFACT_DIRNAME)
+        if args.state_dir else None
+    )
+    if not path:
+        if args.compile_artifacts == "on":
+            raise SystemExit(
+                "--compile-artifacts on needs a bank directory: pass "
+                "--compile-artifacts-dir, or --state-dir (the bank "
+                "then lives next to the statestore journal)"
+            )
+        return None
+    bank = ArtifactBank(path)
+    logging.info("AOT compile-artifact bank: %s (%d entr%s banked)",
+                 bank.dir, len(bank.entries()),
+                 "y" if len(bank.entries()) == 1 else "ies")
+    return bank
+
+
+def wire_compile_bank(args, bank, scheduler, backend=None,
+                      commit=None) -> None:
+    """Attach the bank to the scheduler, adopt peer-mirrored artifacts
+    BEFORE the first cycle (local bank first — this host's own
+    executables; the wire mirror fills in what it lacks), and arm the
+    cluster-side mirror sink (rides the commit pipeline like the
+    statestore's)."""
+    # The no-block ladder needs only a previously compiled fallback
+    # program, not a bank — arm the budget even bank-less so
+    # --compile-budget / KB_TPU_COMPILE_BUDGET is never silently
+    # ignored.
+    scheduler.compile_budget_s = resolve_compile_budget(args)
+    if bank is None:
+        return
+    from kube_batch_tpu.compile_cache import adopt_artifacts
+
+    scheduler.compile_bank = bank
+    # Snapshot what THIS host banked before adoption: the re-mirror
+    # below must not push the peer entries we are about to pull right
+    # back through the wire.
+    local_names = set(bank.entries())
+    adopted = adopt_artifacts(bank, backend)
+    if adopted:
+        logging.info(
+            "%d compile artifact(s) adopted from the peer mirror "
+            "before the first cycle", adopted,
+        )
+    if backend is not None and callable(
+        getattr(backend, "put_compile_artifact", None)
+    ):
+        def _mirror(payload):
+            def _push():
+                try:
+                    backend.put_compile_artifact(payload)
+                except Exception as exc:  # noqa: BLE001 — the local
+                    # bank holds the truth; the next put (or a
+                    # successor's own compile) re-covers the mirror
+                    logging.warning(
+                        "compile artifact mirror write failed "
+                        "(local bank unaffected): %s", exc,
+                    )
+            if commit is not None:
+                commit.submit("compile-artifact", _push, verb="state")
+            else:
+                _push()
+
+        bank.mirror_sink = _mirror
+        # Re-mirror what this host already banked (bounded per entry):
+        # a fresh cluster-side mirror — e.g. after an ExternalCluster
+        # restart — must not stay empty until the next local compile.
+        # Peer-adopted entries are skipped: the mirror already holds
+        # them.
+        for payload in bank.export_payloads():
+            if payload.get("name") in local_names:
+                _mirror(payload)
 
 
 def build_commit_pipeline(args, cache, guardrails):
@@ -782,6 +916,11 @@ def run_external(args) -> int:
         statestore = build_statestore(args)
         wire_statestore(args, statestore, scheduler, health, guardrails,
                         backend=guarded, commit=commit)
+        # AOT artifact bank: adopt peer executables BEFORE the first
+        # cycle (a failover successor warm-starts with zero inline
+        # compiles), then mirror every fresh compile cluster-side.
+        wire_compile_bank(args, build_compile_bank(args), scheduler,
+                          backend=guarded, commit=commit)
         ran = scheduler.run(stop=stop, max_cycles=args.cycles)
         logging.info("stopped after %d cycles", ran)
     except KeyboardInterrupt:
@@ -925,6 +1064,8 @@ def run_http(args) -> int:
         statestore = build_statestore(args)
         wire_statestore(args, statestore, scheduler, health, guardrails,
                         backend=guarded, commit=commit)
+        wire_compile_bank(args, build_compile_bank(args), scheduler,
+                          backend=guarded, commit=commit)
         ran = scheduler.run(stop=stop, max_cycles=args.cycles)
         logging.info("stopped after %d cycles", ran)
     except KeyboardInterrupt:
@@ -1105,6 +1246,9 @@ def main(argv: list[str] | None = None) -> int:
     )
     statestore = build_statestore(args)
     wire_statestore(args, statestore, scheduler, health, guardrails)
+    # Sim mode banks + adopts locally (journal-dir discipline; no wire
+    # to mirror through) — a restarted sim daemon still warm-starts.
+    wire_compile_bank(args, build_compile_bank(args), scheduler)
     try:
         ran = scheduler.run(
             max_cycles=args.cycles,

@@ -149,9 +149,13 @@ class StaleEpochError(RuntimeError):
 #: fenced like every data-plane write: a deposed leader must not keep
 #: overwriting the snapshot its successor is adopting; the READ verb
 #: (getStateSnapshot) stays unfenced — a contender adopting state is
-#: not yet the leader.
+#: not yet the leader.  putCompileArtifact (the AOT artifact bank's
+#: cluster-side mirror, doc/design/compile-artifacts.md) follows the
+#: same rule: fenced write, unfenced read (getCompileArtifact — a
+#: successor adopts artifacts BEFORE its first cycle).
 FENCED_VERBS = frozenset({
     "bind", "evict", "updatePodGroup", "putStateSnapshot",
+    "putCompileArtifact",
 })
 
 
@@ -341,6 +345,23 @@ class StreamBackend:
         resp = self._call({"verb": "getStateSnapshot"})
         obj = resp.get("object")
         return obj if isinstance(obj, dict) else None
+
+    # -- AOT compile-artifact mirror (compile_cache.ArtifactBank) -------
+    def put_compile_artifact(self, payload: dict) -> None:
+        """Mirror one serialized fused-cycle executable cluster-side
+        (doc/design/compile-artifacts.md) so a failover successor or
+        scaled-out peer on a matching host adopts its predecessor's
+        executables instead of recompiling them.  Epoch-fenced like
+        every data-plane write; rides the commit pipeline."""
+        self._call({"verb": "putCompileArtifact", "object": payload})
+
+    def get_compile_artifact(self) -> list:
+        """Every mirrored compile-artifact entry (possibly empty).
+        Unfenced read: artifact adoption happens BEFORE the adopter's
+        first cycle, exactly like statestore adoption."""
+        resp = self._call({"verb": "getCompileArtifact"})
+        obj = resp.get("object")
+        return obj if isinstance(obj, list) else []
 
     # -- watch lifecycle verbs (≙ reflector LIST / re-WATCH calls) ------
     def watch_resume(self, since: int) -> None:

@@ -120,6 +120,14 @@ class ExternalCluster:
         # every data-plane verb, readable by any contender.  The k8s
         # dialect lands here too (ConfigMap-shaped write).
         self.state_snapshot: dict | None = None
+        # The leader's mirrored AOT compile artifacts
+        # (doc/design/compile-artifacts.md): entry-name → payload,
+        # merged per put (a bank holds MANY programs, unlike the
+        # single statestore snapshot), bounded FIFO so a pathological
+        # shape churn cannot grow the control plane unboundedly.
+        # Epoch-fenced on write, readable by any contender; the k8s
+        # dialect lands here too (ConfigMap-shaped write).
+        self.compile_artifacts: dict[str, dict] = {}
         if reader is not None and writer is not None:
             self.attach(reader, writer)
 
@@ -571,13 +579,37 @@ class ExternalCluster:
         )
         if m and verb in ("create", "update", "patch"):
             from kube_batch_tpu.client.k8s_write import (
+                COMPILE_CONFIGMAP_NAME,
+                COMPILE_CONFIGMAP_NAMESPACE,
                 STATE_CONFIGMAP_NAME,
                 STATE_CONFIGMAP_NAMESPACE,
             )
 
+            if m.groups() == (COMPILE_CONFIGMAP_NAMESPACE,
+                              COMPILE_CONFIGMAP_NAME):
+                # The artifact bank's mirror in apiserver dialect: a
+                # ConfigMap whose data maps entry-name → one JSON
+                # entry payload (epoch-fenced by path above).  Each
+                # write MERGES its keys — the bank holds many
+                # programs, and a patch must not clobber its siblings.
+                from kube_batch_tpu.compile_cache import (
+                    payloads_from_configmap_data,
+                )
+
+                data = obj.get("data")
+                if obj.get("kind") != "ConfigMap" or \
+                        not isinstance(data, dict):
+                    self._respond(writer, rid, False,
+                                  "malformed compile-artifacts "
+                                  "ConfigMap")
+                    return
+                for payload in payloads_from_configmap_data(data):
+                    self._merge_compile_artifact(payload)
+                self._respond(writer, rid, True)
+                return
             if m.groups() != (STATE_CONFIGMAP_NAMESPACE,
                               STATE_CONFIGMAP_NAME):
-                # Only the statestore's dedicated object routes here —
+                # Only the dedicated control-plane objects route here —
                 # an unrelated ConfigMap write must not clobber the
                 # snapshot a successor will adopt.
                 self._respond(writer, rid, False,
@@ -616,6 +648,22 @@ class ExternalCluster:
 
         self._respond(writer, rid, False,
                       f"unhandled k8s request {verb} {path}")
+
+    #: Mirror bound: oldest entries drop past this — a pathological
+    #: shape churn must not grow the control-plane object unboundedly
+    #: (the local bank on disk is the full record).
+    COMPILE_ARTIFACTS_MAX = 32
+
+    def _merge_compile_artifact(self, payload: dict) -> None:
+        """Merge one mirrored bank entry (keyed by its entry name;
+        re-puts of the same key replace in place), bounded FIFO."""
+        name = str(payload.get("name") or f"anon-{len(self.compile_artifacts)}")
+        self.compile_artifacts.pop(name, None)
+        self.compile_artifacts[name] = payload
+        while len(self.compile_artifacts) > self.COMPILE_ARTIFACTS_MAX:
+            self.compile_artifacts.pop(
+                next(iter(self.compile_artifacts))
+            )
 
     # -- watch resume (≙ reflector re-watch from last RV / 410 Gone) ----
     def _handle_watch_resume(self, writer, rid: int, since: int) -> None:
@@ -689,6 +737,22 @@ class ExternalCluster:
             elif verb == "getStateSnapshot":
                 self._respond(writer, rid, True,
                               extra={"object": self.state_snapshot})
+            elif verb == "putCompileArtifact":
+                # The AOT artifact bank's cluster-side mirror
+                # (epoch-fenced above): one entry merged per put, no
+                # watch event — control-plane metadata like the state
+                # snapshot, but a SET (a bank holds many programs).
+                obj = msg.get("object")
+                if not isinstance(obj, dict):
+                    self._respond(writer, rid, False,
+                                  "malformed compile artifact")
+                else:
+                    self._merge_compile_artifact(obj)
+                    self._respond(writer, rid, True)
+            elif verb == "getCompileArtifact":
+                self._respond(writer, rid, True, extra={
+                    "object": list(self.compile_artifacts.values()),
+                })
             elif verb == "updatePodGroup":
                 from kube_batch_tpu.client.codec import decode_pod_group
 

@@ -737,6 +737,49 @@ class K8sHttpBackend:
             return None
         return payload if isinstance(payload, dict) else None
 
+    # -- AOT compile-artifact mirror (compile_cache.ArtifactBank) -------
+    def put_compile_artifact(self, payload: dict) -> None:
+        """One bank entry merge-PATCHed into the compile-artifacts
+        ConfigMap (create-on-404 like the statestore mirror).
+        Client-side fenced like the other HTTP writes
+        (doc/design/compile-artifacts.md)."""
+        from kube_batch_tpu.client.k8s_write import (
+            COMPILE_CONFIGMAP_NAMESPACE,
+            compile_artifact_request,
+        )
+
+        self._check_fence()
+        req = compile_artifact_request(payload)
+        try:
+            self._issue(req)
+        except HttpError as exc:
+            if exc.status != 404:
+                raise
+            self._issue({
+                "verb": "create",
+                "path": (
+                    f"/api/v1/namespaces/{COMPILE_CONFIGMAP_NAMESPACE}"
+                    "/configmaps"
+                ),
+                "object": req["object"],
+            })
+
+    def get_compile_artifact(self) -> list:
+        """Every mirrored bank entry read back from the ConfigMap
+        (possibly empty — a cold mirror means 'compile fresh', never
+        a crash).  Unparsable values are skipped; the bank's own
+        validation chain re-checks every survivor before any
+        deserialization."""
+        from kube_batch_tpu.client.k8s_write import COMPILE_CONFIGMAP_PATH
+        from kube_batch_tpu.compile_cache import payloads_from_configmap_data
+
+        try:
+            obj = self.client.request_json("GET", COMPILE_CONFIGMAP_PATH)
+            data = obj.get("data") or {}
+        except HttpError:
+            return []
+        return payloads_from_configmap_data(data)
+
     # -- leadership fencing (same surface as StreamBackend) -------------
     @property
     def epoch(self) -> int | None:

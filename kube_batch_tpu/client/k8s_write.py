@@ -184,6 +184,41 @@ def state_snapshot_request(payload: dict) -> dict[str, Any]:
     }
 
 
+#: Where the AOT compile-artifact bank's cluster-side mirror lives in
+#: apiserver dialect — one ConfigMap whose data maps entry-name → one
+#: JSON entry payload; writes MERGE their keys (merge-PATCH), so the
+#: many-program bank accumulates instead of clobbering itself
+#: (doc/design/compile-artifacts.md).
+COMPILE_CONFIGMAP_NAMESPACE = "kube-system"
+COMPILE_CONFIGMAP_NAME = "kube-batch-tpu-compile-artifacts"
+COMPILE_CONFIGMAP_PATH = (
+    f"/api/v1/namespaces/{COMPILE_CONFIGMAP_NAMESPACE}"
+    f"/configmaps/{COMPILE_CONFIGMAP_NAME}"
+)
+
+
+def compile_artifact_request(payload: dict) -> dict[str, Any]:
+    """One bank entry as an apiserver-shaped merge-PATCH of the
+    compile-artifacts ConfigMap: ``data[<entry name>]`` carries the
+    framed entry (header + base64 payload) as one JSON string."""
+    import json as _json
+
+    name = str(payload.get("name") or "entry")
+    return {
+        "verb": "patch",
+        "path": COMPILE_CONFIGMAP_PATH,
+        "object": {
+            "apiVersion": "v1",
+            "kind": "ConfigMap",
+            "metadata": {
+                "name": COMPILE_CONFIGMAP_NAME,
+                "namespace": COMPILE_CONFIGMAP_NAMESPACE,
+            },
+            "data": {name: _json.dumps(payload, sort_keys=True)},
+        },
+    }
+
+
 def event_request(
     kind: str,
     name: str,
@@ -329,6 +364,13 @@ class K8sStreamBackend(StreamBackend):
         epoch-fenced ConfigMap update (path writes are fenced by the
         epoch check like every data-plane write)."""
         self._call(state_snapshot_request(payload))
+
+    def put_compile_artifact(self, payload: dict) -> None:
+        """The AOT artifact bank's mirror in apiserver dialect: an
+        epoch-fenced merge-PATCH of the compile-artifacts ConfigMap
+        (doc/design/compile-artifacts.md).  Reads stay on the native
+        getCompileArtifact verb, like the statestore's."""
+        self._call(compile_artifact_request(payload))
 
     # -- EventSink (cache.record_event forwarding) ----------------------
     def record_event(

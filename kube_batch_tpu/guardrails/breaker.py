@@ -335,6 +335,18 @@ class GuardedBackend:
             key="state",
         )
 
+    def put_compile_artifact(self, payload: dict) -> None:
+        """The AOT artifact bank's mirror write, guarded like every
+        data-plane verb: with the breaker OPEN it fails fast instead
+        of stalling a compile thread on wire timeouts — the local
+        bank already holds the executable, and a startup re-mirror /
+        the next put re-pushes once the wire heals."""
+        return self._guarded(
+            "putCompileArtifact",
+            lambda: self.inner.put_compile_artifact(payload),
+            key="compile-artifact",
+        )
+
     def cordon_node(self, name: str, unschedulable: bool) -> None:
         """The health ledger's spec.unschedulable mirror write (k8s
         dialects).  Guarded like every data-plane write — and with the

@@ -391,6 +391,65 @@ hbm_blocked_cycles = REGISTRY.register(Counter(
     "action).",
 ))
 
+# -- AOT compile-artifact bank + no-block compile ladder ---------------------
+# (kube_batch_tpu/compile_cache.py · ArtifactBank; scheduler.py ·
+#  _ensure_compiled; doc/design/compile-artifacts.md)
+compile_artifacts_banked = REGISTRY.register(Counter(
+    "compile_artifacts_banked_total",
+    "Compiled fused-cycle executables serialized into the AOT "
+    "artifact bank (inline compiles, growth prewarms, conf prewarms "
+    "and the warm tool all export here).",
+))
+compile_artifacts_adopted = REGISTRY.register(Counter(
+    "compile_artifacts_adopted_total",
+    "Cycles that ADOPTED a banked executable instead of compiling — "
+    "a warm failover/restart records these where a cold one records "
+    "compile_inline_total.",
+))
+compile_artifact_rejected = REGISTRY.register(Counter(
+    "compile_artifact_rejected_total",
+    "Bank entries refused at load, by reason (truncated, crc, "
+    "header, version, host, key, deserialize, io): every refusal "
+    "degrades to 'compile fresh' — never a crash, never a foreign "
+    "executable loaded.",
+    labels=("reason",),
+))
+compile_artifact_peer_adopted = REGISTRY.register(Counter(
+    "compile_artifact_peer_adopted_total",
+    "Artifact entries merged into the local bank from a peer's wire "
+    "mirror at startup/takeover (matching host fingerprint only).",
+))
+compile_inline_total = REGISTRY.register(Counter(
+    "compile_inline_total",
+    "Fused-cycle compiles paid ON the cycle thread (the compile "
+    "cliff this subsystem exists to remove; a warm bank + prewarm "
+    "keeps this at the cold-start minimum).",
+))
+compile_background_total = REGISTRY.register(Counter(
+    "compile_background_total",
+    "Fused-cycle compiles run on a background thread (growth "
+    "prewarm, conf prewarm, and no-block deferrals).",
+))
+compile_pending_cycles = REGISTRY.register(Counter(
+    "compile_pending_cycles_total",
+    "Cycles served DEGRADED by the no-block compile ladder: the "
+    "needed bucket's executable was still compiling in the "
+    "background, so the cycle kept serving the last compiled bucket "
+    "with overflow rows held Pending (CompilePending event).",
+))
+compile_inflight = REGISTRY.register(Gauge(
+    "compile_inflight",
+    "Background fused-cycle compiles currently in flight (growth "
+    "prewarm worker + no-block deferrals); mirrored by /healthz.",
+))
+compile_inflight.set(0.0)
+warm_queue_depth = REGISTRY.register(Gauge(
+    "warm_queue_depth",
+    "Pending growth-prewarm shape variants queued behind the "
+    "background compile worker; mirrored by /healthz.",
+))
+warm_queue_depth.set(0.0)
+
 # -- node-health subsystem (kube_batch_tpu/health/) --------------------------
 node_health_state = REGISTRY.register(Gauge(
     "node_health_state",
@@ -561,6 +620,12 @@ def health_body() -> bytes:
             "ingest_lag_seconds": round(_health_ingest_lag, 3),
         }
     body["commit_queue_depth"] = int(commit_queue_depth.value())
+    # Compile-ladder pressure (doc/design/compile-artifacts.md): a
+    # probe or runbook's first question during a slow-cycle incident
+    # is "is the daemon waiting on the compile service" — both already
+    # exist as /metrics gauges; here they are one cheap GET away.
+    body["compile_inflight"] = int(compile_inflight.value())
+    body["warm_queue_depth"] = int(warm_queue_depth.value())
     return json.dumps(body, sort_keys=True).encode()
 
 

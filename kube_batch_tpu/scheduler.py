@@ -40,6 +40,13 @@ DEFAULT_SCHEDULE_PERIOD = 1.0  # ≙ scheduler.go · defaultSchedulePeriod (1s)
 
 _PENDING = int(TaskStatus.PENDING)
 
+#: Sentinel returned by _ensure_compiled when the needed bucket's
+#: executable is still compiling in the BACKGROUND under the no-block
+#: ladder: the cycle serves the last compiled bucket (overflow rows
+#: held Pending) instead of blocking on the compile service
+#: (doc/design/compile-artifacts.md).
+COMPILE_PENDING = object()
+
 
 class Scheduler:
     """≙ pkg/scheduler/scheduler.go · Scheduler."""
@@ -54,6 +61,8 @@ class Scheduler:
         health=None,
         pack_mode: str | None = None,
         statestore=None,
+        compile_bank=None,
+        compile_budget_s: float | None = None,
     ) -> None:
         self.cache = cache
         self.conf_path = conf_path
@@ -198,6 +207,53 @@ class Scheduler:
         import os
 
         self._compact_wire = os.environ.get("KB_TPU_COMPACT_WIRE") == "1"
+        # -- AOT compile-artifact bank + no-block compile ladder --------
+        # (doc/design/compile-artifacts.md)
+        #: compile_cache.ArtifactBank (or None): every compile this
+        #: scheduler pays — inline, growth warm, conf prewarm,
+        #: warm_grown — serializes its executable into the bank, and
+        #: every _ensure_compiled miss checks the bank BEFORE
+        #: compiling, so a failover successor / restarted daemon /
+        #: scaled-out peer on a matching host ADOPTS its
+        #: predecessor's executables instead of recompiling them.
+        self.compile_bank = compile_bank
+        #: No-block compile budget in seconds (None disables — the
+        #: historical block-inline behavior).  When set and a fallback
+        #: executable exists, a cycle whose bucket has no compiled
+        #: program hands the compile to a background thread and waits
+        #: at most this long; past the budget it serves the LAST
+        #: compiled bucket with overflow rows held Pending
+        #: (CompilePending) — degraded throughput, never a frozen
+        #: cycle.
+        self.compile_budget_s = compile_budget_s
+        #: Digest co-keying every bank entry with the host fingerprint
+        #: (set at conf adoption; compiled programs are a pure
+        #: function of conf + compact-wire + shapes on one host).
+        self._conf_digest: str | None = None
+        #: Shape key of the last executable that actually SERVED a
+        #: cycle under the current policy — the no-block ladder's
+        #: fallback program.
+        self._serving_key: tuple | None = None
+        #: True while the CURRENT cycle is being served degraded by
+        #: the no-block ladder (skips diagnosis — it would compile at
+        #: the very shapes we are avoiding).
+        self._compile_pending_now = False
+        #: Wall seconds the CURRENT cycle spent waiting on compilation
+        #: (inline compiles + bounded joins) — the chaos engine's
+        #: cycle-blocked-on-compile invariant reads this per tick.
+        self._last_compile_wait_s = 0.0
+        #: Requesting-cycle attribution for background compiles (shape
+        #: key -> trace cycle at enqueue time), so Perfetto shows WHY a
+        #: background compile ran — keyed separately to keep the
+        #: growth queue's 4-tuple entry shape stable.
+        self._compile_req_cycle: dict[tuple, int] = {}
+        #: Observable compile-path counters (chaos invariants + tests;
+        #: the /metrics counters aggregate process-wide, these are
+        #: per-instance).
+        self.compile_stats = {
+            "inline": 0, "adopted": 0, "banked": 0,
+            "background": 0, "pending_cycles": 0,
+        }
 
     # -- configuration (hot reload) -------------------------------------
     def _build_from_conf(self, conf: SchedulerConf) -> dict:
@@ -250,6 +306,13 @@ class Scheduler:
         # The old cycle's id() may be reused by the new callable —
         # stale shape keys would silently skip the explicit AOT step.
         self._compiled_shapes.clear()
+        # Artifact-bank key for the adopted policy (and the no-block
+        # fallback belongs to the OLD policy's executables).
+        from kube_batch_tpu.compile_cache import conf_digest
+
+        self._conf_digest = conf_digest(built["conf"], self._compact_wire)
+        self._serving_key = None
+        self._compile_req_cycle.clear()
         # Growth-prewarm state belongs to the OLD policy's executables:
         # keeping it would silently suppress re-warming a boundary the
         # new policy has never compiled (queue entries also carry the
@@ -304,6 +367,15 @@ class Scheduler:
         built["started"] = time.monotonic()
         snap = self._last_snap
         cycle = built["cycle"]
+        # Bank key + span attribution resolved on the CYCLE thread:
+        # the warm compiles the PENDING conf's program, so it banks
+        # under that conf's digest, and its compile span belongs to
+        # the cycle that noticed the edit.
+        from kube_batch_tpu.compile_cache import conf_digest
+
+        new_digest = conf_digest(built["conf"], self._compact_wire)
+        req_cycle = trace.current_cycle()
+        bank = self.compile_bank
 
         def warm() -> None:
             try:
@@ -320,12 +392,20 @@ class Scheduler:
                     # only CLI/bench runs — persistent cache enabled —
                     # would get back cheaply).
                     state = init_state(snap)
-                    exe = cycle.lower(snap, state).compile()
+                    trace.note_transition(
+                        "compile-start", where="conf-prewarm",
+                        cycle=req_cycle,
+                    )
+                    key = Scheduler._shape_key(cycle, snap)
+                    with trace.span("compile", cycle=req_cycle,
+                                    where="conf-prewarm"):
+                        exe = cycle.lower(snap, state).compile()
+                    metrics.compile_background_total.inc()
+                    if bank is not None:
+                        bank.put(new_digest, key[1:], exe)
                     out = exe(snap, state)
                     jax.block_until_ready(out)
-                    built["compiled"] = (
-                        Scheduler._shape_key(cycle, snap), exe
-                    )
+                    built["compiled"] = (key, exe)
             except Exception:  # noqa: BLE001 — warm failure still swaps;
                 # the real cycle will surface (and log) any genuine error
                 logging.exception("conf prewarm failed; swapping anyway")
@@ -493,11 +573,136 @@ class Scheduler:
         out.update(self._pin_shapes(k[1:]) for k in self._growth_refused)
         return out
 
+    # -- compile-artifact bank glue (doc/design/compile-artifacts.md) ---
+    def _bank_put(self, key: tuple, exe) -> None:
+        """Serialize one freshly-compiled executable into the artifact
+        bank (best-effort; the mirror sink pushes it cluster-side)."""
+        bank = self.compile_bank
+        if bank is None or self._conf_digest is None:
+            return
+        if bank.put(self._conf_digest, key[1:], exe):
+            self.compile_stats["banked"] += 1
+
+    def _adopt_banked(self, key: tuple, snap):
+        """A banked executable for `key`, deserialized, admitted and
+        published — or None (miss / refused).  This is the zero-compile
+        path a failover successor or restarted daemon takes: the
+        predecessor's serialized program replays in milliseconds where
+        a cold compile costs seconds to minutes."""
+        bank = self.compile_bank
+        if bank is None or self._conf_digest is None:
+            return None
+        exe = bank.get(self._conf_digest, key[1:])
+        if exe is None:
+            return None
+        label = (
+            f"banked T={int(snap.num_tasks)}×N={int(snap.num_nodes)}"
+        )
+        if self.guardrails.hbm.enabled:
+            # Same admission as an in-cycle compile: the predecessor's
+            # ceiling is not necessarily ours, and an adopted artifact
+            # that projects over the LIVE ceiling must pause the solve,
+            # not OOM the chip.  (A deserialized executable that
+            # exposes no memory_analysis is admitted, like any such.)
+            admitted, projected = self.guardrails.hbm.admit(
+                exe, label=label
+            )
+            if not admitted:
+                self._growth_refused[key] = (label, float(projected or 0.0))
+                return None
+        self._compiled_shapes[key] = exe
+        self.compile_stats["adopted"] += 1
+        metrics.compile_artifacts_adopted.inc()
+        trace.note_transition("compile-adopted", label=label)
+        logging.info(
+            "compile artifact ADOPTED for %s — zero inline compile "
+            "(bank: %s)", label, getattr(bank, "dir", "?"),
+        )
+        return exe
+
+    def _noblock_budget(self, key: tuple) -> float | None:
+        """Seconds this cycle may wait on compilation before degrading
+        to the last compiled bucket, or None when it must block inline
+        (no budget configured, or nothing compiled yet to fall back
+        to — a cold start has no degraded mode to offer)."""
+        if self.compile_budget_s is None:
+            return None
+        serving = self._serving_key
+        if (
+            serving is None
+            or serving == key
+            or serving[0] != key[0]  # fallback belongs to an old policy
+            or serving not in self._compiled_shapes
+        ):
+            return None
+        return max(float(self.compile_budget_s), 0.0)
+
+    def _update_compile_gauges(self) -> None:
+        metrics.compile_inflight.set(float(len(self._growth_inflight)))
+        metrics.warm_queue_depth.set(float(len(self._growth_queue)))
+
+    def _compile_key_background(self, key, snap, state, cycle, done,
+                                req_cycle: int) -> None:
+        """No-block deferral body: compile on this background thread,
+        admit, publish, bank — the same pipeline `_drain_growth_queue`
+        runs for prewarms, for a bucket that arrived before any
+        prewarm could cover it."""
+        try:
+            started = time.monotonic()
+            with trace.span("compile", cycle=req_cycle,
+                            where="noblock-deferred"):
+                exe = cycle.lower(snap, state).compile()
+            if self._cycle is not cycle:
+                return  # conf swapped mid-compile: discard
+            label = (
+                f"deferred T={int(snap.num_tasks)}"
+                f"×N={int(snap.num_nodes)}"
+            )
+            if self.guardrails.hbm.enabled:
+                admitted, projected = self.guardrails.hbm.admit(
+                    exe, label=label
+                )
+                if not admitted:
+                    self._growth_refused[key] = (
+                        label, float(projected or 0.0)
+                    )
+                    return
+            self._compiled_shapes[key] = exe
+            self.compile_stats["background"] += 1
+            metrics.compile_background_total.inc()
+            self._bank_put(key, exe)
+            logging.info(
+                "no-block compile finished for %s in %.1fs — full "
+                "service resumes next cycle", label,
+                time.monotonic() - started,
+            )
+        except Exception:  # noqa: BLE001 — deterministic compile
+            # errors must not respawn every cycle forever; the cycle
+            # keeps serving degraded and the error is loud.
+            logging.exception("no-block deferred compile failed")
+            self._growth_failed.add(key)
+        finally:
+            self._growth_inflight.pop(key, None)
+            self._update_compile_gauges()
+            done.set()
+
     def _ensure_compiled(self, snap, state):
-        """AOT-compile the fused cycle for `snap`'s shapes before its
-        first execution: the compile becomes an explicit, logged,
-        separately-attributable step, and the persistent compile cache
-        is written even if the first dispatch never completes.
+        """The executable serving `snap`'s shapes — resolved down a
+        degrade-don't-block ladder (doc/design/compile-artifacts.md):
+
+        1. already compiled this process → run it;
+        2. in the ARTIFACT BANK → deserialize + admit + run it (zero
+           compile — the failover/restart path);
+        3. absent, with a no-block budget and a fallback program →
+           hand the compile to a background thread, wait at most the
+           budget, then return COMPILE_PENDING (the cycle serves the
+           last compiled bucket, overflow rows wait);
+        4. absent, no budget/fallback → compile inline (the cold-start
+           cost the bank and `make warm` exist to remove), then bank
+           the result.
+
+        Every path records: inline compiles are the cliff this
+        subsystem kills, so they are counted, traced and loud.
 
         Measured caveat (2026-07-30, tunneled v5e, flagship 65k-task ×
         8k-node shapes): XLA:TPU compile time is wildly program-
@@ -509,6 +714,7 @@ class Scheduler:
         once-per-shape cost; flagship deployments should prefer the
         full-pipeline conf, which is also what BASELINE config 5
         exercises."""
+        self._last_compile_wait_s = 0.0
         key = self._shape_key(self._cycle, snap)
         if self._pin_blocks(key) is not None:
             # The snapshot crossed into a bucket whose program the
@@ -519,6 +725,32 @@ class Scheduler:
             return None
         exe = self._compiled_shapes.get(key)
         if exe is None:
+            exe = self._adopt_banked(key, snap)
+            if exe is None and self._pin_blocks(key) is not None:
+                return None  # adoption measured it over the ceiling
+        if exe is None:
+            exe = self._compile_or_defer(key, snap, state)
+        if exe is not None and exe is not COMPILE_PENDING:
+            self._serving_key = key
+        return exe
+
+    def _compile_or_defer(self, key, snap, state):
+        """The compile-needed tail of _ensure_compiled: join/steal the
+        growth machinery's in-flight work, defer to a background
+        thread under the no-block budget, or compile inline."""
+        budget = self._noblock_budget(key)
+        if budget is not None and key in self._growth_failed:
+            # A deterministic compile failure is permanent until the
+            # next conf swap (the growth worker's rule): keep serving
+            # degraded instead of respawning the failing compile on a
+            # fresh background thread every cycle.
+            return COMPILE_PENDING
+        waited = time.monotonic()
+        # One budget covers the WHOLE ladder: joining an in-flight
+        # warm and then falling back to a deferred compile must not
+        # stack two full waits.
+        deadline = None if budget is None else waited + budget
+        try:
             # A growth warm may already be compiling exactly this
             # shape: join it instead of racing a duplicate compile
             # (same wall-clock wait, half the compile work, and no
@@ -544,12 +776,21 @@ class Scheduler:
                     ]
                     mine = threading.Event()
                     self._growth_inflight[key] = mine
+                    self._update_compile_gauges()
             if inflight is not None:
-                logging.info(
-                    "cycle shapes are mid-growth-prewarm; joining the "
-                    "in-flight compile"
-                )
-                inflight.wait()
+                if budget is not None:
+                    # No-block ladder: wait out the budget, then serve
+                    # degraded — the in-flight warm keeps compiling.
+                    if not inflight.wait(
+                        max(0.0, deadline - time.monotonic())
+                    ):
+                        return COMPILE_PENDING
+                else:
+                    logging.info(
+                        "cycle shapes are mid-growth-prewarm; joining "
+                        "the in-flight compile"
+                    )
+                    inflight.wait()
                 # The warm may have failed; fall through to compile
                 # inline if it never published.
                 exe = self._compiled_shapes.get(key)
@@ -561,13 +802,50 @@ class Scheduler:
                     # inline would block the cycle for the same
                     # multi-minute compile only to be refused again.
                     return None
+                if budget is not None and key in self._growth_failed:
+                    # The warm we joined finished by FAILING: the
+                    # error is already loud and permanent — serve
+                    # degraded, don't respawn the same compile.
+                    return COMPILE_PENDING
                 with self._growth_lock:
                     mine = threading.Event()
                     self._growth_inflight[key] = mine
+                    self._update_compile_gauges()
+            if budget is not None:
+                # Degrade-don't-block: the compile runs on a
+                # background thread; this cycle waits at most the
+                # budget before serving the last compiled bucket.
+                trace.note_transition(
+                    "compile-start", where="noblock-deferred",
+                    tasks=int(snap.num_tasks), nodes=int(snap.num_nodes),
+                )
+                threading.Thread(
+                    target=self._compile_key_background,
+                    args=(key, snap, state, self._cycle, mine,
+                          trace.current_cycle()),
+                    name="cycle-compile", daemon=True,
+                ).start()
+                if not mine.wait(max(0.0, deadline - time.monotonic())):
+                    return COMPILE_PENDING
+                exe = self._compiled_shapes.get(key)
+                if exe is not None:
+                    return exe
+                if self._pin_blocks(key) is not None:
+                    return None
+                # Compiled-and-failed within the budget: degrade (the
+                # error is already loud in the background thread).
+                return COMPILE_PENDING
             try:
                 started = time.monotonic()
-                exe = self._cycle.lower(snap, state).compile()
+                trace.note_transition(
+                    "compile-start", where="inline",
+                    tasks=int(snap.num_tasks), nodes=int(snap.num_nodes),
+                )
+                with trace.span("compile", where="inline"):
+                    exe = self._cycle.lower(snap, state).compile()
                 took = time.monotonic() - started
+                self.compile_stats["inline"] += 1
+                metrics.compile_inline_total.inc()
                 if took > 1.0:
                     logging.info(
                         "fused cycle compiled for new shapes in %.1fs",
@@ -594,10 +872,14 @@ class Scheduler:
                         )
                         return None
                 self._compiled_shapes[key] = exe
+                self._bank_put(key, exe)
             finally:
                 self._growth_inflight.pop(key, None)
+                self._update_compile_gauges()
                 mine.set()
-        return exe
+            return exe
+        finally:
+            self._last_compile_wait_s = time.monotonic() - waited
 
     #: A dim whose real count exceeds this fraction of its padding
     #: bucket triggers the growth prewarm.
@@ -765,6 +1047,14 @@ class Scheduler:
             # Wholesale replace: pending entries predicted from older
             # snapshots are stale the moment a boundary moved.
             self._growth_queue[:] = fresh
+            # Attribute each queued warm to the cycle that staged it:
+            # the worker's compile span then lands in THIS cycle's
+            # Perfetto track — background compiles used to be
+            # invisible in the very view that explains slow cycles.
+            req = trace.current_cycle()
+            for e in fresh:
+                self._compile_req_cycle[e[0]] = req
+            self._update_compile_gauges()
             if not fresh or self._growth_worker_running:
                 return
             self._growth_worker_running = True
@@ -809,24 +1099,38 @@ class Scheduler:
                 # queued or inflight, never in the gap between.
                 done = threading.Event()
                 self._growth_inflight[key] = done
+                self._update_compile_gauges()
             if (
                 key in self._compiled_shapes
                 or key in self._growth_failed
                 or self._cycle is not cycle
             ):
                 self._growth_inflight.pop(key, None)
+                self._update_compile_gauges()
                 done.set()
                 continue
             try:
                 started = time.monotonic()
-                exe = cycle.lower(
-                    gsnap, jax.eval_shape(init_state, gsnap)
-                ).compile()
+                req_cycle = self._compile_req_cycle.get(
+                    key, trace.current_cycle()
+                )
+                trace.note_transition(
+                    "compile-start", where="growth-prewarm",
+                    cycle=req_cycle, label=str(label),
+                )
+                with trace.span("compile", cycle=req_cycle,
+                                where="growth-prewarm",
+                                label=str(label)):
+                    exe = cycle.lower(
+                        gsnap, jax.eval_shape(init_state, gsnap)
+                    ).compile()
+                metrics.compile_background_total.inc()
                 # The conf may have hot-swapped mid-warm; only publish
                 # into the policy this warm started under.
                 if self._cycle is cycle:
                     if self._admit_growth(key, exe, label):
                         self._compiled_shapes[key] = exe
+                        self._bank_put(key, exe)
                         logging.info(
                             "growth prewarm: next bucket %s compiled "
                             "in %.1fs", label,
@@ -844,6 +1148,7 @@ class Scheduler:
                 self._growth_failed.add(key)
             finally:
                 self._growth_inflight.pop(key, None)
+                self._update_compile_gauges()
                 done.set()
 
     def _admit_growth(self, key: tuple, exe, label) -> bool:
@@ -897,6 +1202,7 @@ class Scheduler:
         exe = cycle.lower(gsnap, jax.eval_shape(init_state, gsnap)).compile()
         if self._admit_growth(key, exe, label=grow):
             self._compiled_shapes[key] = exe
+            self._bank_put(key, exe)
             return True
         return False
 
@@ -961,25 +1267,148 @@ class Scheduler:
         if any(natural[d] < padded[d] for d in natural):
             self.packer._dirty.mark_full("hbm-shrink")
 
+    # -- no-block compile ladder: the degraded cycle --------------------
+    def _compile_pending_cycle(self, ssn: Session) -> None:
+        """The snapshot's bucket has no compiled program yet and the
+        compile is running in the BACKGROUND (no-block budget
+        exceeded): serve the LAST compiled bucket instead — rows that
+        fit it schedule normally; overflow rows are held Pending under
+        a loud `CompilePending` event (mirroring the HbmCeilingBlocked
+        pause/self-resume discipline: the worst case is degraded
+        throughput, never a frozen cycle).  Self-resumes the cycle
+        after the background compile publishes.  When no safe clamp to
+        the serving bucket exists (node or vocab dims moved too), the
+        whole solve pauses for the cycle — still bounded, still
+        loud."""
+        self._compile_pending_now = True
+        self.compile_stats["pending_cycles"] += 1
+        metrics.compile_pending_cycles.inc()
+        served = self._serve_last_bucket(ssn)
+        mode = (
+            "serving the last compiled bucket; overflow rows wait"
+            if served else
+            "no safe clamp to the serving bucket; solve paused this "
+            "cycle (placed work keeps running)"
+        )
+        logging.warning(
+            "cycle bucket still COMPILING in the background "
+            "(no-block budget %.2fs exceeded): %s.  Full service "
+            "resumes when the compile publishes; pre-warm the bank "
+            "(`make warm`, doc/design/compile-artifacts.md) to avoid "
+            "this window entirely", self.compile_budget_s or 0.0, mode,
+        )
+        self.cache.record_event(
+            "Scheduler", "compile-ladder", "CompilePending",
+            f"bucket T={int(ssn.snap.num_tasks)}"
+            f"×N={int(ssn.snap.num_nodes)} still compiling in the "
+            f"background; {mode}",
+        )
+        trace.note_transition(
+            "compile-pending", served_degraded=bool(served),
+            tasks=int(ssn.snap.num_tasks),
+            nodes=int(ssn.snap.num_nodes),
+        )
+        # Per-pod story: overflow pods this cycle read "cycle waited
+        # on compilation" from the cycle context (quiesced/hbm-style);
+        # the decision log's cycle summary carries compile_pending.
+
+    def _serve_last_bucket(self, ssn: Session) -> bool:
+        """Run the last compiled bucket's executable over a CLAMPED
+        view of this cycle's snapshot.  Safe only when the serving
+        shapes differ from the current pack in shrinkable TASK/JOB
+        axes alone (same nodes, same vocabularies) and every kept task
+        references a kept job — anything else returns False and the
+        cycle pauses instead.  Kept rows solve normally; overflow rows
+        keep their pre-solve state (the pad in _run_exe)."""
+        import dataclasses as _dc
+
+        serving = self._serving_key
+        if serving is None or serving[0] != id(self._cycle):
+            return False
+        exe = self._compiled_shapes.get(serving)
+        if exe is None:
+            return False
+        from kube_batch_tpu.cache.packer import snapshot_dim_axes
+
+        axes = snapshot_dim_axes()
+        target = {name: tuple(shape) for name, shape in serving[1:]}
+        snap = ssn.snap
+        t_old = j_old = None
+        for f in _dc.fields(snap):
+            cur = tuple(getattr(snap, f.name).shape)
+            tgt = target.get(f.name)
+            if tgt is None or len(tgt) != len(cur):
+                return False
+            dim_map = axes.get(f.name, {})
+            for i, (c, t) in enumerate(zip(cur, tgt)):
+                if c == t:
+                    continue
+                if dim_map.get(i) not in ("T", "J") or t > c:
+                    # A node or vocabulary axis moved (or the serving
+                    # bucket is LARGER): no safe clamp.
+                    return False
+            if f.name == "task_state":
+                t_old = tgt[0]
+            if f.name == "job_mask":
+                j_old = tgt[0]
+        if t_old is None or j_old is None:
+            return False
+        task_job = ssn.host_snap_field("task_job")
+        if np.any(np.asarray(task_job[:t_old]) >= j_old):
+            # A kept task references a job row beyond the clamp —
+            # slicing would misindex; pause instead.
+            return False
+        clamped = snap.replace(**{
+            f.name: getattr(snap, f.name)[
+                tuple(slice(0, d) for d in target[f.name])
+            ]
+            for f in _dc.fields(snap)
+            if tuple(getattr(snap, f.name).shape) != target[f.name]
+        })
+        st = ssn.state
+        clamped_state = st.replace(
+            task_state=st.task_state[:t_old],
+            task_node=st.task_node[:t_old],
+        )
+        self._run_exe(
+            ssn, exe, clamped, clamped_state,
+            pad=(int(snap.num_tasks), int(snap.num_jobs)),
+        )
+        return True
+
     def _execute_fused(self, ssn: Session) -> None:
         """One device dispatch for the whole action pipeline, then commit
         evictions per action on the host (see actions/fused.py).  A
         None from _ensure_compiled means the shapes need a ceiling-
-        refused program: the solve pauses for this cycle instead."""
-        import jax
-
-        from kube_batch_tpu.actions.preempt import commit_victim_indices
-
+        refused program: the solve pauses for this cycle instead.
+        COMPILE_PENDING means the needed bucket is still compiling in
+        the background: the cycle serves the last compiled bucket with
+        overflow rows held Pending (doc/design/compile-artifacts.md)."""
         exe = self._ensure_compiled(ssn.snap, ssn.state)
         if exe is None:
             self._hbm_blocked_cycle(ssn)
             return
+        if exe is COMPILE_PENDING:
+            self._compile_pending_cycle(ssn)
+            return
         self.guardrails.note_hbm_block(False)
+        self._run_exe(ssn, exe, ssn.snap, ssn.state)
+
+    def _run_exe(self, ssn: Session, exe, snap, state, pad=None) -> None:
+        """Dispatch one compiled cycle over (snap, state) and land its
+        results in the session.  `pad` (the no-block ladder's degraded
+        serve) is (T_full, J_full): the executable ran on a CLAMPED
+        snapshot, so the host results are padded back to the session's
+        full dims — overflow task rows keep their pre-solve state
+        (Pending rows wait; placed rows stay placed), overflow jobs
+        read not-ready."""
+        import jax
+
+        from kube_batch_tpu.actions.preempt import commit_victim_indices
+
         with metrics.action_latency.time("fused"), trace.span("solve"):
             with metrics.cycle_phase_latency.time("dispatch"):
-                state, evict_payload, job_ready, diag = exe(
-                    ssn.snap, ssn.state
-                )
+                state, evict_payload, job_ready, diag = exe(snap, state)
             ssn.state = state
             # ONE batched D2H for everything the host will read this
             # cycle: device_get starts every leaf's copy asynchronously
@@ -1012,6 +1441,31 @@ class Scheduler:
                          state.task_state, state.task_node, job_ready,
                          evict_payload,
                      ))
+            if pad is not None:
+                # Overflow rows (beyond the clamped bucket) were
+                # invisible to this solve: they keep their PRE-solve
+                # state/node — Pending rows stay Pending, placed rows
+                # stay accounted (their usage is already baked into
+                # node_idle) — and overflow jobs read not-ready so the
+                # gang gate cannot dispatch what was never solved.
+                t_full, j_full = pad
+                init_state_full = ssn.initial_task_state
+                init_node_full = ssn.host_snap_field("task_node")
+                t_old = host_state.shape[0]
+                host_state = np.concatenate([
+                    np.asarray(host_state),
+                    np.asarray(init_state_full[t_old:t_full]),
+                ])
+                host_node = np.concatenate([
+                    np.asarray(host_node),
+                    np.asarray(init_node_full[t_old:t_full]),
+                ])
+                host_ready = np.concatenate([
+                    np.asarray(host_ready),
+                    np.zeros(j_full - np.asarray(host_ready).shape[0],
+                             dtype=bool),
+                ])
+                diag = None  # clamped shapes; diagnosis is skipped
             ssn.set_host_final(host_state, host_node)
             ssn.set_job_ready(host_ready)
             ssn.set_diagnosis(diag)
@@ -1125,6 +1579,8 @@ class Scheduler:
         self.guardrails.pre_cycle()
         started = time.monotonic()
         self._cycle_quiesced = False
+        self._compile_pending_now = False
+        self._last_compile_wait_s = 0.0
         # Always-on observability (kube_batch_tpu/trace/): open this
         # cycle's span tree + stamp for the flight recorder.  A None
         # tracer (tracing disabled) keeps every trace call below a
@@ -1151,6 +1607,11 @@ class Scheduler:
             # quiesced skips too: the breaker's open window is exactly
             # the state a crash must not erase.
             self.journal_state()
+            # /healthz compile-pressure fields (compile_inflight +
+            # warm_queue_depth): refreshed once per cycle — a stall in
+            # the compile service is visible to probes and post-mortems
+            # without scraping /metrics.
+            self._update_compile_gauges()
             if not self._cycle_quiesced:
                 # Quiesced skips (mid-relist, breaker open) return in
                 # microseconds and are NOT evidence of health: feeding
@@ -1193,6 +1654,10 @@ class Scheduler:
                 "rung": self.guardrails.rung,
                 "breaker": self.guardrails.breaker_state(),
                 "hbm_blocked": self.guardrails.hbm_blocked,
+                "compile_pending": self._compile_pending_now,
+                "compile_wait_ms": round(
+                    self._last_compile_wait_s * 1e3, 3
+                ),
             }
             if ssn is not None:
                 summary["pending"] = int(np.sum(
@@ -1290,6 +1755,11 @@ class Scheduler:
                 ssn, diagnose=not (
                     self.guardrails.skip_diagnosis()
                     or self.guardrails.hbm_blocked
+                    # A degraded (compile-pending) cycle must not
+                    # diagnose: diagnose_pending would dispatch a
+                    # device program at the very shapes whose compile
+                    # we are deliberately not waiting for.
+                    or self._compile_pending_now
                 )
             )
             self._last_snap = ssn.snap  # shapes for the next conf prewarm

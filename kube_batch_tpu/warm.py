@@ -42,9 +42,26 @@ ACTION_VARIANTS: tuple[tuple[str, ...], ...] = (
 
 
 def warm_one(config_n: int, actions: tuple[str, ...],
-             conf_path: str | None) -> dict:
+             conf_path: str | None,
+             artifacts_dir: str | None = None) -> dict:
     """Child-process body: build the world + policy, AOT-compile the
-    fused cycle (writing the persistent cache), report timing."""
+    fused cycle (writing the persistent cache), report timing.
+
+    With `artifacts_dir` (or `KB_TPU_COMPILE_ARTIFACTS_DIR`) the
+    compiled executable is ALSO serialized into the AOT artifact bank
+    (doc/design/compile-artifacts.md) — the same bank the daemon
+    populates and adopts from, so an operator pre-warm covers the
+    daemon's cold start, a failover successor, and the bench alike.
+    Caveat: only a FRESH compile is bankable — an executable replayed
+    from the persistent XLA cache loses its AOT symbol table on the
+    load path, so a re-warm over a warm cache banks nothing (the
+    bank.put self-check refuses the unserializable blob and says so)."""
+    import os
+
+    if artifacts_dir is None:
+        artifacts_dir = os.environ.get(
+            "KB_TPU_COMPILE_ARTIFACTS_DIR"
+        ) or None
     from kube_batch_tpu.compile_cache import enable_compile_cache
 
     cache_dir = enable_compile_cache()
@@ -84,14 +101,27 @@ def warm_one(config_n: int, actions: tuple[str, ...],
     ))
     state = init_state(snap)
     t0 = time.monotonic()
-    cycle.lower(snap, state).compile()
-    return {
+    exe = cycle.lower(snap, state).compile()
+    out = {
         "config": config_n,
         "actions": list(actions),
         "compile_s": round(time.monotonic() - t0, 1),
         "cache_dir": cache_dir,
         "device": jax.devices()[0].platform,
     }
+    if artifacts_dir:
+        from kube_batch_tpu.compile_cache import ArtifactBank, conf_digest
+
+        shapes = tuple(
+            (f.name, tuple(getattr(snap, f.name).shape))
+            for f in dataclasses.fields(snap)
+        )
+        bank = ArtifactBank(artifacts_dir)
+        out["banked"] = bank.put(
+            conf_digest(conf, compact), shapes, exe
+        )
+        out["artifacts_dir"] = bank.dir
+    return out
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -110,6 +140,14 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--timeout", type=float, default=1500.0,
                    help="per-compile subprocess timeout in seconds "
                         "(generous: the slow variants are the point)")
+    p.add_argument("--compile-artifacts-dir", default=None,
+                   help="ALSO serialize every freshly-compiled program "
+                        "into the AOT artifact bank at this directory "
+                        "(doc/design/compile-artifacts.md) — the same "
+                        "bank the daemon adopts from at startup/"
+                        "failover (default: env "
+                        "KB_TPU_COMPILE_ARTIFACTS_DIR; unset = "
+                        "persistent XLA cache only)")
     p.add_argument("--_one", default=None, help=argparse.SUPPRESS)
     args = p.parse_args(argv)
 
@@ -117,19 +155,26 @@ def main(argv: list[str] | None = None) -> int:
         spec = json.loads(args._one)
         try:
             out = warm_one(spec["config"], tuple(spec["actions"]),
-                           spec.get("conf"))
+                           spec.get("conf"),
+                           spec.get("artifacts_dir"))
         except Exception as exc:  # noqa: BLE001 — report, don't crash
             out = {"error": f"{type(exc).__name__}: {exc}"}
         print(json.dumps(out))
         return 0 if "error" not in out else 1
 
     shapes = [int(c) for c in args.shape_configs.split(",") if c.strip()]
+    import os
+
+    artifacts_dir = args.compile_artifacts_dir or os.environ.get(
+        "KB_TPU_COMPILE_ARTIFACTS_DIR"
+    ) or None
     results = []
     for n in shapes:
         for actions in ACTION_VARIANTS:
             spec = json.dumps({
                 "config": n, "actions": list(actions),
                 "conf": args.scheduler_conf,
+                "artifacts_dir": artifacts_dir,
             })
             label = f"config {n} × {','.join(actions)}"
             print(f"[warm] {label}: compiling (subprocess, "
@@ -159,7 +204,9 @@ def main(argv: list[str] | None = None) -> int:
             print(f"[warm] {label}: {r}", flush=True)
     failed = [r for r in results if "error" in r]
     print(json.dumps({"warmed": len(results) - len(failed),
-                      "failed": len(failed), "results": results}))
+                      "failed": len(failed),
+                      "banked": sum(1 for r in results if r.get("banked")),
+                      "results": results}))
     return 1 if failed else 0
 
 

@@ -17,7 +17,7 @@ def main() -> int:
     assert isinstance(artifact, dict), artifact
 
     for key in ("first_cycle_ms", "e2e_cycle_ms_p50", "commit_pipeline",
-                "ingest_compare", "trace_overhead"):
+                "ingest_compare", "trace_overhead", "compile_artifacts"):
         assert key in artifact, (
             f"artifact missing {key!r}; keys: {sorted(artifact)}"
         )
@@ -29,6 +29,15 @@ def main() -> int:
     tro = artifact["trace_overhead"]
     assert "error" not in tro, tro
     assert "overhead_pct" in tro, tro
+
+    # Presence + sanity only: the >=5x warm-adopt gate lives in
+    # scripts/check_compile_artifacts.py (make verify); the smoke pins
+    # that every artifact RECORDS the warm-adopt vs cold numbers and
+    # that the adopted executable computed the same cycle.
+    art = artifact["compile_artifacts"]
+    assert "error" not in art, art
+    assert art.get("speedup", 0) > 0, art
+    assert art.get("output_mismatches", 1) == 0, art
 
     ing = artifact["ingest_compare"]
     assert "error" not in ing, ing
@@ -56,7 +65,8 @@ def main() -> int:
         f"{artifact['e2e_cycle_ms_p50']}ms, pipelined commit "
         f"{speedup}x vs sync at {cmp_.get('rtt_ms')}ms RTT, ingest "
         f"storm {ing.get('storm_speedup')}x / relist "
-        f"{ing.get('relist_speedup')}x vs per-event"
+        f"{ing.get('relist_speedup')}x vs per-event, warm artifact "
+        f"adopt {art.get('speedup')}x vs cold compile"
     )
     return 0
 

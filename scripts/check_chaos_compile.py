@@ -1,0 +1,106 @@
+#!/usr/bin/env python
+"""Assert the compile-cliff artifact-bank chaos acceptance criteria
+over two same-seed runs plus a bank-off parity run (make chaos;
+doc/design/compile-artifacts.md):
+
+* both bank-on runs completed with zero invariant violations and
+  converged;
+* bucket growth was actually exercised: the pre-crash leader compiled
+  (and BANKED) >= 2 distinct fused-cycle programs, and every one of
+  them reached the cluster-side mirror (putCompileArtifact);
+* the crash-restart successor adopted its predecessor's executables —
+  in peer mode (compile_bank=2) the local bank was WIPED at the
+  crash, so adoption must have come through the getCompileArtifact
+  wire mirror — and recorded ZERO inline compiles;
+* no post-crash cycle spent more than the engine's
+  cycle-blocked-on-compile budget inside compilation (the successor
+  never paid the compile cliff live);
+* same seed ⇒ same trace hash across the two bank-on runs, AND the
+  bank-OFF run reproduces the identical hash: adopting a serialized
+  artifact and compiling the same program fresh must be
+  decision-invisible.
+"""
+
+import json
+import sys
+
+
+def main(path_a: str, path_b: str, path_off: str | None = None) -> int:
+    with open(path_a, encoding="utf-8") as f:
+        a = json.load(f)
+    with open(path_b, encoding="utf-8") as f:
+        b = json.load(f)
+    for name, run in (("run1", a), ("run2", b)):
+        assert run["ok"], f"{name} violations: {run['violations']}"
+        assert run["converged_after_drain_ticks"] is not None, \
+            f"{name}: never converged"
+        c = run["compile"]
+        assert c is not None, f"{name}: no compile summary"
+        assert c["totals"].get("banked", 0) >= 2, (
+            f"{name}: only {c['totals'].get('banked', 0)} program(s) "
+            f"banked — bucket growth not exercised: {c}"
+        )
+        assert c["mirrored_entries"] >= 2, (
+            f"{name}: cluster-side mirror holds "
+            f"{c['mirrored_entries']} entr(ies) — putCompileArtifact "
+            f"never fanned out: {c}"
+        )
+        post = c["post_restart"] or {}
+        assert post.get("inline", 0) == 0, (
+            f"{name}: the successor compiled inline instead of "
+            f"adopting: {c}"
+        )
+        assert post.get("adopted", 0) >= 1, (
+            f"{name}: the successor adopted nothing: {c}"
+        )
+        if c["mode"] == 2:
+            assert c["peer_adopted"] >= 1, (
+                f"{name}: peer mode but nothing came through the "
+                f"wire mirror: {c}"
+            )
+        assert c["max_post_restart_compile_wait_s"] <= 1.0, (
+            f"{name}: a post-crash cycle blocked "
+            f"{c['max_post_restart_compile_wait_s']}s on compilation: "
+            f"{c}"
+        )
+        r = run["restart"]
+        assert r is not None and r["restarts"] >= 1, r
+        commit = run["commit"]
+        if commit.get("mode") == "pipelined":
+            assert commit["depth"] == 0, f"{name} undrained: {commit}"
+            assert commit["order_violations"] == 0, commit
+            assert commit["flush_errors"] == 0, commit
+    assert a["trace_hash"] == b["trace_hash"], (
+        f"same-seed compile-bank runs diverged: "
+        f"{a['trace_hash']} != {b['trace_hash']}"
+    )
+    parity = ""
+    if path_off is not None:
+        with open(path_off, encoding="utf-8") as f:
+            off = json.load(f)
+        assert off["ok"], f"bank-off run violations: {off['violations']}"
+        assert off.get("compile") is None, (
+            f"bank-off run still ran the bank: {off.get('compile')}"
+        )
+        assert off["trace_hash"] == a["trace_hash"], (
+            "--compile-bank off diverged from the bank-on runs at the "
+            f"same seed — the artifact bank changed a scheduling "
+            f"decision: {off['trace_hash']} != {a['trace_hash']}"
+        )
+        parity = " (and with --compile-bank off)"
+    c = a["compile"]
+    print(
+        "chaos compile: ok — same-seed hash "
+        f"{a['trace_hash'][:16]}… reproduced{parity}; "
+        f"{c['totals']['banked']} program(s) banked pre-crash, "
+        f"{c['mirrored_entries']} mirrored, successor peer-adopted "
+        f"{c['peer_adopted']} and served with 0 inline compiles "
+        f"(worst post-crash compile wait "
+        f"{c['max_post_restart_compile_wait_s']}s)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1], sys.argv[2],
+                  sys.argv[3] if len(sys.argv) > 3 else None))

@@ -1,0 +1,217 @@
+#!/usr/bin/env python
+"""make verify's warm-adopt vs cold-compile gate (config-3 scale, CPU).
+
+The AOT artifact bank (doc/design/compile-artifacts.md) exists to turn
+a failover successor's cold start from "recompile every fused-cycle
+program live while the fleet waits" into "deserialize the
+predecessor's executables".  This gate measures exactly that, on the
+production path at config-3 scale:
+
+* **cold** — one fresh `lower().compile()` of the fused cycle, with
+  the persistent XLA cache NOT enabled (a successor on a new host has
+  no cache — that is the failover scenario the bank covers);
+* **warm** — adopting the same program from the bank through a FRESH
+  `ArtifactBank` instance (a restarted process): the full
+  validate-header → CRC → deserialize-and-load chain `_adopt_banked`
+  runs, best-of-N;
+
+and requires warm-adopt >= GATE (5x) faster.  The margin is
+deliberately huge in practice (compiles cost seconds-to-minutes,
+deserializes cost milliseconds) so the gate only fires when adoption
+is genuinely broken — e.g. a silent fall-through to recompile, or a
+validation chain that re-lowers.
+
+The adopted executable is also RUN and compared against the cold
+executable's output, so the gate would catch an adoption path that
+loads fast but computes garbage.
+
+Exports `measure_adoption` for bench.py, which records the same
+measurement in every daemon artifact (`compile_artifacts` section) so
+the gate's number and the artifact's number can never diverge in
+method.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+# Runnable as `python scripts/check_compile_artifacts.py` from the
+# repo root (the Makefile's invocation): put the repo on the path.
+# (The CPU default is pinned in the __main__ block only — bench.py
+# loads this module IN-PROCESS, where mutating JAX_PLATFORMS or the
+# pinned platform would silently flip the rest of the bench run to
+# CPU.)
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+GATE = 5.0
+#: Warm-adopt repeats (best-of: the first deserialize may page code
+#: in; the steady number is what a failover successor's 2nd..Nth
+#: program adoption pays).
+ADOPT_ROUNDS = 3
+REMEASURES = 1
+
+
+def measure_adoption(config: int = 3) -> dict:
+    """{cold_compile_s, warm_adopt_s, speedup, ...} — one fresh
+    fused-cycle compile vs adopting the banked serialization of the
+    same program (full validation chain, fresh bank instance).
+
+    The persistent XLA cache is disabled AROUND the measurement, not
+    assumed absent: the cold number must be a real compile (the
+    failover successor it models has no cache), and an executable
+    REPLAYED from the cache loses its AOT symbol table and cannot be
+    banked at all — bench.py calls this in-process with the cache
+    enabled."""
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    prev_cache_dir = getattr(jax.config, "jax_compilation_cache_dir", None)
+    jax.config.update("jax_compilation_cache_dir", None)
+    try:
+        return _measure_adoption_body(config)
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev_cache_dir)
+
+
+def _measure_adoption_body(config: int) -> dict:
+    import jax
+    import numpy as np
+
+    from kube_batch_tpu.actions import factory as _af  # noqa: F401
+    from kube_batch_tpu.actions.fused import make_cycle_solver
+    from kube_batch_tpu.cache.packer import pack_snapshot
+    from kube_batch_tpu.compile_cache import ArtifactBank, conf_digest
+    from kube_batch_tpu.framework.conf import default_conf
+    from kube_batch_tpu.framework.session import build_policy
+    from kube_batch_tpu.models.workloads import build_config
+    from kube_batch_tpu.ops.assignment import init_state
+    from kube_batch_tpu.plugins import factory as _pf  # noqa: F401
+
+    conf = default_conf()
+    cache, _sim = build_config(config)
+    snap, _meta = pack_snapshot(cache.snapshot())
+    policy, _plugins = build_policy(conf)
+    cycle = jax.jit(make_cycle_solver(
+        policy, conf.actions,
+        compact_wire=os.environ.get("KB_TPU_COMPACT_WIRE") == "1",
+    ))
+    import dataclasses
+
+    state = init_state(snap)
+    # The scheduler's bank key tail (Scheduler._shape_key minus the
+    # process-local cycle id): every snapshot field's shape, in field
+    # order.
+    shapes = [
+        (f.name, tuple(int(d) for d in getattr(snap, f.name).shape))
+        for f in dataclasses.fields(snap)
+    ]
+
+    # -- cold: what a successor with no bank pays, live ----------------
+    t0 = time.perf_counter()
+    exe = cycle.lower(snap, state).compile()
+    cold_s = time.perf_counter() - t0
+    reference = jax.device_get(exe(snap, state))
+
+    root = tempfile.mkdtemp(prefix="kb-artifact-gate-")
+    try:
+        bank = ArtifactBank(root)
+        digest = conf_digest(conf)
+        if not bank.put(digest, shapes, exe):
+            return {
+                "config": config,
+                "cold_compile_s": round(cold_s, 3),
+                "error": "executable not serializable on this backend "
+                         "(bank degraded; see compile_cache log)",
+            }
+        # -- warm: a restarted/failed-over process adopting ------------
+        warm_times = []
+        adopted = None
+        for _ in range(ADOPT_ROUNDS):
+            fresh = ArtifactBank(root)  # a new process's bank view
+            t0 = time.perf_counter()
+            adopted = fresh.get(digest, shapes)
+            warm_times.append(time.perf_counter() - t0)
+            if adopted is None:
+                return {
+                    "config": config,
+                    "cold_compile_s": round(cold_s, 3),
+                    "error": f"banked entry refused at read: "
+                             f"{fresh.rejects}",
+                }
+        warm_s = min(warm_times)
+        # The adopted executable must COMPUTE the same cycle, not just
+        # load fast.
+        check = jax.device_get(adopted(snap, state))
+        flat_a = jax.tree_util.tree_leaves(reference)
+        flat_b = jax.tree_util.tree_leaves(check)
+        mismatch = sum(
+            0 if np.array_equal(np.asarray(a), np.asarray(b)) else 1
+            for a, b in zip(flat_a, flat_b)
+        )
+        return {
+            "config": config,
+            "cold_compile_s": round(cold_s, 3),
+            "warm_adopt_s": round(warm_s, 4),
+            "warm_adopt_rounds_s": [round(t, 4) for t in warm_times],
+            "speedup": round(cold_s / max(warm_s, 1e-9), 1),
+            "entry_bytes": sum(
+                os.path.getsize(os.path.join(bank.dir, n))
+                for n in bank.entries()
+            ),
+            "output_mismatches": mismatch,
+        }
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if "--json" in argv:
+        # bench.py's mode: ONE measurement, result as a JSON line, no
+        # gate — run in a fresh subprocess because the bench process
+        # has replayed executables from the persistent cache, which
+        # poisons AOT serialization process-wide on this backend.
+        import json
+
+        config = 3
+        if "--config" in argv:
+            config = int(argv[argv.index("--config") + 1])
+        print(json.dumps(measure_adoption(config=config)))
+        return 0
+    result = None
+    for attempt in range(1 + REMEASURES):
+        result = measure_adoption()
+        ok = (
+            "error" not in result
+            and result["speedup"] >= GATE
+            and result["output_mismatches"] == 0
+        )
+        if ok:
+            print(
+                "compile artifacts: ok — cold compile "
+                f"{result['cold_compile_s']}s vs warm adopt "
+                f"{result['warm_adopt_s']}s = {result['speedup']}x "
+                f"(gate >={GATE:.0f}x), adopted output identical "
+                f"({result['entry_bytes']} bytes banked)"
+            )
+            return 0
+        print(f"compile artifacts: attempt {attempt + 1} failed: "
+              f"{result}", file=sys.stderr)
+    print(
+        f"compile artifacts: FAIL after {1 + REMEASURES} attempts — "
+        f"warm adoption is not >= {GATE:.0f}x faster than a cold "
+        f"compile (or the adopted executable diverged): {result}",
+        file=sys.stderr,
+    )
+    return 1
+
+
+if __name__ == "__main__":
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")  # before any jax import
+    sys.exit(main())

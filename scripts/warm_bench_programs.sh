@@ -14,7 +14,11 @@
 #
 # Env knobs: PYTHON (interpreter, default python3), WARM_BENCH_LOG
 # (log path, default /tmp/warm_bench.log), WARM_BENCH_TIMEOUT
-# (per-child seconds, default 2700).
+# (per-child seconds, default 2700), KB_TPU_COMPILE_ARTIFACTS_DIR
+# (set = every freshly-compiled program is ALSO serialized into the
+# AOT artifact bank there — the same bank the daemon adopts from at
+# startup/failover, doc/design/compile-artifacts.md; children inherit
+# the env var, so warm.py banks each child's compile).
 set -euo pipefail
 cd "$(dirname "$0")/.." || {
   echo "warm_bench_programs.sh: cannot cd to repo root" >&2

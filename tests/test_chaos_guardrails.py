@@ -114,6 +114,7 @@ def test_replayed_trace_meta_restores_guardrail_fault_spec():
             "flaky_flap_every": 4, "flaky_drain_budget": 0,
             "crash_restart_at": 0, "crash_restarts": 1,
             "crash_restart_every": 8, "hbm_pin_at": 0,
+            "compile_bank": 0,
             "storm_at": 0, "storm_ticks": 6, "storm_events": 60}
     eng = ChaosEngine(seed=11, ticks=32, events=[meta])
     for field in _META_FAULT_FIELDS:

@@ -1,0 +1,593 @@
+"""AOT compile-artifact bank + no-block compile ladder
+(kube_batch_tpu/compile_cache.py · ArtifactBank; scheduler.py ·
+_ensure_compiled; doc/design/compile-artifacts.md).
+
+Key-integrity discipline under test (the statestore's refused-vN
+lesson applied to executables): a host-fingerprint mismatch, conf
+digest mismatch, truncated/bit-flipped file, or FUTURE-versioned
+entry must all degrade to "compile fresh" with a counted refusal —
+never load a foreign executable, never crash, and never destroy a
+newer binary's entry.  Plus: the wire mirror roundtrip (fenced put /
+unfenced get, bounded), the guarded write seam, the scheduler's
+zero-inline-compile adoption path, and the degrade-don't-block
+ladder's CompilePending cycle.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import shutil
+import subprocess
+import sys
+import time
+import zlib
+
+import pytest
+
+from kube_batch_tpu import metrics
+from kube_batch_tpu.compile_cache import (
+    ARTIFACT_VERSION,
+    ArtifactBank,
+    adopt_artifacts,
+    canonical_shapes,
+    conf_digest,
+    host_fingerprint,
+)
+from kube_batch_tpu.models.workloads import build_config
+from kube_batch_tpu.scheduler import Scheduler
+
+
+# -- shared compiled world: ONE fused-cycle compile for the module ------
+
+@contextlib.contextmanager
+def fresh_compiles():
+    """Serialization needs a FRESH compile: an executable replayed
+    from the persistent XLA cache (tests/conftest.py enables one
+    suite-wide) loses its AOT symbol table on the load path and
+    cannot be banked — exactly why the chaos CLI disables the cache
+    for compile-bank scenarios."""
+    import jax
+
+    jax.config.update("jax_enable_compilation_cache", False)
+    try:
+        yield
+    finally:
+        jax.config.update("jax_enable_compilation_cache", True)
+
+
+def unique_conf(tmp_dir, max_rounds: int) -> str:
+    """A conf file whose compiled program NO other test (or prior
+    suite run) compiles: allocate.max_rounds bakes a distinct loop
+    bound into the HLO.  Disabling the persistent cache is not enough
+    on its own — when an EARLIER test file in the same process
+    compiled the identical default program with the cache enabled
+    (a replay, deserialized via cpu_aot_loader), jax's process-level
+    compilation dedupe hands that same unserializable executable to a
+    later `lower().compile()` of the same HLO, cache flag or not.
+    A unique program sidesteps every layer; compiled only under
+    fresh_compiles, it is never written to the persistent cache
+    either.  Placements are unaffected (the cap is far above the
+    rounds these tiny worlds need)."""
+    path = os.path.join(str(tmp_dir), "scheduler.conf")
+    with open(path, "w", encoding="utf-8") as f:
+        f.write('actions: "allocate, backfill"\n'
+                "arguments:\n"
+                f"  allocate.max_rounds: {max_rounds}\n")
+    return path
+
+
+#: Child body for `banked_world`: compile + bank in a PRISTINE
+#: process.  In the full suite, executables REPLAYED by earlier test
+#: files from the suite-wide persistent XLA cache poison serialization
+#: process-wide on this backend ("Symbols not found" from the AOT
+#: loader's shared JIT state — observed behind the chaos-engine file
+#: even for a program no other test compiles), while DESERIALIZING a
+#: banked entry works in any process.  So the one put() this module
+#: depends on runs where nothing has ever replayed; every test here
+#: exercises the read/adopt side in-process.
+_BANK_CHILD = """
+import json, sys
+import jax
+jax.config.update("jax_platforms", "cpu")  # sitecustomize pins axon
+root, conf_path = sys.argv[1], sys.argv[2]
+from kube_batch_tpu.compile_cache import ArtifactBank
+from kube_batch_tpu.models.workloads import build_config
+from kube_batch_tpu.scheduler import Scheduler
+cache, sim = build_config(1)
+bank = ArtifactBank(root)
+s = Scheduler(cache, conf_path=conf_path, schedule_period=0.0,
+              compile_bank=bank)
+assert s.run_once() is not None and len(sim.binds) == 8
+assert s.compile_stats["inline"] == 1
+assert s.compile_stats["banked"] == 1, (
+    "fused-cycle executable did not serialize: " + str(s.compile_stats))
+assert len(bank.entries()) == 1
+print(json.dumps({
+    "digest": s._conf_digest,
+    "shapes": [[n, list(d)] for n, d in s._serving_key[1:]],
+    "binds": len(sim.binds),
+}))
+"""
+
+
+@pytest.fixture(scope="module")
+def banked_world(tmp_path_factory):
+    """A config-1 world whose fused-cycle executable a pristine
+    subprocess compiled and banked: (bank_root, digest, shapes,
+    conf_path, binds)."""
+    root = str(tmp_path_factory.mktemp("bank"))
+    conf_path = unique_conf(tmp_path_factory.mktemp("conf"), 61)
+    out = subprocess.run(
+        [sys.executable, "-c", _BANK_CHILD, root, conf_path],
+        capture_output=True, text=True, timeout=600,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert out.returncode == 0, (out.stdout, out.stderr)
+    info = json.loads(out.stdout.strip().splitlines()[-1])
+    shapes = canonical_shapes(
+        (n, tuple(d)) for n, d in info["shapes"]
+    )
+    return root, info["digest"], shapes, conf_path, info["binds"]
+
+
+def _copy_bank(root: str, dst: str) -> str:
+    """A pristine copy of the bank at `root` under dst/bank (mutation
+    playground for the integrity tests)."""
+    out = os.path.join(dst, "bank")
+    shutil.copytree(root, out)
+    return out
+
+
+def _entry_path(bank: ArtifactBank) -> str:
+    names = bank.entries()
+    assert len(names) == 1
+    return os.path.join(bank.dir, names[0])
+
+
+def _rewrite_header(path: str, **patch) -> None:
+    with open(path, "rb") as f:
+        raw = f.read()
+    nl = raw.find(b"\n")
+    header = json.loads(raw[:nl])
+    header.update(patch)
+    with open(path, "wb") as f:
+        f.write(json.dumps(header, sort_keys=True).encode())
+        f.write(b"\n")
+        f.write(raw[nl + 1:])
+
+
+# -- key integrity: every refusal degrades to a counted miss ------------
+
+def test_bank_put_get_roundtrip_across_instances(banked_world, tmp_path):
+    root, digest, shapes, _s, _binds = banked_world
+    fresh = ArtifactBank(root)          # a new process's bank view
+    exe = fresh.get(digest, shapes)
+    assert exe is not None
+    assert fresh.hits == 1 and fresh.rejects == {}
+    # Unknown keys are plain misses (no refusal counted).
+    assert fresh.get("0" * 16, shapes) is None
+    assert fresh.rejects == {}
+
+
+def test_host_fingerprint_mismatch_refuses(banked_world, tmp_path):
+    root, digest, shapes, _s, _b = banked_world
+    bank = ArtifactBank(_copy_bank(root, str(tmp_path)))
+    _rewrite_header(_entry_path(bank), host="hw-deadbeef0000")
+    before = metrics.compile_artifact_rejected.value("host")
+    assert bank.get(digest, shapes) is None
+    assert bank.rejects == {"host": 1}
+    assert metrics.compile_artifact_rejected.value("host") == before + 1
+
+
+def test_conf_digest_and_shape_key_mismatch_refuse(banked_world,
+                                                   tmp_path):
+    root, digest, shapes, _s, _b = banked_world
+    bank = ArtifactBank(_copy_bank(root, str(tmp_path)))
+    path = _entry_path(bank)
+    _rewrite_header(path, conf="f" * 16)
+    assert bank.get(digest, shapes) is None
+    _rewrite_header(path, conf=digest,
+                    shapes=[["task_state", [9999]]])
+    assert bank.get(digest, shapes) is None
+    assert bank.rejects == {"key": 2}
+
+
+def test_truncated_and_bitflipped_entries_refuse(banked_world, tmp_path):
+    root, digest, shapes, _s, _b = banked_world
+    bank = ArtifactBank(_copy_bank(root, str(tmp_path)))
+    path = _entry_path(bank)
+    with open(path, "rb") as f:
+        raw = f.read()
+    with open(path, "wb") as f:           # drop the payload tail
+        f.write(raw[: len(raw) - 64])
+    assert bank.get(digest, shapes) is None
+    assert bank.rejects == {"truncated": 1}
+    flipped = bytearray(raw)
+    flipped[-10] ^= 0x40                  # bit-flip inside the payload
+    with open(path, "wb") as f:
+        f.write(bytes(flipped))
+    assert bank.get(digest, shapes) is None
+    assert bank.rejects == {"truncated": 1, "crc": 1}
+
+
+def test_future_version_refused_without_destruction(banked_world,
+                                                    tmp_path):
+    """A newer binary's entry (version rollback in flight) is refused
+    but NOT truncated/overwritten — the newer binary finds its
+    artifact intact when it returns (statestore refused-vN
+    discipline)."""
+    root, digest, shapes, _s, _b = banked_world
+    bank = ArtifactBank(_copy_bank(root, str(tmp_path)))
+    path = _entry_path(bank)
+    _rewrite_header(path, v=ARTIFACT_VERSION + 1)
+    with open(path, "rb") as f:
+        before = f.read()
+    assert bank.get(digest, shapes) is None
+    assert bank.rejects == {"version": 1}
+    with open(path, "rb") as f:
+        assert f.read() == before         # intact, byte for byte
+
+
+def test_garbage_header_and_undeserializable_blob_refuse(tmp_path):
+    """A corrupt header refuses pre-parse; a CRC-valid entry whose
+    payload is not a serialized executable refuses at deserialize —
+    both are counted misses, never a crash."""
+    bank = ArtifactBank(str(tmp_path))
+    shapes = canonical_shapes([("a", (2, 3))])
+    path = bank._path("c" * 16, shapes)
+    os.makedirs(bank.dir, exist_ok=True)
+    with open(path, "wb") as f:           # header line is not JSON
+        f.write(b"not-json\n" + b"blob")
+    assert bank.get("c" * 16, shapes) is None
+    assert bank.rejects == {"header": 1}
+    blob = b"valid-crc-but-garbage"
+    header = {
+        "magic": "kb-compile-artifact", "v": ARTIFACT_VERSION,
+        "host": bank.host, "conf": "c" * 16,
+        "shapes": [[n, list(s)] for n, s in shapes],
+        "size": len(blob), "crc": zlib.crc32(blob) & 0xFFFFFFFF,
+    }
+    with open(path, "wb") as f:
+        f.write(json.dumps(header).encode() + b"\n" + blob)
+    assert bank.get("c" * 16, shapes) is None
+    assert bank.rejects == {"header": 1, "deserialize": 1}
+
+
+# -- peer mirror payloads ----------------------------------------------
+
+def test_adopt_payloads_validates_every_leaf(banked_world, tmp_path):
+    root, digest, shapes, _s, _b = banked_world
+    src = ArtifactBank(root)
+    payloads = src.export_payloads()
+    assert len(payloads) == 1
+    dst = ArtifactBank(str(tmp_path))
+    # Junk shapes: none adopted, each refusal counted, no crash.
+    assert dst.adopt_payloads("not-a-list") == 0
+    assert dst.adopt_payloads([None, 7, {"no": "header"},
+                               {"header": {}, "data": "!!!"}]) == 0
+    assert dst.entries() == []
+    # Foreign-host entry: refused (never written locally).
+    foreign = json.loads(json.dumps(payloads[0]))
+    foreign["header"]["host"] = "hw-000000000000"
+    assert dst.adopt_payloads([foreign]) == 0
+    assert dst.entries() == []
+    # The real thing: adopted, then readable like a local entry.
+    assert dst.adopt_payloads(payloads) == 1
+    assert dst.get(digest, shapes) is not None
+
+
+def test_adopt_artifacts_local_first_peer_fills(banked_world, tmp_path):
+    root, digest, shapes, _s, _b = banked_world
+    src = ArtifactBank(root)
+    payloads = src.export_payloads()
+
+    class Peer:
+        def __init__(self, out):
+            self.out = out
+            self.calls = 0
+
+        def get_compile_artifact(self):
+            self.calls += 1
+            return self.out
+
+    # Local bank already holds the entry: the peer copy is filtered
+    # out (no pointless re-deserialize/rewrite).
+    peer = Peer(payloads)
+    assert adopt_artifacts(src, peer) == 0
+    # A blind successor adopts it from the peer mirror.
+    cold = ArtifactBank(str(tmp_path / "cold"))
+    assert adopt_artifacts(cold, peer) == 1
+    assert cold.get(digest, shapes) is not None
+    # A dead wire / cold mirror both mean "compile fresh".
+    class Dead:
+        def get_compile_artifact(self):
+            raise ConnectionError("wire down")
+
+    assert adopt_artifacts(ArtifactBank(str(tmp_path / "c2")), Dead()) == 0
+    assert adopt_artifacts(None, peer) == 0
+    assert adopt_artifacts(cold, None) == 0
+
+
+# -- wire mirror: fenced put, unfenced get, bounded ---------------------
+
+def test_wire_roundtrip_epoch_fenced_and_bounded():
+    import socket
+
+    from kube_batch_tpu.api.resource import ResourceSpec
+    from kube_batch_tpu.cache.cache import SchedulerCache
+    from kube_batch_tpu.client.adapter import (
+        StaleEpochError,
+        StreamBackend,
+        WatchAdapter,
+    )
+    from kube_batch_tpu.client.external import ExternalCluster
+
+    a, b = socket.socketpair()
+    cl_r = a.makefile("r", encoding="utf-8")
+    cl_w = a.makefile("w", encoding="utf-8")
+    sch_r = b.makefile("r", encoding="utf-8")
+    sch_w = b.makefile("w", encoding="utf-8")
+    cluster = ExternalCluster(cl_r, cl_w).start()
+    backend = StreamBackend(sch_w, timeout=5.0)
+    cache = SchedulerCache(spec=ResourceSpec(), binder=backend,
+                           evictor=backend, status_updater=backend)
+    adapter = WatchAdapter(cache, sch_r, backend=backend).start()
+    try:
+        epoch = backend.acquire_lease("h1", 60.0)
+        backend.set_epoch(epoch)
+        assert backend.get_compile_artifact() == []
+        entry = {"v": 1, "name": "e1.kbart",
+                 "header": {"host": "hw-x"}, "data": "QQ=="}
+        backend.put_compile_artifact(entry)
+        assert backend.get_compile_artifact() == [entry]
+        # Bounded FIFO: the oldest entry drops past the cap.
+        cap = ExternalCluster.COMPILE_ARTIFACTS_MAX
+        for i in range(cap):
+            backend.put_compile_artifact({"v": 1, "name": f"n{i}",
+                                          "data": ""})
+        got = backend.get_compile_artifact()
+        assert len(got) == cap
+        assert all(p["name"] != "e1.kbart" for p in got)  # evicted
+        # A deposed epoch's mirror write is rejected cluster-side.
+        with cluster._lock:
+            cluster.lease_epoch += 1
+        with pytest.raises(StaleEpochError):
+            backend.put_compile_artifact({"v": 1, "name": "zombie",
+                                          "data": ""})
+        assert all(p["name"] != "zombie"
+                   for p in backend.get_compile_artifact())
+        # The READ still serves a contender adopting before leading.
+        assert len(backend.get_compile_artifact()) == cap
+    finally:
+        import socket as _socket
+
+        # shutdown (not close): unblocks both read loops without
+        # contending for the file-object locks.
+        for s in (a, b):
+            try:
+                s.shutdown(_socket.SHUT_RDWR)
+            except OSError:
+                pass
+        adapter.join(2.0)
+
+
+def test_guarded_put_fails_fast_while_breaker_open():
+    from kube_batch_tpu.guardrails.breaker import (
+        Backoff,
+        BreakerOpen,
+        CircuitBreaker,
+        GuardedBackend,
+    )
+
+    class Inner:
+        def __init__(self):
+            self.calls = 0
+
+        def put_compile_artifact(self, payload):
+            self.calls += 1
+
+        def ping(self):
+            pass
+
+    inner = Inner()
+    br = CircuitBreaker(trip_after=1)
+    br.record_failure()
+    assert br.state == CircuitBreaker.OPEN
+    gb = GuardedBackend(inner, breaker=br, backoff=Backoff(attempts=2),
+                        sleep=lambda s: None)
+    with pytest.raises(BreakerOpen):
+        gb.put_compile_artifact({"v": 1})
+    assert inner.calls == 0               # zero wire touches while open
+
+
+# -- scheduler: warm adoption + the no-block ladder ---------------------
+
+def test_successor_adopts_banked_executable_zero_inline(banked_world):
+    """The failover/restart path end to end: a fresh scheduler over
+    the same world shapes + conf adopts its predecessor's banked
+    executable and serves with ZERO inline compiles."""
+    root, _digest, _shapes, conf_path, binds = banked_world
+    cache, sim = build_config(1)
+    successor = Scheduler(cache, conf_path=conf_path,
+                          schedule_period=0.0,
+                          compile_bank=ArtifactBank(root))
+    ssn = successor.run_once()
+    assert ssn is not None and len(sim.binds) == binds
+    assert successor.compile_stats["inline"] == 0
+    assert successor.compile_stats["adopted"] == 1
+
+
+def test_noblock_ladder_degrades_then_self_resumes(tmp_path):
+    """Bucket growth past the no-block budget: the cycle hands the
+    compile to a background thread, serves the LAST compiled bucket
+    (overflow rows held Pending under a loud CompilePending event),
+    and resumes full service once the compile publishes — the worst
+    case is degraded throughput, never a frozen cycle."""
+    from kube_batch_tpu.cache.cluster import PodGroup
+    from kube_batch_tpu.models.workloads import (
+        DEFAULT_SPEC,
+        GI,
+        _node,
+        _pod,
+    )
+    from kube_batch_tpu.sim.simulator import make_world
+
+    # A config-1-shaped world with HEADROOM (config 1 proper is
+    # CPU-full after its 8 binds — held rows could never schedule).
+    cache, sim = make_world(DEFAULT_SPEC)
+    for i in range(4):
+        sim.add_node(_node(f"n{i}", cpu_milli=16000, mem=32 * GI))
+    sim.submit(
+        PodGroup(name="pg1", queue="default", min_member=8),
+        [_pod(f"pg1-{i}", cpu=2000, mem=4 * GI) for i in range(8)],
+    )
+    s = Scheduler(cache, conf_path=unique_conf(tmp_path, 59),
+                  schedule_period=0.0, compile_budget_s=0.05)
+    with fresh_compiles():
+        # (fresh + unique program: a replayed/deduped compile can
+        # return inside the tiny budget and the deferral under test
+        # would never engage)
+        assert s.run_once() is not None       # cold start: inline (no
+        assert s.compile_stats["inline"] == 1  # fallback exists yet)
+        bound_before = len(sim.binds)
+        # Grow the task dim far past any prewarmed next bucket.
+        for i in range(40):
+            sim.submit(
+                PodGroup(name=f"burst-{i}", queue="", min_member=4),
+                [_pod(f"burst-{i}-{k}", cpu=10, mem=GI // 8)
+                 for k in range(4)],
+            )
+        t0 = time.perf_counter()
+        s.run_once()
+        degraded_wall = time.perf_counter() - t0
+        assert s.compile_stats["pending_cycles"] == 1
+        assert s._last_compile_wait_s <= 0.5  # never blocked on it
+        events = cache.events_for("Scheduler", "compile-ladder")
+        assert any(e.reason == "CompilePending" for e in events)
+        # The degraded cycle still returned promptly (the compile
+        # runs on a background thread whose wall is seconds).
+        assert degraded_wall < 5.0
+        # Self-resume: once the background compile publishes, the
+        # next cycle serves the full bucket and the held rows
+        # schedule.
+        deadline = time.monotonic() + 180.0
+        while (s.compile_stats["background"] == 0
+               and not s._growth_failed
+               and time.monotonic() < deadline):
+            time.sleep(0.1)
+    assert s.compile_stats["background"] == 1, (
+        f"background compile never published: {s.compile_stats}, "
+        f"failed={s._growth_failed}"
+    )
+    s.run_once()
+    assert s.compile_stats["pending_cycles"] == 1  # no longer degraded
+    assert len(sim.binds) > bound_before  # held rows scheduled
+
+
+# -- observability ------------------------------------------------------
+
+def test_compile_transitions_ride_ring_without_dumping(tmp_path):
+    """compile-start / compile-adopted / compile-pending are
+    SUBSYSTEM transitions for post-mortem context, not anomaly
+    triggers: they ride the flight-recorder ring without dumping."""
+    from kube_batch_tpu import trace
+    from kube_batch_tpu.trace.recorder import TRIGGERS
+
+    assert not TRIGGERS & {"compile-start", "compile-adopted",
+                           "compile-pending"}
+    t = trace.enable(dump_dir=str(tmp_path))
+    try:
+        trace.note_transition("compile-start", where="inline")
+        trace.note_transition("compile-adopted", label="T=64")
+        trace.note_transition("compile-pending", served_degraded=True)
+        assert len(t.recorder.dumps) == 0
+        kinds = [tr["kind"] for tr in t.recorder.transitions]
+        assert kinds == ["compile-start", "compile-adopted",
+                         "compile-pending"]
+    finally:
+        trace.disable()
+
+
+def test_healthz_exposes_compile_pressure():
+    metrics.compile_inflight.set(2.0)
+    metrics.warm_queue_depth.set(3.0)
+    try:
+        body = json.loads(metrics.health_body())
+        assert body["compile_inflight"] == 2
+        assert body["warm_queue_depth"] == 3
+    finally:
+        metrics.compile_inflight.set(0.0)
+        metrics.warm_queue_depth.set(0.0)
+
+
+# -- CLI wiring ---------------------------------------------------------
+
+def test_cli_budget_and_bank_resolution(tmp_path, monkeypatch):
+    from kube_batch_tpu.cli import (
+        build_compile_bank,
+        build_parser,
+        resolve_compile_budget,
+    )
+
+    p = build_parser()
+    # Default: one schedule period.
+    args = p.parse_args(["--schedule-period", "2.5"])
+    assert resolve_compile_budget(args) == 2.5
+    # 0 opts out (block inline, the pre-ladder behavior).
+    args = p.parse_args(["--compile-budget", "0"])
+    assert resolve_compile_budget(args) is None
+    # Env supplies the default only while the flag is untouched.
+    monkeypatch.setenv("KB_TPU_COMPILE_BUDGET", "7.5")
+    args = p.parse_args([])
+    assert resolve_compile_budget(args) == 7.5
+    args = p.parse_args(["--compile-budget", "3"])
+    assert resolve_compile_budget(args) == 3.0
+
+    # Bank: off → None; auto without any dir → None; auto + state-dir
+    # → next to the statestore journal; explicit dir wins; on with
+    # nowhere to put it → loud exit.
+    assert build_compile_bank(
+        p.parse_args(["--compile-artifacts", "off",
+                      "--state-dir", str(tmp_path)])) is None
+    assert build_compile_bank(p.parse_args([])) is None
+    bank = build_compile_bank(
+        p.parse_args(["--state-dir", str(tmp_path)]))
+    assert bank is not None
+    assert bank.dir.startswith(
+        os.path.join(str(tmp_path), "compile_artifacts"))
+    explicit = build_compile_bank(
+        p.parse_args(["--compile-artifacts-dir",
+                      str(tmp_path / "explicit")]))
+    assert explicit is not None
+    assert explicit.dir.startswith(str(tmp_path / "explicit"))
+    with pytest.raises(SystemExit):
+        build_compile_bank(p.parse_args(["--compile-artifacts", "on"]))
+
+
+# -- warm tool ----------------------------------------------------------
+
+@pytest.mark.slow  # one extra fused-cycle compile (subprocess-free)
+def test_warm_one_banks_into_artifact_dir(tmp_path, monkeypatch):
+    """`make warm` populates the SAME bank the daemon adopts from: a
+    fresh warm_one compile lands one validated bank entry (a replay
+    from a warm XLA cache would not serialize — so point the cache at
+    a fresh dir)."""
+    import jax
+
+    from kube_batch_tpu.warm import warm_one
+
+    monkeypatch.setenv("KB_TPU_COMPILE_CACHE", str(tmp_path / "xla"))
+    old_cache = jax.config.jax_compilation_cache_dir
+    try:
+        out = warm_one(1, ("allocate",), None,
+                       artifacts_dir=str(tmp_path / "bank"))
+    finally:
+        # warm_one re-points the process-global persistent cache;
+        # restore the suite's shared one.
+        jax.config.update("jax_compilation_cache_dir", old_cache)
+    assert out.get("banked") is True, out
+    bank = ArtifactBank(str(tmp_path / "bank"))
+    assert len(bank.entries()) == 1
+    assert out["artifacts_dir"] == bank.dir
