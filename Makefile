@@ -104,6 +104,17 @@ run-example:
 # (zero placements on pre-crash-cordoned nodes), refused-bucket-never-
 # recompiled, breaker-reopen-without-re-streak, journal compaction +
 # HA mirror exercised, and same seed ⇒ same hash across the two runs.
+# The cells runs are the MULTI-CELL scenario
+# (doc/design/multi-cell.md): TWO real schedulers — one per cell, each
+# with its own cache / cell-scoped adapter / cell-fenced backend —
+# against one cluster, under full and asymmetric partitions, cross-
+# cell zombie-write probes, and the wire-negotiated capacity reclaim
+# with a partition-straddling rollback; scripts/check_chaos_cells.py
+# asserts ≥1 cross-cell write rejected and 0 accepted, all three
+# partition shapes exercised, reclaim atomic-or-rolled-back, the
+# partitioned cell's peer unaffected, convergence across both cells,
+# and same seed ⇒ same hash across the two runs AND the
+# --ingest-mode event parity run.
 # The fifth and sixth runs are the FAILOVER scenario
 # (doc/design/failover-fencing.md): a leader crash mid-commit, a
 # second elector instance taking over at a higher epoch, a zombie-
@@ -186,6 +197,17 @@ chaos:
 	    --compile-bank off --quiet > /tmp/kb-chaos-compile-b.json
 	$(PY) scripts/check_chaos_compile.py /tmp/kb-chaos-compile-1.json \
 	    /tmp/kb-chaos-compile-2.json /tmp/kb-chaos-compile-b.json
+	JAX_PLATFORMS=cpu $(PY) -m kube_batch_tpu.chaos --seed 37 --ticks 26 \
+	    --scenario examples/chaos-cells.json \
+	    --quiet > /tmp/kb-chaos-cells-1.json
+	JAX_PLATFORMS=cpu $(PY) -m kube_batch_tpu.chaos --seed 37 --ticks 26 \
+	    --scenario examples/chaos-cells.json \
+	    --quiet > /tmp/kb-chaos-cells-2.json
+	JAX_PLATFORMS=cpu $(PY) -m kube_batch_tpu.chaos --seed 37 --ticks 26 \
+	    --scenario examples/chaos-cells.json \
+	    --ingest-mode event --quiet > /tmp/kb-chaos-cells-e.json
+	$(PY) scripts/check_chaos_cells.py /tmp/kb-chaos-cells-1.json \
+	    /tmp/kb-chaos-cells-2.json /tmp/kb-chaos-cells-e.json
 
 profile:
 	$(PY) -m kube_batch_tpu --workload 2 --cycles 3 --schedule-period 0 \

@@ -762,6 +762,21 @@ def _run_daemon_phases(jax, n, cache, sim, conf_path, steady_cycles) -> dict:
         out["compile_artifacts"] = {"error": str(exc)[:300]}
     emit_partial(compile_artifacts=out["compile_artifacts"])
 
+    # -- multi-cell aggregate (doc/design/multi-cell.md) ----------------
+    # Every daemon artifact records the 2-cell scale-out figure: two
+    # cell-fenced schedulers vs one ExternalCluster, aggregate pods/s
+    # against the single-cell baseline over the same capacity and
+    # arrival.  Cheap (a tiny world, seconds); a tight budget shrinks
+    # the window instead of skipping the section
+    # (scripts/check_bench_smoke.py presence-checks it).
+    try:
+        out["cells_aggregate"] = run_cells_aggregate(
+            cycles=5 if _budget_left() > 90.0 else 3
+        )
+    except Exception as exc:  # noqa: BLE001 — degrade, never die
+        out["cells_aggregate"] = {"error": str(exc)[:300]}
+    emit_partial(cells_aggregate=out["cells_aggregate"])
+
     # -- sustained-churn soak (VERDICT r4 next #7) ----------------------
     # Budget degradation ladder: full 50 cycles, then a shorter soak,
     # then skip only when there is genuinely nothing left — the
@@ -1096,6 +1111,159 @@ def run_commit_compare(cycles: int = 6, gang: int = 8,
         "sync_pods_bound": sync_bound,
         "pipelined_pods_bound": pipe_bound,
         "pipeline_stats": pipe_stats,
+    }
+
+
+def run_cells_aggregate(cells: int = 2, nodes_per_cell: int = 3,
+                        cycles: int = 5, gang: int = 6) -> dict:
+    """Multi-cell aggregate throughput vs the single-cell baseline
+    (doc/design/multi-cell.md), through the REAL wire stack: one
+    ExternalCluster, N cell-fenced scheduler stacks (cell-scoped
+    WatchAdapter + cell-stamped StreamBackend over a socketpair) vs
+    ONE uncelled scheduler over the same total capacity and the same
+    total arrival rate.  Each timed cycle lands one fresh gang per
+    cell; the wall includes the watch round trip (bind → MODIFIED
+    echo → ingest quiesce), so the number is end-to-end pods/s, not
+    solve-only.  Both sides run in one process driven serially — the
+    aggregate figure is per-cell cost isolation, not thread
+    parallelism."""
+    import socket as _socket
+
+    from kube_batch_tpu.api.resource import ResourceSpec
+    from kube_batch_tpu.cache.cache import SchedulerCache
+    from kube_batch_tpu.cache.cluster import Node, Pod, PodGroup, Queue
+    from kube_batch_tpu.client import (
+        ExternalCluster,
+        StreamBackend,
+        WatchAdapter,
+    )
+    from kube_batch_tpu.client.adapter import CELL_LABEL
+    from kube_batch_tpu.models.workloads import GI
+    from kube_batch_tpu.scheduler import Scheduler
+
+    spec = ResourceSpec()
+
+    def build(n_cells: int) -> tuple:
+        """(cluster, [per-cell scheduler stacks], [sockets])."""
+        cluster = ExternalCluster().start()
+        names = [f"bc-{i}" for i in range(n_cells)]
+        for ci, cell in enumerate(names):
+            cluster.add_queue(Queue(
+                name=f"{cell}-q", cell=cell if n_cells > 1 else "",
+                uid=f"uid-q-{cell}",
+            ))
+            for k in range(nodes_per_cell * (cells // n_cells)):
+                labels = {CELL_LABEL: cell} if n_cells > 1 else {}
+                cluster.add_node(Node(
+                    name=f"{cell}-n{k}", labels=labels,
+                    allocatable={"cpu": 16000.0, "memory": 64 * GI,
+                                 "pods": 110.0},
+                    uid=f"uid-n-{cell}-{k}",
+                ))
+        stacks, socks = [], []
+        for cell in names:
+            a, b = _socket.socketpair()
+            cl_r = a.makefile("r", encoding="utf-8")
+            cl_w = a.makefile("w", encoding="utf-8")
+            cluster.attach(cl_r, cl_w)
+            cluster.replay(cl_w)
+            backend = StreamBackend(
+                b.makefile("w", encoding="utf-8"), timeout=10.0,
+            )
+            if n_cells > 1:
+                backend.set_cell(cell)
+            cache = SchedulerCache(
+                spec, binder=backend, evictor=backend,
+                status_updater=backend,
+            )
+            adapter = WatchAdapter(
+                cache, b.makefile("r", encoding="utf-8"),
+                backend=backend,
+                cell=cell if n_cells > 1 else None,
+            ).start()
+            assert adapter.wait_for_sync(10.0)
+            stacks.append((cell, cache, adapter,
+                           Scheduler(cache, schedule_period=0.0)))
+            socks.extend((a, b))
+        return cluster, names, stacks, socks
+
+    def quiesce(cluster, adapter, deadline_s: float = 30.0) -> None:
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < deadline_s:
+            with cluster._lock:
+                rv = cluster._rv
+            if adapter.synced.is_set() and adapter.latest_rv >= rv:
+                return
+            time.sleep(0.001)
+        # Loud, not silent: a lagging ingest would otherwise skew the
+        # bound counts between modes and fail the bench-smoke equality
+        # gate opaquely — raising here routes through the section's
+        # degrade-to-"error" path instead.
+        raise TimeoutError(
+            f"cells-aggregate ingest quiesce timed out after "
+            f"{deadline_s:.0f}s (adapter rv {adapter.latest_rv} < "
+            f"cluster rv {rv})"
+        )
+
+    def submit(cluster, cell: str, tag: str) -> None:
+        group = f"{cell}-{tag}"
+        cluster.submit(
+            PodGroup(name=group, queue=f"{cell}-q", min_member=gang,
+                     uid=f"uid-pg-{group}"),
+            [Pod(name=f"{group}-{k}", uid=f"uid-{group}-{k}",
+                 group=group,
+                 request={"cpu": 250.0, "memory": GI / 2, "pods": 1.0})
+             for k in range(gang)],
+        )
+
+    def one_mode(n_cells: int) -> tuple[float, int]:
+        cluster, names, stacks, socks = build(n_cells)
+        try:
+            # Warmup: pay each scheduler's fused-cycle compile outside
+            # the timed window.
+            for cell, _cache, adapter, sched in stacks:
+                submit(cluster, cell, "warm")
+                quiesce(cluster, adapter)
+                sched.run_once()
+                quiesce(cluster, adapter)
+            bound0 = len(cluster.binds)
+            t0 = time.perf_counter()
+            for i in range(cycles):
+                # One fresh gang per CELL of the fleet per cycle —
+                # the single-cell baseline absorbs the same total
+                # arrival in its one solve.
+                for cell in names:
+                    for j in range(cells // n_cells):
+                        submit(cluster, cell, f"s{i}-{j}")
+                for cell, _cache, adapter, sched in stacks:
+                    quiesce(cluster, adapter)
+                    sched.run_once()
+                for _cell, _cache, adapter, _s in stacks:
+                    quiesce(cluster, adapter)
+            wall = time.perf_counter() - t0
+            return wall, len(cluster.binds) - bound0
+        finally:
+            for s in socks:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+
+    single_wall, single_bound = one_mode(1)
+    multi_wall, multi_bound = one_mode(cells)
+    single_pps = single_bound / single_wall if single_wall > 0 else 0.0
+    multi_pps = multi_bound / multi_wall if multi_wall > 0 else 0.0
+    return {
+        "cells": cells,
+        "nodes_per_cell": nodes_per_cell,
+        "cycles": cycles,
+        "gang": gang,
+        "single_pods_bound": single_bound,
+        "aggregate_pods_bound": multi_bound,
+        "single_pods_per_s": round(single_pps, 1),
+        "aggregate_pods_per_s": round(multi_pps, 1),
+        "scaling": round(multi_pps / single_pps, 2)
+        if single_pps > 0 else None,
     }
 
 

@@ -222,10 +222,17 @@ class PodGroup:
 
 @dataclasses.dataclass
 class Queue:
-    """Weighted fair-share queue (≙ v1alpha1 Queue CRD)."""
+    """Weighted fair-share queue (≙ v1alpha1 Queue CRD).
+
+    `cell` partitions the fleet for multi-cell scale-out
+    (doc/design/multi-cell.md): a queue's PodGroups — and their pods
+    — belong to its cell, are watched only by that cell's scheduler,
+    and are writable only under that cell's epoch lease.  "" = shared
+    (the classic single-fleet deploy)."""
 
     name: str
     weight: float = 1.0
+    cell: str = ""
     uid: str = dataclasses.field(default_factory=lambda: _new_uid("queue"))
 
 

@@ -111,6 +111,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "executable and compiling it fresh are the "
                         "same program, so the same seed must hash "
                         "identically either way (make chaos pins it)")
+    p.add_argument("--cells", type=int, default=0,
+                   help="multi-cell mode (doc/design/multi-cell.md): "
+                        "drive N REAL schedulers — one per cell, each "
+                        "with its own cache/adapter/fenced backend — "
+                        "against one cluster, with partition faults "
+                        "(full / asymmetric / straddling-reclaim), "
+                        "cross-cell zombie probes and the wire-"
+                        "negotiated capacity reclaim.  0 (default) = "
+                        "the classic single-scheduler engine; a "
+                        "scenario JSON with a 'cells' section implies "
+                        "this mode")
     p.add_argument("--quiet", action="store_true",
                    help="suppress progress logging; print only the "
                         "summary JSON")
@@ -118,16 +129,17 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def _load_scenario(path: str) -> tuple:
-    """(events, workload_spec, fault_spec) from --scenario."""
+    """(events, workload_spec, fault_spec, cell_spec, cell_workloads)
+    from --scenario."""
     if path.endswith(".jsonl"):
-        return read_trace(path), None, None
+        return read_trace(path), None, None, None, None
     with open(path, "r", encoding="utf-8") as f:
         raw = json.load(f)
-    unknown = set(raw) - {"workload", "faults"}
+    unknown = set(raw) - {"workload", "faults", "cells", "cell_workloads"}
     if unknown:
         raise SystemExit(
             f"--scenario {path}: unknown sections {sorted(unknown)} "
-            "(known: ['workload', 'faults'])"
+            "(known: ['workload', 'faults', 'cells', 'cell_workloads'])"
         )
 
     def _build(cls, section):
@@ -146,10 +158,14 @@ def _load_scenario(path: str) -> tuple:
         }
         return cls(**coerced)
 
+    from kube_batch_tpu.chaos.cells import CellFaultSpec
+
     return (
         None,
         _build(ScenarioSpec, raw.get("workload", {})),
         _build(FaultSpec, raw.get("faults", {})),
+        _build(CellFaultSpec, raw["cells"]) if "cells" in raw else None,
+        raw.get("cell_workloads"),
     )
 
 
@@ -163,8 +179,67 @@ def main(argv: list[str] | None = None) -> int:
 
     honor_jax_platforms()
     events, scenario, faults = (None, None, None)
+    cell_spec, cell_workloads = None, None
     if args.scenario:
-        events, scenario, faults = _load_scenario(args.scenario)
+        events, scenario, faults, cell_spec, cell_workloads = \
+            _load_scenario(args.scenario)
+
+    if args.cells or cell_spec is not None:
+        # Multi-cell mode: N real schedulers against one cluster
+        # (doc/design/multi-cell.md).  Its own engine — the classic
+        # flags that make no sense here (--wire-commit, --corrupt-tick,
+        # trace replay) are refused rather than silently ignored.
+        import dataclasses as _dc
+
+        from kube_batch_tpu.chaos.cells import (
+            CellChaosEngine,
+            CellFaultSpec,
+        )
+
+        if events is not None:
+            raise SystemExit("--cells does not replay .jsonl traces")
+        unsupported = [
+            flag for flag, hit in (
+                ("--wire-commit", args.wire_commit is not None),
+                ("--corrupt-tick", args.corrupt_tick is not None),
+                ("--trace-out", args.trace_out is not None),
+                ("--pack-mode", args.pack_mode is not None),
+                ("--compile-bank", args.compile_bank != "auto"),
+                ("--no-faults", args.no_faults),
+            ) if hit
+        ]
+        if unsupported:
+            raise SystemExit(
+                f"cells mode does not support {', '.join(unsupported)} "
+                "(the cells engine runs sync commits with its own "
+                "fault family; see doc/design/multi-cell.md)"
+            )
+        spec = cell_spec or CellFaultSpec()
+        if args.cells:
+            spec = _dc.replace(spec, cells=args.cells)
+        from kube_batch_tpu.compile_cache import enable_compile_cache
+
+        enable_compile_cache()
+        engine = CellChaosEngine(
+            seed=args.seed or 0,
+            ticks=args.ticks,
+            scenario=scenario,
+            cell_workloads=cell_workloads,
+            cell_faults=spec,
+            conf_path=args.scheduler_conf,
+            record=args.record,
+            drain=args.drain,
+            dump_dir=args.dump_dir,
+            ingest_mode=args.ingest_mode,
+            trace_obs=args.trace_obs,
+        )
+        try:
+            result = engine.run()
+        except ChaosEngineError as exc:
+            logging.error("chaos-cells harness failed: %s", exc)
+            return 2
+        print(json.dumps(result.summary(), indent=1, sort_keys=True))
+        return 0 if result.ok else 1
 
     if args.no_faults:
         faults = FaultSpec.none()

@@ -1,0 +1,1330 @@
+"""Multi-cell chaos: N REAL schedulers, one fleet, partitions injected.
+
+The capstone scenario of doc/design/multi-cell.md: the fleet is
+partitioned into cells (nodes/queues carry a cell assignment), each
+cell runs a FULL scheduler stack — its own SchedulerCache, cell-scoped
+WatchAdapter, cell-fenced StreamBackend, Guardrails and Scheduler —
+against ONE ChaosCellCluster, generalizing PR 4's
+restart-as-second-elector machinery into N live concurrent
+incarnations in one process.  The engine drives them tick by tick in
+strict cell order (which is what keeps a two-writer threaded wire
+stack deterministic: same seed ⇒ same trace hash), and injects the
+fault class a single-writer fleet can never see:
+
+* **cross-cell zombie writes** — a cell-A scheduler attempts a bind
+  onto a cell-B node, once through the raw wire (the CLUSTER's
+  cell-scope fence must reject it with the structured ``CellScope``
+  code) and once through the normal bind seam (the CLIENT's local
+  cell fence must fail it without burning the RTT);
+* **full partition** — one cell loses ALL verbs and all watch
+  broadcasts: its scheduler stands down, the PEER cell must keep
+  placing (partitioned-cell-peer-unaffected), and after heal the dark
+  cell resumes its watch from the missed tail and re-converges with
+  zero double-binds across the boundary;
+* **asymmetric partition** — the half-open network case: the watch
+  stays LIVE but every write is black-holed, so the cell's wire
+  breaker must trip against a peer it can still see, quiesce, and
+  heal through the half-open probe once the partition lifts;
+* **partition-straddling reclaim** — a starved cell's capacity claim
+  is pending when its donor goes dark: the claim must time out and
+  roll back (reclaim-atomic-or-rolled-back — no node ever leaks into
+  limbo), and the re-claim after heal must land.
+
+Cross-cell reclaim itself runs through the wire protocol's
+negotiation verbs (claimCapacity / offerCapacity / listClaims,
+client/external.py): the starved cell claims, the donor cell's OWN
+scheduler gang-atomically evicts the fullest-empty node's residents
+through its normal evict seam and offers the freed node, and the
+cluster re-cells it atomically — no writer ever touches another
+cell's state.
+
+Engine invariants (on top of the classic per-tick checker, whose
+epoch replay is per-cell here): no-cross-cell-write-accepted,
+single-writer-per-cell-epoch, reclaim-atomic-or-rolled-back,
+partitioned-cell-peer-unaffected, and convergence-after-heal across
+both cells.  `make chaos` runs `examples/chaos-cells.json` twice plus
+an --ingest-mode event parity run through
+scripts/check_chaos_cells.py.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import json
+import logging
+import os
+import socket
+import tempfile
+import time
+import types
+
+from kube_batch_tpu import metrics, scope
+from kube_batch_tpu import trace as trace_obs_mod
+from kube_batch_tpu.api.resource import ResourceSpec
+from kube_batch_tpu.api.types import TaskStatus
+from kube_batch_tpu.cache.cache import SchedulerCache
+from kube_batch_tpu.chaos.engine import (
+    GUARDRAIL_ENGAGE_AFTER,
+    GUARDRAIL_RECOVER_AFTER,
+    GUARDRAIL_RESET_TICKS,
+    GUARDRAIL_TRIP_AFTER,
+    GUARDRAIL_WATCHDOG_PERIOD,
+    ChaosEngineError,
+    FlightRecorder,
+)
+from kube_batch_tpu.chaos.faults import ChaosCluster
+from kube_batch_tpu.chaos.invariants import InvariantChecker, Violation
+from kube_batch_tpu.chaos.workload import (
+    ScenarioSpec,
+    apply_to_cluster,
+    generate,
+    trace_hash,
+)
+from kube_batch_tpu.client.adapter import (
+    CELL_LABEL,
+    CellScopeError,
+    StreamBackend,
+    WatchAdapter,
+    resume_session,
+)
+from kube_batch_tpu.scheduler import Scheduler
+
+log = logging.getLogger(__name__)
+
+GI = float(1 << 30)
+LEASE_TTL = 1e9  # ticks are the only clock; partitions break renewals
+#: Wire round-trip timeout while an asymmetric partition is
+#: configured: a black-holed bind must fail in seconds (same rationale
+#: as the classic engine's BLACKHOLE_WIRE_TIMEOUT).
+ASYM_WIRE_TIMEOUT = 1.5
+
+
+@dataclasses.dataclass(frozen=True)
+class CellFaultSpec:
+    """The cells scenario's fault schedule + reclaim/starvation knobs
+    (examples/chaos-cells.json · "cells" section)."""
+
+    #: Number of cells (each gets a full scheduler stack).
+    cells: int = 2
+    #: Full partition window: the victim cell loses every verb AND all
+    #: watch broadcasts.  0 disables.
+    full_partition_at: int = 0
+    full_partition_ticks: int = 4
+    full_partition_cell: int = 1   # index into sorted cell names
+    #: Asymmetric partition window: watch live, writes black-holed —
+    #: the victim's breaker must trip with a live peer.  0 disables.
+    asym_partition_at: int = 0
+    asym_partition_ticks: int = 3
+    asym_partition_cell: int = 1
+    #: Cross-cell zombie-write probes: at `xcell_probe_at` and every
+    #: `xcell_probe_every` ticks after, a live cell attempts a bind
+    #: onto a foreign node (cluster fence) and through its bind seam
+    #: (local fence).  Every one must be rejected.  0 disables.
+    xcell_probe_at: int = 2
+    xcell_probe_every: int = 8
+    #: Deterministic starvation: at `starve_at` one all-or-nothing
+    #: gang lands in `starve_cell` sized past that cell's whole
+    #: capacity, forcing the reclaim negotiation.  0 pods disables.
+    starve_at: int = 0
+    starve_pods: int = 0
+    starve_cell: int = 0
+    starve_cpu_milli: float = 4000.0
+    starve_mem_gi: float = 2.0
+    #: Structural-starvation trigger: a cell claims once its pending
+    #: demand has exceeded its TOTAL capacity for this many ticks.
+    reclaim_after_ticks: int = 2
+    #: Claim TTL in ticks: a donor that never answers (partition!)
+    #: rolls the claim back at created + ttl.
+    reclaim_ttl_ticks: int = 3
+    #: Straddle window: a FULL partition of the DONOR cell timed to
+    #: strand a pending claim — it must roll back, then the re-claim
+    #: after heal must land.  0 disables.
+    straddle_at: int = 0
+    straddle_ticks: int = 4
+
+    @property
+    def donor_cell_default(self) -> int:
+        """The straddle partitions the donor of `starve_cell`'s
+        claims: the first OTHER cell in sorted order."""
+        return 1 if self.starve_cell == 0 else 0
+
+
+def cellify(events: list[dict], cell: str) -> list[dict]:
+    """Stamp one cell's identity onto a generated event schedule:
+    queues/nodes get cell-prefixed names plus the cell assignment
+    (queues as a first-class field, nodes via the `cell` label);
+    submits follow their renamed queue.  Gang/pod identities are
+    already unique per cell (the generator keys them on the derived
+    per-cell seed)."""
+    out = []
+    for e in events:
+        e = json.loads(json.dumps(e))  # deep, shared-nothing copy
+        op = e["op"]
+        if op == "add-queue":
+            e["name"] = f"{cell}-{e['name']}"
+            e["cell"] = cell
+        elif op == "add-node":
+            node = e["node"]
+            node["name"] = f"{cell}-{node['name']}"
+            node["uid"] = f"uid-node-{node['name']}"
+            node.setdefault("labels", {})[CELL_LABEL] = cell
+        elif op == "remove-node":
+            e["name"] = f"{cell}-{e['name']}"
+        elif op == "submit":
+            e["queue"] = f"{cell}-{e.get('queue', 'default')}"
+        out.append(e)
+    return out
+
+
+def plan_cell_faults(spec: CellFaultSpec, cell_names: list[str],
+                     ticks: int) -> list[dict]:
+    """The cells fault schedule, trace-event shaped (rides the hash
+    like the classic plan)."""
+    events: list[dict] = []
+
+    def window(kind: str, cell: str, at: int, dur: int,
+               origin: str | None = None) -> None:
+        ev: dict = {"tick": at, "op": "fault", "kind": kind,
+                    "cell": cell}
+        if origin:
+            ev["origin"] = origin
+        events.append(ev)
+        events.append({"tick": at + dur, "op": "fault",
+                       "kind": "cell-heal", "cell": cell})
+
+    if spec.full_partition_at:
+        window("cell-partition-full",
+               cell_names[spec.full_partition_cell % len(cell_names)],
+               spec.full_partition_at, spec.full_partition_ticks)
+    if spec.asym_partition_at:
+        window("cell-partition-asym",
+               cell_names[spec.asym_partition_cell % len(cell_names)],
+               spec.asym_partition_at, spec.asym_partition_ticks)
+    if spec.straddle_at:
+        # The straddle is a full partition of the DONOR, timed to
+        # strand a pending capacity claim.  Its window is deliberately
+        # NOT subject to the peer-unaffected check: the peer here is
+        # the STARVED cell, whose whole point is that it cannot place
+        # until the reclaim lands.
+        window("cell-partition-full",
+               cell_names[spec.donor_cell_default % len(cell_names)],
+               spec.straddle_at, spec.straddle_ticks,
+               origin="straddle")
+    if spec.xcell_probe_at:
+        t = spec.xcell_probe_at
+        while t < ticks:
+            events.append({"tick": t, "op": "fault",
+                           "kind": "xcell-probe"})
+            t += max(spec.xcell_probe_every, 1)
+    events.sort(key=lambda e: e["tick"])
+    return events
+
+
+class ChaosCellCluster(ChaosCluster):
+    """ChaosCluster + the partition fault family: per-cell verb
+    swallowing and broadcast suppression, toggled by the engine.  The
+    socket stays up throughout — a partition is silence, not a
+    hangup."""
+
+    RECLAIM_VERBS = frozenset({
+        "claimCapacity", "offerCapacity", "listClaims",
+    })
+
+    def __init__(self, **kwargs) -> None:
+        super().__init__(**kwargs)
+        #: Cells currently FULLY partitioned: every request from their
+        #: sessions is swallowed and no broadcast reaches them.
+        self.full_partitioned: set[str] = set()
+        #: Cells in the ASYMMETRIC (half-open) state: watch and lease
+        #: verbs live, data-plane writes + reclaim verbs + the ping
+        #: probe swallowed.
+        self.asym_partitioned: set[str] = set()
+        self.partition_swallowed = 0
+
+    def _session_blocked(self, writer) -> bool:
+        cell = self._session_cells.get(id(writer))
+        return cell is not None and cell in self.full_partitioned
+
+    def _handle(self, writer, msg: dict) -> None:
+        cell = msg.get("cell")
+        if cell is not None:
+            if cell in self.full_partitioned:
+                with self._lock:
+                    self.partition_swallowed += 1
+                    # Tag the session even while dark so broadcast
+                    # suppression covers it from the first request.
+                    self._session_cells[id(writer)] = str(cell)
+                return
+            if cell in self.asym_partitioned:
+                verb = msg.get("verb")
+                if verb in self.WRITE_VERBS or "path" in msg \
+                        or verb in self.RECLAIM_VERBS:
+                    with self._lock:
+                        self.partition_swallowed += 1
+                    return
+        super()._handle(writer, msg)
+
+
+class CellRuntime:
+    """One cell's full scheduler stack (the per-cell analog of the
+    classic engine's single wire state)."""
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self.holder = f"{name}-sched"
+        self.epoch: int | None = None
+        self.have_lease = False
+        self.lease_lost = False
+        self.cache: SchedulerCache | None = None
+        self.backend: StreamBackend | None = None
+        self.adapter: WatchAdapter | None = None
+        self.scheduler: Scheduler | None = None
+        self.guardrails = None
+        self.seam = None
+        self.socks: list[socket.socket] = []
+        self.sock: socket.socket | None = None
+        #: Ticks the cell's pending demand has exceeded its total
+        #: capacity (the structural-starvation clock).
+        self.starved_ticks = 0
+        self.claim_inflight: int | None = None
+        self.claims_made = 0
+        self.donations = 0
+        self.stood_down = 0
+        self.ingest = {"events": 0, "batches": 0, "coalesced": 0}
+
+    def harvest_ingest(self, adapter) -> None:
+        self.ingest["events"] += getattr(adapter, "events_seen", 0)
+        self.ingest["batches"] += getattr(adapter, "batches_applied", 0)
+        self.ingest["coalesced"] += getattr(adapter, "coalesced_events", 0)
+
+
+@dataclasses.dataclass
+class CellChaosResult:
+    ok: bool
+    ticks_run: int
+    violations: list[Violation]
+    trace_hash: str
+    final_assignment: dict[str, str]
+    faults: dict[str, int]
+    recoveries: dict[str, int]
+    converged_tick: int | None
+    dump_path: str | None
+    cells: dict | None = None
+    cross_cell: dict | None = None
+    partitions: dict | None = None
+    reclaim: dict | None = None
+    ingest: dict | None = None
+    trace: dict | None = None
+
+    def summary(self) -> dict:
+        return {
+            "ok": self.ok,
+            "ticks": self.ticks_run,
+            "violations": [v.as_dict() for v in self.violations],
+            "trace_hash": self.trace_hash,
+            "bound_pods": len(self.final_assignment),
+            "faults": dict(self.faults),
+            "recoveries": dict(self.recoveries),
+            "converged_after_drain_ticks": self.converged_tick,
+            "flight_recorder": self.dump_path,
+            "cells": self.cells,
+            "cross_cell": self.cross_cell,
+            "partitions": self.partitions,
+            "reclaim": self.reclaim,
+            "ingest": self.ingest,
+            "trace": self.trace,
+        }
+
+
+class CellChaosEngine:
+    """Drives N full scheduler stacks against one ChaosCellCluster,
+    tick-deterministically (cells in sorted order within a tick)."""
+
+    def __init__(
+        self,
+        seed: int = 0,
+        ticks: int = 26,
+        scenario: ScenarioSpec | None = None,
+        cell_workloads: list[dict] | None = None,
+        cell_faults: CellFaultSpec | None = None,
+        conf_path: str | None = None,
+        record: int = 64,
+        drain: int = 60,
+        dump_dir: str | None = None,
+        quiesce_timeout: float = 30.0,
+        ingest_mode: str | None = None,
+        trace_obs: str | None = None,
+    ) -> None:
+        from kube_batch_tpu.client.adapter import resolve_ingest_mode
+
+        self.seed = seed
+        self.ticks = ticks
+        self.base_scenario = scenario or ScenarioSpec()
+        self.cell_faults = cell_faults or CellFaultSpec()
+        self.cell_names = [
+            f"cell-{chr(ord('a') + i)}"
+            for i in range(max(self.cell_faults.cells, 2))
+        ]
+        overrides = list(cell_workloads or [])
+        while len(overrides) < len(self.cell_names):
+            overrides.append({})
+        self.cell_scenarios = [
+            dataclasses.replace(self.base_scenario, **{
+                k: tuple(tuple(x) if isinstance(x, list) else x
+                         for x in v) if isinstance(v, list) else v
+                for k, v in ov.items()
+            })
+            for ov in overrides[: len(self.cell_names)]
+        ]
+        self.conf_path = conf_path
+        self.drain = drain
+        self.dump_dir = dump_dir or tempfile.gettempdir()
+        self.quiesce_timeout = quiesce_timeout
+        self.ingest_mode = resolve_ingest_mode(ingest_mode)
+        self.trace_obs = trace_obs or "on"
+        if self.trace_obs not in ("on", "off"):
+            raise ValueError(
+                f"trace_obs must be 'on' or 'off', got {self.trace_obs!r}"
+            )
+        self.wire_timeout = (
+            ASYM_WIRE_TIMEOUT if self.cell_faults.asym_partition_at
+            else 10.0
+        )
+        self.recorder = FlightRecorder(keep=record)
+        self.fault_counts: collections.Counter = collections.Counter()
+        self.recovery_counts: collections.Counter = collections.Counter()
+        self.cluster: ChaosCellCluster | None = None
+        self.cells = [CellRuntime(n) for n in self.cell_names]
+        self._decision_cursor = 0
+        self._decisions: list[dict] = []
+        #: tick -> {cell: accepted binds} (the peer-unaffected
+        #: invariant reads the partition windows out of this).
+        self._binds_by_tick: dict[int, collections.Counter] = {}
+        #: Full-partition windows actually OPENED: cell -> [(t0, t1)].
+        self._partition_windows: dict[str, list[list[int]]] = {}
+        self._asym_cells_seen: set[str] = set()
+        # Cross-cell probe accounting (engine-driven, deterministic).
+        self._xcell_attempted = 0
+        self._xcell_rejected = 0
+        self._xcell_accepted = 0
+        self._xcell_local_fenced = 0
+        self._straddle_rollbacks = 0
+        self._trace_dump_dir: str | None = None
+        self._trace_summary: dict | None = None
+
+    # -- wiring ---------------------------------------------------------
+    def _connect(self, rt: CellRuntime, replay: bool) -> None:
+        a, b = socket.socketpair()
+        cl_r = a.makefile("r", encoding="utf-8")
+        cl_w = a.makefile("w", encoding="utf-8")
+        sch_r = b.makefile("r", encoding="utf-8")
+        sch_w = b.makefile("w", encoding="utf-8")
+        self.cluster.attach(cl_r, cl_w)
+        if not self.cluster._started:
+            self.cluster.start()
+        if replay:
+            self.cluster.replay(cl_w)
+        old = rt.adapter
+        if rt.backend is None:
+            rt.backend = StreamBackend(sch_w, timeout=self.wire_timeout)
+            rt.backend.set_cell(rt.name)
+        else:
+            rt.backend.reconnect(sch_w)
+        adapter = WatchAdapter(
+            rt.cache, sch_r, backend=rt.backend,
+            ingest_mode=self.ingest_mode, cell=rt.name,
+        )
+        if old is not None:
+            adapter.resource_versions.update(old.resource_versions)
+            adapter.list_rv = old.list_rv
+            adapter.adopt_cell_topology(old)
+            rt.harvest_ingest(old)
+        adapter.start()
+        rt.backend.cell_of_node = adapter.cell_of_node
+        rt.socks.extend((a, b))
+        rt.sock = b
+        rt.adapter = adapter
+
+    def _sever(self, rt: CellRuntime) -> None:
+        try:
+            rt.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        deadline = time.monotonic() + self.quiesce_timeout
+        while not rt.adapter.stopped.wait(0.01):
+            if time.monotonic() > deadline:
+                raise ChaosEngineError(
+                    f"{rt.name}: severed stream never stopped the "
+                    "watch adapter"
+                )
+
+    def _reconnect(self, rt: CellRuntime) -> str:
+        since = rt.adapter.latest_rv
+        self._connect(rt, replay=False)
+        mode = resume_session(
+            rt.cache, rt.backend, rt.adapter, since,
+            sync_timeout=self.quiesce_timeout,
+        )
+        self.recovery_counts[f"{mode}-{rt.name}"] += 1
+        return mode
+
+    def _quiesce(self, rt: CellRuntime) -> None:
+        deadline = time.monotonic() + self.quiesce_timeout
+        while time.monotonic() < deadline:
+            if rt.adapter.stopped.is_set():
+                return
+            with self.cluster._lock:
+                rv = self.cluster._rv
+            if rt.adapter.synced.is_set() and rt.adapter.latest_rv >= rv:
+                return
+            time.sleep(0.002)
+        raise ChaosEngineError(f"{rt.name}: ingest quiesce timed out")
+
+    # -- leases ---------------------------------------------------------
+    def _renew_lease(self, rt: CellRuntime, rec: dict) -> bool:
+        try:
+            if rt.have_lease:
+                rt.backend.renew_lease(rt.holder, LEASE_TTL)
+            else:
+                rt.epoch = rt.backend.acquire_lease(rt.holder, LEASE_TTL)
+                rt.backend.set_epoch(rt.epoch)
+                rt.have_lease = True
+                if rt.lease_lost:
+                    rt.lease_lost = False
+                    self.recovery_counts[f"lease-{rt.name}"] += 1
+        except RuntimeError:
+            rt.have_lease = False
+            rt.lease_lost = True
+            rec.setdefault("lease-lost", []).append(rt.name)
+            return False
+        except (ConnectionError, TimeoutError) as exc:
+            with self.cluster._lock:
+                dark = (rt.name in self.cluster.full_partitioned
+                        or rt.name in self.cluster.asym_partitioned)
+            if dark:
+                # Partitioned: the lease verb was swallowed — stand
+                # down for the tick, exactly what a real cell does
+                # when its control plane goes unreachable.
+                rec.setdefault("lease-unreachable", []).append(rt.name)
+                return False
+            raise ChaosEngineError(
+                f"{rt.name}: lease verb failed on a live stream: {exc}"
+            ) from exc
+        return True
+
+    # -- cross-cell reclaim duties --------------------------------------
+    @staticmethod
+    def _cache_demand(rt: CellRuntime) -> tuple[float, float, float]:
+        """(pending_cpu, total_demand_cpu, alloc_cpu) from the cell's
+        own mirror — the structural-starvation / affordability inputs."""
+        with rt.cache.lock():
+            alloc = sum(
+                float(n.node.allocatable.get("cpu", 0.0))
+                for n in rt.cache._nodes.values()
+            )
+            pending = total = 0.0
+            for p in rt.cache._pods.values():
+                cpu = float(p.request.get("cpu", 0.0))
+                total += cpu
+                if p.status == TaskStatus.PENDING:
+                    pending += cpu
+        return pending, total, alloc
+
+    def _claim_duty(self, rt: CellRuntime, rec: dict) -> None:
+        """The starved side: claim capacity from a donor once pending
+        demand has structurally exceeded this cell's whole capacity
+        for `reclaim_after_ticks` ticks and no claim is in flight."""
+        spec = self.cell_faults
+        if rt.claim_inflight is not None:
+            with self.cluster._lock:
+                claim = self.cluster.reclaim_claims.get(rt.claim_inflight)
+            if claim is not None and claim["state"] != "pending":
+                # Terminal: granted capacity arrives on the watch;
+                # a rollback re-arms the claim duty after heal.
+                rec.setdefault("claims-resolved", []).append(
+                    {"cell": rt.name, "claim": claim["id"],
+                     "state": claim["state"]},
+                )
+                rt.claim_inflight = None
+            else:
+                return
+        pending, _total, alloc = self._cache_demand(rt)
+        if pending > alloc:
+            rt.starved_ticks += 1
+        else:
+            rt.starved_ticks = 0
+            return
+        if rt.starved_ticks < max(spec.reclaim_after_ticks, 1):
+            return
+        donor = next(
+            (n for n in self.cell_names if n != rt.name), None
+        )
+        if donor is None:
+            return
+        try:
+            resp = rt.backend._call({
+                "verb": "claimCapacity", "from": donor,
+                "ttlTicks": spec.reclaim_ttl_ticks,
+            })
+        except (ConnectionError, TimeoutError):
+            return  # partitioned mid-claim: retried next tick
+        rt.claim_inflight = int(resp.get("claim", 0)) or None
+        rt.claims_made += 1
+        self.fault_counts["reclaim-claim"] += 1
+        rec.setdefault("claims", []).append(
+            {"cell": rt.name, "from": donor,
+             "claim": rt.claim_inflight},
+        )
+
+    def _donor_duty(self, rt: CellRuntime, rec: dict) -> None:
+        """The donor side: discover pending claims naming this cell
+        (listClaims), free ONE node through the normal evict seam —
+        gang-atomically: every placed member of every gang resident on
+        the chosen node is evicted, so no gang is ever stranded
+        half-on donated hardware — and offer it.  Refuses when the
+        cell cannot afford the capacity loss."""
+        try:
+            resp = rt.backend._call({"verb": "listClaims"})
+        except (ConnectionError, TimeoutError):
+            return  # partitioned: the claim will roll back on TTL
+        claims = [c for c in resp.get("object") or []
+                  if c.get("state") == "pending"]
+        if not claims:
+            return
+        claim = claims[0]  # one donation per tick keeps ticks bounded
+        _pending, total, alloc = self._cache_demand(rt)
+        with rt.cache.lock():
+            nodes = sorted(
+                (info.node for info in rt.cache._nodes.values()),
+                key=lambda n: n.name,
+            )
+            residents: dict[str, list] = {n.name: [] for n in nodes}
+            for p in rt.cache._pods.values():
+                if p.node in residents and p.status in (
+                    TaskStatus.BOUND, TaskStatus.RUNNING,
+                    TaskStatus.BINDING,
+                ):
+                    residents[p.node].append(p)
+        candidates = sorted(
+            nodes, key=lambda n: (len(residents[n.name]), n.name)
+        )
+        for node in candidates:
+            if total > alloc - float(node.allocatable.get("cpu", 0.0)):
+                continue  # cannot afford to lose this node
+            groups = sorted({
+                p.group for p in residents[node.name] if p.group
+            })
+            with rt.cache.lock():
+                victims = sorted(
+                    (
+                        p for p in rt.cache._pods.values()
+                        if (p.group in groups or p in residents[node.name])
+                        and p.node is not None
+                        and p.status in (TaskStatus.BOUND,
+                                         TaskStatus.RUNNING,
+                                         TaskStatus.BINDING)
+                    ),
+                    key=lambda p: p.uid,
+                )
+            try:
+                for pod in victims:
+                    rt.seam.evict(pod, "reclaim-donate")
+                rt.backend._call({
+                    "verb": "offerCapacity", "claim": claim["id"],
+                    "node": node.name,
+                })
+            except (ConnectionError, TimeoutError):
+                return  # partitioned mid-donation: claim rolls back
+            except RuntimeError as exc:
+                log.warning("%s: donation refused: %s", rt.name, exc)
+                return
+            rt.donations += 1
+            self.fault_counts["reclaim-grant"] += 1
+            rec.setdefault("donations", []).append({
+                "cell": rt.name, "claim": claim["id"],
+                "node": node.name, "evicted": len(victims),
+            })
+            return
+        log.info("%s: no affordable node to donate for claim %s",
+                 rt.name, claim["id"])
+
+    # -- cross-cell zombie probes ---------------------------------------
+    def _xcell_probe(self, rec: dict) -> None:
+        """One live cell attempts cross-cell writes, both ways: raw
+        through the wire (cluster fence must answer CellScope) and
+        through the normal bind seam (the LOCAL cell fence must fail
+        it without a wire round trip).  Deterministic: sorted cells,
+        sorted pods, sorted nodes."""
+        with self.cluster._lock:
+            dark = self.cluster.full_partitioned | \
+                self.cluster.asym_partitioned
+        src = next(
+            (rt for rt in self.cells
+             if rt.name not in dark and rt.have_lease), None
+        )
+        if src is None:
+            rec.setdefault("xcell-probe", []).append("skipped")
+            return
+        with self.cluster._lock:
+            foreign = sorted(
+                n for n in self.cluster.nodes
+                if self.cluster.cell_of_node(n)
+                not in ("", src.name)
+            )
+            own = sorted(
+                uid for uid, p in self.cluster.pods.items()
+                if self.cluster.cell_of_pod(p) == src.name
+            )
+            rejections_before = self.cluster.cross_cell_rejections
+        if not foreign or not own:
+            rec.setdefault("xcell-probe", []).append("skipped")
+            return
+        detail = {"cell": src.name, "node": foreign[0], "pod": own[0]}
+        # Probe 1: the CLUSTER fence — a raw wire request, past the
+        # local fence on purpose.
+        self._xcell_attempted += 1
+        try:
+            src.backend._call({
+                "verb": "bind", "pod": own[0], "node": foreign[0],
+            })
+            self._xcell_accepted += 1  # invariant violation
+            detail["cluster"] = "ACCEPTED"
+        except CellScopeError:
+            self._xcell_rejected += 1
+            detail["cluster"] = "rejected"
+        except Exception as exc:  # noqa: BLE001 — a dead wire here is
+            raise ChaosEngineError(   # a harness bug, not a fence test
+                f"xcell probe failed outside the fence: {exc}"
+            ) from exc
+        # Probe 2: the LOCAL fence — the normal bind seam must fail
+        # fast without the request ever reaching the wire.
+        fake = types.SimpleNamespace(uid=own[0])
+        try:
+            src.backend.bind(fake, foreign[0])
+            detail["local"] = "ACCEPTED"
+            self._xcell_accepted += 1
+        except CellScopeError:
+            with self.cluster._lock:
+                cluster_rejections = (
+                    self.cluster.cross_cell_rejections
+                    - rejections_before
+                )
+            if cluster_rejections <= 1:
+                # Only probe 1 hit the cluster: probe 2 was fenced
+                # locally, as designed.
+                self._xcell_local_fenced += 1
+                detail["local"] = "fenced-locally"
+            else:
+                detail["local"] = "rejected-on-wire"
+        self.fault_counts["xcell-probe"] += 1
+        rec.setdefault("xcell-probe", []).append(detail)
+
+    # -- partition faults -----------------------------------------------
+    def _fire_fault(self, ev: dict, t: int, rec: dict) -> None:
+        kind = ev["kind"]
+        if kind in ("cell-partition-full", "cell-partition-asym"):
+            cell = ev["cell"]
+            with self.cluster._lock:
+                if kind.endswith("full"):
+                    self.cluster.full_partitioned.add(cell)
+                    if ev.get("origin") != "straddle":
+                        self._partition_windows.setdefault(
+                            cell, []
+                        ).append([t, self.ticks + self.drain])
+                else:
+                    self.cluster.asym_partitioned.add(cell)
+                    self._asym_cells_seen.add(cell)
+            self.fault_counts[kind] += 1
+            rec.setdefault("faults", []).append(
+                {"kind": kind, "cell": cell},
+            )
+        elif kind == "cell-heal":
+            cell = ev["cell"]
+            with self.cluster._lock:
+                was_full = cell in self.cluster.full_partitioned
+                self.cluster.full_partitioned.discard(cell)
+                self.cluster.asym_partitioned.discard(cell)
+            if was_full and self._partition_windows.get(cell) and \
+                    self._partition_windows[cell][-1][1] == \
+                    self.ticks + self.drain:
+                self._partition_windows[cell][-1][1] = t
+            rt = next(r for r in self.cells if r.name == cell)
+            if was_full:
+                # The dark window suppressed broadcasts: force the
+                # resume path so the healed cell replays the missed
+                # tail (or relists past a 410) before its next cycle.
+                self._sever(rt)
+                rec.setdefault("faults", []).append({
+                    "kind": "cell-heal", "cell": cell,
+                    "resume": self._reconnect(rt),
+                })
+            else:
+                rec.setdefault("faults", []).append(
+                    {"kind": "cell-heal", "cell": cell},
+                )
+            self.recovery_counts[f"heal-{cell}"] += 1
+        elif kind == "xcell-probe":
+            self._xcell_probe(rec)
+        else:
+            raise ChaosEngineError(f"unknown cell fault kind {kind!r}")
+
+    # -- the run --------------------------------------------------------
+    def _build_events(self) -> tuple[list[dict], list[dict]]:
+        events: list[dict] = []
+        for i, cell in enumerate(self.cell_names):
+            evs = generate(
+                self.cell_scenarios[i], self.seed * 10 + i, self.ticks
+            )
+            events.extend(cellify(evs, cell))
+        spec = self.cell_faults
+        if spec.asym_partition_at:
+            # The half-open case needs the victim actually WRITING
+            # into the black hole: a small gang lands in the asym cell
+            # at the window's onset, so its bind dispatches time out
+            # and the breaker must trip against a live watch.  It
+            # places after heal — part of convergence like any gang.
+            cell = self.cell_names[
+                spec.asym_partition_cell % len(self.cell_names)
+            ]
+            group = f"asym-nudge-{self.seed}"
+            events.append({
+                "tick": spec.asym_partition_at, "op": "submit",
+                "group": group, "queue": f"{cell}-default",
+                "minMember": 3, "priority": 10,
+                "pods": [
+                    {
+                        "name": f"{group}-{i}",
+                        "uid": f"uid-{group}-{i}",
+                        "group": group,
+                        "priority": 10,
+                        "request": {"cpu": 500.0, "memory": GI / 2,
+                                    "pods": 1.0},
+                    }
+                    for i in range(3)
+                ],
+            })
+        if spec.starve_pods and spec.starve_at:
+            cell = self.cell_names[spec.starve_cell % len(self.cell_names)]
+            group = f"starve-{self.seed}"
+            events.append({
+                "tick": spec.starve_at, "op": "submit", "group": group,
+                "queue": f"{cell}-default",
+                "minMember": spec.starve_pods, "priority": 50,
+                "pods": [
+                    {
+                        "name": f"{group}-{i}",
+                        "uid": f"uid-{group}-{i}",
+                        "group": group,
+                        "priority": 50,
+                        "request": {
+                            "cpu": spec.starve_cpu_milli,
+                            "memory": spec.starve_mem_gi * GI,
+                            "pods": 1.0,
+                        },
+                    }
+                    for i in range(spec.starve_pods)
+                ],
+            })
+        events.sort(key=lambda e: e["tick"])
+        faults = plan_cell_faults(spec, self.cell_names, self.ticks)
+        return events, faults
+
+    def run(self) -> CellChaosResult:
+        events, fault_events = self._build_events()
+        by_tick: dict[int, list[dict]] = collections.defaultdict(list)
+        for ev in events:
+            by_tick[ev["tick"]].append(ev)
+        faults_by_tick: dict[int, list[dict]] = collections.defaultdict(list)
+        for ev in fault_events:
+            faults_by_tick[ev["tick"]].append(ev)
+
+        if self.trace_obs == "on":
+            self._trace_dump_dir = tempfile.mkdtemp(
+                prefix="kb-chaos-cells-trace-"
+            )
+            for rt in self.cells:
+                trace_obs_mod.enable(
+                    dump_dir=self._trace_dump_dir, scope=rt.name,
+                )
+        else:
+            trace_obs_mod.disable()
+
+        self.cluster = ChaosCellCluster(seed=self.seed, history=8192)
+        from kube_batch_tpu.guardrails import GuardrailConfig, Guardrails
+
+        for rt in self.cells:
+            rt.cache = SchedulerCache(
+                spec=ResourceSpec(),
+                binder=None, evictor=None, status_updater=None,
+                default_queue=f"{rt.name}-default",
+            )
+            self._connect(rt, replay=True)
+            rt.guardrails = Guardrails(GuardrailConfig(
+                hbm_ceiling_mb=None,
+                watchdog_overruns=GUARDRAIL_ENGAGE_AFTER,
+                watchdog_recovery=GUARDRAIL_RECOVER_AFTER,
+                watchdog_period=GUARDRAIL_WATCHDOG_PERIOD,
+                breaker_failures=GUARDRAIL_TRIP_AFTER,
+                breaker_reset_s=GUARDRAIL_RESET_TICKS,
+                backoff_base_s=0.01,
+                backoff_cap_s=0.04,
+                backoff_attempts=2,
+            ), scope=rt.name)
+            rt.seam = rt.guardrails.guard_backend(
+                rt.backend, rt.cache, name=f"wire-{rt.name}",
+                clock=lambda: float(self.cluster.tick_now),
+            )
+            rt.cache.binder = rt.seam
+            rt.cache.evictor = rt.seam
+            rt.cache.status_updater = rt.seam
+            if not rt.adapter.wait_for_sync(self.quiesce_timeout):
+                raise ChaosEngineError(
+                    f"{rt.name}: initial LIST replay never synced"
+                )
+            with scope.bound(rt.name):
+                rt.scheduler = Scheduler(
+                    rt.cache, conf_path=self.conf_path,
+                    schedule_period=0.0, guardrails=rt.guardrails,
+                )
+
+        checker = InvariantChecker(self.cluster)
+        violations: list[Violation] = []
+        converged_tick: int | None = None
+        ticks_run = 0
+
+        def one_tick(t: int, active: bool) -> list[Violation]:
+            nonlocal ticks_run
+            self.cluster.tick_now = t
+            self.cluster.claim_clock = t
+            rec: dict = {"tick": t}
+            # Drain ticks inject nothing new, but HEALS still fire: a
+            # partition window reaching past the horizon must lift
+            # during the drain, or the dark cell can never converge.
+            fault_list = faults_by_tick.get(t, ())
+            if not active:
+                fault_list = [
+                    fe for fe in fault_list if fe["kind"] == "cell-heal"
+                ]
+            for fe in fault_list:
+                self._fire_fault(fe, t, rec)
+            # Claims past deadline roll back — straddle accounting
+            # reads whether the DONOR was dark at rollback time.
+            with self.cluster._lock:
+                dark_now = set(self.cluster.full_partitioned)
+            before = {
+                cid: c["from"]
+                for cid, c in self.cluster.reclaim_claims.items()
+                if c["state"] == "pending"
+            }
+            rolled = self.cluster.expire_reclaims()
+            if rolled:
+                rec["reclaim-rollbacks"] = rolled
+                self.fault_counts["reclaim-rollback"] += rolled
+                for cid, donor in before.items():
+                    claim = self.cluster.reclaim_claims[cid]
+                    if claim["state"] == "rolled-back" and \
+                            donor in dark_now:
+                        self._straddle_rollbacks += 1
+            evs = by_tick.get(t, ())
+            if not active:
+                evs = [e for e in evs if e["op"] == "complete"]
+            for ev in evs:
+                apply_to_cluster(self.cluster, ev)
+            rec["workload"] = len(evs)
+            for rt in self.cells:
+                with self.cluster._lock:
+                    fully_dark = rt.name in self.cluster.full_partitioned
+                if fully_dark:
+                    rt.stood_down += 1
+                    rec.setdefault("stood-down", []).append(rt.name)
+                    continue
+                with scope.bound(rt.name):
+                    if rt.adapter.stopped.is_set() or \
+                            rt.backend.closed.is_set():
+                        rec[f"reconnect-{rt.name}"] = self._reconnect(rt)
+                    lead = self._renew_lease(rt, rec)
+                    self._quiesce(rt)
+                    if lead:
+                        self._donor_duty(rt, rec)
+                        self._claim_duty(rt, rec)
+                        # The duties' wire effects (drain evictions,
+                        # the grant's node re-cell) come back as watch
+                        # events: quiesce AGAIN so the solve's
+                        # snapshot deterministically includes them —
+                        # otherwise whether this tick's cycle sees
+                        # the freed pods is a thread race and the
+                        # same seed hashes differently.
+                        self._quiesce(rt)
+                        rt.scheduler.run_once()
+                    else:
+                        rt.stood_down += 1
+            self.cluster.tick()
+            for rt in self.cells:
+                with self.cluster._lock:
+                    if rt.name in self.cluster.full_partitioned:
+                        continue
+                self._quiesce(rt)
+            found = self._drain_decisions(t, rec)
+            found += checker.check_tick(t)
+            if found:
+                rec["violations"] = [v.as_dict() for v in found]
+                for v in found:
+                    metrics.chaos_invariant_violations.inc(v.kind)
+            self.recorder.record(rec)
+            ticks_run += 1
+            return found
+
+        try:
+            for t in range(self.ticks):
+                violations = one_tick(t, active=True)
+                if violations:
+                    break
+            else:
+                for extra in range(self.drain):
+                    t = self.ticks + extra
+                    violations = one_tick(t, active=False)
+                    if violations:
+                        break
+                    if self._all_settled() and self._cells_recovered():
+                        converged_tick = extra
+                        break
+                else:
+                    violations = checker.pending_after_deadline(
+                        self.ticks + self.drain
+                    )
+                if not violations:
+                    violations = self._check_cells(ticks_run)
+        finally:
+            self._teardown()
+
+        final = self._final_assignment()
+        full_hash = trace_hash(events + fault_events + self._decisions)
+        dump_path = None
+        if violations:
+            os.makedirs(self.dump_dir, exist_ok=True)
+            dump_path = os.path.join(
+                self.dump_dir, f"chaos-cells-flight-seed{self.seed}.json",
+            )
+            self.recorder.dump(dump_path, meta={
+                "seed": self.seed,
+                "ticks": ticks_run,
+                "violations": [v.as_dict() for v in violations],
+                "trace_hash": full_hash,
+            })
+            log.error(
+                "chaos-cells: %d invariant violation(s); flight "
+                "recorder dumped to %s", len(violations), dump_path,
+            )
+        return CellChaosResult(
+            ok=not violations,
+            ticks_run=ticks_run,
+            violations=list(violations),
+            trace_hash=full_hash,
+            final_assignment=final,
+            faults=dict(self.fault_counts),
+            recoveries=dict(self.recovery_counts),
+            converged_tick=converged_tick,
+            dump_path=dump_path,
+            cells=self._cells_summary(),
+            cross_cell=self._cross_cell_summary(),
+            partitions=self._partitions_summary(),
+            reclaim=self._reclaim_summary(),
+            ingest=self._ingest_summary(),
+            trace=self._trace_summary,
+        )
+
+    # -- per-tick decision drain + cross-cell audit ---------------------
+    def _drain_decisions(self, t: int, rec: dict) -> list[Violation]:
+        with self.cluster._lock:
+            tail = self.cluster.wire_log[self._decision_cursor:]
+            self._decision_cursor = len(self.cluster.wire_log)
+        tail = sorted(
+            tail, key=lambda e: (e["op"], e.get("uid") or "",
+                                 e.get("node") or "", e.get("claim") or 0),
+        )
+        out: list[Violation] = []
+        binds = collections.Counter()
+        for e in tail:
+            if e["op"] != "bind":
+                continue
+            cell = e.get("cell")
+            if cell:
+                binds[cell] += 1
+                with self.cluster._lock:
+                    node_cell = self.cluster.cell_of_node(e["node"])
+                if node_cell and node_cell != cell:
+                    # Re-cells only happen in the pre-cycle donor
+                    # phase, so a bind's node cell at drain time IS
+                    # its cell at acceptance.
+                    out.append(Violation(
+                        "cross-cell-write-accepted", t,
+                        f"bind of {e['uid']} by cell {cell!r} landed "
+                        f"on node {e['node']!r} of cell {node_cell!r}",
+                    ))
+        if binds:
+            self._binds_by_tick[t] = binds
+        if tail:
+            rec["decisions"] = tail
+            self._decisions.extend(tail)
+        return out
+
+    # -- convergence ----------------------------------------------------
+    def _all_settled(self) -> bool:
+        with self.cluster._lock:
+            if self.cluster.full_partitioned:
+                return False  # a dark cell cannot have converged
+            return all(
+                p.status in (TaskStatus.BOUND, TaskStatus.RUNNING)
+                for p in self.cluster.pods.values()
+            )
+
+    def _cells_recovered(self) -> bool:
+        from kube_batch_tpu.guardrails import CircuitBreaker
+
+        with self.cluster._lock:
+            pending_claims = any(
+                c["state"] == "pending"
+                for c in self.cluster.reclaim_claims.values()
+            )
+        if pending_claims:
+            return False
+        return all(
+            rt.guardrails.breaker_state() != CircuitBreaker.OPEN
+            for rt in self.cells
+        )
+
+    # -- post-run invariants --------------------------------------------
+    def _check_cells(self, tick: int) -> list[Violation]:
+        out: list[Violation] = []
+        spec = self.cell_faults
+        # Cross-cell fencing actually exercised, nothing accepted.
+        if spec.xcell_probe_at:
+            if self._xcell_attempted < 1 or self._xcell_rejected < 1:
+                out.append(Violation(
+                    "xcell-fence-not-exercised", tick,
+                    "no cross-cell write was attempted and rejected — "
+                    "the cell-scope fence went untested",
+                ))
+            if self._xcell_local_fenced < 1:
+                out.append(Violation(
+                    "xcell-local-fence-not-exercised", tick,
+                    "the client-side cell fence never fast-failed a "
+                    "probe",
+                ))
+        if self._xcell_accepted:
+            out.append(Violation(
+                "cross-cell-write-accepted", tick,
+                f"{self._xcell_accepted} cross-cell probe write(s) "
+                "were ACCEPTED — no-cross-cell-write-accepted broken",
+            ))
+        # Partition shapes all fired.
+        if spec.full_partition_at and \
+                self.fault_counts.get("cell-partition-full", 0) < 1:
+            out.append(Violation(
+                "partition-not-fired", tick,
+                "full_partition_at configured but never fired",
+            ))
+        if spec.asym_partition_at and \
+                self.fault_counts.get("cell-partition-asym", 0) < 1:
+            out.append(Violation(
+                "partition-not-fired", tick,
+                "asym_partition_at configured but never fired",
+            ))
+        # The asym (half-open) case must actually trip the victim's
+        # breaker against a live watch — and it must have healed.
+        if spec.asym_partition_at:
+            for cell in sorted(self._asym_cells_seen):
+                rt = next(r for r in self.cells if r.name == cell)
+                breaker = rt.guardrails.breaker
+                if breaker is None or breaker.opened_count < 1:
+                    out.append(Violation(
+                        "asym-breaker-never-tripped", tick,
+                        f"{cell}: writes were black-holed with the "
+                        "watch live but the wire breaker never "
+                        "tripped",
+                    ))
+                elif breaker.closed_count < 1:
+                    out.append(Violation(
+                        "asym-breaker-never-closed", tick,
+                        f"{cell}: breaker tripped but never healed "
+                        "after the partition lifted",
+                    ))
+        # Peer-unaffected: during every full-partition window the
+        # OTHER cells kept placing.
+        for cell, windows in sorted(self._partition_windows.items()):
+            for t0, t1 in windows:
+                peer_binds = sum(
+                    n
+                    for t in range(t0, t1)
+                    for c, n in self._binds_by_tick.get(
+                        t, collections.Counter()
+                    ).items()
+                    if c != cell
+                )
+                if peer_binds < 1:
+                    out.append(Violation(
+                        "partitioned-cell-peer-starved", tick,
+                        f"cell {cell!r} was dark over ticks "
+                        f"[{t0},{t1}) and NO peer cell placed "
+                        "anything — the partition leaked across the "
+                        "cell boundary",
+                    ))
+        # Reclaim: atomic or rolled back; exercised when configured.
+        with self.cluster._lock:
+            claims = [dict(c) for c in
+                      self.cluster.reclaim_claims.values()]
+        unresolved = [c for c in claims if c["state"] == "pending"]
+        if unresolved:
+            out.append(Violation(
+                "reclaim-unresolved", tick,
+                f"{len(unresolved)} capacity claim(s) still pending "
+                "after the drain — neither granted nor rolled back",
+            ))
+        for c in claims:
+            if c["state"] == "rolled-back" and c["node"] is not None:
+                out.append(Violation(
+                    "reclaim-not-atomic", tick,
+                    f"rolled-back claim {c['id']} still names node "
+                    f"{c['node']!r} — capacity leaked into limbo",
+                ))
+            if c["state"] == "granted":
+                with self.cluster._lock:
+                    now_cell = self.cluster.cell_of_node(c["node"])
+                if now_cell != c["to"]:
+                    out.append(Violation(
+                        "reclaim-not-atomic", tick,
+                        f"granted claim {c['id']}: node {c['node']!r} "
+                        f"is in cell {now_cell!r}, not the claimant "
+                        f"{c['to']!r}",
+                    ))
+        if spec.starve_pods:
+            if not any(c["state"] == "granted" for c in claims):
+                out.append(Violation(
+                    "reclaim-never-granted", tick,
+                    "starvation was injected but no capacity claim "
+                    "was ever granted",
+                ))
+        if spec.straddle_at and self._straddle_rollbacks < 1:
+            out.append(Violation(
+                "straddle-not-exercised", tick,
+                "a straddle partition was configured but no claim "
+                "rolled back while its donor was dark",
+            ))
+        return out
+
+    # -- summaries ------------------------------------------------------
+    def _cells_summary(self) -> dict:
+        out = {}
+        for rt in self.cells:
+            out[rt.name] = {
+                "epoch": int(rt.epoch or 0),
+                "stood_down_ticks": rt.stood_down,
+                "claims_made": rt.claims_made,
+                "donations": rt.donations,
+                "breaker_opened": (
+                    rt.guardrails.breaker.opened_count
+                    if rt.guardrails and rt.guardrails.breaker else 0
+                ),
+            }
+        return out
+
+    def _cross_cell_summary(self) -> dict:
+        return {
+            "attempted": self._xcell_attempted,
+            "rejected": self._xcell_rejected,
+            "accepted": self._xcell_accepted,
+            "local_fenced": self._xcell_local_fenced,
+            "cluster_rejections": (
+                self.cluster.cross_cell_rejections
+                if self.cluster else 0
+            ),
+        }
+
+    def _partitions_summary(self) -> dict:
+        return {
+            "full": self.fault_counts.get("cell-partition-full", 0),
+            "asym": self.fault_counts.get("cell-partition-asym", 0),
+            "swallowed": (
+                self.cluster.partition_swallowed if self.cluster else 0
+            ),
+            "windows": {
+                cell: [list(w) for w in ws]
+                for cell, ws in sorted(self._partition_windows.items())
+            },
+            "straddle_rollbacks": self._straddle_rollbacks,
+        }
+
+    def _reclaim_summary(self) -> dict:
+        with self.cluster._lock:
+            claims = [dict(c) for c in
+                      self.cluster.reclaim_claims.values()]
+        return {
+            "claims": len(claims),
+            "granted": sum(1 for c in claims
+                           if c["state"] == "granted"),
+            "rolled_back": sum(1 for c in claims
+                               if c["state"] == "rolled-back"),
+            "pending": sum(1 for c in claims
+                           if c["state"] == "pending"),
+            "sequence": sorted(claims, key=lambda c: c["id"]),
+        }
+
+    def _ingest_summary(self) -> dict:
+        totals = {"events": 0, "batches": 0, "coalesced": 0}
+        dropped = 0
+        for rt in self.cells:
+            if rt.adapter is not None:
+                rt.harvest_ingest(rt.adapter)
+                dropped += rt.adapter.cell_dropped
+            for k in totals:
+                totals[k] += rt.ingest[k]
+        return {"mode": self.ingest_mode, "cell_filtered": dropped,
+                **totals}
+
+    def _final_assignment(self) -> dict[str, str]:
+        with self.cluster._lock:
+            return {
+                uid: p.node
+                for uid, p in sorted(self.cluster.pods.items())
+                if p.node is not None
+            }
+
+    def _teardown(self) -> None:
+        if self.trace_obs == "on":
+            per_cell = {}
+            for rt in self.cells:
+                tracer = trace_obs_mod.get(scope=rt.name)
+                if tracer is not None:
+                    per_cell[rt.name] = {
+                        "spans_recorded":
+                            tracer.spans.stats()["spans_recorded"],
+                        "decision_records":
+                            tracer.decisions.stats()["records_total"],
+                        "dumps": [dict(d) for d in
+                                  tracer.recorder.dumps],
+                    }
+                trace_obs_mod.disable(scope=rt.name)
+            self._trace_summary = {"enabled": True, "cells": per_cell}
+        else:
+            self._trace_summary = {"enabled": False}
+        if self._trace_dump_dir is not None:
+            import shutil
+
+            shutil.rmtree(self._trace_dump_dir, ignore_errors=True)
+        metrics.reset_health_scopes()
+        if self.cluster is not None:
+            with self.cluster._lock:
+                self.cluster.full_partitioned.clear()
+                self.cluster.asym_partitioned.clear()
+        for rt in self.cells:
+            try:
+                if rt.have_lease and rt.backend is not None:
+                    rt.backend.release_lease(rt.holder)
+            except Exception:  # noqa: BLE001 — best effort on the way down
+                pass
+            for sock in rt.socks:
+                try:
+                    sock.close()
+                except OSError:
+                    pass

@@ -437,12 +437,19 @@ class ChaosCluster(ExternalCluster):
                 self._req_epoch = None
 
     # -- epoch instrumentation (ExternalCluster hooks) ------------------
-    def _on_epoch_advance(self, epoch: int, holder: str) -> None:
+    def _on_epoch_advance(self, epoch: int, holder: str,
+                          cell: str = "") -> None:
         """Every mint rides the wire log (deterministic: acquires are
         engine-sequenced), so the invariant checker can replay which
-        epoch was current when each write was accepted."""
-        self._log({"op": "epoch-advance", "epoch": epoch,
-                   "holder": holder})
+        epoch was current when each write was accepted — per cell:
+        each cell's lease mints its own sequence, and the checker
+        keys its replay on the entry's cell ("" = the classic
+        single-fleet lease, omitted so pre-cell hashes are stable)."""
+        entry = {"op": "epoch-advance", "epoch": epoch,
+                 "holder": holder}
+        if cell:
+            entry["cell"] = cell
+        self._log(entry)
 
     def _on_stale_reject(self, msg: dict) -> None:
         """A zombie write was fenced.  Logged (the engine's zombie
@@ -460,7 +467,27 @@ class ChaosCluster(ExternalCluster):
         entry["tick"] = self.tick_now
         if self._req_epoch is not None and "epoch" not in entry:
             entry["epoch"] = self._req_epoch
+        if self._req_cell is not None and "cell" not in entry:
+            # Only cell-declaring writers stamp entries: classic
+            # (uncelled) scenarios hash byte-identically to pre-cell
+            # runs.
+            entry["cell"] = self._req_cell
         self.wire_log.append(entry)
+
+    # -- cell instrumentation (ExternalCluster hooks) -------------------
+    def _on_cell_reject(self, why: str) -> None:
+        """A cross-cell write was fenced cluster-side.  Logged (the
+        cells engine's probes fire deterministically) and counted by
+        the base class; the cells invariants assert ≥1 rejected and
+        0 accepted."""
+        self._log({"op": "cell-reject", "why": why})
+
+    def _on_reclaim(self, entry: dict) -> None:
+        """Reclaim negotiation steps (claim / grant / rollback) ride
+        the wire log: they are engine-sequenced, so they hash stably,
+        and the reclaim-atomic-or-rolled-back invariant replays
+        them."""
+        self._log(dict(entry))
 
     # -- bind sabotage + instrumentation -------------------------------
     def _bind_pod(self, writer, rid, pod, node_name) -> None:
@@ -502,6 +529,7 @@ class ChaosCluster(ExternalCluster):
         accepted = (
             node_name in self.nodes
             and pod.name not in self.fail_bind_pods
+            and self._cell_scope_violation(pod, node_name) is None
         )
         super()._bind_pod(writer, rid, pod, node_name)
         if accepted:
@@ -515,7 +543,8 @@ class ChaosCluster(ExternalCluster):
             })
 
     def _evict_pod(self, writer, rid, pod, reason) -> None:
-        if pod is not None:
+        if pod is not None and \
+                self._cell_scope_violation(pod, None) is None:
             self._log({
                 "op": "evict", "uid": pod.uid, "group": pod.group,
                 "reason": reason, "prior_status": pod.status.name,

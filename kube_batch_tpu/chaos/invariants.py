@@ -79,9 +79,12 @@ class InvariantChecker:
         self._placed: dict[str, str] = {}
         # group → uids ever placed (for gang first-wave detection).
         self._group_placed: dict[str, set[str]] = {}
-        # The lease epoch current at this point of the log replay
-        # (advanced by epoch-advance entries; 0 = no lease yet).
-        self._epoch = 0
+        # The lease epoch current at this point of the log replay,
+        # PER CELL (advanced by epoch-advance entries; 0 = no lease
+        # yet).  Key "" is the classic single-fleet lease; an entry
+        # with no cell stamp replays against it — pre-cell scenarios
+        # behave exactly as before.
+        self._epochs: dict[str, int] = {"": 0}
 
     # -- per-tick -------------------------------------------------------
     def check_tick(self, tick: int) -> list[Violation]:
@@ -117,23 +120,29 @@ class InvariantChecker:
         first_wave: set[str] = set()
         for e in entries:
             op, uid, group = e["op"], e.get("uid"), e.get("group")
+            cell = str(e.get("cell") or "")
             if op == "epoch-advance":
-                self._epoch = int(e["epoch"])
+                self._epochs[cell] = int(e["epoch"])
                 continue
-            if op == "stale-reject":
-                continue  # the fence working: rejected, nothing mutated
+            if op in ("stale-reject", "cell-reject"):
+                continue  # the fences working: rejected, nothing mutated
+            if op.startswith("reclaim-"):
+                continue  # negotiation bookkeeping, replayed elsewhere
             if op in ("bind", "evict") and e.get("epoch") is not None \
-                    and int(e["epoch"]) != self._epoch:
-                # An ACCEPTED write stamped with a non-current epoch:
-                # a zombie from a deposed leadership mutated the world
-                # (the log is appended under the cluster lock, so the
-                # epoch current at acceptance is exactly the last
-                # epoch-advance replayed before this entry).
+                    and int(e["epoch"]) != self._epochs.get(cell, 0):
+                # An ACCEPTED write stamped with a non-current epoch
+                # OF ITS CELL: a zombie from a deposed leadership
+                # mutated the world (the log is appended under the
+                # cluster lock, so the epoch current at acceptance is
+                # exactly the last epoch-advance replayed before this
+                # entry — per cell: single-writer-per-CELL-epoch).
                 violations.append(Violation(
                     "stale-epoch-write-accepted", tick,
                     f"{op} of pod {uid} accepted with epoch "
-                    f"{e['epoch']} while epoch {self._epoch} was "
-                    "current — single-writer-per-epoch broken",
+                    f"{e['epoch']} while epoch "
+                    f"{self._epochs.get(cell, 0)} was current for "
+                    f"cell {cell!r} — single-writer-per-cell-epoch "
+                    "broken",
                 ))
             if op in ("bind", "bind-fault", "flaky-bind-fault") and \
                     group is not None:

@@ -292,6 +292,7 @@ def apply_to_cluster(cluster, ev: dict) -> None:
     if op == "add-queue":
         cluster.add_queue(Queue(
             name=ev["name"], weight=float(ev.get("weight", 1.0)),
+            cell=str(ev.get("cell", "")),
             uid=f"uid-queue-{ev['name']}",
         ))
     elif op == "add-node":

@@ -74,6 +74,17 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--workload", default=None,
                    help="world spec: a BASELINE config number (1-5) or a "
                         "YAML file of nodes/queues/jobs")
+    p.add_argument("--cell", default=None,
+                   help="multi-cell scale-out (doc/design/"
+                        "multi-cell.md): fence this scheduler to ONE "
+                        "cell of the fleet — the watch ingests only "
+                        "this cell's (and shared) objects, every "
+                        "write is stamped with the cell and rejected "
+                        "cluster-side if its target lies outside it, "
+                        "leader election contends for the PER-CELL "
+                        "lease, and the statestore HA mirror lands "
+                        "under the cell's snapshot key.  Unset = the "
+                        "classic single-fleet deploy")
     p.add_argument("--cluster-stream", default=None,
                    help="host:port of a cluster watch/write stream (the "
                         "apiserver seam); replaces --workload, accepts "
@@ -507,6 +518,41 @@ def build_commit_pipeline(args, cache, guardrails):
     return commit
 
 
+def install_stand_down_signals(stop) -> dict:
+    """SIGTERM runs the FULL graceful stand-down instead of killing
+    the process mid-flush: the handler sets `stop`, the scheduler
+    loop exits, and the run mode's shutdown path executes fence →
+    drain → compact+mirror → release (`drain_write_path_then_release`
+    after `statestore.close()`).  Before this, `kubectl delete pod`
+    on a leader relied on the lease TTL — the successor waited out
+    the full 15 s and the dying leader's queued flushes raced the
+    epoch fence.  Installed in all THREE run modes (wire, HTTP, sim);
+    pinned by tests/test_cli.py.
+
+    Returns a record dict ({"signal": N} once fired) for tests.  A
+    non-main thread (can't own signal handlers) degrades to a no-op
+    with a debug log — behavior is then exactly the pre-handler
+    world."""
+    import signal
+
+    seen: dict = {}
+
+    def _handler(signum, frame):  # noqa: ARG001 — signal API shape
+        seen["signal"] = signum
+        logging.info(
+            "SIGTERM: graceful stand-down (fence -> drain -> "
+            "compact+mirror -> release)"
+        )
+        stop.set()
+
+    try:
+        signal.signal(signal.SIGTERM, _handler)
+    except ValueError:
+        logging.debug("SIGTERM handler not installed (not the main "
+                      "thread)")
+    return seen
+
+
 def drain_write_path_then_release(commit, elector, backend=None,
                                   commit_timeout: float = 10.0,
                                   event_timeout: float = 5.0) -> None:
@@ -716,6 +762,16 @@ def run_external(args) -> int:
         backend = K8sStreamBackend(writer)
     else:
         backend = StreamBackend(writer)
+    if args.cell:
+        # Multi-cell scale-out (doc/design/multi-cell.md): fence the
+        # write path to this cell (stamped on every request, enforced
+        # cluster-side), contend for the PER-CELL lease, and publish
+        # the cell identity on /healthz.
+        backend.set_cell(args.cell)
+        from kube_batch_tpu import metrics as _metrics
+
+        _metrics.set_cell(args.cell)
+        _metrics.set_cell_peer_visible(False)
     cache = SchedulerCache(
         spec=ResourceSpec(),
         binder=backend,
@@ -746,7 +802,14 @@ def run_external(args) -> int:
         cache, reader, backend=backend,
         scheduler_name=args.scheduler_name,
         ingest_mode=args.ingest_mode,
+        cell=args.cell,
+        trace_scope="",
     ).start()
+    if args.cell:
+        # The local half of the cell fence: the adapter sees every
+        # node PRE-filter, so a bind targeting a foreign node fails
+        # in-process without burning the RTT.
+        backend.cell_of_node = adapter.cell_of_node
     # Node-health ledger: bind-failure attribution + quarantine.  In
     # the k8s dialect, ledger cordons mirror onto spec.unschedulable
     # (kubectl and other controllers then see them too).  Built AFTER
@@ -761,6 +824,7 @@ def run_external(args) -> int:
     )
 
     stop = threading.Event()
+    install_stand_down_signals(stop)
     state = {"sock": sock, "adapter": adapter}
 
     def reconnect_once(old, since: int):
@@ -775,10 +839,17 @@ def run_external(args) -> int:
                 cache, nreader, backend=backend,
                 scheduler_name=args.scheduler_name,
                 ingest_mode=args.ingest_mode,
+                cell=args.cell,
+                trace_scope="",
             )
             nadapter.resource_versions.update(old.resource_versions)
             nadapter.list_rv = old.list_rv
+            if args.cell:
+                nadapter.adopt_cell_topology(old)
             nadapter.start()
+            if args.cell:
+                # The local fence follows the live adapter.
+                backend.cell_of_node = nadapter.cell_of_node
             resume_session(cache, backend, nadapter, since)
             return nsock, nadapter
         except BaseException:
@@ -965,6 +1036,12 @@ def run_http(args) -> int:
         insecure=args.kube_insecure,
     )
     backend = K8sHttpBackend(client)
+    if args.cell:
+        backend.set_cell(args.cell)
+        from kube_batch_tpu import metrics as _metrics
+
+        _metrics.set_cell(args.cell)
+        _metrics.set_cell_peer_visible(False)
     cache = SchedulerCache(
         spec=ResourceSpec(),
         binder=backend,
@@ -992,10 +1069,15 @@ def run_http(args) -> int:
     adapter = K8sWatchAdapter(
         cache, mux, scheduler_name=args.scheduler_name,
         ingest_mode=args.ingest_mode,
+        cell=args.cell,
+        trace_scope="",
     ).start()
+    if args.cell:
+        backend.cell_of_node = adapter.cell_of_node
 
     elector = None
     stop = threading.Event()
+    install_stand_down_signals(stop)
 
     def on_lease_lost() -> None:
         """Deposed (the elector fenced the backend first): quiesce +
@@ -1225,6 +1307,12 @@ def main(argv: list[str] | None = None) -> int:
 
         metrics.set_leadership("leader", lock.epoch)
 
+    if args.cell:
+        logging.warning(
+            "--cell %r ignored: the in-process simulator has no wire "
+            "to fence (cells are a --cluster-stream/--kube-api "
+            "feature)", args.cell,
+        )
     cache, sim = load_world(
         args.workload, args.default_queue, args.scheduler_name
     )
@@ -1249,8 +1337,17 @@ def main(argv: list[str] | None = None) -> int:
     # Sim mode banks + adopts locally (journal-dir discipline; no wire
     # to mirror through) — a restarted sim daemon still warm-starts.
     wire_compile_bank(args, build_compile_bank(args), scheduler)
+    # SIGTERM = graceful stand-down in sim mode too: the loop exits
+    # and the finally runs the statestore's final compaction + the
+    # lock release, instead of the default handler killing the
+    # process mid-journal-write.
+    import threading as _threading
+
+    stop = _threading.Event()
+    install_stand_down_signals(stop)
     try:
         ran = scheduler.run(
+            stop=stop,
             max_cycles=args.cycles,
             on_cycle=sim.tick if sim is not None else None,
         )

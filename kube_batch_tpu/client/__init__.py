@@ -12,6 +12,8 @@ without the Kubernetes dependency.
 """
 
 from kube_batch_tpu.client.adapter import (
+    CELL_LABEL,
+    CellScopeError,
     LeaseElector,
     StaleEpochError,
     StreamBackend,
@@ -27,6 +29,8 @@ from kube_batch_tpu.client.failover import (
 from kube_batch_tpu.client.k8s import K8sWatchAdapter
 
 __all__ = [
+    "CELL_LABEL",
+    "CellScopeError",
     "WatchAdapter",
     "StaleEpochError",
     "StreamBackend",

@@ -106,7 +106,8 @@ class _Scanned:
     the raw line kept for a lazy full parse."""
 
     __slots__ = ("ts", "raw", "msg", "mtype", "kind", "key",
-                 "mergeable", "uid", "status", "node", "rv", "tail")
+                 "mergeable", "uid", "status", "node", "rv", "tail",
+                 "drop")
 
     def __init__(self, ts, raw=None, msg=None, mtype=None, kind=None,
                  key=None, mergeable=True, uid=None, status=None,
@@ -122,11 +123,35 @@ class _Scanned:
         self.status = status
         self.node = node
         self.rv = rv
+        # Cell-filtered out: the record still rides the batch (its RV
+        # must publish — resume points may not regress past foreign
+        # events) but produces no cache op.
+        self.drop = False
         # The LAST MODIFIED coalesced into this record (None = none):
         # the record's own object stays the apply BASIS — a serial
         # chain only ever takes status/node from later events, so the
         # newest object's spec fields must never replace the first's.
         self.tail = None
+
+
+#: Node/pod label key carrying the object's CELL assignment
+#: (doc/design/multi-cell.md).  Queues carry theirs as a first-class
+#: `cell` field; a pod's cell follows its PodGroup's queue, with this
+#: label as the groupless fallback.  An object with no cell ("" /
+#: absent) is SHARED: visible to every cell, writable by any epoch
+#: holder.
+CELL_LABEL = "cell"
+
+
+class CellScopeError(RuntimeError):
+    """A data-plane write targeted an object OUTSIDE the writer's
+    cell — a cell-A scheduler can never bind onto a cell-B node.  Like
+    StaleEpochError it is deliberately a RuntimeError subclass: the
+    wire answered (breaker success, no backoff retry), and retrying
+    cannot help — the write is wrong by construction, not stale.
+    Raised locally by the client's cell fence (the fast-fail mirror of
+    PR 4's epoch fence) and on the cluster's structured ``CellScope``
+    rejection (doc/design/multi-cell.md)."""
 
 
 class StaleEpochError(RuntimeError):
@@ -153,9 +178,14 @@ class StaleEpochError(RuntimeError):
 #: cluster-side mirror, doc/design/compile-artifacts.md) follows the
 #: same rule: fenced write, unfenced read (getCompileArtifact — a
 #: successor adopts artifacts BEFORE its first cycle).
+#: The cross-cell reclaim negotiation verbs (claimCapacity /
+#: offerCapacity) are fenced like every data-plane write: a deposed
+#: cell leader must not keep negotiating capacity transfers its
+#: successor knows nothing about.  The READ verb (listClaims) stays
+#: unfenced, like every adoption-time read.
 FENCED_VERBS = frozenset({
     "bind", "evict", "updatePodGroup", "putStateSnapshot",
-    "putCompileArtifact",
+    "putCompileArtifact", "claimCapacity", "offerCapacity",
 })
 
 
@@ -196,6 +226,19 @@ class StreamBackend:
         # CLUSTER-side epoch check is the authority; this just spares
         # a deposed leader's queued flushes their wire round trips.
         self._fenced = False
+        # -- cell scoping (doc/design/multi-cell.md) --------------------
+        # The cell this scheduler is fenced TO: stamped onto every
+        # request (data-plane writes are rejected cluster-side when
+        # their target lies outside it; lease verbs contend for the
+        # PER-CELL lease).  None = uncelled single-fleet deploys —
+        # nothing is stamped and nothing changes.
+        self._cell: str | None = None
+        # Optional node-name → cell resolver (fed by the cell-scoped
+        # WatchAdapter, which sees every node PRE-filter): the local
+        # half of the cell fence — a bind targeting a foreign node
+        # fails here in microseconds instead of burning the RTT.  The
+        # cluster-side check remains the authority.
+        self.cell_of_node = None
 
     # -- called by WatchAdapter's read loop -----------------------------
     def deliver_response(self, msg: dict) -> None:
@@ -238,6 +281,45 @@ class StreamBackend:
         ingesting, and re-acquiring is how the fence lifts)."""
         self._fenced = True
 
+    # -- cell scoping ---------------------------------------------------
+    @property
+    def cell(self) -> str | None:
+        return self._cell
+
+    def set_cell(self, cell: str | None) -> None:
+        """Fence this backend to one cell: every request is stamped
+        with it (the cluster rejects data-plane writes whose target
+        lies outside), and lease verbs contend for the per-cell
+        lease.  Unlike the epoch, the cell never changes over a
+        backend's lifetime — one scheduler, one cell."""
+        self._cell = cell or None
+
+    def check_cell_target(self, node_name: str) -> None:
+        """The local cell fence (the fast-fail mirror of the epoch
+        fence): raise CellScopeError when `node_name` is KNOWN to lie
+        in a different cell, before the request burns a wire RTT.
+        Unknown nodes pass — the cluster-side check is the
+        authority."""
+        if self._cell is None or self.cell_of_node is None:
+            return
+        try:
+            node_cell = self.cell_of_node(node_name)
+        except Exception:  # noqa: BLE001 — a resolver bug must not
+            return         # turn into a phantom fence
+        if node_cell and node_cell != self._cell:
+            from kube_batch_tpu import metrics, trace
+
+            metrics.cross_cell_writes.inc()
+            trace.note_transition(
+                "cell-scope", where="local-fence", node=node_name,
+                node_cell=node_cell, cell=self._cell,
+            )
+            raise CellScopeError(
+                f"bind targets node {node_name!r} in cell "
+                f"{node_cell!r}; this scheduler is fenced to cell "
+                f"{self._cell!r}"
+            )
+
     @staticmethod
     def _is_fenced_payload(payload: dict) -> bool:
         return "path" in payload or payload.get("verb") in FENCED_VERBS
@@ -260,6 +342,12 @@ class StreamBackend:
                 )
             if self._epoch is not None:
                 payload["epoch"] = self._epoch
+        if self._cell is not None and "cell" not in payload:
+            # Every verb carries the cell: data-plane writes are
+            # cell-scope-checked, lease verbs contend per cell, and
+            # the cluster learns each session's cell for the
+            # partition fault family.
+            payload["cell"] = self._cell
         if self.closed.is_set():
             raise ConnectionError("cluster stream closed")
         rid = next(self._ids)
@@ -306,11 +394,30 @@ class StreamBackend:
                     resp.get("error", ""),
                 )
                 raise StaleEpochError(resp.get("error", "stale epoch"))
+            if resp.get("code") == "CellScope":
+                # The cluster fenced this write by CELL: its target
+                # lies outside this scheduler's cell.  Same posture as
+                # StaleEpoch — loud, counted, never retried.
+                from kube_batch_tpu import metrics, trace
+
+                metrics.cross_cell_writes.inc()
+                trace.note_transition(
+                    "cell-scope", where="cluster-reject",
+                    verb=str(payload.get("verb")
+                             or payload.get("path")),
+                )
+                log.error(
+                    "write rejected by cell-scope fencing (%s): %s",
+                    payload.get("verb") or payload.get("path"),
+                    resp.get("error", ""),
+                )
+                raise CellScopeError(resp.get("error", "cell scope"))
             raise RuntimeError(resp.get("error", "request failed"))
         return resp
 
     # -- the seam (cache/backend.py protocols) --------------------------
     def bind(self, pod: Pod, node_name: str) -> None:
+        self.check_cell_target(node_name)
         self._call({"verb": "bind", "pod": pod.uid, "node": node_name})
 
     def evict(self, pod: Pod, reason: str) -> None:
@@ -632,10 +739,35 @@ class WatchAdapter:
         reader: IO[str],
         backend: StreamBackend | None = None,
         ingest_mode: str | None = None,
+        cell: str | None = None,
+        trace_scope: str | None = None,
     ) -> None:
         self.cache = cache
         self._reader = reader
         self._backend = backend
+        # -- cell-scoped watch filter (doc/design/multi-cell.md) -------
+        # When set, only THIS cell's (and shared) objects reach the
+        # cache: foreign-cell Queues/Nodes are dropped at the door, a
+        # PodGroup follows its queue's cell, a pod follows its group's
+        # (label fallback for groupless pods).  A node RE-CELLED away
+        # (cross-cell reclaim granted its capacity to another cell)
+        # arrives as a MODIFIED carrying the foreign cell and is
+        # rewritten to a DELETED — the mirror drops it exactly as if
+        # the node left the fleet.  Objects are tracked PRE-filter
+        # (node_cells, peer visibility) so the local cell fence and
+        # the /healthz cell_peer_visible probe see the whole fleet.
+        self.cell = cell or None
+        self._queue_cells: dict[str, str] = {}
+        self._group_queues: dict[str, str] = {}
+        self._my_nodes: set[str] = set()
+        self.node_cells: dict[str, str] = {}
+        self.peer_cells_seen: set[str] = set()
+        self.cell_dropped = 0
+        # Observability scope for this adapter's worker threads
+        # (kube_batch_tpu/scope.py): two live schedulers in one
+        # process must not interleave their span trees.
+        self._trace_scope = trace_scope if trace_scope is not None \
+            else (cell or None)
         # The backend generation this adapter's connection belongs to
         # (see StreamBackend.mark_closed's staleness guard).
         self._backend_gen = backend.generation if backend is not None else 0
@@ -710,8 +842,102 @@ class WatchAdapter:
         self._relist_diff = True
         return True
 
+    # -- cell-scoped filtering (doc/design/multi-cell.md) ---------------
+    def adopt_cell_topology(self, old: "WatchAdapter") -> None:
+        """Carry the cell-filter tracking state across a reconnect
+        (the resumed tail replays only what was MISSED, so the new
+        adapter must inherit what the old one already learned) — the
+        ONE place a new tracking field gets added, shared by every
+        reconnect path (CLI supervisor, cells engine)."""
+        self.node_cells.update(old.node_cells)
+        self._queue_cells.update(old._queue_cells)
+        self._group_queues.update(old._group_queues)
+        self._my_nodes.update(old._my_nodes)
+        self.peer_cells_seen.update(old.peer_cells_seen)
+
+    def cell_of_node(self, name: str) -> str:
+        """Cell of a node as last seen on the (pre-filter) watch
+        stream; "" for unknown or shared nodes.  Fed to the backend's
+        local cell fence (StreamBackend.cell_of_node)."""
+        return self.node_cells.get(name, "")
+
+    def _note_peer(self, cell: str) -> None:
+        if cell not in self.peer_cells_seen:
+            self.peer_cells_seen.add(cell)
+        if self.cell is not None:
+            from kube_batch_tpu import metrics
+
+            # Fresh foreign-cell evidence on a live watch: the peer
+            # side of the fleet is VISIBLE from here.  Cleared when
+            # the stream dies (see _run) — a fully partitioned cell
+            # reads false, which is exactly what the "cell dark"
+            # runbook probes for.
+            metrics.set_cell_peer_visible(True, scope=self._trace_scope)
+
+    def _cell_admit(self, mtype: str, kind: str, obj: dict) -> str | None:
+        """The cell filter: returns the mtype to APPLY (possibly
+        rewritten to DELETED for an object re-celled away), or None
+        to drop the event.  Tracks queue/group/node cell assignments
+        PRE-filter so pods resolve through their group's queue and
+        the local cell fence knows every node in the fleet."""
+        mine = self.cell
+        if kind == "Queue":
+            name = obj.get("name")
+            qcell = str(obj.get("cell") or "")
+            if name:
+                self._queue_cells[name] = qcell
+            if qcell and qcell != mine:
+                self._note_peer(qcell)
+                return None
+            return mtype
+        if kind == "Node":
+            name = obj.get("name")
+            ncell = str((obj.get("labels") or {}).get(CELL_LABEL, ""))
+            if name:
+                self.node_cells[name] = ncell
+            if ncell and ncell != mine:
+                self._note_peer(ncell)
+                if name in self._my_nodes:
+                    # Re-celled away (cross-cell reclaim): to this
+                    # cell's mirror the node just LEFT the fleet.
+                    self._my_nodes.discard(name)
+                    return "DELETED"
+                return None
+            if name:
+                if mtype == "DELETED":
+                    self._my_nodes.discard(name)
+                else:
+                    self._my_nodes.add(name)
+            return mtype
+        if kind == "PodGroup":
+            name = obj.get("name")
+            queue = str(obj.get("queue") or "")
+            if name:
+                self._group_queues[name] = queue
+            gcell = self._queue_cells.get(queue, "")
+            if gcell and gcell != mine:
+                self._note_peer(gcell)
+                return None
+            return mtype
+        if kind == "Pod":
+            group = obj.get("group")
+            if group:
+                queue = self._group_queues.get(str(group), "")
+                pcell = self._queue_cells.get(queue, "")
+            else:
+                pcell = str((obj.get("labels") or {}).get(CELL_LABEL, ""))
+            if pcell and pcell != mine:
+                self._note_peer(pcell)
+                return None
+            return mtype
+        return mtype  # other kinds are shared control metadata
+
     # -- the read loop --------------------------------------------------
     def _run(self) -> None:
+        if self._trace_scope is not None:
+            from kube_batch_tpu import scope
+
+            scope.bind(self._trace_scope)
         buf = self._ingest_buf
         wake = self._ingest_wake
         try:
@@ -758,6 +984,15 @@ class WatchAdapter:
             # landed yet (generation-guarded for late deaths besides).
             if self._backend is not None:
                 self._backend.mark_closed(self._backend_gen)
+            if self.cell is not None:
+                # A dead watch can see NO peer: /healthz flips
+                # cell_peer_visible false until a resumed stream
+                # delivers fresh foreign-cell evidence.
+                from kube_batch_tpu import metrics
+
+                metrics.set_cell_peer_visible(
+                    False, scope=self._trace_scope,
+                )
             if buf is not None:
                 self._ingest_eof = True
                 wake.set()  # the ingest thread drains, then stops
@@ -771,6 +1006,10 @@ class WatchAdapter:
         never WAITS for more input — an empty buffer flushes what is
         in hand — so batching adds no idle latency; the size/time caps
         only bound how much a sustained burst can defer its apply."""
+        if self._trace_scope is not None:
+            from kube_batch_tpu import scope
+
+            scope.bind(self._trace_scope)
         buf = self._ingest_buf
         wake = self._ingest_wake
         try:
@@ -930,7 +1169,10 @@ class WatchAdapter:
         JSON parse (their status/node tail is sniffed later, for
         coalescing SURVIVORS only); anything else — and any line the
         sniff rejects — parses fully."""
-        if isinstance(payload, str):
+        if isinstance(payload, str) and self.cell is None:
+            # The envelope sniff cannot see a pod's cell (it lives on
+            # the group's queue); cell-filtered adapters always parse
+            # fully — the filter's correctness beats the parse saving.
             m = _SNIFF_HEAD.match(payload)
             if m is not None and m.group(2) == "Pod":
                 # Hand-rolled construction: this runs once per event
@@ -947,7 +1189,9 @@ class WatchAdapter:
                 rec.uid = uid
                 rec.mergeable = True
                 rec.status = rec.node = rec.rv = rec.tail = None
+                rec.drop = False
                 return rec
+        if isinstance(payload, str):
             msg = json.loads(payload)
             return self._scan_msg(ts, msg)
         return self._scan_msg(ts, payload)
@@ -956,7 +1200,19 @@ class WatchAdapter:
         mtype = msg.get("type")
         kind = msg.get("kind")
         rec = _Scanned(ts, msg=msg, mtype=mtype, kind=kind)
-        if mtype in ("ADDED", "MODIFIED", "DELETED") and kind == "Pod":
+        if self.cell is not None and kind is not None and \
+                mtype in ("ADDED", "MODIFIED", "DELETED"):
+            admitted = self._cell_admit(mtype, kind, msg.get("object") or {})
+            if admitted is None:
+                # Dropped, but the record stays in the batch so its
+                # RV still publishes (resume points must cover
+                # consumed foreign events).
+                rec.drop = True
+                self.cell_dropped += 1
+            elif admitted != mtype:
+                rec.mtype = admitted  # re-celled away → DELETED
+        if rec.mtype in ("ADDED", "MODIFIED", "DELETED") and \
+                kind == "Pod" and not rec.drop:
             uid = (msg.get("object") or {}).get("uid")
             if uid is not None:
                 rec.key = ("Pod", uid)
@@ -1037,6 +1293,8 @@ class WatchAdapter:
         carrying a coalesced `tail` applies its own (basis) event and
         then the tail's final status/node — the serial chain collapsed
         to its first and last elements."""
+        if rec.drop:
+            return None  # cell-filtered: RV tracked, no cache op
         if rec.msg is None and rec.kind == "Pod":
             return self._prepare_pod_fast(rec)
         msg = rec.msg
@@ -1175,7 +1433,7 @@ class WatchAdapter:
         event — must match cache.sweep_unlisted's keying: Pod by uid,
         every other kind by name.  DELETEDs record nothing (a deleted
         object must stay sweepable)."""
-        if rec.mtype == "DELETED":
+        if rec.mtype == "DELETED" or rec.drop:
             return None
         if rec.kind == "Pod" and rec.uid is not None:
             return ("Pod", rec.uid)
@@ -1222,6 +1480,14 @@ class WatchAdapter:
             return
         kind = msg.get("kind")
         self._track_rv(msg, kind)
+        if self.cell is not None and kind is not None and \
+                mtype in ("ADDED", "MODIFIED", "DELETED"):
+            admitted = self._cell_admit(mtype, kind,
+                                        msg.get("object") or {})
+            if admitted is None:
+                self.cell_dropped += 1
+                return
+            mtype = admitted  # re-celled away → DELETED
         decode = DECODERS.get(kind)
         if decode is None or mtype not in ("ADDED", "MODIFIED", "DELETED"):
             log.warning("unknown watch message: type=%s kind=%s", mtype, kind)

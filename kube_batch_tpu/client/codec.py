@@ -190,12 +190,18 @@ def decode_pod_group(d: dict[str, Any]) -> PodGroup:
 
 
 def encode_queue(queue: Queue) -> dict[str, Any]:
-    return {"uid": queue.uid, "name": queue.name, "weight": queue.weight}
+    out = {"uid": queue.uid, "name": queue.name, "weight": queue.weight}
+    if queue.cell:
+        # Only celled queues carry the key: uncelled fleets' wire
+        # shapes (and recorded chaos traces) stay byte-identical.
+        out["cell"] = queue.cell
+    return out
 
 
 def decode_queue(d: dict[str, Any]) -> Queue:
     return Queue(
-        uid=d["uid"], name=d["name"], weight=float(d.get("weight", 1.0))
+        uid=d["uid"], name=d["name"], weight=float(d.get("weight", 1.0)),
+        cell=str(d.get("cell", "")),
     )
 
 

@@ -66,6 +66,21 @@ def stream_pair() -> tuple[IO[str], IO[str], IO[str], IO[str]]:
     )
 
 
+class _CellLease:
+    """One cell's resourcelock: holder + TTL + its monotone fencing
+    epoch.  The default cell "" is the classic single-fleet lease —
+    every pre-cell code path reads/writes it through the back-compat
+    properties below."""
+
+    __slots__ = ("holder", "expires", "epoch", "holders")
+
+    def __init__(self) -> None:
+        self.holder: str | None = None
+        self.expires: float = 0.0
+        self.epoch: int = 0
+        self.holders: dict[int, str] = {}  # audit: epoch → holder
+
+
 class ExternalCluster:
     def __init__(
         self,
@@ -105,21 +120,50 @@ class ExternalCluster:
         self.fail_bind_pods: set[str] = set()  # inject failures by pod name
         self._threads: list[threading.Thread] = []
         self._started = False
-        # -- the resourcelock (≙ resourcelock.LeaseLock on the apiserver)
-        self.lease_holder: str | None = None
-        self.lease_expires: float = 0.0
-        # Fencing epoch: bumped on every acquire that changes hands or
-        # revives an expired lease (≙ leaseTransitions), NEVER reset —
-        # a write stamped with an older epoch is a zombie from a
-        # deposed leader and is rejected below.
-        self.lease_epoch: int = 0
-        self.epoch_holders: dict[int, str] = {}  # audit: epoch → holder
+        # -- the resourcelocks (≙ resourcelock.LeaseLock on the
+        # apiserver), PER CELL (doc/design/multi-cell.md): each cell
+        # mints its own monotone fencing-epoch sequence, so N fenced
+        # schedulers lead N disjoint partitions of the fleet
+        # concurrently.  The default cell "" is the classic
+        # single-fleet lease; the back-compat properties below keep
+        # every pre-cell caller working unchanged.
+        self._cell_leases: dict[str, _CellLease] = {"": _CellLease()}
         self.stale_epoch_rejections = 0
-        # The leader's mirrored operational-state snapshot (statestore
-        # HA adoption): last-write-wins, epoch-fenced on write like
-        # every data-plane verb, readable by any contender.  The k8s
-        # dialect lands here too (ConfigMap-shaped write).
-        self.state_snapshot: dict | None = None
+        # -- cell scoping (doc/design/multi-cell.md) -------------------
+        # Data-plane writes carrying a `cell` are rejected BEFORE any
+        # state is touched when their target (bind: the node; evict /
+        # status: the pod / group via its queue) lies in a DIFFERENT
+        # cell — a cell-A scheduler can never mutate cell-B state.
+        self.cross_cell_rejections = 0
+        #: The cell of the request CURRENTLY dispatching (stashed under
+        #: the cluster lock around _handle, like ChaosCluster's epoch
+        #: stash) — _bind_pod/_evict_pod enforce scope from it for
+        #: BOTH wire dialects.
+        self._req_cell: str | None = None
+        #: writer-id → cell, learned from each session's requests: the
+        #: partition fault family needs to know which sessions belong
+        #: to a dark cell (broadcast suppression keys on this).
+        self._session_cells: dict[int, str] = {}
+        # -- cross-cell reclaim (offerCapacity / claimCapacity) --------
+        # A starved cell REQUESTS capacity; the donor cell's own
+        # scheduler evicts via its normal drain machinery and OFFERS a
+        # freed node; the cluster re-cells it atomically.  A claim the
+        # donor never answers (partition!) times out and ROLLS BACK —
+        # no node is ever left in limbo.  The clock is supplied by the
+        # driver (chaos: the tick counter) via `claim_clock` +
+        # `expire_reclaims`.
+        self.reclaim_claims: dict[int, dict] = {}
+        self._claim_seq = 0
+        self.claim_clock = 0
+        self.reclaim_granted = 0
+        self.reclaim_rolled_back = 0
+        # The leaders' mirrored operational-state snapshots (statestore
+        # HA adoption), PER CELL: last-write-wins within a cell,
+        # epoch-fenced on write like every data-plane verb, readable
+        # by any contender OF THAT CELL — takeover adoption stays
+        # cell-local.  The k8s dialect lands here too (ConfigMap-
+        # shaped write).  Key "" is the classic uncelled snapshot.
+        self.state_snapshots: dict[str, dict | None] = {}
         # The leader's mirrored AOT compile artifacts
         # (doc/design/compile-artifacts.md): entry-name → payload,
         # merged per put (a bank holds MANY programs, unlike the
@@ -130,6 +174,103 @@ class ExternalCluster:
         self.compile_artifacts: dict[str, dict] = {}
         if reader is not None and writer is not None:
             self.attach(reader, writer)
+
+    # -- per-cell lease access + back-compat single-lease surface -------
+    def lease(self, cell: str = "") -> _CellLease:
+        lease = self._cell_leases.get(cell)
+        if lease is None:
+            lease = self._cell_leases[cell] = _CellLease()
+        return lease
+
+    @property
+    def lease_holder(self) -> str | None:
+        return self.lease("").holder
+
+    @lease_holder.setter
+    def lease_holder(self, v: str | None) -> None:
+        self.lease("").holder = v
+
+    @property
+    def lease_expires(self) -> float:
+        return self.lease("").expires
+
+    @lease_expires.setter
+    def lease_expires(self, v: float) -> None:
+        self.lease("").expires = v
+
+    @property
+    def lease_epoch(self) -> int:
+        return self.lease("").epoch
+
+    @lease_epoch.setter
+    def lease_epoch(self, v: int) -> None:
+        self.lease("").epoch = v
+
+    @property
+    def epoch_holders(self) -> dict[int, str]:
+        return self.lease("").holders
+
+    @property
+    def state_snapshot(self) -> dict | None:
+        return self.state_snapshots.get("")
+
+    @state_snapshot.setter
+    def state_snapshot(self, v: dict | None) -> None:
+        self.state_snapshots[""] = v
+
+    # -- cell resolution (doc/design/multi-cell.md) ---------------------
+    def cell_of_node(self, name: str) -> str:
+        """A node's cell assignment ("" = shared / uncelled)."""
+        from kube_batch_tpu.client.adapter import CELL_LABEL
+
+        node = self.nodes.get(name)
+        return str(node.labels.get(CELL_LABEL, "")) if node else ""
+
+    def cell_of_pod(self, pod: Pod) -> str:
+        """A pod's cell: its group's queue's cell, with the pod label
+        as the groupless fallback ("" = shared)."""
+        from kube_batch_tpu.client.adapter import CELL_LABEL
+
+        if pod.group:
+            group = self.groups.get(pod.group)
+            if group is not None:
+                queue = self.queues.get(group.queue)
+                cell = getattr(queue, "cell", "") if queue else ""
+                if cell:
+                    return str(cell)
+        return str(pod.labels.get(CELL_LABEL, ""))
+
+    def _cell_scope_violation(self, pod: Pod | None,
+                              node_name: str | None) -> str | None:
+        """The authoritative cell-scope check, shared by both wire
+        dialects: a write from a cell-declaring session may only touch
+        objects of ITS cell (or shared ones).  Returns the rejection
+        message, or None when the write may proceed.  Uncelled
+        writers (no `cell` on the request) pass — single-fleet
+        deploys are unchanged."""
+        cell = self._req_cell
+        if not cell:
+            return None
+        if node_name is not None and node_name in self.nodes:
+            node_cell = self.cell_of_node(node_name)
+            if node_cell and node_cell != cell:
+                return (
+                    f"cell-scope: node {node_name!r} belongs to cell "
+                    f"{node_cell!r}, writer is fenced to {cell!r}"
+                )
+        if pod is not None:
+            pod_cell = self.cell_of_pod(pod)
+            if pod_cell and pod_cell != cell:
+                return (
+                    f"cell-scope: pod {pod.uid!r} belongs to cell "
+                    f"{pod_cell!r}, writer is fenced to {cell!r}"
+                )
+        return None
+
+    def _reject_cell_scope(self, writer, rid: int, why: str) -> None:
+        self.cross_cell_rejections += 1
+        self._on_cell_reject(why)
+        self._respond(writer, rid, False, why, code="CellScope")
 
     # -- sessions -------------------------------------------------------
     def attach(self, reader: IO[str], writer: IO[str]) -> None:
@@ -173,6 +314,14 @@ class ExternalCluster:
         except (OSError, ValueError):
             pass  # dead session; its reader thread is ending too
 
+    def _session_blocked(self, writer) -> bool:
+        """Broadcast suppression hook: True = this session receives no
+        watch events right now (a fully partitioned cell — see
+        chaos/cells.py).  The event still lands in the history ring,
+        so the healed session resumes the missed tail."""
+        del writer
+        return False
+
     def _emit(self, mtype: str, kind: str, obj: dict) -> None:
         with self._lock:
             self._rv += 1
@@ -182,6 +331,8 @@ class ExternalCluster:
             }
             self._history.append(msg)
             for _r, w in self._sessions:
+                if self._session_blocked(w):
+                    continue
                 self._emit_to(w, None, None, None, raw=msg)
 
     def _respond(
@@ -324,74 +475,93 @@ class ExternalCluster:
         finally:
             # Prune the dead session: repeated failovers must not leave
             # broadcasts writing to an ever-growing list of corpses.
+            # Its cell tag goes too — id() values get recycled, and a
+            # stale entry could mis-tag (and partition-suppress) a
+            # future session whose writer lands on the same address.
             with self._lock:
+                for r, w in self._sessions:
+                    if r is reader:
+                        self._session_cells.pop(id(w), None)
                 self._sessions = [
                     (r, w) for r, w in self._sessions if r is not reader
                 ]
 
     # -- lease arbitration (≙ resourcelock acquire-or-renew) ------------
     def _handle_lease(self, writer, verb: str, msg: dict) -> None:
+        """One cell's resourcelock dance.  The request's `cell`
+        selects WHICH lease ("" = the classic single-fleet one): each
+        cell mints its own monotone epoch sequence, so two cells'
+        leaderships never fence each other."""
         rid, holder = msg["id"], msg.get("holder", "")
+        cell = str(msg.get("cell") or "")
+        lease = self.lease(cell)
         now = time.monotonic()
         if verb == "releaseLease":
-            if self.lease_holder == holder:
-                self.lease_holder = None
-                self.lease_expires = 0.0
+            if lease.holder == holder:
+                lease.holder = None
+                lease.expires = 0.0
                 # The epoch is NOT reset: monotonicity is the fencing
                 # guarantee, and the next acquire mints a fresh one.
             self._respond(writer, rid, True)
             return
         ttl = float(msg.get("ttl", 15.0))
-        expired = now >= self.lease_expires
-        if verb == "renewLease" and self.lease_holder != holder:
+        expired = now >= lease.expires
+        if verb == "renewLease" and lease.holder != holder:
             # A renewal after the lease was TAKEN must fail: the old
             # holder has to stand down (≙ RunOrDie's OnStoppedLeading).
             # A merely-expired-but-unclaimed lease renews fine — the
             # holder was just slow, and nobody else is leading.
             self._respond(
                 writer, rid, False,
-                f"lease lost (held by {self.lease_holder!r})",
+                f"lease lost (held by {lease.holder!r})",
             )
             return
-        if verb == "acquireLease" and not expired and self.lease_holder not in (
+        if verb == "acquireLease" and not expired and lease.holder not in (
             None, holder
         ):
             self._respond(
                 writer, rid, False,
-                f"lease held by {self.lease_holder!r} for "
-                f"{self.lease_expires - now:.1f}s",
+                f"lease held by {lease.holder!r} for "
+                f"{lease.expires - now:.1f}s",
             )
             return
         if verb == "acquireLease" and (
-            self.lease_holder != holder or expired or self.lease_epoch == 0
+            lease.holder != holder or expired or lease.epoch == 0
         ):
             # A change of hands (or reviving an expired lease — even by
             # its previous holder: its pre-expiry in-flight writes are
             # no longer trustworthy) mints the next epoch.  An
             # idempotent re-acquire by the live current holder keeps
             # its epoch.
-            self.lease_epoch += 1
-            self.epoch_holders[self.lease_epoch] = holder
-            self._on_epoch_advance(self.lease_epoch, holder)
-        self.lease_holder = holder
-        self.lease_expires = now + ttl
+            lease.epoch += 1
+            lease.holders[lease.epoch] = holder
+            self._on_epoch_advance(lease.epoch, holder, cell)
+        lease.holder = holder
+        lease.expires = now + ttl
         self._respond(writer, rid, True,
-                      extra={"epoch": self.lease_epoch})
+                      extra={"epoch": lease.epoch})
 
-    def expire_lease(self) -> None:
-        """Force the current lease to expire NOW (≙ the holder's
+    def expire_lease(self, cell: str = "") -> None:
+        """Force a cell's lease to expire NOW (≙ the holder's
         renewals stopping and the TTL running out — a leader crash as
         the cluster observes it): the next acquire by anyone succeeds
         and mints a higher epoch.  The holder field is left as the
         corpse's identity, exactly like a real resourcelock."""
         with self._lock:
-            self.lease_expires = 0.0
+            self.lease(cell).expires = 0.0
 
     # Hooks a subclass (chaos/faults.ChaosCluster) can instrument.
-    def _on_epoch_advance(self, epoch: int, holder: str) -> None:
+    def _on_epoch_advance(self, epoch: int, holder: str,
+                          cell: str = "") -> None:
         pass
 
     def _on_stale_reject(self, msg: dict) -> None:
+        pass
+
+    def _on_cell_reject(self, why: str) -> None:
+        pass
+
+    def _on_reclaim(self, entry: dict) -> None:
         pass
 
     @property
@@ -411,21 +581,24 @@ class ExternalCluster:
         """True when the request may proceed.  A data-plane write
         stamped with a non-current epoch is a zombie — rejected with
         the structured StaleEpoch code (no retry: the caller's
-        leadership is gone, not its wire)."""
+        leadership is gone, not its wire).  The epoch is checked
+        against the WRITER'S CELL's lease: each cell fences its own
+        epoch sequence."""
         epoch = msg.get("epoch")
         if epoch is None:
             return True  # unfenced caller (no leader election wired)
         verb = msg.get("verb")
         if "path" not in msg and verb not in self.FENCED_VERBS:
             return True
-        if int(epoch) == self.lease_epoch:
+        lease = self.lease(str(msg.get("cell") or ""))
+        if int(epoch) == lease.epoch:
             return True
         self.stale_epoch_rejections += 1
         self._on_stale_reject(msg)
         self._respond(
             writer, msg["id"], False,
             f"stale epoch {epoch} (current epoch "
-            f"{self.lease_epoch}, holder {self.lease_holder!r})",
+            f"{lease.epoch}, holder {lease.holder!r})",
             code="StaleEpoch",
         )
         return False
@@ -453,8 +626,17 @@ class ExternalCluster:
 
     def _bind_pod(self, writer, rid: int, pod: Pod | None,
                   node_name: str) -> None:
-        """Shared bind semantics for both wire dialects."""
-        if pod is None:
+        """Shared bind semantics for both wire dialects.  Cell scope
+        is enforced HERE, cluster-side, before any state is touched:
+        a cell-A scheduler can never bind onto a cell-B node (or bind
+        a cell-B pod), whatever its epoch says."""
+        scope_err = (
+            self._cell_scope_violation(pod, node_name)
+            if pod is not None else None
+        )
+        if scope_err is not None:
+            self._reject_cell_scope(writer, rid, scope_err)
+        elif pod is None:
             self._respond(writer, rid, False, "pod not found")
         elif pod.name in self.fail_bind_pods:
             self._respond(writer, rid, False, "injected bind failure")
@@ -469,7 +651,13 @@ class ExternalCluster:
 
     def _evict_pod(self, writer, rid: int, pod: Pod | None,
                    reason: str) -> None:
-        if pod is None:
+        scope_err = (
+            self._cell_scope_violation(pod, None)
+            if pod is not None else None
+        )
+        if scope_err is not None:
+            self._reject_cell_scope(writer, rid, scope_err)
+        elif pod is None:
             self._respond(writer, rid, False, "pod not found")
         else:
             pod.node = None
@@ -631,7 +819,7 @@ class ExternalCluster:
                 self._respond(writer, rid, False,
                               "state ConfigMap data.state is not JSON")
                 return
-            self.state_snapshot = (
+            self.state_snapshots[self._req_cell or ""] = (
                 payload if isinstance(payload, dict) else None
             )
             self._respond(writer, rid, True)
@@ -702,64 +890,239 @@ class ExternalCluster:
     def _handle(self, writer: IO[str], msg: dict) -> None:
         verb, rid = msg.get("verb"), msg["id"]
         with self._lock:
-            if not self._check_epoch(writer, msg):
-                return  # zombie write from a deposed epoch: rejected
-            if "path" in msg:  # apiserver-dialect write
-                self._handle_k8s(writer, msg)
-            elif verb == "watchResume":
-                self._handle_watch_resume(writer, rid,
-                                          int(msg.get("since", 0)))
-            elif verb == "list":
-                self._respond(writer, rid, True)
-                self.replay(writer)
-            elif verb in ("acquireLease", "renewLease", "releaseLease"):
-                self._handle_lease(writer, verb, msg)
-            elif verb == "bind":
-                self._bind_pod(
-                    writer, rid, self.pods.get(msg["pod"]), msg["node"]
-                )
-            elif verb == "evict":
-                self._evict_pod(
-                    writer, rid, self.pods.get(msg["pod"]),
-                    msg.get("reason", ""),
-                )
-            elif verb == "ping":
-                # Health probe (the wire breaker's half-open check):
-                # answer, touch nothing.
-                self._respond(writer, rid, True)
-            elif verb == "putStateSnapshot":
-                # The statestore's HA mirror (epoch-fenced above):
-                # last-write-wins, no watch event — control-plane
-                # metadata, not cluster state.
-                obj = msg.get("object")
-                self.state_snapshot = obj if isinstance(obj, dict) else None
-                self._respond(writer, rid, True)
-            elif verb == "getStateSnapshot":
-                self._respond(writer, rid, True,
-                              extra={"object": self.state_snapshot})
-            elif verb == "putCompileArtifact":
-                # The AOT artifact bank's cluster-side mirror
-                # (epoch-fenced above): one entry merged per put, no
-                # watch event — control-plane metadata like the state
-                # snapshot, but a SET (a bank holds many programs).
-                obj = msg.get("object")
-                if not isinstance(obj, dict):
-                    self._respond(writer, rid, False,
-                                  "malformed compile artifact")
-                else:
-                    self._merge_compile_artifact(obj)
-                    self._respond(writer, rid, True)
-            elif verb == "getCompileArtifact":
-                self._respond(writer, rid, True, extra={
-                    "object": list(self.compile_artifacts.values()),
-                })
-            elif verb == "updatePodGroup":
-                from kube_batch_tpu.client.codec import decode_pod_group
+            cell = msg.get("cell")
+            if cell is not None:
+                # Tag the session (the partition fault family keys
+                # broadcast suppression on it) and stash the request
+                # cell for the dialect-shared scope checks.
+                self._session_cells[id(writer)] = str(cell)
+            self._req_cell = str(cell) if cell is not None else None
+            try:
+                self._handle_locked(writer, verb, rid, msg)
+            finally:
+                self._req_cell = None
 
-                group = decode_pod_group(msg["object"])
-                if group.name in self.groups:
-                    self.groups[group.name] = group
-                self.status_updates.append(group)
-                self._respond(writer, rid, True)
+    def _handle_locked(self, writer: IO[str], verb, rid,
+                   msg: dict) -> None:
+        if not self._check_epoch(writer, msg):
+            return  # zombie write from a deposed epoch: rejected
+        if "path" in msg:  # apiserver-dialect write
+            self._handle_k8s(writer, msg)
+        elif verb == "watchResume":
+            self._handle_watch_resume(writer, rid,
+                                      int(msg.get("since", 0)))
+        elif verb == "list":
+            self._respond(writer, rid, True)
+            self.replay(writer)
+        elif verb in ("acquireLease", "renewLease", "releaseLease"):
+            self._handle_lease(writer, verb, msg)
+        elif verb == "bind":
+            self._bind_pod(
+                writer, rid, self.pods.get(msg["pod"]), msg["node"]
+            )
+        elif verb == "evict":
+            self._evict_pod(
+                writer, rid, self.pods.get(msg["pod"]),
+                msg.get("reason", ""),
+            )
+        elif verb == "ping":
+            # Health probe (the wire breaker's half-open check):
+            # answer, touch nothing.
+            self._respond(writer, rid, True)
+        elif verb == "putStateSnapshot":
+            # The statestore's HA mirror (epoch-fenced above):
+            # last-write-wins PER CELL, no watch event —
+            # control-plane metadata, not cluster state.  A cell's
+            # takeover successor adopts ITS cell's snapshot only.
+            obj = msg.get("object")
+            self.state_snapshots[self._req_cell or ""] = (
+                obj if isinstance(obj, dict) else None
+            )
+            self._respond(writer, rid, True)
+        elif verb == "getStateSnapshot":
+            self._respond(writer, rid, True, extra={
+                "object": self.state_snapshots.get(
+                    self._req_cell or ""
+                ),
+            })
+        elif verb == "claimCapacity":
+            self._handle_claim(writer, rid, msg)
+        elif verb == "offerCapacity":
+            self._handle_offer(writer, rid, msg)
+        elif verb == "listClaims":
+            # Unfenced read: the donor cell's scheduler polls for
+            # claims targeting it (adoption-time reads never need
+            # leadership).
+            donor = str(msg.get("cell") or "")
+            claims = [
+                dict(c) for _cid, c in sorted(
+                    self.reclaim_claims.items()
+                )
+                if c["from"] == donor and c["state"] == "pending"
+            ]
+            self._respond(writer, rid, True,
+                          extra={"object": claims})
+        elif verb == "putCompileArtifact":
+            # The AOT artifact bank's cluster-side mirror
+            # (epoch-fenced above): one entry merged per put, no
+            # watch event — control-plane metadata like the state
+            # snapshot, but a SET (a bank holds many programs).
+            obj = msg.get("object")
+            if not isinstance(obj, dict):
+                self._respond(writer, rid, False,
+                              "malformed compile artifact")
             else:
-                self._respond(writer, rid, False, f"unknown verb {verb}")
+                self._merge_compile_artifact(obj)
+                self._respond(writer, rid, True)
+        elif verb == "getCompileArtifact":
+            self._respond(writer, rid, True, extra={
+                "object": list(self.compile_artifacts.values()),
+            })
+        elif verb == "updatePodGroup":
+            from kube_batch_tpu.client.codec import decode_pod_group
+
+            group = decode_pod_group(msg["object"])
+            if self._req_cell:
+                queue = self.queues.get(group.queue)
+                gcell = getattr(queue, "cell", "") if queue else ""
+                if gcell and gcell != self._req_cell:
+                    self._reject_cell_scope(
+                        writer, rid,
+                        f"cell-scope: group {group.name!r} belongs "
+                        f"to cell {gcell!r}, writer is fenced to "
+                        f"{self._req_cell!r}",
+                    )
+                    return
+            if group.name in self.groups:
+                self.groups[group.name] = group
+            self.status_updates.append(group)
+            self._respond(writer, rid, True)
+        else:
+            self._respond(writer, rid, False, f"unknown verb {verb}")
+
+    # -- cross-cell reclaim (doc/design/multi-cell.md) ------------------
+    #: Default claim TTL in claim-clock units (chaos: ticks) when the
+    #: claimant names none.
+    RECLAIM_TTL_DEFAULT = 8
+
+    def _handle_claim(self, writer, rid: int, msg: dict) -> None:
+        """A starved cell REQUESTS capacity from a donor cell.  The
+        cluster records the pending claim; the donor's own scheduler
+        discovers it (listClaims), frees a node through its normal
+        drain machinery, and offers it back.  Nothing moves yet —
+        creation is bookkeeping only, so a claim that dies with a
+        partition rolls back to exactly nothing."""
+        to_cell = str(msg.get("cell") or "")
+        donor = str(msg.get("from") or "")
+        if not to_cell or not donor or donor == to_cell:
+            self._respond(
+                writer, rid, False,
+                f"malformed capacity claim (cell={to_cell!r} "
+                f"from={donor!r})",
+            )
+            return
+        ttl = int(msg.get("ttlTicks", self.RECLAIM_TTL_DEFAULT))
+        self._claim_seq += 1
+        claim = {
+            "id": self._claim_seq,
+            "to": to_cell,
+            "from": donor,
+            "state": "pending",
+            "created": self.claim_clock,
+            "deadline": self.claim_clock + max(ttl, 1),
+            "node": None,
+        }
+        self.reclaim_claims[claim["id"]] = claim
+        self._on_reclaim({
+            "op": "reclaim-claim", "claim": claim["id"],
+            "to": to_cell, "from": donor,
+            "deadline": claim["deadline"],
+        })
+        self._respond(writer, rid, True, extra={"claim": claim["id"]})
+
+    def _handle_offer(self, writer, rid: int, msg: dict) -> None:
+        """The donor cell OFFERS a freed node against a pending claim.
+        The transfer is atomic under the cluster lock: validate, then
+        re-cell the node and mark the claim granted in one step — the
+        watch broadcast makes the node vanish from the donor's filter
+        and appear in the claimant's.  An offer for a rolled-back (or
+        unknown) claim is refused outright: after a partition the
+        donor's drain was wasted work, but no node leaks into limbo."""
+        from kube_batch_tpu.client.adapter import CELL_LABEL
+        from kube_batch_tpu.api.types import TaskStatus
+
+        donor = str(msg.get("cell") or "")
+        claim = self.reclaim_claims.get(int(msg.get("claim", 0)))
+        node = self.nodes.get(str(msg.get("node") or ""))
+        if claim is None or claim["state"] != "pending":
+            self._respond(
+                writer, rid, False,
+                f"claim {msg.get('claim')!r} is not pending "
+                f"(state {claim['state'] if claim else 'unknown'!r})",
+            )
+            return
+        if claim["from"] != donor:
+            self._respond(
+                writer, rid, False,
+                f"claim {claim['id']} names donor {claim['from']!r}, "
+                f"not {donor!r}",
+            )
+            return
+        if node is None:
+            self._respond(writer, rid, False,
+                          f"node {msg.get('node')!r} not found")
+            return
+        if self.cell_of_node(node.name) != donor:
+            self._respond(
+                writer, rid, False,
+                f"node {node.name!r} is not in donor cell {donor!r}",
+            )
+            return
+        residents = sorted(
+            p.name for p in self.pods.values()
+            if p.node == node.name and p.status in (
+                TaskStatus.BOUND, TaskStatus.RUNNING,
+            )
+        )
+        if residents:
+            # The donor must drain FIRST (its own scheduler, its own
+            # evictions) — re-celling a node under live residents
+            # would strand them outside their scheduler's scope.
+            self._respond(
+                writer, rid, False,
+                f"node {node.name!r} still has resident pod(s) "
+                f"{residents[:4]} — drain before offering",
+            )
+            return
+        node.labels = {**node.labels, CELL_LABEL: claim["to"]}
+        claim["state"] = "granted"
+        claim["node"] = node.name
+        self.reclaim_granted += 1
+        self._on_reclaim({
+            "op": "reclaim-grant", "claim": claim["id"],
+            "node": node.name, "to": claim["to"], "from": donor,
+        })
+        self._respond(writer, rid, True)
+        self._emit("MODIFIED", "Node", encode_node(node))
+
+    def expire_reclaims(self) -> int:
+        """Roll back every pending claim past its deadline (driver-
+        clocked via `claim_clock`): the donor partitioned — or just
+        never answered — and the claim must die cleanly.  Nothing was
+        re-celled for a pending claim, so rollback is pure
+        bookkeeping; the claimant re-claims after heal.  Returns the
+        number rolled back."""
+        rolled = 0
+        with self._lock:
+            for cid in sorted(self.reclaim_claims):
+                claim = self.reclaim_claims[cid]
+                if claim["state"] == "pending" and \
+                        self.claim_clock >= claim["deadline"]:
+                    claim["state"] = "rolled-back"
+                    self.reclaim_rolled_back += 1
+                    rolled += 1
+                    self._on_reclaim({
+                        "op": "reclaim-rollback", "claim": cid,
+                        "to": claim["to"], "from": claim["from"],
+                    })
+        return rolled

@@ -792,6 +792,28 @@ class K8sHttpBackend:
     def fence(self) -> None:
         self._fenced = True
 
+    # -- cell scoping (same surface as StreamBackend) -------------------
+    # A real apiserver cannot reject Binding POSTs by cell without an
+    # admission webhook, so — exactly like the HTTP epoch fence — the
+    # CLIENT-side half is the load-bearing one here: the cell-scoped
+    # watch filter keeps foreign objects out of the mirror, and the
+    # local fence below fast-fails any bind that still names a
+    # foreign node.
+    _cell: str | None = None
+    cell_of_node = None  # resolver installed by the CLI wiring
+
+    @property
+    def cell(self) -> str | None:
+        return self._cell
+
+    def set_cell(self, cell: str | None) -> None:
+        self._cell = cell or None
+
+    def check_cell_target(self, node_name: str) -> None:
+        from kube_batch_tpu.client.adapter import StreamBackend
+
+        StreamBackend.check_cell_target(self, node_name)
+
     def _check_fence(self) -> None:
         if self._fenced:
             from kube_batch_tpu import metrics, trace
@@ -806,6 +828,7 @@ class K8sHttpBackend:
 
     def bind(self, pod: Pod, node_name: str) -> None:
         self._check_fence()
+        self.check_cell_target(node_name)
         self._issue(binding_request(pod, node_name))
 
     def evict(self, pod: Pod, reason: str) -> None:

@@ -511,10 +511,52 @@ class K8sWatchAdapter(WatchAdapter):
         backend=None,
         scheduler_name: str = DEFAULT_SCHEDULER_NAME,
         ingest_mode: str | None = None,
+        cell: str | None = None,
+        trace_scope: str | None = None,
     ) -> None:
-        super().__init__(cache, reader, backend, ingest_mode=ingest_mode)
+        super().__init__(cache, reader, backend,
+                         ingest_mode=ingest_mode, cell=cell,
+                         trace_scope=trace_scope)
         self.decoder = K8sDecoder(cache.spec, scheduler_name)
         self.ignored_pods = 0  # foreign/terminal pods filtered out
+
+    def _k8s_cell_admit(self, mtype: str | None, obj: dict) -> str | None:
+        """Cell filter for k8s-dialect lines — the same contract as
+        the native `_cell_admit`: returns the mtype to APPLY (a node
+        re-celled away rewrites to a synthetic DELETED so the old
+        cell's mirror drops it), or None to drop the event.  Nodes
+        and Pods carry their cell as a metadata label
+        (doc/design/multi-cell.md); node cells are tracked PRE-filter
+        so the local cell fence (backend.cell_of_node) covers the
+        whole fleet in this dialect too.  (Queue/PodGroup indirection
+        is a native-dialect feature — k8s pods label their cell
+        directly.)"""
+        kind = obj.get("kind")
+        if self.cell is None or kind not in ("Node", "Pod"):
+            return mtype
+        from kube_batch_tpu.client.adapter import CELL_LABEL
+
+        meta = obj.get("metadata") or {}
+        labels = meta.get("labels") or {}
+        ocell = str(labels.get(CELL_LABEL, ""))
+        name = meta.get("name")
+        if kind == "Node" and name:
+            self.node_cells[name] = ocell
+        if ocell and ocell != self.cell:
+            self._note_peer(ocell)
+            if kind == "Node" and name in self._my_nodes:
+                # Re-celled away: to this cell's mirror the node just
+                # left the fleet.
+                self._my_nodes.discard(name)
+                return "DELETED"
+            self.cell_dropped += 1
+            return None
+        if kind == "Node" and name:
+            if mtype == "DELETED":
+                self._my_nodes.discard(name)
+            else:
+                self._my_nodes.add(name)
+        return mtype
 
     def _dispatch(self, msg: dict) -> None:
         obj = msg.get("object")
@@ -525,8 +567,13 @@ class K8sWatchAdapter(WatchAdapter):
                                                  msg.get("resourceVersion"))
             if rv is not None:
                 self._track_rv({"resourceVersion": rv}, obj.get("kind"))
+            mtype = msg.get("type")
+            if self.cell is not None:
+                mtype = self._k8s_cell_admit(mtype, obj)
+                if mtype is None:
+                    return
             try:
-                self._apply_k8s(msg.get("type"), obj)
+                self._apply_k8s(mtype, obj)
             except Exception:  # noqa: BLE001 — one bad event ≠ dead ingest
                 log.exception(
                     "k8s event handler failed: %s %s",
@@ -549,6 +596,12 @@ class K8sWatchAdapter(WatchAdapter):
             return super()._scan_msg(ts, msg)
         kind = obj.get("kind")
         rec = _Scanned(ts, msg=msg, mtype=msg.get("type"), kind=kind)
+        if self.cell is not None:
+            admitted = self._k8s_cell_admit(rec.mtype, obj)
+            if admitted is None:
+                rec.drop = True  # RV still publishes via the batch
+                return rec
+            rec.mtype = admitted  # re-celled away → DELETED
         if kind == "PriorityClass":
             # Decoder-state: a merge-window barrier (no pod decode may
             # cross it — see WatchAdapter._coalesce).
@@ -565,6 +618,8 @@ class K8sWatchAdapter(WatchAdapter):
         return rec
 
     def _prepare_op(self, rec: _Scanned):
+        if rec.drop:
+            return None  # cell-filtered: RV tracked, no cache op
         msg, obj = rec.msg, None
         if msg is not None:
             obj = msg.get("object")

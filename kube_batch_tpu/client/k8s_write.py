@@ -339,6 +339,10 @@ class K8sStreamBackend(StreamBackend):
 
     # -- the Binder/Evictor/StatusUpdater seam --------------------------
     def bind(self, pod: Pod, node_name: str) -> None:
+        # The local cell fence applies to the apiserver dialect too:
+        # a Binding POST targeting a foreign-cell node fails here
+        # before the RTT (cluster-side scope check is the authority).
+        self.check_cell_target(node_name)
         self._call(binding_request(pod, node_name))
 
     def evict(self, pod: Pod, reason: str) -> None:

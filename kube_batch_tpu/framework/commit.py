@@ -126,8 +126,14 @@ class CommitPipeline:
         workers: int = DEFAULT_WORKERS,
         name: str = "commit",
         on_flush=None,
+        trace_scope: str | None = None,
     ) -> None:
         self._cache = cache
+        #: Observability scope the flush workers bind at thread start
+        #: (kube_batch_tpu/scope.py): a multi-scheduler process routes
+        #: each pipeline's spans/transitions to its OWNING scheduler's
+        #: tracer instead of interleaving them.
+        self._trace_scope = trace_scope
         self.max_inflight = max(int(max_inflight), 1)
         self._nworkers = max(int(workers), 1)
         self.name = name
@@ -232,6 +238,10 @@ class CommitPipeline:
     # -- the flush loop --------------------------------------------------
     def _worker(self) -> None:
         _worker_tls.active = True
+        if self._trace_scope is not None:
+            from kube_batch_tpu import scope
+
+            scope.bind(self._trace_scope)
         while True:
             with self._cv:
                 while not self._ready:

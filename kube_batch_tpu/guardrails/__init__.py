@@ -125,8 +125,15 @@ class Guardrails:
     `/healthz` + metrics gauges (`guardrail_state`, `breaker_state`).
     """
 
-    def __init__(self, config: GuardrailConfig | None = None) -> None:
+    def __init__(self, config: GuardrailConfig | None = None,
+                 scope: str | None = None) -> None:
         self.config = config or GuardrailConfig.from_env()
+        #: /healthz publication scope (kube_batch_tpu/scope.py): None
+        #: = the process-global body (single-scheduler deploys); a
+        #: cell name routes this instance's ladder/leadership state
+        #: into the per-scope registry so two LIVE schedulers in one
+        #: process never stomp each other's health.
+        self.scope = scope
         ceiling = self.config.hbm_ceiling_mb
         self.hbm = HbmCeiling(
             int(ceiling * 1e6) if ceiling else None
@@ -303,7 +310,7 @@ class Guardrails:
             and self.breaker.state != CircuitBreaker.CLOSED
         ):
             rung = max(rung, 1)
-        metrics.set_health_state(RUNGS[rung])
+        metrics.set_health_state(RUNGS[rung], scope=self.scope)
 
     def note_hbm_block(self, blocked: bool) -> None:
         """Scheduler hook: the cycle's solve was (or no longer is)
@@ -318,7 +325,7 @@ class Guardrails:
         epoch to /healthz and the `leader_epoch` gauge, and event the
         transition — failover runbooks read role+epoch before anything
         else (doc/design/failover-fencing.md)."""
-        metrics.set_leadership(role, epoch or 0)
+        metrics.set_leadership(role, epoch or 0, scope=self.scope)
         log.info("leadership: %s (epoch %s)", role, epoch)
         if cache is not None:
             cache.record_event(

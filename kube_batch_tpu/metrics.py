@@ -520,6 +520,13 @@ leader_epoch = REGISTRY.register(Gauge(
 # live daemon's published epoch — transitions publish via
 # set_leadership only.
 leader_epoch.set(0.0)
+cross_cell_writes = REGISTRY.register(Counter(
+    "cross_cell_writes_total",
+    "Data-plane writes rejected by cell-scope fencing (cluster-side "
+    "CellScope answers plus locally-fenced fast-fails): each one is a "
+    "write that targeted an object OUTSIDE the writer's cell and was "
+    "PREVENTED from mutating it (doc/design/multi-cell.md).",
+))
 stale_epoch_writes = REGISTRY.register(Counter(
     "stale_epoch_writes_total",
     "Data-plane writes rejected by epoch fencing (cluster-side "
@@ -541,46 +548,134 @@ _health_role = "standby"
 _health_epoch = 0
 _health_quarantined = 0
 _health_ingest_lag = 0.0
+_health_cell = ""
+_health_cell_peer_visible: bool | None = None
+#: Per-SCOPE health registry (multi-scheduler-per-process): a live
+#: scheduler driven under a bound scope (kube_batch_tpu/scope.py —
+#: the cell name) publishes here instead of stomping the process-
+#: global fields above; /healthz surfaces the whole registry under
+#: "cells".  Empty in single-scheduler processes — zero change.
+_health_scopes: dict[str, dict] = {}
 
 
-def set_health_state(state: str) -> None:
+def _resolve_scope(scope) -> str | None:
+    """Explicit scope argument wins; else the calling thread's bound
+    scope (kube_batch_tpu/scope.py); else None = process-global.
+    "" normalizes to None either way — a thread explicitly bound to
+    the EMPTY scope (single-scheduler daemon worker threads) must
+    publish to the process-global fields, never a phantom "" entry."""
+    if scope is not None:
+        return scope or None
+    from kube_batch_tpu import scope as scope_mod
+
+    return scope_mod.current() or None
+
+
+def _scope_entry(name: str) -> dict:
+    return _health_scopes.setdefault(name, {
+        "state": "ok", "role": "standby", "epoch": 0,
+        "quarantined": 0, "cell_peer_visible": None,
+    })
+
+
+def set_health_state(state: str, scope: str | None = None) -> None:
     """Transition the /healthz body's `state` (ok | degraded |
     overloaded) — the watchdog's rung, externally observable without
-    scraping metrics (load-balancers and runbooks read this)."""
+    scraping metrics (load-balancers and runbooks read this).  Under
+    a bound scope (a cell's scheduler) the transition lands in that
+    scope's registry entry instead of the process-global field."""
     global _health_state
+    s = _resolve_scope(scope)
     with _health_lock:
-        _health_state = state
+        if s is not None:
+            _scope_entry(s)["state"] = state
+        else:
+            _health_state = state
 
 
-def health_state() -> str:
+def health_state(scope: str | None = None) -> str:
+    s = _resolve_scope(scope)
     with _health_lock:
+        if s is not None:
+            # Non-creating read: probing an unknown scope must not
+            # materialize a phantom /healthz "cells" entry.
+            entry = _health_scopes.get(s)
+            return entry["state"] if entry else "ok"
         return _health_state
 
 
-def set_leadership(role: str, epoch: int) -> None:
+def set_leadership(role: str, epoch: int,
+                   scope: str | None = None) -> None:
     """Publish this process's election role ("leader" | "standby")
     and fencing epoch to /healthz and the `leader_epoch` gauge — the
     runbook's first question after a failover is "who leads, and at
     what epoch" (doc/design/failover-fencing.md)."""
     global _health_role, _health_epoch
+    s = _resolve_scope(scope)
     with _health_lock:
-        _health_role = role
-        _health_epoch = int(epoch)
-    leader_epoch.set(float(epoch))
+        if s is not None:
+            entry = _scope_entry(s)
+            entry["role"] = role
+            entry["epoch"] = int(epoch)
+        else:
+            _health_role = role
+            _health_epoch = int(epoch)
+    if s is None:
+        leader_epoch.set(float(epoch))
 
 
-def leadership() -> tuple[str, int]:
+def leadership(scope: str | None = None) -> tuple[str, int]:
+    s = _resolve_scope(scope)
     with _health_lock:
+        if s is not None:
+            entry = _health_scopes.get(s)  # non-creating, like health_state
+            return (entry["role"], entry["epoch"]) if entry \
+                else ("standby", 0)
         return _health_role, _health_epoch
 
 
-def set_quarantined(count: int) -> None:
+def set_quarantined(count: int, scope: str | None = None) -> None:
     """Publish the health ledger's cordoned-node count to /healthz —
     a fleet runbook's "is degraded hardware masked right now" read,
     without scraping /metrics (doc/design/node-health.md)."""
     global _health_quarantined
+    s = _resolve_scope(scope)
     with _health_lock:
-        _health_quarantined = int(count)
+        if s is not None:
+            _scope_entry(s)["quarantined"] = int(count)
+        else:
+            _health_quarantined = int(count)
+
+
+def set_cell(name: str) -> None:
+    """Publish this process's cell assignment to /healthz ("" =
+    uncelled single-fleet deploy) — doc/design/multi-cell.md."""
+    global _health_cell
+    with _health_lock:
+        _health_cell = str(name or "")
+
+
+def set_cell_peer_visible(visible: bool | None,
+                          scope: str | None = None) -> None:
+    """Publish whether PEER-cell evidence is currently visible on a
+    live watch stream: true = foreign-cell objects observed and the
+    stream is up; false = stream dead or no foreign evidence since
+    reconnect; null = not a celled deploy.  The "cell dark" runbook's
+    discriminator: a fully partitioned cell reads false while its
+    local process is otherwise healthy (doc/design/multi-cell.md)."""
+    global _health_cell_peer_visible
+    s = _resolve_scope(scope)
+    with _health_lock:
+        if s is not None:
+            _scope_entry(s)["cell_peer_visible"] = visible
+        else:
+            _health_cell_peer_visible = visible
+
+
+def reset_health_scopes() -> None:
+    """Drop every per-scope health entry (test / engine teardown)."""
+    with _health_lock:
+        _health_scopes.clear()
 
 
 def quarantined() -> int:
@@ -618,7 +713,20 @@ def health_body() -> bytes:
             # series; here they are one cheap GET away for a liveness
             # probe or a runbook's first look.
             "ingest_lag_seconds": round(_health_ingest_lag, 3),
+            # Cell identity + peer visibility (doc/design/
+            # multi-cell.md): probes triaging a "cell dark" page
+            # distinguish a partitioned cell (healthy process,
+            # cell_peer_visible false) from a dead leader (no
+            # response at all) from a breaker-open one (state
+            # degraded, peer still visible).
+            "cell": _health_cell,
+            "cell_peer_visible": _health_cell_peer_visible,
         }
+        if _health_scopes:
+            body["cells"] = {
+                name: dict(entry)
+                for name, entry in sorted(_health_scopes.items())
+            }
     body["commit_queue_depth"] = int(commit_queue_depth.value())
     # Compile-ladder pressure (doc/design/compile-artifacts.md): a
     # probe or runbook's first question during a slow-cycle incident

@@ -1,0 +1,57 @@
+"""Thread-bound subsystem scope for multi-scheduler-per-process runs.
+
+PR 2 stopped a second constructed Scheduler from STOMPING the
+process-global observability state (gauges, tracer); multi-cell
+scale-out needs the stronger form: two LIVE schedulers in one process
+(the 2-cell chaos drive, the bench aggregate section) must not
+interleave their span trees, decision records, flight-recorder rings
+or /healthz ladder states.  The fix is a per-scheduler SCOPE — the
+cell name — bound to whichever thread is currently doing that
+scheduler's work:
+
+* the driving thread binds the cell's scope around `run_once`;
+* a scheduler-owned worker thread (watch ingest applier, commit flush
+  workers) binds its owner's scope once at thread start;
+* the process-global facades (`kube_batch_tpu.trace`,
+  `metrics.set_health_state` & friends) resolve the CURRENT scope
+  first and fall back to the legacy process-global state when no
+  scope is bound — single-scheduler processes see zero change.
+
+Deliberately a leaf module (stdlib only): both `metrics` and `trace`
+consume it, and neither may import the other.
+"""
+
+from __future__ import annotations
+
+import threading
+
+_local = threading.local()
+
+
+def bind(name: str | None) -> None:
+    """Bind the calling thread to scope `name` (None = unscoped: the
+    legacy process-global state)."""
+    _local.name = name
+
+
+def current() -> str | None:
+    return getattr(_local, "name", None)
+
+
+class bound:
+    """Context manager: bind a scope for the duration of a block and
+    restore whatever was bound before (nesting-safe)."""
+
+    __slots__ = ("name", "_prev")
+
+    def __init__(self, name: str | None) -> None:
+        self.name = name
+
+    def __enter__(self) -> "bound":
+        self._prev = current()
+        bind(self.name)
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        bind(self._prev)
+        return False

@@ -122,6 +122,29 @@ class Tracer:
 
 _LOCK = threading.Lock()
 _TRACER: Tracer | None = None
+#: Per-SCOPE tracers (multi-scheduler-per-process): each live
+#: scheduler instance — a cell's — gets its OWN Tracer (spans +
+#: decisions + flight ring) registered under its scope name, and the
+#: facade functions below resolve the CALLING THREAD's bound scope
+#: (kube_batch_tpu/scope.py) first, falling back to the process-global
+#: tracer.  Two live schedulers in one process therefore never
+#: interleave span trees or decision records — each thread doing a
+#: scheduler's work (cycle driver, ingest applier, commit workers)
+#: records into that scheduler's tracer.
+_TRACERS: dict[str, Tracer] = {}
+
+
+def _current() -> Tracer | None:
+    """The calling thread's tracer: its bound scope's, else the
+    process-global one."""
+    from kube_batch_tpu import scope as scope_mod
+
+    s = scope_mod.current()
+    if s is not None:
+        t = _TRACERS.get(s)
+        if t is not None:
+            return t
+    return _TRACER
 
 
 def enable(
@@ -129,34 +152,52 @@ def enable(
     flight_cycles: int = 256,
     dump_dir: str | None = None,
     trace_dir: str | None = None,
+    scope: str | None = None,
 ) -> Tracer:
     """Turn the subsystem on (idempotent per process: a second enable
     replaces the tracer — chaos restarts and tests rely on a clean
-    slate).  ``flight_cycles`` <= 0 disables instead."""
+    slate).  ``flight_cycles`` <= 0 disables instead.  With `scope`
+    the tracer registers PER-SCHEDULER under that name (the cell)
+    instead of replacing the process-global one — threads bound to
+    the scope record into it exclusively."""
     global _TRACER
     if flight_cycles is not None and int(flight_cycles) <= 0:
-        disable()
+        disable(scope=scope)
         return None  # type: ignore[return-value]
     with _LOCK:
-        _TRACER = Tracer(
+        tracer = Tracer(
             span_cycles=span_cycles, flight_cycles=flight_cycles,
             dump_dir=dump_dir, trace_dir=trace_dir,
         )
-        return _TRACER
+        if scope:
+            _TRACERS[scope] = tracer
+        else:
+            _TRACER = tracer
+        return tracer
 
 
-def disable() -> None:
+def disable(scope: str | None = None) -> None:
+    """Tear the subsystem down.  Bare disable() clears EVERYTHING —
+    the process-global tracer and every scoped one (tests and engine
+    teardowns rely on the clean slate); disable(scope=...) removes
+    just that scheduler's tracer."""
     global _TRACER
     with _LOCK:
-        _TRACER = None
+        if scope:
+            _TRACERS.pop(scope, None)
+        else:
+            _TRACER = None
+            _TRACERS.clear()
 
 
 def enabled() -> bool:
-    return _TRACER is not None
+    return _current() is not None
 
 
-def get() -> Tracer | None:
-    return _TRACER
+def get(scope: str | None = None) -> Tracer | None:
+    if scope:
+        return _TRACERS.get(scope)
+    return _current()
 
 
 # -- hot-path helpers (flag check first, always) -------------------------
@@ -166,7 +207,7 @@ def span(name: str, cycle: int | None = None, **args):
     ``cycle`` attributes a cross-thread span (commit flush, ingest
     apply) to the cycle that caused it; the default is the current
     cycle."""
-    t = _TRACER
+    t = _current()
     if t is None:
         return _NOOP
     return t.spans.span(
@@ -178,20 +219,20 @@ def begin_cycle() -> "Tracer | None":
     """Open the next cycle's span tree; returns the Tracer (so the
     scheduler ends the SAME tracer it began, even if a concurrent
     enable() swapped the global mid-cycle) or None when disabled."""
-    t = _TRACER
+    t = _current()
     if t is not None:
         t.begin_cycle()
     return t
 
 
 def end_cycle(summary: dict) -> None:
-    t = _TRACER
+    t = _current()
     if t is not None:
         t.end_cycle(summary)
 
 
 def current_cycle() -> int:
-    t = _TRACER
+    t = _current()
     return t.cycle if t is not None else 0
 
 
@@ -199,13 +240,13 @@ def decision_log() -> DecisionLog | None:
     """The live DecisionLog, or None when disabled.  (Named
     decision_log, not decisions — `trace.decisions` is the
     submodule.)"""
-    t = _TRACER
+    t = _current()
     return t.decisions if t is not None else None
 
 
 def note_wire(verb: str, target: str, ok: bool,
               cycle: int | None = None, **detail) -> None:
-    t = _TRACER
+    t = _current()
     if t is None:
         return
     t.recorder.note_wire({
@@ -218,7 +259,7 @@ def note_transition(kind: str, **detail) -> None:
     """Record one subsystem transition; trigger kinds (TRIGGERS)
     auto-dump a post-mortem.  Never raises — observability must not
     kill the transition that tripped it."""
-    t = _TRACER
+    t = _current()
     if t is None:
         return
     try:
@@ -237,7 +278,7 @@ def debug_http(path: str) -> tuple[int, dict]:
     """Route one GET /debug/... request.  Returns (status, JSON body).
     404 bodies explain what exists, so an operator probing blind gets
     a map instead of silence."""
-    t = _TRACER
+    t = _current()
     if t is None:
         return 503, {
             "error": "tracing disabled (the daemon enables it by "

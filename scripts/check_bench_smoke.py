@@ -17,11 +17,23 @@ def main() -> int:
     assert isinstance(artifact, dict), artifact
 
     for key in ("first_cycle_ms", "e2e_cycle_ms_p50", "commit_pipeline",
-                "ingest_compare", "trace_overhead", "compile_artifacts"):
+                "ingest_compare", "trace_overhead", "compile_artifacts",
+                "cells_aggregate"):
         assert key in artifact, (
             f"artifact missing {key!r}; keys: {sorted(artifact)}"
         )
     assert isinstance(artifact["first_cycle_ms"], (int, float))
+
+    # Presence + sanity only: the multi-cell chaos invariants live in
+    # scripts/check_chaos_cells.py (make chaos); the smoke pins that
+    # every artifact RECORDS the 2-cell aggregate vs single-cell
+    # figures, measured through the real wire stack.
+    ca = artifact["cells_aggregate"]
+    assert "error" not in ca, ca
+    assert ca.get("aggregate_pods_per_s", 0) > 0, ca
+    assert ca.get("single_pods_per_s", 0) > 0, ca
+    assert ca.get("aggregate_pods_bound", 0) == \
+        ca.get("single_pods_bound", -1), ca
 
     # Presence + sanity only: the <3% gate lives in
     # scripts/check_trace_overhead.py (make verify); the smoke pins
@@ -66,7 +78,10 @@ def main() -> int:
         f"{speedup}x vs sync at {cmp_.get('rtt_ms')}ms RTT, ingest "
         f"storm {ing.get('storm_speedup')}x / relist "
         f"{ing.get('relist_speedup')}x vs per-event, warm artifact "
-        f"adopt {art.get('speedup')}x vs cold compile"
+        f"adopt {art.get('speedup')}x vs cold compile, 2-cell "
+        f"aggregate {ca.get('aggregate_pods_per_s')} pods/s vs "
+        f"single {ca.get('single_pods_per_s')} "
+        f"({ca.get('scaling')}x)"
     )
     return 0
 

@@ -1,0 +1,88 @@
+#!/usr/bin/env python
+"""Assert the multi-cell chaos acceptance criteria over two same-seed
+runs plus the --ingest-mode event parity run (make chaos):
+
+* both runs completed with zero invariant violations and CONVERGED —
+  including cell B re-converging after its full-partition window with
+  zero double-binds across the boundary (the per-tick checker's
+  no-double-bind spans both cells' writers);
+* the cell-scope fence was actually EXERCISED: ≥1 cross-cell write
+  attempted and rejected cluster-side (structured CellScope answer),
+  ZERO accepted, and the client-side local fence fast-failed ≥1 probe
+  without a wire round trip (no-cross-cell-write-accepted);
+* all three partition shapes fired: full (cell loses every verb and
+  all broadcasts — the peer cell kept placing throughout, per the
+  partitioned-cell-peer-unaffected invariant the engine enforces),
+  asymmetric (watch live, writes black-holed — the victim's breaker
+  tripped against a live peer and healed), and straddling-reclaim
+  (≥1 capacity claim rolled back while its donor was dark);
+* cross-cell reclaim is atomic-or-rolled-back: ≥1 claim granted (the
+  node re-celled to the claimant), ≥1 rolled back (no node moved),
+  zero left pending;
+* same seed ⇒ same trace hash across the two runs AND the event-mode
+  run — two live schedulers through the threaded wire stack are fully
+  deterministic, and the batched ingest pipeline's cell filter is
+  decision-invisible.
+"""
+
+import json
+import sys
+
+from chaos_parity import check_ingest_parity
+
+
+def main(path_a: str, path_b: str, path_event: str | None = None) -> int:
+    with open(path_a, encoding="utf-8") as f:
+        a = json.load(f)
+    with open(path_b, encoding="utf-8") as f:
+        b = json.load(f)
+    for name, run in (("run1", a), ("run2", b)):
+        assert run["ok"], f"{name} violations: {run['violations']}"
+        assert run["converged_after_drain_ticks"] is not None, \
+            f"{name}: never converged"
+        xc = run["cross_cell"]
+        assert xc["attempted"] >= 1, f"{name}: no cross-cell probe: {xc}"
+        assert xc["rejected"] >= 1, \
+            f"{name}: no cross-cell write was rejected: {xc}"
+        assert xc["accepted"] == 0, \
+            f"{name}: a cross-cell write was ACCEPTED: {xc}"
+        assert xc["local_fenced"] >= 1, \
+            f"{name}: the client-side cell fence never fired: {xc}"
+        pt = run["partitions"]
+        assert pt["full"] >= 1, f"{name}: no full partition: {pt}"
+        assert pt["asym"] >= 1, f"{name}: no asym partition: {pt}"
+        assert pt["straddle_rollbacks"] >= 1, \
+            f"{name}: no claim rolled back under a donor partition: {pt}"
+        rc = run["reclaim"]
+        assert rc["granted"] >= 1, f"{name}: no reclaim granted: {rc}"
+        assert rc["rolled_back"] >= 1, \
+            f"{name}: no reclaim rolled back: {rc}"
+        assert rc["pending"] == 0, \
+            f"{name}: claim(s) left in limbo: {rc}"
+        cells = run["cells"]
+        assert len(cells) >= 2, cells
+        assert any(c["breaker_opened"] >= 1 for c in cells.values()), (
+            f"{name}: the asym window never tripped a breaker: {cells}"
+        )
+    assert a["trace_hash"] == b["trace_hash"], (
+        f"same-seed 2-scheduler runs diverged: "
+        f"{a['trace_hash']} != {b['trace_hash']}"
+    )
+    parity = check_ingest_parity(a, path_event, "cells")
+    xc, rc = a["cross_cell"], a["reclaim"]
+    print(
+        "chaos cells: ok — same-seed hash "
+        f"{a['trace_hash'][:16]}… reproduced across two live "
+        "schedulers" + parity + f"; {xc['rejected']} cross-cell "
+        f"write(s) rejected / 0 accepted / {xc['local_fenced']} "
+        f"locally fenced; partitions full={a['partitions']['full']} "
+        f"asym={a['partitions']['asym']} straddle-rollbacks="
+        f"{a['partitions']['straddle_rollbacks']}; reclaim "
+        f"granted={rc['granted']} rolled-back={rc['rolled_back']}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1], sys.argv[2],
+                  sys.argv[3] if len(sys.argv) > 3 else None))

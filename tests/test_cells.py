@@ -1,0 +1,471 @@
+"""Multi-cell scale-out (doc/design/multi-cell.md), pinned at tier-1:
+
+* per-cell epoch leases — two cells' leaderships never fence each
+  other, and each mints its own monotone epoch sequence;
+* cluster-side cell-scope fencing — a cell-A writer can never bind
+  onto / evict from / status-write into cell B, rejected with the
+  structured ``CellScope`` code BEFORE any state is touched;
+* the client-side local cell fence — fast-fail without a wire RTT;
+* the cell-scoped watch filter — foreign objects never reach the
+  cache, a node re-celled away arrives as a synthetic DELETED, and
+  peer-cell visibility is tracked for /healthz;
+* per-cell statestore snapshot keys — takeover adoption stays
+  cell-local;
+* the cross-cell reclaim protocol — claim → drain → offer → atomic
+  re-cell, with the timeout rollback leaving exactly nothing behind;
+* per-scope observability — two LIVE schedulers' tracers and
+  /healthz ladder states never interleave (the PR's singleton
+  satellite).
+
+The full two-scheduler partition scenario runs in `make chaos`
+(examples/chaos-cells.json via scripts/check_chaos_cells.py); the
+engine smoke here is marked slow.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import types
+
+import pytest
+
+from kube_batch_tpu import metrics, scope, trace
+from kube_batch_tpu.api.resource import ResourceSpec
+from kube_batch_tpu.api.types import TaskStatus
+from kube_batch_tpu.cache.cache import SchedulerCache
+from kube_batch_tpu.cache.cluster import Node, Pod, PodGroup, Queue
+from kube_batch_tpu.client.adapter import (
+    CELL_LABEL,
+    CellScopeError,
+    StreamBackend,
+    WatchAdapter,
+)
+from kube_batch_tpu.client.external import ExternalCluster
+from kube_batch_tpu.models.workloads import GI
+
+SPEC = ResourceSpec(("cpu", "memory", "pods", "accelerator"))
+
+
+def _cluster() -> ExternalCluster:
+    cl = ExternalCluster().start()
+    cl.add_queue(Queue(name="cell-a-q", cell="cell-a",
+                       uid="uid-q-a"))
+    cl.add_queue(Queue(name="cell-b-q", cell="cell-b",
+                       uid="uid-q-b"))
+    for cell, n in (("cell-a", "a-n0"), ("cell-a", "a-n1"),
+                    ("cell-b", "b-n0")):
+        cl.add_node(Node(
+            name=n, labels={CELL_LABEL: cell},
+            allocatable={"cpu": 8000, "memory": 16 * GI, "pods": 110},
+            uid=f"uid-{n}",
+        ))
+    cl.submit(
+        PodGroup(name="ga", queue="cell-a-q", min_member=1,
+                 uid="uid-pg-ga"),
+        [Pod(name="pa", uid="uid-pa",
+             request={"cpu": 500, "memory": GI, "pods": 1})],
+    )
+    cl.submit(
+        PodGroup(name="gb", queue="cell-b-q", min_member=1,
+                 uid="uid-pg-gb"),
+        [Pod(name="pb", uid="uid-pb",
+             request={"cpu": 500, "memory": GI, "pods": 1})],
+    )
+    return cl
+
+
+def _session(cl: ExternalCluster, cell: str | None):
+    """One attached wire session: (backend, cache, adapter)."""
+    a, b = socket.socketpair()
+    cl_r = a.makefile("r", encoding="utf-8")
+    cl_w = a.makefile("w", encoding="utf-8")
+    cl.attach(cl_r, cl_w)
+    cl.replay(cl_w)
+    backend = StreamBackend(
+        b.makefile("w", encoding="utf-8"), timeout=5.0,
+    )
+    if cell:
+        backend.set_cell(cell)
+    cache = SchedulerCache(
+        SPEC, binder=backend, evictor=backend, status_updater=backend,
+    )
+    adapter = WatchAdapter(
+        cache, b.makefile("r", encoding="utf-8"), backend=backend,
+        cell=cell,
+    ).start()
+    assert adapter.wait_for_sync(5.0)
+    return backend, cache, adapter
+
+
+def test_per_cell_leases_mint_independent_epochs():
+    """Each cell's lease is its own resourcelock: acquiring cell-a's
+    neither blocks nor fences cell-b's, and each cell mints its own
+    monotone epoch sequence starting at 1."""
+    cl = _cluster()
+    ba, _ca, _aa = _session(cl, "cell-a")
+    bb, _cb, _ab = _session(cl, "cell-b")
+    ea = ba.acquire_lease("holder-a", ttl=30.0)
+    eb = bb.acquire_lease("holder-b", ttl=30.0)
+    assert ea == 1 and eb == 1
+    assert cl.lease("cell-a").holder == "holder-a"
+    assert cl.lease("cell-b").holder == "holder-b"
+    # The classic default-cell lease is untouched.
+    assert cl.lease_epoch == 0 and cl.lease_holder is None
+    # A steal in cell-b leaves cell-a's epoch alone.
+    cl.expire_lease("cell-b")
+    eb2 = bb.acquire_lease("usurper-b", ttl=30.0)
+    assert eb2 == 2
+    assert cl.lease("cell-a").epoch == 1
+
+
+def test_cluster_rejects_cross_cell_writes_before_state():
+    """The authoritative fence: bind onto a foreign node, evict of a
+    foreign pod, and a foreign group's status write all come back
+    with the structured CellScope code and mutate NOTHING."""
+    cl = _cluster()
+    ba, _ca, _aa = _session(cl, "cell-a")
+    ba.set_epoch(ba.acquire_lease("holder-a", ttl=30.0))
+
+    with pytest.raises(CellScopeError):
+        ba._call({"verb": "bind", "pod": "uid-pa", "node": "b-n0"})
+    with pytest.raises(CellScopeError):
+        ba._call({"verb": "bind", "pod": "uid-pb", "node": "a-n0"})
+    with pytest.raises(CellScopeError):
+        ba._call({"verb": "evict", "pod": "uid-pb", "reason": "x"})
+    from kube_batch_tpu.client.codec import encode_pod_group
+
+    with pytest.raises(CellScopeError):
+        ba._call({
+            "verb": "updatePodGroup",
+            "object": encode_pod_group(cl.groups["gb"]),
+        })
+    assert cl.cross_cell_rejections == 4
+    assert cl.binds == [] and cl.evictions == []
+    assert cl.pods["uid-pb"].status == TaskStatus.PENDING
+    # The legal writes still work.
+    ba.bind(types.SimpleNamespace(uid="uid-pa"), "a-n0")
+    assert cl.pods["uid-pa"].status == TaskStatus.BOUND
+
+
+def test_uncelled_writer_passes_everywhere():
+    """Back-compat: a writer declaring no cell (single-fleet deploy)
+    is never scope-checked — celled objects or not."""
+    cl = _cluster()
+    b0, _c0, _a0 = _session(cl, None)
+    b0.bind(types.SimpleNamespace(uid="uid-pb"), "b-n0")
+    assert cl.pods["uid-pb"].status == TaskStatus.BOUND
+    assert cl.cross_cell_rejections == 0
+
+
+def test_local_cell_fence_fast_fails_without_rtt():
+    cl = _cluster()
+    ba, _ca, aa = _session(cl, "cell-a")
+    ba.cell_of_node = aa.cell_of_node
+    before = metrics.cross_cell_writes.value()
+    with pytest.raises(CellScopeError):
+        ba.bind(types.SimpleNamespace(uid="uid-pa"), "b-n0")
+    # Fenced LOCALLY: the cluster never saw the request.
+    assert cl.cross_cell_rejections == 0
+    assert metrics.cross_cell_writes.value() == before + 1
+
+
+def test_cell_scoped_watch_filter_and_peer_tracking():
+    """A cell-A adapter mirrors only cell-A (and shared) objects, yet
+    tracks every node's cell PRE-filter for the local fence, and
+    records peer-cell visibility for /healthz."""
+    cl = _cluster()
+    _ba, ca, aa = _session(cl, "cell-a")
+    with ca.lock():
+        assert sorted(ca._nodes) == ["a-n0", "a-n1"]
+        assert sorted(ca._pods) == ["uid-pa"]
+        # The cache's own auto-created default queue (uncelled =
+        # shared) is allowed; cell-b's queue is not.
+        assert sorted(ca._queues) == ["cell-a-q", "default"]
+        assert sorted(ca._jobs) == ["ga"]
+    assert aa.cell_of_node("b-n0") == "cell-b"
+    assert "cell-b" in aa.peer_cells_seen
+    assert aa.cell_dropped > 0
+
+
+def test_recelled_node_becomes_synthetic_delete():
+    """A node granted away by reclaim arrives as a MODIFIED carrying
+    the foreign cell: the old cell's filter rewrites it to DELETED
+    (the mirror drops it), the new cell's filter upserts it."""
+    cl = _cluster()
+    _ba, ca, aa = _session(cl, "cell-a")
+    _bb, cb, ab = _session(cl, "cell-b")
+    node = cl.nodes["a-n1"]
+    node.labels = {**node.labels, CELL_LABEL: "cell-b"}
+    from kube_batch_tpu.client.codec import encode_node
+
+    cl._emit("MODIFIED", "Node", encode_node(node))
+    deadline = 50
+    import time
+
+    for _ in range(deadline):
+        with ca.lock():
+            gone = "a-n1" not in ca._nodes
+        with cb.lock():
+            arrived = "a-n1" in cb._nodes
+        if gone and arrived:
+            break
+        time.sleep(0.05)
+    with ca.lock():
+        assert "a-n1" not in ca._nodes
+    with cb.lock():
+        assert "a-n1" in cb._nodes
+    assert aa.cell_of_node("a-n1") == "cell-b"
+    assert ab.cell_of_node("a-n1") == "cell-b"
+
+
+def test_per_cell_state_snapshots_do_not_clobber():
+    cl = _cluster()
+    ba, _ca, _aa = _session(cl, "cell-a")
+    bb, _cb, _ab = _session(cl, "cell-b")
+    ba.set_epoch(ba.acquire_lease("a", ttl=30.0))
+    bb.set_epoch(bb.acquire_lease("b", ttl=30.0))
+    ba.put_state_snapshot({"who": "a"})
+    bb.put_state_snapshot({"who": "b"})
+    assert ba.get_state_snapshot() == {"who": "a"}
+    assert bb.get_state_snapshot() == {"who": "b"}
+    assert cl.state_snapshots["cell-a"] == {"who": "a"}
+    assert cl.state_snapshot is None  # the uncelled key is untouched
+
+
+def test_reclaim_claim_offer_grant_and_rollback():
+    """The negotiation protocol end to end: a pending claim is
+    discoverable by its donor, an offer of a NON-empty node is
+    refused, a drained node's offer re-cells it atomically, and an
+    unanswered claim rolls back at its deadline leaving nothing."""
+    cl = _cluster()
+    ba, _ca, _aa = _session(cl, "cell-a")
+    bb, _cb, _ab = _session(cl, "cell-b")
+    ba.set_epoch(ba.acquire_lease("a", ttl=30.0))
+    bb.set_epoch(bb.acquire_lease("b", ttl=30.0))
+
+    # cell-b claims capacity from cell-a.
+    cl.claim_clock = 0
+    cid = bb._call({"verb": "claimCapacity", "from": "cell-a",
+                    "ttlTicks": 3})["claim"]
+    listed = ba._call({"verb": "listClaims"})["object"]
+    assert [c["id"] for c in listed] == [cid]
+    assert bb._call({"verb": "listClaims"})["object"] == []
+
+    # A resident blocks the offer; draining unblocks it.
+    ba.bind(types.SimpleNamespace(uid="uid-pa"), "a-n1")
+    with pytest.raises(RuntimeError):
+        ba._call({"verb": "offerCapacity", "claim": cid,
+                  "node": "a-n1"})
+    ba.evict(types.SimpleNamespace(uid="uid-pa"), "reclaim-donate")
+    ba._call({"verb": "offerCapacity", "claim": cid, "node": "a-n1"})
+    claim = cl.reclaim_claims[cid]
+    assert claim["state"] == "granted" and claim["node"] == "a-n1"
+    assert cl.cell_of_node("a-n1") == "cell-b"
+    assert cl.reclaim_granted == 1
+
+    # An unanswered claim rolls back cleanly at its deadline.
+    cid2 = bb._call({"verb": "claimCapacity", "from": "cell-a",
+                     "ttlTicks": 2})["claim"]
+    cl.claim_clock = 1
+    assert cl.expire_reclaims() == 0  # not yet due
+    cl.claim_clock = 5
+    assert cl.expire_reclaims() == 1
+    c2 = cl.reclaim_claims[cid2]
+    assert c2["state"] == "rolled-back" and c2["node"] is None
+    # A late offer against the rolled-back claim is refused: the
+    # donor's wasted drain never leaks a node into limbo.
+    with pytest.raises(RuntimeError):
+        ba._call({"verb": "offerCapacity", "claim": cid2,
+                  "node": "a-n0"})
+    assert cl.cell_of_node("a-n0") == "cell-a"
+
+    # Donor mismatch is refused too.
+    cid3 = bb._call({"verb": "claimCapacity", "from": "cell-a",
+                     "ttlTicks": 8})["claim"]
+    with pytest.raises(RuntimeError):
+        bb._call({"verb": "offerCapacity", "claim": cid3,
+                  "node": "b-n0"})
+
+
+def test_reclaim_verbs_are_epoch_fenced():
+    """A deposed cell leader must not keep negotiating: claim/offer
+    carry the cell's epoch and are StaleEpoch-rejected after a
+    takeover in THAT cell."""
+    from kube_batch_tpu.client.adapter import StaleEpochError
+
+    cl = _cluster()
+    bb, _cb, _ab = _session(cl, "cell-b")
+    bb.set_epoch(bb.acquire_lease("b1", ttl=0.01))
+    import time
+
+    time.sleep(0.05)
+    bb2, _cb2, _ab2 = _session(cl, "cell-b")
+    bb2.set_epoch(bb2.acquire_lease("b2", ttl=30.0))
+    with pytest.raises(StaleEpochError):
+        bb._call({"verb": "claimCapacity", "from": "cell-a",
+                  "ttlTicks": 3})
+
+
+def test_k8s_dialect_cell_filter_tracks_and_recells():
+    """The apiserver-dialect filter carries the same contract as the
+    native one: foreign Nodes/Pods (by metadata label) are dropped but
+    TRACKED pre-filter (the local fence is the load-bearing half on
+    HTTP — a real apiserver cannot reject by cell), and a node
+    re-celled away becomes a synthetic DELETED."""
+    import io
+
+    from kube_batch_tpu.client.k8s import K8sWatchAdapter
+
+    cache = SchedulerCache(
+        SPEC, binder=None, evictor=None, status_updater=None,
+    )
+    adapter = K8sWatchAdapter(cache, io.StringIO(""), cell="cell-a")
+
+    def node_event(mtype: str, name: str, cell: str) -> dict:
+        return {"type": mtype, "object": {
+            "kind": "Node", "apiVersion": "v1",
+            "metadata": {"name": name, "uid": f"uid-{name}",
+                         "labels": {CELL_LABEL: cell}},
+            "status": {"allocatable": {
+                "cpu": "8", "memory": "16Gi", "pods": "110",
+            }},
+        }}
+
+    adapter._dispatch(node_event("ADDED", "n1", "cell-a"))
+    adapter._dispatch(node_event("ADDED", "n2", "cell-b"))
+    with cache.lock():
+        assert "n1" in cache._nodes and "n2" not in cache._nodes
+    # Pre-filter tracking feeds the local cell fence.
+    assert adapter.cell_of_node("n2") == "cell-b"
+    assert "cell-b" in adapter.peer_cells_seen
+    # Re-celled away (reclaim / relabel): the old cell's mirror drops
+    # the node exactly as if it left the fleet.
+    adapter._dispatch(node_event("MODIFIED", "n1", "cell-b"))
+    with cache.lock():
+        assert "n1" not in cache._nodes
+    assert adapter.cell_of_node("n1") == "cell-b"
+
+
+# -- per-scope observability (the singleton satellite) -----------------
+
+def test_scoped_tracers_do_not_interleave():
+    """Two LIVE schedulers in one process: each scope's spans land in
+    its own tracer; an unscoped thread still reaches the process
+    default."""
+    default = trace.enable()
+    ta = trace.enable(scope="cell-a")
+    tb = trace.enable(scope="cell-b")
+    try:
+        with scope.bound("cell-a"):
+            trace.begin_cycle()
+            with trace.span("solve"):
+                pass
+            trace.end_cycle({"who": "a"})
+        with scope.bound("cell-b"):
+            trace.begin_cycle()
+            trace.end_cycle({"who": "b"})
+        trace.begin_cycle()
+        trace.end_cycle({"who": "default"})
+        assert ta.cycle == 1 and tb.cycle == 1 and default.cycle == 1
+        assert [c["who"] for c in ta.recorder.cycles] == ["a"]
+        assert [c["who"] for c in tb.recorder.cycles] == ["b"]
+        assert [c["who"] for c in default.recorder.cycles] == ["default"]
+        assert ta.spans.stats()["spans_recorded"] >= 1
+        assert tb.spans.stats()["spans_recorded"] == 0
+        # Cross-thread: a worker thread bound to a scope records there.
+        def worker():
+            scope.bind("cell-b")
+            trace.note_transition("test-transition", detail=1)
+
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+        assert len(tb.recorder.transitions) == 1
+        assert len(ta.recorder.transitions) == 0
+    finally:
+        trace.disable()
+    assert trace.get() is None and trace.get(scope="cell-a") is None
+
+
+def test_scoped_health_registry_and_healthz_cells():
+    """Per-scope /healthz: a scoped scheduler's ladder/leadership
+    lands in the registry (surfaced under "cells"), never stomping
+    the process-global fields."""
+    import json
+
+    metrics.reset_health_scopes()
+    try:
+        metrics.set_health_state("ok")
+        metrics.set_health_state("degraded", scope="cell-b")
+        metrics.set_leadership("leader", 7, scope="cell-b")
+        metrics.set_cell_peer_visible(False, scope="cell-b")
+        assert metrics.health_state() == "ok"
+        assert metrics.health_state(scope="cell-b") == "degraded"
+        assert metrics.leadership(scope="cell-b") == ("leader", 7)
+        body = json.loads(metrics.health_body())
+        assert body["state"] == "ok"
+        assert body["cells"]["cell-b"]["state"] == "degraded"
+        assert body["cells"]["cell-b"]["epoch"] == 7
+        assert body["cells"]["cell-b"]["cell_peer_visible"] is False
+        # Thread-bound scope resolves implicitly too.
+        with scope.bound("cell-b"):
+            metrics.set_health_state("overloaded")
+        assert metrics.health_state() == "ok"
+        assert metrics.health_state(scope="cell-b") == "overloaded"
+    finally:
+        metrics.reset_health_scopes()
+        metrics.set_health_state("ok")
+
+
+def test_guardrails_scope_routes_health():
+    from kube_batch_tpu.guardrails import GuardrailConfig, Guardrails
+
+    metrics.reset_health_scopes()
+    try:
+        rails = Guardrails(GuardrailConfig(watchdog_overruns=1,
+                                           watchdog_period=0.01),
+                           scope="cell-a")
+        rails.watchdog.observe(1.0)  # overrun → degraded
+        rails._publish_health()
+        assert metrics.health_state(scope="cell-a") != "ok" or \
+            rails.rung == 0
+        # Whatever the rung did, the PROCESS state was untouched.
+        assert metrics.health_state() == "ok"
+    finally:
+        metrics.reset_health_scopes()
+        metrics.set_health_state("ok")
+
+
+# -- the two-scheduler engine smoke (the full scenario is make chaos) --
+
+@pytest.mark.slow
+def test_cell_engine_mini_run_is_deterministic():
+    from kube_batch_tpu.chaos.cells import CellChaosEngine, CellFaultSpec
+    from kube_batch_tpu.chaos.workload import ScenarioSpec
+
+    def run():
+        engine = CellChaosEngine(
+            seed=5, ticks=8,
+            scenario=ScenarioSpec(
+                nodes=2, arrival_rate=0.8, burst_every=0,
+                gang_max=2, lifetime_mean=4.0, node_churn_every=0,
+                target_utilization=0.5,
+            ),
+            cell_faults=CellFaultSpec(
+                cells=2, full_partition_at=0, asym_partition_at=0,
+                xcell_probe_at=2, xcell_probe_every=4,
+                starve_at=0, straddle_at=0,
+            ),
+            drain=30,
+        )
+        return engine.run()
+
+    r1 = run()
+    assert r1.ok, [v.as_dict() for v in r1.violations]
+    assert r1.cross_cell["rejected"] >= 1
+    assert r1.cross_cell["accepted"] == 0
+    assert r1.converged_tick is not None
+    r2 = run()
+    assert r2.trace_hash == r1.trace_hash
+    assert r2.final_assignment == r1.final_assignment

@@ -63,7 +63,7 @@ def test_tracing_on_off_hash_parity():
 
 
 def _scenario_kw(name: str, seed: int, ticks: int) -> dict:
-    _events, scenario, faults = _load_scenario(
+    _events, scenario, faults, _cells, _cellwl = _load_scenario(
         os.path.join(EXAMPLES, name)
     )
     return dict(

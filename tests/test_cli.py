@@ -3,6 +3,7 @@
 import subprocess
 import sys
 
+import pytest
 import yaml
 
 from kube_batch_tpu.cli import acquire_leadership, build_parser, load_world, main
@@ -172,6 +173,69 @@ def test_shutdown_drains_write_paths_before_release(monkeypatch):
     order.clear()
     drain_write_path_then_release(None, FakeElector(), object())
     assert order == ["bind-pool", "release"]
+
+
+def test_sigterm_runs_graceful_stand_down():
+    """The SIGTERM satellite pin: `install_stand_down_signals` routes
+    SIGTERM into the stop event, so the run loop exits and the normal
+    shutdown path (statestore compact+mirror, then
+    drain_write_path_then_release) executes — `kubectl delete pod` on
+    a leader no longer relies on the lease TTL.  All three run modes
+    register it; here the handler contract itself is pinned."""
+    import signal
+    import threading
+
+    from kube_batch_tpu.cli import install_stand_down_signals
+
+    previous = signal.getsignal(signal.SIGTERM)
+    stop = threading.Event()
+    try:
+        seen = install_stand_down_signals(stop)
+        assert not stop.is_set() and seen == {}
+        signal.raise_signal(signal.SIGTERM)
+        assert stop.is_set()
+        assert seen["signal"] == signal.SIGTERM
+        # A second delivery is harmless (stop is already set).
+        signal.raise_signal(signal.SIGTERM)
+        assert stop.is_set()
+    finally:
+        signal.signal(signal.SIGTERM, previous)
+
+
+@pytest.mark.slow
+def test_sigterm_daemon_exits_cleanly(tmp_path):
+    """End-to-end: a sim-mode daemon killed with SIGTERM runs the
+    graceful stand-down (final statestore compaction included) and
+    exits 0 — the pre-handler behavior was the default handler
+    killing the process mid-loop with a non-zero status."""
+    import os
+    import signal
+    import time
+
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "kube_batch_tpu",
+            "--workload", "1", "--schedule-period", "0.2",
+            "--listen-address", "", "--state-dir", str(tmp_path),
+        ],
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    try:
+        # Give the daemon time to boot (first compile included).
+        time.sleep(30.0)
+        proc.send_signal(signal.SIGTERM)
+        out, _ = proc.communicate(timeout=60.0)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate(timeout=10.0)
+    assert proc.returncode == 0, out[-2000:]
+    assert "graceful stand-down" in out, out[-2000:]
+    # The shutdown path compacted the journal (statestore.close).
+    from kube_batch_tpu.statestore import journal_path
+
+    assert os.path.exists(journal_path(str(tmp_path)))
 
 
 def test_cluster_stream_mode_end_to_end():

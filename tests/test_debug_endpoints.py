@@ -165,6 +165,37 @@ def test_cli_exits_nonzero_on_bound_port(server):
     assert rc == 1
 
 
+def test_healthz_carries_cell_identity(server):
+    """/healthz gains {cell, cell_peer_visible} (doc/design/
+    multi-cell.md): probes triaging a "cell dark" page distinguish a
+    partitioned cell (process healthy, peer invisible) from a dead
+    leader (no response) from a breaker-open one (state degraded,
+    peer visible)."""
+    try:
+        status, body = _get(server, "/healthz")
+        assert status == 200
+        # The uncelled default: identity "" and peer-visibility null.
+        assert body["cell"] == ""
+        assert body["cell_peer_visible"] is None
+        metrics.set_cell("cell-a")
+        metrics.set_cell_peer_visible(True)
+        status, body = _get(server, "/healthz")
+        assert body["cell"] == "cell-a"
+        assert body["cell_peer_visible"] is True
+        # The partitioned-cell read: stream death flips it false.
+        metrics.set_cell_peer_visible(False)
+        _status, body = _get(server, "/healthz")
+        assert body["cell_peer_visible"] is False
+        # Per-scope (multi-scheduler) health surfaces under "cells".
+        metrics.set_health_state("degraded", scope="cell-b")
+        _status, body = _get(server, "/healthz")
+        assert body["cells"]["cell-b"]["state"] == "degraded"
+    finally:
+        metrics.set_cell("")
+        metrics.set_cell_peer_visible(None)
+        metrics.reset_health_scopes()
+
+
 def test_healthz_carries_backlog_pressure(server):
     """/healthz gains ingest_lag_seconds + commit_queue_depth so
     probes see backlog pressure without scraping /metrics."""

@@ -228,6 +228,121 @@ def test_stale_epoch_is_app_level_for_the_breaker():
     assert breaker.state == CircuitBreaker.CLOSED  # counted as success
 
 
+def test_steal_during_inflight_pipelined_commit_with_live_contender():
+    """A lease steal DURING an in-flight pipelined commit, with a
+    second contender LIVE on its own session: every write of the
+    stolen-from epoch fails into the fence (cluster-side StaleEpoch
+    for ops already on the wire, local fast-fail for the queued tail
+    — zero mutations either way), and the usurper's takeover
+    reconcile classifies every pod the dead epoch left frozen in
+    BINDING.  Extends the single-scheduler steal coverage: here the
+    usurper is a real second scheduler session ingesting the same
+    watch stream throughout."""
+    from kube_batch_tpu.chaos.faults import ChaosCluster
+
+    cluster = ChaosCluster(seed=0, bind_fail_pct=0).start()
+    for i in range(2):
+        cluster.add_node(Node(
+            name=f"n{i}",
+            allocatable={"cpu": 8000, "memory": 16 * GI, "pods": 110},
+        ))
+    cluster.submit(
+        PodGroup(name="gang", queue="default", min_member=4),
+        [Pod(name=f"p{i}", uid=f"uid-p{i}",
+             request={"cpu": 1000, "memory": GI, "pods": 1})
+         for i in range(4)],
+    )
+
+    def session():
+        a, b = socket.socketpair()
+        cl_r = a.makefile("r", encoding="utf-8")
+        cl_w = a.makefile("w", encoding="utf-8")
+        cluster.attach(cl_r, cl_w)
+        cluster.replay(cl_w)
+        backend = StreamBackend(
+            b.makefile("w", encoding="utf-8"), timeout=5.0,
+        )
+        cache = SchedulerCache(
+            SPEC, binder=backend, evictor=backend,
+            status_updater=backend,
+        )
+        adapter = WatchAdapter(
+            cache, b.makefile("r", encoding="utf-8"), backend=backend,
+        ).start()
+        assert adapter.wait_for_sync(5.0)
+        return backend, cache, adapter
+
+    leader_be, leader_cache, _leader_ad = session()
+    cont_be, cont_cache, cont_ad = session()   # the LIVE contender
+    commit = CommitPipeline(cache=leader_cache)
+    leader_cache.commit = commit
+    try:
+        leader_be.set_epoch(leader_be.acquire_lease("leader", ttl=30.0))
+        # One bind LANDS under the old epoch (the frozen-BINDING pod
+        # the reconcile must later ADOPT).
+        leader_cache.begin_bind("uid-p0", "n0")
+        commit.submit_bind("uid-p0", "n0")
+        assert commit.drain(timeout=5.0)
+        assert ("p0", "n0") in cluster.binds
+
+        # Now the wire turns slow and a commit tail goes IN FLIGHT.
+        cluster.response_delay = 0.25
+        for i in (1, 2, 3):
+            assert leader_cache.begin_bind(f"uid-p{i}", "n1")
+            commit.submit_bind(f"uid-p{i}", "n1")
+
+        # THE STEAL, mid-flight: the contender wins at a higher epoch
+        # while the old epoch's flushes are still sleeping on the
+        # wire.  The leader fences the moment its renewal would fail
+        # (what LeaseElector does) and stands down.
+        cluster.expire_lease()
+        epoch2 = cont_be.acquire_lease("usurper", ttl=30.0)
+        cont_be.set_epoch(epoch2)
+        assert epoch2 == 2
+        leader_be.fence()
+        t0 = time.monotonic()
+        assert stand_down(leader_cache, leader_be, commit)
+        took = time.monotonic() - t0
+        assert took < 4.0, f"stand-down took {took:.1f}s"
+
+        # Not one zombie write mutated the cluster: p0's pre-steal
+        # bind is the ONLY accepted bind, and the in-flight tail was
+        # rejected cluster-side (the requests had already left the
+        # client, so the fence HAD to be the cluster's epoch check).
+        cluster.response_delay = 0.0
+        assert cluster.binds == [("p0", "n0")]
+        assert cluster.stale_epoch_rejections >= 1
+        with leader_cache.lock():
+            assert all(
+                leader_cache._pods[f"uid-p{i}"].status
+                == TaskStatus.PENDING
+                for i in (1, 2, 3)
+            )
+
+        # The usurper inherits frozen-BINDING wreckage in its own
+        # mirror: p0's bind landed (adopt), p1's never did (roll
+        # back).  Its reconcile must classify BOTH.
+        cont_cache.update_pod_status(
+            "uid-p0", TaskStatus.BINDING, node="n0"
+        )
+        cont_cache.update_pod_status(
+            "uid-p1", TaskStatus.BINDING, node="n1"
+        )
+        summary = reconcile_takeover(
+            cont_cache, cont_be, cont_ad, epoch=epoch2,
+        )
+        assert summary["adopted"] == 1
+        assert summary["rolled_back"] == 1
+        assert summary["vanished"] == 0
+        with cont_cache.lock():
+            p0 = cont_cache._pods["uid-p0"]
+            p1 = cont_cache._pods["uid-p1"]
+            assert p0.status == TaskStatus.BOUND and p0.node == "n0"
+            assert p1.status == TaskStatus.PENDING and p1.node is None
+    finally:
+        commit.close(timeout=5.0)
+
+
 def test_scheduler_on_takeover_disarms_idle_skip():
     """The first post-takeover cycle must always solve — the idle
     early-out's armed state belongs to the previous epoch's view."""
