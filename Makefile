@@ -113,8 +113,14 @@ run-example:
 # asserts ≥1 cross-cell write rejected and 0 accepted, all three
 # partition shapes exercised, reclaim atomic-or-rolled-back, the
 # partitioned cell's peer unaffected, convergence across both cells,
-# and same seed ⇒ same hash across the two runs AND the
-# --ingest-mode event parity run.
+# ≥1 STITCHED trace whose span tree crosses both schedulers under one
+# trace id (verified against the merged Perfetto export), the
+# partitioned cell's SLO engine fast-burning during its dark window
+# (with an 'slo-burn' flight-recorder post-mortem auto-dumped) and
+# clearing after heal while /debug/fleet shows the peer healthy, and
+# same seed ⇒ same hash across the two runs, the --ingest-mode event
+# parity run AND the --trace off run (stitching + SLO engine are
+# decision-invisible).
 # The fifth and sixth runs are the FAILOVER scenario
 # (doc/design/failover-fencing.md): a leader crash mid-commit, a
 # second elector instance taking over at a higher epoch, a zombie-
@@ -206,8 +212,12 @@ chaos:
 	JAX_PLATFORMS=cpu $(PY) -m kube_batch_tpu.chaos --seed 37 --ticks 26 \
 	    --scenario examples/chaos-cells.json \
 	    --ingest-mode event --quiet > /tmp/kb-chaos-cells-e.json
+	JAX_PLATFORMS=cpu $(PY) -m kube_batch_tpu.chaos --seed 37 --ticks 26 \
+	    --scenario examples/chaos-cells.json \
+	    --trace off --quiet > /tmp/kb-chaos-cells-t.json
 	$(PY) scripts/check_chaos_cells.py /tmp/kb-chaos-cells-1.json \
-	    /tmp/kb-chaos-cells-2.json /tmp/kb-chaos-cells-e.json
+	    /tmp/kb-chaos-cells-2.json /tmp/kb-chaos-cells-e.json \
+	    /tmp/kb-chaos-cells-t.json
 
 profile:
 	$(PY) -m kube_batch_tpu --workload 2 --cycles 3 --schedule-period 0 \
@@ -225,6 +235,7 @@ verify:
 	JAX_PLATFORMS=cpu $(PY) scripts/check_pack_microbench.py
 	JAX_PLATFORMS=cpu $(PY) scripts/check_ingest_microbench.py
 	JAX_PLATFORMS=cpu $(PY) scripts/check_trace_overhead.py
+	JAX_PLATFORMS=cpu $(PY) scripts/check_slo_overhead.py
 	JAX_PLATFORMS=cpu $(PY) scripts/check_compile_artifacts.py
 	$(PY) -c "import __graft_entry__ as g; g.entry()"
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \

@@ -747,6 +747,20 @@ def _run_daemon_phases(jax, n, cache, sim, conf_path, steady_cycles) -> dict:
         out["trace_overhead"] = {"error": str(exc)[:300]}
     emit_partial(trace_overhead=out["trace_overhead"])
 
+    # -- SLO engine + trace stitching overhead --------------------------
+    # Every daemon artifact records the FULL fleet-observability tax
+    # (stitching flow contexts + the default SLO objective set armed) —
+    # the <3% GATE lives in scripts/check_slo_overhead.py (make
+    # verify); here the number rides the artifact so the trajectory
+    # shows any creep.  Cheap (seconds).
+    try:
+        out["slo"] = run_slo_overhead(
+            config=3 if _budget_left() > 120.0 else 1
+        )
+    except Exception as exc:  # noqa: BLE001 — degrade, never die
+        out["slo"] = {"error": str(exc)[:300]}
+    emit_partial(slo=out["slo"])
+
     # -- AOT artifact bank: warm-adopt vs cold compile ------------------
     # Every daemon artifact records what a failover successor's warm
     # start saves — the >=5x GATE lives in
@@ -1466,6 +1480,25 @@ def run_trace_overhead(config: int = 3, rounds: int = 2) -> dict:
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     return mod.measure_overhead(config=config, rounds=rounds)
+
+
+def run_slo_overhead(config: int = 3, rounds: int = 2) -> dict:
+    """Stitching+SLO-engine-on vs tracing-off steady-cycle medians —
+    the same measurement `scripts/check_slo_overhead.py` gates in
+    make verify, loaded from the script so the artifact's number and
+    the gate's number can never diverge in method."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "check_slo_overhead",
+        os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "scripts", "check_slo_overhead.py",
+        ),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.measure_slo_overhead(config=config, rounds=rounds)
 
 
 def run_compile_artifacts(config: int = 3) -> dict:

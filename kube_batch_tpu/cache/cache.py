@@ -204,6 +204,11 @@ class SchedulerCache:
         # TaskSchedulingLatency).  Only pods that arrive PENDING count:
         # a pod ingested already running was scheduled by someone else.
         self._arrival_ts: dict[str, float] = {}
+        # PodGroup arrival stamps → the gang time-to-full-placement
+        # SLO series (trace/slo.py): observed ONCE, when the group's
+        # recomputed status first reaches Running (min_member placed).
+        self._group_arrival_ts: dict[str, float] = {}
+        self._group_placed_seen: set[str] = set()
         # > 0 between begin_resync() and end_resync(): the mirror must
         # not be scheduled against (see snapshot()'s guard).  A DEPTH,
         # not a flag: two independent actors hold quiesces — the
@@ -788,11 +793,28 @@ class SchedulerCache:
                     spec=self.spec, pod_group=group, queue=queue
                 )
                 self._mark_job_added(group.name)
+                # The gang's SLO clock starts at first sight — but
+                # only for gangs that arrive NOT yet fully placed: a
+                # group ingested already Running (restart/relist
+                # against a live cluster) was placed by a previous
+                # incarnation, and observing a near-zero wait for it
+                # would dilute the gang SLO exactly when a restarted
+                # scheduler's own stuck gangs should burn it (same
+                # rule as the pod arrival stamps above, which count
+                # only pods arriving PENDING).
+                if str(group.phase) == "Running":
+                    self._group_placed_seen.add(group.name)
+                else:
+                    self._group_arrival_ts.setdefault(
+                        group.name, time.monotonic()
+                    )
 
     def delete_pod_group(self, name: str) -> None:
         with self._lock:
             if self._jobs.pop(name, None) is not None:
                 self._mark_full("job-deleted")
+            self._group_arrival_ts.pop(name, None)
+            self._group_placed_seen.discard(name)
         self._status_retry.discard(name)
 
     def add_queue(self, queue: Queue) -> None:
@@ -1139,7 +1161,11 @@ class SchedulerCache:
             ts = self._arrival_ts.pop(pod_uid, None)
             self.update_pod_status(pod_uid, TaskStatus.BOUND)
         if ts is not None:
-            metrics.task_scheduling_latency.observe(time.monotonic() - ts)
+            placed_after = time.monotonic() - ts
+            metrics.task_scheduling_latency.observe(placed_after)
+            # SLO series feed (trace/slo.py): pod time-to-placement,
+            # observed at the wire ack like the histogram above.
+            trace.slo_observe("placement", placed_after)
         if self.health is not None:
             self.health.note_bind_success(node_name)
         trace.note_wire("bind", pod.name, True, node=node_name)
@@ -1280,6 +1306,19 @@ class SchedulerCache:
                 )
                 for n in targets
             ]
+            # Gang time-to-full-placement SLO feed (trace/slo.py):
+            # the first refresh that sees a group Running consumes its
+            # arrival stamp — one observation per gang lifetime.
+            gang_waits = []
+            for group, _changed in groups:
+                if str(group.phase) == "Running" and \
+                        group.name not in self._group_placed_seen:
+                    self._group_placed_seen.add(group.name)
+                    ts = self._group_arrival_ts.pop(group.name, None)
+                    if ts is not None:
+                        gang_waits.append(time.monotonic() - ts)
+        for wait in gang_waits:
+            trace.slo_observe("gang", wait)
         written = 0
         for group, changed in groups:
             if changed or group.name in self._status_retry:
@@ -1348,6 +1387,8 @@ class SchedulerCache:
             self._resync.clear()
             self._status_counts.clear()
             self._arrival_ts.clear()
+            self._group_arrival_ts.clear()
+            self._group_placed_seen.clear()
             self._node_version += 1
             self._mark_full("relist")
             self.add_queue(Queue(name=self.default_queue, weight=1.0))

@@ -99,6 +99,30 @@ LEASE_TTL = 1e9  # ticks are the only clock; partitions break renewals
 #: as the classic engine's BLACKHOLE_WIRE_TIMEOUT).
 ASYM_WIRE_TIMEOUT = 1.5
 
+# -- SLO engine wiring (trace/slo.py), tick-clocked ------------------------
+#: The cells run arms each cell's tracer with a TICK-clocked SLO
+#: engine.  The deterministic invariant rides the CYCLE objective: a
+#: cycle that ran feeds its real (tiny) wall latency through
+#: Tracer.end_cycle — always under the generous threshold, so a
+#: healthy cell never reads bad on wall-clock noise (the PR-8
+#: lesson) — while every stood-down tick (full partition, lease
+#: unreachable) feeds one synthetic bad observation.  The dark window
+#: therefore drives a fast burn EXACTLY over its ticks, and the
+#: healed cell's sliding windows clear it.
+CYCLE_SLO_THRESHOLD_S = 30.0
+CYCLE_SLO_BAD_VALUE = 2 * CYCLE_SLO_THRESHOLD_S
+#: Placement objective (informational, rides the summary): pending
+#: pods older than this many ticks burn; first placements observe
+#: their age.
+PLACEMENT_SLO_THRESHOLD_TICKS = 3.0
+#: Multi-window pairs in TICKS: (short, long, burn threshold).
+SLO_FAST = (3.0, 6.0, 4.0)
+SLO_SLOW = (6.0, 12.0, 2.0)
+#: Ticks past a partition heal within which the victim's fast burn
+#: must still have been observed flagged (evaluation trails the
+#: window by up to a bucket).
+SLO_FLAG_GRACE_TICKS = 2
+
 
 @dataclasses.dataclass(frozen=True)
 class CellFaultSpec:
@@ -316,6 +340,7 @@ class CellChaosResult:
     reclaim: dict | None = None
     ingest: dict | None = None
     trace: dict | None = None
+    slo: dict | None = None
 
     def summary(self) -> dict:
         return {
@@ -334,6 +359,7 @@ class CellChaosResult:
             "reclaim": self.reclaim,
             "ingest": self.ingest,
             "trace": self.trace,
+            "slo": self.slo,
         }
 
 
@@ -412,6 +438,21 @@ class CellChaosEngine:
         self._straddle_rollbacks = 0
         self._trace_dump_dir: str | None = None
         self._trace_summary: dict | None = None
+        # -- SLO engine state (trace/slo.py; trace_obs == "on" only) ---
+        #: uid -> submit tick (the placement series' arrival clock).
+        self._arrival_ticks: dict[str, int] = {}
+        self._placement_seen: set[str] = set()
+        #: cell -> ticks its CYCLE objective read fast-burn.
+        self._slo_flagged: dict[str, set[int]] = {}
+        #: The /debug/fleet body captured the first tick a FULLY DARK
+        #: cell read fast-burn — the acceptance evidence that the pane
+        #: names the burning cell while its peer reads healthy.
+        self._fleet_during_burn: dict | None = None
+        self._slo_summary: dict | None = None
+        #: Cross-scheduler stitched traces (computed while the tracers
+        #: are still alive; cached — _check_cells and _teardown share
+        #: it).
+        self._stitched: dict | None = None
 
     # -- wiring ---------------------------------------------------------
     def _connect(self, rt: CellRuntime, replay: bool) -> None:
@@ -563,10 +604,19 @@ class CellChaosEngine:
         if donor is None:
             return
         try:
-            resp = rt.backend._call({
-                "verb": "claimCapacity", "from": donor,
-                "ttlTicks": spec.reclaim_ttl_ticks,
-            })
+            # The claim is the ORIGIN of a cross-scheduler flow: the
+            # flow's traceparent rides the claimCapacity request, the
+            # cluster remembers it on the claim, and the donor's
+            # drain + offer stitch under the same trace id
+            # (doc/design/observability.md).  A no-op flow when
+            # tracing is off.
+            with trace_obs_mod.flow(
+                "reclaim-claim", cell=rt.name, donor=donor,
+            ):
+                resp = rt.backend._call({
+                    "verb": "claimCapacity", "from": donor,
+                    "ttlTicks": spec.reclaim_ttl_ticks,
+                })
         except (ConnectionError, TimeoutError):
             return  # partitioned mid-claim: retried next tick
         rt.claim_inflight = int(resp.get("claim", 0)) or None
@@ -627,18 +677,46 @@ class CellChaosEngine:
                     ),
                     key=lambda p: p.uid,
                 )
+            victim_nodes = {p.uid: p.node for p in victims}
+            # The donor side of the stitched flow: adopt the
+            # claimant's propagated context (the cluster handed it
+            # back on listClaims), so the drain evictions and the
+            # offer record as CHILD spans under the claim's trace id
+            # — one Perfetto tree spanning both schedulers.
+            from kube_batch_tpu.trace import context as trace_ctx
+
+            parent = trace_ctx.parse(claim.get("traceparent"))
             try:
-                for pod in victims:
-                    rt.seam.evict(pod, "reclaim-donate")
-                rt.backend._call({
-                    "verb": "offerCapacity", "claim": claim["id"],
-                    "node": node.name,
-                })
+                with trace_obs_mod.flow(
+                    "reclaim-donate", ctx=parent, cell=rt.name,
+                    claim=claim["id"], node=node.name,
+                ):
+                    for pod in victims:
+                        rt.seam.evict(pod, "reclaim-donate")
+                    rt.backend._call({
+                        "verb": "offerCapacity", "claim": claim["id"],
+                        "node": node.name,
+                    })
             except (ConnectionError, TimeoutError):
                 return  # partitioned mid-donation: claim rolls back
             except RuntimeError as exc:
                 log.warning("%s: donation refused: %s", rt.name, exc)
                 return
+            # The donor's decision story: a pod reclaimed across
+            # cells must show the donor's drain eviction next to the
+            # recipient's placement at /debug/pods/<uid> (the merged
+            # fleet story) — the engine evicts through the raw seam,
+            # which bypasses the cache's eviction funnel, so the
+            # records land here.
+            dlog = trace_obs_mod.decision_log()
+            if dlog is not None:
+                for pod in victims:
+                    dlog.note_eviction(
+                        pod.uid, pod.name, pod.group,
+                        victim_nodes.get(pod.uid),
+                        "reclaim-donate",
+                        trace_obs_mod.current_cycle(),
+                    )
             rt.donations += 1
             self.fault_counts["reclaim-grant"] += 1
             rec.setdefault("donations", []).append({
@@ -719,6 +797,149 @@ class CellChaosEngine:
                 detail["local"] = "rejected-on-wire"
         self.fault_counts["xcell-probe"] += 1
         rec.setdefault("xcell-probe", []).append(detail)
+
+    # -- SLO engine feed + evaluation (trace/slo.py) --------------------
+    def _feed_slo(self, t: int, rec: dict, cycled: set[str]) -> None:
+        """Per-tick SLO feeding, deterministic: every cell that did
+        NOT run a cycle this tick (fully dark, lease unreachable)
+        feeds one synthetic bad cycle observation (a stood-down
+        scheduler is an infinitely late cycle); placement observes
+        pending-pod ages and first placements in ticks, from the
+        cluster's authoritative state.  Then every engine evaluates —
+        a fresh fast-burn breach auto-dumps an 'slo-burn' post-mortem
+        into that cell's flight recorder."""
+        if self.trace_obs != "on":
+            return
+        with self.cluster._lock:
+            pods = [
+                (self.cluster.cell_of_pod(p), uid, p.status)
+                for uid, p in sorted(self.cluster.pods.items())
+            ]
+            dark_now = set(self.cluster.full_partitioned)
+        placed = (TaskStatus.BOUND, TaskStatus.RUNNING)
+        slo_rec: dict = {}
+        for rt in self.cells:
+            tracer = trace_obs_mod.get(scope=rt.name)
+            if tracer is None or tracer.slo is None:
+                continue
+            engine = tracer.slo
+            if rt.name not in cycled:
+                engine.observe("cycle", CYCLE_SLO_BAD_VALUE)
+            for cell, uid, status in pods:
+                if cell != rt.name:
+                    continue
+                arrived = self._arrival_ticks.get(uid)
+                if arrived is None:
+                    continue
+                age = float(t - arrived)
+                if status == TaskStatus.PENDING:
+                    if age > PLACEMENT_SLO_THRESHOLD_TICKS:
+                        engine.observe("placement", age)
+                elif status in placed and \
+                        uid not in self._placement_seen:
+                    self._placement_seen.add(uid)
+                    engine.observe("placement", age)
+            state = engine.evaluate()
+            fast = state["cycle"]["fast_burn"]
+            slo_rec[rt.name] = {
+                "cycle_fast_burn": fast,
+                "burn": state["cycle"]["burn"],
+            }
+            if fast:
+                self._slo_flagged.setdefault(rt.name, set()).add(t)
+                if self._fleet_during_burn is None and \
+                        rt.name in dark_now:
+                    # The acceptance evidence: ONE /debug/fleet body,
+                    # captured while the dark cell burns — it must
+                    # name the burning cell and show the peer healthy.
+                    body = trace_obs_mod.debug_http("/debug/fleet")[1]
+                    self._fleet_during_burn = {
+                        "tick": t,
+                        "burning_cell": rt.name,
+                        "burning": (body.get("fleet") or {})
+                        .get("burning"),
+                        "cells": {
+                            name: {
+                                "state": blk.get("state"),
+                                "fast_burning": sorted(
+                                    (blk.get("slo") or {})
+                                    .get("burning") or []
+                                ),
+                            }
+                            for name, blk in
+                            (body.get("cells") or {}).items()
+                        },
+                    }
+        if slo_rec:
+            rec["slo"] = slo_rec
+
+    def _stitched_traces(self) -> dict:
+        """Trace ids whose spans appear in ≥2 cells' tracers — the
+        cross-scheduler stitching evidence (a reclaim's claim span in
+        the starved cell, its drain+offer span in the donor, one
+        trace id).  Computed while the tracers are alive; the merged
+        Perfetto-loadable export is written next to the flight
+        recorder dumps."""
+        if self._stitched is not None:
+            return self._stitched
+        per_cell: dict[str, dict[str, list[dict]]] = {}
+        for rt in self.cells:
+            tracer = trace_obs_mod.get(scope=rt.name)
+            if tracer is None:
+                continue
+            by_id: dict[str, list[dict]] = {}
+            for ev in tracer.spans.chrome_events():
+                tid = (ev.get("args") or {}).get("trace_id")
+                if tid:
+                    by_id.setdefault(tid, []).append(ev)
+            per_cell[rt.name] = by_id
+        all_ids: set[str] = set()
+        for by_id in per_cell.values():
+            all_ids.update(by_id)
+        stitched: dict[str, dict] = {}
+        for tid in sorted(all_ids):
+            cells = sorted(c for c, by_id in per_cell.items()
+                           if tid in by_id)
+            if len(cells) >= 2:
+                stitched[tid] = {
+                    "cells": cells,
+                    "spans": {
+                        c: sorted(ev["name"]
+                                  for ev in per_cell[c][tid])
+                        for c in cells
+                    },
+                }
+        export_path = None
+        if stitched:
+            events = []
+            for cell, by_id in sorted(per_cell.items()):
+                for tid, evs in sorted(by_id.items()):
+                    if tid not in stitched:
+                        continue
+                    for ev in evs:
+                        ev = dict(ev)
+                        ev["args"] = {**(ev.get("args") or {}),
+                                      "cell": cell}
+                        events.append(ev)
+            try:
+                os.makedirs(self.dump_dir, exist_ok=True)
+                export_path = os.path.join(
+                    self.dump_dir,
+                    f"chaos-cells-stitched-seed{self.seed}.json",
+                )
+                with open(export_path, "w", encoding="utf-8") as f:
+                    json.dump({"traceEvents": events}, f, indent=1,
+                              sort_keys=True)
+                    f.write("\n")
+            except OSError as exc:
+                log.warning("stitched-trace export failed: %s", exc)
+                export_path = None
+        self._stitched = {
+            "count": len(stitched),
+            "traces": stitched,
+            "export": export_path,
+        }
+        return self._stitched
 
     # -- partition faults -----------------------------------------------
     def _fire_fault(self, ev: dict, t: int, rec: dict) -> None:
@@ -851,6 +1072,43 @@ class CellChaosEngine:
             trace_obs_mod.disable()
 
         self.cluster = ChaosCellCluster(seed=self.seed, history=8192)
+        if self.trace_obs == "on":
+            # Tick-clocked SLO engines, one per cell's tracer: the
+            # partitioned cell must FLAG fast-burn during its dark
+            # window (and auto-dump an 'slo-burn' post-mortem) and
+            # CLEAR after heal — engine invariants below.  Decision-
+            # invisible: observations only; the same seed hashes
+            # identically with the engines armed or not (the
+            # --trace off parity run pins it).
+            from kube_batch_tpu.trace.slo import SloEngine, SloObjective
+
+            for ev in events:
+                if ev.get("op") == "submit":
+                    for pod in ev.get("pods", ()):
+                        self._arrival_ticks[pod["uid"]] = ev["tick"]
+            for rt in self.cells:
+                tracer = trace_obs_mod.get(scope=rt.name)
+                tracer.arm_slo(SloEngine(
+                    [
+                        # min_events 2: the tick clock feeds ~1
+                        # observation per tick, so the production
+                        # cold-start floor (10) would outlast the
+                        # 3-tick fast window entirely.
+                        SloObjective(
+                            "cycle", "cycle", target=0.9,
+                            threshold=CYCLE_SLO_THRESHOLD_S,
+                            fast=SLO_FAST, slow=SLO_SLOW,
+                            min_events=2,
+                        ),
+                        SloObjective(
+                            "placement", "placement", target=0.9,
+                            threshold=PLACEMENT_SLO_THRESHOLD_TICKS,
+                            fast=SLO_FAST, slow=SLO_SLOW,
+                            min_events=2,
+                        ),
+                    ],
+                    clock=lambda: float(self.cluster.tick_now),
+                ))
         from kube_batch_tpu.guardrails import GuardrailConfig, Guardrails
 
         for rt in self.cells:
@@ -932,6 +1190,7 @@ class CellChaosEngine:
             for ev in evs:
                 apply_to_cluster(self.cluster, ev)
             rec["workload"] = len(evs)
+            cycled: set[str] = set()
             for rt in self.cells:
                 with self.cluster._lock:
                     fully_dark = rt.name in self.cluster.full_partitioned
@@ -957,6 +1216,7 @@ class CellChaosEngine:
                         # same seed hashes differently.
                         self._quiesce(rt)
                         rt.scheduler.run_once()
+                        cycled.add(rt.name)
                     else:
                         rt.stood_down += 1
             self.cluster.tick()
@@ -965,6 +1225,7 @@ class CellChaosEngine:
                     if rt.name in self.cluster.full_partitioned:
                         continue
                 self._quiesce(rt)
+            self._feed_slo(t, rec, cycled)
             found = self._drain_decisions(t, rec)
             found += checker.check_tick(t)
             if found:
@@ -1032,6 +1293,7 @@ class CellChaosEngine:
             reclaim=self._reclaim_summary(),
             ingest=self._ingest_summary(),
             trace=self._trace_summary,
+            slo=self._slo_summary,
         )
 
     # -- per-tick decision drain + cross-cell audit ---------------------
@@ -1211,6 +1473,105 @@ class CellChaosEngine:
                 "a straddle partition was configured but no claim "
                 "rolled back while its donor was dark",
             ))
+        out.extend(self._check_slo_and_stitching(tick))
+        return out
+
+    def _check_slo_and_stitching(self, tick: int) -> list[Violation]:
+        """The fleet-observability invariants (trace_obs == "on"
+        runs only): the partitioned cell's SLO engine flagged
+        fast-burn during its dark window, auto-dumped an 'slo-burn'
+        post-mortem, and cleared after heal; /debug/fleet named the
+        burning cell while its peer read healthy; and the reclaim
+        produced ≥1 stitched trace whose span tree crosses both
+        schedulers under one trace id."""
+        if self.trace_obs != "on":
+            return []
+        out: list[Violation] = []
+        spec = self.cell_faults
+        # Fast burn flagged during every (non-straddle) dark window.
+        for cell, windows in sorted(self._partition_windows.items()):
+            flagged = self._slo_flagged.get(cell, set())
+            for t0, t1 in windows:
+                if not any(t0 <= ft <= t1 + SLO_FLAG_GRACE_TICKS
+                           for ft in flagged):
+                    out.append(Violation(
+                        "slo-burn-not-flagged", tick,
+                        f"cell {cell!r} was fully dark over "
+                        f"[{t0},{t1}) but its SLO engine never read "
+                        "fast-burn during the window",
+                    ))
+        # ... and CLEARED by the end of the drain.
+        for rt in self.cells:
+            tracer = trace_obs_mod.get(scope=rt.name)
+            if tracer is None or tracer.slo is None:
+                continue
+            # Cleared = the deterministic CYCLE objective (the
+            # placement objective keeps honestly burning right up to
+            # the late placements a reclaim unblocks — that is the
+            # SLO telling the truth, not a failure to clear).
+            if "cycle" in tracer.slo.burning():
+                out.append(Violation(
+                    "slo-burn-not-cleared", tick,
+                    f"{rt.name}: the cycle objective still reads "
+                    "fast-burn after heal + drain — the burn never "
+                    "cleared",
+                ))
+            # A fresh fast-burn breach must have auto-dumped a
+            # post-mortem with trigger 'slo-burn' (rate-limited, so
+            # one per cell suffices).
+            if self._slo_flagged.get(rt.name) and not any(
+                d.get("trigger") == "slo-burn"
+                for d in tracer.recorder.dumps
+            ):
+                out.append(Violation(
+                    "slo-burn-dump-missing", tick,
+                    f"{rt.name}: fast-burn was flagged but no "
+                    "'slo-burn' flight-recorder post-mortem was "
+                    "auto-dumped",
+                ))
+        # The fleet pane named the burning cell while peers read
+        # healthy (captured live, during the dark window).
+        if self._partition_windows:
+            snap = self._fleet_during_burn
+            if snap is None:
+                out.append(Violation(
+                    "slo-fleet-snapshot-missing", tick,
+                    "a cell burned while dark but no /debug/fleet "
+                    "snapshot was captured",
+                ))
+            else:
+                victim = snap["burning_cell"]
+                vic = (snap["cells"].get(victim) or {})
+                if "cycle" not in (vic.get("fast_burning") or []):
+                    out.append(Violation(
+                        "slo-fleet-burn-missing", tick,
+                        f"/debug/fleet did not report cell {victim!r} "
+                        f"burning during its dark window: {snap}",
+                    ))
+                for name, blk in sorted(snap["cells"].items()):
+                    if name in ("", victim):
+                        continue
+                    # The deterministic objective is CYCLE (a live
+                    # peer always cycles); the placement objective is
+                    # workload-shaped and informational.
+                    if "cycle" in (blk.get("fast_burning") or []):
+                        out.append(Violation(
+                            "slo-peer-burning", tick,
+                            f"/debug/fleet showed PEER cell {name!r} "
+                            "fast-burning during the victim's dark "
+                            f"window: {snap}",
+                        ))
+        # Cross-scheduler stitching: the reclaim must leave ≥1 trace
+        # whose span tree crosses both schedulers.
+        if spec.starve_pods:
+            stitched = self._stitched_traces()
+            if stitched["count"] < 1:
+                out.append(Violation(
+                    "trace-not-stitched", tick,
+                    "cross-cell reclaim ran but no trace id appears "
+                    "in BOTH schedulers' span trees — stitching is "
+                    "broken",
+                ))
         return out
 
     # -- summaries ------------------------------------------------------
@@ -1292,7 +1653,9 @@ class CellChaosEngine:
 
     def _teardown(self) -> None:
         if self.trace_obs == "on":
+            stitched = self._stitched_traces()
             per_cell = {}
+            slo_cells = {}
             for rt in self.cells:
                 tracer = trace_obs_mod.get(scope=rt.name)
                 if tracer is not None:
@@ -1304,10 +1667,35 @@ class CellChaosEngine:
                         "dumps": [dict(d) for d in
                                   tracer.recorder.dumps],
                     }
+                    if tracer.slo is not None:
+                        state = tracer.slo.state()
+                        slo_cells[rt.name] = {
+                            "flagged_ticks": sorted(
+                                self._slo_flagged.get(rt.name, ())
+                            ),
+                            "still_burning": tracer.slo.burning(),
+                            "breaches": {
+                                name: st.get("breaches", 0)
+                                for name, st in
+                                state["objectives"].items()
+                            },
+                            "slo_burn_dumps": sum(
+                                1 for d in tracer.recorder.dumps
+                                if d.get("trigger") == "slo-burn"
+                            ),
+                        }
                 trace_obs_mod.disable(scope=rt.name)
-            self._trace_summary = {"enabled": True, "cells": per_cell}
+            self._trace_summary = {
+                "enabled": True, "cells": per_cell,
+                "stitched": stitched,
+            }
+            self._slo_summary = {
+                "cells": slo_cells,
+                "fleet_during_burn": self._fleet_during_burn,
+            }
         else:
             self._trace_summary = {"enabled": False}
+            self._slo_summary = None
         if self._trace_dump_dir is not None:
             import shutil
 

@@ -211,6 +211,31 @@ def build_parser() -> argparse.ArgumentParser:
                         "directory (last 8 x 128-cycle chunks kept); "
                         "unset serves spans on demand at /debug/trace "
                         "only")
+    p.add_argument("--slo", action="append", default=None,
+                   metavar="SPEC",
+                   help="declare one SLO objective (repeatable): "
+                        "'<series>:<target>%%<<threshold>[ms|s|m]', "
+                        "e.g. 'placement:99%%<30s' = 99%% of pods "
+                        "placed within 30 s; series: placement, gang, "
+                        "cycle, commit_flush, ingest_lag; the literal "
+                        "value 'default' arms the built-in set.  The "
+                        "engine evaluates multi-window burn rates "
+                        "every cycle (fast 5m/1h >= 14.4x pages and "
+                        "auto-dumps a flight-recorder post-mortem "
+                        "with trigger 'slo-burn'; slow 1h/6h >= 6x "
+                        "warns), gauges slo_burn_rate{slo,window}, "
+                        "and serves live state at GET /debug/slo "
+                        "(doc/design/observability.md)")
+    p.add_argument("--fleet-peers", default=None,
+                   help="comma-separated base URLs of PEER scheduler "
+                        "processes' --listen-address endpoints (e.g. "
+                        "http://cell-b:8080,http://cell-c:8080): GET "
+                        "/debug/fleet merges every peer's /healthz + "
+                        "/debug/slo (fetched best-effort with "
+                        "per-peer staleness stamps) with this "
+                        "process's own scopes into one fleet pane — "
+                        "per-cell leader/epoch/ladder/SLO burn plus "
+                        "fleet rollups")
     # -- guardrails (kube_batch_tpu/guardrails/; doc/design/guardrails.md)
     p.add_argument("--hbm-ceiling-mb", type=float, default=None,
                    help="HBM-ceiling admission: refuse growth-prewarm "
@@ -1255,6 +1280,10 @@ def main(argv: list[str] | None = None) -> int:
             flight_cycles=args.flight_recorder_cycles,
             dump_dir=args.flight_recorder_dir,
             trace_dir=args.trace_dir,
+            # Dump filenames carry the cell so N daemons sharing one
+            # --flight-recorder-dir never interleave ambiguous
+            # post-mortems.
+            tag=args.cell or None,
         )
         tracer.recorder.install_signal_handler()
         logging.info(
@@ -1265,6 +1294,43 @@ def main(argv: list[str] | None = None) -> int:
             tracer.recorder.dump_dir,
             f", span chunks -> {args.trace_dir}" if args.trace_dir
             else "",
+        )
+        if args.slo:
+            # SLO burn-rate engine (doc/design/observability.md): the
+            # declared objectives evaluate every cycle; a fast-burn
+            # breach is a flight-recorder trigger like breaker-open.
+            from kube_batch_tpu.trace.slo import (
+                SloEngine,
+                parse_slo_specs,
+            )
+
+            try:
+                objectives = parse_slo_specs(args.slo)
+            except ValueError as exc:
+                logging.error("--slo: %s", exc)
+                return 1
+            tracer.arm_slo(SloEngine(objectives))
+            logging.info(
+                "SLO engine armed: %s (burn state at /debug/slo, "
+                "fleet rollup at /debug/fleet)",
+                ", ".join(
+                    f"{o.name} {o.target:.0%}<{o.threshold:g}s"
+                    for o in objectives
+                ),
+            )
+    elif args.slo:
+        logging.warning(
+            "--slo ignored: the SLO engine rides the tracing "
+            "subsystem, which --flight-recorder-cycles 0 disabled"
+        )
+    if args.fleet_peers:
+        from kube_batch_tpu.trace import fleet
+
+        peers = [p for p in args.fleet_peers.split(",") if p.strip()]
+        fleet.configure(peers)
+        logging.info(
+            "fleet pane: %d peer(s) merged into GET /debug/fleet",
+            len(peers),
         )
 
     # Metrics listener first: it serves in EVERY mode, including the

@@ -348,6 +348,21 @@ class StreamBackend:
             # the cluster learns each session's cell for the
             # partition fault family.
             payload["cell"] = self._cell
+        if "traceparent" not in payload:
+            # Cross-scheduler trace stitching (doc/design/
+            # observability.md · wire format): the calling thread's
+            # active flow rides the request as a W3C traceparent, so
+            # the receiving side (ExternalCluster handlers, a donor
+            # cell's scheduler via listClaims, a takeover successor)
+            # opens child spans under it.  DECISION-INVISIBLE: the
+            # field is never logged into the hashed chaos wire log and
+            # never read by any handler's semantics — None when
+            # tracing is off, which is exactly "stitching off".
+            from kube_batch_tpu import trace
+
+            tp = trace.wire_traceparent()
+            if tp is not None:
+                payload["traceparent"] = tp
         if self.closed.is_set():
             raise ConnectionError("cluster stream closed")
         rid = next(self._ids)
@@ -1122,8 +1137,14 @@ class WatchAdapter:
             lag = max(0.0, time.monotonic() - records[-1].ts)
             metrics.ingest_lag.observe(lag)
             # /healthz carries the freshest lag so probes see backlog
-            # pressure without scraping (and parsing) /metrics.
+            # pressure without scraping (and parsing) /metrics.  The
+            # applier thread is bound to its owner's scope, so the
+            # value lands in THAT scheduler's /healthz entry (and its
+            # SLO engine's ingest_lag series) — never a sibling's.
             metrics.set_ingest_lag(lag)
+            from kube_batch_tpu import trace
+
+            trace.slo_observe("ingest_lag", lag)
             metrics.ingest_batch_size.observe(float(len(records)))
             if coalesced:
                 metrics.ingest_coalesced.inc(by=float(coalesced))

@@ -140,6 +140,14 @@ class ExternalCluster:
         #: stash) — _bind_pod/_evict_pod enforce scope from it for
         #: BOTH wire dialects.
         self._req_cell: str | None = None
+        #: The W3C traceparent of the request CURRENTLY dispatching
+        #: (cross-scheduler trace stitching, doc/design/
+        #: observability.md): stashed like the cell, consumed by the
+        #: reclaim verbs (a claim REMEMBERS its claimant's context so
+        #: the donor stitches its drain under the same trace id) and
+        #: by the cluster's own handler spans.  Never logged into the
+        #: hashed wire log — stitching is decision-invisible.
+        self._req_trace: str | None = None
         #: writer-id → cell, learned from each session's requests: the
         #: partition fault family needs to know which sessions belong
         #: to a dark cell (broadcast suppression keys on this).
@@ -897,13 +905,33 @@ class ExternalCluster:
                 # cell for the dialect-shared scope checks.
                 self._session_cells[id(writer)] = str(cell)
             self._req_cell = str(cell) if cell is not None else None
+            tp = msg.get("traceparent")
+            self._req_trace = str(tp) if isinstance(tp, str) else None
             try:
                 self._handle_locked(writer, verb, rid, msg)
             finally:
                 self._req_cell = None
+                self._req_trace = None
 
     def _handle_locked(self, writer: IO[str], verb, rid,
-                   msg: dict) -> None:
+                       msg: dict) -> None:
+        if self._req_trace is None:
+            return self._dispatch_locked(writer, verb, rid, msg)
+        # Trace stitching, receiving side: the cluster's handling of a
+        # context-carrying request records as a CHILD span under the
+        # propagated traceparent (no-op when tracing is off) — the
+        # cluster hop shows up in the same Perfetto tree as the
+        # scheduler that issued the write.
+        from kube_batch_tpu import trace
+
+        with trace.adopted_span(
+            "cluster:" + str(verb or msg.get("path") or "?"),
+            self._req_trace,
+        ):
+            return self._dispatch_locked(writer, verb, rid, msg)
+
+    def _dispatch_locked(self, writer: IO[str], verb, rid,
+                         msg: dict) -> None:
         if not self._check_epoch(writer, msg):
             return  # zombie write from a deposed epoch: rejected
         if "path" in msg:  # apiserver-dialect write
@@ -1031,6 +1059,13 @@ class ExternalCluster:
             "created": self.claim_clock,
             "deadline": self.claim_clock + max(ttl, 1),
             "node": None,
+            # The claimant's propagated trace context: listClaims
+            # hands it to the donor, whose drain + offer open child
+            # spans under it — one Perfetto tree spanning both
+            # schedulers.  Rides OUTSIDE the hashed wire-log entries
+            # (which name only op/claim/cells), so stitching on/off
+            # never moves the chaos hash.
+            "traceparent": self._req_trace,
         }
         self.reclaim_claims[claim["id"]] = claim
         self._on_reclaim({

@@ -150,6 +150,15 @@ class _Client:
         tok = self._bearer()
         if tok:
             h["Authorization"] = f"Bearer {tok}"
+        # Cross-scheduler trace stitching, HTTP dialect: the calling
+        # thread's active flow rides every request as the standard
+        # W3C traceparent header (doc/design/observability.md · wire
+        # format) — absent entirely when tracing is off.
+        from kube_batch_tpu import trace
+
+        tp = trace.wire_traceparent()
+        if tp is not None:
+            h["traceparent"] = tp
         if extra:
             h.update(extra)
         return h

@@ -51,6 +51,28 @@ _WARNING_REASONS = frozenset({
     "BindFailed", "EvictFailed", "FailedScheduling", "Unschedulable",
 })
 
+#: Annotation key carrying the cross-scheduler trace context in the
+#: apiserver dialect (doc/design/observability.md · wire format): a
+#: W3C traceparent on the written OBJECT's metadata, so any consumer
+#: replaying these shapes against a real cluster keeps the stitching.
+TRACEPARENT_ANNOTATION = "kube-batch.tpu/traceparent"
+
+
+def _stamp_trace(obj: dict) -> dict:
+    """Annotate a k8s-shaped object with the calling thread's active
+    flow context (no-op when tracing is off — the apiserver dialect's
+    form of the native stream's top-level ``traceparent`` field).
+    Decision-invisible: consumers never read the annotation's
+    semantics, and the chaos wire log hashes none of it."""
+    from kube_batch_tpu import trace
+
+    tp = trace.wire_traceparent()
+    if tp is not None:
+        obj.setdefault("metadata", {}).setdefault(
+            "annotations", {}
+        )[TRACEPARENT_ANNOTATION] = tp
+    return obj
+
 
 def binding_request(pod: Pod, node_name: str) -> dict[str, Any]:
     """≙ defaultBinder: POST core/v1 Binding to the binding subresource."""
@@ -59,7 +81,7 @@ def binding_request(pod: Pod, node_name: str) -> dict[str, Any]:
         "path": (
             f"/api/v1/namespaces/{pod.namespace}/pods/{pod.name}/binding"
         ),
-        "object": {
+        "object": _stamp_trace({
             "apiVersion": "v1",
             "kind": "Binding",
             "metadata": {
@@ -72,7 +94,7 @@ def binding_request(pod: Pod, node_name: str) -> dict[str, Any]:
                 "kind": "Node",
                 "name": node_name,
             },
-        },
+        }),
     }
 
 
@@ -80,6 +102,11 @@ def evict_request(pod: Pod) -> dict[str, Any]:
     """≙ defaultEvictor: graceful pod DELETE with a uid precondition
     (delete exactly the pod the decision was made against, not a
     same-named successor)."""
+    # NOT trace-stamped: DeleteOptions has no ObjectMeta, so an
+    # annotation here would be an invalid shape against a real
+    # apiserver (fieldValidation=Strict rejects it).  The eviction's
+    # context still rides the native dialect's top-level field; in
+    # the apiserver dialect the accompanying Evicted Event narrates.
     return {
         "verb": "delete",
         "path": f"/api/v1/namespaces/{pod.namespace}/pods/{pod.name}",
@@ -105,7 +132,7 @@ def pod_group_status_request(
             f"/apis/{api_version}/namespaces/default/"
             f"podgroups/{group.name}/status"
         ),
-        "object": {
+        "object": _stamp_trace({
             "apiVersion": api_version,
             "kind": "PodGroup",
             "metadata": {
@@ -131,7 +158,7 @@ def pod_group_status_request(
                     for c in group.conditions
                 ],
             },
-        },
+        }),
     }
 
 
@@ -172,7 +199,7 @@ def state_snapshot_request(payload: dict) -> dict[str, Any]:
     return {
         "verb": "update",
         "path": STATE_CONFIGMAP_PATH,
-        "object": {
+        "object": _stamp_trace({
             "apiVersion": "v1",
             "kind": "ConfigMap",
             "metadata": {
@@ -180,7 +207,7 @@ def state_snapshot_request(payload: dict) -> dict[str, Any]:
                 "namespace": STATE_CONFIGMAP_NAMESPACE,
             },
             "data": {"state": _json.dumps(payload, sort_keys=True)},
-        },
+        }),
     }
 
 
@@ -207,7 +234,7 @@ def compile_artifact_request(payload: dict) -> dict[str, Any]:
     return {
         "verb": "patch",
         "path": COMPILE_CONFIGMAP_PATH,
-        "object": {
+        "object": _stamp_trace({
             "apiVersion": "v1",
             "kind": "ConfigMap",
             "metadata": {
@@ -215,7 +242,7 @@ def compile_artifact_request(payload: dict) -> dict[str, Any]:
                 "namespace": COMPILE_CONFIGMAP_NAMESPACE,
             },
             "data": {name: _json.dumps(payload, sort_keys=True)},
-        },
+        }),
     }
 
 

@@ -77,6 +77,7 @@ import threading
 import time
 
 from kube_batch_tpu import metrics, trace
+from kube_batch_tpu.trace import context as trace_context
 
 log = logging.getLogger(__name__)
 
@@ -95,9 +96,10 @@ _worker_tls = threading.local()
 
 class _Op:
     __slots__ = ("key", "verb", "fn", "enqueued_at", "batch",
-                 "trace_cycle")
+                 "trace_cycle", "trace_ctx")
 
-    def __init__(self, key, verb, fn, enqueued_at, batch, trace_cycle=0):
+    def __init__(self, key, verb, fn, enqueued_at, batch, trace_cycle=0,
+                 trace_ctx=None):
         self.key = key
         self.verb = verb
         self.fn = fn
@@ -108,6 +110,11 @@ class _Op:
         # worker finally lands the RTT), so a Perfetto view shows
         # cycle N's commit tail overlapping cycle N+1's solve.
         self.trace_cycle = trace_cycle
+        # The FLOW context active at enqueue (the cycle's trace id):
+        # the worker re-binds it around the flush so the wire write
+        # carries the enqueuing cycle's traceparent even though it
+        # lands threads and cycles later.
+        self.trace_ctx = trace_ctx
 
 
 class CommitPipeline:
@@ -207,7 +214,7 @@ class CommitPipeline:
                     b["first"] = now
                 b["pending"] += 1
                 op = _Op(key, verb, fn, now, self._batch_seq,
-                         trace.current_cycle())
+                         trace.current_cycle(), trace_context.current())
                 q = self._queues.get(key)
                 if q is None:
                     q = self._queues[key] = collections.deque()
@@ -217,7 +224,7 @@ class CommitPipeline:
                     self._ready.append(key)
                 self._pending += 1
                 self.max_depth_seen = max(self.max_depth_seen, self._pending)
-                metrics.commit_queue_depth.set(float(self._pending))
+                metrics.set_commit_queue_depth(self._pending)
                 if len(self._threads) < self._nworkers:
                     self._spawn_workers_locked()
                 self._cv.notify()
@@ -258,6 +265,11 @@ class CommitPipeline:
             started = time.monotonic()
             overlapped = self._solving
             flush_ok = True
+            # Re-bind the enqueuing cycle's flow context: the flush's
+            # wire write (and its span) stitches to the cycle that
+            # decided it, not whatever cycle is solving right now.
+            tok = trace_context.bind(op.trace_ctx) \
+                if op.trace_ctx is not None else None
             try:
                 with trace.span(
                     "flush:" + op.verb, cycle=op.trace_cycle,
@@ -274,6 +286,9 @@ class CommitPipeline:
                     "commit flush op (%s %s) raised unexpectedly",
                     op.verb, op.key,
                 )
+            finally:
+                if op.trace_ctx is not None:
+                    trace_context.restore(tok)
             if op.verb != "bind":
                 # Bind outcomes land in the wire ring from the cache's
                 # own finish_bind funnel (shared with the sync path);
@@ -285,6 +300,10 @@ class CommitPipeline:
             metrics.commit_flush_latency.observe(
                 done - op.enqueued_at, op.verb
             )
+            # SLO series feed (trace/slo.py): enqueue→ack latency per
+            # op; the worker thread is scope-bound, so the observation
+            # lands in the OWNING scheduler's engine.
+            trace.slo_observe("commit_flush", done - op.enqueued_at)
             finalize = None
             with self._cv:
                 self._running_keys[key] -= 1
@@ -295,7 +314,7 @@ class CommitPipeline:
                     self._queues.pop(key, None)     # keys are pod uids:
                     self._running_keys.pop(key, None)  # don't leak them
                 self._pending -= 1
-                metrics.commit_queue_depth.set(float(self._pending))
+                metrics.set_commit_queue_depth(self._pending)
                 dur = done - started
                 self._flush_busy_s += dur
                 if overlapped or self._solving:

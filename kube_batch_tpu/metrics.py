@@ -346,6 +346,24 @@ commit_flush_errors = REGISTRY.register(Counter(
     "(bugs; the worker survives and logs the stack).",
 ))
 
+# -- SLO burn-rate engine (kube_batch_tpu/trace/slo.py) ----------------------
+slo_burn_rate = REGISTRY.register(Gauge(
+    "slo_burn_rate",
+    "Error-budget burn rate per SLO objective and evaluation window "
+    "(burn = bad_fraction / error_budget; 1.0 = spending the budget "
+    "exactly on schedule).  Fast-burn alerts fire when BOTH fast "
+    "windows exceed their threshold (default 14.4x over 5m AND 1h) "
+    "and auto-dump a flight-recorder post-mortem "
+    "(doc/design/observability.md).",
+    labels=("slo", "window"),
+))
+slo_breaches = REGISTRY.register(Counter(
+    "slo_breaches_total",
+    "Fresh fast-burn SLO breaches per objective (a sustained burn "
+    "counts once until it clears and re-fires).",
+    labels=("slo",),
+))
+
 # -- guardrail subsystem (kube_batch_tpu/guardrails/) ------------------------
 guardrail_state = REGISTRY.register(Gauge(
     "guardrail_state",
@@ -575,6 +593,11 @@ def _scope_entry(name: str) -> dict:
     return _health_scopes.setdefault(name, {
         "state": "ok", "role": "standby", "epoch": 0,
         "quarantined": 0, "cell_peer_visible": None,
+        # Backlog pressure PER SCOPE: two in-process schedulers (the
+        # cells chaos drive, bench cells_aggregate) must not report
+        # each other's ingest lag / commit depth through the
+        # process-global gauges.
+        "ingest_lag_seconds": 0.0, "commit_queue_depth": 0,
     })
 
 
@@ -678,19 +701,64 @@ def reset_health_scopes() -> None:
         _health_scopes.clear()
 
 
+def health_snapshot() -> dict[str, dict]:
+    """Every scope's health fields, keyed by scope name ("" = the
+    process-global daemon) — the fleet pane's in-process read
+    (trace/fleet.py).  The "" entry mirrors the /healthz top level;
+    scoped entries carry their own backlog fields."""
+    with _health_lock:
+        out = {
+            "": {
+                "state": _health_state,
+                "role": _health_role,
+                "epoch": _health_epoch,
+                "quarantined": _health_quarantined,
+                "cell": _health_cell,
+                "cell_peer_visible": _health_cell_peer_visible,
+                "ingest_lag_seconds": round(_health_ingest_lag, 3),
+            },
+            **{name: dict(entry)
+               for name, entry in sorted(_health_scopes.items())},
+        }
+    out[""]["commit_queue_depth"] = int(commit_queue_depth.value())
+    return out
+
+
 def quarantined() -> int:
     with _health_lock:
         return _health_quarantined
 
 
-def set_ingest_lag(seconds: float) -> None:
+def set_ingest_lag(seconds: float, scope: str | None = None) -> None:
     """Publish the freshest ingest lag (age of the newest applied
     watch event) to /healthz — probes see backlog pressure without
     scraping and parsing the `ingest_lag_seconds` histogram.  Set by
-    the batched ingest applier on every applied batch."""
+    the batched ingest applier on every applied batch; resolved
+    through the CALLER'S scope (the applier thread binds its owning
+    scheduler's) so two in-process schedulers never report each
+    other's backlog."""
     global _health_ingest_lag
+    s = _resolve_scope(scope)
     with _health_lock:
-        _health_ingest_lag = float(seconds)
+        if s is not None:
+            _scope_entry(s)["ingest_lag_seconds"] = round(
+                float(seconds), 3
+            )
+        else:
+            _health_ingest_lag = float(seconds)
+
+
+def set_commit_queue_depth(depth: int, scope: str | None = None) -> None:
+    """Publish the commit pipeline's queued+running depth.  The
+    process-global gauge always updates (single-scheduler /metrics
+    behavior unchanged); under a bound scope the caller's /healthz
+    "cells" entry additionally carries ITS OWN depth — the scoped
+    read the fleet pane and the cells chaos/bench drives consume."""
+    commit_queue_depth.set(float(depth))
+    s = _resolve_scope(scope)
+    if s is not None:
+        with _health_lock:
+            _scope_entry(s)["commit_queue_depth"] = int(depth)
 
 
 def health_body() -> bytes:

@@ -349,9 +349,21 @@ class StateStore:
         metrics.statestore_records.set(float(self._records))
         sink = self.mirror_sink
         if sink is not None:
+            payload = {"v": VERSION, "cycle": self.cycle,
+                       "state": self._last_state}
+            # Cross-scheduler stitching (doc/design/observability.md):
+            # the mirroring cycle's flow context rides the payload so
+            # a takeover successor's adoption opens a child span under
+            # the dead leader's LAST mirror — the failover is one
+            # causal tree.  Loaders ignore unknown keys; version
+            # gating is untouched.
+            from kube_batch_tpu import trace as _trace
+
+            tp = _trace.wire_traceparent()
+            if tp is not None:
+                payload["traceparent"] = tp
             try:
-                sink({"v": VERSION, "cycle": self.cycle,
-                      "state": self._last_state})
+                sink(payload)
             except Exception as exc:  # noqa: BLE001 — the mirror is a
                 # replica; the journal already holds the truth
                 log.warning("state mirror sink failed (retried at the "
@@ -452,6 +464,7 @@ def adopt_state(
     restore summary, or None when both sources are cold."""
     state = statestore.load() if statestore is not None else None
     source = "journal"
+    peer_traceparent = None
     if state is None and backend is not None:
         get = getattr(backend, "get_state_snapshot", None)
         if callable(get):
@@ -480,6 +493,7 @@ def adopt_state(
                     isinstance(payload.get("state"), dict):
                 state = payload["state"]
                 source = "peer"
+                peer_traceparent = payload.get("traceparent")
                 if statestore is not None:
                     try:
                         statestore.cycle = max(
@@ -490,6 +504,22 @@ def adopt_state(
                         pass
     if not state:
         return None
+    if source == "peer" and peer_traceparent:
+        # Stitch the takeover to the dead leader's LAST mirror: the
+        # adoption records as a child span under the traceparent the
+        # mirror carried (no-op when tracing is off or the payload
+        # predates stitching) — a Perfetto export shows the dead
+        # leader's final compaction and its successor's adoption in
+        # one causal tree.
+        from kube_batch_tpu import trace as _trace
+
+        with _trace.adopted_span("state-adopt", peer_traceparent,
+                                 source="peer"):
+            return restore_state(
+                state, health=health, guardrails=guardrails,
+                scheduler=scheduler, max_age_cycles=max_age_cycles,
+                source=source,
+            )
     return restore_state(
         state, health=health, guardrails=guardrails, scheduler=scheduler,
         max_age_cycles=max_age_cycles, source=source,

@@ -35,16 +35,21 @@ from __future__ import annotations
 import logging
 import threading
 
+from kube_batch_tpu.trace import context
 from kube_batch_tpu.trace.decisions import DecisionLog
 from kube_batch_tpu.trace.recorder import TRIGGERS, FlightRecorder
+from kube_batch_tpu.trace.slo import SloEngine
 from kube_batch_tpu.trace.spans import SpanRecorder
 
 __all__ = [
     "DecisionLog",
     "FlightRecorder",
+    "SloEngine",
     "SpanRecorder",
     "TRIGGERS",
     "Tracer",
+    "adopted_span",
+    "all_tracers",
     "begin_cycle",
     "current_cycle",
     "debug_http",
@@ -53,10 +58,13 @@ __all__ = [
     "enable",
     "enabled",
     "end_cycle",
+    "flow",
     "get",
     "note_transition",
     "note_wire",
+    "slo_observe",
     "span",
+    "wire_traceparent",
 ]
 
 log = logging.getLogger(__name__)
@@ -85,22 +93,60 @@ class Tracer:
         flight_cycles: int = 256,
         dump_dir: str | None = None,
         trace_dir: str | None = None,
+        tag: str | None = None,
     ) -> None:
         self.spans = SpanRecorder(keep_cycles=span_cycles)
         self.decisions = DecisionLog()
         self.recorder = FlightRecorder(
             keep_cycles=flight_cycles, dump_dir=dump_dir,
-            decisions=self.decisions,
+            decisions=self.decisions, tag=tag,
         )
         self.trace_dir = trace_dir
+        self.tag = tag
         self.cycle = 0
         self._cycle_open = False
+        #: The SLO burn-rate engine (trace/slo.py), armed via
+        #: arm_slo(); None = no objectives declared.
+        self.slo: SloEngine | None = None
+        # Per-cycle flow context: minted at begin_cycle, bound to the
+        # cycle thread so every span and wire write of the cycle rides
+        # one trace id (doc/design/observability.md · wire format).
+        self._flow_ctx = None
+        self._flow_token = None
+
+    def arm_slo(self, engine: SloEngine) -> SloEngine:
+        """Attach the SLO engine; fresh fast-burn breaches become
+        ``slo-burn`` flight-recorder triggers (auto-dump,
+        rate-limited)."""
+        engine.on_breach = self._on_slo_breach
+        self.slo = engine
+        return engine
+
+    def _on_slo_breach(self, objective, burn_short: float,
+                       burn_long: float) -> None:
+        try:
+            self.recorder.note_transition("slo-burn", {
+                "slo": objective.name,
+                "series": objective.series,
+                "burn_short": round(burn_short, 2),
+                "burn_long": round(burn_long, 2),
+                "threshold": objective.fast[2],
+            }, cycle=self.cycle)
+        except Exception:  # noqa: BLE001 — observability must never
+            log.exception("slo-burn transition note failed")
 
     # -- cycle bracketing (scheduler.run_once) ---------------------------
     def begin_cycle(self) -> int:
         self.cycle += 1
         self._cycle_open = True
         self.spans.begin_cycle(self.cycle)
+        # The cycle IS a flow: bind a fresh root context so this
+        # cycle's spans — and every wire write it enqueues, including
+        # commit flushes landing later on worker threads — carry one
+        # trace id.  begin/end run on the same (cycle) thread, so the
+        # bind/restore pair below is balanced.
+        self._flow_ctx = context.mint()
+        self._flow_token = context.bind(self._flow_ctx)
         return self.cycle
 
     def end_cycle(self, summary: dict) -> None:
@@ -108,6 +154,18 @@ class Tracer:
         self.recorder.note_cycle(summary)
         self.spans.end_cycle()
         self._cycle_open = False
+        context.restore(self._flow_token)
+        self._flow_ctx = self._flow_token = None
+        if self.slo is not None:
+            # Feed the cycle-latency series (quiesced skips return in
+            # microseconds and are not evidence), then evaluate every
+            # objective's multi-window burn — bounded work, once per
+            # cycle.
+            if not summary.get("quiesced"):
+                self.slo.observe(
+                    "cycle", float(summary.get("dur_ms", 0.0)) / 1e3
+                )
+            self.slo.evaluate()
         if self.trace_dir:
             self.spans.maybe_rotate(self.trace_dir, self.cycle)
 
@@ -117,6 +175,7 @@ class Tracer:
             "spans": self.spans.stats(),
             "decisions": self.decisions.stats(),
             "recorder": self.recorder.stats(),
+            "slo": self.slo.state() if self.slo is not None else None,
         }
 
 
@@ -153,13 +212,17 @@ def enable(
     dump_dir: str | None = None,
     trace_dir: str | None = None,
     scope: str | None = None,
+    tag: str | None = None,
 ) -> Tracer:
     """Turn the subsystem on (idempotent per process: a second enable
     replaces the tracer — chaos restarts and tests rely on a clean
     slate).  ``flight_cycles`` <= 0 disables instead.  With `scope`
     the tracer registers PER-SCHEDULER under that name (the cell)
     instead of replacing the process-global one — threads bound to
-    the scope record into it exclusively."""
+    the scope record into it exclusively.  ``tag`` (default: the
+    scope) rides flight-recorder dump FILENAMES so two cells sharing
+    one --flight-recorder-dir never interleave ambiguous
+    post-mortems."""
     global _TRACER
     if flight_cycles is not None and int(flight_cycles) <= 0:
         disable(scope=scope)
@@ -168,6 +231,7 @@ def enable(
         tracer = Tracer(
             span_cycles=span_cycles, flight_cycles=flight_cycles,
             dump_dir=dump_dir, trace_dir=trace_dir,
+            tag=tag if tag is not None else scope,
         )
         if scope:
             _TRACERS[scope] = tracer
@@ -200,19 +264,137 @@ def get(scope: str | None = None) -> Tracer | None:
     return _current()
 
 
+def all_tracers() -> dict[str, Tracer]:
+    """Every live tracer, keyed by scope ("" = the process-global
+    one) — the fleet pane's and the merged pod-story's iteration
+    surface."""
+    with _LOCK:
+        out = dict(_TRACERS)
+        if _TRACER is not None:
+            out[""] = _TRACER
+        return out
+
+
 # -- hot-path helpers (flag check first, always) -------------------------
 
 def span(name: str, cycle: int | None = None, **args):
     """A timed region context manager; a shared no-op when disabled.
     ``cycle`` attributes a cross-thread span (commit flush, ingest
     apply) to the cycle that caused it; the default is the current
-    cycle."""
+    cycle.  A span recorded inside an active FLOW (a cycle, a
+    propagated reclaim/failover context) carries the flow's trace id
+    + a fresh span id, so exports stitch into one causal tree across
+    threads and processes."""
     t = _current()
     if t is None:
         return _NOOP
+    ctx = context.current()
+    if ctx is not None:
+        args = dict(args)
+        args["trace_id"] = ctx.trace_id
+        args["span_id"] = context._new_span_id()
+        args["parent_span_id"] = ctx.span_id
     return t.spans.span(
         name, t.cycle if cycle is None else cycle, args or None
     )
+
+
+class _FlowCtx:
+    """Context manager returned by flow(): binds the flow's trace
+    context to the thread for the block (so nested spans and wire
+    writes inherit it) and records one span for the flow itself.
+    ``.ctx`` is the bound context — its traceparent is what a caller
+    propagates by hand when the wire stamping cannot (e.g. a payload
+    built outside the block)."""
+
+    __slots__ = ("ctx", "_span", "_token")
+
+    def __init__(self, ctx, span_cm) -> None:
+        self.ctx = ctx
+        self._span = span_cm
+        self._token = None
+
+    def __enter__(self) -> "_FlowCtx":
+        self._token = context.bind(self.ctx)
+        self._span.__enter__()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self._span.__exit__(*exc)
+        context.restore(self._token)
+        return False
+
+
+class _NoopFlow:
+    __slots__ = ()
+    ctx = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NOOP_FLOW = _NoopFlow()
+
+
+def flow(name: str, ctx=None, cycle: int | None = None, **args):
+    """Open (or adopt) a FLOW: a causal tree that may cross threads
+    and schedulers.  ``ctx`` None mints a fresh root (this scheduler
+    is the flow's origin); a TraceContext — typically parsed from a
+    wire-propagated traceparent — opens a CHILD under the remote
+    parent, which is what stitches a reclaim's donor-side drain to
+    the claimant's request in one Perfetto tree.  A no-op (no
+    binding, no propagation) when tracing is disabled — stitching
+    on/off is exactly tracing on/off."""
+    t = _current()
+    if t is None:
+        return _NOOP_FLOW
+    parent = ctx
+    child = parent.child() if parent is not None else context.mint()
+    args = dict(args)
+    args["trace_id"] = child.trace_id
+    args["span_id"] = child.span_id
+    if parent is not None:
+        args["parent_span_id"] = parent.span_id
+    span_cm = t.spans.span(
+        name, t.cycle if cycle is None else cycle, args
+    )
+    return _FlowCtx(child, span_cm)
+
+
+def adopted_span(name: str, traceparent, **args):
+    """Record one span as the CHILD of a wire-propagated traceparent
+    (a takeover successor adopting the dead leader's last mirror, a
+    donor acknowledging a claim).  Returns a context manager; a
+    shared no-op when tracing is disabled or the header is
+    unparsable."""
+    ctx = context.parse(traceparent)
+    if ctx is None:
+        return span(name, **args)
+    return flow(name, ctx=ctx, **args)
+
+
+def wire_traceparent() -> str | None:
+    """The traceparent an outgoing wire request should carry — a
+    child of the calling thread's active flow — or None when tracing
+    is disabled or no flow is bound.  Backends stamp this OUTSIDE
+    their hashed/logged payload fields, so stitching is
+    decision-invisible by construction."""
+    if _current() is None:
+        return None
+    return context.current_traceparent()
+
+
+def slo_observe(series: str, value: float) -> None:
+    """One observation on an SLO series (trace/slo.py); a dict miss
+    when no engine is armed — the feed sites live in the hot path
+    permanently, like every other facade call here."""
+    t = _current()
+    if t is None or t.slo is None:
+        return
+    t.slo.observe(series, value)
 
 
 def begin_cycle() -> "Tracer | None":
@@ -274,10 +456,25 @@ def note_transition(kind: str, **detail) -> None:
 
 # -- the /debug HTTP surface (served by metrics.serve) -------------------
 
+_DEBUG_ENDPOINTS = [
+    "/debug/pods/<uid>", "/debug/groups/<name>",
+    "/debug/cycles", "/debug/dump", "/debug/trace",
+    "/debug/slo", "/debug/fleet", "/debug/stats",
+]
+
+
 def debug_http(path: str) -> tuple[int, dict]:
     """Route one GET /debug/... request.  Returns (status, JSON body).
     404 bodies explain what exists, so an operator probing blind gets
     a map instead of silence."""
+    if path == "/debug/fleet":
+        # The fleet pane works even without a tracer bound to THIS
+        # thread: it merges every in-process scope's health/SLO state
+        # plus the configured --fleet-peers (doc/design/
+        # observability.md · fleet pane).
+        from kube_batch_tpu.trace import fleet
+
+        return 200, fleet.fleet_body()
     t = _current()
     if t is None:
         return 503, {
@@ -287,12 +484,49 @@ def debug_http(path: str) -> tuple[int, dict]:
     if path.startswith("/debug/pods/"):
         uid = path[len("/debug/pods/"):]
         story = t.decisions.pod_story(uid)
+        # A pod reclaimed ACROSS cells leaves its eviction in the
+        # donor's tracer and its placement in the recipient's: merge
+        # every scope's records (decision records carry a process-
+        # monotone seq, so the merged order is the true one) into one
+        # coherent story.
+        others = {}
+        for scope_name, tracer in sorted(all_tracers().items()):
+            if tracer is t:
+                continue
+            other = tracer.decisions.pod_story(uid)
+            if other is not None:
+                others[scope_name] = other
+        if story is None and others:
+            # The thread's own tracer never touched this pod but a
+            # sibling scope did — serve the merged fleet story.
+            first = next(iter(others.values()))
+            story = {"uid": uid,
+                     **{k: first.get(k)
+                        for k in ("name", "namespace", "group")},
+                     "records": []}
         if story is None:
             return 404, {
                 "error": f"no decision records for pod uid {uid!r} "
                          "(untouched yet, or rotated out of the "
                          "bounded ring)",
             }
+        if others:
+            own_scope = next(
+                (s for s, tr in all_tracers().items() if tr is t), "",
+            )
+            merged = [
+                {**rec, "cell": own_scope}
+                for rec in story.get("records", ())
+            ]
+            story["cells"] = {}
+            for scope_name, other in others.items():
+                story["cells"][scope_name] = other
+                merged.extend(
+                    {**rec, "cell": scope_name}
+                    for rec in other.get("records", ())
+                )
+            merged.sort(key=lambda r: r.get("seq", 0))
+            story["fleet_records"] = merged
         story["cycle_now"] = t.cycle
         # The latest cycle summary gives the pod's answer its CONTEXT:
         # a pending pod during an HBM pause or a breaker quiesce is
@@ -318,20 +552,21 @@ def debug_http(path: str) -> tuple[int, dict]:
         return 200, t.recorder.dump_body(trigger="debug-endpoint")
     if path == "/debug/trace":
         return 200, {"traceEvents": t.spans.chrome_events()}
+    if path == "/debug/slo":
+        if t.slo is None:
+            return 404, {
+                "error": "no SLO objectives armed (declare them with "
+                         "--slo, e.g. --slo placement:99%<30s or "
+                         "--slo default)",
+                "slo": None,
+            }
+        return 200, {"cycle_now": t.cycle, "slo": t.slo.state()}
     if path == "/debug/stats" or path == "/debug" or path == "/debug/":
         return 200, {
-            "endpoints": [
-                "/debug/pods/<uid>", "/debug/groups/<name>",
-                "/debug/cycles", "/debug/dump", "/debug/trace",
-                "/debug/stats",
-            ],
+            "endpoints": list(_DEBUG_ENDPOINTS),
             **t.stats(),
         }
     return 404, {
         "error": f"unknown debug path {path!r}",
-        "endpoints": [
-            "/debug/pods/<uid>", "/debug/groups/<name>",
-            "/debug/cycles", "/debug/dump", "/debug/trace",
-            "/debug/stats",
-        ],
+        "endpoints": list(_DEBUG_ENDPOINTS),
     }

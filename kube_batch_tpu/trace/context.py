@@ -1,0 +1,122 @@
+"""Cross-scheduler trace context: W3C-traceparent-shaped flow identity.
+
+PR 10 gave one scheduler a span tree; the flows that matter at fleet
+scale cross PROCESS boundaries — a cross-cell reclaim is claim (cell
+B) → drain + offer (cell A) → re-cell (cluster), a failover is the
+dead leader's last mirror stitched to its successor's adoption.  This
+module is the identity those flows travel under:
+
+* a ``TraceContext`` is (trace_id, span_id) formatted exactly like a
+  W3C ``traceparent`` header (``00-<32 hex>-<16 hex>-01``) so any
+  standard tooling parses it;
+* the ORIGIN scheduler mints a root context (`mint`), every hop mints
+  a `child` (same trace id, fresh span id), and the wire stamps the
+  current context onto outgoing requests (native stream field, k8s
+  annotation, HTTP header — see doc/design/observability.md · wire
+  format);
+* a thread-local BINDING (`bind`/`restore`/`current`) carries the
+  active flow down the call stack, so `trace.span()` enriches every
+  span recorded inside a flow with (trace_id, span_id, parent) and
+  the backends pick the context up without threading it through every
+  signature.
+
+Deliberately a leaf module (stdlib only) and deliberately DECISION-
+INVISIBLE: contexts ride OUTSIDE every hashed wire-log payload, so
+same-seed chaos hashes are pinned identical with stitching on or off.
+IDs are process-salted counters, not seeded randomness — they are
+identity, never input.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import re
+import threading
+
+#: traceparent: version "00", 16-byte trace id, 8-byte parent span id,
+#: flags "01" (sampled) — the W3C shape, so Perfetto/OTel tooling can
+#: consume exported spans' ids unmodified.
+_TRACEPARENT_RE = re.compile(
+    r"^00-([0-9a-f]{32})-([0-9a-f]{16})-[0-9a-f]{2}$"
+)
+
+#: Process salt + monotone counters: unique across processes with
+#: overwhelming probability, unique within one by construction, and
+#: cheap to mint on the hot path (no urandom syscall per span).
+_SALT = int.from_bytes(os.urandom(8), "big")
+_TRACE_SEQ = itertools.count(1)
+_SPAN_SEQ = itertools.count(1)
+
+_local = threading.local()
+
+
+class TraceContext:
+    """One hop of one flow: the flow's trace id plus THIS hop's span
+    id.  Immutable by convention; `child()` mints the next hop."""
+
+    __slots__ = ("trace_id", "span_id")
+
+    def __init__(self, trace_id: str, span_id: str) -> None:
+        self.trace_id = trace_id
+        self.span_id = span_id
+
+    def traceparent(self) -> str:
+        return f"00-{self.trace_id}-{self.span_id}-01"
+
+    def child(self) -> "TraceContext":
+        return TraceContext(self.trace_id, _new_span_id())
+
+    def __repr__(self) -> str:  # debugging/logs only
+        return f"TraceContext({self.traceparent()})"
+
+
+def _new_span_id() -> str:
+    return f"{(_SALT ^ (next(_SPAN_SEQ) * 0x9E3779B97F4A7C15)) & ((1 << 64) - 1):016x}"
+
+
+def mint() -> TraceContext:
+    """A fresh ROOT context: new trace id, new span id — the origin
+    scheduler calls this once per flow (per cycle, per reclaim
+    claim)."""
+    tid = (_SALT << 64) | ((next(_TRACE_SEQ) * 0x9E3779B97F4A7C15)
+                           & ((1 << 64) - 1))
+    return TraceContext(f"{tid & ((1 << 128) - 1):032x}", _new_span_id())
+
+
+def parse(header) -> TraceContext | None:
+    """A TraceContext from a wire-propagated traceparent string, or
+    None for anything malformed — a garbled header degrades to an
+    unstitched span, never an error."""
+    if not isinstance(header, str):
+        return None
+    m = _TRACEPARENT_RE.match(header.strip().lower())
+    if m is None:
+        return None
+    return TraceContext(m.group(1), m.group(2))
+
+
+# -- thread-local flow binding ----------------------------------------------
+
+def bind(ctx: TraceContext | None):
+    """Bind `ctx` as the calling thread's active flow; returns a token
+    for `restore` (nesting-safe — flows may open inside flows)."""
+    prev = getattr(_local, "ctx", None)
+    _local.ctx = ctx
+    return prev
+
+
+def restore(token) -> None:
+    _local.ctx = token
+
+
+def current() -> TraceContext | None:
+    return getattr(_local, "ctx", None)
+
+
+def current_traceparent() -> str | None:
+    """The traceparent an outgoing wire request should carry: a CHILD
+    of the active flow (each hop gets its own span id), or None when
+    no flow is bound."""
+    ctx = getattr(_local, "ctx", None)
+    return ctx.child().traceparent() if ctx is not None else None

@@ -32,7 +32,15 @@ recorded FROM the decision path but never read by it
 from __future__ import annotations
 
 import collections
+import itertools
 import threading
+
+#: Process-monotone record sequence, SHARED across every DecisionLog
+#: in the process: two in-process schedulers' cycle counters are
+#: incomparable, but a pod reclaimed across cells (donor evicts,
+#: recipient places) still needs ONE true order for its merged
+#: /debug/pods story — the seq is that order.
+_SEQ = itertools.count(1)
 
 MAX_PODS = 4096
 PER_POD = 32
@@ -101,7 +109,8 @@ class DecisionLog:
         with self._lock:
             entry = self._pod_entry(uid, name, namespace, group)
             entry["records"].append(
-                {"cycle": cycle, "kind": kind, **detail}
+                {"cycle": cycle, "kind": kind, "seq": next(_SEQ),
+                 **detail}
             )
             self.records_total += 1
 
@@ -109,7 +118,8 @@ class DecisionLog:
                    **detail) -> None:
         with self._lock:
             g = self._group_entry(name)
-            g["records"].append({"cycle": cycle, "kind": kind, **detail})
+            g["records"].append({"cycle": cycle, "kind": kind,
+                                 "seq": next(_SEQ), **detail})
             self.records_total += 1
 
     def note_placed(self, uid: str, name: str, group: str | None,
@@ -120,7 +130,7 @@ class DecisionLog:
         whose capacity it inherited."""
         with self._lock:
             rec = {"cycle": cycle, "kind": "placed", "node": node,
-                   **detail}
+                   "seq": next(_SEQ), **detail}
             vac = self._vacated.get(node)
             if vac is not None:
                 vcycle, victims = vac
@@ -134,7 +144,7 @@ class DecisionLog:
                             ventry["records"].append({
                                 "cycle": cycle, "kind": "beneficiary",
                                 "pod": name, "group": group,
-                                "node": node,
+                                "node": node, "seq": next(_SEQ),
                             })
                 else:
                     self._vacated.pop(node, None)
@@ -151,7 +161,7 @@ class DecisionLog:
             entry = self._pod_entry(uid, name, None, group)
             entry["records"].append({
                 "cycle": cycle, "kind": "preempted", "reason": reason,
-                "node": node,
+                "node": node, "seq": next(_SEQ),
             })
             self.records_total += 1
             if node:

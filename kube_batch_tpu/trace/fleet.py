@@ -1,0 +1,217 @@
+"""The fleet pane: one JSON for "is the fleet healthy", N cells deep.
+
+PR 12 made the fleet multi-actor; answering "which cell is sick"
+still required a human to curl N /healthz bodies and eyeball raw
+numbers.  ``GET /debug/fleet`` merges every scope this process hosts
+(the per-scheduler scope registry — two in-process cells in the chaos
+drive / bench aggregate) with a configured list of PEER processes
+(``--fleet-peers``: each peer's /healthz + /debug/slo fetched
+best-effort with per-peer staleness stamps) into one body:
+
+* per cell: leader/epoch, ladder rung (health state), quarantined
+  count, peer visibility, backlog (ingest lag + commit depth), and
+  the cell's SLO engine state with the currently-burning objectives
+  pulled to the front;
+* fleet rollups: cell count, the worst health state, every burning
+  (cell, objective) pair — so "cell B is burning its placement SLO
+  14× while cell A is fine" is one curl.
+
+Peer fetches are synchronous but bounded (PEER_TIMEOUT_S each,
+refreshed at most every PEER_REFRESH_S): a dead peer costs one short
+timeout and is served from its last-good snapshot with ``stale: true``
+and its age — the pane degrades, it never blocks or throws.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+import urllib.request
+
+log = logging.getLogger(__name__)
+
+PEER_TIMEOUT_S = 1.0
+#: Minimum seconds between refreshes of one peer: a dashboard polling
+#: /debug/fleet at 1 Hz must not turn into a healthz storm.
+PEER_REFRESH_S = 2.0
+#: A peer snapshot older than this reads as STALE even when the last
+#: fetch succeeded (the peer may have stopped answering since).
+PEER_STALE_S = 15.0
+
+_lock = threading.Lock()
+_peers: list[str] = []
+#: url -> {"healthz", "slo", "fetched_at", "attempted_at", "error"}
+#: — fetched_at is the last SUCCESSFUL fetch (the data's age);
+#: attempted_at is the last try of any outcome (the refresh
+#: throttle's clock: a dead peer must not be re-probed on every
+#: request).
+_cache: dict[str, dict] = {}
+
+
+def configure(peers) -> None:
+    """Install the --fleet-peers list (base URLs, e.g.
+    ``http://cell-b:8080``); clears stale cache entries for peers no
+    longer listed."""
+    global _peers
+    cleaned = [p.strip().rstrip("/") for p in (peers or []) if p.strip()]
+    with _lock:
+        _peers = cleaned
+        for url in list(_cache):
+            if url not in cleaned:
+                del _cache[url]
+
+
+def peers() -> list[str]:
+    with _lock:
+        return list(_peers)
+
+
+def _fetch_json(url: str) -> dict | None:
+    with urllib.request.urlopen(url, timeout=PEER_TIMEOUT_S) as resp:
+        body = json.loads(resp.read().decode("utf-8", "replace"))
+    return body if isinstance(body, dict) else None
+
+
+def _refresh_peer(url: str) -> dict:
+    """One peer's entry, refreshed when due; failures keep the
+    last-good payloads and stamp the error.  The throttle keys on the
+    last ATTEMPT, success or not — a dead peer costs one bounded
+    timeout per PEER_REFRESH_S across however many requests poll the
+    pane, never one per request."""
+    now = time.monotonic()
+    with _lock:
+        entry = _cache.get(url)
+        if entry is not None and \
+                now - entry["attempted_at"] < PEER_REFRESH_S:
+            return entry
+        if entry is not None:
+            # Claim this refresh slot BEFORE the (unlocked) fetch so
+            # concurrent pane requests don't all probe a slow peer.
+            entry["attempted_at"] = now
+    healthz = slo = None
+    error = None
+    try:
+        healthz = _fetch_json(url + "/healthz")
+        try:
+            slo_body = _fetch_json(url + "/debug/slo")
+            slo = (slo_body or {}).get("slo")
+        except Exception:  # noqa: BLE001 — a peer without an SLO
+            slo = None     # engine (older build) is not an error
+    except Exception as exc:  # noqa: BLE001 — dead peer: degrade
+        error = f"{type(exc).__name__}: {exc}"
+    with _lock:
+        entry = _cache.get(url)
+        if error is None:
+            entry = {"healthz": healthz, "slo": slo,
+                     "fetched_at": now, "attempted_at": now,
+                     "error": None}
+        elif entry is None:
+            # Never fetched successfully: no data to age.
+            entry = {"healthz": None, "slo": None,
+                     "fetched_at": None, "attempted_at": now,
+                     "error": error}
+        else:
+            entry = {**entry, "attempted_at": now, "error": error}
+        _cache[url] = entry
+        return entry
+
+
+def _cell_block(health: dict, slo_state: dict | None) -> dict:
+    """One cell's pane row from its healthz-shaped fields + SLO
+    state."""
+    block = dict(health)
+    if slo_state is not None:
+        burning = sorted(
+            name for name, st in
+            (slo_state.get("objectives") or {}).items()
+            if st.get("fast_burn")
+        )
+        block["slo"] = {"burning": burning, **slo_state}
+    else:
+        block["slo"] = None
+    return block
+
+
+def fleet_body() -> dict:
+    """The GET /debug/fleet response body."""
+    from kube_batch_tpu import metrics, trace
+
+    snapshot = metrics.health_snapshot()
+    tracers = trace.all_tracers()
+    cells: dict[str, dict] = {}
+    for name, health in snapshot.items():
+        tracer = tracers.get(name)
+        slo_state = None
+        if tracer is not None and tracer.slo is not None:
+            slo_state = tracer.slo.state()
+        cells[name or ""] = {
+            **_cell_block(health, slo_state),
+            "source": "in-process",
+        }
+    # A scoped tracer with no health entry yet (nothing published)
+    # still surfaces — its SLO burn may be the only signal.
+    for name, tracer in tracers.items():
+        if name not in cells and tracer.slo is not None:
+            cells[name] = {
+                **_cell_block({}, tracer.slo.state()),
+                "source": "in-process",
+            }
+    now = time.monotonic()
+    peer_rows: dict[str, dict] = {}
+    for url in peers():
+        entry = _refresh_peer(url)
+        fetched = entry["fetched_at"]
+        age = None if fetched is None else max(now - fetched, 0.0)
+        peer_rows[url] = {
+            "healthz": entry["healthz"],
+            "slo": entry["slo"],
+            # Age of the DATA (last successful fetch); null = never
+            # reached at all.
+            "age_s": None if age is None else round(age, 3),
+            "stale": bool(entry["error"]) or age is None
+            or age > PEER_STALE_S,
+            "error": entry["error"],
+        }
+    # -- rollups ---------------------------------------------------------
+    states = []
+    burning: list[dict] = []
+    for name, block in sorted(cells.items()):
+        states.append(str(block.get("state", "ok")))
+        slo = block.get("slo") or {}
+        for obj in slo.get("burning") or []:
+            burn = ((slo.get("objectives") or {}).get(obj) or {}) \
+                .get("burn") or {}
+            burning.append({
+                "cell": name, "slo": obj,
+                "burn": max([v for v in burn.values()] or [0.0]),
+            })
+    for url, row in sorted(peer_rows.items()):
+        hz = row["healthz"] or {}
+        if hz:
+            states.append(str(hz.get("state", "ok")))
+        for obj, st in (((row["slo"] or {}).get("objectives")) or {}) \
+                .items():
+            if st.get("fast_burn"):
+                burning.append({
+                    "cell": url, "slo": obj,
+                    "burn": max([v for v in (st.get("burn") or {})
+                                 .values()] or [0.0]),
+                })
+    order = {"ok": 0, "degraded": 1, "overloaded": 2}
+    worst = max(states, key=lambda s: order.get(s, 0), default="ok")
+    return {
+        "cells": cells,
+        "peers": peer_rows,
+        "fleet": {
+            "cells": len(cells),
+            "peers": len(peer_rows),
+            "peers_stale": sum(1 for r in peer_rows.values()
+                               if r["stale"]),
+            "worst_state": worst,
+            "burning": sorted(
+                burning, key=lambda b: -float(b["burn"])
+            ),
+        },
+    }

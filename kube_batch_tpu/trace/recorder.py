@@ -41,13 +41,18 @@ import time
 
 log = logging.getLogger(__name__)
 
-#: The transition kinds that auto-dump a post-mortem.
+#: The transition kinds that auto-dump a post-mortem.  ``slo-burn``
+#: is the user-facing one: a fast-burn SLO breach (trace/slo.py) is
+#: an anomaly exactly like a breaker trip — the operator wants the
+#: last N cycles on disk the moment the placement SLO starts burning,
+#: not after the page.
 TRIGGERS = frozenset({
     "breaker-open",
     "watchdog-escalation",
     "stale-epoch",
     "quarantine-cordon",
     "statestore-corrupt",
+    "slo-burn",
 })
 #: Per-kind dump rate limit (cycles): a storm of StaleEpoch rejections
 #: during one failover window produces ONE post-mortem, not hundreds.
@@ -64,9 +69,14 @@ TRANSITION_RING = 256
 class FlightRecorder:
     def __init__(self, keep_cycles: int = 256,
                  dump_dir: str | None = None,
-                 decisions=None) -> None:
+                 decisions=None, tag: str | None = None) -> None:
         self.keep_cycles = max(int(keep_cycles), 1)
         self.dump_dir = dump_dir or tempfile.gettempdir()
+        #: Scope/cell tag riding dump FILENAMES: a 2-cell daemon pair
+        #: writing into one --flight-recorder-dir must not interleave
+        #: ambiguous post-mortems ("whose breaker opened?").  Empty =
+        #: the classic single-scheduler names, unchanged.
+        self.tag = str(tag) if tag else ""
         self._decisions = decisions   # DecisionLog for dump enrichment
         self._lock = threading.Lock()
         self.cycles: collections.deque = collections.deque(
@@ -136,6 +146,7 @@ class FlightRecorder:
                     "trigger": trigger,
                     "transition": transition,
                     "cycle": cycle,
+                    "scope": self.tag,
                     "wall_time": time.time(),
                 },
                 "ticks": list(self.cycles),
@@ -145,14 +156,17 @@ class FlightRecorder:
         if self._decisions is not None:
             body["decisions"] = self._decisions.export()
         if path is None:
+            # The scope/cell tag disambiguates dump files when several
+            # schedulers share one --flight-recorder-dir.
+            stem = f"kb-flight-{self.tag}" if self.tag else "kb-flight"
             if trigger in TRIGGERS:
-                name = f"kb-flight-{trigger}-c{cycle:08d}.json"
+                name = f"{stem}-{trigger}-c{cycle:08d}.json"
             else:
                 # On-demand (sigusr2 / debug-endpoint / manual): one
                 # fixed file per kind, overwritten — "give me the
                 # current state", not an archive; a polling probe
                 # cannot accumulate files.
-                name = f"kb-flight-{trigger}.json"
+                name = f"{stem}-{trigger}.json"
             path = os.path.join(self.dump_dir, name)
         try:
             os.makedirs(os.path.dirname(path) or ".", exist_ok=True)

@@ -1,0 +1,363 @@
+"""SLO burn-rate engine: user-facing objectives evaluated in-process.
+
+"Is the fleet healthy for users" must be a number the system computes
+itself, not a human eyeballing raw metrics across N /healthz bodies —
+the Tesserae posture (PAPERS.md): judge the scheduler by job-level
+outcomes (time-to-placement, gang wait), not per-decision mechanics.
+This module is that judge, always on and dependency-free:
+
+* **Series** — bounded ring timeseries over the signals the system
+  already emits: ``placement`` (pod time-to-placement, observed at the
+  bind ack), ``gang`` (PodGroup time-to-full-placement, observed when
+  the group first reaches Running), ``cycle`` (scheduler cycle
+  latency), ``commit_flush`` (commit-pipeline enqueue→ack latency),
+  ``ingest_lag`` (age of the newest applied watch batch).
+
+* **Objectives** — declarative (CLI ``--slo``, e.g.
+  ``placement:99%<30s``): a target fraction and a threshold; an
+  observation is GOOD when its value ≤ threshold.
+
+* **Multi-window multi-burn-rate alerts** (the SRE-workbook shape):
+  burn = bad_fraction / error_budget, evaluated over paired windows —
+  FAST (default 5 m AND 1 h, both ≥ 14.4×) pages, SLOW (1 h AND 6 h,
+  both ≥ 6×) warns.  A fast-burn breach is a first-class flight-
+  recorder TRIGGER (``slo-burn`` — auto-dump, rate-limited, alongside
+  breaker-open/watchdog) and increments ``slo_breaches_total``;
+  ``slo_burn_rate{slo,window}`` gauges every evaluated window.
+
+Bounded memory: one fixed bucket ring per objective (counts only, no
+samples kept), all appends O(1) under one short lock.  Decision-
+invisible like all of ``trace/``: the engine is recorded INTO, never
+read by a scheduling decision — same-seed chaos hashes are pinned
+identical with the engine armed or not, and
+``scripts/check_slo_overhead.py`` gates the always-on cost under the
+same <3% steady-cycle budget as the rest of the subsystem.  The clock
+is pluggable (the chaos cells engine drives a tick clock) so burn
+windows are deterministic under simulation.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+import time
+
+#: Default multi-window pairs (seconds, seconds, burn threshold) — the
+#: SRE-workbook constants: fast burn spends 2% of a 30-day budget in
+#: an hour (14.4×), slow burn 10% in six hours (6×).
+FAST_WINDOWS = (300.0, 3600.0, 14.4)
+SLOW_WINDOWS = (3600.0, 21600.0, 6.0)
+
+#: Series names the feed sites emit — an objective naming anything
+#: else is a spec error surfaced at parse time, not a silent no-op.
+KNOWN_SERIES = ("placement", "gang", "cycle", "commit_flush",
+                "ingest_lag")
+
+#: --slo default: the objective set a daemon gets from ``--slo
+#: default`` (doc/design/observability.md · SLO objective schema).
+DEFAULT_SPECS = (
+    "placement:99%<30s",
+    "gang:95%<120s",
+    "cycle:99%<1s",
+    "commit_flush:99%<5s",
+    "ingest_lag:99%<5s",
+)
+
+_SPEC_RE = re.compile(
+    r"^(?P<series>[a-z_]+)"
+    r"(?:=(?P<name>[A-Za-z0-9_-]+))?"
+    r":(?P<target>[0-9.]+)%"
+    r"<(?P<threshold>[0-9.]+)(?P<unit>ms|s|m)?$"
+)
+
+
+#: Minimum observations the ALERTING window must hold before a burn
+#: can fire: a daemon's very first cycle is a multi-second compile —
+#: one legitimate bad observation over an empty history reads as
+#: burn = 1/budget (≥100×) and would page every cold start.  The burn
+#: GAUGES still publish below the floor; only the alert (and its
+#: flight-recorder dump) waits for evidence.
+DEFAULT_MIN_EVENTS = 10
+
+
+class SloObjective:
+    """One declarative objective: ``target`` fraction of ``series``
+    observations must be ≤ ``threshold`` (seconds)."""
+
+    __slots__ = ("name", "series", "target", "threshold",
+                 "fast", "slow", "min_events")
+
+    def __init__(self, name: str, series: str, target: float,
+                 threshold: float,
+                 fast: tuple = FAST_WINDOWS,
+                 slow: tuple = SLOW_WINDOWS,
+                 min_events: int = DEFAULT_MIN_EVENTS) -> None:
+        if series not in KNOWN_SERIES:
+            raise ValueError(
+                f"unknown SLO series {series!r} (known: "
+                f"{', '.join(KNOWN_SERIES)})"
+            )
+        if not 0.0 < target < 1.0:
+            raise ValueError(
+                f"SLO target must be a fraction in (0, 1), got {target}"
+            )
+        self.name = name
+        self.series = series
+        self.target = float(target)
+        self.threshold = float(threshold)
+        self.fast = tuple(fast)
+        self.slow = tuple(slow)
+        self.min_events = max(int(min_events), 1)
+
+    def spec(self) -> dict:
+        return {
+            "name": self.name, "series": self.series,
+            "target": self.target, "threshold_s": self.threshold,
+            "fast_windows_s": [self.fast[0], self.fast[1]],
+            "fast_burn_threshold": self.fast[2],
+            "slow_windows_s": [self.slow[0], self.slow[1]],
+            "slow_burn_threshold": self.slow[2],
+            "min_events": self.min_events,
+        }
+
+
+def parse_slo_spec(spec: str,
+                   fast: tuple = FAST_WINDOWS,
+                   slow: tuple = SLOW_WINDOWS,
+                   min_events: int = DEFAULT_MIN_EVENTS) -> SloObjective:
+    """One ``--slo`` value → an objective.  Format:
+    ``<series>[=<name>]:<target>%<<threshold>[ms|s|m]`` — e.g.
+    ``placement:99%<30s`` reads "99% of pods placed within 30 s"."""
+    m = _SPEC_RE.match(spec.strip())
+    if m is None:
+        raise ValueError(
+            f"unparsable SLO spec {spec!r} (format: "
+            "'<series>:<target>%<<threshold>[ms|s|m]', e.g. "
+            "'placement:99%<30s'; series: "
+            f"{', '.join(KNOWN_SERIES)})"
+        )
+    unit = {"ms": 1e-3, "s": 1.0, "m": 60.0, None: 1.0}[m.group("unit")]
+    return SloObjective(
+        name=m.group("name") or m.group("series"),
+        series=m.group("series"),
+        target=float(m.group("target")) / 100.0,
+        threshold=float(m.group("threshold")) * unit,
+        fast=fast, slow=slow, min_events=min_events,
+    )
+
+
+def parse_slo_specs(specs,
+                    fast: tuple = FAST_WINDOWS,
+                    slow: tuple = SLOW_WINDOWS,
+                    min_events: int = DEFAULT_MIN_EVENTS,
+                    ) -> list[SloObjective]:
+    """The CLI's repeatable ``--slo`` values → objectives; the literal
+    value ``default`` expands to DEFAULT_SPECS.  Duplicate names are a
+    spec error (two objectives publishing one gauge label would
+    shadow each other)."""
+    out: list[SloObjective] = []
+    for spec in specs:
+        if spec.strip() == "default":
+            out.extend(
+                parse_slo_spec(s, fast=fast, slow=slow,
+                               min_events=min_events)
+                for s in DEFAULT_SPECS
+            )
+        else:
+            out.append(parse_slo_spec(spec, fast=fast, slow=slow,
+                                      min_events=min_events))
+    names = [o.name for o in out]
+    dupes = sorted({n for n in names if names.count(n) > 1})
+    if dupes:
+        raise ValueError(f"duplicate SLO objective name(s): {dupes}")
+    return out
+
+
+class _Ring:
+    """Fixed ring of (good, bad) count buckets over wall (or tick)
+    time.  Bucket width is sized off the SHORTEST window so even the
+    fast window spans ≥ ``MIN_BUCKETS_PER_WINDOW`` buckets; total
+    buckets cover the longest window and are capped — memory is fixed
+    at construction, never grows with traffic."""
+
+    MIN_BUCKETS_PER_WINDOW = 12
+    MAX_BUCKETS = 4096
+
+    def __init__(self, shortest_s: float, longest_s: float) -> None:
+        self.width = max(shortest_s / self.MIN_BUCKETS_PER_WINDOW, 1e-9)
+        n = int(longest_s / self.width) + 2
+        if n > self.MAX_BUCKETS:
+            n = self.MAX_BUCKETS
+            self.width = longest_s / (n - 2)
+        self.n = n
+        self.good = [0] * n
+        self.bad = [0] * n
+        self._last_abs = -1  # absolute bucket index last touched
+
+    def _advance(self, now: float) -> int:
+        """Zero every bucket the clock skipped since the last touch;
+        returns the current ring index."""
+        abs_i = int(now / self.width)
+        if self._last_abs >= 0 and abs_i > self._last_abs:
+            for a in range(self._last_abs + 1,
+                           min(abs_i, self._last_abs + self.n) + 1):
+                self.good[a % self.n] = 0
+                self.bad[a % self.n] = 0
+        if self._last_abs < 0 or abs_i > self._last_abs:
+            self._last_abs = abs_i
+        return abs_i % self.n
+
+    def add(self, now: float, good: bool) -> None:
+        i = self._advance(now)
+        if good:
+            self.good[i] += 1
+        else:
+            self.bad[i] += 1
+
+    def counts(self, now: float, window_s: float) -> tuple[int, int]:
+        """(good, bad) over the trailing `window_s`."""
+        self._advance(now)
+        abs_now = int(now / self.width)
+        span = min(int(window_s / self.width) + 1, self.n)
+        g = b = 0
+        for a in range(abs_now - span + 1, abs_now + 1):
+            if a < 0:
+                continue
+            g += self.good[a % self.n]
+            b += self.bad[a % self.n]
+        return g, b
+
+
+class SloEngine:
+    """All objectives + their rings + the multi-window evaluation.
+    One per Tracer (so two in-process schedulers burn independently);
+    everything under one short lock."""
+
+    def __init__(self, objectives, clock=None) -> None:
+        self.objectives = list(objectives)
+        self.clock = clock or time.monotonic
+        self._lock = threading.Lock()
+        self._rings: dict[str, _Ring] = {}
+        self._by_series: dict[str, list[SloObjective]] = {}
+        for o in self.objectives:
+            shortest = min(o.fast[0], o.slow[0])
+            longest = max(o.fast[1], o.slow[1])
+            self._rings[o.name] = _Ring(shortest, longest)
+            self._by_series.setdefault(o.series, []).append(o)
+        #: name -> {"fast_burn", "slow_burn", "burn": {window: rate},
+        #:          "breaches", "observations", "bad"}
+        self._state: dict[str, dict] = {
+            o.name: {
+                "fast_burn": False, "slow_burn": False, "burn": {},
+                "breaches": 0, "observations": 0, "bad": 0,
+            }
+            for o in self.objectives
+        }
+        #: Fired on a fresh fast-burn breach: (objective, burn_short,
+        #: burn_long).  The owning Tracer wires this to the flight
+        #: recorder's ``slo-burn`` trigger.
+        self.on_breach = None
+
+    # -- write side ------------------------------------------------------
+    def observe(self, series: str, value: float) -> None:
+        """One observation on `series` (seconds, or ticks under a tick
+        clock).  O(objectives-on-series) bucket increments; a series
+        no objective watches is one dict miss."""
+        watchers = self._by_series.get(series)
+        if not watchers:
+            return
+        now = self.clock()
+        with self._lock:
+            for o in watchers:
+                st = self._state[o.name]
+                good = value <= o.threshold
+                st["observations"] += 1
+                if not good:
+                    st["bad"] += 1
+                self._rings[o.name].add(now, good)
+
+    # -- evaluation (once per cycle, from Tracer.end_cycle) --------------
+    @staticmethod
+    def _burn(ring: _Ring, now: float, window_s: float,
+              budget: float) -> tuple[float, int]:
+        """(burn rate, total events) over the trailing window."""
+        g, b = ring.counts(now, window_s)
+        total = g + b
+        if total == 0:
+            return 0.0, 0
+        return (b / total) / max(budget, 1e-9), total
+
+    def evaluate(self) -> dict:
+        """Recompute every objective's burn rates and alert states;
+        fires `on_breach` on each FRESH fast-burn breach.  Returns the
+        state dict (also served at /debug/slo and merged into
+        /debug/fleet)."""
+        now = self.clock()
+        breaches = []
+        with self._lock:
+            for o in self.objectives:
+                st = self._state[o.name]
+                ring = self._rings[o.name]
+                budget = 1.0 - o.target
+                fs, fs_n = self._burn(ring, now, o.fast[0], budget)
+                fl, _ = self._burn(ring, now, o.fast[1], budget)
+                ss, ss_n = self._burn(ring, now, o.slow[0], budget)
+                sl, _ = self._burn(ring, now, o.slow[1], budget)
+                st["burn"] = {
+                    f"{o.fast[0]:g}": round(fs, 3),
+                    f"{o.fast[1]:g}": round(fl, 3),
+                    f"{o.slow[0]:g}": round(ss, 3),
+                    f"{o.slow[1]:g}": round(sl, 3),
+                }
+                # The alert needs BOTH windows over threshold AND the
+                # short window holding min_events of evidence — a cold
+                # start's single slow compile cycle must not page
+                # (gauges publish regardless).
+                fast_now = (fs >= o.fast[2] and fl >= o.fast[2]
+                            and fs_n >= o.min_events)
+                slow_now = (ss >= o.slow[2] and sl >= o.slow[2]
+                            and ss_n >= o.min_events)
+                if fast_now and not st["fast_burn"]:
+                    st["breaches"] += 1
+                    breaches.append((o, fs, fl))
+                st["fast_burn"] = fast_now
+                st["slow_burn"] = slow_now
+        # Gauges + the breach callback OUTSIDE the lock: the callback
+        # dumps a post-mortem (file I/O) and must not hold up a
+        # concurrent observe() from a flush worker.
+        from kube_batch_tpu import metrics
+
+        with self._lock:
+            state = {k: dict(v) for k, v in self._state.items()}
+        for o in self.objectives:
+            for window, rate in state[o.name]["burn"].items():
+                metrics.slo_burn_rate.set(rate, o.name, window)
+        for o, fs, fl in breaches:
+            metrics.slo_breaches.inc(o.name)
+            cb = self.on_breach
+            if cb is not None:
+                try:
+                    cb(o, fs, fl)
+                except Exception:  # noqa: BLE001 — observability must
+                    pass           # never raise into the cycle
+        return state
+
+    def state(self) -> dict:
+        """{"objectives": {name: spec + live state}} — the /debug/slo
+        body and the fleet pane's per-cell SLO block."""
+        with self._lock:
+            return {
+                "objectives": {
+                    o.name: {**o.spec(), **self._state[o.name]}
+                    for o in self.objectives
+                },
+            }
+
+    def burning(self) -> list[str]:
+        """Names of objectives currently in FAST burn — the fleet
+        rollup's one-line answer."""
+        with self._lock:
+            return sorted(
+                name for name, st in self._state.items()
+                if st["fast_burn"]
+            )

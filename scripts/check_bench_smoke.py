@@ -18,11 +18,19 @@ def main() -> int:
 
     for key in ("first_cycle_ms", "e2e_cycle_ms_p50", "commit_pipeline",
                 "ingest_compare", "trace_overhead", "compile_artifacts",
-                "cells_aggregate"):
+                "cells_aggregate", "slo"):
         assert key in artifact, (
             f"artifact missing {key!r}; keys: {sorted(artifact)}"
         )
     assert isinstance(artifact["first_cycle_ms"], (int, float))
+
+    # Presence + sanity only: the <3% gate lives in
+    # scripts/check_slo_overhead.py (make verify); the smoke pins
+    # that every artifact RECORDS the stitching+SLO-engine tax.
+    slo = artifact["slo"]
+    assert "error" not in slo, slo
+    assert "overhead_pct" in slo, slo
+    assert slo.get("objectives", 0) >= 1, slo
 
     # Presence + sanity only: the multi-cell chaos invariants live in
     # scripts/check_chaos_cells.py (make chaos); the smoke pins that
@@ -81,7 +89,8 @@ def main() -> int:
         f"adopt {art.get('speedup')}x vs cold compile, 2-cell "
         f"aggregate {ca.get('aggregate_pods_per_s')} pods/s vs "
         f"single {ca.get('single_pods_per_s')} "
-        f"({ca.get('scaling')}x)"
+        f"({ca.get('scaling')}x), slo+stitching "
+        f"{slo.get('overhead_pct')}% overhead"
     )
     return 0
 

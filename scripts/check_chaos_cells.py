@@ -1,11 +1,12 @@
 #!/usr/bin/env python
 """Assert the multi-cell chaos acceptance criteria over two same-seed
-runs plus the --ingest-mode event parity run (make chaos):
+runs, the --ingest-mode event parity run, and the --trace off
+stitching-parity run (make chaos):
 
-* both runs completed with zero invariant violations and CONVERGED —
-  including cell B re-converging after its full-partition window with
-  zero double-binds across the boundary (the per-tick checker's
-  no-double-bind spans both cells' writers);
+* both trace-on runs completed with zero invariant violations and
+  CONVERGED — including cell B re-converging after its full-partition
+  window with zero double-binds across the boundary (the per-tick
+  checker's no-double-bind spans both cells' writers);
 * the cell-scope fence was actually EXERCISED: ≥1 cross-cell write
   attempted and rejected cluster-side (structured CellScope answer),
   ZERO accepted, and the client-side local fence fast-failed ≥1 probe
@@ -19,10 +20,20 @@ runs plus the --ingest-mode event parity run (make chaos):
 * cross-cell reclaim is atomic-or-rolled-back: ≥1 claim granted (the
   node re-celled to the claimant), ≥1 rolled back (no node moved),
   zero left pending;
-* same seed ⇒ same trace hash across the two runs AND the event-mode
-  run — two live schedulers through the threaded wire stack are fully
-  deterministic, and the batched ingest pipeline's cell filter is
-  decision-invisible.
+* FLEET OBSERVABILITY (this PR): ≥1 STITCHED trace — one trace id
+  whose span tree contains spans from BOTH schedulers (the reclaim's
+  claim span in the starved cell, the drain+offer in the donor),
+  verified against the merged Perfetto export on disk; the
+  partitioned cell's SLO engine read FAST BURN during its dark window
+  and auto-dumped an 'slo-burn' flight-recorder post-mortem, and
+  cleared after heal; the /debug/fleet snapshot captured DURING the
+  burn names the burning cell while the peer cell reads healthy;
+* same seed ⇒ same trace hash across the two runs, the event-mode
+  run AND the --trace off run — two live schedulers through the
+  threaded wire stack are fully deterministic, the batched ingest
+  cell filter is decision-invisible, and trace STITCHING + the SLO
+  engine are decision-invisible (hash pinned with stitching on or
+  off).
 """
 
 import json
@@ -31,7 +42,77 @@ import sys
 from chaos_parity import check_ingest_parity
 
 
-def main(path_a: str, path_b: str, path_event: str | None = None) -> int:
+def _check_fleet_obs(name: str, run: dict) -> dict:
+    """The stitching + SLO assertions for one trace-ON run; returns
+    the stitched summary for the export cross-check."""
+    tr = run["trace"]
+    assert tr and tr.get("enabled"), f"{name}: tracing was off: {tr}"
+    st = tr.get("stitched") or {}
+    assert st.get("count", 0) >= 1, (
+        f"{name}: no stitched trace — no trace id crossed both "
+        f"schedulers: {st}"
+    )
+    spanning = [
+        t for t in (st.get("traces") or {}).values()
+        if len(t.get("cells", [])) >= 2
+    ]
+    assert spanning, f"{name}: stitched traces span <2 cells: {st}"
+    slo = run["slo"]
+    assert slo and slo.get("cells"), f"{name}: no SLO summary: {slo}"
+    flagged_cells = [
+        c for c, s in slo["cells"].items() if s.get("flagged_ticks")
+    ]
+    assert flagged_cells, (
+        f"{name}: no cell ever read SLO fast-burn: {slo}"
+    )
+    assert any(
+        s.get("slo_burn_dumps", 0) >= 1 for s in slo["cells"].values()
+    ), f"{name}: no 'slo-burn' flight-recorder post-mortem: {slo}"
+    for cell, s in slo["cells"].items():
+        assert "cycle" not in (s.get("still_burning") or []), (
+            f"{name}: {cell} still fast-burning after heal: {s}"
+        )
+    snap = slo.get("fleet_during_burn")
+    assert snap, f"{name}: no /debug/fleet snapshot during burn: {slo}"
+    victim = snap["burning_cell"]
+    assert "cycle" in (
+        (snap["cells"].get(victim) or {}).get("fast_burning") or []
+    ), f"{name}: /debug/fleet missed the burning cell: {snap}"
+    for cell, blk in snap["cells"].items():
+        if cell in ("", victim):
+            continue
+        assert "cycle" not in (blk.get("fast_burning") or []), (
+            f"{name}: /debug/fleet showed peer {cell} burning during "
+            f"the victim's dark window: {snap}"
+        )
+    return st
+
+
+def _check_export(st: dict) -> int:
+    """The on-disk merged Perfetto export really contains spans from
+    BOTH schedulers under one trace id."""
+    path = st.get("export")
+    assert path, f"stitched summary carries no export path: {st}"
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    events = doc.get("traceEvents") or []
+    by_trace: dict = {}
+    for ev in events:
+        args = ev.get("args") or {}
+        tid, cell = args.get("trace_id"), args.get("cell")
+        if tid and cell:
+            by_trace.setdefault(tid, set()).add(cell)
+    spanning = {t: sorted(c) for t, c in by_trace.items()
+                if len(c) >= 2}
+    assert spanning, (
+        f"exported trace {path} has no trace id with spans from "
+        f"two schedulers: {sorted(by_trace)}"
+    )
+    return len(spanning)
+
+
+def main(path_a: str, path_b: str, path_event: str | None = None,
+         path_traceoff: str | None = None) -> int:
     with open(path_a, encoding="utf-8") as f:
         a = json.load(f)
     with open(path_b, encoding="utf-8") as f:
@@ -64,25 +145,52 @@ def main(path_a: str, path_b: str, path_event: str | None = None) -> int:
         assert any(c["breaker_opened"] >= 1 for c in cells.values()), (
             f"{name}: the asym window never tripped a breaker: {cells}"
         )
+        _check_fleet_obs(name, run)
+    stitched_exports = _check_export(a["trace"]["stitched"])
     assert a["trace_hash"] == b["trace_hash"], (
         f"same-seed 2-scheduler runs diverged: "
         f"{a['trace_hash']} != {b['trace_hash']}"
     )
     parity = check_ingest_parity(a, path_event, "cells")
+    stitch_parity = ""
+    if path_traceoff:
+        with open(path_traceoff, encoding="utf-8") as f:
+            off = json.load(f)
+        assert off["ok"], f"trace-off run violations: {off['violations']}"
+        assert not (off["trace"] or {}).get("enabled"), (
+            "the stitching-parity run ran with tracing ON"
+        )
+        assert off["trace_hash"] == a["trace_hash"], (
+            "trace stitching + SLO engine moved the decision hash: "
+            f"{off['trace_hash']} != {a['trace_hash']} — stitching "
+            "must be decision-invisible"
+        )
+        stitch_parity = " + stitching-off parity"
     xc, rc = a["cross_cell"], a["reclaim"]
+    slo = a["slo"]
+    burning = sorted(
+        c for c, s in slo["cells"].items() if s.get("flagged_ticks")
+    )
     print(
         "chaos cells: ok — same-seed hash "
         f"{a['trace_hash'][:16]}… reproduced across two live "
-        "schedulers" + parity + f"; {xc['rejected']} cross-cell "
-        f"write(s) rejected / 0 accepted / {xc['local_fenced']} "
-        f"locally fenced; partitions full={a['partitions']['full']} "
-        f"asym={a['partitions']['asym']} straddle-rollbacks="
-        f"{a['partitions']['straddle_rollbacks']}; reclaim "
-        f"granted={rc['granted']} rolled-back={rc['rolled_back']}"
+        "schedulers" + parity + stitch_parity +
+        f"; {xc['rejected']} cross-cell write(s) rejected / 0 "
+        f"accepted / {xc['local_fenced']} locally fenced; partitions "
+        f"full={a['partitions']['full']} asym={a['partitions']['asym']} "
+        f"straddle-rollbacks={a['partitions']['straddle_rollbacks']}; "
+        f"reclaim granted={rc['granted']} "
+        f"rolled-back={rc['rolled_back']}; "
+        f"{a['trace']['stitched']['count']} stitched trace(s) "
+        f"({stitched_exports} exported spanning both schedulers); "
+        f"SLO fast-burn flagged in {burning} with "
+        f"{sum(s.get('slo_burn_dumps', 0) for s in slo['cells'].values())}"
+        " slo-burn post-mortem(s), fleet pane pinned burning-vs-healthy"
     )
     return 0
 
 
 if __name__ == "__main__":
     sys.exit(main(sys.argv[1], sys.argv[2],
-                  sys.argv[3] if len(sys.argv) > 3 else None))
+                  sys.argv[3] if len(sys.argv) > 3 else None,
+                  sys.argv[4] if len(sys.argv) > 4 else None))

@@ -39,3 +39,21 @@ if enable_compile_cache("/tmp/kube-batch-tpu-test-xla-cache"):
     # The daemon-facing default (1 s) skips the suite's many ~0.3-1 s
     # helper compiles; at test scale those add up to minutes.
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
+
+
+# The process-global tracer must not LEAK across test files: cli.main
+# enables it (the daemon's always-on posture) and, like a real daemon,
+# never disables; with cross-scheduler trace stitching a live leaked
+# tracer decorates later tests' wire shapes (the k8s dialect annotates
+# written objects whenever a tracer + flow are bound).  One autouse
+# teardown here covers every test file — past and future — instead of
+# per-file copies.
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _drop_leaked_tracer():
+    yield
+    from kube_batch_tpu import trace
+
+    trace.disable()

@@ -1,0 +1,591 @@
+"""Fleet observability plane (doc/design/observability.md):
+
+* cross-scheduler trace stitching — W3C-shaped trace contexts minted
+  per flow, stamped onto wire requests in all three dialects, adopted
+  by the receiving side (the reclaim claim's context handed back to
+  the donor through listClaims), and decision-invisible by
+  construction;
+* the SLO burn-rate engine — declarative objectives, bounded ring
+  timeseries, multi-window multi-burn-rate alerts, the 'slo-burn'
+  flight-recorder trigger;
+* the /debug/fleet pane — in-process scopes + best-effort peers with
+  staleness stamps, burning-vs-healthy rollups;
+* the scoped-backlog /healthz satellite and the tagged flight-dump
+  satellite;
+* merged per-pod decision stories across cells (donor eviction +
+  recipient placement at one /debug/pods/<uid>).
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+
+import pytest
+
+from kube_batch_tpu import metrics, scope, trace
+from kube_batch_tpu.api.resource import ResourceSpec
+from kube_batch_tpu.cache.cache import SchedulerCache
+from kube_batch_tpu.cache.cluster import Node, Pod, PodGroup, Queue
+from kube_batch_tpu.client.adapter import (
+    CELL_LABEL,
+    StreamBackend,
+    WatchAdapter,
+)
+from kube_batch_tpu.client.external import ExternalCluster
+from kube_batch_tpu.models.workloads import GI
+from kube_batch_tpu.trace import context as trace_context
+from kube_batch_tpu.trace.slo import (
+    SloEngine,
+    SloObjective,
+    parse_slo_spec,
+    parse_slo_specs,
+)
+
+SPEC = ResourceSpec(("cpu", "memory", "pods", "accelerator"))
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    trace.disable()
+    metrics.reset_health_scopes()
+    scope.bind(None)
+    from kube_batch_tpu.trace import fleet
+
+    fleet.configure([])
+    yield
+    trace.disable()
+    metrics.reset_health_scopes()
+    scope.bind(None)
+    fleet.configure([])
+
+
+# -- trace context ----------------------------------------------------------
+
+def test_traceparent_roundtrip_and_children():
+    ctx = trace_context.mint()
+    tp = ctx.traceparent()
+    assert tp.startswith("00-") and tp.endswith("-01")
+    parsed = trace_context.parse(tp)
+    assert parsed.trace_id == ctx.trace_id
+    assert parsed.span_id == ctx.span_id
+    child = ctx.child()
+    assert child.trace_id == ctx.trace_id
+    assert child.span_id != ctx.span_id
+    # Garbage degrades to None, never a raise.
+    assert trace_context.parse("not-a-header") is None
+    assert trace_context.parse(None) is None
+    assert trace_context.parse(41) is None
+
+
+def test_flow_binds_context_and_enriches_spans(tmp_path):
+    tracer = trace.enable(dump_dir=str(tmp_path))
+    tracer.begin_cycle()
+    assert trace_context.current() is not None  # the cycle IS a flow
+    cycle_tid = trace_context.current().trace_id
+    with trace.flow("reclaim-claim") as fl:
+        assert fl.ctx is not None
+        assert trace_context.current() is fl.ctx
+        flow_tid = fl.ctx.trace_id
+        assert flow_tid != cycle_tid  # fresh root, not the cycle's
+        with trace.span("inner"):
+            pass
+    # The cycle's own flow context is restored after the block.
+    assert trace_context.current().trace_id == cycle_tid
+    tracer.end_cycle({"dur_ms": 1.0})
+    assert trace_context.current() is None
+    events = tracer.spans.chrome_events()
+    by_name = {e["name"]: e for e in events if e.get("ph") == "X"}
+    assert by_name["reclaim-claim"]["args"]["trace_id"] == flow_tid
+    inner = by_name["inner"]["args"]
+    assert inner["trace_id"] == flow_tid
+    assert inner["parent_span_id"] == by_name["reclaim-claim"]["args"][
+        "span_id"
+    ]
+
+
+def test_flow_is_noop_when_tracing_disabled():
+    with trace.flow("x") as fl:
+        assert fl.ctx is None
+        assert trace_context.current() is None
+    assert trace.wire_traceparent() is None
+
+
+def test_adopted_flow_keeps_remote_trace_id(tmp_path):
+    tracer = trace.enable(dump_dir=str(tmp_path))
+    tracer.begin_cycle()
+    remote = trace_context.mint()
+    with trace.flow("donate", ctx=remote):
+        pass
+    tracer.end_cycle({"dur_ms": 1.0})
+    args = [
+        e["args"] for e in tracer.spans.chrome_events()
+        if e.get("name") == "donate"
+    ][0]
+    assert args["trace_id"] == remote.trace_id
+    assert args["parent_span_id"] == remote.span_id
+
+
+# -- wire propagation -------------------------------------------------------
+
+def _cluster() -> ExternalCluster:
+    cl = ExternalCluster().start()
+    cl.add_queue(Queue(name="cell-a-q", cell="cell-a", uid="uid-q-a"))
+    cl.add_queue(Queue(name="cell-b-q", cell="cell-b", uid="uid-q-b"))
+    for cell, n in (("cell-a", "a-n0"), ("cell-b", "b-n0")):
+        cl.add_node(Node(
+            name=n, labels={CELL_LABEL: cell},
+            allocatable={"cpu": 8000, "memory": 16 * GI, "pods": 110},
+            uid=f"uid-{n}",
+        ))
+    cl.submit(
+        PodGroup(name="ga", queue="cell-a-q", min_member=1,
+                 uid="uid-pg-ga"),
+        [Pod(name="pa", uid="uid-pa",
+             request={"cpu": 500, "memory": GI, "pods": 1})],
+    )
+    return cl
+
+
+def _session(cl: ExternalCluster, cell: str | None):
+    a, b = socket.socketpair()
+    cl_r = a.makefile("r", encoding="utf-8")
+    cl_w = a.makefile("w", encoding="utf-8")
+    cl.attach(cl_r, cl_w)
+    cl.replay(cl_w)
+    backend = StreamBackend(
+        b.makefile("w", encoding="utf-8"), timeout=5.0,
+    )
+    if cell:
+        backend.set_cell(cell)
+    cache = SchedulerCache(
+        SPEC, binder=backend, evictor=backend, status_updater=backend,
+    )
+    adapter = WatchAdapter(
+        cache, b.makefile("r", encoding="utf-8"), backend=backend,
+        cell=cell,
+    ).start()
+    assert adapter.wait_for_sync(5.0)
+    return backend, cache, adapter
+
+
+def test_claim_propagates_traceparent_to_the_donor(tmp_path):
+    """The reclaim stitching round trip: the claimant's flow context
+    rides claimCapacity, the cluster remembers it on the claim,
+    listClaims hands it to the donor, and a flow adopted from it
+    shares the claimant's trace id — one causal tree, two
+    schedulers."""
+    cl = _cluster()
+    bb, _cb, _ab = _session(cl, "cell-b")
+    ba, _ca, _aa = _session(cl, "cell-a")
+    trace.enable(dump_dir=str(tmp_path), scope="cell-b")
+    donor_tracer = trace.enable(dump_dir=str(tmp_path), scope="cell-a")
+    with scope.bound("cell-b"):
+        with trace.flow("reclaim-claim") as fl:
+            resp = bb._call({"verb": "claimCapacity", "from": "cell-a",
+                             "ttlTicks": 4})
+            claim_tid = fl.ctx.trace_id
+    claim = cl.reclaim_claims[int(resp["claim"])]
+    assert claim["traceparent"] is not None
+    assert trace_context.parse(claim["traceparent"]).trace_id == \
+        claim_tid
+    # The donor lists the claim (context included) and adopts it.
+    with scope.bound("cell-a"):
+        listed = ba._call({"verb": "listClaims"})["object"]
+        assert listed[0]["traceparent"] == claim["traceparent"]
+        donor_tracer.begin_cycle()
+        donor_tracer.end_cycle({"dur_ms": 1.0})  # a closed ring cycle
+        with trace.flow(
+            "reclaim-donate",
+            ctx=trace_context.parse(listed[0]["traceparent"]),
+            cycle=donor_tracer.cycle,
+        ):
+            pass
+    donated = [
+        e for e in donor_tracer.spans.chrome_events()
+        if e.get("name") == "reclaim-donate"
+    ]
+    assert donated and donated[0]["args"]["trace_id"] == claim_tid
+
+
+def test_traceparent_rides_writes_but_never_the_wire_log(tmp_path):
+    """Stitching is decision-invisible on the hashed surface: a bind
+    issued inside a flow carries the traceparent on the wire, but the
+    ChaosCluster's structured wire log (the hash's input) records
+    none of it."""
+    from kube_batch_tpu.chaos.faults import ChaosCluster
+
+    cl = ChaosCluster(seed=0)
+    cl.start()
+    cl.add_queue(Queue(name="q", uid="uid-q"))
+    cl.add_node(Node(
+        name="n0",
+        allocatable={"cpu": 8000, "memory": 16 * GI, "pods": 110},
+        uid="uid-n0",
+    ))
+    cl.submit(
+        PodGroup(name="g", queue="q", min_member=1, uid="uid-pg"),
+        [Pod(name="p0", uid="uid-p0",
+             request={"cpu": 500, "memory": GI, "pods": 1})],
+    )
+    backend, _cache, _adapter = _session(cl, None)
+    trace.enable(dump_dir=str(tmp_path))
+    with trace.flow("cycle-ish"):
+        backend._call({"verb": "bind", "pod": "uid-p0", "node": "n0"})
+    assert ("p0", "n0") in cl.binds
+    for entry in cl.wire_log:
+        assert "traceparent" not in entry
+    # With tracing off, nothing is stamped at all.
+    trace.disable()
+    sent = {}
+    orig = backend._writer.write
+
+    def spy(line):
+        sent.setdefault("last", line)
+        return orig(line)
+
+    backend._writer.write = spy
+    backend._call({"verb": "ping"})
+    assert "traceparent" not in json.loads(sent["last"])
+
+
+def test_k8s_annotation_and_statestore_payload_stamping(tmp_path):
+    from kube_batch_tpu.client.k8s_write import (
+        TRACEPARENT_ANNOTATION,
+        binding_request,
+        state_snapshot_request,
+    )
+
+    pod = Pod(name="p0", uid="uid-p0", request={"cpu": 1.0})
+    # Off: no annotation anywhere.
+    req = binding_request(pod, "n0")
+    assert "annotations" not in req["object"]["metadata"]
+    trace.enable(dump_dir=str(tmp_path))
+    with trace.flow("cycle-ish") as fl:
+        req = binding_request(pod, "n0")
+        ann = req["object"]["metadata"]["annotations"]
+        assert trace_context.parse(
+            ann[TRACEPARENT_ANNOTATION]
+        ).trace_id == fl.ctx.trace_id
+        cm = state_snapshot_request({"v": 1, "state": {}})
+        assert TRACEPARENT_ANNOTATION in \
+            cm["object"]["metadata"]["annotations"]
+
+
+# -- SLO engine -------------------------------------------------------------
+
+def test_parse_slo_specs():
+    o = parse_slo_spec("placement:99%<30s")
+    assert (o.name, o.series, o.target, o.threshold) == \
+        ("placement", "placement", 0.99, 30.0)
+    o = parse_slo_spec("cycle=solve-latency:95%<250ms")
+    assert o.name == "solve-latency" and o.threshold == 0.25
+    o = parse_slo_spec("gang:90%<2m")
+    assert o.threshold == 120.0
+    defaults = parse_slo_specs(["default"])
+    assert {d.series for d in defaults} == {
+        "placement", "gang", "cycle", "commit_flush", "ingest_lag",
+    }
+    with pytest.raises(ValueError):
+        parse_slo_spec("nonsense:99%<30s")
+    with pytest.raises(ValueError):
+        parse_slo_spec("placement:130%<30s")
+    with pytest.raises(ValueError):
+        parse_slo_spec("placement 99% 30s")
+    with pytest.raises(ValueError):
+        parse_slo_specs(["placement:99%<30s", "placement:95%<10s"])
+
+
+def test_burn_rates_multi_window_and_clear():
+    clock = [0.0]
+    eng = SloEngine(
+        [SloObjective("cycle", "cycle", target=0.9, threshold=1.0,
+                      fast=(3, 6, 4.0), slow=(6, 12, 2.0),
+                      min_events=2)],
+        clock=lambda: clock[0],
+    )
+    breaches = []
+    eng.on_breach = lambda o, fs, fl: breaches.append((o.name, fs))
+    for t in range(3):
+        clock[0] = float(t)
+        eng.observe("cycle", 0.1)
+        eng.evaluate()
+    assert eng.burning() == []
+    for t in range(3, 8):
+        clock[0] = float(t)
+        eng.observe("cycle", 5.0)
+        eng.evaluate()
+    assert eng.burning() == ["cycle"]
+    assert len(breaches) == 1  # a sustained burn breaches ONCE
+    assert metrics.slo_breaches.value("cycle") >= 1.0
+    assert metrics.slo_burn_rate.value("cycle", "3") >= 4.0
+    for t in range(8, 25):
+        clock[0] = float(t)
+        eng.observe("cycle", 0.1)
+        eng.evaluate()
+    assert eng.burning() == []  # windows slid clean after heal
+    st = eng.state()["objectives"]["cycle"]
+    assert st["breaches"] == 1 and st["observations"] == 25
+
+
+def test_no_data_means_no_burn():
+    clock = [100.0]
+    eng = SloEngine(
+        [SloObjective("cycle", "cycle", target=0.99, threshold=1.0)],
+        clock=lambda: clock[0],
+    )
+    st = eng.evaluate()
+    assert st["cycle"]["fast_burn"] is False
+    assert all(v == 0.0 for v in st["cycle"]["burn"].values())
+
+
+def test_slo_breach_is_a_flight_recorder_trigger(tmp_path):
+    clock = [0.0]
+    tracer = trace.enable(dump_dir=str(tmp_path), tag="cell-x")
+    tracer.arm_slo(SloEngine(
+        [SloObjective("cycle", "cycle", target=0.9, threshold=1.0,
+                      fast=(3, 6, 4.0), slow=(6, 12, 2.0),
+                      min_events=2)],
+        clock=lambda: clock[0],
+    ))
+    for t in range(8):
+        clock[0] = float(t)
+        tracer.slo.observe("cycle", 9.0)
+        tracer.slo.evaluate()
+    dumps = [d for d in tracer.recorder.dumps
+             if d["trigger"] == "slo-burn"]
+    assert len(dumps) == 1  # rate-limited like every trigger
+    # The tag satellite: the filename names the scope/cell.
+    assert "kb-flight-cell-x-slo-burn" in dumps[0]["path"]
+    body = json.loads(open(dumps[0]["path"]).read())
+    assert body["meta"]["trigger"] == "slo-burn"
+    assert body["meta"]["transition"]["slo"] == "cycle"
+    assert body["meta"]["scope"] == "cell-x"
+
+
+def test_cycle_slo_fed_from_scheduler_summaries(tmp_path):
+    """Tracer.end_cycle feeds the cycle series and evaluates —
+    /debug/slo serves live state without any scheduler wiring."""
+    tracer = trace.enable(dump_dir=str(tmp_path))
+    tracer.arm_slo(SloEngine(parse_slo_specs(["cycle:99%<1s"])))
+    tracer.begin_cycle()
+    tracer.end_cycle({"dur_ms": 12.5})
+    tracer.begin_cycle()
+    tracer.end_cycle({"dur_ms": 3.0, "quiesced": True})  # not fed
+    status, body = trace.debug_http("/debug/slo")
+    assert status == 200
+    assert body["slo"]["objectives"]["cycle"]["observations"] == 1
+
+
+def test_debug_slo_404_when_unarmed(tmp_path):
+    trace.enable(dump_dir=str(tmp_path))
+    status, body = trace.debug_http("/debug/slo")
+    assert status == 404 and "--slo" in body["error"]
+
+
+def test_gang_slo_fed_on_first_running_refresh(tmp_path):
+    """The gang time-to-full-placement series observes ONCE, at the
+    first status refresh that sees the group Running."""
+    from kube_batch_tpu.api.types import TaskStatus
+
+    tracer = trace.enable(dump_dir=str(tmp_path))
+    tracer.arm_slo(SloEngine(parse_slo_specs(["gang:95%<120s"])))
+    cache = SchedulerCache(SPEC, binder=None, evictor=None,
+                           status_updater=None)
+    cache.add_queue(Queue(name="q", uid="uid-q"))
+    cache.add_pod_group(PodGroup(name="g", queue="q", min_member=2,
+                                 uid="uid-pg"))
+    for i in range(2):
+        cache.add_pod(Pod(name=f"p{i}", uid=f"uid-p{i}", group="g",
+                          request={"cpu": 1.0}))
+    cache.refresh_job_statuses(None)  # still pending: no observation
+    assert tracer.slo.state()["objectives"]["gang"][
+        "observations"] == 0
+    for i in range(2):
+        cache.update_pod_status(f"uid-p{i}", TaskStatus.RUNNING,
+                                node="n0")
+    cache.refresh_job_statuses(None)
+    cache.refresh_job_statuses(None)  # second refresh must NOT re-feed
+    st = tracer.slo.state()["objectives"]["gang"]
+    assert st["observations"] == 1 and st["bad"] == 0
+
+
+# -- /debug/fleet -----------------------------------------------------------
+
+def test_fleet_pane_merges_scopes_and_rolls_up(tmp_path):
+    clock = [0.0]
+    for cell in ("cell-a", "cell-b"):
+        tracer = trace.enable(dump_dir=str(tmp_path), scope=cell)
+        tracer.arm_slo(SloEngine(
+            [SloObjective("cycle", "cycle", target=0.9, threshold=1.0,
+                          fast=(3, 6, 4.0), slow=(6, 12, 2.0),
+                          min_events=2)],
+            clock=lambda: clock[0],
+        ))
+    metrics.set_health_state("ok", scope="cell-a")
+    metrics.set_health_state("degraded", scope="cell-b")
+    metrics.set_leadership("leader", 7, scope="cell-b")
+    metrics.set_ingest_lag(0.25, scope="cell-b")
+    # cell-b burns, cell-a stays healthy.
+    for t in range(8):
+        clock[0] = float(t)
+        b = trace.get(scope="cell-b").slo
+        b.observe("cycle", 9.0)
+        b.evaluate()
+        a = trace.get(scope="cell-a").slo
+        a.observe("cycle", 0.1)
+        a.evaluate()
+    status, body = trace.debug_http("/debug/fleet")
+    assert status == 200
+    cells = body["cells"]
+    assert cells["cell-b"]["state"] == "degraded"
+    assert cells["cell-b"]["epoch"] == 7
+    assert cells["cell-b"]["ingest_lag_seconds"] == 0.25
+    assert cells["cell-b"]["slo"]["burning"] == ["cycle"]
+    assert cells["cell-a"]["slo"]["burning"] == []
+    roll = body["fleet"]
+    assert roll["worst_state"] == "degraded"
+    assert [b["cell"] for b in roll["burning"]] == ["cell-b"]
+
+
+def test_fleet_pane_fetches_peers_with_staleness(tmp_path):
+    """A live peer's /healthz + /debug/slo merge in; a dead peer
+    degrades to an error row with stale=True — never a raise."""
+    from kube_batch_tpu.trace import fleet
+
+    thread = metrics.serve(":0")
+    port = thread.server.server_address[1]
+    try:
+        trace.enable(dump_dir=str(tmp_path))
+        fleet.configure([
+            f"http://127.0.0.1:{port}",
+            "http://127.0.0.1:1",  # nothing listens here
+        ])
+        body = fleet.fleet_body()
+        live = body["peers"][f"http://127.0.0.1:{port}"]
+        assert live["error"] is None and not live["stale"]
+        assert live["healthz"]["state"] in ("ok", "degraded",
+                                            "overloaded")
+        dead = body["peers"]["http://127.0.0.1:1"]
+        assert dead["stale"] and dead["error"]
+        assert body["fleet"]["peers"] == 2
+        assert body["fleet"]["peers_stale"] == 1
+    finally:
+        thread.server.shutdown()
+        fleet.configure([])
+
+
+def test_dead_peer_probes_are_throttled(monkeypatch):
+    """A dead peer is re-probed at most once per PEER_REFRESH_S — not
+    once per /debug/fleet request: the failure path must advance the
+    attempt clock even though the data clock (fetched_at) stays."""
+    from kube_batch_tpu.trace import fleet
+
+    calls = []
+
+    def dead_fetch(url):
+        calls.append(url)
+        raise OSError("connection refused")
+
+    monkeypatch.setattr(fleet, "_fetch_json", dead_fetch)
+    fleet.configure(["http://dead-peer:1"])
+    body1 = fleet.fleet_body()
+    n_after_first = len(calls)
+    assert n_after_first >= 1
+    body2 = fleet.fleet_body()  # within PEER_REFRESH_S: no new probe
+    assert len(calls) == n_after_first
+    for body in (body1, body2):
+        row = body["peers"]["http://dead-peer:1"]
+        assert row["stale"] and row["error"]
+        assert row["age_s"] is None  # never fetched: no data to age
+
+
+def test_gang_slo_skips_groups_ingested_already_running(tmp_path):
+    """A restart/relist against a cluster of already-Running gangs
+    must not flood the gang series with near-zero 'good' waits — only
+    gangs this scheduler actually waited on observe."""
+    from kube_batch_tpu.api.types import PodGroupPhase, TaskStatus
+
+    tracer = trace.enable(dump_dir=str(tmp_path))
+    tracer.arm_slo(SloEngine(parse_slo_specs(["gang:95%<120s"])))
+    cache = SchedulerCache(SPEC, binder=None, evictor=None,
+                           status_updater=None)
+    cache.add_queue(Queue(name="q", uid="uid-q"))
+    # Ingested already Running (a previous incarnation placed it).
+    old = PodGroup(name="old", queue="q", min_member=1, uid="uid-old")
+    old.phase = PodGroupPhase.RUNNING
+    cache.add_pod_group(old)
+    cache.add_pod(Pod(name="o0", uid="uid-o0", group="old",
+                      request={"cpu": 1.0}))
+    cache.update_pod_status("uid-o0", TaskStatus.RUNNING, node="n0")
+    cache.refresh_job_statuses(None)
+    assert tracer.slo.state()["objectives"]["gang"][
+        "observations"] == 0
+    # A gang THIS incarnation waited on still observes normally.
+    cache.add_pod_group(PodGroup(name="new", queue="q", min_member=1,
+                                 uid="uid-new"))
+    cache.add_pod(Pod(name="n0p", uid="uid-n0p", group="new",
+                      request={"cpu": 1.0}))
+    cache.update_pod_status("uid-n0p", TaskStatus.RUNNING, node="n0")
+    cache.refresh_job_statuses(None)
+    assert tracer.slo.state()["objectives"]["gang"][
+        "observations"] == 1
+
+
+def test_fleet_pane_served_even_with_tracing_disabled():
+    status, body = trace.debug_http("/debug/fleet")
+    assert status == 200
+    assert "" in body["cells"]  # the process-global healthz row
+
+
+# -- scoped /healthz backlog satellite --------------------------------------
+
+def test_healthz_backlog_resolves_through_scope():
+    metrics.set_health_state("ok", scope="cell-a")
+    metrics.set_health_state("ok", scope="cell-b")
+    with scope.bound("cell-a"):
+        metrics.set_ingest_lag(1.5)
+        metrics.set_commit_queue_depth(9)
+    with scope.bound("cell-b"):
+        metrics.set_ingest_lag(0.01)
+        metrics.set_commit_queue_depth(0)
+    body = json.loads(metrics.health_body())
+    cells = body["cells"]
+    assert cells["cell-a"]["ingest_lag_seconds"] == 1.5
+    assert cells["cell-a"]["commit_queue_depth"] == 9
+    assert cells["cell-b"]["ingest_lag_seconds"] == 0.01
+    assert cells["cell-b"]["commit_queue_depth"] == 0
+    # The process-global body fields stay gauge-backed (single-
+    # scheduler behavior unchanged); the scoped entries are the
+    # per-scheduler truth.
+    assert body["commit_queue_depth"] == 0
+
+
+# -- merged cross-cell pod story --------------------------------------------
+
+def test_pod_story_merges_donor_eviction_and_recipient_placement(
+    tmp_path,
+):
+    """The multi-cell decision-record satellite: a pod reclaimed
+    across cells shows the donor's drain eviction AND the recipient's
+    placement as one coherent story at /debug/pods/<uid>, ordered by
+    the process-monotone seq."""
+    donor = trace.enable(dump_dir=str(tmp_path), scope="cell-a")
+    recip = trace.enable(dump_dir=str(tmp_path), scope="cell-b")
+    donor.decisions.note_eviction(
+        "uid-p1", "p1", "g1", "a-n0", "reclaim-donate", cycle=5,
+    )
+    recip.decisions.note_placed("uid-p1", "p1", "g1", "b-n0", cycle=2)
+    with scope.bound("cell-b"):
+        status, story = trace.debug_http("/debug/pods/uid-p1")
+    assert status == 200
+    assert set(story["cells"]) == {"cell-a"}
+    kinds = [(r["kind"], r["cell"]) for r in story["fleet_records"]]
+    assert kinds == [("preempted", "cell-a"), ("placed", "cell-b")]
+    # The thread's own records still serve unmerged, back-compat.
+    assert [r["kind"] for r in story["records"]] == ["placed"]
+    # And a scope that never touched the pod still gets the story.
+    with scope.bound("cell-a"):
+        status, story = trace.debug_http("/debug/pods/uid-p1")
+    assert status == 200
+    assert set(story["cells"]) == {"cell-b"}

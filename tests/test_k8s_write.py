@@ -16,6 +16,9 @@ import json
 import threading
 import time
 
+import pytest
+
+from kube_batch_tpu import trace
 from kube_batch_tpu.actions import BUILTIN_ACTIONS  # noqa: F401
 from kube_batch_tpu.api.resource import ResourceSpec
 from kube_batch_tpu.cache.cache import SchedulerCache
@@ -37,6 +40,17 @@ from kube_batch_tpu.scheduler import Scheduler
 from tests.test_k8s_ingest import events, k8s_node, k8s_pod, k8s_pod_group
 
 SPEC = ResourceSpec(("cpu", "memory", "pods", "accelerator"))
+
+
+@pytest.fixture(autouse=True)
+def _exact_shapes_need_tracing_off():
+    """These are recorded-fixture tests: the EXACT wire shapes, which
+    deliberately exclude the trace-context annotation a live tracer's
+    cycle flow would stamp (doc/design/observability.md · wire
+    format).  Pin tracing off BEFORE each test too (conftest only
+    cleans AFTER) so nothing can decorate the shapes."""
+    trace.disable()
+    yield
 
 
 def _wire_up_k8s():
