@@ -121,6 +121,11 @@ run-example:
 # same seed ⇒ same hash across the two runs, the --ingest-mode event
 # parity run AND the --trace off run (stitching + SLO engine are
 # decision-invisible).
+# The guardrail and restart scenarios each also run ONCE at
+# --mesh-devices 8 (doc/design/multichip-shard.md, virtual CPU mesh):
+# the node-axis sharded pack/solve must be decision-invisible, so the
+# check scripts assert hash parity against the single-device runs (and
+# refuse a vacuous parity where the mesh never actually activated).
 # The fifth and sixth runs are the FAILOVER scenario
 # (doc/design/failover-fencing.md): a leader crash mid-commit, a
 # second elector instance taking over at a higher epoch, a zombie-
@@ -145,9 +150,12 @@ chaos:
 	JAX_PLATFORMS=cpu $(PY) -m kube_batch_tpu.chaos --seed 11 --ticks 32 \
 	    --scenario examples/chaos-guardrail.json --wire-commit pipelined \
 	    --ingest-mode event --quiet > /tmp/kb-chaos-ingestevent.json
+	JAX_PLATFORMS=cpu $(PY) -m kube_batch_tpu.chaos --seed 11 --ticks 32 \
+	    --scenario examples/chaos-guardrail.json --wire-commit pipelined \
+	    --mesh-devices 8 --quiet > /tmp/kb-chaos-mesh.json
 	$(PY) scripts/check_chaos_pipelined.py /tmp/kb-chaos-pipelined-1.json \
 	    /tmp/kb-chaos-pipelined-2.json /tmp/kb-chaos-packfull.json \
-	    /tmp/kb-chaos-ingestevent.json
+	    /tmp/kb-chaos-ingestevent.json /tmp/kb-chaos-mesh.json
 	JAX_PLATFORMS=cpu $(PY) -m kube_batch_tpu.chaos --seed 13 --ticks 24 \
 	    --scenario examples/chaos-failover.json --wire-commit pipelined \
 	    --quiet > /tmp/kb-chaos-failover-1.json
@@ -179,8 +187,12 @@ chaos:
 	JAX_PLATFORMS=cpu $(PY) -m kube_batch_tpu.chaos --seed 23 --ticks 26 \
 	    --scenario examples/chaos-restart.json --wire-commit pipelined \
 	    --ingest-mode event --quiet > /tmp/kb-chaos-restart-e.json
+	JAX_PLATFORMS=cpu $(PY) -m kube_batch_tpu.chaos --seed 23 --ticks 26 \
+	    --scenario examples/chaos-restart.json --wire-commit pipelined \
+	    --mesh-devices 8 --quiet > /tmp/kb-chaos-restart-m.json
 	$(PY) scripts/check_chaos_restart.py /tmp/kb-chaos-restart-1.json \
-	    /tmp/kb-chaos-restart-2.json /tmp/kb-chaos-restart-e.json
+	    /tmp/kb-chaos-restart-2.json /tmp/kb-chaos-restart-e.json \
+	    /tmp/kb-chaos-restart-m.json
 	JAX_PLATFORMS=cpu $(PY) -m kube_batch_tpu.chaos --seed 29 --ticks 24 \
 	    --scenario examples/chaos-ingest.json --wire-commit pipelined \
 	    --quiet > /tmp/kb-chaos-ingest-1.json
@@ -238,8 +250,7 @@ verify:
 	JAX_PLATFORMS=cpu $(PY) scripts/check_slo_overhead.py
 	JAX_PLATFORMS=cpu $(PY) scripts/check_compile_artifacts.py
 	$(PY) -c "import __graft_entry__ as g; g.entry()"
-	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
-	    $(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+	$(PY) scripts/check_shard_bench.py
 	$(MAKE) chaos
 	$(MAKE) bench-smoke
 

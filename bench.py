@@ -776,6 +776,24 @@ def _run_daemon_phases(jax, n, cache, sim, conf_path, steady_cycles) -> dict:
         out["compile_artifacts"] = {"error": str(exc)[:300]}
     emit_partial(compile_artifacts=out["compile_artifacts"])
 
+    # -- device-mesh sharding tier (doc/design/multichip-shard.md) ------
+    # Every daemon artifact records the multichip figure: the gang
+    # config packed and solved 1-device vs node-sharded over 8 virtual
+    # devices, with per-device peak MB and the single-device refusal
+    # boundary — the same measurement scripts/check_shard_bench.py
+    # gates (<=0.2x per-device peak, bit-identical solve) in make
+    # verify, run AS that script in a fresh subprocess because the
+    # virtual device count is read once at backend init and the bench
+    # process's backend is already up.  A tight budget drops to the
+    # smoke worlds, not the section.
+    try:
+        out["shard"] = run_shard_bench(
+            smoke=_budget_left() <= 240.0
+        )
+    except Exception as exc:  # noqa: BLE001 — degrade, never die
+        out["shard"] = {"error": str(exc)[:300]}
+    emit_partial(shard=out["shard"])
+
     # -- multi-cell aggregate (doc/design/multi-cell.md) ----------------
     # Every daemon artifact records the 2-cell scale-out figure: two
     # cell-fenced schedulers vs one ExternalCluster, aggregate pods/s
@@ -1526,6 +1544,34 @@ def run_compile_artifacts(config: int = 3) -> dict:
     if out.returncode != 0:
         raise RuntimeError(
             f"check_compile_artifacts --json rc={out.returncode}: "
+            f"{(out.stderr or out.stdout)[-300:]}"
+        )
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def run_shard_bench(smoke: bool = False) -> dict:
+    """The device-mesh sharding figure — 1-device vs 8-virtual-device
+    pack+solve on the gang config with per-device peak MB — run AS
+    scripts/check_shard_bench.py in a fresh subprocess so the
+    artifact's number and the verify gate's number can never diverge
+    in method.  The subprocess is load-bearing: the 8-device virtual
+    CPU mesh is an XLA_FLAGS value read exactly once at backend init,
+    and the bench process's backend is already initialized."""
+    import subprocess
+
+    script = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "scripts", "check_shard_bench.py",
+    )
+    cmd = [sys.executable, script, "--json"]
+    if smoke:
+        cmd.append("--smoke")
+    out = subprocess.run(
+        cmd, capture_output=True, text=True, timeout=900,
+    )
+    if out.returncode != 0:
+        raise RuntimeError(
+            f"check_shard_bench --json rc={out.returncode}: "
             f"{(out.stderr or out.stdout)[-300:]}"
         )
     return json.loads(out.stdout.strip().splitlines()[-1])

@@ -167,8 +167,15 @@ class IncrementalPacker:
     #: fresh copy once indices + values approach the array itself).
     ROW_PATCH_MAX_FRAC = 0.25
 
-    def __init__(self, cache: SchedulerCache) -> None:
+    def __init__(self, cache: SchedulerCache, mesh=None) -> None:
         self.cache = cache
+        #: parallel.mesh.MeshContext (None/inert = today's single-
+        #: device path: plain device_put, no sharding metadata).  When
+        #: active, node-major arrays land sharded PartitionSpec('node')
+        #: and row patches scatter into the committed sharded buffers —
+        #: each write touches only the owning device's shard
+        #: (doc/design/multichip-shard.md).
+        self.mesh = mesh
         self._dirty = cache.register_dirty_listener()
         self._snap = None
         self._meta: SnapshotMeta | None = None
@@ -185,6 +192,11 @@ class IncrementalPacker:
         # H2D bytes the LAST pack shipped (whole arrays + row patches);
         # the bench's pack comparison and the H2D-bytes tests read it.
         self.last_h2d_bytes = 0
+        # The PER-DEVICE share of that transfer: node-sharded fields
+        # ship 1/devices of their bytes to each device, replicated
+        # fields ship whole.  Equal to last_h2d_bytes on an inert mesh;
+        # the pack_h2d trace span carries it (PR 10 observability).
+        self.last_h2d_bytes_per_device = 0
         # Operator escape hatch (--pack-mode full / chaos parity runs):
         # every pack rebuilds from scratch; device state is identical
         # either way, so same-seed chaos hashes must not move.
@@ -199,6 +211,45 @@ class IncrementalPacker:
         # cycle (~O(total tasks) of host Python at flagship scale).
         self.last_groups: set[str] | None = None
         self.check = os.environ.get("KB_TPU_CHECK_PACK") == "1"
+
+    # -- mesh-aware device placement -----------------------------------
+
+    @property
+    def _mesh_devices(self) -> int:
+        return self.mesh.devices if self.mesh is not None else 1
+
+    def _num_nodes(self, arrays: dict | None = None) -> int:
+        """The PADDED node count of the current pack (the sharded dim).
+        A full pack's per-device accounting runs BEFORE self._ints is
+        swapped in, so the fresh array dict (which always carries
+        node_cap) takes precedence over the previous pack's."""
+        if arrays is not None and "node_cap" in arrays:
+            return int(arrays["node_cap"].shape[0])
+        return int(self._ints.arrays["node_cap"].shape[0])
+
+    def _place(self, arrays: dict) -> dict:
+        """ONE batched H2D for a field dict: plain device_put on an
+        inert mesh (today's exact path), node-axis NamedShardings on an
+        active one."""
+        if self.mesh is None or not self.mesh.active:
+            return jax.device_put(arrays)
+        return self.mesh.place_arrays(arrays, self._num_nodes(arrays))
+
+    def _per_device_nbytes(self, arrays: dict, extra: int = 0) -> int:
+        """Bytes each device receives for `arrays` (+ `extra` bytes of
+        replicated row-patch payload): node-sharded fields ship
+        1/devices of themselves per device, everything else whole."""
+        m = self.mesh
+        if m is None or not m.active:
+            return extra + sum(arr.nbytes for arr in arrays.values())
+        n = self._num_nodes(arrays)
+        total = extra
+        for f, arr in arrays.items():
+            if m.node_sharded(f, arr, n):
+                total += arr.nbytes // m.devices
+            else:
+                total += arr.nbytes
+        return total
 
     # -- entry point ----------------------------------------------------
 
@@ -262,12 +313,18 @@ class IncrementalPacker:
             )
         # H2D split out of the host build so the pack_host_patch /
         # pack_h2d attribution in cycle_phase_latency is real; one
-        # batched device_put for the whole pytree, as ever.
-        with metrics.cycle_phase_latency.time("pack_h2d"), \
-                trace.span("pack_h2d", mode="full"):
-            snap = SnapshotTensors(**jax.device_put(ints.arrays))
+        # batched device_put for the whole pytree, as ever (mesh-aware:
+        # node-major fields land sharded over the node axis).
         nbytes = sum(arr.nbytes for arr in ints.arrays.values())
+        per_dev = self._per_device_nbytes(ints.arrays)
+        with metrics.cycle_phase_latency.time("pack_h2d"), \
+                trace.span("pack_h2d", mode="full",
+                           mesh_devices=self._mesh_devices,
+                           pack_h2d_bytes=nbytes,
+                           pack_h2d_bytes_per_device=per_dev):
+            snap = SnapshotTensors(**self._place(ints.arrays))
         self.last_h2d_bytes = nbytes
+        self.last_h2d_bytes_per_device = per_dev
         metrics.pack_h2d_bytes.inc(by=float(nbytes))
         metrics.pack_total.inc("full")
         self._snap, self._meta, self._ints = snap, meta, ints
@@ -318,8 +375,10 @@ class IncrementalPacker:
         row_patched = False
         if changed:
             try:
-                with metrics.cycle_phase_latency.time("pack_h2d"), \
-                        trace.span("pack_h2d", mode="incremental"):
+                # The pack_h2d trace span lives inside _upload, where
+                # the whole/patch byte split is known and can ride the
+                # span's attrs (mesh_devices + per-device bytes).
+                with metrics.cycle_phase_latency.time("pack_h2d"):
                     row_patched = self._upload(changed)
             except Exception:
                 # Device upload failed (e.g. OOM): the host arrays are
@@ -329,6 +388,7 @@ class IncrementalPacker:
                 raise
         else:
             self.last_h2d_bytes = 0
+            self.last_h2d_bytes_per_device = 0
         # Drain the journal only once the device state is consistent.
         d.clear()
         self.incremental_packs += 1
@@ -373,11 +433,12 @@ class IncrementalPacker:
                 patch[f] = np.fromiter(
                     sorted(rows), np.int32, count=len(rows))
         nbytes = sum(arr.nbytes for arr in whole.values())
-        patched: dict = {}
+        patch_payload = 0
+        bufs: dict = {}
+        rows_d: dict[str, np.ndarray] = {}
+        vals_d: dict[str, np.ndarray] = {}
         if patch:
             bufs = {f: getattr(self._snap, f) for f in patch}
-            rows_d: dict[str, np.ndarray] = {}
-            vals_d: dict[str, np.ndarray] = {}
             for f, ridx in patch.items():
                 # Bucket the row count so the scatter kernel compiles
                 # O(log max-churn) times, not once per distinct k; the
@@ -395,11 +456,26 @@ class IncrementalPacker:
                 vals = a[f][ridx]
                 rows_d[f] = ridx
                 vals_d[f] = vals
-                nbytes += ridx.nbytes + vals.nbytes
-            patched = dict(_row_patch(bufs, rows_d, vals_d))
-        uploaded = jax.device_put(whole) if whole else {}
+                patch_payload += ridx.nbytes + vals.nbytes
+        nbytes += patch_payload
+        # Patch indices/values replicate to every device (the owning
+        # shard applies its rows; GSPMD keeps the scatter shard-local
+        # for node-axis buffers), so they count whole per device.
+        per_dev = self._per_device_nbytes(whole, extra=patch_payload)
+        with trace.span("pack_h2d", mode="incremental",
+                        mesh_devices=self._mesh_devices,
+                        pack_h2d_bytes=nbytes,
+                        pack_h2d_bytes_per_device=per_dev):
+            patched: dict = {}
+            if patch:
+                # The committed device buffers carry their shardings;
+                # the jitted scatter's outputs inherit them, so a
+                # row patch on an active mesh stays a per-shard write.
+                patched = dict(_row_patch(bufs, rows_d, vals_d))
+            uploaded = self._place(whole) if whole else {}
         self._snap = self._snap.replace(**patched, **uploaded)
         self.last_h2d_bytes = nbytes
+        self.last_h2d_bytes_per_device = per_dev
         metrics.pack_h2d_bytes.inc(by=float(nbytes))
         return bool(patch)
 
@@ -712,12 +788,21 @@ class IncrementalPacker:
         from kube_batch_tpu.ops.assignment import AllocState
 
         a = self._ints.arrays
-        return AllocState(
+        state = AllocState(
             task_state=a["task_state"].copy(),
             task_node=a["task_node"].copy(),
             node_idle=a["node_idle"].copy(),
             node_future=a["node_idle"] + a["node_releasing"],
         )
+        if self.mesh is not None and self.mesh.active:
+            # Explicit placement on an active mesh: a program lowered
+            # with node-sharded state inputs must be CALLED with node-
+            # sharded state — mixing committed sharded snapshot args
+            # with uncommitted numpy state would leave the placement
+            # to inference.  (Inert mesh keeps the numpy fields: they
+            # ride the jitted call's own argument transfer.)
+            state = self.mesh.place_fields(state, self._num_nodes())
+        return state
 
     # -- mechanical invariant check (VERDICT r2 weak #8) ---------------
 
@@ -824,6 +909,46 @@ class IncrementalPacker:
                 assert a["job_queue"][row] == want_q, (
                     f"job {jname}: packed queue row {a['job_queue'][row]}"
                     f" != live {want_q}"
+                )
+        if self.mesh is not None and self.mesh.active:
+            self.verify_sharded_view()
+
+    def verify_sharded_view(self) -> None:
+        """Per-shard device==host bit-identity on an ACTIVE mesh: every
+        node-sharded field's addressable shards must tile the packed
+        host array exactly (shard k == host rows [k·N/D, (k+1)·N/D)),
+        and every replicated field must read back equal on device.  A
+        row patch that scattered into the wrong shard, or a placement
+        that silently replicated a field the layout says shards, fails
+        here — the sharded extension of the device==host invariant the
+        journal fuzz pins (tests/test_incremental_pack.py)."""
+        import dataclasses as _dc
+
+        a = self._ints.arrays
+        n = self._num_nodes()
+        devs = self.mesh.devices
+        for f in _dc.fields(self._snap):
+            host = a.get(f.name)
+            dev = getattr(self._snap, f.name)
+            if host is None or not hasattr(dev, "addressable_shards"):
+                continue
+            if self.mesh.node_sharded(f.name, host, n):
+                shards = sorted(
+                    dev.addressable_shards,
+                    key=lambda s: s.index[0].start or 0,
+                )
+                assert len(shards) == devs, (
+                    f"{f.name}: {len(shards)} shards != {devs} devices"
+                )
+                rows = host.shape[0] // devs
+                for k, s in enumerate(shards):
+                    np.testing.assert_array_equal(
+                        np.asarray(s.data), host[k * rows:(k + 1) * rows],
+                        err_msg=f"{f.name} shard {k}",
+                    )
+            else:
+                np.testing.assert_array_equal(
+                    np.asarray(dev), host, err_msg=f.name
                 )
 
     def _verify_vol_row(self, pod, row: int, a: dict) -> None:

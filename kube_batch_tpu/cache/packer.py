@@ -435,6 +435,7 @@ def pack_snapshot_full(
     device: bool = True,
     prev: PackInternals | None = None,
     invalid_jobs=frozenset(),
+    mesh=None,
 ) -> tuple[SnapshotTensors, SnapshotMeta, PackInternals]:
     """Vectorized full pack.  `min_buckets` forces minimum padded sizes
     for the primary dims ("T"/"J"/"N"), used by the scheduler's growth
@@ -453,7 +454,12 @@ def pack_snapshot_full(
     PackInternals.arrays dict (the incremental packer patches such
     arrays in place), so a device=False caller must treat the
     internals as consumed; the device path gets fresh device buffers
-    and has no such coupling."""
+    and has no such coupling.
+
+    `mesh` is an optional parallel.mesh.MeshContext: on an ACTIVE one
+    the device transfer places node-major arrays sharded
+    PartitionSpec('node') across the mesh (task/job/queue tensors
+    replicate); inert or None keeps today's plain device_put."""
     spec = host.spec
 
     queue_names = sorted(host.queues)
@@ -962,10 +968,14 @@ def pack_snapshot_full(
     # (~40 arrays; same batching as the incremental path's changed-set
     # upload and the fused cycle's device_get).  `device=False` keeps
     # the fields numpy for device-free callers (pack_snapshot_host).
+    # An active MeshContext shards node-major fields over the node axis.
     if device:
         import jax
 
-        snap = SnapshotTensors(**jax.device_put(arrays))
+        if mesh is not None and getattr(mesh, "active", False):
+            snap = SnapshotTensors(**mesh.place_arrays(arrays, Np))
+        else:
+            snap = SnapshotTensors(**jax.device_put(arrays))
     else:
         snap = SnapshotTensors(**arrays)
     uid_list: list[str] = []

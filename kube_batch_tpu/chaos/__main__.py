@@ -101,6 +101,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "invisible, so the same seed must hash "
                         "identically either way (pinned by "
                         "tests/test_chaos_trace.py)")
+    p.add_argument("--mesh-devices", type=int, default=None,
+                   help="device-mesh dimension for the scheduler under "
+                        "test (doc/design/multichip-shard.md): N>1 "
+                        "arms a virtual host-device mesh and runs the "
+                        "node-axis sharded pack/solve.  The mesh is "
+                        "decision-invisible: the same seed must hash "
+                        "identically at any device count (make chaos "
+                        "pins 1 vs 8).  Default: adopt from a replayed "
+                        "trace's meta header, else 1")
     p.add_argument("--compile-bank", choices=("auto", "on", "off"),
                    default="auto",
                    help="AOT compile-artifact bank dimension "
@@ -280,6 +289,28 @@ def main(argv: list[str] | None = None) -> int:
         )
         seed = int(meta.get("seed", 0)) if meta else 0
 
+    # The virtual device mesh must be armed BEFORE the first jax
+    # backend touch (XLA reads the host-device count exactly once), so
+    # the replayed-trace meta adoption the engine would do is resolved
+    # here too — a mesh=8 trace replayed without the flag still runs
+    # on 8 devices.
+    from kube_batch_tpu.parallel.mesh import (
+        arm_virtual_devices,
+        resolve_mesh_devices,
+    )
+
+    mesh_devices = args.mesh_devices
+    if mesh_devices is None and events is not None:
+        meta = next(
+            (e for e in events if e.get("op") == "meta"), None
+        )
+        if meta is not None and meta.get("mesh_devices") is not None:
+            mesh_devices = int(meta["mesh_devices"])
+    mesh_n = resolve_mesh_devices(mesh_devices)
+    if mesh_n > 1:
+        arm_virtual_devices(mesh_n)
+        logging.info("chaos mesh: armed %d virtual host devices", mesh_n)
+
     engine = ChaosEngine(
         seed=seed,
         ticks=args.ticks,
@@ -297,6 +328,7 @@ def main(argv: list[str] | None = None) -> int:
         ingest_mode=args.ingest_mode,
         trace_obs=args.trace_obs,
         compile_bank=args.compile_bank,
+        mesh_devices=mesh_n,
     )
     try:
         result = engine.run()

@@ -185,6 +185,11 @@ class ChaosResult:
     #: supposed to exercise incremental packs but full-packed every
     #: cycle is visible here, and the pack-mode parity check reads it.
     pack: dict | None = None
+    #: Device-mesh observability: the run's mesh size plus the
+    #: packer's per-device H2D accounting — the mesh-parity check
+    #: reads the device count to prove the dimension actually ran
+    #: sharded while the trace hash stayed put.
+    mesh: dict | None = None
     #: Crash-restart observability (None unless the crash_restart
     #: fault ran): per-restart restore records (pre/post quarantine
     #: states, refusal pins, breaker state, adoption source, wire
@@ -224,6 +229,7 @@ class ChaosResult:
             "failover": self.failover,
             "health": self.health,
             "pack": self.pack,
+            "mesh": self.mesh,
             "restart": self.restart,
             "ingest": self.ingest,
             "trace": self.trace,
@@ -273,6 +279,7 @@ class ChaosEngine:
         ingest_mode: str | None = None,
         trace_obs: str | None = None,
         compile_bank: str | None = None,
+        mesh_devices: int | str | None = None,
     ) -> None:
         self.seed = seed
         self.ticks = ticks
@@ -327,6 +334,22 @@ class ChaosEngine:
         from kube_batch_tpu.client.adapter import resolve_ingest_mode
 
         self.ingest_mode = resolve_ingest_mode(ingest_mode)
+        # The mesh dimension (node-axis sharded pack/solve across N
+        # devices vs the single-device path) must be decision-
+        # invisible exactly like pack mode: the sharded solve is
+        # bit-identical, so the SAME seed must produce the SAME trace
+        # hash at any device count — `make chaos` pins 1 vs 8.  Rides
+        # the meta header (excluded from the hash), adopted on replay
+        # unless overridden.
+        if mesh_devices is None and events is not None:
+            meta = next(
+                (e for e in events if e.get("op") == "meta"), None
+            )
+            if meta is not None:
+                mesh_devices = meta.get("mesh_devices")
+        from kube_batch_tpu.parallel.mesh import resolve_mesh_devices
+
+        self.mesh_devices = resolve_mesh_devices(mesh_devices)
         #: Ingest observability accumulated across every adapter
         #: incarnation (reconnects/restarts replace the adapter).
         self._ingest_stats = {"events": 0, "batches": 0, "coalesced": 0}
@@ -598,7 +621,8 @@ class ChaosEngine:
         )
 
         bank = ArtifactBank(os.path.join(self.state_dir,
-                                         ARTIFACT_DIRNAME))
+                                         ARTIFACT_DIRNAME),
+                            mesh_devices=self.mesh_devices)
         bank.mirror_sink = self._mirror_artifact
         return bank
 
@@ -1117,6 +1141,7 @@ class ChaosEngine:
             self.cache, conf_path=self.conf_path, schedule_period=0.0,
             guardrails=self.guardrails, health=self.health,
             pack_mode=self.pack_mode, compile_bank=self.compile_bank,
+            mesh_devices=self.mesh_devices,
         )
         self.scheduler = scheduler
         self.statestore = self._build_statestore()
@@ -1210,8 +1235,16 @@ class ChaosEngine:
         # churn, so the workload can never legitimately cross into the
         # pinned bucket — the settled ceiling below refuses exactly
         # one program (the grown one) and admits every serving shape
-        # the scenario's task/job churn produces.
-        grow = {"N": int(sched._last_snap.num_nodes) + 1}
+        # the scenario's task/job churn produces.  On an active mesh
+        # the admission ceiling is PER DEVICE and the node axis shards
+        # over `devices`, so the growth must be >= devices x for the
+        # grown program's per-device projection to clear the serving
+        # one — a +1 bump shards away to a SMALLER footprint per
+        # device (the whole point of the mesh) and leaves no gap to
+        # settle a ceiling into.
+        devs = max(1, int(self.mesh_devices))
+        grow = {"N": int(sched._last_snap.num_nodes) * devs + 1
+                if devs > 1 else int(sched._last_snap.num_nodes) + 1}
         if self._pinned_shapes is not None:
             # The probe's strong form re-runs the EXACT pinned growth:
             # warm_grown must answer False from the restored pin with
@@ -1404,6 +1437,7 @@ class ChaosEngine:
                 "wire_commit": self.wire_commit,
                 "pack_mode": self.pack_mode,
                 "ingest_mode": self.ingest_mode,
+                "mesh_devices": self.mesh_devices,
                 **{k: getattr(self.faults, k)
                    for k in _META_FAULT_FIELDS},
             }
@@ -1460,6 +1494,7 @@ class ChaosEngine:
             self.cache, conf_path=self.conf_path, schedule_period=0.0,
             guardrails=self.guardrails, health=self.health,
             pack_mode=self.pack_mode, compile_bank=self.compile_bank,
+            mesh_devices=self.mesh_devices,
         )
         self.scheduler = scheduler
         # Durable operational memory: journal end-of-cycle state and
@@ -1682,6 +1717,7 @@ class ChaosEngine:
             failover=self._failover_summary(),
             health=self._health_summary(),
             pack=self._pack_summary(),
+            mesh=self._mesh_summary(),
             restart=self._restart_summary(),
             ingest=self._ingest_summary(),
             trace=self._trace_summary,
@@ -1700,6 +1736,20 @@ class ChaosEngine:
             "incremental_packs": packer.incremental_packs,
             "row_patched_packs": packer.row_patched_packs,
             "fallback_reasons": dict(packer.fallback_reasons),
+        }
+
+    def _mesh_summary(self) -> dict | None:
+        scheduler = getattr(self, "scheduler", None)
+        if scheduler is None:
+            return None
+        packer = getattr(scheduler, "packer", None)
+        return {
+            "devices": self.mesh_devices,
+            "active": bool(getattr(scheduler.mesh, "active", False)),
+            "last_h2d_bytes_per_device": (
+                getattr(packer, "last_h2d_bytes_per_device", 0)
+                if packer is not None else 0
+            ),
         }
 
     # -- guardrail invariants ------------------------------------------

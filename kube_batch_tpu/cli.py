@@ -139,6 +139,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "the diagnosis/parity escape hatch, see "
                         "doc/design/daemon-operations.md; env "
                         "KB_TPU_PACK_MODE)")
+    p.add_argument("--mesh-devices", type=int, default=None,
+                   help="shard the pack→solve→patch pipeline across a "
+                        "1-D device mesh of N devices (node axis; "
+                        "doc/design/multichip-shard.md).  Default 1 = "
+                        "the exact single-device path; env "
+                        "KB_TPU_MESH_DEVICES.  On a CPU-only host, "
+                        "N>1 arms a virtual device mesh "
+                        "(--xla_force_host_platform_device_count) for "
+                        "shard-layout rehearsal")
     p.add_argument("--ingest-mode", choices=("batched", "event"),
                    default=None,
                    help="watch-ingest strategy: 'batched' (default; "
@@ -452,7 +461,14 @@ def build_compile_bank(args):
                 "then lives next to the statestore journal)"
             )
         return None
-    bank = ArtifactBank(path)
+    from kube_batch_tpu.parallel.mesh import resolve_mesh_devices
+
+    bank = ArtifactBank(
+        path,
+        mesh_devices=resolve_mesh_devices(
+            getattr(args, "mesh_devices", None)
+        ),
+    )
     logging.info("AOT compile-artifact bank: %s (%d entr%s banked)",
                  bank.dir, len(bank.entries()),
                  "y" if len(bank.entries()) == 1 else "ies")
@@ -1004,6 +1020,7 @@ def run_external(args) -> int:
             guardrails=guardrails,
             health=health,
             pack_mode=args.pack_mode,
+            mesh_devices=args.mesh_devices,
         )
         run_state["scheduler"] = scheduler
         # Durable operational memory: adopt journal/peer state BEFORE
@@ -1166,6 +1183,7 @@ def run_http(args) -> int:
             guardrails=guardrails,
             health=health,
             pack_mode=args.pack_mode,
+            mesh_devices=args.mesh_devices,
         )
         run_state["scheduler"] = scheduler
         statestore = build_statestore(args)
@@ -1260,6 +1278,22 @@ def main(argv: list[str] | None = None) -> int:
         format="%(asctime)s %(levelname)s %(name)s: %(message)s",
     )
     honor_jax_platforms()
+
+    # Device-mesh sizing must land BEFORE the first jax backend touch:
+    # a CPU-only host realizes an N>1 mesh as N virtual host devices
+    # (XLA_FLAGS), which XLA reads exactly once at backend init.
+    from kube_batch_tpu.parallel.mesh import (
+        arm_virtual_devices,
+        resolve_mesh_devices,
+    )
+
+    mesh_n = resolve_mesh_devices(args.mesh_devices)
+    if mesh_n > 1 and os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        # Real multi-chip backends bring their own devices; the
+        # virtual mesh (which also pins the cpu platform) is only for
+        # cpu-pinned rehearsal runs.
+        arm_virtual_devices(mesh_n)
+        logging.info("mesh: armed %d virtual host devices", mesh_n)
 
     from kube_batch_tpu.compile_cache import enable_compile_cache
 
@@ -1395,6 +1429,7 @@ def main(argv: list[str] | None = None) -> int:
         schedule_period=args.schedule_period,
         profile_dir=args.profile_dir,
         pack_mode=args.pack_mode,
+        mesh_devices=args.mesh_devices,
         guardrails=guardrails,
         health=health,
     )

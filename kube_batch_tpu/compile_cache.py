@@ -113,7 +113,9 @@ def enable_compile_cache(path: str | None = None) -> str | None:
 # still pays every compile live while the fleet waits.  The bank below
 # is the explicit, shippable form of the same executables: each entry
 # is one `jax.experimental.serialize_executable`-serialized fused-cycle
-# program keyed by (host fingerprint, conf digest, shape key), stored
+# program keyed by (host fingerprint, conf digest, shape key, mesh
+# topology — device count + platform, omitted at 1 device for
+# pre-mesh filename compatibility), stored
 # as one framed file under --state-dir next to the statestore journal
 # and mirrored cluster-side through the statestore's wire pattern
 # (putCompileArtifact / getCompileArtifact), so a successor or a
@@ -159,9 +161,34 @@ def canonical_shapes(shapes) -> tuple:
     )
 
 
-def _entry_name(conf: str, shapes: tuple) -> str:
-    key = json.dumps([conf, [[n, list(s)] for n, s in shapes]],
-                     separators=(",", ":"))
+def mesh_topology(mesh_devices: int = 1) -> dict:
+    """The device-mesh topology axis of an artifact key: a sharded
+    executable is lowered against a FIXED device assignment, and
+    deserializing it on a process with a different device count (or a
+    different platform behind the same host fingerprint, e.g. an
+    8-virtual-CPU mesh vs the real backend) fails at load time at
+    best and silently mismatches shard layouts at worst.  Kept out of
+    host_fingerprint(): two daemons on the SAME host may legitimately
+    run different mesh sizes, and their banks must coexist."""
+    try:
+        import jax
+
+        plat = jax.default_backend()
+    except Exception:  # noqa: BLE001 — never fail key construction
+        plat = os.environ.get("JAX_PLATFORMS", "") or "unknown"
+    return {"devices": int(mesh_devices), "platform": str(plat)}
+
+
+def _entry_name(conf: str, shapes: tuple, mesh: dict | None = None) -> str:
+    key_parts = [conf, [[n, list(s)] for n, s in shapes]]
+    # The single-device key DELIBERATELY omits the mesh component so
+    # every pre-mesh entry (and every entry written by a peer that
+    # predates mesh-aware banking) keeps resolving to the same
+    # filename: mesh_devices=1 stays byte-identical to the old path.
+    if mesh and int(mesh.get("devices", 1)) != 1:
+        key_parts.append({"devices": int(mesh.get("devices", 1)),
+                          "platform": str(mesh.get("platform", ""))})
+    key = json.dumps(key_parts, separators=(",", ":"))
     return hashlib.sha256(key.encode()).hexdigest()[:24] + ARTIFACT_SUFFIX
 
 
@@ -177,9 +204,10 @@ class ArtifactBank:
     never load, never crash.  Writes are atomic (tmp + rename) and
     best-effort — a full disk degrades the bank, never a cycle."""
 
-    def __init__(self, root: str) -> None:
+    def __init__(self, root: str, mesh_devices: int = 1) -> None:
         self.root = root
         self.host = host_fingerprint()
+        self.mesh = mesh_topology(mesh_devices)
         self.dir = os.path.join(root, f"hw-{self.host}")
         #: Optional callable(entry_payload) pushing one freshly-banked
         #: entry out through the wire dialect (the cluster-side
@@ -203,7 +231,7 @@ class ArtifactBank:
         )
 
     def _path(self, conf: str, shapes: tuple) -> str:
-        return os.path.join(self.dir, _entry_name(conf, shapes))
+        return os.path.join(self.dir, _entry_name(conf, shapes, self.mesh))
 
     @staticmethod
     def _serialize_exe(exe) -> bytes | None:
@@ -264,6 +292,7 @@ class ArtifactBank:
             "host": self.host,
             "conf": str(conf),
             "shapes": [[n, list(s)] for n, s in shapes],
+            "mesh": dict(self.mesh),
             "size": len(blob),
             "crc": zlib.crc32(blob) & 0xFFFFFFFF,
         }
@@ -395,6 +424,29 @@ class ArtifactBank:
             # hazard host_fingerprint() exists to fence.
             self._reject("host", f"{where}: {header.get('host')} != "
                                  f"{self.host}")
+            return None
+        # Mesh topology gate: a sharded executable carries its device
+        # assignment — adopting it onto a peer with a different device
+        # count (or platform) would fail the device load or silently
+        # mis-shard.  Entries written before mesh-aware banking carry
+        # no "mesh" field and validate as single-device.
+        have_mesh = header.get("mesh")
+        if not isinstance(have_mesh, dict):
+            have_mesh = {"devices": 1, "platform": self.mesh["platform"]}
+        try:
+            have_devices = int(have_mesh.get("devices", 1))
+        except (TypeError, ValueError):
+            self._reject("mesh", f"{where}: unreadable mesh topology")
+            return None
+        if (have_devices != self.mesh["devices"]
+                or str(have_mesh.get("platform", self.mesh["platform"]))
+                != self.mesh["platform"]):
+            self._reject(
+                "mesh",
+                f"{where}: entry mesh {have_devices}dev/"
+                f"{have_mesh.get('platform')} != local "
+                f"{self.mesh['devices']}dev/{self.mesh['platform']}",
+            )
             return None
         if conf is not None and str(header.get("conf")) != str(conf):
             self._reject("key", f"{where}: conf digest mismatch")
@@ -594,7 +646,9 @@ def adopt_artifacts(bank: ArtifactBank | None, backend=None) -> int:
             shapes = canonical_shapes(
                 (n, s) for n, s in header.get("shapes", ())
             )
-            name = _entry_name(str(header.get("conf")), shapes)
+            mesh = header.get("mesh")
+            name = _entry_name(str(header.get("conf")), shapes,
+                               mesh if isinstance(mesh, dict) else None)
         except (TypeError, ValueError):
             fresh.append(p)
             continue

@@ -39,7 +39,14 @@ def projected_device_bytes(exe) -> int | None:
     buffer assignment: peak when the backend reports it, else the
     argument+output+temp sum (the same fallback bench.py's
     ``peak_hbm_mb`` uses).  None when the executable exposes no
-    analysis (non-XLA fakes in tests)."""
+    analysis (non-XLA fakes in tests).
+
+    PER DEVICE by construction: ``memory_analysis()`` reports
+    per-partition figures for an SPMD-sharded executable, so under
+    ``--mesh-devices N`` (doc/design/multichip-shard.md) the ceiling
+    compares each device's share — a world the single-device ceiling
+    refuses can legitimately admit sharded, which is the mesh's whole
+    point."""
     try:
         ma = exe.memory_analysis()
         peak = getattr(ma, "peak_memory_in_bytes", 0) or (

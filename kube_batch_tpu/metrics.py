@@ -568,6 +568,7 @@ _health_quarantined = 0
 _health_ingest_lag = 0.0
 _health_cell = ""
 _health_cell_peer_visible: bool | None = None
+_health_mesh_devices = 1
 #: Per-SCOPE health registry (multi-scheduler-per-process): a live
 #: scheduler driven under a bound scope (kube_batch_tpu/scope.py —
 #: the cell name) publishes here instead of stomping the process-
@@ -676,6 +677,16 @@ def set_cell(name: str) -> None:
     global _health_cell
     with _health_lock:
         _health_cell = str(name or "")
+
+
+def set_mesh_devices(devices: int) -> None:
+    """Publish the scheduler's device-mesh size to /healthz (1 =
+    single-device; doc/design/multichip-shard.md) — a probe triaging
+    a capacity page reads how many devices the solve shards over
+    without scraping /metrics."""
+    global _health_mesh_devices
+    with _health_lock:
+        _health_mesh_devices = int(devices)
 
 
 def set_cell_peer_visible(visible: bool | None,
@@ -789,6 +800,9 @@ def health_body() -> bytes:
             # degraded, peer still visible).
             "cell": _health_cell,
             "cell_peer_visible": _health_cell_peer_visible,
+            # Device-mesh size (doc/design/multichip-shard.md): how
+            # many devices the solve shards over (1 = single-device).
+            "mesh_devices": _health_mesh_devices,
         }
         if _health_scopes:
             body["cells"] = {

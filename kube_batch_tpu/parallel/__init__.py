@@ -10,8 +10,12 @@ scoring and conflict resolution ride ICI collectives emitted by XLA
 
 from kube_batch_tpu.parallel.mesh import (  # noqa: F401
     DCN_AXIS,
+    MESH_DEVICES_ENV,
     NODE_AXIS,
+    MeshContext,
+    arm_virtual_devices,
     make_mesh,
     make_multislice_mesh,
+    resolve_mesh_devices,
     shard_cycle_inputs,
 )

@@ -23,6 +23,180 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 NODE_AXIS = "node"
 
+#: Env fallback for the production mesh knob (CLI --mesh-devices).
+MESH_DEVICES_ENV = "KB_TPU_MESH_DEVICES"
+
+_FORCE_DEVICES_RE = r"--xla_force_host_platform_device_count=\d+"
+
+
+def resolve_mesh_devices(value: int | str | None = None) -> int:
+    """The production mesh size: explicit value > KB_TPU_MESH_DEVICES >
+    1 (today's single-device path).  Raises ValueError on anything
+    below 1 — a zero-device mesh is a config typo, not a request."""
+    import os
+
+    if value is None:
+        raw = os.environ.get(MESH_DEVICES_ENV, "").strip()
+        value = raw or 1
+    n = int(value)
+    if n < 1:
+        raise ValueError(f"mesh devices must be >= 1, got {n}")
+    return n
+
+
+def arm_virtual_devices(n: int) -> None:
+    """Arm an n-device virtual CPU platform (XLA_FLAGS host-platform
+    device count + the CPU platform pin).  Must run BEFORE the first
+    CPU backend initialization to take effect — XLA reads the flag
+    once; callers that may already have touched the backend should
+    re-exec or subprocess instead (scripts/check_shard_bench.py).
+    Replace-don't-append: a stale count in an inherited XLA_FLAGS
+    would silently win over the appended one."""
+    import os
+    import re
+
+    flag = f"--xla_force_host_platform_device_count={int(n)}"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if re.search(_FORCE_DEVICES_RE, flags):
+        flags = re.sub(_FORCE_DEVICES_RE, flag, flags)
+    else:
+        flags = f"{flags} {flag}".strip()
+    os.environ["XLA_FLAGS"] = flags
+    try:
+        # The env-var platform pin loses to an earlier programmatic
+        # pin (the image's sitecustomize); the config update wins.
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:  # noqa: BLE001 — backend already initialized;
+        pass           # make_mesh raises its own actionable error
+
+
+class MeshContext:
+    """The production scheduler's mesh knob, resolved once per
+    Scheduler (doc/design/multichip-shard.md).
+
+    ``devices == 1`` is today's exact single-device path: ``place`` is
+    a plain ``jax.device_put``, ``scan_scope`` a no-op, and no sharding
+    metadata reaches any traced program — byte-identical HLO, so
+    persistent-cache entries and banked artifacts from before the knob
+    keep hitting.  ``devices > 1`` builds the 1-D node mesh: node-major
+    arrays (``node_*`` with a leading padded-node dim) get
+    ``NamedSharding(P('node'))``, everything else replicates, with the
+    same loud full-replication fallback as ``shard_cycle_inputs`` when
+    the padded node count doesn't divide the mesh (rare: both are
+    powers of two)."""
+
+    def __init__(self, devices: int | str | None = None) -> None:
+        self.devices = resolve_mesh_devices(devices)
+        self.mesh: Mesh | None = (
+            make_mesh(self.devices) if self.devices > 1 else None
+        )
+        self._warned_ragged: set[int] = set()
+
+    @property
+    def active(self) -> bool:
+        return self.mesh is not None
+
+    def _node_ok(self, num_nodes: int) -> bool:
+        """Divisibility gate, warning ONCE per offending node count."""
+        if num_nodes % self.devices == 0:
+            return True
+        if num_nodes not in self._warned_ragged:
+            self._warned_ragged.add(num_nodes)
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "padded node count %d not divisible by %d mesh devices;"
+                " falling back to FULL REPLICATION — no node-axis "
+                "parallelism", num_nodes, self.devices,
+            )
+        return False
+
+    def node_sharded(self, name: str, value: Any, num_nodes: int) -> bool:
+        """Does this field shard over the node axis?  (Name-prefixed,
+        like shard_cycle_inputs: task_req is [T, R] and T can collide
+        with N on tiny square worlds.)"""
+        return (
+            self.active
+            and name.startswith("node_")
+            and getattr(value, "ndim", 0) >= 1
+            and value.shape[0] == num_nodes
+            and self._node_ok(num_nodes)
+        )
+
+    def sharding_for(self, name: str, value: Any, num_nodes: int):
+        """The NamedSharding one snapshot/state field gets, or None
+        when the mesh is inert (devices == 1: caller must not attach
+        ANY sharding — today's path stays byte-identical)."""
+        if not self.active:
+            return None
+        want_node = self.node_sharded(name, value, num_nodes)
+        return NamedSharding(
+            self.mesh, P(NODE_AXIS) if want_node else P()
+        )
+
+    def place_arrays(self, arrays: dict, num_nodes: int) -> dict:
+        """ONE batched H2D for a packed snapshot's field dict — the
+        mesh-aware replacement for ``jax.device_put(arrays)``: node-
+        major fields land sharded over the node axis, the rest
+        replicate."""
+        if not self.active:
+            return jax.device_put(arrays)
+        shardings = {
+            k: self.sharding_for(k, v, num_nodes)
+            for k, v in arrays.items()
+        }
+        return jax.device_put(arrays, shardings)
+
+    def place_fields(self, obj: Any, num_nodes: int) -> Any:
+        """device_put every array field of a dataclass pytree with this
+        mesh's shardings (node-major fields shard, the rest replicate).
+        Inert mesh: returned unchanged — numpy fields keep riding the
+        jitted call's own argument transfer, today's exact path."""
+        if not self.active:
+            return obj
+        updates = {}
+        for f in dataclasses.fields(obj):
+            v = getattr(obj, f.name)
+            if not hasattr(v, "shape"):
+                continue
+            updates[f.name] = jax.device_put(
+                v, self.sharding_for(f.name, v, num_nodes)
+            )
+        return dataclasses.replace(obj, **updates)
+
+    def shard_avals(self, obj: Any, num_nodes: int) -> Any:
+        """Attach this mesh's shardings to a ShapeDtypeStruct pytree
+        (the growth prewarm's lock-free AOT inputs, packer.grown_avals)
+        so ``.lower()`` produces the same SPMD program the live sharded
+        snapshot would.  Inert mesh: returned unchanged."""
+        if not self.active:
+            return obj
+        updates = {}
+        for f in dataclasses.fields(obj):
+            v = getattr(obj, f.name)
+            if not hasattr(v, "shape"):
+                continue
+            updates[f.name] = jax.ShapeDtypeStruct(
+                v.shape, v.dtype,
+                sharding=self.sharding_for(f.name, v, num_nodes),
+            )
+        return dataclasses.replace(obj, **updates)
+
+    def scan_scope(self):
+        """The tracing scope every ``.lower()`` of a cycle program must
+        run under: sharded traces need the blocked node-axis prefix sum
+        (ops/assignment.py · shard_local_scan — XLA cannot partition a
+        scan along the scanned axis); single-chip traces MUST keep the
+        plain cumsum whose flagship compile time is the measured-fast
+        program and whose persistent-cache entries must keep hitting."""
+        if not self.active:
+            import contextlib
+
+            return contextlib.nullcontext()
+        from kube_batch_tpu.ops.assignment import shard_local_scan
+
+        return shard_local_scan()
+
 
 def make_mesh(n_devices: int | None = None, axis: str = NODE_AXIS) -> Mesh:
     """A 1-D device mesh over the node axis (ICI within a slice)."""

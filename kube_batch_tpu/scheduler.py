@@ -63,6 +63,7 @@ class Scheduler:
         statestore=None,
         compile_bank=None,
         compile_budget_s: float | None = None,
+        mesh_devices: int | str | None = None,
     ) -> None:
         self.cache = cache
         self.conf_path = conf_path
@@ -97,7 +98,24 @@ class Scheduler:
 
         import os as _os
 
-        self.packer = IncrementalPacker(cache)
+        # Device-mesh scale-out (doc/design/multichip-shard.md): the
+        # `--mesh-devices` / KB_TPU_MESH_DEVICES knob shards the whole
+        # pack→solve→patch pipeline over a 1-D node-axis mesh — node-
+        # major snapshot arrays land PartitionSpec('node'), the fused
+        # cycle compiles SPMD with the heavy [T, N] products shard-
+        # local, and row patches scatter into the owning shard.  The
+        # default (1) is today's exact single-device path: an inert
+        # MeshContext attaches no sharding metadata anywhere, so the
+        # traced programs — and their persistent-cache and artifact-
+        # bank entries — stay byte-identical.  The mesh is a LAYOUT
+        # choice, never a semantics choice: same-seed chaos hashes are
+        # pinned identical across device counts (`make chaos`).
+        from kube_batch_tpu.parallel.mesh import MeshContext
+
+        self.mesh = MeshContext(mesh_devices)
+        self.mesh_devices = self.mesh.devices
+        metrics.set_mesh_devices(self.mesh_devices)
+        self.packer = IncrementalPacker(cache, mesh=self.mesh)
         mode = pack_mode or _os.environ.get(
             "KB_TPU_PACK_MODE", "incremental"
         )
@@ -376,6 +394,7 @@ class Scheduler:
         new_digest = conf_digest(built["conf"], self._compact_wire)
         req_cycle = trace.current_cycle()
         bank = self.compile_bank
+        mesh = self.mesh
 
         def warm() -> None:
             try:
@@ -398,7 +417,8 @@ class Scheduler:
                     )
                     key = Scheduler._shape_key(cycle, snap)
                     with trace.span("compile", cycle=req_cycle,
-                                    where="conf-prewarm"):
+                                    where="conf-prewarm"), \
+                            mesh.scan_scope():
                         exe = cycle.lower(snap, state).compile()
                     metrics.compile_background_total.inc()
                     if bank is not None:
@@ -650,7 +670,8 @@ class Scheduler:
         try:
             started = time.monotonic()
             with trace.span("compile", cycle=req_cycle,
-                            where="noblock-deferred"):
+                            where="noblock-deferred"), \
+                    self.mesh.scan_scope():
                 exe = cycle.lower(snap, state).compile()
             if self._cycle is not cycle:
                 return  # conf swapped mid-compile: discard
@@ -841,7 +862,8 @@ class Scheduler:
                     "compile-start", where="inline",
                     tasks=int(snap.num_tasks), nodes=int(snap.num_nodes),
                 )
-                with trace.span("compile", where="inline"):
+                with trace.span("compile", where="inline"), \
+                        self.mesh.scan_scope():
                     exe = self._cycle.lower(snap, state).compile()
                 took = time.monotonic() - started
                 self.compile_stats["inline"] += 1
@@ -1118,12 +1140,20 @@ class Scheduler:
                     "compile-start", where="growth-prewarm",
                     cycle=req_cycle, label=str(label),
                 )
+                # Grown ShapeDtypeStruct avals carry no placement; on
+                # an active mesh, re-attach the node-axis shardings so
+                # the AOT program matches what the live sharded
+                # snapshot will call (inert mesh: both no-ops).
+                g_nodes = int(gsnap.node_cap.shape[0])
+                gsnap_l = self.mesh.shard_avals(gsnap, g_nodes)
+                gstate_l = self.mesh.shard_avals(
+                    jax.eval_shape(init_state, gsnap), g_nodes
+                )
                 with trace.span("compile", cycle=req_cycle,
                                 where="growth-prewarm",
-                                label=str(label)):
-                    exe = cycle.lower(
-                        gsnap, jax.eval_shape(init_state, gsnap)
-                    ).compile()
+                                label=str(label)), \
+                        self.mesh.scan_scope():
+                    exe = cycle.lower(gsnap_l, gstate_l).compile()
                 metrics.compile_background_total.inc()
                 # The conf may have hot-swapped mid-warm; only publish
                 # into the policy this warm started under.
@@ -1199,7 +1229,14 @@ class Scheduler:
             # the verdict.  This is the refused-bucket-never-
             # recompiled contract a warm restart must keep.
             return False
-        exe = cycle.lower(gsnap, jax.eval_shape(init_state, gsnap)).compile()
+        g_nodes = int(gsnap.node_cap.shape[0])
+        with self.mesh.scan_scope():
+            exe = cycle.lower(
+                self.mesh.shard_avals(gsnap, g_nodes),
+                self.mesh.shard_avals(
+                    jax.eval_shape(init_state, gsnap), g_nodes
+                ),
+            ).compile()
         if self._admit_growth(key, exe, label=grow):
             self._compiled_shapes[key] = exe
             self._bank_put(key, exe)
@@ -1406,7 +1443,8 @@ class Scheduler:
 
         from kube_batch_tpu.actions.preempt import commit_victim_indices
 
-        with metrics.action_latency.time("fused"), trace.span("solve"):
+        with metrics.action_latency.time("fused"), \
+                trace.span("solve", mesh_devices=self.mesh_devices):
             with metrics.cycle_phase_latency.time("dispatch"):
                 state, evict_payload, job_ready, diag = exe(snap, state)
             ssn.state = state

@@ -38,3 +38,35 @@ def check_ingest_parity(batched_run: dict, path_event: str | None,
         f"the parity check is vacuous: {batched}"
     )
     return " (and under --ingest-mode event)"
+
+
+def check_mesh_parity(base_run: dict, path_mesh: str | None,
+                      what: str) -> str:
+    """Assert the --mesh-devices 8 run at `path_mesh` reproduces
+    `base_run`'s hash (the node-axis sharded pack/solve is decision-
+    invisible: device state is bit-identical at any device count,
+    doc/design/multichip-shard.md) and that the mesh run actually ran
+    sharded — a run that silently fell back to one device proves
+    nothing.  Returns an ok-line suffix; empty when no mesh-run file
+    was supplied."""
+    if path_mesh is None:
+        return ""
+    with open(path_mesh, encoding="utf-8") as f:
+        m = json.load(f)
+    assert m["ok"], f"{what} mesh run violations: {m['violations']}"
+    mesh = m.get("mesh") or {}
+    assert mesh.get("devices", 1) > 1 and mesh.get("active"), (
+        f"{what}: the mesh run never built an active mesh — the "
+        f"parity check is vacuous: {mesh}"
+    )
+    assert m["trace_hash"] == base_run["trace_hash"], (
+        f"{what}: --mesh-devices {mesh.get('devices')} diverged from "
+        f"single-device at the same seed: {m['trace_hash']} != "
+        f"{base_run['trace_hash']}"
+    )
+    base_mesh = base_run.get("mesh") or {}
+    assert not base_mesh.get("active", False), (
+        f"{what}: the baseline run was itself sharded — the parity "
+        f"check compares a mesh against itself: {base_mesh}"
+    )
+    return f" (and at --mesh-devices {mesh.get('devices')})"

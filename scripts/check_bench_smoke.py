@@ -18,7 +18,7 @@ def main() -> int:
 
     for key in ("first_cycle_ms", "e2e_cycle_ms_p50", "commit_pipeline",
                 "ingest_compare", "trace_overhead", "compile_artifacts",
-                "cells_aggregate", "slo"):
+                "cells_aggregate", "slo", "shard"):
         assert key in artifact, (
             f"artifact missing {key!r}; keys: {sorted(artifact)}"
         )
@@ -59,6 +59,17 @@ def main() -> int:
     assert art.get("speedup", 0) > 0, art
     assert art.get("output_mismatches", 1) == 0, art
 
+    # Presence + sanity only: the <=0.2x per-device-peak / 4x-scale
+    # gates live in scripts/check_shard_bench.py (make verify); the
+    # smoke pins that every artifact RECORDS the sharded-tier figures
+    # and that the sharded solve stayed bit-identical.
+    shard = artifact["shard"]
+    assert "error" not in shard, shard
+    assert shard.get("devices", 0) > 1, shard
+    assert shard.get("parity_mismatches", 1) == 0, shard
+    assert shard.get("boundary_refused_1dev") is True, shard
+    assert shard.get("big_admitted_8dev") is True, shard
+
     ing = artifact["ingest_compare"]
     assert "error" not in ing, ing
     # Presence + sanity only: the >=3x/>=2x speed gates live in
@@ -90,7 +101,9 @@ def main() -> int:
         f"aggregate {ca.get('aggregate_pods_per_s')} pods/s vs "
         f"single {ca.get('single_pods_per_s')} "
         f"({ca.get('scaling')}x), slo+stitching "
-        f"{slo.get('overhead_pct')}% overhead"
+        f"{slo.get('overhead_pct')}% overhead, sharded tier "
+        f"{shard.get('devices')}-device peak ratio "
+        f"{shard.get('peak_ratio')}"
     )
     return 0
 

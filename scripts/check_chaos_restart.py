@@ -27,10 +27,11 @@ import json
 import sys
 
 
-from chaos_parity import check_ingest_parity
+from chaos_parity import check_ingest_parity, check_mesh_parity
 
 
-def main(path_a: str, path_b: str, path_event: str | None = None) -> int:
+def main(path_a: str, path_b: str, path_event: str | None = None,
+         path_mesh: str | None = None) -> int:
     with open(path_a, encoding="utf-8") as f:
         a = json.load(f)
     with open(path_b, encoding="utf-8") as f:
@@ -89,10 +90,11 @@ def main(path_a: str, path_b: str, path_event: str | None = None) -> int:
         f"{a['trace_hash']} != {b['trace_hash']}"
     )
     parity = check_ingest_parity(a, path_event, "restart")
+    mesh_parity = check_mesh_parity(a, path_mesh, "restart")
     r = a["restart"]
     print(
         "chaos restart: ok — same-seed hash "
-        f"{a['trace_hash'][:16]}… reproduced" + parity +
+        f"{a['trace_hash'][:16]}… reproduced" + parity + mesh_parity +
         f"; {r['restarts']} "
         f"restart(s), {len([s for s in r['sequence'] if s['pre_cordoned']])} "
         f"mid-quarantine (0 cordoned placements), pin survived "
@@ -105,4 +107,5 @@ def main(path_a: str, path_b: str, path_event: str | None = None) -> int:
 
 if __name__ == "__main__":
     sys.exit(main(sys.argv[1], sys.argv[2],
-                  sys.argv[3] if len(sys.argv) > 3 else None))
+                  sys.argv[3] if len(sys.argv) > 3 else None,
+                  sys.argv[4] if len(sys.argv) > 4 else None))

@@ -1,0 +1,216 @@
+#!/usr/bin/env python
+"""make verify's device-mesh sharding gate (virtual 8-CPU mesh).
+
+The multichip claim (doc/design/multichip-shard.md) is that sharding
+the pack→solve→patch pipeline over the node axis lets the fleet
+schedule worlds a single device's HBM refuses, without changing one
+scheduling decision.  This gate measures exactly that, end to end:
+
+* **refusal boundary** — the fused cycle compiled for the BOUNDARY
+  world on ONE device defines an HBM ceiling that refuses it
+  (guardrails/hbm.py admission, the production gate);
+* **scale-out** — a world with >= 4x the boundary's [T, N] elements,
+  compiled node-sharded over 8 devices, must ADMIT under that same
+  per-device ceiling, and one full solve step must execute;
+* **per-device peak** — the sharded executable's per-partition
+  footprint (argument + output + temp, `memory_analysis()`) must be
+  <= 0.2x the single-device footprint of the SAME world;
+* **bit-identity** — the sharded solve's output state must equal the
+  single-device solve's bit for bit (the mesh is a layout, never a
+  decision input), with the shard-local-HLO guard from the old
+  multichip dryrun (no all-gather may materialize a full [T, N]
+  matrix per device).
+
+Compile ORDER is load-bearing: the sharded programs compile FIRST.
+Tracing the single-device twin first commits its constants to one
+device, and the later sharded trace then inherits pessimized layouts
+(measured: per-device temp 2.1x larger) — production never interleaves
+the two, so the gate must not either.
+
+`--json [--smoke]` is bench.py's mode: one measurement as a JSON line,
+no gate (the bench artifact's `shard` section; --smoke shrinks the
+worlds so the bench tier stays minutes-bounded).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+# Runnable as `python scripts/check_shard_bench.py` from the repo root.
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+DEVICES = 8
+#: Per-device peak must be <= this fraction of the 1-device peak.
+PEAK_RATIO_GATE = 0.2
+#: The big world must hold >= this many times the boundary's [T, N]
+#: elements (the acceptance criterion's "4x the refusal boundary").
+SCALE_FACTOR = 4
+
+#: (nodes, tasks) per measurement.  Boundary defines the single-device
+#: refusal ceiling; big is 4x its elements; parity is the bit-identity
+#: world (executed on BOTH device counts, so it stays small).
+FULL_SHAPES = {
+    "parity": (1024, 2048),
+    "boundary": (2048, 4096),
+    "big": (4096, 8192),
+}
+SMOKE_SHAPES = {
+    "parity": (512, 1024),
+    "boundary": (1024, 2048),
+    "big": (2048, 4096),
+}
+
+
+def measure_shard(shapes: dict | None = None) -> dict:
+    """One full sharded-vs-single-device measurement; returns the
+    result dict the gate (and bench.py's artifact) reads.  Requires
+    >= DEVICES jax devices — the __main__ block arms the virtual CPU
+    mesh before any jax import; in-process callers must already be
+    armed."""
+    import jax
+    import numpy as np
+
+    import __graft_entry__ as g
+    from kube_batch_tpu.guardrails.hbm import (
+        HbmCeiling,
+        projected_device_bytes,
+    )
+    from kube_batch_tpu.ops.assignment import shard_local_scan
+    from kube_batch_tpu.parallel import make_mesh, shard_cycle_inputs
+    from kube_batch_tpu.parallel.mesh import NODE_AXIS
+
+    shapes = shapes or FULL_SHAPES
+    if len(jax.devices()) < DEVICES:
+        return {"error": f"need {DEVICES} devices, have "
+                         f"{len(jax.devices())} (arm XLA_FLAGS="
+                         f"--xla_force_host_platform_device_count="
+                         f"{DEVICES} before jax initializes)"}
+    (pn, pt) = shapes["parity"]
+    (bn, bt) = shapes["boundary"]
+    (gn, gt) = shapes["big"]
+    assert gn * gt >= SCALE_FACTOR * bn * bt, (
+        "big world does not scale the boundary by "
+        f">={SCALE_FACTOR}x: {gn}x{gt} vs {bn}x{bt}"
+    )
+    mesh = make_mesh(DEVICES)
+
+    def _assert_sharded(name, arr):
+        spec = getattr(arr.sharding, "spec", None)
+        assert spec is not None and NODE_AXIS in tuple(spec), (
+            f"{name} is NOT node-sharded (sharding={arr.sharding}) — "
+            "replication fallback"
+        )
+
+    # -- sharded programs FIRST (see module docstring) ------------------
+    policy_p, snap_p, state_p = g._build_world(n_nodes=pn, n_tasks=pt)
+    fn_p = g._pipeline_fn(policy_p)
+    snap_ps, state_ps = shard_cycle_inputs(snap_p, state_p, mesh)
+    with shard_local_scan():
+        exe8_parity = jax.jit(fn_p).lower(snap_ps, state_ps).compile()
+    g._assert_shard_local_hlo(exe8_parity.as_text(), pt, pn)
+    out8 = jax.block_until_ready(exe8_parity(snap_ps, state_ps))
+    _assert_sharded("out.node_future", out8.node_future)
+
+    policy_g, snap_g, state_g = g._build_world(n_nodes=gn, n_tasks=gt)
+    fn_g = g._pipeline_fn(policy_g)
+    snap_gs, state_gs = shard_cycle_inputs(snap_g, state_g, mesh)
+    for field in ("node_cap", "node_idle", "node_releasing"):
+        _assert_sharded(f"big.{field}", getattr(snap_gs, field))
+    with shard_local_scan():
+        exe8_big = jax.jit(fn_g).lower(snap_gs, state_gs).compile()
+    g._assert_shard_local_hlo(exe8_big.as_text(), gt, gn)
+    peak8_big = g._peak_mb(exe8_big)
+    # "Packs and SOLVES": one full fused cycle over the big world.
+    out_big = jax.block_until_ready(exe8_big(snap_gs, state_gs))
+    placed_big = int(np.sum(
+        np.asarray(out_big.task_state) != np.asarray(state_g.task_state)
+    ))
+
+    # -- single-device twins -------------------------------------------
+    policy_b, snap_b, state_b = g._build_world(n_nodes=bn, n_tasks=bt)
+    exe1_boundary = jax.jit(
+        g._pipeline_fn(policy_b)).lower(snap_b, state_b).compile()
+    boundary_bytes = projected_device_bytes(exe1_boundary)
+    # The ceiling a single device cannot fit the boundary world under:
+    # every world at or beyond (bn, bt) REFUSES on one device.
+    ceiling = HbmCeiling(ceiling_bytes=boundary_bytes - 1)
+    refused, _ = ceiling.admit(exe1_boundary, label="boundary-1dev")
+    big_admitted, big_bytes = ceiling.admit(exe8_big, label="big-8dev")
+
+    exe1_big = jax.jit(fn_g).lower(snap_g, state_g).compile()
+    peak1_big = g._peak_mb(exe1_big)
+
+    exe1_parity = jax.jit(fn_p).lower(snap_p, state_p).compile()
+    out1 = jax.block_until_ready(exe1_parity(snap_p, state_p))
+    mismatches = sum(
+        0 if np.array_equal(np.asarray(a), np.asarray(b)) else 1
+        for a, b in zip(jax.tree_util.tree_leaves(out1),
+                        jax.tree_util.tree_leaves(out8))
+    )
+
+    return {
+        "devices": DEVICES,
+        "parity_world": f"{pt}x{pn}",
+        "boundary_world": f"{bt}x{bn}",
+        "big_world": f"{gt}x{gn}",
+        "scale_factor": round((gn * gt) / (bn * bt), 1),
+        "boundary_1dev_mb": round(boundary_bytes / 1e6, 1),
+        "boundary_refused_1dev": not refused,
+        "big_admitted_8dev": bool(big_admitted),
+        "big_per_device_mb": round(big_bytes / 1e6, 1),
+        "peak_mb_1dev": round(peak1_big, 1),
+        "peak_mb_per_device_8dev": round(peak8_big, 1),
+        "peak_ratio": round(peak8_big / peak1_big, 3),
+        "solved_big_transitions": placed_big,
+        "parity_mismatches": mismatches,
+    }
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if "--json" in argv:
+        import json
+
+        shapes = SMOKE_SHAPES if "--smoke" in argv else FULL_SHAPES
+        print(json.dumps(measure_shard(shapes)))
+        return 0
+    result = measure_shard()
+    ok = (
+        "error" not in result
+        and result["boundary_refused_1dev"]
+        and result["big_admitted_8dev"]
+        and result["scale_factor"] >= SCALE_FACTOR
+        and result["peak_ratio"] <= PEAK_RATIO_GATE
+        and result["solved_big_transitions"] > 0
+        and result["parity_mismatches"] == 0
+    )
+    if ok:
+        print(
+            "shard bench: ok — "
+            f"{result['big_world']} ({result['scale_factor']}x the "
+            f"1-device refusal boundary {result['boundary_world']}) "
+            f"packed and solved over {result['devices']} devices at "
+            f"{result['big_per_device_mb']} MB/device (admitted under "
+            f"the {result['boundary_1dev_mb']} MB ceiling that refuses "
+            f"1 device); per-device peak "
+            f"{result['peak_mb_per_device_8dev']} MB = "
+            f"{result['peak_ratio']}x of 1-device "
+            f"{result['peak_mb_1dev']} MB (gate <={PEAK_RATIO_GATE}); "
+            "sharded solve bit-identical"
+        )
+        return 0
+    print(f"shard bench: FAIL — {result}", file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    # Both pins must land before any jax import: the virtual host
+    # devices are read once at CPU backend init, and the sitecustomize
+    # platform pin loses to arm_virtual_devices' config update.
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from kube_batch_tpu.parallel.mesh import arm_virtual_devices
+
+    arm_virtual_devices(DEVICES)
+    sys.exit(main())

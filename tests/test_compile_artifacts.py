@@ -591,3 +591,71 @@ def test_warm_one_banks_into_artifact_dir(tmp_path, monkeypatch):
     bank = ArtifactBank(str(tmp_path / "bank"))
     assert len(bank.entries()) == 1
     assert out["artifacts_dir"] == bank.dir
+
+
+# -- mesh-topology keying (doc/design/multichip-shard.md) ---------------
+
+def test_mesh_topology_mismatch_refuses(banked_world, tmp_path):
+    """An entry claiming a different mesh topology is refused with a
+    counted `mesh` rejection — adopting an executable partitioned for
+    a different device count would mis-shard every input."""
+    root, digest, shapes, _s, _b = banked_world
+    bank = ArtifactBank(_copy_bank(root, str(tmp_path)))
+    _rewrite_header(
+        _entry_path(bank),
+        mesh={"devices": 8, "platform": bank.mesh["platform"]},
+    )
+    before = metrics.compile_artifact_rejected.value("mesh")
+    assert bank.get(digest, shapes) is None
+    assert bank.rejects == {"mesh": 1}
+    assert metrics.compile_artifact_rejected.value("mesh") == before + 1
+
+
+def test_premesh_entry_validates_as_single_device(banked_world,
+                                                  tmp_path):
+    """Back-compat: an entry written BEFORE mesh-aware banking (no
+    `mesh` header field) keeps loading on a single-device bank — the
+    knob's devices=1 default must not orphan an existing fleet bank."""
+    root, digest, shapes, _s, _b = banked_world
+    bank = ArtifactBank(_copy_bank(root, str(tmp_path)))
+    path = _entry_path(bank)
+    with open(path, "rb") as f:
+        raw = f.read()
+    nl = raw.find(b"\n")
+    header = json.loads(raw[:nl])
+    header.pop("mesh", None)
+    with open(path, "wb") as f:
+        f.write(json.dumps(header, sort_keys=True).encode())
+        f.write(b"\n")
+        f.write(raw[nl + 1:])
+    assert bank.get(digest, shapes) is not None
+    assert bank.rejects == {}
+
+
+def test_mesh_entry_names_disjoint_but_single_device_unchanged():
+    """The 8-device key gets its own filename (banks for different
+    mesh sizes coexist in one dir) while the devices=1 filename stays
+    byte-identical to the pre-mesh scheme (old entries keep hitting)."""
+    from kube_batch_tpu.compile_cache import _entry_name
+
+    shapes = canonical_shapes([("a", (2, 3))])
+    legacy = _entry_name("d" * 16, shapes)
+    explicit_one = _entry_name(
+        "d" * 16, shapes, {"devices": 1, "platform": "cpu"})
+    eight = _entry_name(
+        "d" * 16, shapes, {"devices": 8, "platform": "cpu"})
+    assert legacy == explicit_one
+    assert eight != legacy
+
+
+def test_bank_header_records_local_mesh(tmp_path):
+    """A mesh-armed bank stamps its topology into every header it
+    writes, and a differently-sized bank refuses to look where that
+    entry lives (different filename) — no cross-topology adoption."""
+    one = ArtifactBank(str(tmp_path))
+    eight = ArtifactBank(str(tmp_path), mesh_devices=8)
+    assert one.mesh["devices"] == 1
+    assert eight.mesh["devices"] == 8
+    assert one.mesh["platform"] == eight.mesh["platform"]
+    shapes = canonical_shapes([("a", (2, 3))])
+    assert one._path("e" * 16, shapes) != eight._path("e" * 16, shapes)

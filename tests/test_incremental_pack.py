@@ -792,18 +792,35 @@ def test_append_pod_with_interned_topo_and_claims():
     assert_pack_equivalent(packer, cache)
 
 
-def test_journal_fuzz_200_mutations_geo_world():
+@pytest.mark.parametrize("mesh_devices", [1, 8])
+def test_journal_fuzz_200_mutations_geo_world(mesh_devices):
     """The seeded 200-step journal fuzz: mixed add/delete/status/node/
     topology mutations against the geometry-bearing world; after EVERY
     pack the device state must be bit-identical to the patched host
     arrays AND decode-identical to a from-scratch full pack — the
     row-patched upload and the previously cliff'd topo/volume columns
-    included."""
+    included.  The mesh_devices=8 leg runs the SAME journal with the
+    production pack path sharded over the virtual 8-CPU mesh
+    (doc/design/multichip-shard.md): every per-shard scatter must
+    land in the right partition (check=True routes each pack through
+    verify_sharded_view) and the decoded cluster facts must be
+    identical to the single-device leg's full-pack oracle."""
+    from kube_batch_tpu.parallel import MeshContext
+
     rng = random.Random(20260804)
     cache, sim = _build_geo_world()
-    packer = IncrementalPacker(cache)
+    mesh = MeshContext(mesh_devices)
+    assert mesh.active == (mesh_devices > 1)
+    packer = IncrementalPacker(cache, mesh=mesh)
     packer.check = True  # verify_against_live every pack
     packer.pack()
+    if mesh.active:
+        # Non-vacuous: the geo world's padded node count must really
+        # shard (silent replication fallback would prove nothing).
+        from jax.sharding import PartitionSpec
+
+        assert packer._snap.node_idle.sharding.spec == \
+            PartitionSpec("node")
     c = _Churn(cache, sim, rng)
 
     def op_add_topo_pod(c):

@@ -247,3 +247,115 @@ def test_node_cumsum_matches_plain_cumsum():
             )
     finally:
         assignment.SHARD_LOCAL_SCAN = prev
+
+
+# -- production pack path shardings (doc/design/multichip-shard.md) -----
+# The tests above drive shard_cycle_inputs by hand; these pin the
+# DAEMON's own pack path: an IncrementalPacker under an armed
+# MeshContext must emit node-axis sharded device arrays, keep them
+# sharded across row patches, and stay byte-identical-inert at the
+# devices=1 default.
+
+def test_production_packer_shards_node_axis():
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from kube_batch_tpu.cache.incremental import IncrementalPacker
+    from kube_batch_tpu.parallel import MeshContext
+
+    cache, _sim = build_config(2)
+    packer = IncrementalPacker(cache, mesh=MeshContext(8))
+    packer.pack()
+    snap = packer._snap
+    for name in ("node_cap", "node_idle", "node_releasing"):
+        sh = getattr(snap, name).sharding
+        assert isinstance(sh, NamedSharding), name
+        assert sh.spec == PartitionSpec("node"), (name, sh.spec)
+    for name in ("task_req", "task_state", "job_min", "queue_weight"):
+        sh = getattr(snap, name).sharding
+        assert isinstance(sh, NamedSharding), name
+        assert sh.spec == PartitionSpec(), (name, sh.spec)
+    # per-shard device==host bit-identity (the sharded extension of
+    # the journal-fuzz invariant)
+    packer.verify_sharded_view()
+    # node-sharded fields ship 1/8 per device, so the per-device share
+    # must be strictly below the total
+    assert 0 < packer.last_h2d_bytes_per_device < packer.last_h2d_bytes
+
+
+def test_production_row_patch_stays_sharded():
+    """A row-local mutation takes the incremental path and scatters
+    into the RIGHT shard — the per-shard view check would catch a
+    patch that landed whole-array or in the wrong partition."""
+    from kube_batch_tpu.api.types import TaskStatus
+    from kube_batch_tpu.cache.incremental import IncrementalPacker
+    from kube_batch_tpu.parallel import MeshContext
+
+    cache, _sim = build_config(2)
+    packer = IncrementalPacker(cache, mesh=MeshContext(8))
+    packer.pack()
+    with cache.lock():
+        uid = next(
+            u for u, p in cache._pods.items()
+            if p.status == TaskStatus.PENDING
+        )
+        node = next(iter(cache._nodes))
+    cache.update_pod_status(uid, TaskStatus.BOUND, node=node)
+    packer.pack()
+    assert packer.incremental_packs >= 1, packer.fallback_reasons
+    packer.verify_sharded_view()
+    packer.verify_against_live()
+
+
+def test_production_packer_inert_at_one_device():
+    """devices=1 (the default) must not attach ANY sharding metadata —
+    today's exact path, so persistent-cache entries and banked
+    artifacts from before the knob keep hitting."""
+    from jax.sharding import NamedSharding
+
+    from kube_batch_tpu.cache.incremental import IncrementalPacker
+    from kube_batch_tpu.parallel import MeshContext
+
+    mesh = MeshContext(1)
+    assert not mesh.active
+    cache, _sim = build_config(1)
+    packer = IncrementalPacker(cache, mesh=mesh)
+    packer.pack()
+    snap = packer._snap
+    for name in ("node_idle", "task_state"):
+        sh = getattr(snap, name).sharding
+        assert not isinstance(sh, NamedSharding), (name, sh)
+    assert packer.last_h2d_bytes_per_device == packer.last_h2d_bytes
+
+
+def test_scheduler_mesh_knob_health_and_spans():
+    """Scheduler(mesh_devices=8): one full cycle solves on the mesh,
+    /healthz reports the device count, and the pack/solve spans carry
+    mesh_devices + per-device H2D bytes (PR 10 observability)."""
+    import json as _json
+
+    from jax.sharding import PartitionSpec
+
+    from kube_batch_tpu import metrics, trace
+
+    cache, sim = build_config(1)
+    from kube_batch_tpu.scheduler import Scheduler
+
+    tracer = trace.enable()
+    try:
+        s = Scheduler(cache, schedule_period=0.0, mesh_devices=8)
+        assert s.run_once() is not None
+        assert len(sim.binds) == 8
+        assert s.packer._snap.node_idle.sharding.spec == \
+            PartitionSpec("node")
+        health = _json.loads(metrics.health_body())
+        assert health["mesh_devices"] == 8
+        args = {
+            e["name"]: e.get("args", {})
+            for e in tracer.spans.chrome_events()
+        }
+        assert args["pack_h2d"]["mesh_devices"] == 8
+        assert args["pack_h2d"]["pack_h2d_bytes_per_device"] > 0
+        assert args["solve"]["mesh_devices"] == 8
+    finally:
+        trace.disable()
+        metrics.set_mesh_devices(1)  # don't leak into health tests
