@@ -121,6 +121,16 @@ run-example:
 # same seed ⇒ same hash across the two runs, the --ingest-mode event
 # parity run AND the --trace off run (stitching + SLO engine are
 # decision-invisible).
+# The autopilot runs are the FLEET-AUTOPILOT scenario
+# (doc/design/fleet-autopilot.md): the cells scenario's exact
+# workload/fault schedule with the per-cell rebalancer driving the
+# reclaim instead of the manual duties — scripts/check_chaos_autopilot
+# .py asserts the spike cell drained via >=1 AUTOMATIC multi-node
+# claim, donor invariants held, zero claims opened inside the straddle
+# partition window, zero flap reversals (no donor->claimant claim),
+# same seed ⇒ same hash across the two autopilot-on runs, AND the
+# --autopilot off run hashing byte-identical to the pre-existing cells
+# run (the whole subsystem is decision-invisible when disabled).
 # The guardrail and restart scenarios each also run ONCE at
 # --mesh-devices 8 (doc/design/multichip-shard.md, virtual CPU mesh):
 # the node-axis sharded pack/solve must be decision-invisible, so the
@@ -230,6 +240,18 @@ chaos:
 	$(PY) scripts/check_chaos_cells.py /tmp/kb-chaos-cells-1.json \
 	    /tmp/kb-chaos-cells-2.json /tmp/kb-chaos-cells-e.json \
 	    /tmp/kb-chaos-cells-t.json
+	JAX_PLATFORMS=cpu $(PY) -m kube_batch_tpu.chaos --seed 37 --ticks 26 \
+	    --scenario examples/chaos-autopilot.json \
+	    --quiet > /tmp/kb-chaos-autopilot-1.json
+	JAX_PLATFORMS=cpu $(PY) -m kube_batch_tpu.chaos --seed 37 --ticks 26 \
+	    --scenario examples/chaos-autopilot.json \
+	    --quiet > /tmp/kb-chaos-autopilot-2.json
+	JAX_PLATFORMS=cpu $(PY) -m kube_batch_tpu.chaos --seed 37 --ticks 26 \
+	    --scenario examples/chaos-autopilot.json \
+	    --autopilot off --quiet > /tmp/kb-chaos-autopilot-off.json
+	$(PY) scripts/check_chaos_autopilot.py /tmp/kb-chaos-autopilot-1.json \
+	    /tmp/kb-chaos-autopilot-2.json /tmp/kb-chaos-autopilot-off.json \
+	    /tmp/kb-chaos-cells-1.json
 
 profile:
 	$(PY) -m kube_batch_tpu --workload 2 --cycles 3 --schedule-period 0 \

@@ -1,0 +1,44 @@
+"""Fleet autopilot: close the loop from SLO burn to capacity claims.
+
+PR 12 gave the fleet a negotiated cross-cell reclaim protocol
+(epoch-fenced ``claimCapacity`` / ``offerCapacity`` with TTL'd
+rollback) and PR 13 gave it per-cell SLO burn rates — but the two were
+never connected: an operator read ``/debug/fleet``, saw cell A burning
+its placement SLO with a mountain of pending gangs, and typed a
+``claimCapacity`` by hand.  This package is that operator, automated
+and made boring:
+
+* ``signal``  — the demand/pressure signal: pending pods + gangs with
+  their aggregate requested resource VECTOR (cpu / memory / devices),
+  computed from the cell's own cache mirror.  Constraint-shaped
+  demand, not raw pod counts ("Priority Matters", PAPERS.md).
+* ``ladder``  — the hysteresis ladder (observe → armed → claiming →
+  cooldown), borrowed from the guardrails watchdog: claims fire only
+  from SUSTAINED pressure, at most one claim is in flight, and every
+  resolution is followed by a cooldown — two cells can never
+  ping-pong capacity (doc/design/fleet-autopilot.md § no-flap).
+* ``rebalancer`` — the per-cell ``Autopilot`` that runs on the LEADER
+  after each scheduling cycle: publishes the demand column to
+  ``/healthz`` + ``/debug/fleet``, serves the donor side of pending
+  claims (headroom-guarded, gang-atomic drains), resolves its own
+  in-flight claim from the wire, and — when the ladder says so —
+  issues a multi-node ``claimCapacity`` against the least-utilized
+  donor.
+
+Strictly decision-invisible when disabled: with ``--autopilot off``
+(the default) nothing here is constructed and every existing chaos
+hash reproduces byte-identical (scripts/check_chaos_autopilot.py pins
+it).
+"""
+
+from kube_batch_tpu.autopilot.ladder import ReclaimLadder
+from kube_batch_tpu.autopilot.rebalancer import Autopilot, AutopilotConfig
+from kube_batch_tpu.autopilot.signal import DemandSignal, demand_signal
+
+__all__ = [
+    "Autopilot",
+    "AutopilotConfig",
+    "DemandSignal",
+    "ReclaimLadder",
+    "demand_signal",
+]

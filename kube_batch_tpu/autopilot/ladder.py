@@ -1,0 +1,141 @@
+"""The reclaim hysteresis ladder: observe → armed → claiming →
+cooldown.
+
+Borrowed from the guardrails watchdog's escalation ladder (one rung at
+a time, streaks not instants, cooldown before re-escalation) and
+pointed at capacity claims.  The structural no-flap argument:
+
+* a claim requires ``arm_after`` consecutive pressured evaluations to
+  ARM plus one more to FIRE — an oscillating signal that dips below
+  the threshold every other tick resets the streak and never arms;
+* at most one claim is in flight: the CLAIMING rung evaluates to
+  "do nothing" until the claim resolves on the wire (granted, rolled
+  back, or expired-fractional);
+* every resolution enters COOLDOWN for ``cooldown_ticks`` evaluations
+  — a second claim can never be issued within the cooldown of the
+  first, so a claim is never "reversed within its cooldown" (the
+  zero-flap acceptance check in scripts/check_chaos_autopilot.py);
+* the ladder RELEASES (returns to observe) only after ``quiet_after``
+  consecutive quiet evaluations while armed — one quiet blip under
+  sustained pressure does not disarm it.
+
+The rung survives a restart through the statestore: ``export_state``
+rides the journal, and ``restore_state`` deliberately degrades a
+persisted CLAIMING rung to a full COOLDOWN — the restarted leader no
+longer knows its claim id, and re-claiming immediately could
+double-claim against a grant that is already in flight.  The TTL'd
+protocol guarantees the orphaned claim resolves on its own.
+"""
+
+from __future__ import annotations
+
+OBSERVE = "observe"
+ARMED = "armed"
+CLAIMING = "claiming"
+COOLDOWN = "cooldown"
+
+_RUNGS = (OBSERVE, ARMED, CLAIMING, COOLDOWN)
+
+
+class ReclaimLadder:
+    def __init__(self, arm_after: int = 2, quiet_after: int = 2,
+                 cooldown_ticks: int = 3) -> None:
+        self.arm_after = max(int(arm_after), 1)
+        self.quiet_after = max(int(quiet_after), 1)
+        self.cooldown_ticks = max(int(cooldown_ticks), 1)
+        self.rung = OBSERVE
+        self.pressure_streak = 0
+        self.quiet_streak = 0
+        self.cooldown_left = 0
+        self.transitions = 0
+        self.last_transition: str | None = None
+
+    # -- internal ----------------------------------------------------
+    def _move(self, rung: str, why: str) -> None:
+        if rung == self.rung:
+            return
+        self.last_transition = f"{self.rung}->{rung}:{why}"
+        self.rung = rung
+        self.transitions += 1
+        self.pressure_streak = 0
+        self.quiet_streak = 0
+
+    # -- the per-cycle evaluation -------------------------------------
+    def evaluate(self, pressured: bool) -> bool:
+        """Advance one evaluation; True means "issue a claim NOW".
+        Returning True does NOT move the rung — the caller reports the
+        wire outcome via claim_opened() (claim exists) or nothing (no
+        donor / wire error: still armed, retried next evaluation)."""
+        if self.rung == OBSERVE:
+            if pressured:
+                self.pressure_streak += 1
+                if self.pressure_streak >= self.arm_after:
+                    self._move(ARMED, "sustained-pressure")
+            else:
+                self.pressure_streak = 0
+            return False
+        if self.rung == ARMED:
+            if pressured:
+                self.quiet_streak = 0
+                return True
+            self.quiet_streak += 1
+            if self.quiet_streak >= self.quiet_after:
+                self._move(OBSERVE, "sustained-quiet")
+            return False
+        if self.rung == CLAIMING:
+            # One claim in flight: nothing to decide until the wire
+            # resolves it (resolve()) — the no-double-claim guarantee.
+            return False
+        # COOLDOWN: count down; at expiry re-arm under pressure (the
+        # re-claim path after a rollback) or stand down.
+        self.cooldown_left -= 1
+        if self.cooldown_left <= 0:
+            self._move(ARMED if pressured else OBSERVE,
+                       "cooldown-expired")
+        return False
+
+    # -- claim lifecycle reports ---------------------------------------
+    def claim_opened(self) -> None:
+        """The claimCapacity call succeeded: a claim is in flight."""
+        self._move(CLAIMING, "claim-opened")
+
+    def resolve(self, outcome: str) -> None:
+        """The in-flight claim reached a terminal state on the wire
+        (granted / rolled_back / expired).  Every outcome cools down:
+        after a grant the new capacity needs cycles to absorb demand,
+        and after a rollback hammering a dark donor helps nobody."""
+        if self.rung != CLAIMING:
+            return
+        self.cooldown_left = self.cooldown_ticks
+        self._move(COOLDOWN, outcome)
+
+    # -- persistence ----------------------------------------------------
+    def export_state(self) -> dict:
+        return {
+            "rung": self.rung,
+            "pressure_streak": self.pressure_streak,
+            "quiet_streak": self.quiet_streak,
+            "cooldown_left": self.cooldown_left,
+        }
+
+    def restore_state(self, state: dict) -> str:
+        """Adopt a journaled rung; tolerant of junk (cold start).
+        A persisted CLAIMING rung degrades to a FULL cooldown: the
+        claim id did not survive the restart, and the TTL will resolve
+        the orphan — re-claiming before it does could double-claim."""
+        rung = state.get("rung")
+        if rung not in _RUNGS:
+            return f"ignored unknown rung {rung!r}"
+        if rung == CLAIMING:
+            self.rung = COOLDOWN
+            self.cooldown_left = self.cooldown_ticks
+            self.pressure_streak = self.quiet_streak = 0
+            self.last_transition = "claiming->cooldown:restart"
+            return "claiming degraded to cooldown (restart safety)"
+        self.rung = rung
+        self.pressure_streak = max(int(state.get("pressure_streak", 0)), 0)
+        self.quiet_streak = max(int(state.get("quiet_streak", 0)), 0)
+        self.cooldown_left = max(int(state.get("cooldown_left", 0)), 0)
+        if self.rung == COOLDOWN and self.cooldown_left <= 0:
+            self.cooldown_left = self.cooldown_ticks
+        return f"adopted rung {self.rung}"

@@ -1,0 +1,337 @@
+"""The per-cell Autopilot: the rebalancer that runs on the LEADER
+after each scheduling cycle and closes the sensor→actuator loop.
+
+One ``step()`` per cycle does, in order:
+
+1. SENSE   — compute the cell's demand signal from its cache mirror
+   and publish it to the scoped health registry (the /healthz +
+   /debug/fleet demand column; visible even in ``observe`` mode).
+2. DONATE  — serve the donor side of the reclaim protocol: discover
+   pending claims naming this cell, and free ONE node per step through
+   the normal evict seam (gang-atomically), guarded by donor-side
+   headroom — a donor never drains below its own demand + headroom.
+3. RESOLVE — poll this cell's own in-flight claim (claimant-role
+   listClaims) and feed the terminal outcome to the ladder + the
+   ``reclaim_claims_total{outcome}`` counter.
+4. DECIDE  — evaluate the hysteresis ladder against the pressure
+   predicate (structural starvation AND sustained SLO fast-burn) and,
+   when it fires, issue one multi-node ``claimCapacity`` against the
+   least-utilized donor.
+
+Every wire interaction is the SAME epoch-fenced protocol the manual
+path uses: a stale leader's claim bounces off the fence, a partition
+mid-claim rolls back on TTL to exactly nothing.  The step is wrapped
+in try/except at its call sites — an autopilot bug degrades to "no
+rebalancing", never to a broken scheduling cycle.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+
+from kube_batch_tpu import metrics
+from kube_batch_tpu import trace as trace_obs
+from kube_batch_tpu.api.types import TaskStatus
+from kube_batch_tpu.autopilot.ladder import ReclaimLadder
+from kube_batch_tpu.autopilot.signal import DemandSignal, demand_signal
+from kube_batch_tpu.trace import context as trace_ctx
+
+log = logging.getLogger(__name__)
+
+_RESIDENT = (TaskStatus.BINDING, TaskStatus.BOUND, TaskStatus.RUNNING)
+
+
+@dataclasses.dataclass(frozen=True)
+class AutopilotConfig:
+    """Thresholds; defaults match the chaos scenario's tick scale —
+    the daemon flags (--autopilot-*) override for wall-clock cycles."""
+
+    #: "observe" publishes the demand column + ladder state but never
+    #: claims and never donates; "on" is the full loop.
+    mode: str = "on"
+    #: Donor cells this cell may claim from (never itself).
+    donors: tuple = ()
+    arm_after: int = 2
+    quiet_after: int = 2
+    cooldown_ticks: int = 3
+    claim_ttl_ticks: int = 3
+    #: Upper bound on nodes per claim — one claim burst is bounded
+    #: even against an unbounded deficit; fractional grants cover the
+    #: rest on the next armed cycle.
+    max_nodes_per_claim: int = 2
+    #: cpu (milli) the donor keeps free beyond its own demand before
+    #: it will donate a node.
+    headroom_cpu_milli: float = 0.0
+    #: Pressure requires an SLO fast-burn reading, not just structural
+    #: starvation (False = structural-only, for benches without an
+    #: SLO engine).
+    require_slo_burn: bool = True
+    #: Which objective must burn ("" = any objective).
+    slo_objective: str = "placement"
+    #: The burn sensor is bursty (a sliding window slides); a burn
+    #: reading stays "fresh" for this many steps when joined with
+    #: still-starved demand.
+    burn_memory: int = 3
+
+
+class Autopilot:
+    def __init__(self, cache, backend, cell: str,
+                 config: AutopilotConfig, *, evict=None, slo=None,
+                 is_leader=None) -> None:
+        self.cache = cache
+        self.backend = backend
+        self.cell = cell
+        self.config = config
+        self._evict = evict
+        #: Callable returning the cell's SloEngine (or None) — a
+        #: callable because the engine is armed after construction.
+        self._slo = slo
+        self._is_leader = is_leader
+        self.ladder = ReclaimLadder(config.arm_after, config.quiet_after,
+                                    config.cooldown_ticks)
+        self.claim_inflight: int | None = None
+        self.counters = {"claims": 0, "granted": 0, "rolled_back": 0,
+                         "expired": 0, "donations": 0}
+        self.last_signal: DemandSignal | None = None
+        self._burn_age: int | None = None  # steps since last burn read
+
+    # -- persistence (rides the statestore journal) --------------------
+    def export_state(self) -> dict:
+        return {"ladder": self.ladder.export_state()}
+
+    def restore_state(self, state: dict) -> str:
+        return self.ladder.restore_state(state.get("ladder") or {})
+
+    # -- the per-cycle step ---------------------------------------------
+    def step(self) -> dict:
+        """One sense→donate→resolve→decide pass; returns a record of
+        what happened (empty when nothing did).  Leader-gated: a
+        follower publishes nothing and touches no wire."""
+        rec: dict = {}
+        if self._is_leader is not None and not self._is_leader():
+            return rec
+        sig = demand_signal(self.cache)
+        self.last_signal = sig
+        metrics.set_pending_demand(sig.as_dict())
+        if self.config.mode == "on":
+            self._donor_step(sig, rec)
+            self._resolve_step(rec)
+            pressured = self._pressured(sig)
+            if self.ladder.evaluate(pressured):
+                self._claim_step(sig, rec)
+        metrics.set_autopilot_state(self.state())
+        return rec
+
+    def state(self) -> dict:
+        """The /healthz + /debug/fleet autopilot column."""
+        return {
+            "mode": self.config.mode,
+            "rung": self.ladder.rung,
+            "claim_inflight": self.claim_inflight,
+            "transitions": self.ladder.transitions,
+            **self.counters,
+        }
+
+    # -- pressure ---------------------------------------------------------
+    def _pressured(self, sig: DemandSignal) -> bool:
+        """Sustained-pressure INPUT (the ladder supplies "sustained"):
+        structurally starved AND the SLO burn gate agrees."""
+        if not sig.starved:
+            return False
+        return self._slo_gate()
+
+    def _slo_gate(self) -> bool:
+        if not self.config.require_slo_burn:
+            return True
+        eng = self._slo() if callable(self._slo) else self._slo
+        if eng is None:
+            # No engine armed (tracing off): structural starvation
+            # stands alone — the ladder still demands it be sustained.
+            return True
+        burning = eng.fast_burning(self.config.slo_objective or None)
+        if burning:
+            self._burn_age = 0
+            return True
+        # The burn window slides: demand that stays starved keeps a
+        # recent burn reading fresh for burn_memory steps, so a
+        # one-tick sensor dip cannot disarm a real starvation episode.
+        if self._burn_age is not None:
+            self._burn_age += 1
+            if self._burn_age <= self.config.burn_memory:
+                return True
+            self._burn_age = None
+        return False
+
+    # -- claimant side ------------------------------------------------
+    def _resolve_step(self, rec: dict) -> None:
+        """Poll the in-flight claim for a terminal state (claimant-role
+        listClaims) and settle the ladder + counters."""
+        if self.claim_inflight is None:
+            return
+        try:
+            claims = self.backend.list_claims(role="claimant")
+        except (ConnectionError, TimeoutError):
+            return  # partitioned: the TTL is already running
+        claim = next((c for c in claims
+                      if c.get("id") == self.claim_inflight), None)
+        if claim is None or claim.get("state") == "pending":
+            return
+        state = str(claim.get("state"))
+        if state == "rolled-back":
+            outcome = "rolled_back"
+        elif claim.get("fractional"):
+            outcome = "expired"  # partial fill closed at TTL
+        else:
+            outcome = "granted"
+        granted = claim.get("granted") or (
+            [claim["node"]] if claim.get("node") else [])
+        self.counters[outcome] += 1
+        metrics.note_reclaim_outcome(outcome)
+        trace_obs.note_transition(
+            "reclaim-resolve", claim=claim.get("id"), cell=self.cell,
+            outcome=outcome, granted=len(granted),
+        )
+        self.ladder.resolve(outcome)
+        self.claim_inflight = None
+        rec["resolved"] = {"claim": claim.get("id"), "outcome": outcome,
+                           "granted": list(granted)}
+
+    def _claim_step(self, sig: DemandSignal, rec: dict) -> None:
+        donor = self._pick_donor()
+        if donor is None:
+            rec["claim-error"] = "no-donor"
+            return
+        per_node = (sig.alloc_cpu_milli / sig.nodes) if sig.nodes else 0.0
+        nodes = sig.nodes_needed(per_node, self.config.max_nodes_per_claim)
+        try:
+            # The claim is the ORIGIN of a cross-scheduler flow: its
+            # traceparent rides the request and the donor's drain +
+            # offer stitch under the same trace id.
+            with trace_obs.flow("reclaim-claim", cell=self.cell,
+                                donor=donor):
+                cid = self.backend.claim_capacity(
+                    donor, nodes=nodes,
+                    ttl_ticks=self.config.claim_ttl_ticks,
+                )
+        except (ConnectionError, TimeoutError):
+            rec["claim-error"] = "unreachable"  # still armed: retried
+            return
+        except RuntimeError as exc:
+            rec["claim-error"] = str(exc)[:200]
+            return
+        self.claim_inflight = cid
+        self.counters["claims"] += 1
+        self.ladder.claim_opened()
+        trace_obs.note_transition(
+            "reclaim-claim", claim=cid, cell=self.cell, donor=donor,
+            nodes=nodes,
+        )
+        rec["claim"] = {"claim": cid, "from": donor, "nodes": nodes}
+
+    def _pick_donor(self) -> str | None:
+        """Least-utilized donor first, from whatever demand columns
+        this process can see (in-process scopes in the chaos drive /
+        bench; a lone daemon falls back to configured order)."""
+        donors = [d for d in self.config.donors if d != self.cell]
+        if not donors:
+            return None
+        snap = metrics.health_snapshot()
+
+        def util(item):
+            idx, name = item
+            demand = (snap.get(name) or {}).get("demand") or {}
+            u = demand.get("utilization")
+            return (float(u) if u is not None else 0.5, idx)
+
+        return sorted(enumerate(donors), key=util)[0][1]
+
+    # -- donor side -----------------------------------------------------
+    def _donor_step(self, sig: DemandSignal, rec: dict) -> None:
+        """Serve one node of the oldest pending claim naming this
+        cell, gang-atomically, iff the cell can afford it."""
+        try:
+            claims = self.backend.list_claims()
+        except (ConnectionError, TimeoutError):
+            return  # partitioned: the claim rolls back on TTL
+        claims = [c for c in claims if c.get("state") == "pending"]
+        if not claims:
+            return
+        claim = claims[0]
+        total = sig.pending_cpu_milli + sig.used_cpu_milli
+        with self.cache.lock():
+            nodes = sorted(
+                (info.node for info in self.cache._nodes.values()),
+                key=lambda n: n.name,
+            )
+            residents: dict[str, list] = {n.name: [] for n in nodes}
+            for p in self.cache._pods.values():
+                if p.node in residents and p.status in _RESIDENT:
+                    residents[p.node].append(p)
+            # The eviction CLOSURE per node: every placed member of
+            # every gang resident on it (gang-atomicity — no gang is
+            # ever stranded half-on donated hardware).  Cheapest
+            # closure first: an empty node donates for free, and the
+            # fewer pods drained, the less the donor's own next cycle
+            # churns re-placing them.
+            closures: dict[str, list] = {}
+            for node in nodes:
+                groups = {p.group for p in residents[node.name]
+                          if p.group}
+                closures[node.name] = sorted(
+                    (
+                        p for p in self.cache._pods.values()
+                        if (p.group in groups
+                            or p in residents[node.name])
+                        and p.node is not None
+                        and p.status in _RESIDENT
+                    ),
+                    key=lambda p: p.uid,
+                )
+        candidates = sorted(
+            nodes, key=lambda n: (len(closures[n.name]), n.name)
+        )
+        for node in candidates:
+            node_cpu = float(node.allocatable.get("cpu", 0.0))
+            if total + self.config.headroom_cpu_milli > \
+                    sig.alloc_cpu_milli - node_cpu:
+                continue  # headroom guard: cannot afford this node
+            victims = closures[node.name]
+            victim_nodes = {p.uid: p.node for p in victims}
+            # Donor side of the stitched flow: adopt the claimant's
+            # propagated context so drain + offer record under the
+            # claim's trace id.
+            parent = trace_ctx.parse(claim.get("traceparent"))
+            try:
+                with trace_obs.flow(
+                    "reclaim-donate", ctx=parent, cell=self.cell,
+                    claim=claim["id"], node=node.name,
+                ):
+                    for pod in victims:
+                        if self._evict is not None:
+                            self._evict(pod, "reclaim-donate")
+                    self.backend.offer_capacity(claim["id"], node.name)
+            except (ConnectionError, TimeoutError):
+                return  # partitioned mid-donation: rolls back on TTL
+            except RuntimeError as exc:
+                log.warning("%s: donation refused: %s", self.cell, exc)
+                return
+            dlog = trace_obs.decision_log()
+            if dlog is not None:
+                for pod in victims:
+                    dlog.note_eviction(
+                        pod.uid, pod.name, pod.group,
+                        victim_nodes.get(pod.uid),
+                        "reclaim-donate",
+                        trace_obs.current_cycle(),
+                    )
+            self.counters["donations"] += 1
+            trace_obs.note_transition(
+                "reclaim-offer", claim=claim["id"], cell=self.cell,
+                node=node.name, evicted=len(victims),
+            )
+            rec["donation"] = {"claim": claim["id"], "node": node.name,
+                               "evicted": len(victims)}
+            return
+        rec["donate-skipped"] = {"claim": claim["id"],
+                                 "reason": "headroom"}

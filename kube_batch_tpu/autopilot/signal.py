@@ -1,0 +1,131 @@
+"""The demand/pressure signal: what the autopilot (and an operator at
+/debug/fleet) sees of one cell's backlog.
+
+Demand is CONSTRAINT-SHAPED, not a pod count: a cell with 40 pending
+best-effort singletons is healthy; a cell with one pending 14-member
+gang whose aggregate cpu exceeds the whole cell's allocatable is
+structurally starved — no amount of waiting places it.  The signal
+therefore carries the aggregate requested resource vector of the
+pending set (cpu / memory / accelerator devices), the gang count, and
+the cell's own capacity + usage, all read from the scheduler's cache
+mirror under one lock hold.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+from kube_batch_tpu.api.types import TaskStatus
+
+#: Requested-resource keys that are neither cpu/memory nor the pods
+#: count are accelerator devices (google.com/tpu, nvidia.com/gpu, …) —
+#: summed into one "device" axis for the demand vector.
+_NON_DEVICE_KEYS = ("cpu", "memory", "pods")
+
+#: Statuses that hold capacity on a node (the "used" side of the
+#: signal) — matches the donor duty's resident set.
+_PLACED = (TaskStatus.BINDING, TaskStatus.BOUND, TaskStatus.RUNNING)
+
+
+@dataclasses.dataclass(frozen=True)
+class DemandSignal:
+    """One cell's demand/capacity snapshot (all resource quantities in
+    the cache's native units: milli-cpu, bytes, device count)."""
+
+    pending_pods: int = 0
+    pending_gangs: int = 0
+    pending_cpu_milli: float = 0.0
+    pending_mem_bytes: float = 0.0
+    pending_device: float = 0.0
+    used_cpu_milli: float = 0.0
+    alloc_cpu_milli: float = 0.0
+    alloc_mem_bytes: float = 0.0
+    nodes: int = 0
+
+    @property
+    def starved(self) -> bool:
+        """Structural starvation: the pending set cannot fit even an
+        EMPTY cell — pending demand exceeds total allocatable on the
+        cpu or memory axis.  This is the same predicate the chaos
+        engine's manual claim duty uses, vector-widened."""
+        return (self.pending_cpu_milli > self.alloc_cpu_milli
+                or self.pending_mem_bytes > self.alloc_mem_bytes)
+
+    @property
+    def utilization(self) -> float:
+        """cpu demand / allocatable — the donor-ranking axis."""
+        if self.alloc_cpu_milli <= 0:
+            return 0.0
+        return self.used_cpu_milli / self.alloc_cpu_milli
+
+    def nodes_needed(self, per_node_cpu_milli: float,
+                     cap: int = 1) -> int:
+        """How many donor nodes close the cpu deficit (pending beyond
+        this cell's free capacity), clamped to [1, cap].  Fractional
+        grants mean asking for the full deficit is safe: a donor that
+        can only afford part of it still moves that part."""
+        free = max(self.alloc_cpu_milli - self.used_cpu_milli, 0.0)
+        deficit = self.pending_cpu_milli - free
+        if deficit <= 0 or per_node_cpu_milli <= 0:
+            return 1
+        return max(1, min(int(math.ceil(deficit / per_node_cpu_milli)),
+                          max(cap, 1)))
+
+    def as_dict(self) -> dict:
+        """The /healthz + /debug/fleet demand column."""
+        return {
+            "pending_pods": self.pending_pods,
+            "pending_gangs": self.pending_gangs,
+            "pending_cpu_milli": round(self.pending_cpu_milli, 3),
+            "pending_mem_bytes": round(self.pending_mem_bytes, 3),
+            "pending_device": round(self.pending_device, 3),
+            "used_cpu_milli": round(self.used_cpu_milli, 3),
+            "alloc_cpu_milli": round(self.alloc_cpu_milli, 3),
+            "alloc_mem_bytes": round(self.alloc_mem_bytes, 3),
+            "nodes": self.nodes,
+            "starved": self.starved,
+            "utilization": round(self.utilization, 4),
+        }
+
+
+def demand_signal(cache) -> DemandSignal:
+    """Compute the cell's demand signal from its cache mirror under
+    one lock hold — O(pods + nodes), run once per cycle on the leader
+    (never in the hot packing path)."""
+    pending_pods = 0
+    pending_cpu = pending_mem = pending_dev = 0.0
+    used_cpu = 0.0
+    gangs: set[str] = set()
+    with cache.lock():
+        alloc_cpu = alloc_mem = 0.0
+        nodes = 0
+        for info in cache._nodes.values():
+            alloc_cpu += float(info.node.allocatable.get("cpu", 0.0))
+            alloc_mem += float(info.node.allocatable.get("memory", 0.0))
+            nodes += 1
+        for p in cache._pods.values():
+            cpu = float(p.request.get("cpu", 0.0))
+            if p.status == TaskStatus.PENDING:
+                pending_pods += 1
+                pending_cpu += cpu
+                pending_mem += float(p.request.get("memory", 0.0))
+                pending_dev += sum(
+                    float(v) for k, v in p.request.items()
+                    if k not in _NON_DEVICE_KEYS
+                )
+                if p.group:
+                    gangs.add(p.group)
+            elif p.status in _PLACED:
+                used_cpu += cpu
+    return DemandSignal(
+        pending_pods=pending_pods,
+        pending_gangs=len(gangs),
+        pending_cpu_milli=pending_cpu,
+        pending_mem_bytes=pending_mem,
+        pending_device=pending_dev,
+        used_cpu_milli=used_cpu,
+        alloc_cpu_milli=alloc_cpu,
+        alloc_mem_bytes=alloc_mem,
+        nodes=nodes,
+    )

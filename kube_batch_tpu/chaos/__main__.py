@@ -101,6 +101,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "invisible, so the same seed must hash "
                         "identically either way (pinned by "
                         "tests/test_chaos_trace.py)")
+    p.add_argument("--autopilot", choices=("on", "off"), default=None,
+                   help="fleet-autopilot dimension for cells mode "
+                        "(doc/design/fleet-autopilot.md): 'on' runs a "
+                        "per-cell rebalancer on each leader that turns "
+                        "sustained SLO burn + pending demand into "
+                        "epoch-fenced capacity claims automatically; "
+                        "'off' forces it off even when the scenario's "
+                        "cells section sets autopilot — the parity "
+                        "baseline (the same seed must hash identically "
+                        "to a run of the scenario without autopilot).  "
+                        "Default: follow the scenario's cells.autopilot")
     p.add_argument("--mesh-devices", type=int, default=None,
                    help="device-mesh dimension for the scheduler under "
                         "test (doc/design/multichip-shard.md): N>1 "
@@ -241,6 +252,7 @@ def main(argv: list[str] | None = None) -> int:
             dump_dir=args.dump_dir,
             ingest_mode=args.ingest_mode,
             trace_obs=args.trace_obs,
+            autopilot=args.autopilot,
         )
         try:
             result = engine.run()
@@ -250,6 +262,10 @@ def main(argv: list[str] | None = None) -> int:
         print(json.dumps(result.summary(), indent=1, sort_keys=True))
         return 0 if result.ok else 1
 
+    if args.autopilot is not None:
+        raise SystemExit("--autopilot only applies to cells mode "
+                         "(--cells N or a scenario with a 'cells' "
+                         "section)")
     if args.no_faults:
         faults = FaultSpec.none()
         if events is not None:

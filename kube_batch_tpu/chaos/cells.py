@@ -166,6 +166,19 @@ class CellFaultSpec:
     #: after heal must land.  0 disables.
     straddle_at: int = 0
     straddle_ticks: int = 4
+    #: Fleet autopilot (kube_batch_tpu/autopilot/): when true the
+    #: engine replaces the manual claim/donor duties with a per-cell
+    #: Autopilot — demand signal + SLO burn join + hysteresis ladder
+    #: driving multi-node claims.  The --autopilot CLI flag overrides
+    #: either way; OFF leaves every decision byte-identical to the
+    #: manual path (the knobs below are then inert).
+    autopilot: bool = False
+    autopilot_arm_after: int = 2
+    autopilot_quiet_after: int = 2
+    autopilot_cooldown_ticks: int = 3
+    autopilot_max_nodes: int = 2
+    autopilot_headroom_cpu_milli: float = 0.0
+    autopilot_burn_memory: int = 3
 
     @property
     def donor_cell_default(self) -> int:
@@ -315,6 +328,9 @@ class CellRuntime:
         self.claims_made = 0
         self.donations = 0
         self.stood_down = 0
+        #: The cell's Autopilot (autopilot mode only) — replaces the
+        #: manual claim/donor duties at the same per-tick duty site.
+        self.autopilot = None
         self.ingest = {"events": 0, "batches": 0, "coalesced": 0}
 
     def harvest_ingest(self, adapter) -> None:
@@ -341,6 +357,7 @@ class CellChaosResult:
     ingest: dict | None = None
     trace: dict | None = None
     slo: dict | None = None
+    autopilot: dict | None = None
 
     def summary(self) -> dict:
         return {
@@ -360,6 +377,7 @@ class CellChaosResult:
             "ingest": self.ingest,
             "trace": self.trace,
             "slo": self.slo,
+            "autopilot": self.autopilot,
         }
 
 
@@ -381,6 +399,7 @@ class CellChaosEngine:
         quiesce_timeout: float = 30.0,
         ingest_mode: str | None = None,
         trace_obs: str | None = None,
+        autopilot: str | None = None,
     ) -> None:
         from kube_batch_tpu.client.adapter import resolve_ingest_mode
 
@@ -412,6 +431,20 @@ class CellChaosEngine:
         if self.trace_obs not in ("on", "off"):
             raise ValueError(
                 f"trace_obs must be 'on' or 'off', got {self.trace_obs!r}"
+            )
+        # Autopilot mode: the CLI flag overrides the scenario's
+        # "autopilot" knob either way; OFF keeps the manual claim/
+        # donor duties and must be decision-invisible (the off-parity
+        # run in scripts/check_chaos_autopilot.py pins it).
+        if autopilot is None:
+            self.autopilot_mode = (
+                "on" if self.cell_faults.autopilot else "off"
+            )
+        elif autopilot in ("on", "off"):
+            self.autopilot_mode = autopilot
+        else:
+            raise ValueError(
+                f"autopilot must be 'on' or 'off', got {autopilot!r}"
             )
         self.wire_timeout = (
             ASYM_WIRE_TIMEOUT if self.cell_faults.asym_partition_at
@@ -583,6 +616,19 @@ class CellChaosEngine:
             if claim is not None and claim["state"] != "pending":
                 # Terminal: granted capacity arrives on the watch;
                 # a rollback re-arms the claim duty after heal.
+                # Outcome counters + recorder transitions ride along
+                # (observation-only: neither is hashed).
+                if claim["state"] == "rolled-back":
+                    outcome = "rolled_back"
+                elif claim.get("fractional"):
+                    outcome = "expired"
+                else:
+                    outcome = "granted"
+                metrics.note_reclaim_outcome(outcome)
+                trace_obs_mod.note_transition(
+                    "reclaim-resolve", claim=claim["id"],
+                    cell=rt.name, outcome=outcome,
+                )
                 rec.setdefault("claims-resolved", []).append(
                     {"cell": rt.name, "claim": claim["id"],
                      "state": claim["state"]},
@@ -622,6 +668,10 @@ class CellChaosEngine:
         rt.claim_inflight = int(resp.get("claim", 0)) or None
         rt.claims_made += 1
         self.fault_counts["reclaim-claim"] += 1
+        trace_obs_mod.note_transition(
+            "reclaim-claim", claim=rt.claim_inflight, cell=rt.name,
+            donor=donor,
+        )
         rec.setdefault("claims", []).append(
             {"cell": rt.name, "from": donor,
              "claim": rt.claim_inflight},
@@ -719,6 +769,10 @@ class CellChaosEngine:
                     )
             rt.donations += 1
             self.fault_counts["reclaim-grant"] += 1
+            trace_obs_mod.note_transition(
+                "reclaim-offer", claim=claim["id"], cell=rt.name,
+                node=node.name, evicted=len(victims),
+            )
             rec.setdefault("donations", []).append({
                 "cell": rt.name, "claim": claim["id"],
                 "node": node.name, "evicted": len(victims),
@@ -726,6 +780,40 @@ class CellChaosEngine:
             return
         log.info("%s: no affordable node to donate for claim %s",
                  rt.name, claim["id"])
+
+    def _autopilot_duty(self, rt: CellRuntime, rec: dict) -> None:
+        """Autopilot mode: one Autopilot.step() replaces the manual
+        donor+claim duties at the same site — sense (publish the
+        demand column), donate, resolve, decide.  The engine folds
+        the step's record into its own tick record and fault
+        counters so the summaries read the same either way."""
+        out = rt.autopilot.step()
+        claim = out.get("claim")
+        if claim:
+            rt.claim_inflight = claim["claim"]
+            rt.claims_made += 1
+            self.fault_counts["reclaim-claim"] += 1
+            rec.setdefault("claims", []).append(
+                {"cell": rt.name, **claim},
+            )
+        donation = out.get("donation")
+        if donation:
+            rt.donations += 1
+            self.fault_counts["reclaim-grant"] += 1
+            rec.setdefault("donations", []).append(
+                {"cell": rt.name, **donation},
+            )
+        resolved = out.get("resolved")
+        if resolved:
+            rt.claim_inflight = None
+            rec.setdefault("claims-resolved", []).append(
+                {"cell": rt.name, **resolved},
+            )
+        for key in ("claim-error", "donate-skipped"):
+            if out.get(key):
+                rec.setdefault(f"autopilot-{key}", []).append(
+                    {"cell": rt.name, "detail": out[key]},
+                )
 
     # -- cross-cell zombie probes ---------------------------------------
     def _xcell_probe(self, rec: dict) -> None:
@@ -1145,6 +1233,40 @@ class CellChaosEngine:
                     rt.cache, conf_path=self.conf_path,
                     schedule_period=0.0, guardrails=rt.guardrails,
                 )
+            if self.autopilot_mode == "on":
+                # The engine drives the Autopilot at the duty site
+                # (one_tick), NOT via Scheduler.run_once — the duties
+                # must run BEFORE the tick's cycle, exactly where the
+                # manual claim/donor duties ran, so autopilot-off
+                # stays byte-identical.
+                from kube_batch_tpu.autopilot import (
+                    Autopilot, AutopilotConfig,
+                )
+
+                spec = self.cell_faults
+                rt.autopilot = Autopilot(
+                    cache=rt.cache, backend=rt.backend, cell=rt.name,
+                    config=AutopilotConfig(
+                        mode="on",
+                        donors=tuple(n for n in self.cell_names
+                                     if n != rt.name),
+                        arm_after=spec.autopilot_arm_after,
+                        quiet_after=spec.autopilot_quiet_after,
+                        cooldown_ticks=spec.autopilot_cooldown_ticks,
+                        claim_ttl_ticks=spec.reclaim_ttl_ticks,
+                        max_nodes_per_claim=spec.autopilot_max_nodes,
+                        headroom_cpu_milli=(
+                            spec.autopilot_headroom_cpu_milli
+                        ),
+                        require_slo_burn=(self.trace_obs == "on"),
+                        slo_objective="placement",
+                        burn_memory=spec.autopilot_burn_memory,
+                    ),
+                    evict=rt.seam.evict,
+                    slo=(lambda rt=rt: getattr(
+                        trace_obs_mod.get(scope=rt.name), "slo", None,
+                    )),
+                )
 
         checker = InvariantChecker(self.cluster)
         violations: list[Violation] = []
@@ -1205,8 +1327,11 @@ class CellChaosEngine:
                     lead = self._renew_lease(rt, rec)
                     self._quiesce(rt)
                     if lead:
-                        self._donor_duty(rt, rec)
-                        self._claim_duty(rt, rec)
+                        if rt.autopilot is not None:
+                            self._autopilot_duty(rt, rec)
+                        else:
+                            self._donor_duty(rt, rec)
+                            self._claim_duty(rt, rec)
                         # The duties' wire effects (drain evictions,
                         # the grant's node re-cell) come back as watch
                         # events: quiesce AGAIN so the solve's
@@ -1294,6 +1419,7 @@ class CellChaosEngine:
             ingest=self._ingest_summary(),
             trace=self._trace_summary,
             slo=self._slo_summary,
+            autopilot=self._autopilot_summary(),
         )
 
     # -- per-tick decision drain + cross-cell audit ---------------------
@@ -1451,15 +1577,20 @@ class CellChaosEngine:
                     f"{c['node']!r} — capacity leaked into limbo",
                 ))
             if c["state"] == "granted":
-                with self.cluster._lock:
-                    now_cell = self.cluster.cell_of_node(c["node"])
-                if now_cell != c["to"]:
-                    out.append(Violation(
-                        "reclaim-not-atomic", tick,
-                        f"granted claim {c['id']}: node {c['node']!r} "
-                        f"is in cell {now_cell!r}, not the claimant "
-                        f"{c['to']!r}",
-                    ))
+                # EVERY granted node (multi-node claims fill a list;
+                # single-node claims carry just c["node"]) must live
+                # in the claimant's cell.
+                granted = c.get("granted") or [c["node"]]
+                for node_name in granted:
+                    with self.cluster._lock:
+                        now_cell = self.cluster.cell_of_node(node_name)
+                    if now_cell != c["to"]:
+                        out.append(Violation(
+                            "reclaim-not-atomic", tick,
+                            f"granted claim {c['id']}: node "
+                            f"{node_name!r} is in cell {now_cell!r}, "
+                            f"not the claimant {c['to']!r}",
+                        ))
         if spec.starve_pods:
             if not any(c["state"] == "granted" for c in claims):
                 out.append(Violation(
@@ -1588,6 +1719,18 @@ class CellChaosEngine:
                     if rt.guardrails and rt.guardrails.breaker else 0
                 ),
             }
+            if rt.autopilot is not None:
+                out[rt.name]["autopilot"] = {
+                    "rung": rt.autopilot.ladder.rung,
+                    "transitions": rt.autopilot.ladder.transitions,
+                    "last_transition":
+                        rt.autopilot.ladder.last_transition,
+                    **rt.autopilot.counters,
+                    "demand": (
+                        rt.autopilot.last_signal.as_dict()
+                        if rt.autopilot.last_signal else None
+                    ),
+                }
         return out
 
     def _cross_cell_summary(self) -> dict:
@@ -1614,6 +1757,15 @@ class CellChaosEngine:
                 for cell, ws in sorted(self._partition_windows.items())
             },
             "straddle_rollbacks": self._straddle_rollbacks,
+            # The straddle's dark window [t0, t1) — the autopilot
+            # check script asserts zero claims were CREATED strictly
+            # inside it (the ladder must not spam a dark donor).
+            "straddle_window": (
+                [self.cell_faults.straddle_at,
+                 self.cell_faults.straddle_at
+                 + self.cell_faults.straddle_ticks]
+                if self.cell_faults.straddle_at else None
+            ),
         }
 
     def _reclaim_summary(self) -> dict:
@@ -1628,8 +1780,23 @@ class CellChaosEngine:
                                if c["state"] == "rolled-back"),
             "pending": sum(1 for c in claims
                            if c["state"] == "pending"),
+            "fractional": sum(1 for c in claims
+                              if c.get("fractional")),
             "sequence": sorted(claims, key=lambda c: c["id"]),
         }
+
+    def _autopilot_summary(self) -> dict:
+        out: dict = {"mode": self.autopilot_mode}
+        if self.autopilot_mode == "on":
+            out["cells"] = {
+                rt.name: {
+                    "rung": rt.autopilot.ladder.rung,
+                    "transitions": rt.autopilot.ladder.transitions,
+                    **rt.autopilot.counters,
+                }
+                for rt in self.cells if rt.autopilot is not None
+            }
+        return out
 
     def _ingest_summary(self) -> dict:
         totals = {"events": 0, "batches": 0, "coalesced": 0}

@@ -189,9 +189,18 @@ class InvariantChecker:
                 self._unplace(uid, group)
             elif op in ("unplace", "pod-gone"):
                 self._unplace(uid, group)
-        # Evicted pods must be observably unplaced by end of tick.
+        # Evicted pods must be observably unplaced by end of tick —
+        # unless a LATER accepted bind re-placed them (a donor's drain
+        # evictions legally re-pack onto its remaining nodes within
+        # the same cycle).  Only an eviction with no subsequent bind
+        # and a still-placed pod is a lost write.
+        last_op: dict[str, str] = {}
         for e in entries:
-            if e["op"] != "evict":
+            if e["op"] in ("bind", "evict"):
+                last_op[e.get("uid")] = e["op"]
+        for e in entries:
+            if e["op"] != "evict" or \
+                    last_op.get(e.get("uid")) == "bind":
                 continue
             state = pods.get(e.get("uid"))
             if state is not None and state[2] is not None and \

@@ -245,6 +245,53 @@ def build_parser() -> argparse.ArgumentParser:
                         "process's own scopes into one fleet pane — "
                         "per-cell leader/epoch/ladder/SLO burn plus "
                         "fleet rollups")
+    # -- fleet autopilot (kube_batch_tpu/autopilot/;
+    #    doc/design/fleet-autopilot.md)
+    p.add_argument("--autopilot", choices=("off", "observe", "on"),
+                   default="off",
+                   help="fleet autopilot (doc/design/fleet-autopilot.md"
+                        "): 'observe' publishes the per-cell pending-"
+                        "demand signal and ladder rung on /healthz and "
+                        "/debug/fleet without ever claiming; 'on' also "
+                        "closes the loop — sustained SLO fast-burn + "
+                        "sustained pending demand walks a hysteresis "
+                        "ladder (observe -> armed -> claiming -> "
+                        "cooldown) and issues epoch-fenced claimCapacity "
+                        "calls against the least-utilized donor from "
+                        "--autopilot-donors.  Requires the native wire "
+                        "stream and --cell.  Default off: the scheduler "
+                        "decides identically with the autopilot absent")
+    p.add_argument("--autopilot-donors", default=None,
+                   help="comma-separated donor CELL NAMES the autopilot "
+                        "may claim capacity from (this cell is excluded "
+                        "automatically); unset with --autopilot on "
+                        "means the autopilot arms but never finds a "
+                        "donor")
+    p.add_argument("--autopilot-arm-after", type=int, default=3,
+                   help="consecutive pressured cycles (pending demand "
+                        "exceeds allocatable AND the SLO gate is hot) "
+                        "before the ladder arms (default 3)")
+    p.add_argument("--autopilot-quiet-after", type=int, default=3,
+                   help="consecutive quiet cycles before an armed "
+                        "ladder stands down to observe (default 3)")
+    p.add_argument("--autopilot-cooldown", type=int, default=5,
+                   help="cycles the ladder holds in cooldown after a "
+                        "claim resolves — granted, rolled back or "
+                        "expired — before it may re-arm (default 5)")
+    p.add_argument("--autopilot-max-nodes", type=int, default=2,
+                   help="ceiling on nodes requested per claim; the "
+                        "actual ask is ceil(cpu deficit / donor "
+                        "per-node cpu), clamped to this (default 2)")
+    p.add_argument("--autopilot-headroom", type=float, default=0.0,
+                   help="donor-side guard, in milli-cpu: a donor "
+                        "refuses to drain a node when doing so would "
+                        "leave it less than this much headroom above "
+                        "its own demand (default 0)")
+    p.add_argument("--autopilot-claim-ttl", type=int, default=8,
+                   help="claim TTL in claim-clock ticks: a claim not "
+                        "fully served by then rolls back (no grants) "
+                        "or closes fractionally (some grants) on the "
+                        "donor side (default 8)")
     # -- guardrails (kube_batch_tpu/guardrails/; doc/design/guardrails.md)
     p.add_argument("--hbm-ceiling-mb", type=float, default=None,
                    help="HBM-ceiling admission: refuse growth-prewarm "
@@ -1023,6 +1070,55 @@ def run_external(args) -> int:
             mesh_devices=args.mesh_devices,
         )
         run_state["scheduler"] = scheduler
+        if args.autopilot != "off":
+            # Fleet autopilot (doc/design/fleet-autopilot.md): steps on
+            # the leader after every cycle, BEFORE the journal append —
+            # the ladder rung rides the statestore, so wire it ahead of
+            # wire_statestore (restore adopts the persisted rung).
+            from kube_batch_tpu import metrics, trace
+            from kube_batch_tpu.autopilot import (
+                Autopilot,
+                AutopilotConfig,
+            )
+
+            if not args.cell:
+                raise SystemExit(
+                    "--autopilot requires --cell: claims are fenced "
+                    "per cell (doc/design/fleet-autopilot.md)"
+                )
+            donors = tuple(
+                d.strip()
+                for d in (args.autopilot_donors or "").split(",")
+                if d.strip()
+            )
+            scheduler.autopilot = Autopilot(
+                cache, guarded, args.cell,
+                AutopilotConfig(
+                    mode=args.autopilot,
+                    donors=donors,
+                    arm_after=args.autopilot_arm_after,
+                    quiet_after=args.autopilot_quiet_after,
+                    cooldown_ticks=args.autopilot_cooldown,
+                    claim_ttl_ticks=args.autopilot_claim_ttl,
+                    max_nodes_per_claim=args.autopilot_max_nodes,
+                    headroom_cpu_milli=args.autopilot_headroom,
+                ),
+                evict=guarded.evict,
+                # The SLO engine arms after tracing comes up; resolve
+                # it per step, not at construction.
+                slo=lambda: getattr(trace.get(), "slo", None),
+                is_leader=(
+                    (lambda: metrics.leadership()[0] == "leader")
+                    if args.leader_elect else None
+                ),
+            )
+            logging.info(
+                "fleet autopilot %s: donors=%s arm_after=%d "
+                "cooldown=%d max_nodes=%d",
+                args.autopilot, list(donors) or "(none)",
+                args.autopilot_arm_after, args.autopilot_cooldown,
+                args.autopilot_max_nodes,
+            )
         # Durable operational memory: adopt journal/peer state BEFORE
         # the first cycle (a restarted daemon must not re-trust the
         # node that was killing gangs), then journal every cycle.
@@ -1381,6 +1477,14 @@ def main(argv: list[str] | None = None) -> int:
             # should see a clean, attributable exit.
             logging.error("%s", exc)
             return 1
+
+    if args.autopilot != "off" and not args.cluster_stream:
+        logging.warning(
+            "--autopilot %s ignored: the reclaim protocol rides the "
+            "native wire stream (--cluster-stream); the HTTP dialect "
+            "and the in-process simulator have no claimCapacity verb",
+            args.autopilot,
+        )
 
     if args.kube_api:
         if args.workload or args.cluster_stream:

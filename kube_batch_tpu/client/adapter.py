@@ -541,6 +541,38 @@ class StreamBackend:
     def release_lease(self, holder: str) -> None:
         self._call({"verb": "releaseLease", "holder": holder})
 
+    # -- cross-cell reclaim (doc/design/fleet-autopilot.md) ----------
+    def claim_capacity(self, donor: str, nodes: int = 1,
+                       ttl_ticks: int | None = None) -> int | None:
+        """Mint an epoch-fenced capacity claim against `donor`;
+        returns the claim id.  `nodes` > 1 asks for a multi-node
+        grant; the wire payload stays byte-identical to the
+        single-node dialect when it is 1."""
+        payload: dict = {"verb": "claimCapacity", "from": donor}
+        if ttl_ticks is not None:
+            payload["ttlTicks"] = int(ttl_ticks)
+        if int(nodes) > 1:
+            payload["nodes"] = int(nodes)
+        resp = self._call(payload)
+        return int(resp.get("claim", 0)) or None
+
+    def offer_capacity(self, claim_id: int, node: str) -> None:
+        """Offer one drained node against a pending claim (donor
+        side); raises RuntimeError when the cluster refuses (claim
+        resolved, node not drained, …)."""
+        self._call({"verb": "offerCapacity", "claim": int(claim_id),
+                    "node": node})
+
+    def list_claims(self, role: str | None = None) -> list[dict]:
+        """Unfenced claim poll.  Default: pending claims naming this
+        cell as DONOR.  role="claimant": this cell's own outbound
+        claims in ANY state (grant/rollback/expiry resolution)."""
+        payload: dict = {"verb": "listClaims"}
+        if role is not None:
+            payload["role"] = role
+        resp = self._call(payload)
+        return list(resp.get("object") or [])
+
 
 class FatalElectionError(Exception):
     """An election error no amount of retrying fixes (bad token,

@@ -165,6 +165,10 @@ class ExternalCluster:
         self.claim_clock = 0
         self.reclaim_granted = 0
         self.reclaim_rolled_back = 0
+        # Multi-node claims partially filled at TTL close "granted"
+        # with fractional=True (the filled nodes stay moved; the
+        # unfilled remainder rolls back to nothing) — counted apart.
+        self.reclaim_expired = 0
         # The leaders' mirrored operational-state snapshots (statestore
         # HA adoption), PER CELL: last-write-wins within a cell,
         # epoch-fenced on write like every data-plane verb, readable
@@ -980,14 +984,27 @@ class ExternalCluster:
         elif verb == "listClaims":
             # Unfenced read: the donor cell's scheduler polls for
             # claims targeting it (adoption-time reads never need
-            # leadership).
-            donor = str(msg.get("cell") or "")
-            claims = [
-                dict(c) for _cid, c in sorted(
-                    self.reclaim_claims.items()
-                )
-                if c["from"] == donor and c["state"] == "pending"
-            ]
+            # leadership).  role="claimant" flips the filter: the
+            # CLAIMANT polls its own claims — any state, so it can
+            # observe grant/rollback/fractional-expire resolutions.
+            # The default (donor view, pending only) is unchanged: a
+            # donor must never see its own outbound claims here, or
+            # it would drain victims against itself.
+            cell = str(msg.get("cell") or "")
+            if msg.get("role") == "claimant":
+                claims = [
+                    dict(c) for _cid, c in sorted(
+                        self.reclaim_claims.items()
+                    )
+                    if c["to"] == cell
+                ]
+            else:
+                claims = [
+                    dict(c) for _cid, c in sorted(
+                        self.reclaim_claims.items()
+                    )
+                    if c["from"] == cell and c["state"] == "pending"
+                ]
             self._respond(writer, rid, True,
                           extra={"object": claims})
         elif verb == "putCompileArtifact":
@@ -1050,6 +1067,7 @@ class ExternalCluster:
             )
             return
         ttl = int(msg.get("ttlTicks", self.RECLAIM_TTL_DEFAULT))
+        nodes = max(int(msg.get("nodes", 1)), 1)
         self._claim_seq += 1
         claim = {
             "id": self._claim_seq,
@@ -1059,6 +1077,12 @@ class ExternalCluster:
             "created": self.claim_clock,
             "deadline": self.claim_clock + max(ttl, 1),
             "node": None,
+            # Multi-node claims: the donor fills the claim one offer
+            # at a time; `granted` accumulates the moved nodes and
+            # `node` keeps the first for single-node back-compat.
+            "nodes": nodes,
+            "granted": [],
+            "resolved": None,
             # The claimant's propagated trace context: listClaims
             # hands it to the donor, whose drain + offer open child
             # spans under it — one Perfetto tree spanning both
@@ -1068,11 +1092,16 @@ class ExternalCluster:
             "traceparent": self._req_trace,
         }
         self.reclaim_claims[claim["id"]] = claim
-        self._on_reclaim({
+        entry = {
             "op": "reclaim-claim", "claim": claim["id"],
             "to": to_cell, "from": donor,
             "deadline": claim["deadline"],
-        })
+        }
+        if nodes > 1:
+            # Only stamped for multi-node claims: single-node wire
+            # entries stay byte-identical to the pre-autopilot hash.
+            entry["nodes"] = nodes
+        self._on_reclaim(entry)
         self._respond(writer, rid, True, extra={"claim": claim["id"]})
 
     def _handle_offer(self, writer, rid: int, msg: dict) -> None:
@@ -1130,9 +1159,16 @@ class ExternalCluster:
             )
             return
         node.labels = {**node.labels, CELL_LABEL: claim["to"]}
-        claim["state"] = "granted"
-        claim["node"] = node.name
-        self.reclaim_granted += 1
+        granted = claim.setdefault("granted", [])
+        granted.append(node.name)
+        claim["node"] = granted[0]  # single-node back-compat
+        if len(granted) >= int(claim.get("nodes", 1)):
+            # Full fill: the claim closes granted.  A partial fill
+            # stays pending — more offers may land before the TTL
+            # closes it fractionally (expire_reclaims).
+            claim["state"] = "granted"
+            claim["resolved"] = self.claim_clock
+            self.reclaim_granted += 1
         self._on_reclaim({
             "op": "reclaim-grant", "claim": claim["id"],
             "node": node.name, "to": claim["to"], "from": donor,
@@ -1151,13 +1187,32 @@ class ExternalCluster:
         with self._lock:
             for cid in sorted(self.reclaim_claims):
                 claim = self.reclaim_claims[cid]
-                if claim["state"] == "pending" and \
-                        self.claim_clock >= claim["deadline"]:
-                    claim["state"] = "rolled-back"
-                    self.reclaim_rolled_back += 1
-                    rolled += 1
+                if claim["state"] != "pending" or \
+                        self.claim_clock < claim["deadline"]:
+                    continue
+                if claim.get("granted"):
+                    # FRACTIONAL close: a multi-node claim partially
+                    # filled at its deadline keeps what moved (every
+                    # granted node was already atomically re-celled)
+                    # and abandons the remainder — "granted" with
+                    # fractional=True, counted as an expiry.
+                    claim["state"] = "granted"
+                    claim["fractional"] = True
+                    claim["resolved"] = self.claim_clock
+                    self.reclaim_expired += 1
                     self._on_reclaim({
-                        "op": "reclaim-rollback", "claim": cid,
+                        "op": "reclaim-expire", "claim": cid,
                         "to": claim["to"], "from": claim["from"],
+                        "granted": len(claim["granted"]),
+                        "wanted": int(claim.get("nodes", 1)),
                     })
+                    continue
+                claim["state"] = "rolled-back"
+                claim["resolved"] = self.claim_clock
+                self.reclaim_rolled_back += 1
+                rolled += 1
+                self._on_reclaim({
+                    "op": "reclaim-rollback", "claim": cid,
+                    "to": claim["to"], "from": claim["from"],
+                })
         return rolled

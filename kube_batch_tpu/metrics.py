@@ -545,6 +545,17 @@ cross_cell_writes = REGISTRY.register(Counter(
     "write that targeted an object OUTSIDE the writer's cell and was "
     "PREVENTED from mutating it (doc/design/multi-cell.md).",
 ))
+reclaim_claims = REGISTRY.register(Counter(
+    "reclaim_claims_total",
+    "Cross-cell capacity claims resolved, by outcome: 'granted' "
+    "(every requested node moved), 'rolled_back' (TTL fired with "
+    "nothing moved — the donor was dark or refused), 'expired' "
+    "(multi-node claim closed FRACTIONALLY at TTL: the filled nodes "
+    "stay, the remainder rolled back).  Counted at the CLAIMANT on "
+    "resolution, whether the claim was typed by an operator or "
+    "issued by the autopilot (doc/design/fleet-autopilot.md).",
+    labels=("outcome",),
+))
 stale_epoch_writes = REGISTRY.register(Counter(
     "stale_epoch_writes_total",
     "Data-plane writes rejected by epoch fencing (cluster-side "
@@ -569,6 +580,11 @@ _health_ingest_lag = 0.0
 _health_cell = ""
 _health_cell_peer_visible: bool | None = None
 _health_mesh_devices = 1
+#: Pending-demand column + autopilot ladder state (doc/design/
+#: fleet-autopilot.md) — None until first published, and then only
+#: surfaced: bodies of daemons that never compute them are unchanged.
+_health_demand: dict | None = None
+_health_autopilot: dict | None = None
 #: Per-SCOPE health registry (multi-scheduler-per-process): a live
 #: scheduler driven under a bound scope (kube_batch_tpu/scope.py —
 #: the cell name) publishes here instead of stomping the process-
@@ -706,10 +722,52 @@ def set_cell_peer_visible(visible: bool | None,
             _health_cell_peer_visible = visible
 
 
+def note_reclaim_outcome(outcome: str) -> None:
+    """One resolved cross-cell claim (granted | rolled_back |
+    expired) — the reclaim_claims_total counter's single funnel, so
+    the manual claim path and the autopilot count identically."""
+    reclaim_claims.inc(outcome)
+
+
+def set_pending_demand(demand: dict | None,
+                       scope: str | None = None) -> None:
+    """Publish the cell's pending-demand column (autopilot/signal.py
+    `DemandSignal.as_dict()`) to /healthz + /debug/fleet: pending
+    pods + gangs with their aggregate requested cpu/mem/device — the
+    exact signal the autopilot acts on, visible to operators even
+    when the autopilot is off.  Keys appear only once published: a
+    daemon that never computes the signal serves an unchanged body."""
+    global _health_demand
+    s = _resolve_scope(scope)
+    with _health_lock:
+        if s is not None:
+            _scope_entry(s)["demand"] = dict(demand or {})
+        else:
+            _health_demand = dict(demand) if demand else None
+
+
+def set_autopilot_state(state: dict | None,
+                        scope: str | None = None) -> None:
+    """Publish the autopilot's ladder rung + claim counters
+    (autopilot/rebalancer.py `Autopilot.state()`) to /healthz +
+    /debug/fleet — the "fleet is rebalancing, why?" runbook's first
+    read."""
+    global _health_autopilot
+    s = _resolve_scope(scope)
+    with _health_lock:
+        if s is not None:
+            _scope_entry(s)["autopilot"] = dict(state or {})
+        else:
+            _health_autopilot = dict(state) if state else None
+
+
 def reset_health_scopes() -> None:
     """Drop every per-scope health entry (test / engine teardown)."""
+    global _health_demand, _health_autopilot
     with _health_lock:
         _health_scopes.clear()
+        _health_demand = None
+        _health_autopilot = None
 
 
 def health_snapshot() -> dict[str, dict]:
@@ -731,6 +789,10 @@ def health_snapshot() -> dict[str, dict]:
             **{name: dict(entry)
                for name, entry in sorted(_health_scopes.items())},
         }
+        if _health_demand is not None:
+            out[""]["demand"] = dict(_health_demand)
+        if _health_autopilot is not None:
+            out[""]["autopilot"] = dict(_health_autopilot)
     out[""]["commit_queue_depth"] = int(commit_queue_depth.value())
     return out
 
@@ -804,6 +866,13 @@ def health_body() -> bytes:
             # many devices the solve shards over (1 = single-device).
             "mesh_devices": _health_mesh_devices,
         }
+        # Demand + autopilot columns appear only once published
+        # (--autopilot observe|on): probes of a daemon without the
+        # subsystem see a byte-unchanged body.
+        if _health_demand is not None:
+            body["demand"] = dict(_health_demand)
+        if _health_autopilot is not None:
+            body["autopilot"] = dict(_health_autopilot)
         if _health_scopes:
             body["cells"] = {
                 name: dict(entry)

@@ -197,6 +197,12 @@ class Scheduler:
         # guardrail, pins) at end-of-cycle — cycle thread only, no
         # wire, no fsync-per-record.
         self.statestore = statestore
+        # The fleet autopilot (kube_batch_tpu/autopilot/), wired by
+        # the CLI when --autopilot is observe|on: stepped once at
+        # end-of-cycle BEFORE the journal append, so the ladder rung
+        # it moved this cycle is the rung that survives a restart.
+        # None (the default) = subsystem absent, zero per-cycle cost.
+        self.autopilot = None
         # True while the CURRENT run_once is a quiesced skip
         # (mid-relist / breaker open): such cycles bypass the overrun
         # watchdog — their near-zero latency is not evidence of health.
@@ -1639,6 +1645,17 @@ class Scheduler:
         finally:
             if commit is not None:
                 commit.note_solve(False)
+            # The fleet autopilot's sense→donate→resolve→decide pass
+            # (doc/design/fleet-autopilot.md): end-of-cycle on the
+            # cycle thread, leader-gated inside, BEFORE the journal
+            # append so this cycle's ladder rung is the one that
+            # survives a restart.  A bug here degrades to "no
+            # rebalancing", never to a broken cycle.
+            if self.autopilot is not None:
+                try:
+                    self.autopilot.step()
+                except Exception:
+                    logging.exception("autopilot step failed")
             # Durable operational memory: one end-of-cycle journal
             # append on the cycle thread (digest-deduped; no wire, no
             # fsync — statestore.append never raises).  Runs on

@@ -397,6 +397,12 @@ def collect_state(scheduler) -> dict:
     pins = scheduler.export_refusal_pins()
     if pins:
         state["hbm_pins"] = pins
+    autopilot = getattr(scheduler, "autopilot", None)
+    if autopilot is not None:
+        # The reclaim ladder's rung: a restarted leader mid-COOLDOWN
+        # must not wake up eager, and one that died CLAIMING must not
+        # double-claim (the restore degrades that rung to cooldown).
+        state["autopilot"] = autopilot.export_state()
     return state
 
 
@@ -443,6 +449,13 @@ def restore_state(
             summary["pins"] = scheduler.restore_refusal_pins(pins)
         except Exception:  # noqa: BLE001 — start blind, never crash
             log.exception("malformed refusal pins; starting blind")
+    ap_state = state.get("autopilot")
+    autopilot = getattr(scheduler, "autopilot", None)
+    if autopilot is not None and isinstance(ap_state, dict):
+        try:
+            summary["autopilot"] = autopilot.restore_state(ap_state)
+        except Exception:  # noqa: BLE001 — start blind, never crash
+            log.exception("malformed autopilot state; starting blind")
     metrics.state_adopted.inc(source)
     log.info("operational state adopted from %s: %s", source, summary)
     return summary

@@ -177,8 +177,20 @@ def fleet_body() -> dict:
     # -- rollups ---------------------------------------------------------
     states = []
     burning: list[dict] = []
+    pending_pods = 0
+    pending_gangs = 0
+    autopilot: dict[str, str] = {}
     for name, block in sorted(cells.items()):
         states.append(str(block.get("state", "ok")))
+        # The autopilot's demand column (doc/design/fleet-autopilot.md):
+        # per-cell rows carry the full vector; the rollup answers
+        # "how much is the FLEET starving for" in one line.
+        demand = block.get("demand") or {}
+        pending_pods += int(demand.get("pending_pods") or 0)
+        pending_gangs += int(demand.get("pending_gangs") or 0)
+        ap = block.get("autopilot") or {}
+        if ap.get("rung"):
+            autopilot[name] = str(ap["rung"])
         slo = block.get("slo") or {}
         for obj in slo.get("burning") or []:
             burn = ((slo.get("objectives") or {}).get(obj) or {}) \
@@ -191,6 +203,12 @@ def fleet_body() -> dict:
         hz = row["healthz"] or {}
         if hz:
             states.append(str(hz.get("state", "ok")))
+            demand = hz.get("demand") or {}
+            pending_pods += int(demand.get("pending_pods") or 0)
+            pending_gangs += int(demand.get("pending_gangs") or 0)
+            ap = hz.get("autopilot") or {}
+            if ap.get("rung"):
+                autopilot[url] = str(ap["rung"])
         for obj, st in (((row["slo"] or {}).get("objectives")) or {}) \
                 .items():
             if st.get("fast_burn"):
@@ -213,5 +231,10 @@ def fleet_body() -> dict:
             "burning": sorted(
                 burning, key=lambda b: -float(b["burn"])
             ),
+            "pending_pods": pending_pods,
+            "pending_gangs": pending_gangs,
+            # cell → ladder rung, only for cells running an autopilot:
+            # "the fleet is rebalancing — why?" starts here.
+            "autopilot": autopilot,
         },
     }

@@ -361,3 +361,15 @@ class SloEngine:
                 name for name, st in self._state.items()
                 if st["fast_burn"]
             )
+
+    def fast_burning(self, objective: str | None = None) -> bool:
+        """Consumer-facing burn read (the autopilot's pressure join):
+        is `objective` — or, when None, ANY objective — currently in
+        FAST burn?  Reads the last evaluate()'d state; it never
+        re-evaluates, so automated consumers polling every cycle see
+        exactly what /debug/slo shows."""
+        with self._lock:
+            if objective is not None:
+                st = self._state.get(objective)
+                return bool(st and st["fast_burn"])
+            return any(st["fast_burn"] for st in self._state.values())

@@ -18,7 +18,7 @@ def main() -> int:
 
     for key in ("first_cycle_ms", "e2e_cycle_ms_p50", "commit_pipeline",
                 "ingest_compare", "trace_overhead", "compile_artifacts",
-                "cells_aggregate", "slo", "shard"):
+                "cells_aggregate", "slo", "shard", "autopilot"):
         assert key in artifact, (
             f"artifact missing {key!r}; keys: {sorted(artifact)}"
         )
@@ -70,6 +70,17 @@ def main() -> int:
     assert shard.get("boundary_refused_1dev") is True, shard
     assert shard.get("big_admitted_8dev") is True, shard
 
+    # Presence + sanity only: the no-flap / rollback / hash-parity
+    # gates live in scripts/check_chaos_autopilot.py (make chaos); the
+    # smoke pins that every artifact RECORDS the closed-loop
+    # convergence figure next to its ideal-manual baseline.
+    ap = artifact["autopilot"]
+    assert "error" not in ap, ap
+    assert (ap.get("autopilot_ticks_to_converge") or 0) >= 1, ap
+    assert (ap.get("manual_ticks_to_converge") or 0) >= 1, ap
+    assert ap.get("claims", 0) >= 1, ap
+    assert ap.get("donations", 0) >= 1, ap
+
     ing = artifact["ingest_compare"]
     assert "error" not in ing, ing
     # Presence + sanity only: the >=3x/>=2x speed gates live in
@@ -103,7 +114,9 @@ def main() -> int:
         f"({ca.get('scaling')}x), slo+stitching "
         f"{slo.get('overhead_pct')}% overhead, sharded tier "
         f"{shard.get('devices')}-device peak ratio "
-        f"{shard.get('peak_ratio')}"
+        f"{shard.get('peak_ratio')}, autopilot converge "
+        f"{ap.get('autopilot_ticks_to_converge')} ticks vs manual "
+        f"{ap.get('manual_ticks_to_converge')}"
     )
     return 0
 

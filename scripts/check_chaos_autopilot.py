@@ -1,0 +1,149 @@
+#!/usr/bin/env python
+"""Assert the fleet-autopilot chaos acceptance criteria (make chaos)
+over two same-seed autopilot-ON runs, the --autopilot off parity run,
+and the pre-existing cells-scenario run:
+
+* both autopilot-on runs completed with zero invariant violations and
+  CONVERGED — the demand spike in the starved cell drained through
+  >=1 AUTOMATIC epoch-fenced capacity claim (no manual claim duty ran
+  at all: every claim in the sequence was opened by the rebalancer);
+* the reclaim protocol held under automation: every granted node was
+  re-celled to the claimant, >=1 claim rolled back (the straddle
+  partition darkened the donor mid-claim), zero claims left pending,
+  and >=1 claim asked for MULTIPLE nodes (the multi-node extension is
+  exercised, not just reachable);
+* partition safety: ZERO claims were OPENED strictly inside the
+  straddle window (the ladder holds its rung through a dark donor —
+  the claim that rolls back is the one opened BEFORE the window);
+* no flap: every claim targeted the starved cell (no reverse claim
+  from the donor ever opened — the hysteresis ladder never
+  oscillated into claiming back), and the ladder finished on a calm
+  rung (observe/armed, nothing stuck mid-claim);
+* donor invariants: the donor ended with >=1 donation served, its own
+  cell converged, and all cells' caps/fences held (the per-tick
+  checker ran both cells' writers);
+* same seed ⇒ same trace hash across the two autopilot-on runs (the
+  closed loop is deterministic), AND the --autopilot off run hashes
+  BYTE-IDENTICAL to the pre-existing cells run — every shared-path
+  change this subsystem made (claim schema, multi-node grants,
+  claimant-role reads) is decision-invisible when the autopilot is
+  disabled.
+"""
+
+import json
+import sys
+
+
+def _claims(run: dict) -> list:
+    return (run.get("reclaim") or {}).get("sequence") or []
+
+
+def _check_on_run(name: str, run: dict) -> None:
+    assert run["ok"], f"{name} violations: {run['violations']}"
+    assert run["converged_after_drain_ticks"] is not None, \
+        f"{name}: never converged"
+    ap = run.get("autopilot") or {}
+    assert ap.get("mode") == "on", f"{name}: autopilot was not on: {ap}"
+    cells = ap.get("cells") or {}
+    assert cells, f"{name}: no per-cell autopilot summary: {ap}"
+    claimants = {c: s for c, s in cells.items() if s.get("claims")}
+    assert claimants, f"{name}: the autopilot never claimed: {cells}"
+    # AUTOMATIC: the engine's manual claim duty is replaced wholesale
+    # in autopilot mode, so every claim in the protocol summary was
+    # opened by a rebalancer.
+    rc = run["reclaim"]
+    total_auto = sum(s.get("claims", 0) for s in cells.values())
+    assert rc["claims"] == total_auto, (
+        f"{name}: protocol saw {rc['claims']} claim(s) but the "
+        f"autopilots opened {total_auto}: {rc} vs {cells}"
+    )
+    assert rc["granted"] >= 1, f"{name}: no claim granted: {rc}"
+    assert rc["rolled_back"] >= 1, \
+        f"{name}: no claim rolled back under the straddle: {rc}"
+    assert rc["pending"] == 0, f"{name}: claim(s) left in limbo: {rc}"
+    seq = _claims(run)
+    assert any(int(c.get("nodes", 1)) > 1 for c in seq), (
+        f"{name}: no multi-node claim was ever opened: {seq}"
+    )
+    for c in seq:
+        if c.get("state") == "granted":
+            granted = c.get("granted") or []
+            assert granted, f"{name}: granted claim moved no node: {c}"
+    pt = run["partitions"]
+    assert pt["straddle_rollbacks"] >= 1, (
+        f"{name}: no claim rolled back under a donor partition: {pt}"
+    )
+    window = pt.get("straddle_window")
+    assert window, f"{name}: no straddle window recorded: {pt}"
+    t0, t1 = window
+    inside = [c for c in seq if t0 < int(c["created"]) < t1]
+    assert not inside, (
+        f"{name}: claim(s) OPENED while the donor was dark "
+        f"{window}: {inside} — the ladder must hold through a "
+        "partition, not flap into re-claiming"
+    )
+    # No flap: one direction only.  Every claim targets the starved
+    # cell; the donor's own autopilot never counter-claimed.
+    targets = {c["to"] for c in seq}
+    assert len(targets) == 1, (
+        f"{name}: claims flapped across cells: {sorted(targets)}"
+    )
+    starved = targets.pop()
+    for cell, s in cells.items():
+        if cell != starved:
+            assert s.get("claims", 0) == 0, (
+                f"{name}: donor {cell} opened a reverse claim: {s}"
+            )
+            assert s.get("donations", 0) >= 1, (
+                f"{name}: donor {cell} never served a donation: {s}"
+            )
+        assert s.get("rung") in ("observe", "armed"), (
+            f"{name}: {cell} ladder finished mid-claim on "
+            f"{s.get('rung')}: {s}"
+        )
+
+
+def main(path_a: str, path_b: str, path_off: str,
+         path_cells: str) -> int:
+    with open(path_a, encoding="utf-8") as f:
+        a = json.load(f)
+    with open(path_b, encoding="utf-8") as f:
+        b = json.load(f)
+    for name, run in (("run1", a), ("run2", b)):
+        _check_on_run(name, run)
+    assert a["trace_hash"] == b["trace_hash"], (
+        f"same-seed autopilot runs diverged: "
+        f"{a['trace_hash']} != {b['trace_hash']}"
+    )
+    with open(path_off, encoding="utf-8") as f:
+        off = json.load(f)
+    with open(path_cells, encoding="utf-8") as f:
+        base = json.load(f)
+    assert off["ok"], f"autopilot-off run violations: {off['violations']}"
+    assert (off.get("autopilot") or {}).get("mode") == "off", (
+        "the parity run ran with the autopilot ON"
+    )
+    assert off["trace_hash"] == base["trace_hash"], (
+        "the autopilot moved the decision hash while DISABLED: "
+        f"{off['trace_hash']} != {base['trace_hash']} — the subsystem "
+        "must be decision-invisible when off"
+    )
+    rc, seq = a["reclaim"], _claims(a)
+    multi = sum(1 for c in seq if int(c.get("nodes", 1)) > 1)
+    print(
+        "chaos autopilot: ok — same-seed hash "
+        f"{a['trace_hash'][:16]}… reproduced with the loop closed; "
+        f"{rc['claims']} automatic claim(s) ({multi} multi-node), "
+        f"granted={rc['granted']} rolled-back={rc['rolled_back']} "
+        f"pending=0; zero claims opened inside the straddle window "
+        f"{a['partitions']['straddle_window']}; zero flap reversals; "
+        f"converged after {a['converged_after_drain_ticks']} drain "
+        "tick(s) vs the manual baseline's "
+        f"{base['converged_after_drain_ticks']}; --autopilot off "
+        "hashed byte-identical to the pre-autopilot cells run"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1], sys.argv[2], sys.argv[3], sys.argv[4]))

@@ -1,0 +1,570 @@
+"""Fleet autopilot (doc/design/fleet-autopilot.md), pinned at tier-1:
+
+* the hysteresis ladder's structural no-flap guarantees — oscillating
+  demand at the threshold never claims; sustained demand claims
+  exactly once then cools down; a restart degrades a persisted
+  CLAIMING rung to a full cooldown;
+* the demand signal — constraint-shaped aggregates from the cache
+  mirror (pending vector, gang count, starvation, nodes_needed);
+* the multi-node / fractional reclaim protocol extension on the real
+  wire — a partially-filled claim keeps what moved and closes as a
+  fractional expiry, an unfilled one rolls back to exactly nothing,
+  and the claimant-role listClaims view shows terminal states without
+  polluting the donor's pending-only view;
+* the closed loop end to end against a live ExternalCluster — a
+  starved cell's autopilot claims, the donor's autopilot drains and
+  offers, the grant resolves and the node changes cells;
+* partition-mid-claim — the ladder holds through a dark donor (no
+  double claim), adopts the TTL rollback, and re-arms for exactly one
+  new claim after heal;
+* the demand/autopilot columns on /healthz and the /debug/fleet
+  rollups.
+
+The full two-cell chaos drive runs in `make chaos`
+(examples/chaos-autopilot.json via scripts/check_chaos_autopilot.py).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import socket
+import types
+
+import pytest
+
+from kube_batch_tpu import metrics
+from kube_batch_tpu.api.resource import ResourceSpec
+from kube_batch_tpu.api.types import TaskStatus
+from kube_batch_tpu.autopilot import (
+    Autopilot,
+    AutopilotConfig,
+    DemandSignal,
+    ReclaimLadder,
+    demand_signal,
+)
+from kube_batch_tpu.cache.cache import SchedulerCache
+from kube_batch_tpu.cache.cluster import Node, Pod, PodGroup, Queue
+from kube_batch_tpu.client.adapter import (
+    CELL_LABEL,
+    StreamBackend,
+    WatchAdapter,
+)
+from kube_batch_tpu.client.external import ExternalCluster
+from kube_batch_tpu.models.workloads import GI
+
+SPEC = ResourceSpec(("cpu", "memory", "pods", "accelerator"))
+
+
+# -- the hysteresis ladder -----------------------------------------------
+
+def test_ladder_oscillating_demand_never_claims():
+    """A signal that dips every other evaluation resets the streak in
+    OBSERVE and the quiet counter in ARMED: zero claims, ever."""
+    ladder = ReclaimLadder(arm_after=2, quiet_after=2, cooldown_ticks=3)
+    fired = [ladder.evaluate(bool(i % 2)) for i in range(40)]
+    assert not any(fired)
+    assert ladder.rung == "observe"
+
+
+def test_ladder_oscillation_cannot_release_armed_early():
+    """Once armed, a single quiet blip under sustained pressure does
+    NOT release; only quiet_after consecutive quiet reads do."""
+    ladder = ReclaimLadder(arm_after=1, quiet_after=2)
+    ladder.evaluate(True)
+    assert ladder.rung == "armed"
+    assert ladder.evaluate(False) is False  # blip
+    assert ladder.rung == "armed"
+    assert ladder.evaluate(True) is True    # still armed, fires
+    assert ladder.evaluate(False) is False
+    assert ladder.evaluate(False) is False
+    assert ladder.rung == "observe"         # sustained quiet releases
+
+
+def test_ladder_sustained_demand_one_claim_then_cooldown():
+    ladder = ReclaimLadder(arm_after=2, quiet_after=2, cooldown_ticks=2)
+    assert ladder.evaluate(True) is False   # streak 1
+    assert ladder.evaluate(True) is False   # streak 2 -> armed
+    assert ladder.rung == "armed"
+    assert ladder.evaluate(True) is True    # fire
+    ladder.claim_opened()
+    assert ladder.rung == "claiming"
+    # In flight: sustained pressure cannot open a second claim.
+    assert not any(ladder.evaluate(True) for _ in range(10))
+    ladder.resolve("granted")
+    assert ladder.rung == "cooldown"
+    assert ladder.evaluate(True) is False   # cooldown 2 -> 1
+    assert ladder.evaluate(True) is False   # 1 -> 0: re-arms
+    assert ladder.rung == "armed"
+    assert ladder.evaluate(True) is True    # next burst may fire
+
+
+def test_ladder_cooldown_stands_down_when_quiet():
+    ladder = ReclaimLadder(arm_after=1, quiet_after=1, cooldown_ticks=1)
+    ladder.evaluate(True)
+    assert ladder.evaluate(True) is True
+    ladder.claim_opened()
+    ladder.resolve("rolled_back")
+    assert ladder.evaluate(False) is False
+    assert ladder.rung == "observe"
+
+
+def test_ladder_restore_degrades_claiming_to_cooldown():
+    src = ReclaimLadder(cooldown_ticks=4)
+    src.evaluate(True)
+    src.evaluate(True)
+    src.evaluate(True)
+    src.claim_opened()
+    dst = ReclaimLadder(cooldown_ticks=4)
+    note = dst.restore_state(src.export_state())
+    assert "degraded" in note
+    assert dst.rung == "cooldown" and dst.cooldown_left == 4
+    # Junk is a cold start, not a crash.
+    fresh = ReclaimLadder()
+    assert "ignored" in fresh.restore_state({"rung": "lol"})
+    assert fresh.rung == "observe"
+
+
+def test_ladder_restore_roundtrips_armed():
+    src = ReclaimLadder(arm_after=1)
+    src.evaluate(True)
+    dst = ReclaimLadder(arm_after=1)
+    dst.restore_state(src.export_state())
+    assert dst.rung == "armed"
+    assert dst.evaluate(True) is True
+
+
+# -- the demand signal ---------------------------------------------------
+
+class _FakeCache:
+    def __init__(self, nodes, pods):
+        self._nodes = {
+            name: types.SimpleNamespace(node=types.SimpleNamespace(
+                allocatable=alloc, name=name))
+            for name, alloc in nodes.items()
+        }
+        self._pods = {p.uid: p for p in pods}
+
+    @contextlib.contextmanager
+    def lock(self):
+        yield
+
+
+def _pod(uid, status, cpu, mem=GI, group=None, node=None, extra=None):
+    req = {"cpu": cpu, "memory": mem, "pods": 1, **(extra or {})}
+    return types.SimpleNamespace(uid=uid, name=uid, status=status,
+                                 request=req, group=group, node=node)
+
+
+def test_demand_signal_aggregates_the_pending_vector():
+    cache = _FakeCache(
+        {"n0": {"cpu": 8000.0, "memory": 16 * GI},
+         "n1": {"cpu": 8000.0, "memory": 16 * GI}},
+        [
+            _pod("p1", TaskStatus.PENDING, 2000.0, group="g1",
+                 extra={"accelerator": 2}),
+            _pod("p2", TaskStatus.PENDING, 3000.0, group="g1"),
+            _pod("p3", TaskStatus.PENDING, 500.0),
+            _pod("p4", TaskStatus.RUNNING, 4000.0, node="n0"),
+            _pod("p5", TaskStatus.BOUND, 1000.0, node="n1"),
+            # Terminal pods hold nothing and demand nothing.
+            _pod("p6", TaskStatus.SUCCEEDED, 9000.0),
+        ],
+    )
+    sig = demand_signal(cache)
+    assert sig.pending_pods == 3
+    assert sig.pending_gangs == 1
+    assert sig.pending_cpu_milli == 5500.0
+    assert sig.pending_device == 2.0
+    assert sig.used_cpu_milli == 5000.0
+    assert sig.alloc_cpu_milli == 16000.0
+    assert sig.nodes == 2
+    assert not sig.starved
+    assert sig.utilization == pytest.approx(5000.0 / 16000.0)
+    d = sig.as_dict()
+    assert d["pending_pods"] == 3 and d["starved"] is False
+
+
+def test_demand_signal_starvation_and_nodes_needed():
+    sig = DemandSignal(pending_cpu_milli=20000.0, used_cpu_milli=12000.0,
+                       alloc_cpu_milli=16000.0,
+                       alloc_mem_bytes=32 * GI, nodes=2)
+    assert sig.starved
+    # deficit = 20000 - free(4000) = 16000 -> 2 donor nodes of 8000.
+    assert sig.nodes_needed(8000.0, cap=4) == 2
+    assert sig.nodes_needed(8000.0, cap=1) == 1   # clamped
+    assert sig.nodes_needed(0.0, cap=4) == 1      # degenerate per-node
+    calm = DemandSignal(pending_cpu_milli=100.0, alloc_cpu_milli=16000.0,
+                        alloc_mem_bytes=GI)
+    assert not calm.starved
+    assert calm.nodes_needed(8000.0, cap=4) == 1
+
+
+# -- the multi-node / fractional protocol extension ----------------------
+
+def _cluster() -> ExternalCluster:
+    cl = ExternalCluster().start()
+    cl.add_queue(Queue(name="cell-a-q", cell="cell-a", uid="uid-q-a"))
+    cl.add_queue(Queue(name="cell-b-q", cell="cell-b", uid="uid-q-b"))
+    for cell, n in (("cell-a", "a-n0"), ("cell-a", "a-n1"),
+                    ("cell-a", "a-n2"), ("cell-b", "b-n0")):
+        cl.add_node(Node(
+            name=n, labels={CELL_LABEL: cell},
+            allocatable={"cpu": 8000, "memory": 16 * GI, "pods": 110},
+            uid=f"uid-{n}",
+        ))
+    return cl
+
+
+def _session(cl: ExternalCluster, cell: str | None):
+    a, b = socket.socketpair()
+    cl_r = a.makefile("r", encoding="utf-8")
+    cl_w = a.makefile("w", encoding="utf-8")
+    cl.attach(cl_r, cl_w)
+    cl.replay(cl_w)
+    backend = StreamBackend(
+        b.makefile("w", encoding="utf-8"), timeout=5.0,
+    )
+    if cell:
+        backend.set_cell(cell)
+    cache = SchedulerCache(
+        SPEC, binder=backend, evictor=backend, status_updater=backend,
+    )
+    adapter = WatchAdapter(
+        cache, b.makefile("r", encoding="utf-8"), backend=backend,
+        cell=cell,
+    ).start()
+    assert adapter.wait_for_sync(5.0)
+    return backend, cache, adapter
+
+
+def test_multinode_claim_partial_fill_closes_fractional():
+    """A 2-node claim with one node served by its deadline keeps the
+    moved node and closes as a FRACTIONAL expiry — never a rollback
+    that would strand the re-celled node, never an open-ended grant."""
+    cl = _cluster()
+    ba, _ca, _aa = _session(cl, "cell-a")
+    bb, _cb, _ab = _session(cl, "cell-b")
+    ba.set_epoch(ba.acquire_lease("a", ttl=30.0))
+    bb.set_epoch(bb.acquire_lease("b", ttl=30.0))
+
+    cl.claim_clock = 0
+    cid = bb.claim_capacity("cell-a", nodes=2, ttl_ticks=3)
+    listed = ba.list_claims()
+    assert [c["id"] for c in listed] == [cid]
+    assert listed[0]["nodes"] == 2 and listed[0]["granted"] == []
+    # The claimant-role view sees its own claim; the donor-role view
+    # of the CLAIMANT stays empty (a donor must never drain against
+    # its own outbound claim).
+    assert [c["id"] for c in bb.list_claims(role="claimant")] == [cid]
+    assert bb.list_claims() == []
+
+    ba.offer_capacity(cid, "a-n0")
+    claim = cl.reclaim_claims[cid]
+    assert claim["state"] == "pending"          # half-filled: still open
+    assert claim["granted"] == ["a-n0"]
+    assert cl.cell_of_node("a-n0") == "cell-b"  # but already re-celled
+
+    cl.claim_clock = 3
+    assert cl.expire_reclaims() == 0            # fractional ≠ rollback
+    claim = cl.reclaim_claims[cid]
+    assert claim["state"] == "granted" and claim["fractional"] is True
+    assert claim["resolved"] == 3
+    assert cl.reclaim_expired == 1
+    assert cl.cell_of_node("a-n0") == "cell-b"  # the grant sticks
+    # Terminal states surface on the claimant-role view only.
+    (seen,) = bb.list_claims(role="claimant")
+    assert seen["state"] == "granted" and seen["fractional"] is True
+    assert ba.list_claims() == []
+
+
+def test_multinode_claim_full_fill_grants_and_zero_fill_rolls_back():
+    cl = _cluster()
+    ba, _ca, _aa = _session(cl, "cell-a")
+    bb, _cb, _ab = _session(cl, "cell-b")
+    ba.set_epoch(ba.acquire_lease("a", ttl=30.0))
+    bb.set_epoch(bb.acquire_lease("b", ttl=30.0))
+
+    cl.claim_clock = 0
+    cid = bb.claim_capacity("cell-a", nodes=2, ttl_ticks=5)
+    ba.offer_capacity(cid, "a-n0")
+    assert cl.reclaim_claims[cid]["state"] == "pending"
+    ba.offer_capacity(cid, "a-n1")
+    claim = cl.reclaim_claims[cid]
+    assert claim["state"] == "granted"
+    assert claim["granted"] == ["a-n0", "a-n1"]
+    assert not claim.get("fractional")
+    assert claim["node"] == "a-n0"              # back-compat alias
+    assert cl.reclaim_granted == 1
+
+    # Zero offers by the deadline: a pure rollback, nothing moved.
+    cid2 = bb.claim_capacity("cell-a", nodes=2, ttl_ticks=2)
+    cl.claim_clock = 2
+    assert cl.expire_reclaims() == 1
+    c2 = cl.reclaim_claims[cid2]
+    assert c2["state"] == "rolled-back" and c2["node"] is None
+    assert c2["granted"] == [] and c2["resolved"] == 2
+    assert cl.cell_of_node("a-n2") == "cell-a"
+
+
+# -- the closed loop -----------------------------------------------------
+
+def _starve_cell_b(cl: ExternalCluster) -> None:
+    """Pending demand in cell-b that exceeds its whole allocatable."""
+    cl.submit(
+        PodGroup(name="spike", queue="cell-b-q", min_member=5,
+                 uid="uid-pg-spike"),
+        [Pod(name=f"spike-{i}", uid=f"uid-spike-{i}",
+             request={"cpu": 2500, "memory": GI, "pods": 1})
+         for i in range(5)],
+    )
+
+
+def _quiesce(cl, adapters) -> None:
+    import time
+
+    for _ in range(100):
+        if all(a.latest_rv >= cl._rv for a in adapters):
+            return
+        time.sleep(0.02)
+    raise AssertionError("adapters never caught up with the cluster")
+
+
+def test_autopilot_closes_the_loop_end_to_end():
+    """Starved claimant + donor autopilots against a live cluster:
+    sense -> arm -> claim -> donor drain/offer -> grant -> resolve ->
+    cooldown, with the node actually changing cells."""
+    cl = _cluster()
+    ba, ca, aa = _session(cl, "cell-a")
+    bb, cb, ab = _session(cl, "cell-b")
+    ba.set_epoch(ba.acquire_lease("a", ttl=30.0))
+    bb.set_epoch(bb.acquire_lease("b", ttl=30.0))
+    _starve_cell_b(cl)
+    _quiesce(cl, (aa, ab))
+
+    claimant = Autopilot(
+        cb, bb, "cell-b",
+        AutopilotConfig(donors=("cell-a",), arm_after=1, quiet_after=1,
+                        cooldown_ticks=2, claim_ttl_ticks=5,
+                        max_nodes_per_claim=2, require_slo_burn=False),
+    )
+    donor = Autopilot(
+        ca, ba, "cell-a",
+        AutopilotConfig(donors=("cell-b",), arm_after=1, quiet_after=1,
+                        cooldown_ticks=1, claim_ttl_ticks=5,
+                        require_slo_burn=False),
+        evict=ba.evict,
+    )
+    try:
+        cl.claim_clock = 0
+        rec = claimant.step()          # observe -> armed
+        assert "claim" not in rec
+        rec = claimant.step()          # armed + pressured: claim
+        assert rec["claim"]["from"] == "cell-a"
+        # 12500 pending vs 8000 alloc, free 8000 -> deficit 4500 ->
+        # one 8000-cpu donor node.
+        assert rec["claim"]["nodes"] == 1
+        assert claimant.ladder.rung == "claiming"
+        assert claimant.step() == {}   # in flight: no double claim
+
+        drec = donor.step()            # donor serves the claim
+        assert drec["donation"]["node"].startswith("a-n")
+        moved = drec["donation"]["node"]
+        assert cl.cell_of_node(moved) == "cell-b"
+        assert donor.counters["donations"] == 1
+        assert donor.ladder.rung == "observe"  # donor never pressured
+
+        rec = claimant.step()          # poll: terminal grant
+        assert rec["resolved"]["outcome"] == "granted"
+        assert rec["resolved"]["granted"] == [moved]
+        assert claimant.ladder.rung == "cooldown"
+        assert claimant.counters == {
+            "claims": 1, "granted": 1, "rolled_back": 0,
+            "expired": 0, "donations": 0,
+        }
+    finally:
+        metrics.reset_health_scopes()
+
+
+def test_autopilot_partition_mid_claim_rolls_back_and_rearms():
+    """The donor goes dark after the claim opens: the ladder HOLDS in
+    claiming (zero new claims) through the partition, adopts the TTL
+    rollback after heal, cools down, and re-arms for exactly ONE new
+    claim — never a double claim against the rolled-back one."""
+    cl = _cluster()
+    ba, _ca, aa = _session(cl, "cell-a")
+    bb, cb, ab = _session(cl, "cell-b")
+    ba.set_epoch(ba.acquire_lease("a", ttl=30.0))
+    bb.set_epoch(bb.acquire_lease("b", ttl=30.0))
+    _starve_cell_b(cl)
+    _quiesce(cl, (aa, ab))
+
+    claimant = Autopilot(
+        cb, bb, "cell-b",
+        AutopilotConfig(donors=("cell-a",), arm_after=1, quiet_after=1,
+                        cooldown_ticks=2, claim_ttl_ticks=2,
+                        require_slo_burn=False),
+    )
+    try:
+        cl.claim_clock = 0
+        claimant.step()
+        rec = claimant.step()
+        first = rec["claim"]["claim"]
+        assert claimant.counters["claims"] == 1
+
+        # PARTITION: every wire read fails; the donor never answers.
+        claimant.backend = types.SimpleNamespace(
+            list_claims=lambda role=None: (_ for _ in ()).throw(
+                ConnectionError("partitioned")),
+            claim_capacity=lambda *a, **k: (_ for _ in ()).throw(
+                ConnectionError("partitioned")),
+            offer_capacity=lambda *a, **k: (_ for _ in ()).throw(
+                ConnectionError("partitioned")),
+        )
+        for tick in (1, 2, 3):
+            cl.claim_clock = tick
+            cl.expire_reclaims()       # TTL fires at tick 2
+            out = claimant.step()
+            assert "claim" not in out  # dark: rung held, no re-claim
+        assert claimant.counters["claims"] == 1
+        assert cl.reclaim_claims[first]["state"] == "rolled-back"
+        assert claimant.ladder.rung == "claiming"
+
+        # HEAL: adopt the rollback, cool down, re-arm, re-claim once.
+        claimant.backend = bb
+        rec = claimant.step()
+        assert rec["resolved"]["outcome"] == "rolled_back"
+        assert claimant.ladder.rung == "cooldown"
+        claimant.step()                # cooldown expires -> armed
+        rec = claimant.step()
+        second = rec["claim"]["claim"]
+        assert second != first
+        assert claimant.counters["claims"] == 2
+        assert claimant.counters["rolled_back"] == 1
+        # Exactly two claims ever reached the cluster.
+        assert sorted(cl.reclaim_claims) == sorted([first, second])
+    finally:
+        metrics.reset_health_scopes()
+
+
+def test_autopilot_observe_mode_publishes_but_never_claims():
+    cl = _cluster()
+    bb, cb, ab = _session(cl, "cell-b")
+    bb.set_epoch(bb.acquire_lease("b", ttl=30.0))
+    _starve_cell_b(cl)
+    _quiesce(cl, (ab,))
+    ap = Autopilot(
+        cb, bb, "cell-b",
+        AutopilotConfig(mode="observe", donors=("cell-a",),
+                        arm_after=1, require_slo_burn=False),
+    )
+    try:
+        for _ in range(5):
+            assert ap.step() == {}
+        assert ap.counters["claims"] == 0
+        assert ap.ladder.rung == "observe"
+        assert cl.reclaim_claims == {}
+        # ... but the demand column is live.
+        snap = metrics.health_snapshot()
+        assert snap[""]["demand"]["starved"] is True
+        assert snap[""]["autopilot"]["mode"] == "observe"
+    finally:
+        metrics.reset_health_scopes()
+
+
+def test_autopilot_is_leader_gate_blocks_followers():
+    cl = _cluster()
+    bb, cb, ab = _session(cl, "cell-b")
+    _starve_cell_b(cl)
+    _quiesce(cl, (ab,))
+    ap = Autopilot(
+        cb, bb, "cell-b",
+        AutopilotConfig(donors=("cell-a",), arm_after=1,
+                        require_slo_burn=False),
+        is_leader=lambda: False,
+    )
+    try:
+        assert ap.step() == {}
+        assert ap.last_signal is None       # never even sensed
+        assert metrics.health_snapshot().get("", {}).get("demand") \
+            is None
+    finally:
+        metrics.reset_health_scopes()
+
+
+def test_autopilot_state_rides_the_statestore():
+    """collect_state/restore_state round-trip the ladder rung through
+    the scheduler's journal seam, degrading claiming to cooldown."""
+    from kube_batch_tpu.statestore import collect_state, restore_state
+
+    cache = _FakeCache({}, [])
+    ap = Autopilot(cache, None, "cell-x",
+                   AutopilotConfig(arm_after=1, require_slo_burn=False))
+    ap.ladder.evaluate(True)
+    ap.ladder.evaluate(True)
+    ap.ladder.claim_opened()
+    scheduler = types.SimpleNamespace(
+        health=None,
+        guardrails=types.SimpleNamespace(export_state=lambda: {}),
+        export_refusal_pins=lambda: [],
+        autopilot=ap,
+    )
+    state = collect_state(scheduler)
+    assert state["autopilot"]["ladder"]["rung"] == "claiming"
+
+    ap2 = Autopilot(cache, None, "cell-x",
+                    AutopilotConfig(require_slo_burn=False))
+    scheduler2 = types.SimpleNamespace(autopilot=ap2)
+    summary = restore_state(state, scheduler=scheduler2)
+    assert "autopilot" in summary
+    assert ap2.ladder.rung == "cooldown"
+    # Malformed journals degrade to a cold start, never a crash.
+    ap3 = Autopilot(cache, None, "cell-x", AutopilotConfig())
+    scheduler3 = types.SimpleNamespace(autopilot=ap3)
+    restore_state({"autopilot": {"ladder": "junk"}}, scheduler=scheduler3)
+    assert ap3.ladder.rung == "observe"
+
+
+# -- observability surfaces ----------------------------------------------
+
+def test_reclaim_outcome_counter_and_health_columns():
+    base = {
+        o: metrics.reclaim_claims.value(o)
+        for o in ("granted", "rolled_back", "expired")
+    }
+    metrics.note_reclaim_outcome("granted")
+    metrics.note_reclaim_outcome("rolled_back")
+    metrics.note_reclaim_outcome("expired")
+    metrics.note_reclaim_outcome("granted")
+    assert metrics.reclaim_claims.value("granted") == \
+        base["granted"] + 2
+    assert metrics.reclaim_claims.value("rolled_back") == \
+        base["rolled_back"] + 1
+    assert metrics.reclaim_claims.value("expired") == \
+        base["expired"] + 1
+
+
+def test_fleet_pane_rolls_up_demand_and_autopilot_rungs():
+    from kube_batch_tpu.trace.fleet import fleet_body
+
+    try:
+        metrics.set_pending_demand(
+            {"pending_pods": 3, "pending_gangs": 1, "starved": True},
+            scope="cell-a",
+        )
+        metrics.set_pending_demand(
+            {"pending_pods": 2, "pending_gangs": 2, "starved": False},
+            scope="cell-b",
+        )
+        metrics.set_autopilot_state(
+            {"mode": "on", "rung": "armed"}, scope="cell-a",
+        )
+        body = fleet_body()
+        fleet = body["fleet"]
+        assert fleet["pending_pods"] == 5
+        assert fleet["pending_gangs"] == 3
+        assert fleet["autopilot"] == {"cell-a": "armed"}
+        # Per-cell rows carry the full vector.
+        assert body["cells"]["cell-a"]["demand"]["pending_pods"] == 3
+        assert body["cells"]["cell-b"]["demand"]["starved"] is False
+    finally:
+        metrics.reset_health_scopes()
