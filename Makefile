@@ -163,9 +163,13 @@ chaos:
 	JAX_PLATFORMS=cpu $(PY) -m kube_batch_tpu.chaos --seed 11 --ticks 32 \
 	    --scenario examples/chaos-guardrail.json --wire-commit pipelined \
 	    --mesh-devices 8 --quiet > /tmp/kb-chaos-mesh.json
+	JAX_PLATFORMS=cpu $(PY) -m kube_batch_tpu.chaos --seed 11 --ticks 32 \
+	    --scenario examples/chaos-guardrail.json --wire-commit pipelined \
+	    --joint-solve on --quiet > /tmp/kb-chaos-joint.json
 	$(PY) scripts/check_chaos_pipelined.py /tmp/kb-chaos-pipelined-1.json \
 	    /tmp/kb-chaos-pipelined-2.json /tmp/kb-chaos-packfull.json \
-	    /tmp/kb-chaos-ingestevent.json /tmp/kb-chaos-mesh.json
+	    /tmp/kb-chaos-ingestevent.json /tmp/kb-chaos-mesh.json \
+	    /tmp/kb-chaos-joint.json
 	JAX_PLATFORMS=cpu $(PY) -m kube_batch_tpu.chaos --seed 13 --ticks 24 \
 	    --scenario examples/chaos-failover.json --wire-commit pipelined \
 	    --quiet > /tmp/kb-chaos-failover-1.json
@@ -273,6 +277,7 @@ verify:
 	JAX_PLATFORMS=cpu $(PY) scripts/check_compile_artifacts.py
 	$(PY) -c "import __graft_entry__ as g; g.entry()"
 	$(PY) scripts/check_shard_bench.py
+	$(PY) scripts/check_joint_bench.py
 	$(MAKE) chaos
 	$(MAKE) bench-smoke
 

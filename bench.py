@@ -397,6 +397,21 @@ def run_bare_headline(jax) -> dict:
     }
 
 
+def _cycle_flags() -> dict:
+    """The env-opted program variants the daemon honors at construction
+    (scheduler.py · __init__: KB_TPU_COMPACT_WIRE, KB_TPU_JOINT_SOLVE).
+    The bench must build the SAME program — a number measured (or an
+    artifact banked) for a program the daemon never runs is worse than
+    no number.  tests/test_program_identity.py pins bench↔daemon
+    StableHLO identity across these flags."""
+    import os
+
+    return {
+        "compact_wire": os.environ.get("KB_TPU_COMPACT_WIRE") == "1",
+        "joint": os.environ.get("KB_TPU_JOINT_SOLVE") == "1",
+    }
+
+
 def run_config(jax, n: int, timed_iters: int = 8) -> dict:
     """One BASELINE config: pack + fused-pipeline solve, timed.
 
@@ -440,7 +455,8 @@ def run_config(jax, n: int, timed_iters: int = 8) -> dict:
          f"{meta.num_real_tasks}x{meta.num_real_nodes})")
 
     policy, _ = build_policy(default_conf())
-    jitted = jax.jit(make_cycle_solver(policy, CONFIG_ACTIONS[n]))
+    flags = _cycle_flags()
+    jitted = jax.jit(make_cycle_solver(policy, CONFIG_ACTIONS[n], **flags))
     state0 = init_state(snap)
 
     # AOT path: trace+compile explicitly, so (a) compile time excludes
@@ -468,7 +484,7 @@ def run_config(jax, n: int, timed_iters: int = 8) -> dict:
         pass
     cycle_fn = compiled
     t0 = time.perf_counter()
-    state, evict_masks, _job_ready, _diag = cycle_fn(snap, state0)
+    state, evict_out, _job_ready, _diag = cycle_fn(snap, state0)
     final = np.asarray(state.task_state)
     first_exec_s = time.perf_counter() - t0
     _log(f"  config {n}: compile {compile_s:.1f}s + first exec "
@@ -478,12 +494,18 @@ def run_config(jax, n: int, timed_iters: int = 8) -> dict:
     init_np = np.asarray(state0.task_state)[: meta.num_real_tasks]
     fin_np = final[: meta.num_real_tasks]
     placed = int(np.sum((init_np == pend) & (fin_np != pend)))
-    evicted = int(
-        sum(
-            np.sum(np.asarray(m)[: meta.num_real_tasks])
-            for m in evict_masks.values()
+    if flags["compact_wire"]:
+        # the wire dict folds per-action masks into one code array
+        evicted = int(np.sum(
+            np.asarray(evict_out["evict_code"])[: meta.num_real_tasks] > 0
+        ))
+    else:
+        evicted = int(
+            sum(
+                np.sum(np.asarray(m)[: meta.num_real_tasks])
+                for m in evict_out.values()
+            )
         )
-    )
 
     times = []
     for _ in range(timed_iters):
@@ -793,6 +815,20 @@ def _run_daemon_phases(jax, n, cache, sim, conf_path, steady_cycles) -> dict:
     except Exception as exc:  # noqa: BLE001 — degrade, never die
         out["shard"] = {"error": str(exc)[:300]}
     emit_partial(shard=out["shard"])
+
+    # -- joint single-solve tier (doc/design/joint-solve.md) ------------
+    # Every daemon artifact records the one-solve figure: the steady
+    # drf world's sequential-vs-joint p99 at mesh 1 and mesh 8, with
+    # decision parity — the >=1.5x GATE lives in
+    # scripts/check_joint_bench.py (make verify), run AS that script
+    # in a fresh subprocess for the same reason as the shard tier (the
+    # 8-device virtual mesh arms at backend init).  A tight budget
+    # drops the ungated scale section, not the tier.
+    try:
+        out["joint"] = run_joint_bench(smoke=_budget_left() <= 240.0)
+    except Exception as exc:  # noqa: BLE001 — degrade, never die
+        out["joint"] = {"error": str(exc)[:300]}
+    emit_partial(joint=out["joint"])
 
     # -- multi-cell aggregate (doc/design/multi-cell.md) ----------------
     # Every daemon artifact records the 2-cell scale-out figure: two
@@ -1802,6 +1838,33 @@ def run_shard_bench(smoke: bool = False) -> dict:
     if out.returncode != 0:
         raise RuntimeError(
             f"check_shard_bench --json rc={out.returncode}: "
+            f"{(out.stderr or out.stdout)[-300:]}"
+        )
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def run_joint_bench(smoke: bool = False) -> dict:
+    """The joint single-solve figure — sequential vs joint steady p99
+    at mesh 1 and mesh 8 with decision parity — run AS
+    scripts/check_joint_bench.py in a fresh subprocess so the
+    artifact's number and the verify gate's number can never diverge
+    in method (and because the 8-device virtual CPU mesh is read once
+    at backend init; same constraint as run_shard_bench)."""
+    import subprocess
+
+    script = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "scripts", "check_joint_bench.py",
+    )
+    cmd = [sys.executable, script, "--json"]
+    if smoke:
+        cmd.append("--smoke")
+    out = subprocess.run(
+        cmd, capture_output=True, text=True, timeout=900,
+    )
+    if out.returncode != 0:
+        raise RuntimeError(
+            f"check_joint_bench --json rc={out.returncode}: "
             f"{(out.stderr or out.stdout)[-300:]}"
         )
     return json.loads(out.stdout.strip().splitlines()[-1])

@@ -40,12 +40,19 @@ def non_besteffort_eligible(policy):
     return eligible
 
 
-def make_backfill_solver(policy, max_rounds: int | None = None):
-    def eligible(snap, state):  # noqa: ARG001 — backfill has no queue/job gate
-        return besteffort_mask(snap)
+def backfill_eligible(snap, state):  # noqa: ARG001 — no queue/job gate
+    """bool[T]: best-effort tasks are exclusively backfill's."""
+    return besteffort_mask(snap)
 
-    def zero_score(snap, state):  # noqa: ARG001
-        return jnp.zeros((snap.num_tasks, snap.num_nodes), jnp.float32)
+
+def zero_score(snap, state):  # noqa: ARG001
+    """f32[T, N] zeros: the reference takes the first feasible node;
+    round-robin tie dealing spreads the zero-score ties."""
+    return jnp.zeros((snap.num_tasks, snap.num_nodes), jnp.float32)
+
+
+def make_backfill_solver(policy, max_rounds: int | None = None):
+    eligible = backfill_eligible
 
     def solve(snap, state):
         state = policy.setup_state(snap, state)

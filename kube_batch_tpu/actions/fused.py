@@ -30,7 +30,8 @@ from kube_batch_tpu.ops.assignment import AllocState
 
 
 def make_cycle_solver(
-    policy, action_names: Sequence[str], compact_wire: bool = False
+    policy, action_names: Sequence[str], compact_wire: bool = False,
+    joint: bool = False,
 ):
     """(snap, state) -> (state, evict_masks, job_ready, diag) — the
     full cycle: final AllocState, per-evicting-action RELEASING masks,
@@ -58,8 +59,20 @@ def make_cycle_solver(
     Opt-in (KB_TPU_COMPACT_WIRE=1) because it changes the compiled
     program: the default must keep replaying the persistent cache's
     entries.
+
+    `joint=True` returns the SAME (state, evict_masks|wire, job_ready,
+    diag) contract computed by the single joint constraint solve
+    (ops/joint.py) instead of the chained per-action kernels — opt-in
+    (KB_TPU_JOINT_SOLVE=1 / --joint-solve) for the same artifact-bank
+    reason.  Only the four built-in action classes can be folded into
+    the tier list; a custom action registered under a built-in name
+    raises ValueError here, which sends the scheduler down the
+    sequential path exactly like a missing solver_factory would.
     """
     from kube_batch_tpu.framework.plugin import get_action
+
+    if joint:
+        return _make_joint_cycle(policy, action_names, compact_wire)
 
     solvers = []
     for name in action_names:
@@ -123,10 +136,182 @@ def make_cycle_solver(
     return cycle
 
 
-def make_full_pipeline(policy):
+def build_joint_phases(policy, action_names: Sequence[str]):
+    """Tier list for the joint solve (ops/joint.py): conf order becomes
+    constraint bands — allocate's idle+future auctions, backfill's
+    best-effort auction, preempt's inter/intra-job eviction bands,
+    reclaim's cross-queue band — each band built from the SAME mask
+    factories its sequential action uses, plus the gated post-eviction
+    admission sweep when any eviction band is configured (the one
+    formulation gain the sequential order cannot express)."""
+    from kube_batch_tpu.actions.backfill import (
+        backfill_eligible,
+        non_besteffort_eligible,
+        zero_score,
+    )
+    from kube_batch_tpu.actions.preempt import (
+        preempt_eligible,
+        preempt_victim_fn,
+        preempt_victim_fn_intra,
+        starving_jobs_mask,
+        wanting_jobs_mask,
+    )
+    from kube_batch_tpu.actions.reclaim import reclaim_victim_fn
+    from kube_batch_tpu.ops.joint import AuctionPhase, EvictPhase
+
+    alloc_elig = non_besteffort_eligible(policy)
+    max_rounds = getattr(policy, "max_rounds", None)
+    phases = []
+    for i, name in enumerate(action_names):
+        code = i + 1  # same attribution codes as the compact-wire fold
+        if name == "allocate":
+            for use_future in (False, True):
+                phases.append(AuctionPhase(
+                    score_fn=policy.score_fn,
+                    eligible_fn=alloc_elig,
+                    use_future=use_future,
+                    max_steps=max_rounds,
+                    score_quantum=policy.score_quantum,
+                ))
+        elif name == "backfill":
+            phases.append(AuctionPhase(
+                score_fn=zero_score,
+                eligible_fn=backfill_eligible,
+                use_future=False,
+            ))
+        elif name == "preempt":
+            elig = preempt_eligible(policy)
+            phases.append(EvictPhase(
+                victim_fn=preempt_victim_fn(policy),
+                starving_fn=starving_jobs_mask(policy),
+                eligible_fn=elig,
+                evict_code=code,
+            ))
+            phases.append(EvictPhase(
+                victim_fn=preempt_victim_fn_intra(policy),
+                starving_fn=wanting_jobs_mask(policy),
+                eligible_fn=elig,
+                evict_code=code,
+            ))
+        elif name == "reclaim":
+            phases.append(EvictPhase(
+                victim_fn=reclaim_victim_fn(policy),
+                starving_fn=wanting_jobs_mask(policy),
+                eligible_fn=alloc_elig,
+                evict_code=code,
+            ))
+        else:
+            raise ValueError(
+                f"action {name!r} has no joint-solve band"
+            )
+    if any(isinstance(ph, EvictPhase) for ph in phases):
+        # Post-eviction admission: one more future-capacity auction
+        # over the freed resources.  Sequentially unreachable — the
+        # placement actions already ran, and the eviction kernels'
+        # per-cycle `tried` latch never revisits a preemptor that
+        # failed BEFORE a later victim freed surplus.  Gated on "some
+        # eviction actually landed" so eviction-free cycles stay
+        # bit-identical to the sequential pipeline.
+        phases.append(AuctionPhase(
+            score_fn=policy.score_fn,
+            eligible_fn=alloc_elig,
+            use_future=True,
+            max_steps=max_rounds,
+            score_quantum=policy.score_quantum,
+            gated_on_evictions=True,
+        ))
+    return phases
+
+
+def _make_joint_cycle(
+    policy, action_names: Sequence[str], compact_wire: bool
+):
+    """The joint-solve twin of the sequential cycle: same
+    (state, evict_masks|wire, job_ready, diag) contract, computed by
+    ONE `joint_rounds` solve with cycle setup hoisted out of the
+    tiers."""
+    from kube_batch_tpu.framework.plugin import get_action
+    from kube_batch_tpu.actions.allocate import AllocateAction
+    from kube_batch_tpu.actions.backfill import BackfillAction
+    from kube_batch_tpu.actions.preempt import PreemptAction
+    from kube_batch_tpu.actions.reclaim import ReclaimAction
+    from kube_batch_tpu.ops.joint import joint_rounds
+
+    builtin = {
+        "allocate": AllocateAction,
+        "backfill": BackfillAction,
+        "preempt": PreemptAction,
+        "reclaim": ReclaimAction,
+    }
+    action_names = tuple(action_names)
+    evicting_names = []
+    for name in action_names:
+        cls = builtin.get(name)
+        if cls is None or type(get_action(name)) is not cls:
+            # A custom action (or a custom class shadowing a built-in
+            # name) cannot be folded into the tier list — refuse, and
+            # the scheduler takes the sequential path instead.
+            raise ValueError(
+                f"action {name!r} is not a built-in solver; "
+                "the joint solve cannot fold it"
+            )
+        if getattr(cls, "evicting", False):
+            evicting_names.append(name)
+    phases = build_joint_phases(policy, action_names)
+
+    def cycle(snap, state: AllocState):
+        import jax.numpy as jnp
+
+        from kube_batch_tpu.framework.fit_errors import failure_counts
+
+        state = policy.setup_state(snap, state)
+        pred = policy.predicate_mask(snap)
+        state, evict_code = joint_rounds(
+            snap,
+            state,
+            phases,
+            pred,
+            policy.rank_fn,
+            snap.eps,
+            dyn_predicate_fn=policy.dyn_predicate,
+            dyn_predicate_row_fn=policy.dyn_predicate_row,
+            global_serialize_fn=policy.global_serialize_fn,
+            domain_serialize_fn=policy.domain_serialize_fn,
+        )
+        job_ready = policy.job_ready_mask(snap, state)
+        # Same in-program diagnosis as the sequential cycle (see the
+        # compile-surface note there — the subset form is deliberately
+        # NOT wired).
+        dyn = policy.dynamic_predicate_fn(snap, state, immediate=True)
+        diag = failure_counts(
+            snap, state, pred if dyn is None else pred & dyn
+        )
+        if compact_wire:
+            node_dtype = (
+                jnp.int16 if snap.num_nodes < 32768 else jnp.int32
+            )
+            wire = {
+                "task_state": state.task_state.astype(jnp.uint8),
+                "task_node": state.task_node.astype(node_dtype),
+                "evict_code": evict_code.astype(jnp.uint8),
+            }
+            return state, wire, job_ready, diag
+        evict_masks = {
+            name: (evict_code == (action_names.index(name) + 1))
+            & snap.task_mask
+            for name in evicting_names
+        }
+        return state, evict_masks, job_ready, diag
+
+    return cycle
+
+
+def make_full_pipeline(policy, joint: bool = False):
     """The flagship four-action pipeline in the reference's canonical
     order (allocate, backfill, preempt, reclaim — scheduler.conf's
     superset config), fused."""
     from kube_batch_tpu.actions import factory as _factory  # noqa: F401
 
-    return make_cycle_solver(policy, ("allocate", "backfill", "preempt", "reclaim"))
+    return make_cycle_solver(
+        policy, ("allocate", "backfill", "preempt", "reclaim"), joint=joint
+    )

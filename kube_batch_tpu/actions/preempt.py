@@ -82,17 +82,9 @@ def snapshot_victims(snap, state):
     )
 
 
-def make_preempt_solver(policy, max_iters: int | None = None):
-    """(snap, state) -> state with victims RELEASING and preemptors
-    PIPELINED — the pure transactional sweep.
-
-    Two phases, like the reference (actions/preempt/preempt.go ·
-    Execute): phase 1 preempts BETWEEN jobs of one queue (job-rank
-    gated); phase 2 preempts WITHIN one job — a higher-priority pending
-    task displaces its own job's lower-priority running task, under the
-    same tiered vetoes (gang's minMember-survival veto in particular,
-    so a gang below its floor never cannibalises itself).
-    """
+def preempt_victim_fn(policy):
+    """victim_fn for phase 1 — BETWEEN jobs of one queue (job-rank
+    gated); shared by the sequential solver and the joint tier list."""
 
     def victim_fn(snap, state, p):
         tq = task_queue_of(snap)
@@ -107,15 +99,26 @@ def make_preempt_solver(policy, max_iters: int | None = None):
             & policy.preemptable_mask(snap, state, p)
         )
 
+    return victim_fn
+
+
+def preempt_victim_fn_intra(policy):
+    """victim_fn for phase 2 — victims from the preemptor's OWN job,
+    strictly lower task priority (preempt.go's second loop)."""
+
     def victim_fn_intra(snap, state, p):
-        # Phase 2: victims from the preemptor's OWN job, strictly lower
-        # task priority (preempt.go's second loop).
         return (
             snapshot_victims(snap, state)
             & (snap.task_job == snap.task_job[p])
             & (snap.task_prio < snap.task_prio[p])
             & policy.preemptable_mask(snap, state, p)
         )
+
+    return victim_fn_intra
+
+
+def preempt_eligible(policy):
+    """The preemptor gate both phases share."""
 
     def eligible(snap, state):
         # Within-queue preemption is exempt from the Overused gate (the
@@ -130,6 +133,23 @@ def make_preempt_solver(policy, max_iters: int | None = None):
         tj = jnp.clip(snap.task_job, 0, snap.num_jobs - 1)
         return jv[tj] & (snap.task_job >= 0) & ~besteffort_mask(snap)
 
+    return eligible
+
+
+def make_preempt_solver(policy, max_iters: int | None = None):
+    """(snap, state) -> state with victims RELEASING and preemptors
+    PIPELINED — the pure transactional sweep.
+
+    Two phases, like the reference (actions/preempt/preempt.go ·
+    Execute): phase 1 preempts BETWEEN jobs of one queue (job-rank
+    gated); phase 2 preempts WITHIN one job — a higher-priority pending
+    task displaces its own job's lower-priority running task, under the
+    same tiered vetoes (gang's minMember-survival veto in particular,
+    so a gang below its floor never cannibalises itself).
+    """
+    victim_fn = preempt_victim_fn(policy)
+    victim_fn_intra = preempt_victim_fn_intra(policy)
+    eligible = preempt_eligible(policy)
     # Phase 2 serves any valid job with pending work — including Ready
     # jobs whose higher-priority members wait behind lower-priority
     # running ones.

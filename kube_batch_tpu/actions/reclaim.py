@@ -30,13 +30,9 @@ from kube_batch_tpu.actions.preempt import (
 )
 
 
-def make_reclaim_solver(policy, max_iters: int | None = None):
-    # Any valid job with pending work may reclaim — the stop condition
-    # is queue-level (its queue reaching deserved → Overused, via the
-    # eligibility gate), NOT job-level gang readiness: reclaim's purpose
-    # is pushing each queue up to its fair share (≙ reclaim.go looping
-    # every pending task of every non-overused queue).
-    wanting = wanting_jobs_mask(policy)
+def reclaim_victim_fn(policy):
+    """Cross-queue victim gate — shared by the sequential solver and
+    the joint tier list."""
 
     def victim_fn(snap, state, p):
         # Inline stop-at-deserved (≙ reclaim.go's own check on the
@@ -58,6 +54,18 @@ def make_reclaim_solver(policy, max_iters: int | None = None):
             & victim_stays_above_deserved(snap, state)
             & policy.reclaimable_mask(snap, state, p)
         )
+
+    return victim_fn
+
+
+def make_reclaim_solver(policy, max_iters: int | None = None):
+    # Any valid job with pending work may reclaim — the stop condition
+    # is queue-level (its queue reaching deserved → Overused, via the
+    # eligibility gate), NOT job-level gang readiness: reclaim's purpose
+    # is pushing each queue up to its fair share (≙ reclaim.go looping
+    # every pending task of every non-overused queue).
+    wanting = wanting_jobs_mask(policy)
+    victim_fn = reclaim_victim_fn(policy)
 
     def solve(snap, state):
         state = policy.setup_state(snap, state)

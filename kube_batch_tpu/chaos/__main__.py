@@ -121,6 +121,19 @@ def build_parser() -> argparse.ArgumentParser:
                         "identically at any device count (make chaos "
                         "pins 1 vs 8).  Default: adopt from a replayed "
                         "trace's meta header, else 1")
+    p.add_argument("--joint-solve", choices=("on", "off"), default=None,
+                   help="cycle-solver dimension (doc/design/"
+                        "joint-solve.md): 'on' runs the scheduler "
+                        "under test with the joint single-solve cycle "
+                        "(KB_TPU_JOINT_SOLVE=1), 'off' forces the "
+                        "sequential four-pass program.  The joint "
+                        "solve is decision-invisible wherever the "
+                        "sequential outcome is policy-complete, so "
+                        "eviction-free seeds must hash identically "
+                        "under both (make chaos pins it); where it "
+                        "admits MORE (post-eviction sweep) the "
+                        "divergence is the documented improvement.  "
+                        "Default: inherit the environment")
     p.add_argument("--compile-bank", choices=("auto", "on", "off"),
                    default="auto",
                    help="AOT compile-artifact bank dimension "
@@ -198,6 +211,15 @@ def main(argv: list[str] | None = None) -> int:
     from kube_batch_tpu.cli import honor_jax_platforms
 
     honor_jax_platforms()
+    if args.joint_solve is not None:
+        # The scheduler under test reads the env var at construction;
+        # both engines (classic and cells) build their schedulers
+        # after this point.
+        import os
+
+        os.environ["KB_TPU_JOINT_SOLVE"] = (
+            "1" if args.joint_solve == "on" else "0"
+        )
     events, scenario, faults = (None, None, None)
     cell_spec, cell_workloads = None, None
     if args.scenario:

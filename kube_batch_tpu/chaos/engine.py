@@ -190,6 +190,12 @@ class ChaosResult:
     #: reads the device count to prove the dimension actually ran
     #: sharded while the trace hash stayed put.
     mesh: dict | None = None
+    #: Joint-solve observability: whether KB_TPU_JOINT_SOLVE was on
+    #: for the run's schedulers and whether the fused (joint) cycle
+    #: actually served — the joint-parity check reads this to prove
+    #: the dimension ran the one-solve program, not the per-action
+    #: fallback, while the trace hash stayed put.
+    joint: dict | None = None
     #: Crash-restart observability (None unless the crash_restart
     #: fault ran): per-restart restore records (pre/post quarantine
     #: states, refusal pins, breaker state, adoption source, wire
@@ -230,6 +236,7 @@ class ChaosResult:
             "health": self.health,
             "pack": self.pack,
             "mesh": self.mesh,
+            "joint": self.joint,
             "restart": self.restart,
             "ingest": self.ingest,
             "trace": self.trace,
@@ -1718,6 +1725,7 @@ class ChaosEngine:
             health=self._health_summary(),
             pack=self._pack_summary(),
             mesh=self._mesh_summary(),
+            joint=self._joint_summary(),
             restart=self._restart_summary(),
             ingest=self._ingest_summary(),
             trace=self._trace_summary,
@@ -1750,6 +1758,19 @@ class ChaosEngine:
                 getattr(packer, "last_h2d_bytes_per_device", 0)
                 if packer is not None else 0
             ),
+        }
+
+    def _joint_summary(self) -> dict | None:
+        scheduler = getattr(self, "scheduler", None)
+        if scheduler is None:
+            return None
+        return {
+            "enabled": bool(getattr(scheduler, "_joint_solve", False)),
+            # the joint builder refuses custom actions with a
+            # ValueError, which lands the daemon on the per-action
+            # fallback (_cycle is None) — a parity run that silently
+            # fell back proves nothing, so record the cycle presence
+            "fused_cycle": getattr(scheduler, "_cycle", None) is not None,
         }
 
     # -- guardrail invariants ------------------------------------------

@@ -139,6 +139,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "the diagnosis/parity escape hatch, see "
                         "doc/design/daemon-operations.md; env "
                         "KB_TPU_PACK_MODE)")
+    p.add_argument("--joint-solve", choices=("on", "off"), default=None,
+                   help="solve the whole action pipeline as ONE joint "
+                        "constraint solve (doc/design/joint-solve.md) "
+                        "instead of chained per-action kernels.  "
+                        "Default off = today's exact sequential "
+                        "program (the persistent artifact bank keeps "
+                        "replaying); env KB_TPU_JOINT_SOLVE=1")
     p.add_argument("--mesh-devices", type=int, default=None,
                    help="shard the pack→solve→patch pipeline across a "
                         "1-D device mesh of N devices (node axis; "
@@ -1374,6 +1381,14 @@ def main(argv: list[str] | None = None) -> int:
         format="%(asctime)s %(levelname)s %(name)s: %(message)s",
     )
     honor_jax_platforms()
+
+    # The joint-solve flag travels as the env var the Scheduler (and
+    # warm.py) read at construction, so every run mode below — daemon,
+    # sim, warm — builds the same program variant.
+    if args.joint_solve is not None:
+        os.environ["KB_TPU_JOINT_SOLVE"] = (
+            "1" if args.joint_solve == "on" else "0"
+        )
 
     # Device-mesh sizing must land BEFORE the first jax backend touch:
     # a CPU-only host realizes an N>1 mesh as N virtual host devices

@@ -139,17 +139,29 @@ ARTIFACT_DIRNAME = "compile_artifacts"
 MIRROR_MAX_BYTES = 512 * 1024
 
 
-def conf_digest(conf, compact_wire: bool | None = None) -> str:
+def conf_digest(
+    conf, compact_wire: bool | None = None, joint: bool | None = None
+) -> str:
     """Stable cross-process digest of everything that changes the
     COMPILED fused-cycle program for a given shape: the policy conf
     (actions + tiers + arguments — frozen dataclasses of primitives,
-    so repr() is canonical) and the compact-wire D2H variant.  The
-    jax version / platform axis is covered by host_fingerprint(),
-    which co-keys every bank entry.  Deliberately NOT hash(conf):
-    Python string hashing is per-process salted."""
+    so repr() is canonical), the compact-wire D2H variant, and the
+    joint-solve variant.  The jax version / platform axis is covered
+    by host_fingerprint(), which co-keys every bank entry.
+    Deliberately NOT hash(conf): Python string hashing is per-process
+    salted.
+
+    The joint axis is appended ONLY when on: every digest minted
+    before the joint solve existed — including the persistent bank's
+    warmed default entries — must keep verifying byte-for-byte.
+    """
     if compact_wire is None:
         compact_wire = os.environ.get("KB_TPU_COMPACT_WIRE") == "1"
+    if joint is None:
+        joint = os.environ.get("KB_TPU_JOINT_SOLVE") == "1"
     body = f"{conf!r}|compact_wire={bool(compact_wire)}"
+    if joint:
+        body += "|joint=True"
     return hashlib.sha256(body.encode("utf-8")).hexdigest()[:16]
 
 

@@ -1,0 +1,533 @@
+"""Joint single-solve cycle: the four-pass pipeline as ONE constraint solve.
+
+Reference formulation: PAPERS.md — *CvxCluster* (granular allocation as
+one optimization) and *Priority Matters* (constraint-based pod packing
+with priority tiers).  The sequential fused cycle (actions/fused.py)
+chains six independent `lax.while_loop` kernels — allocate's idle and
+future auctions, backfill's auction, preempt's two Statement sweeps,
+reclaim's sweep — each re-deriving its own cycle-setup tensors,
+predicate mask and loop-entry pass, so an idle steady-state cycle still
+pays six full [T, N] solver bodies before concluding there is nothing
+to do.
+
+This kernel recasts the pipeline as a single solve over one unified
+while_loop.  The action order becomes *constraint tiers* (the priority
+bands of the *Priority Matters* formulation): a `phase` register walks
+the tier list, and each loop iteration executes exactly one step of the
+current tier — an auction round (placement / backfill band) or one
+eviction-granular Statement step (victim-selection band).  Shared
+feasibility inputs (`TensorPolicy.setup_state` aux tensors, the static
+predicate mask, the anti-affinity serialize mask) are computed ONCE for
+the whole solve instead of once per action, and a cheap [T]-mask
+work-test advances past empty tiers without paying their [T, N] body —
+the steady-cycle p99 win (see doc/design/joint-solve.md for measured
+figures).
+
+Decision semantics: each tier's step body is the SAME math as the
+sequential kernel it replaces (ops/assignment.py · allocate_rounds,
+ops/preemption.py · preemption_rounds — deliberately mirrored, not
+refactored, so the default sequential program stays byte-identical),
+executed in the same conf order, so the joint solve is
+decision-invisible wherever the sequential pipeline's outcome is
+policy-complete.  The ONE formulation gain is the final admission tier
+(`gated_on_evictions`): a placement auction against post-eviction
+FutureIdle that can only ADD pipelined placements.  The sequential
+order cannot express it — allocate runs before preempt/reclaim free
+capacity, and the eviction kernels' per-cycle `tried` latch is
+rank-order-sensitive (a preemptor that failed BEFORE a later victim
+freed surplus is never revisited) — see
+tests/test_joint_solve.py · test_joint_admits_placement_sequential_refuses
+for the pinned scenario.
+
+Eviction attribution: every eviction records the evicting tier's action
+code in `evict_code` (i32[T], 0 = kept, i+1 = evicted by conf action
+i), discarded plans clearing their codes on rollback — so the host-side
+per-action reason commit and the compact-wire payload are unchanged.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+from jax import lax
+
+from kube_batch_tpu.api.snapshot import SnapshotTensors, allocated_mask, fits
+from kube_batch_tpu.api.types import TaskStatus
+from kube_batch_tpu.ops.assignment import (
+    NEG_INF,
+    AllocState,
+    _resolve_conflicts,
+    _round_robin_proposals,
+)
+from kube_batch_tpu.ops.preemption import BIG_K, INT_MAX, _min_victims_per_node
+
+ScoreFn = Callable[[SnapshotTensors, AllocState], jax.Array]
+MaskFn = Callable[[SnapshotTensors, AllocState], jax.Array]
+VictimFn = Callable[[SnapshotTensors, AllocState, jax.Array], jax.Array]
+
+
+@dataclasses.dataclass(frozen=True, eq=False)
+class AuctionPhase:
+    """One placement band: an auction-rounds tier (allocate's idle or
+    future pass, backfill, or the joint admission sweep).
+
+    `max_steps=None` resolves at trace time to the sequential kernel's
+    default bound (`allocate_rounds`: T).  `gated_on_evictions` marks
+    the admission sweep: it only runs when a prior tier actually
+    evicted something, keeping the joint solve bit-identical to the
+    sequential pipeline on eviction-free cycles.  `eq=False`: tiers are
+    identified positionally (two tiers sharing closures must not alias
+    in the dispatch tables).
+    """
+
+    score_fn: ScoreFn
+    eligible_fn: MaskFn
+    use_future: bool
+    max_steps: int | None = None
+    score_quantum: float = 0.0
+    gated_on_evictions: bool = False
+
+
+@dataclasses.dataclass(frozen=True, eq=False)
+class EvictPhase:
+    """One victim-selection band: eviction-granular Statement steps
+    (preempt phase 1/2 or reclaim), attributed to conf action
+    `evict_code - 1`.  `max_steps=None` resolves at trace time to
+    `preemption_rounds`' default bound (2T + 4N + 16)."""
+
+    victim_fn: VictimFn
+    starving_fn: MaskFn
+    eligible_fn: MaskFn
+    evict_code: int
+    max_steps: int | None = None
+
+
+@struct.dataclass
+class JointCarry:
+    """The unified solve state: AllocState plus the phase register and
+    the superset of the per-kernel loop carries (auction round counter,
+    Statement plan, per-preemptor node exclusions), reset at each tier
+    boundary."""
+
+    state: AllocState
+    phase: jax.Array        # i32[]  current tier index
+    step: jax.Array         # i32[]  steps taken inside the current tier
+    progressed: jax.Array   # bool[] last step made progress
+    evict_code: jax.Array   # i32[T] 0 = kept, i+1 = evicted by action i
+    tried: jax.Array        # bool[T] preemptors served or out of nodes
+    prov: jax.Array         # bool[T] provisional victims of the open plan
+    prov_active: jax.Array  # bool[]  a Statement is in progress
+    prov_p: jax.Array       # i32[]   its preemptor
+    prov_n: jax.Array       # i32[]   its target node
+    excl: jax.Array         # bool[N] nodes whose plan failed for excl_p
+    excl_p: jax.Array       # i32[]   preemptor the exclusions belong to
+
+
+def joint_rounds(
+    snap: SnapshotTensors,
+    state: AllocState,
+    phases: Sequence[AuctionPhase | EvictPhase],
+    predicate_mask: jax.Array,   # bool[T, N] static feasibility (plugins)
+    rank_fn: MaskFn,             # i32[T] global scheduling order
+    eps: jax.Array,              # f32[R]
+    dyn_predicate_fn=None,       # (snap, state, immediate) -> bool[T, N]
+    dyn_predicate_row_fn=None,   # (snap, state, p) -> bool[N]
+    global_serialize_fn=None,    # (snap, state) -> bool[T]
+    domain_serialize_fn=None,    # (snap, state) -> bool[T]
+) -> tuple[AllocState, jax.Array]:
+    """Run the tier list to completion; returns (state, evict_code).
+
+    One while_loop iteration is either one step of the current tier
+    (auction round / Statement step — the same math as the sequential
+    kernels) or a cheap tier-advance (close any open Statement exactly
+    as preemption_rounds' post-loop Discard, reset the per-tier carry,
+    move on).  The per-tier work tests are mask-only [T] reductions and
+    may only skip steps that provably change nothing, so skipping is
+    decision-invisible by construction.
+    """
+    T = snap.num_tasks
+    N = snap.num_nodes
+    P = len(phases)
+    if P == 0:
+        return state, jnp.zeros(T, jnp.int32)
+    pending_s = int(TaskStatus.PENDING)
+    releasing = int(TaskStatus.RELEASING)
+    tj = jnp.clip(snap.task_job, 0, snap.num_jobs - 1)
+
+    def _steps(ph) -> int:
+        if ph.max_steps is not None:
+            return int(ph.max_steps)
+        # The sequential kernels' own default bounds (allocate_rounds /
+        # preemption_rounds) — shape-dependent, so resolved here.
+        if isinstance(ph, AuctionPhase):
+            return T
+        return 2 * T + 4 * N + 16
+
+    max_steps_arr = jnp.asarray([_steps(ph) for ph in phases], jnp.int32)
+    is_auction_arr = jnp.asarray(
+        [isinstance(ph, AuctionPhase) for ph in phases]
+    )
+    auction_phases = [ph for ph in phases if isinstance(ph, AuctionPhase)]
+    evict_phases = [ph for ph in phases if isinstance(ph, EvictPhase)]
+    # Positional (identity-based) index into the per-kind dispatch
+    # tables — phase specs are eq=False, so list.index matches `is`.
+    kind_idx_arr = jnp.asarray(
+        [
+            (auction_phases if isinstance(ph, AuctionPhase)
+             else evict_phases).index(ph)
+            for ph in phases
+        ],
+        jnp.int32,
+    )
+    evict_code_arr = jnp.asarray(
+        [getattr(ph, "evict_code", 0) for ph in phases], jnp.int32
+    )
+
+    # Anti-affinity per-round serialization (≙ allocate_rounds):
+    # snapshot-static, shared by every auction tier, computed once.
+    serialize_mask = None
+    if dyn_predicate_fn is not None:
+        anti_union = jnp.any(snap.task_anti > 0, axis=0)
+        serialize_mask = jnp.any(snap.task_anti > 0, axis=1) | jnp.any(
+            (snap.task_podlabels > 0) & anti_union[None, :], axis=1
+        )
+
+    # -- cheap per-tier work tests (mask-only; may ONLY skip no-ops) ----
+    def _haswork_fn(ph):
+        if isinstance(ph, AuctionPhase):
+            def haswork(c):
+                st = c.state
+                pending = (st.task_state == pending_s) & snap.task_mask
+                work = jnp.any(pending & ph.eligible_fn(snap, st))
+                if ph.gated_on_evictions:
+                    work = work & jnp.any(c.evict_code > 0)
+                return work
+        else:
+            def haswork(c):
+                st = c.state
+                pending = (st.task_state == pending_s) & snap.task_mask
+                starving_j = ph.starving_fn(snap, st)
+                elig = (
+                    pending
+                    & starving_j[tj]
+                    & (snap.task_job >= 0)
+                    & ph.eligible_fn(snap, st)
+                    & ~c.tried
+                )
+                return jnp.any(elig) | c.prov_active
+        return haswork
+
+    haswork_fns = [_haswork_fn(ph) for ph in phases]
+
+    # -- tier advance: Discard any open Statement, reset per-tier carry -
+    def advance(c: JointCarry) -> JointCarry:
+        st = c.state
+        open_plan = c.prov_active
+        prov_req_sum = jnp.sum(
+            jnp.where(c.prov[:, None], snap.task_req, 0.0), axis=0
+        )
+        task_state = jnp.where(
+            open_plan & c.prov, snap.task_state, st.task_state
+        )
+        node_future = st.node_future.at[c.prov_n].add(
+            jnp.where(open_plan, -prov_req_sum, jnp.zeros_like(prov_req_sum))
+        )
+        code = jnp.where(open_plan & c.prov, 0, c.evict_code)
+        return c.replace(
+            state=st.replace(task_state=task_state, node_future=node_future),
+            phase=c.phase + 1,
+            step=jnp.asarray(0, jnp.int32),
+            progressed=jnp.asarray(True),
+            evict_code=code,
+            tried=jnp.zeros(T, bool),
+            prov=jnp.zeros(T, bool),
+            prov_active=jnp.asarray(False),
+            prov_p=jnp.asarray(0, jnp.int32),
+            prov_n=jnp.asarray(0, jnp.int32),
+            excl=jnp.zeros(N, bool),
+            excl_p=jnp.asarray(-1, jnp.int32),
+        )
+
+    # -- auction tier step (≙ allocate_rounds body, use_future static) --
+    def _auction_step_fn(ph: AuctionPhase):
+        def step(c: JointCarry) -> JointCarry:
+            st = c.state
+            avail = st.node_future if ph.use_future else st.node_idle
+            pending = (st.task_state == pending_s) & snap.task_mask
+            eligible = pending & ph.eligible_fn(snap, st)
+
+            fit = fits(snap.task_req[:, None, :], avail[None, :, :], eps)
+            feas = (
+                predicate_mask & fit & snap.node_mask[None, :]
+                & eligible[:, None]
+            )
+            if dyn_predicate_fn is not None:
+                feas = feas & dyn_predicate_fn(snap, st, not ph.use_future)
+
+            score = jnp.where(feas, ph.score_fn(snap, st), NEG_INF)
+            if ph.score_quantum > 0.0:
+                score = jnp.floor(score * (1.0 / ph.score_quantum))
+            best = jnp.max(score, axis=1, keepdims=True)
+            tied = feas & (score >= best)
+            active = jnp.any(feas, axis=1)
+
+            rank = rank_fn(snap, st)
+            prop_node = _round_robin_proposals(tied, active, rank)
+            accept = _resolve_conflicts(
+                prop_node, active, rank, snap.task_req, avail, eps,
+                serialize_mask=serialize_mask,
+            )
+            if domain_serialize_fn is not None and snap.node_key_domain.shape[1]:
+                big_d = jnp.iinfo(jnp.int32).max
+                part_mask = domain_serialize_fn(snap, st)
+                D = snap.domain_mask.shape[0]
+                for tk in range(snap.node_key_domain.shape[1]):
+                    part = part_mask & accept
+                    dom = snap.node_key_domain[
+                        jnp.clip(prop_node, 0, snap.num_nodes - 1), tk
+                    ]
+                    seg = jnp.where(part, dom, D)
+                    minr = jax.ops.segment_min(
+                        jnp.where(part, rank, big_d), seg,
+                        num_segments=D + 1,
+                    )[:D]
+                    keep = ~part | (rank == minr[jnp.clip(dom, 0, D - 1)])
+                    cancelled = accept & ~keep
+                    accept = accept & keep
+                    min_cancelled = jnp.min(
+                        jnp.where(cancelled, rank, big_d)
+                    )
+                    accept = accept & (rank < min_cancelled)
+            if global_serialize_fn is not None:
+                gmask = global_serialize_fn(snap, st) & accept
+                big = jnp.iinfo(jnp.int32).max
+                best_g = jnp.min(jnp.where(gmask, rank, big))
+                cancelled = gmask & (rank != best_g)
+                accept = accept & (~gmask | (rank == best_g))
+                min_cancelled = jnp.min(jnp.where(cancelled, rank, big))
+                accept = accept & (rank < min_cancelled)
+
+            new_status = int(
+                TaskStatus.PIPELINED if ph.use_future else TaskStatus.ALLOCATED
+            )
+            task_state = jnp.where(accept, new_status, st.task_state)
+            task_node = jnp.where(accept, prop_node, st.task_node)
+            delta_seg = jnp.where(accept, prop_node, snap.num_nodes)
+            delta = jax.ops.segment_sum(
+                jnp.where(accept[:, None], snap.task_req, 0.0),
+                delta_seg,
+                num_segments=snap.num_nodes + 1,
+            )[: snap.num_nodes]
+            node_future = st.node_future - delta
+            node_idle = (
+                st.node_idle if ph.use_future else st.node_idle - delta
+            )
+            new_st = st.replace(
+                task_state=task_state,
+                task_node=task_node,
+                node_idle=node_idle,
+                node_future=node_future,
+            )
+            return c.replace(
+                state=new_st,
+                progressed=jnp.any(accept),
+                step=c.step + 1,
+            )
+
+        return step
+
+    auction_step_fns = [_auction_step_fn(ph) for ph in auction_phases]
+
+    # -- eviction tier step (≙ preemption_rounds body; only the phase
+    # masks switch — the Statement machinery is shared) -----------------
+    def _elig_fn(ph: EvictPhase):
+        def elig(c: JointCarry) -> jax.Array:
+            st = c.state
+            pending = (st.task_state == pending_s) & snap.task_mask
+            starving_j = ph.starving_fn(snap, st)
+            return (
+                pending
+                & starving_j[tj]
+                & (snap.task_job >= 0)
+                & ph.eligible_fn(snap, st)
+                & ~c.tried
+            )
+        return elig
+
+    def _victims_fn(ph: EvictPhase):
+        def victims(args) -> jax.Array:
+            c, p = args
+            return (
+                ph.victim_fn(snap, c.state, p)
+                & snap.task_mask
+                & (c.state.task_node >= 0)
+                & ~c.prov
+            )
+        return victims
+
+    evict_elig_fns = [_elig_fn(ph) for ph in evict_phases]
+    evict_victim_fns = [_victims_fn(ph) for ph in evict_phases]
+
+    def evict_step(c: JointCarry) -> JointCarry:
+        st = c.state
+        rank = rank_fn(snap, st)
+        kidx = kind_idx_arr[c.phase]
+
+        any_victim_possible = jnp.any(
+            allocated_mask(snap.task_state)
+            & allocated_mask(st.task_state)
+            & snap.task_mask
+            & ~c.prov
+        )
+
+        elig = lax.switch(kidx, evict_elig_fns, c)
+        any_elig = jnp.any(elig)
+        any_direct_fit = jnp.any(
+            fits(snap.task_req[:, None, :], st.node_future[None, :, :], eps)
+            & elig[:, None]
+            & (snap.node_mask & snap.node_ready)[None, :]
+        )
+        p_new = jnp.argmin(jnp.where(elig, rank, INT_MAX)).astype(jnp.int32)
+        p = jnp.where(c.prov_active, c.prov_p, p_new)
+        have_p = c.prov_active | any_elig
+        preq = snap.task_req[p]
+        is_p = jnp.arange(T, dtype=jnp.int32) == p
+        excl = jnp.where(p == c.excl_p, c.excl, jnp.zeros_like(c.excl))
+
+        victims = lax.switch(kidx, evict_victim_fns, (c, p))
+        sacrifice = -rank
+
+        if dyn_predicate_row_fn is not None:
+            dyn_row = dyn_predicate_row_fn(snap, st, p)
+        else:
+            dyn_row = jnp.ones(N, bool)
+
+        def choose_node(_):
+            k = _min_victims_per_node(
+                snap, st.node_future, victims, sacrifice, preq, eps
+            )
+            feasible = (
+                (k < BIG_K)
+                & predicate_mask[p]
+                & snap.node_mask
+                & snap.node_ready
+                & dyn_row
+                & ~excl
+            )
+            kk = jnp.where(feasible, k, BIG_K)
+            n_best = jnp.argmax(feasible & (kk == jnp.min(kk))).astype(
+                jnp.int32
+            )
+            return n_best, jnp.any(feasible)
+
+        def keep_node(_):
+            return c.prov_n, jnp.asarray(True)
+
+        n, node_ok = lax.cond(c.prov_active, keep_node, choose_node, None)
+
+        opening = ~c.prov_active & have_p & node_ok
+        no_node = ~c.prov_active & have_p & ~node_ok
+        active = c.prov_active | opening
+
+        fit_now = fits(preq[None, :], st.node_future[n][None, :], eps)[0]
+        viable = dyn_row[n]
+        victims_on_n = victims & (st.task_node == n)
+        any_vic = jnp.any(victims_on_n)
+
+        finalize = active & viable & fit_now
+        evict_this = active & viable & ~fit_now & any_vic
+        fail = active & (~viable | (~fit_now & ~any_vic))
+
+        v = jnp.argmin(
+            jnp.where(victims_on_n, sacrifice, INT_MAX)
+        ).astype(jnp.int32)
+        is_v = (jnp.arange(T, dtype=jnp.int32) == v) & evict_this
+        req_v = snap.task_req[v]
+
+        task_state = jnp.where(is_v, releasing, st.task_state)
+        task_state = jnp.where(
+            finalize & is_p, int(TaskStatus.PIPELINED), task_state
+        )
+        task_state = jnp.where(fail & c.prov, snap.task_state, task_state)
+        task_node = jnp.where(finalize & is_p, n, st.task_node)
+
+        prov_req_sum = jnp.sum(
+            jnp.where(c.prov[:, None], snap.task_req, 0.0), axis=0
+        )
+        delta = (
+            jnp.where(evict_this, req_v, 0.0)
+            - jnp.where(finalize, preq, 0.0)
+            - jnp.where(fail, prov_req_sum, 0.0)
+        )
+        node_future = st.node_future.at[n].add(delta)
+
+        code = jnp.where(is_v, evict_code_arr[c.phase], c.evict_code)
+        code = jnp.where(fail & c.prov, 0, code)
+
+        closed = finalize | fail
+        new_state = st.replace(
+            task_state=task_state, task_node=task_node,
+            node_future=node_future,
+        )
+        return c.replace(
+            state=new_state,
+            progressed=have_p
+            & (any_victim_possible | any_direct_fit | c.prov_active),
+            step=c.step + 1,
+            evict_code=code,
+            tried=c.tried | (is_p & (no_node | finalize)),
+            prov=jnp.where(closed, False, c.prov | is_v),
+            prov_active=evict_this,
+            prov_p=p,
+            prov_n=n,
+            excl=jnp.where(
+                fail, excl | (jnp.arange(N) == n), excl
+            ),
+            excl_p=p,
+        )
+
+    def auction_dispatch(c: JointCarry) -> JointCarry:
+        return lax.switch(kind_idx_arr[c.phase], auction_step_fns, c)
+
+    if auction_phases and evict_phases:
+        def run_step(c: JointCarry) -> JointCarry:
+            return lax.cond(
+                is_auction_arr[c.phase], auction_dispatch, evict_step, c
+            )
+    elif auction_phases:
+        run_step = auction_dispatch
+    else:
+        run_step = evict_step
+
+    def cond(c: JointCarry):
+        return c.phase < P
+
+    def body(c: JointCarry) -> JointCarry:
+        has_work = lax.switch(c.phase, haswork_fns, c)
+        tier_done = (
+            ~c.progressed
+            | (c.step >= max_steps_arr[c.phase])
+            | ~has_work
+        )
+        return lax.cond(tier_done, advance, run_step, c)
+
+    init = JointCarry(
+        state=state,
+        phase=jnp.asarray(0, jnp.int32),
+        step=jnp.asarray(0, jnp.int32),
+        progressed=jnp.asarray(True),
+        evict_code=jnp.zeros(T, jnp.int32),
+        tried=jnp.zeros(T, bool),
+        prov=jnp.zeros(T, bool),
+        prov_active=jnp.asarray(False),
+        prov_p=jnp.asarray(0, jnp.int32),
+        prov_n=jnp.asarray(0, jnp.int32),
+        excl=jnp.zeros(N, bool),
+        excl_p=jnp.asarray(-1, jnp.int32),
+    )
+    out = lax.while_loop(cond, body, init)
+    return out.state, out.evict_code

@@ -231,6 +231,12 @@ class Scheduler:
         import os
 
         self._compact_wire = os.environ.get("KB_TPU_COMPACT_WIRE") == "1"
+        # Opt-in joint single-solve cycle (doc/design/joint-solve.md):
+        # the four-pass pipeline as one constraint solve.  Same
+        # artifact-bank caveat as compact wire — a different compiled
+        # program, so it co-keys the conf digest and never replaces the
+        # default program silently.
+        self._joint_solve = os.environ.get("KB_TPU_JOINT_SOLVE") == "1"
         # -- AOT compile-artifact bank + no-block compile ladder --------
         # (doc/design/compile-artifacts.md)
         #: compile_cache.ArtifactBank (or None): every compile this
@@ -303,7 +309,8 @@ class Scheduler:
             # the upload rides the jit call's own argument transfer
             # (framework/session.py · Session.state).
             cycle = jax.jit(make_cycle_solver(
-                policy, conf.actions, compact_wire=self._compact_wire
+                policy, conf.actions, compact_wire=self._compact_wire,
+                joint=self._joint_solve,
             ))
         except Exception as exc:  # noqa: BLE001 — any build failure must
             # fall back to per-action dispatch, never break the daemon's
@@ -334,7 +341,9 @@ class Scheduler:
         # fallback belongs to the OLD policy's executables).
         from kube_batch_tpu.compile_cache import conf_digest
 
-        self._conf_digest = conf_digest(built["conf"], self._compact_wire)
+        self._conf_digest = conf_digest(
+            built["conf"], self._compact_wire, joint=self._joint_solve
+        )
         self._serving_key = None
         self._compile_req_cycle.clear()
         # Growth-prewarm state belongs to the OLD policy's executables:
@@ -397,7 +406,9 @@ class Scheduler:
         # the cycle that noticed the edit.
         from kube_batch_tpu.compile_cache import conf_digest
 
-        new_digest = conf_digest(built["conf"], self._compact_wire)
+        new_digest = conf_digest(
+            built["conf"], self._compact_wire, joint=self._joint_solve
+        )
         req_cycle = trace.current_cycle()
         bank = self.compile_bank
         mesh = self.mesh

@@ -91,13 +91,14 @@ def warm_one(config_n: int, actions: tuple[str, ...],
     import os
 
     compact = os.environ.get("KB_TPU_COMPACT_WIRE") == "1"
+    joint = os.environ.get("KB_TPU_JOINT_SOLVE") == "1"
     world_cache, _sim = build_config(config_n)
     from kube_batch_tpu.cache.packer import pack_snapshot
 
     snap, _meta = pack_snapshot(world_cache.snapshot())
     policy, _plugins = build_policy(conf)
     cycle = jax.jit(make_cycle_solver(
-        policy, conf.actions, compact_wire=compact
+        policy, conf.actions, compact_wire=compact, joint=joint
     ))
     state = init_state(snap)
     t0 = time.monotonic()
@@ -118,7 +119,7 @@ def warm_one(config_n: int, actions: tuple[str, ...],
         )
         bank = ArtifactBank(artifacts_dir)
         out["banked"] = bank.put(
-            conf_digest(conf, compact), shapes, exe
+            conf_digest(conf, compact, joint=joint), shapes, exe
         )
         out["artifacts_dir"] = bank.dir
     return out

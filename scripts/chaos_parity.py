@@ -70,3 +70,39 @@ def check_mesh_parity(base_run: dict, path_mesh: str | None,
         f"check compares a mesh against itself: {base_mesh}"
     )
     return f" (and at --mesh-devices {mesh.get('devices')})"
+
+
+def check_joint_parity(base_run: dict, path_joint: str | None,
+                       what: str) -> str:
+    """Assert the --joint-solve on run at `path_joint` reproduces
+    `base_run`'s hash.  The joint single-solve cycle
+    (doc/design/joint-solve.md) is decision-invisible wherever the
+    sequential pipeline is policy-complete; its one documented
+    divergence (the gated post-eviction admission sweep) needs a
+    tried-latch race the chaos workloads' conf does not produce, so
+    at these pinned seeds the hash must be bit-identical.  Also
+    proves the run actually served the joint program — a silent
+    fall back to the per-action path would make the parity vacuous.
+    Returns an ok-line suffix; empty when no joint-run file was
+    supplied."""
+    if path_joint is None:
+        return ""
+    with open(path_joint, encoding="utf-8") as f:
+        j = json.load(f)
+    assert j["ok"], f"{what} joint run violations: {j['violations']}"
+    joint = j.get("joint") or {}
+    assert joint.get("enabled") and joint.get("fused_cycle"), (
+        f"{what}: the joint run never served the joint cycle — the "
+        f"parity check is vacuous: {joint}"
+    )
+    assert j["trace_hash"] == base_run["trace_hash"], (
+        f"{what}: --joint-solve on diverged from the sequential "
+        f"pipeline at the same seed: {j['trace_hash']} != "
+        f"{base_run['trace_hash']}"
+    )
+    base_joint = base_run.get("joint") or {}
+    assert not base_joint.get("enabled", False), (
+        f"{what}: the baseline run was itself joint — the parity "
+        f"check compares joint against itself: {base_joint}"
+    )
+    return " (and at --joint-solve on)"

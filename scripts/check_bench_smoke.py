@@ -18,7 +18,7 @@ def main() -> int:
 
     for key in ("first_cycle_ms", "e2e_cycle_ms_p50", "commit_pipeline",
                 "ingest_compare", "trace_overhead", "compile_artifacts",
-                "cells_aggregate", "slo", "shard", "autopilot"):
+                "cells_aggregate", "slo", "shard", "joint", "autopilot"):
         assert key in artifact, (
             f"artifact missing {key!r}; keys: {sorted(artifact)}"
         )
@@ -70,6 +70,20 @@ def main() -> int:
     assert shard.get("boundary_refused_1dev") is True, shard
     assert shard.get("big_admitted_8dev") is True, shard
 
+    # Presence + sanity only: the >=1.5x steady-p99 gate lives in
+    # scripts/check_joint_bench.py (make verify); the smoke pins that
+    # every artifact RECORDS the sequential-vs-joint figures at both
+    # mesh sizes and that the joint decisions stayed bit-identical.
+    jnt = artifact["joint"]
+    assert "error" not in jnt, jnt
+    assert jnt.get("p99_seq_ms", 0) > 0, jnt
+    assert jnt.get("p99_joint_ms", 0) > 0, jnt
+    assert jnt.get("ratio_8dev", 0) > 0, jnt
+    assert jnt.get("steady_parity") is True, jnt
+    assert jnt.get("mesh_parity") is True, jnt
+    assert jnt.get("evict_parity") is True, jnt
+    assert jnt.get("evictions", 0) >= 1, jnt
+
     # Presence + sanity only: the no-flap / rollback / hash-parity
     # gates live in scripts/check_chaos_autopilot.py (make chaos); the
     # smoke pins that every artifact RECORDS the closed-loop
@@ -114,7 +128,10 @@ def main() -> int:
         f"({ca.get('scaling')}x), slo+stitching "
         f"{slo.get('overhead_pct')}% overhead, sharded tier "
         f"{shard.get('devices')}-device peak ratio "
-        f"{shard.get('peak_ratio')}, autopilot converge "
+        f"{shard.get('peak_ratio')}, joint solve "
+        f"{jnt.get('ratio_1dev')}x / {jnt.get('ratio_8dev')}x "
+        f"(mesh 1/{jnt.get('devices')}) p99 vs sequential, "
+        f"autopilot converge "
         f"{ap.get('autopilot_ticks_to_converge')} ticks vs manual "
         f"{ap.get('manual_ticks_to_converge')}"
     )

@@ -20,7 +20,8 @@ import sys
 
 def main(path_a: str, path_b: str, path_packfull: str | None = None,
          path_event: str | None = None,
-         path_mesh: str | None = None) -> int:
+         path_mesh: str | None = None,
+         path_joint: str | None = None) -> int:
     with open(path_a, encoding="utf-8") as f:
         a = json.load(f)
     with open(path_b, encoding="utf-8") as f:
@@ -64,16 +65,22 @@ def main(path_a: str, path_b: str, path_packfull: str | None = None,
             "incremental runs never took the patch path — the parity "
             f"check is vacuous: {incr_pack}"
         )
-    from chaos_parity import check_ingest_parity, check_mesh_parity
+    from chaos_parity import (
+        check_ingest_parity,
+        check_joint_parity,
+        check_mesh_parity,
+    )
 
     parity = check_ingest_parity(a, path_event, "guardrail")
     mesh_parity = check_mesh_parity(a, path_mesh, "guardrail")
+    joint_parity = check_joint_parity(a, path_joint, "guardrail")
     print(
         "chaos pipelined: ok — same-seed hash "
         f"{a['trace_hash'][:16]}… reproduced"
         + (" (and under --pack-mode full)" if path_packfull else "")
         + parity
         + mesh_parity
+        + joint_parity
         + f"; breaker tripped {a['guardrail']['breaker_opened']}x "
         "and drained to zero in-flight writes; per-pod wire order "
         "preserved"
@@ -85,4 +92,5 @@ if __name__ == "__main__":
     sys.exit(main(sys.argv[1], sys.argv[2],
                   sys.argv[3] if len(sys.argv) > 3 else None,
                   sys.argv[4] if len(sys.argv) > 4 else None,
-                  sys.argv[5] if len(sys.argv) > 5 else None))
+                  sys.argv[5] if len(sys.argv) > 5 else None,
+                  sys.argv[6] if len(sys.argv) > 6 else None))

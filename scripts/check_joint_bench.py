@@ -1,0 +1,298 @@
+#!/usr/bin/env python
+"""make verify's joint single-solve gate (doc/design/joint-solve.md).
+
+The joint cycle's perf claim is about the DAEMON-CYCLE shape: at
+steady state the sequential pipeline pays six bounded while_loop
+kernels (allocate idle+future, backfill, preempt inter+intra, reclaim)
+whose fixed per-kernel costs dominate when the world is small enough
+to solve in milliseconds — the regime every production cycle after
+convergence lives in.  The joint program walks ONE loop across the
+same tiers and advances through workless tiers in a single step each.
+
+Gate, at the drf steady world (BASELINE config 2), mesh 1 AND mesh 8
+(virtual devices):
+
+* steady p99(sequential) >= JOINT_RATIO_GATE x p99(joint);
+* decisions bit-identical (state, placements, eviction attribution);
+* the eviction overlay world fires >= 1 eviction under parity, so the
+  identity claim is not vacuous on the evict bands.
+
+Honesty section (recorded, NOT gated): the eviction-storm scale
+(BASELINE config 3, and config 4 measured during development) shows
+the joint program is NOT universally faster — per-step switch dispatch
+costs real time when a cycle runs thousands of eviction steps.  The
+artifact records the config-3 ratio every round so the trajectory
+shows where the crossover sits; the flag stays opt-in.
+
+`--json [--smoke]` is bench.py's mode: one measurement as a JSON
+line, no gate (the bench artifact's `joint` section; --smoke drops
+the scale section and shrinks the iteration counts so the tier stays
+minutes-bounded).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+# Runnable as `python scripts/check_joint_bench.py` from the repo root.
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+DEVICES = 8
+#: Sequential steady p99 must be >= this multiple of the joint p99 at
+#: the daemon-cycle shape (the acceptance criterion's 1.5x).
+JOINT_RATIO_GATE = 1.5
+
+FOUR = ("allocate", "backfill", "preempt", "reclaim")
+
+
+def _steady(exe, snap, state0, iters):
+    import time
+
+    import numpy as np
+
+    r = exe(snap, state0)
+    np.asarray(r[0].task_state[:8])  # warm + D2H fence
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        r = exe(snap, state0)
+        np.asarray(r[0].task_state[:8])
+        times.append(time.perf_counter() - t0)
+    return float(np.percentile(times, 99) * 1e3), r
+
+
+def _parity(a, b) -> bool:
+    import numpy as np
+
+    sa, ea, ra, _ = a
+    sb, eb, rb, _ = b
+    return (
+        np.array_equal(np.asarray(sa.task_state), np.asarray(sb.task_state))
+        and np.array_equal(np.asarray(sa.task_node), np.asarray(sb.task_node))
+        and np.array_equal(np.asarray(ra), np.asarray(rb))
+        and set(ea) == set(eb)
+        and all(
+            np.array_equal(np.asarray(ea[k]), np.asarray(eb[k])) for k in ea
+        )
+    )
+
+
+def _evict_world():
+    """The tests' priority-preempt overlay (test_joint_solve.py):
+    running low-prio pods fill two nodes, a high-prio gang arrives —
+    the preempt band must fire under parity."""
+    import dataclasses
+
+    from kube_batch_tpu.api.resource import ResourceSpec
+    from kube_batch_tpu.cache.cluster import Node, Pod, PodGroup
+    from kube_batch_tpu.framework.conf import default_conf
+    from kube_batch_tpu.framework.plugin import get_action
+    from kube_batch_tpu.framework.session import (
+        build_policy,
+        close_session,
+        open_session,
+    )
+    from kube_batch_tpu.models.workloads import GI
+    from kube_batch_tpu.sim.simulator import make_world
+
+    spec = ResourceSpec(("cpu", "memory", "pods", "accelerator"))
+    cache, sim = make_world(spec)
+    for i in range(2):
+        sim.add_node(Node(
+            name=f"n{i}",
+            allocatable={"cpu": 4000, "memory": 8 * GI, "pods": 110},
+        ))
+    sim.submit(
+        PodGroup(name="low", queue="default", min_member=1),
+        [Pod(name=f"low-{i}",
+             request={"cpu": 2000, "memory": 4 * GI, "pods": 1})
+         for i in range(4)],
+    )
+    conf = dataclasses.replace(default_conf(), actions=("allocate",))
+    policy, plugins = build_policy(conf)
+    acts = [get_action(n) for n in conf.actions]
+    for a in acts:
+        a.initialize(policy)
+    ssn = open_session(cache, policy, plugins)
+    for a in acts:
+        a.execute(ssn)
+    close_session(ssn)
+    sim.tick()
+    sim.submit(
+        PodGroup(name="high", queue="default", min_member=2, priority=1000),
+        [Pod(name=f"high-{i}",
+             request={"cpu": 2000, "memory": 4 * GI, "pods": 1},
+             priority=1000)
+         for i in range(2)],
+    )
+    return cache
+
+
+def measure_joint(smoke: bool = False) -> dict:
+    """One sequential-vs-joint measurement; returns the dict the gate
+    (and bench.py's `joint` artifact section) reads.  Requires
+    >= DEVICES jax devices for the mesh-8 section (the __main__ block
+    arms the virtual CPU mesh before any jax import)."""
+    import dataclasses
+    import time
+
+    import jax
+    import numpy as np
+
+    from kube_batch_tpu.actions import factory as _af  # noqa: F401
+    from kube_batch_tpu.actions.fused import make_cycle_solver
+    from kube_batch_tpu.cache.packer import pack_snapshot
+    from kube_batch_tpu.framework.conf import default_conf
+    from kube_batch_tpu.framework.session import build_policy
+    from kube_batch_tpu.models.workloads import build_config
+    from kube_batch_tpu.ops.assignment import init_state, shard_local_scan
+    from kube_batch_tpu.parallel import make_mesh, shard_cycle_inputs
+    from kube_batch_tpu.plugins import factory as _pf  # noqa: F401
+
+    if len(jax.devices()) < DEVICES:
+        return {"error": f"need {DEVICES} devices, have "
+                         f"{len(jax.devices())} (arm XLA_FLAGS="
+                         f"--xla_force_host_platform_device_count="
+                         f"{DEVICES} before jax initializes)"}
+    iters = 7 if smoke else 15
+    conf = dataclasses.replace(default_conf(), actions=FOUR)
+    policy, _ = build_policy(conf)
+
+    def compile_pair(snap, state0, sharded=False):
+        exes, secs = {}, {}
+        # joint FIRST for the same reason as the shard gate's order
+        # note: tracing the twin first commits constants to layouts
+        # the second trace inherits.
+        for tag, kw in (("joint", {"joint": True}), ("seq", {})):
+            fn = jax.jit(make_cycle_solver(policy, FOUR, **kw))
+            t0 = time.perf_counter()
+            if sharded:
+                with shard_local_scan():
+                    exes[tag] = fn.lower(snap, state0).compile()
+            else:
+                exes[tag] = fn.lower(snap, state0).compile()
+            secs[tag] = round(time.perf_counter() - t0, 1)
+        return exes, secs
+
+    # -- steady world (config 2: drf, 100 tasks x 20 nodes), mesh 1 --
+    cache, _sim = build_config(2)
+    snap, meta = pack_snapshot(cache.snapshot())
+    state0 = init_state(snap)
+    exes, compile_s = compile_pair(snap, state0)
+    p99_joint, out_joint = _steady(exes["joint"], snap, state0, iters)
+    p99_seq, out_seq = _steady(exes["seq"], snap, state0, iters)
+    steady_parity = _parity(out_seq, out_joint)
+
+    # -- same world, mesh 8 (node-axis shardings, PR 15) --------------
+    mesh = make_mesh(DEVICES)
+    snap_s, state_s = shard_cycle_inputs(snap, init_state(snap), mesh)
+    exes8, compile8_s = compile_pair(snap_s, state_s, sharded=True)
+    p99_joint8, out_joint8 = _steady(exes8["joint"], snap_s, state_s, iters)
+    p99_seq8, out_seq8 = _steady(exes8["seq"], snap_s, state_s, iters)
+    mesh_parity = _parity(out_seq8, out_joint8) and _parity(
+        out_joint, out_joint8
+    )
+
+    # -- eviction overlay: the evict bands must fire under parity -----
+    ecache = _evict_world()
+    esnap, _emeta = pack_snapshot(ecache.snapshot())
+    estate0 = init_state(esnap)
+    eexes, _esecs = compile_pair(esnap, estate0)
+    eout_joint = eexes["joint"](esnap, estate0)
+    eout_seq = eexes["seq"](esnap, estate0)
+    evict_parity = _parity(eout_seq, eout_joint)
+    evictions = int(sum(
+        int(np.asarray(m).sum()) for m in eout_seq[1].values()
+    ))
+
+    out = {
+        "devices": DEVICES,
+        "steady_world": f"{meta.num_real_tasks}x{meta.num_real_nodes}",
+        "iters": iters,
+        "compile_s": compile_s,
+        "p99_seq_ms": round(p99_seq, 2),
+        "p99_joint_ms": round(p99_joint, 2),
+        "ratio_1dev": round(p99_seq / p99_joint, 2) if p99_joint else 0.0,
+        "p99_seq_ms_8dev": round(p99_seq8, 2),
+        "p99_joint_ms_8dev": round(p99_joint8, 2),
+        "ratio_8dev": (
+            round(p99_seq8 / p99_joint8, 2) if p99_joint8 else 0.0
+        ),
+        "compile_s_8dev": compile8_s,
+        "steady_parity": bool(steady_parity),
+        "mesh_parity": bool(mesh_parity),
+        "evict_parity": bool(evict_parity),
+        "evictions": evictions,
+    }
+
+    if not smoke:
+        # honesty: the predicate-heavy scale world (config 3) where
+        # the per-step dispatch tax eats most of the win — recorded,
+        # not gated (module docstring).
+        cache3, _sim3 = build_config(3)
+        snap3, meta3 = pack_snapshot(cache3.snapshot())
+        state3 = init_state(snap3)
+        exes3, _secs3 = compile_pair(snap3, state3)
+        p99_joint3, out_joint3 = _steady(exes3["joint"], snap3, state3, 5)
+        p99_seq3, out_seq3 = _steady(exes3["seq"], snap3, state3, 5)
+        out["scale"] = {
+            "world": f"{meta3.num_real_tasks}x{meta3.num_real_nodes}",
+            "p99_seq_ms": round(p99_seq3, 1),
+            "p99_joint_ms": round(p99_joint3, 1),
+            "ratio": (
+                round(p99_seq3 / p99_joint3, 2) if p99_joint3 else 0.0
+            ),
+            "parity": _parity(out_seq3, out_joint3),
+            "gated": False,
+        }
+    return out
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if "--json" in argv:
+        import json
+
+        print(json.dumps(measure_joint(smoke="--smoke" in argv)))
+        return 0
+    result = measure_joint(smoke=True)
+    ok = (
+        "error" not in result
+        and result["ratio_1dev"] >= JOINT_RATIO_GATE
+        and result["ratio_8dev"] >= JOINT_RATIO_GATE
+        and result["steady_parity"]
+        and result["mesh_parity"]
+        and result["evict_parity"]
+        and result["evictions"] > 0
+    )
+    if ok:
+        print(
+            "joint bench: ok — steady world "
+            f"{result['steady_world']} p99 "
+            f"{result['p99_seq_ms']}ms sequential vs "
+            f"{result['p99_joint_ms']}ms joint "
+            f"({result['ratio_1dev']}x, gate >={JOINT_RATIO_GATE}); "
+            f"mesh-{result['devices']} "
+            f"{result['p99_seq_ms_8dev']}ms vs "
+            f"{result['p99_joint_ms_8dev']}ms "
+            f"({result['ratio_8dev']}x); decisions bit-identical "
+            f"({result['evictions']} evictions fired under parity)"
+        )
+        return 0
+    print(f"joint bench: FAIL — {result}", file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    # Both pins must land before any jax import: the virtual host
+    # devices are read once at CPU backend init, and the sitecustomize
+    # platform pin loses to arm_virtual_devices' config update.
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from kube_batch_tpu.compile_cache import enable_compile_cache
+    from kube_batch_tpu.parallel.mesh import arm_virtual_devices
+
+    enable_compile_cache()
+    arm_virtual_devices(DEVICES)
+    sys.exit(main())

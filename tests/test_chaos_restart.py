@@ -54,6 +54,9 @@ def _run(seed: int = 23, ticks: int = 26, faults: FaultSpec = FAULTS,
     ).run()
 
 
+@pytest.mark.slow  # soak-scale (3 crash/restart cycles in one run);
+# `make chaos`'s restart scenario asserts the same survival
+# invariants every run, and plain `pytest tests/` still runs this
 def test_crash_restart_loop_state_survives():
     result = _run()
     # ok folds in _check_restart (state-adopted, quarantine/pin/
@@ -104,6 +107,8 @@ def test_crash_restart_loop_state_survives():
     assert result.recoveries.get("crash-restart") == 3
 
 
+@pytest.mark.slow  # three full engine runs; kept out of the tier-1
+# budget, plain `pytest tests/` still runs it
 def test_cold_and_corrupt_state_dirs_match_stateless_run(tmp_path):
     """Acceptance parity: a cold start (empty/missing state dir) and a
     corrupt-journal start must reach the SAME converged final

@@ -94,6 +94,9 @@ def test_tracing_parity_base_scenario():
     _parity(seed=7, ticks=48)
 
 
+@pytest.mark.slow  # soak-scale (~30 s) full guardrail scenario with
+# tracing on; `make chaos` runs the same scenario every time and plain
+# `pytest tests/` still runs this
 def test_breaker_trip_dump_invariant_is_armed():
     """The guardrail scenario's flight-dump invariant: a tracing-on
     run whose breaker trips must auto-dump ON the trip tick — pinned

@@ -1,0 +1,447 @@
+"""Joint single-solve cycle (ops/joint.py) correctness.
+
+The contract (doc/design/joint-solve.md): with `joint=True` the fused
+cycle must be DECISION-INVISIBLE wherever the sequential four-pass
+pipeline is policy-complete — same placements, same victims, same
+per-action eviction attribution — and LOUDLY better in the one case the
+sequential order cannot express: a preemptor latched `tried` before a
+later victim freed the capacity it fits
+(test_joint_admits_placement_sequential_refuses pins that scenario).
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+
+from kube_batch_tpu.actions import factory as _af  # noqa: F401
+from kube_batch_tpu.actions.fused import build_joint_phases, make_cycle_solver
+from kube_batch_tpu.api.resource import ResourceSpec
+from kube_batch_tpu.cache.cluster import Node, Pod, PodGroup, Queue
+from kube_batch_tpu.cache.packer import pack_snapshot
+from kube_batch_tpu.framework.conf import default_conf
+from kube_batch_tpu.framework.plugin import get_action
+from kube_batch_tpu.framework.session import (
+    build_policy,
+    close_session,
+    open_session,
+)
+from kube_batch_tpu.models.workloads import GI
+from kube_batch_tpu.ops.assignment import init_state
+from kube_batch_tpu.plugins import factory as _pf  # noqa: F401
+from kube_batch_tpu.sim.simulator import make_world
+
+SPEC = ResourceSpec(("cpu", "memory", "pods", "accelerator"))
+FOUR = ("allocate", "backfill", "preempt", "reclaim")
+
+
+def _run_cycle(cache, actions):
+    """Drive one host-side scheduling cycle (the per-action fallback
+    path) so the sim can tick pipelined pods to Running."""
+    conf = dataclasses.replace(default_conf(), actions=tuple(actions))
+    policy, plugins = build_policy(conf)
+    acts = [get_action(n) for n in conf.actions]
+    for a in acts:
+        a.initialize(policy)
+    ssn = open_session(cache, policy, plugins)
+    for a in acts:
+        a.execute(ssn)
+    close_session(ssn)
+
+
+def _pods(prefix, n, cpu, mem, prio=0):
+    return [
+        Pod(
+            name=f"{prefix}-{i}",
+            request={"cpu": cpu, "memory": mem, "pods": 1},
+            priority=prio,
+        )
+        for i in range(n)
+    ]
+
+
+def _solve_both(cache, actions, **kw):
+    conf = dataclasses.replace(default_conf(), actions=tuple(actions))
+    policy, _ = build_policy(conf)
+    snap, meta = pack_snapshot(cache.snapshot())
+    seq = jax.jit(make_cycle_solver(policy, conf.actions, **kw))
+    jnt = jax.jit(make_cycle_solver(policy, conf.actions, joint=True, **kw))
+    state0 = init_state(snap)
+    return seq(snap, state0), jnt(snap, state0), meta
+
+
+def _assert_parity(rs, rj):
+    s1, em1, jr1, _ = rs
+    s2, em2, jr2, _ = rj
+    np.testing.assert_array_equal(
+        np.asarray(s1.task_state), np.asarray(s2.task_state)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(s1.task_node), np.asarray(s2.task_node)
+    )
+    np.testing.assert_array_equal(np.asarray(jr1), np.asarray(jr2))
+    assert set(em1) == set(em2)
+    for name in em1:
+        np.testing.assert_array_equal(
+            np.asarray(em1[name]), np.asarray(em2[name]), err_msg=name
+        )
+
+
+# -- parity worlds: each family exercises a different band of the tier
+#    list (auction-only, inter-job eviction, cross-queue eviction,
+#    multi-preemptor interleaving) -----------------------------------
+
+def _world_priority_preempt():
+    """Running low-prio pods fill 2 nodes; a high-prio gang arrives →
+    the preempt band must evict, and the post-eviction sweep must stay
+    decision-invisible (the preempt kernel already pipelines the gang)."""
+    cache, sim = make_world(SPEC)
+    for i in range(2):
+        sim.add_node(Node(
+            name=f"n{i}",
+            allocatable={"cpu": 4000, "memory": 8 * GI, "pods": 110},
+        ))
+    sim.submit(
+        PodGroup(name="low", queue="default", min_member=1),
+        _pods("low", 4, 2000, 4 * GI, 0),
+    )
+    _run_cycle(cache, ["allocate"])
+    sim.tick()
+    sim.submit(
+        PodGroup(name="high", queue="default", min_member=2, priority=1000),
+        _pods("high", 2, 2000, 4 * GI, 1000),
+    )
+    return cache
+
+
+def _world_cross_queue_reclaim():
+    """An over-deserved silver queue hogs the cluster; gold arrives →
+    only the reclaim band may evict (same-queue preemption has no
+    victims)."""
+    cache, sim = make_world(SPEC)
+    sim.add_queue(Queue(name="gold", weight=3.0))
+    sim.add_queue(Queue(name="silver", weight=1.0))
+    for i in range(2):
+        sim.add_node(Node(
+            name=f"n{i}",
+            allocatable={"cpu": 4000, "memory": 8 * GI, "pods": 110},
+        ))
+    sim.submit(
+        PodGroup(name="hog", queue="silver", min_member=1),
+        _pods("hog", 4, 2000, 4 * GI, 0),
+    )
+    _run_cycle(cache, ["allocate"])
+    sim.tick()
+    sim.submit(
+        PodGroup(name="claim", queue="gold", min_member=1),
+        _pods("claim", 2, 2000, 4 * GI, 0),
+    )
+    return cache
+
+
+def _world_multi_preemptor():
+    """Three priority strata on 4 nodes: mid and high preemptors
+    interleave in rank order — the band ordering must reproduce the
+    sequential interleaving exactly."""
+    cache, sim = make_world(SPEC)
+    for i in range(4):
+        sim.add_node(Node(
+            name=f"n{i}",
+            allocatable={"cpu": 4000, "memory": 8 * GI, "pods": 110},
+        ))
+    sim.submit(
+        PodGroup(name="low", queue="default", min_member=1),
+        _pods("low", 8, 2000, 4 * GI, 0),
+    )
+    _run_cycle(cache, ["allocate"])
+    sim.tick()
+    sim.submit(
+        PodGroup(name="mid", queue="default", min_member=1, priority=100),
+        _pods("mid", 3, 2000, 4 * GI, 100),
+    )
+    sim.submit(
+        PodGroup(name="high", queue="default", min_member=2, priority=1000),
+        _pods("high", 2, 2000, 4 * GI, 1000),
+    )
+    return cache
+
+
+@pytest.mark.slow  # the same world + full-tuple parity (and eviction
+# count) is gated by scripts/check_joint_bench.py's evict overlay on
+# every `make verify`; plain `pytest tests/` still runs this
+def test_joint_parity_priority_preemption():
+    rs, rj, _ = _solve_both(_world_priority_preempt(), FOUR)
+    _assert_parity(rs, rj)
+    # the preempt band actually fired, attributed to the right action
+    assert int(np.asarray(rs[1]["preempt"]).sum()) == 2
+    assert int(np.asarray(rs[1]["reclaim"]).sum()) == 0
+
+
+@pytest.mark.slow
+def test_joint_parity_cross_queue_reclaim():
+    rs, rj, _ = _solve_both(_world_cross_queue_reclaim(), FOUR)
+    _assert_parity(rs, rj)
+    assert int(np.asarray(rs[1]["reclaim"]).sum()) == 2
+    assert int(np.asarray(rs[1]["preempt"]).sum()) == 0
+
+
+@pytest.mark.slow
+def test_joint_parity_multi_preemptor():
+    rs, rj, _ = _solve_both(_world_multi_preemptor(), FOUR)
+    _assert_parity(rs, rj)
+    assert int(np.asarray(rs[1]["preempt"]).sum()) == 3
+
+
+def test_joint_parity_allocate_backfill():
+    """Eviction-free default conf (no evict bands → no gated sweep):
+    the joint solve is the same auction sequence and must be
+    bit-identical, best-effort backfill included."""
+    cache, sim = make_world(SPEC)
+    for i in range(2):
+        sim.add_node(Node(
+            name=f"n{i}",
+            allocatable={"cpu": 4000, "memory": 8 * GI, "pods": 110},
+        ))
+    sim.submit(
+        PodGroup(name="work", queue="default", min_member=2),
+        _pods("work", 3, 1500, 2 * GI, 0),
+    )
+    # best-effort pods (no requests) — only the backfill band takes them
+    sim.submit(
+        PodGroup(name="be", queue="default", min_member=1),
+        [Pod(name=f"be-{i}", request={"pods": 1}) for i in range(2)],
+    )
+    rs, rj, _ = _solve_both(cache, ("allocate", "backfill"))
+    _assert_parity(rs, rj)
+    assert rs[1] == {}  # no evicting action configured
+
+
+@pytest.mark.slow
+def test_joint_compact_wire_parity():
+    """KB_TPU_COMPACT_WIRE × joint: the narrow wire dict (u8 states,
+    int16 nodes, u8 evict codes) must match the sequential fold's."""
+    rs, rj, _ = _solve_both(
+        _world_priority_preempt(), FOUR, compact_wire=True
+    )
+    _, w1, jr1, _ = rs
+    _, w2, jr2, _ = rj
+    assert set(w1) == set(w2) == {"task_state", "task_node", "evict_code"}
+    for k in w1:
+        assert w1[k].dtype == w2[k].dtype, k
+        np.testing.assert_array_equal(
+            np.asarray(w1[k]), np.asarray(w2[k]), err_msg=k
+        )
+    np.testing.assert_array_equal(np.asarray(jr1), np.asarray(jr2))
+
+
+# -- the pinned strictly-better scenario ----------------------------
+
+def test_joint_admits_placement_sequential_refuses():
+    """The one divergence the joint formulation is FOR (and the design
+    doc's worked example).
+
+    World: node n0 (4 cpu) is full with gang G (queue qb): W (3 cpu,
+    prio 0) + W2 (1 cpu, prio 500), min_member=1.  Pending: X (queue
+    qa, 1.5 cpu, prio 1000) and Y — a late 1-cpu member of G with task
+    priority 1000.
+
+    Sequential (allocate, preempt): X can't allocate (n0 full), can't
+    preempt (its victims are same-queue only — G is in qb), so the
+    intra-job band scans X first (qa's vtime ranks it ahead), finds
+    nothing, and latches `tried`.  Y then intra-preempts W (3 cpu out,
+    1 cpu in — 2 cpu surplus), but the latch never revisits X.  X
+    stays Pending on freed capacity it fits.
+
+    Joint: the gated post-eviction sweep runs one more future-capacity
+    auction over the surplus and pipelines X.  Strictly more work
+    placed; the eviction set is identical.
+    """
+    cache, sim = make_world(SPEC)
+    sim.add_queue(Queue(name="qa", weight=1.0))
+    sim.add_queue(Queue(name="qb", weight=1.0))
+    sim.add_node(Node(
+        name="n0",
+        allocatable={"cpu": 4000, "memory": 16 * GI, "pods": 110},
+    ))
+    sim.submit(
+        PodGroup(name="G", queue="qb", min_member=1),
+        [
+            Pod(name="G-w",
+                request={"cpu": 3000, "memory": 4 * GI, "pods": 1},
+                priority=0),
+            Pod(name="G-w2",
+                request={"cpu": 1000, "memory": 1 * GI, "pods": 1},
+                priority=500),
+        ],
+    )
+    _run_cycle(cache, ["allocate"])
+    sim.tick()
+    sim.submit(
+        PodGroup(name="JA", queue="qa", min_member=1, priority=1000),
+        [Pod(name="X",
+             request={"cpu": 1500, "memory": 2 * GI, "pods": 1},
+             priority=1000)],
+    )
+    sim.submit_to_group(
+        "G",
+        [Pod(name="Y",
+             request={"cpu": 1000, "memory": 1 * GI, "pods": 1},
+             priority=1000)],
+    )
+
+    rs, rj, meta = _solve_both(cache, ("allocate", "preempt"))
+    names = [p.name for p in meta.task_pods]
+    xi = names.index("X")
+    st_seq = np.asarray(rs[0].task_state)
+    st_jnt = np.asarray(rj[0].task_state)
+
+    # both pipelines evict exactly W, attributed to preempt
+    for r in (rs, rj):
+        assert int(np.asarray(r[1]["preempt"]).sum()) == 1
+        assert bool(np.asarray(r[1]["preempt"])[names.index("G-w")])
+
+    # sequential refuses X; joint admits it onto the freed surplus
+    assert st_seq[xi] == 0, "sequential unexpectedly placed X"
+    assert st_jnt[xi] != 0, "joint failed to admit X"
+    assert np.asarray(rj[0].task_node)[xi] == 0  # n0
+
+    # strict superset: joint places everything sequential placed
+    placed_seq = st_seq != 0
+    placed_jnt = st_jnt != 0
+    assert np.all(placed_jnt[placed_seq])
+    assert int(placed_jnt.sum()) == int(placed_seq.sum()) + 1
+
+
+# -- sharding: joint must stay a layout-invariant program -----------
+
+@pytest.mark.slow  # mesh-8 compile; `make verify`'s check_joint_bench
+# gates the sharded parity claim on every run regardless
+def test_joint_sharded_matches_unsharded():
+    """The joint cycle on the 8-device virtual mesh (PR 15 node-axis
+    shardings) must be bit-identical to the single-device solve —
+    including the eviction bands and the gated sweep."""
+    from kube_batch_tpu.parallel import make_mesh, shard_cycle_inputs
+
+    cache = _world_priority_preempt()
+    conf = dataclasses.replace(default_conf(), actions=FOUR)
+    policy, _ = build_policy(conf)
+    snap, _meta = pack_snapshot(cache.snapshot())
+    cycle = jax.jit(make_cycle_solver(policy, conf.actions, joint=True))
+
+    plain, plain_ev, plain_ready, _ = cycle(snap, init_state(snap))
+    mesh = make_mesh(8)
+    snap_s, state_s = shard_cycle_inputs(snap, init_state(snap), mesh)
+    shard, shard_ev, shard_ready, _ = cycle(snap_s, state_s)
+
+    np.testing.assert_array_equal(
+        np.asarray(plain.task_state), np.asarray(shard.task_state)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(plain.task_node), np.asarray(shard.task_node)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(plain_ready), np.asarray(shard_ready)
+    )
+    for name in plain_ev:
+        np.testing.assert_array_equal(
+            np.asarray(plain_ev[name]), np.asarray(shard_ev[name]),
+            err_msg=name,
+        )
+
+
+# -- builder guardrails and cache-key hygiene -----------------------
+
+def test_joint_phase_list_shape():
+    policy, _ = build_policy(
+        dataclasses.replace(default_conf(), actions=FOUR)
+    )
+    from kube_batch_tpu.ops.joint import AuctionPhase, EvictPhase
+
+    phases = build_joint_phases(policy, FOUR)
+    kinds = [type(p).__name__ for p in phases]
+    # allocate(idle,future), backfill, preempt(inter,intra), reclaim,
+    # gated admission sweep
+    assert kinds == [
+        "AuctionPhase", "AuctionPhase", "AuctionPhase",
+        "EvictPhase", "EvictPhase", "EvictPhase", "AuctionPhase",
+    ]
+    assert phases[-1].gated_on_evictions
+    assert [p.evict_code for p in phases if isinstance(p, EvictPhase)] \
+        == [3, 3, 4]
+    # no evict bands → no sweep, nothing gated
+    phases = build_joint_phases(policy, ("allocate", "backfill"))
+    assert all(isinstance(p, AuctionPhase) for p in phases)
+    assert not any(p.gated_on_evictions for p in phases)
+
+
+def test_joint_refuses_custom_actions():
+    """A custom action (or a custom class shadowing a built-in name)
+    cannot be folded into the tier list: the builder must raise so the
+    scheduler takes the sequential fallback, never silently drop it."""
+    from kube_batch_tpu.framework.plugin import ACTION_REGISTRY
+    from kube_batch_tpu.actions.allocate import AllocateAction
+
+    policy, _ = build_policy(default_conf())
+    with pytest.raises(ValueError, match="joint"):
+        make_cycle_solver(policy, ("allocate", "bogus"), joint=True)
+
+    class ShadowAllocate(AllocateAction):
+        pass
+
+    prev = ACTION_REGISTRY["allocate"]
+    ACTION_REGISTRY["allocate"] = ShadowAllocate
+    try:
+        with pytest.raises(ValueError, match="not a built-in"):
+            make_cycle_solver(policy, ("allocate",), joint=True)
+    finally:
+        ACTION_REGISTRY["allocate"] = prev
+
+
+def test_conf_digest_joint_axis(monkeypatch):
+    """The artifact-bank key must fork on the joint flag — and stay
+    byte-identical to the pre-joint digest when the flag is off, so
+    every banked artifact from before the knob keeps hitting."""
+    from kube_batch_tpu.compile_cache import conf_digest
+
+    conf = default_conf()
+    monkeypatch.delenv("KB_TPU_JOINT_SOLVE", raising=False)
+    base = conf_digest(conf)
+    assert conf_digest(conf, joint=False) == base
+    assert conf_digest(conf, joint=True) != base
+    monkeypatch.setenv("KB_TPU_JOINT_SOLVE", "1")
+    assert conf_digest(conf) == conf_digest(conf, joint=True)
+    monkeypatch.setenv("KB_TPU_JOINT_SOLVE", "0")
+    assert conf_digest(conf) == base
+
+
+def test_scheduler_env_flag_runs_joint_cycle():
+    """KB_TPU_JOINT_SOLVE=1 at scheduler construction: the fused cycle
+    is the joint program and a full run_once still binds correctly."""
+    import os
+
+    from kube_batch_tpu.scheduler import Scheduler
+
+    cache, sim = make_world(SPEC)
+    for i in range(2):
+        sim.add_node(Node(
+            name=f"n{i}",
+            allocatable={"cpu": 4000, "memory": 8 * GI, "pods": 110},
+        ))
+    sim.submit(
+        PodGroup(name="work", queue="default", min_member=2),
+        _pods("work", 4, 2000, 4 * GI, 0),
+    )
+    prev = os.environ.get("KB_TPU_JOINT_SOLVE")
+    os.environ["KB_TPU_JOINT_SOLVE"] = "1"
+    try:
+        s = Scheduler(cache, schedule_period=0.0)
+        assert s._joint_solve
+        assert s.run_once() is not None
+        assert len(sim.binds) == 4
+    finally:
+        if prev is None:
+            os.environ.pop("KB_TPU_JOINT_SOLVE", None)
+        else:
+            os.environ["KB_TPU_JOINT_SOLVE"] = prev

@@ -1,0 +1,115 @@
+"""Bench ↔ daemon program identity.
+
+The bench's numbers (and the compile artifacts it banks) are only
+evidence about the daemon if both build the SAME XLA program for the
+same conf + shapes.  This pins it at the StableHLO level across the
+env-opted program variants (KB_TPU_COMPACT_WIRE, KB_TPU_JOINT_SOLVE):
+the bench's construction (bench.py · _cycle_flags + make_cycle_solver)
+must lower to byte-identical StableHLO as the scheduler's
+_build_from_conf cycle.  A drift here is silent — both sides still
+run — so only this test catches it.
+"""
+
+import dataclasses
+import hashlib
+import sys
+
+import pytest
+
+import jax
+
+from kube_batch_tpu.actions import factory as _af  # noqa: F401
+from kube_batch_tpu.actions.fused import make_cycle_solver
+from kube_batch_tpu.cache.packer import pack_snapshot
+from kube_batch_tpu.framework.conf import default_conf
+from kube_batch_tpu.models.workloads import build_config
+from kube_batch_tpu.ops.assignment import init_state
+from kube_batch_tpu.plugins import factory as _pf  # noqa: F401
+
+sys.path.insert(0, "/root/repo")  # bench.py lives at the repo root
+import bench  # noqa: E402
+
+FOUR = ("allocate", "backfill", "preempt", "reclaim")
+
+
+def _world():
+    cache, _sim = build_config(1)
+    snap, _meta = pack_snapshot(cache.snapshot())
+    return cache, snap, init_state(snap)
+
+
+def _stablehlo(fn, snap, state0) -> str:
+    return jax.jit(fn).lower(snap, state0).as_text()
+
+
+def _daemon_cycle(cache, monkeypatch, compact: bool, joint: bool):
+    """The program the daemon would adopt under these env flags —
+    through the real construction path (Scheduler.__init__ reads the
+    env, _build_from_conf builds the cycle)."""
+    from kube_batch_tpu.scheduler import Scheduler
+
+    monkeypatch.setenv("KB_TPU_COMPACT_WIRE", "1" if compact else "0")
+    monkeypatch.setenv("KB_TPU_JOINT_SOLVE", "1" if joint else "0")
+    s = Scheduler(cache, schedule_period=0.0)
+    built = s._build_from_conf(
+        dataclasses.replace(default_conf(), actions=FOUR)
+    )
+    assert built["cycle"] is not None
+    return built["cycle"]
+
+
+@pytest.mark.parametrize(
+    "compact,joint",
+    [
+        (False, False),
+        pytest.param(True, False, marks=pytest.mark.slow),
+        (False, True),
+        pytest.param(True, True, marks=pytest.mark.slow),
+    ],
+    ids=["default", "compact", "joint", "compact+joint"],
+)
+def test_bench_and_daemon_lower_identically(monkeypatch, compact, joint):
+    cache, snap, state0 = _world()
+
+    daemon_jitted = _daemon_cycle(cache, monkeypatch, compact, joint)
+    daemon_hlo = daemon_jitted.lower(snap, state0).as_text()
+
+    # the bench side: same env, its own flag resolution + construction
+    from kube_batch_tpu.framework.session import build_policy
+
+    flags = bench._cycle_flags()
+    assert flags == {"compact_wire": compact, "joint": joint}
+    policy, _ = build_policy(default_conf())
+    bench_hlo = _stablehlo(
+        make_cycle_solver(policy, FOUR, **flags), snap, state0
+    )
+
+    d = hashlib.sha256(daemon_hlo.encode()).hexdigest()
+    b = hashlib.sha256(bench_hlo.encode()).hexdigest()
+    assert d == b, (
+        f"bench and daemon compile different programs for "
+        f"compact={compact} joint={joint}"
+    )
+
+
+@pytest.mark.slow
+def test_flags_actually_fork_the_program(monkeypatch):
+    """The identity test above would pass vacuously if the flags were
+    ignored on BOTH sides — prove each flag changes the lowered
+    program."""
+    cache, snap, state0 = _world()
+    from kube_batch_tpu.framework.session import build_policy
+
+    policy, _ = build_policy(default_conf())
+
+    def hlo(**kw):
+        return hashlib.sha256(
+            _stablehlo(
+                make_cycle_solver(policy, FOUR, **kw), snap, state0
+            ).encode()
+        ).hexdigest()
+
+    base = hlo()
+    assert hlo(compact_wire=True) != base
+    assert hlo(joint=True) != base
+    assert hlo(joint=True) != hlo(compact_wire=True)

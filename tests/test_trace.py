@@ -306,6 +306,8 @@ def test_transitions_stamp_the_open_cycle(tmp_path):
     assert t.recorder.dumps[-1]["cycle"] == 2
 
 
+@pytest.mark.slow  # soak-scale (~60 s) on the tier-1 host; plain
+# `pytest tests/` still runs it
 def test_flight_ring_bounded_under_churn_soak(tmp_path, monkeypatch):
     """500 scheduler cycles of steady churn: every trace-side ring
     stays at its bound — the always-on recorder can never become the
