@@ -256,6 +256,17 @@ chaos:
 	$(PY) scripts/check_chaos_autopilot.py /tmp/kb-chaos-autopilot-1.json \
 	    /tmp/kb-chaos-autopilot-2.json /tmp/kb-chaos-autopilot-off.json \
 	    /tmp/kb-chaos-cells-1.json
+	JAX_PLATFORMS=cpu $(PY) -m kube_batch_tpu.chaos --seed 23 --ticks 32 \
+	    --scenario examples/chaos-mesh.json --mesh-devices 8 \
+	    --quiet > /tmp/kb-chaos-meshladder-1.json
+	JAX_PLATFORMS=cpu $(PY) -m kube_batch_tpu.chaos --seed 23 --ticks 32 \
+	    --scenario examples/chaos-mesh.json --mesh-devices 8 \
+	    --quiet > /tmp/kb-chaos-meshladder-2.json
+	JAX_PLATFORMS=cpu $(PY) -m kube_batch_tpu.chaos --seed 23 --ticks 32 \
+	    --scenario examples/chaos-mesh.json --mesh-devices 8 \
+	    --no-faults --quiet > /tmp/kb-chaos-meshladder-f.json
+	$(PY) scripts/check_chaos_mesh.py /tmp/kb-chaos-meshladder-1.json \
+	    /tmp/kb-chaos-meshladder-2.json /tmp/kb-chaos-meshladder-f.json
 
 profile:
 	$(PY) -m kube_batch_tpu --workload 2 --cycles 3 --schedule-period 0 \

@@ -115,6 +115,8 @@ _META_FAULT_FIELDS = (
     "crash_restart_at", "crash_restarts", "crash_restart_every",
     "hbm_pin_at", "compile_bank",
     "storm_at", "storm_ticks", "storm_events",
+    "device_loss_at", "device_loss_ticks", "device_loss_devices",
+    "device_loss_refuse_devices",
 )
 
 # -- node-health fault tuning (active only when FaultSpec.flaky_at is
@@ -188,8 +190,17 @@ class ChaosResult:
     #: Device-mesh observability: the run's mesh size plus the
     #: packer's per-device H2D accounting — the mesh-parity check
     #: reads the device count to prove the dimension actually ran
-    #: sharded while the trace hash stayed put.
+    #: sharded while the trace hash stayed put.  Device-loss runs add
+    #: the degradation-ladder evidence (rung reached, shift counts,
+    #: refused rungs, per-window serve census).
     mesh: dict | None = None
+    #: Hash of the DECISION log alone (no workload/fault events): the
+    #: device-loss parity check compares it between a fault-on run and
+    #: its fault-off baseline — the injected outage changes the fault
+    #: schedule (hence the full trace hash) but must never change one
+    #: decision (the mesh is a layout choice; degraded cycles solve
+    #: bit-identically, doc/design/multichip-shard.md).
+    decisions_hash: str = ""
     #: Joint-solve observability: whether KB_TPU_JOINT_SOLVE was on
     #: for the run's schedulers and whether the fused (joint) cycle
     #: actually served — the joint-parity check reads this to prove
@@ -225,6 +236,7 @@ class ChaosResult:
             "ticks": self.ticks_run,
             "violations": [v.as_dict() for v in self.violations],
             "trace_hash": self.trace_hash,
+            "decisions_hash": self.decisions_hash,
             "bound_pods": len(self.final_assignment),
             "faults": dict(self.faults),
             "recoveries": dict(self.recoveries),
@@ -478,6 +490,18 @@ class ChaosEngine:
         # scenarios build both too — they are the state under test.
         self._flaky_victim: str | None = None
         self._health_by_tick: dict[int, dict] = {}
+        # -- device-loss state (mesh degradation ladder) ---------------
+        #: The live injector (raises DeviceLossError at the solve
+        #: seam while the topology is wider than the healthy floor);
+        #: kept on the engine so a crash-restart mid-window re-arms
+        #: the successor incarnation.
+        self._device_loss_injector = None
+        #: tick -> ladder sample at end of a COMPLETED run_once (rung,
+        #: devices, refused rungs): the no-cycle-lost-while-degraded
+        #: invariant reads window-tick coverage; NOT part of the
+        #: trace hash (the ladder's walk is observability, the
+        #: decisions are the contract).
+        self._mesh_by_tick: dict[int, dict] = {}
         self._cordoned_placements = 0
         self._canary_overruns = 0
         self.health = self._build_health()
@@ -538,6 +562,7 @@ class ChaosEngine:
             or self.faults.health_faults
             or self.faults.restart_faults
             or self.faults.ingest_faults
+            or self.faults.device_loss_faults
         ):
             return None
         from kube_batch_tpu.guardrails import GuardrailConfig, Guardrails
@@ -830,6 +855,49 @@ class ChaosEngine:
                 metrics.chaos_faults_injected.inc(kind)
             else:
                 detail["skipped"] = True
+        elif kind == "device-loss":
+            # Arm the solve-seam injector: every dispatch at a
+            # topology wider than the healthy floor raises a
+            # DeviceLossError BEFORE the program runs (no state
+            # mutates), so the ladder's retry replays the identical
+            # cycle at the fallback rung — decisions unchanged.
+            sched = self.scheduler
+            if sched is None or not sched.mesh_ladder.enabled:
+                detail["skipped"] = True
+            else:
+                from kube_batch_tpu.guardrails.mesh import DeviceLossError
+
+                healthy = max(1, int(self.faults.device_loss_devices))
+
+                def _inject(s, _healthy=healthy,
+                            _err=DeviceLossError):
+                    if s.mesh_devices > _healthy:
+                        raise _err(
+                            f"chaos: injected device loss (topology "
+                            f"{s.mesh_devices} > {_healthy} healthy "
+                            "device(s))"
+                        )
+
+                self._device_loss_injector = _inject
+                sched._mesh_fault_injector = _inject
+                refuse = int(self.faults.device_loss_refuse_devices)
+                if refuse:
+                    # The refusal leg: while the ladder holds this
+                    # rung, its compile admission runs under a 1-byte
+                    # ceiling — the rung must be SKIPPED loudly, never
+                    # served (hbm-pressure's clamp model).
+                    sched._mesh_hbm_clamp = refuse
+                    detail["refuse_devices"] = refuse
+                detail["healthy_devices"] = healthy
+                self.fault_counts[kind] += 1
+                metrics.chaos_faults_injected.inc(kind)
+        elif kind == "device-heal":
+            self._device_loss_injector = None
+            if self.scheduler is not None:
+                self.scheduler._mesh_fault_injector = None
+                self.scheduler._mesh_hbm_clamp = None
+            self.recovery_counts["device-healed"] += 1
+            metrics.chaos_recoveries.inc("device-healed")
         elif kind == "hbm-pressure":
             # Compile ONE next-bucket program through the real
             # compile-then-admit path under a 1-byte ceiling: the HBM
@@ -1151,6 +1219,16 @@ class ChaosEngine:
             mesh_devices=self.mesh_devices,
         )
         self.scheduler = scheduler
+        if self._device_loss_injector is not None:
+            # A crash mid-outage restarts INTO the outage: the dead
+            # devices are still dead, so the successor gets the live
+            # injector (its persisted rung keeps it off the dead mesh;
+            # restore_mesh_state is the other half of that contract).
+            scheduler._mesh_fault_injector = self._device_loss_injector
+            if self.faults.device_loss_refuse_devices:
+                scheduler._mesh_hbm_clamp = int(
+                    self.faults.device_loss_refuse_devices
+                )
         self.statestore = self._build_statestore()
         adopted = None
         if self.statestore is not None:
@@ -1584,6 +1662,17 @@ class ChaosEngine:
                     self._compile_wait_by_tick[t] = \
                         self.scheduler._last_compile_wait_s
                     rec["compile"] = dict(self.scheduler.compile_stats)
+                if self.faults.device_loss_faults:
+                    # A sample landing here means run_once COMPLETED —
+                    # the coverage the no-cycle-lost-while-degraded
+                    # invariant reads.  NOT part of the trace hash.
+                    lad = self.scheduler.mesh_ladder
+                    self._mesh_by_tick[t] = {
+                        "rung": lad.rung,
+                        "devices": lad.devices,
+                        "refused": sorted(lad._refused),
+                    }
+                    rec["mesh"] = dict(self._mesh_by_tick[t])
             else:
                 rec["stood-down"] = True
             if self.corrupt_tick is not None and t == self.corrupt_tick:
@@ -1649,7 +1738,8 @@ class ChaosEngine:
                     if violations:
                         break
                     if self._all_settled() and self._rails_recovered() \
-                            and self._health_recovered():
+                            and self._health_recovered() \
+                            and self._mesh_recovered():
                         # Guardrail runs also drain until the ladder
                         # descends and the breaker closes; health runs
                         # until every quarantined node re-admitted
@@ -1675,6 +1765,8 @@ class ChaosEngine:
                     violations = self._check_restart(ticks_run)
                 if not violations and self.faults.ingest_faults:
                     violations = self._check_ingest(ticks_run)
+                if not violations and self.faults.device_loss_faults:
+                    violations = self._check_mesh_ladder(ticks_run)
                 if not violations and self.compile_bank_mode:
                     violations = self._check_compile(ticks_run)
         finally:
@@ -1692,6 +1784,7 @@ class ChaosEngine:
         full_hash = trace_hash(
             events + fault_events + self._decisions
         )
+        decisions_hash = trace_hash(self._decisions)
         dump_path = None
         if violations:
             os.makedirs(self.dump_dir, exist_ok=True)
@@ -1714,6 +1807,7 @@ class ChaosEngine:
             ticks_run=ticks_run,
             violations=list(violations),
             trace_hash=full_hash,
+            decisions_hash=decisions_hash,
             final_assignment=final,
             faults=dict(self.fault_counts),
             recoveries=dict(self.recovery_counts),
@@ -1751,7 +1845,7 @@ class ChaosEngine:
         if scheduler is None:
             return None
         packer = getattr(scheduler, "packer", None)
-        return {
+        out = {
             "devices": self.mesh_devices,
             "active": bool(getattr(scheduler.mesh, "active", False)),
             "last_h2d_bytes_per_device": (
@@ -1759,6 +1853,41 @@ class ChaosEngine:
                 if packer is not None else 0
             ),
         }
+        if self.faults.device_loss_faults:
+            # Degradation-ladder evidence for check_chaos_mesh.py:
+            # the ladder must have engaged (≥1 down-shift), every
+            # window tick must have served, a clamped rung must show
+            # in the refused census, and the run must end healed.
+            lad = scheduler.mesh_ladder
+            w0 = self.faults.device_loss_at
+            w1 = w0 + self.faults.device_loss_ticks
+            window = [
+                t for t in range(w0, min(w1, self.ticks))
+            ]
+            out["ladder"] = {
+                "chain": list(lad.chain),
+                "rung": lad.rung,
+                "live_devices": lad.devices,
+                "max_rung_seen": lad.max_rung_seen,
+                "transitions": lad.transitions,
+                "refused_rungs": sorted(
+                    {d for s in self._mesh_by_tick.values()
+                     for d in s.get("refused", ())}
+                ),
+                "window_ticks": len(window),
+                "window_served": sum(
+                    1 for t in window if t in self._mesh_by_tick
+                ),
+                "window_degraded": sum(
+                    1 for t in window
+                    if self._mesh_by_tick.get(t, {}).get("rung", 0) > 0
+                ),
+                "shifts_down": metrics.mesh_rung_shifts.value("down"),
+                "shifts_up": metrics.mesh_rung_shifts.value("up"),
+                "solve_failures_device":
+                    metrics.mesh_solve_failures.value("device"),
+            }
+        return out
 
     def _joint_summary(self) -> dict | None:
         scheduler = getattr(self, "scheduler", None)
@@ -1794,6 +1923,90 @@ class ChaosEngine:
             rung_recovered
             and self.guardrails.breaker_state() != CircuitBreaker.OPEN
         )
+
+    def _mesh_recovered(self) -> bool:
+        """Drain gate for device-loss runs: 'converged' includes the
+        ladder back at rung 0 — the heal-after-restore half of the
+        contract (canary streaks climbing through admitted rungs after
+        the fault window closes).  Non-device-loss runs gate on
+        nothing: the rung can only move when the injector is armed."""
+        if not self.faults.device_loss_faults or self.scheduler is None:
+            return True
+        return self.scheduler.mesh_ladder.rung == 0
+
+    def _check_mesh_ladder(self, tick: int) -> list[Violation]:
+        """Post-run assertions for the device-loss scenario
+        (guardrails/mesh.py):
+
+        * **mesh-ladder-unarmed** — the fault ran against a 1-device
+          scheduler (no chain to walk): the run proves nothing;
+        * **mesh-ladder-never-engaged** — the injected window never
+          moved the ladder off rung 0;
+        * **mesh-cycle-lost** — a window tick never completed its
+          cycle: the ladder's whole point is that a lost device costs
+          retries inside the cycle, not the cycle;
+        * **mesh-rung-not-refused / mesh-refused-rung-served** — with
+          the refusal leg configured, the clamped rung must appear in
+          the refused census and must never be the rung a completed
+          cycle ended on;
+        * **mesh-not-healed** — the ladder must be back at rung 0 (and
+          the refused census cleared) once the window closed and the
+          drain ran."""
+        out: list[Violation] = []
+        sched = self.scheduler
+        lad = sched.mesh_ladder if sched is not None else None
+        if lad is None or not lad.enabled:
+            out.append(Violation(
+                "mesh-ladder-unarmed", tick,
+                "device-loss fault configured but the scheduler has no "
+                "ladder to walk (run with --mesh-devices >= 2)",
+            ))
+            return out
+        if lad.max_rung_seen == 0:
+            out.append(Violation(
+                "mesh-ladder-never-engaged", tick,
+                "the device-loss window never degraded the mesh — the "
+                "injector did not reach the solve seam",
+            ))
+        w0 = self.faults.device_loss_at
+        w1 = min(w0 + self.faults.device_loss_ticks, self.ticks)
+        lost = [t for t in range(w0, w1) if t not in self._mesh_by_tick]
+        if lost:
+            out.append(Violation(
+                "mesh-cycle-lost", lost[0],
+                f"{len(lost)} tick(s) in the device-loss window never "
+                f"completed a cycle: {lost[:8]} — the ladder must "
+                "serve every cycle while degraded",
+            ))
+        refuse = int(self.faults.device_loss_refuse_devices)
+        if refuse:
+            samples = list(self._mesh_by_tick.values())
+            if not any(refuse in s.get("refused", ()) for s in samples):
+                out.append(Violation(
+                    "mesh-rung-not-refused", tick,
+                    f"the {refuse}-device rung was never HBM-refused "
+                    "— the refusal leg did not fire",
+                ))
+            served_refused = [
+                t for t, s in sorted(self._mesh_by_tick.items())
+                if s.get("rung", 0) > 0 and s.get("devices") == refuse
+                and refuse in s.get("refused", ())
+            ]
+            if served_refused:
+                out.append(Violation(
+                    "mesh-refused-rung-served", served_refused[0],
+                    f"cycle(s) at {served_refused[:8]} ended on the "
+                    f"HBM-refused {refuse}-device rung — a refused "
+                    "rung must be skipped, never served",
+                ))
+        if lad.rung != 0:
+            out.append(Violation(
+                "mesh-not-healed", tick,
+                f"ladder still at rung {lad.rung} ({lad.devices} "
+                "device(s)) after the heal and the full drain — the "
+                "canary streak never restored the mesh",
+            ))
+        return out
 
     def _open_tick_binds(self) -> int:
         """Bind requests received during FULLY-open breaker ticks

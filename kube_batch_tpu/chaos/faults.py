@@ -145,6 +145,28 @@ class FaultSpec:
     #: bank on and off.
     compile_bank: int = 0
 
+    # -- device-loss faults (kube_batch_tpu/guardrails/mesh.py) ---------
+    #: Tick the DEVICE-LOSS window opens: every sharded solve dispatch
+    #: at a topology wider than `device_loss_devices` raises a
+    #: DeviceLossError BEFORE any state mutates, so the mesh
+    #: degradation ladder must classify, walk down to an admitted
+    #: rung, and keep serving every cycle — then heal back up after
+    #: the window (device_loss_at + device_loss_ticks) through the
+    #: canary-solve streak.  0 disables.
+    device_loss_at: int = 0
+    device_loss_ticks: int = 10
+    #: Devices that stay HEALTHY during the window — the widest
+    #: topology a solve can dispatch at without the injected failure.
+    #: The ladder must settle at this rung (or below, if a rung is
+    #: HBM-refused) for the window's duration.
+    device_loss_devices: int = 2
+    #: Optional rung to FORCE-REFUSE: while the ladder holds this
+    #: device count, its compile admission runs under a 1-byte HBM
+    #: ceiling (the hbm-pressure fault's clamp model), so the rung
+    #: must be skipped with MeshRungRefused instead of served.  0
+    #: disables the refusal leg.
+    device_loss_refuse_devices: int = 0
+
     # -- batched-ingest faults (doc/design/ingest-batching.md) ----------
     #: Tick the EVENT STORM opens: every tick of the window the
     #: cluster re-emits `storm_events` MODIFIED pod events (seeded
@@ -201,6 +223,14 @@ class FaultSpec:
         invariant is asserted against a LIVE ladder, and runs the
         mirror-parity (no-event-lost / latest-wins) check."""
         return bool(self.storm_at)
+
+    @property
+    def device_loss_faults(self) -> bool:
+        """The device-loss fault configured — the engine then installs
+        the solve-seam injector on the driven scheduler (and a
+        Guardrails instance, so rung admission runs against a LIVE
+        HBM ceiling) and asserts the mesh-ladder invariants."""
+        return bool(self.device_loss_at)
 
     @property
     def health_faults(self) -> bool:
@@ -277,6 +307,15 @@ def plan_faults(spec: FaultSpec, seed: int, ticks: int) -> list[dict]:
         events.append({
             "tick": spec.flaky_at + spec.flaky_ticks, "op": "fault",
             "kind": "flaky-heal",
+        })
+    if spec.device_loss_at:
+        events.append({
+            "tick": spec.device_loss_at, "op": "fault",
+            "kind": "device-loss",
+        })
+        events.append({
+            "tick": spec.device_loss_at + spec.device_loss_ticks,
+            "op": "fault", "kind": "device-heal",
         })
     if spec.storm_at:
         for t in range(spec.storm_at, spec.storm_at + spec.storm_ticks):

@@ -231,6 +231,17 @@ class ArtifactBank:
         self.hits = 0
         self.rejects: dict[str, int] = {}
 
+    def retarget_mesh(self, mesh_devices: int) -> None:
+        """Re-key every subsequent get/put at a different mesh
+        topology — the mesh degradation ladder's rung shifts
+        (guardrails/mesh.py) retarget the live bank instead of
+        rebuilding it, so the mirror sink, counters and root survive
+        the shift.  Entries banked at other topologies stay on disk
+        untouched (their keys no longer resolve from this rung), which
+        is exactly what makes a later heal adopt the full-mesh program
+        instead of recompiling it."""
+        self.mesh = mesh_topology(mesh_devices)
+
     # -- internals ------------------------------------------------------
     def _reject(self, reason: str, detail: str = "") -> None:
         self.rejects[reason] = self.rejects.get(reason, 0) + 1

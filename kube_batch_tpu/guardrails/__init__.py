@@ -52,6 +52,13 @@ from kube_batch_tpu.guardrails.breaker import (
     is_transient,
 )
 from kube_batch_tpu.guardrails.hbm import HbmCeiling, projected_device_bytes
+from kube_batch_tpu.guardrails.mesh import (
+    DeviceLossError,
+    MeshLadder,
+    MeshRungRefused,
+    classify_solve_error,
+    topology_chain,
+)
 from kube_batch_tpu.guardrails.watchdog import RUNGS, CycleWatchdog
 
 __all__ = [
@@ -59,13 +66,18 @@ __all__ = [
     "BreakerOpen",
     "CircuitBreaker",
     "CycleWatchdog",
+    "DeviceLossError",
     "GuardedBackend",
     "Guardrails",
     "GuardrailConfig",
     "HbmCeiling",
+    "MeshLadder",
+    "MeshRungRefused",
     "RUNGS",
+    "classify_solve_error",
     "is_transient",
     "projected_device_bytes",
+    "topology_chain",
 ]
 
 log = logging.getLogger(__name__)

@@ -1,0 +1,261 @@
+"""Device-loss degradation ladder for the sharded solve.
+
+PR 15 sharded the pack→solve→patch pipeline over a 1-D node-axis
+device mesh (doc/design/multichip-shard.md); this module makes a
+device error mid-solve a DEGRADATION instead of a crash.  Failures at
+the `run_once` solve seam are classified — device/runtime errors
+(a shard died, the runtime wedged) walk the ladder; data errors (a
+bug in the program or the pack) re-raise and stay loud — and the
+ladder degrades along a topology chain halving from the configured
+mesh (8 → 4 → 2 → 1 devices; 1 is the inert single-device path that
+always works) with the same structural hysteresis as the cycle
+watchdog (guardrails/watchdog.py)::
+
+    rung 0 (8 dev) ──(engage_after consecutive device failures)──► rung 1
+    rung 1 (4 dev) ──(engage_after more)──► rung 2 (2 dev) ── …
+    rung N ──(recover_after consecutive clean solves)──► rung N-1
+
+Engagement and recovery both require CONSECUTIVE streaks, and any
+failure resets the healthy streak (and vice versa) — a flaky device
+that alternates cannot flap the topology.  Recovery is deliberately
+slower than engagement: the clean-solve streak at the degraded rung
+is the canary evidence that climbing back is safe, and climbing too
+eagerly re-enters the failure that engaged the ladder.
+
+The ladder only holds STATE (rung + streaks + refused rungs); the
+scheduler owns the effects — rebuilding the MeshContext, re-keying
+the artifact bank, re-running per-device HBM admission at the new
+(larger-per-shard) partitioning, and refusing a rung loudly
+(`MeshRungRefused`) rather than OOMing it.  The mesh is a LAYOUT
+choice, never a semantics choice (PR 15 pins bit-identical device
+state across mesh sizes), so a degraded cycle's decisions hash
+identical to the healthy mesh's — the chaos harness pins exactly
+that (`make chaos`, examples/chaos-mesh.json).
+"""
+
+from __future__ import annotations
+
+import threading
+
+from kube_batch_tpu import metrics
+
+#: Hysteresis defaults — consecutive device failures per rung down,
+#: consecutive clean solves per rung up.  Recovery > engagement so a
+#: heal needs more evidence than the outage that engaged the ladder.
+ENGAGE_AFTER = 2
+RECOVER_AFTER = 4
+
+
+class DeviceLossError(RuntimeError):
+    """A solve shard failed because its device is gone/wedged.  The
+    chaos engine's `device_loss` fault family raises exactly this at
+    the dispatch seam; real backends surface XlaRuntimeError, which
+    classifies identically."""
+
+
+class MeshRungRefused(RuntimeError):
+    """No admitted fallback topology remains: every rung below the
+    failing one was refused by per-device HBM admission (shrinking the
+    mesh GROWS each shard — a world that barely fit at 8 devices may
+    fit nowhere smaller).  The scheduler catches this and pauses the
+    solve (the hbm-blocked discipline: placed work keeps running,
+    pending rows wait) instead of OOMing a rung the ceiling refused."""
+
+    def __init__(self, devices: int, label: str = "") -> None:
+        self.devices = int(devices)
+        self.label = label
+        super().__init__(
+            f"mesh rung at {devices} device(s) refused by HBM "
+            f"admission and no admitted rung remains below"
+            + (f": {label}" if label else "")
+        )
+
+
+#: Exception types that classify as DATA errors: deterministic
+#: program/pack bugs that would fail identically at every topology —
+#: degrading the mesh for them would burn the ladder without fixing
+#: anything, so they re-raise and stay loud.
+_DATA_ERRORS = (ValueError, TypeError, KeyError, IndexError,
+                ZeroDivisionError, AssertionError)
+
+
+def classify_solve_error(exc: BaseException) -> str:
+    """``"device"`` (walks the ladder) or ``"data"`` (re-raises).
+
+    Device evidence: the chaos injector's DeviceLossError, XLA/JAX
+    runtime errors (matched by name — jaxlib's XlaRuntimeError import
+    path is version-dependent), and the OS/runtime error families a
+    dying accelerator surfaces through.  Anything unrecognized
+    classifies as DATA: silently shrinking the mesh over an unknown
+    bug would hide it, and a real device error recurs until the
+    runtime error types above catch it."""
+    if isinstance(exc, DeviceLossError):
+        return "device"
+    if isinstance(exc, _DATA_ERRORS):
+        return "data"
+    name = type(exc).__name__
+    if "XlaRuntimeError" in name or "JaxRuntimeError" in name:
+        return "device"
+    if isinstance(exc, (RuntimeError, OSError, SystemError, MemoryError)):
+        return "device"
+    return "data"
+
+
+def topology_chain(devices: int) -> tuple[int, ...]:
+    """The degradation chain for a configured mesh: halve down to the
+    single-device floor (8 → (8, 4, 2, 1)).  Index == rung.  A
+    1-device mesh yields the single-rung chain (1,) — ladder disabled,
+    today's exact unsharded path."""
+    d = max(int(devices), 1)
+    chain = [d]
+    while d > 1:
+        d //= 2
+        chain.append(max(d, 1))
+    return tuple(chain)
+
+
+class MeshLadder:
+    """Rung state machine over a topology chain.  Thread-safe like the
+    watchdog; all effects live in the scheduler."""
+
+    def __init__(
+        self,
+        devices: int,
+        engage_after: int = ENGAGE_AFTER,
+        recover_after: int = RECOVER_AFTER,
+    ) -> None:
+        self.chain = topology_chain(devices)
+        self.engage_after = max(int(engage_after), 0)
+        self.recover_after = max(int(recover_after), 1)
+        self.rung = 0
+        self.max_rung_seen = 0
+        #: Total rung shifts (both directions) — the /healthz `mesh`
+        #: entry's transitions counter.
+        self.transitions = 0
+        self._failures = 0   # current consecutive device-failure streak
+        self._healthy = 0    # current consecutive clean-solve streak
+        #: Device counts whose rung the HBM re-admission REFUSED: the
+        #: walk skips them in BOTH directions until a full heal to
+        #: rung 0 (the refusal measured this world's per-shard size;
+        #: a healed world has moved on).
+        self._refused: set[int] = set()
+        self._lock = threading.Lock()
+        # Deliberately NO metrics.mesh_rung.set(0.0) here: the gauge
+        # is process-global and initialized at registration —
+        # constructing a second ladder (a second Scheduler in the
+        # same process) must not erase a live daemon's rung.
+
+    @property
+    def enabled(self) -> bool:
+        return len(self.chain) > 1 and self.engage_after > 0
+
+    @property
+    def devices(self) -> int:
+        """Device count of the live rung."""
+        return self.chain[self.rung]
+
+    @property
+    def configured_devices(self) -> int:
+        return self.chain[0]
+
+    def export_state(self) -> dict:
+        with self._lock:
+            return {
+                "rung": self.rung,
+                "devices": self.devices,
+                "chain": list(self.chain),
+                "transitions": self.transitions,
+            }
+
+    def restore(self, rung: int) -> None:
+        """Warm-restart adoption of a persisted rung: a daemon that
+        crashed while degraded resumes degraded — a restart is not
+        evidence the dead devices came back — and must walk back up
+        through the normal recover_after canary streaks.  The caller
+        (scheduler.restore_mesh_state) rebuilds the MeshContext and
+        publishes the gauge after restoring."""
+        with self._lock:
+            self.rung = min(max(int(rung), 0), len(self.chain) - 1)
+            self.max_rung_seen = max(self.max_rung_seen, self.rung)
+            self._failures = 0
+            self._healthy = 0
+
+    def _next_down(self) -> int | None:
+        nxt = self.rung + 1
+        while nxt < len(self.chain) and self.chain[nxt] in self._refused:
+            nxt += 1
+        return nxt if nxt < len(self.chain) else None
+
+    def _next_up(self) -> int | None:
+        nxt = self.rung - 1
+        while nxt >= 0 and self.chain[nxt] in self._refused:
+            nxt -= 1
+        return nxt if nxt >= 0 else None
+
+    def _shift(self, new_rung: int, direction: str) -> tuple[int, int]:
+        old = self.chain[self.rung]
+        self.rung = new_rung
+        self.max_rung_seen = max(self.max_rung_seen, self.rung)
+        self.transitions += 1
+        self._failures = 0
+        self._healthy = 0
+        if self.rung == 0:
+            self._refused.clear()  # a full heal retires old verdicts
+        metrics.mesh_rung.set(float(self.rung))
+        metrics.mesh_rung_shifts.inc(direction)
+        return (old, self.chain[self.rung])
+
+    def observe_failure(self) -> tuple[int, int] | None:
+        """Record one device-classified solve failure.  Returns
+        ``(old_devices, new_devices)`` when the rung shifted down,
+        else None (streak still inside the hysteresis, or already at
+        the floor)."""
+        if not self.enabled:
+            return None
+        with self._lock:
+            self._healthy = 0
+            self._failures += 1
+            if self._failures < self.engage_after:
+                return None
+            nxt = self._next_down()
+            if nxt is None:
+                self._failures = 0
+                return None
+            return self._shift(nxt, "down")
+
+    def observe_healthy(self) -> tuple[int, int] | None:
+        """Record one clean solve.  At a degraded rung these are the
+        canary streak; after recover_after of them the ladder climbs
+        one (admitted) rung.  Returns ``(old_devices, new_devices)``
+        on a shift, else None."""
+        if not self.enabled:
+            return None
+        with self._lock:
+            self._failures = 0
+            if self.rung == 0:
+                self._healthy = 0
+                return None
+            self._healthy += 1
+            if self._healthy < self.recover_after:
+                return None
+            nxt = self._next_up()
+            if nxt is None:
+                self._healthy = 0
+                return None
+            return self._shift(nxt, "up")
+
+    def refuse_current(self) -> tuple[int, int] | None:
+        """Per-device HBM admission refused the LIVE rung's program:
+        mark it refused and advance immediately to the next admitted
+        rung below (no hysteresis — the projection is a pure function
+        of the program, so retrying the refused rung is pointless).
+        Returns the shift, or None when no admitted rung remains (the
+        caller raises MeshRungRefused and pauses the solve)."""
+        if not self.enabled:
+            return None
+        with self._lock:
+            self._refused.add(self.chain[self.rung])
+            nxt = self._next_down()
+            if nxt is None:
+                return None
+            return self._shift(nxt, "down")

@@ -409,6 +409,33 @@ hbm_blocked_cycles = REGISTRY.register(Counter(
     "action).",
 ))
 
+# -- mesh degradation ladder (kube_batch_tpu/guardrails/mesh.py) -------------
+mesh_rung = REGISTRY.register(Gauge(
+    "mesh_rung",
+    "Device-loss degradation-ladder rung of the sharded solve "
+    "(0 = full configured mesh; each rung halves the device count "
+    "down to the single-device floor); mirrored by the /healthz "
+    "`mesh` entry.",
+))
+# Exposed from process start (not from a constructor: MeshLadder
+# instances must never reset the process-global rung a LIVE instance
+# already published — same discipline as guardrail_state above).
+mesh_rung.set(0.0)
+mesh_rung_shifts = REGISTRY.register(Counter(
+    "mesh_rung_shifts_total",
+    "Mesh-ladder rung transitions by direction ('down' = device-loss "
+    "degradation or HBM-refused-rung skip, 'up' = canary-streak "
+    "heal).",
+    labels=("direction",),
+))
+mesh_solve_failures = REGISTRY.register(Counter(
+    "mesh_solve_failures_total",
+    "Sharded-solve failures at the run_once seam by classification "
+    "('device' walks the degradation ladder; 'data' re-raises — a "
+    "program bug fails identically at every topology).",
+    labels=("class",),
+))
+
 # -- AOT compile-artifact bank + no-block compile ladder ---------------------
 # (kube_batch_tpu/compile_cache.py · ArtifactBank; scheduler.py ·
 #  _ensure_compiled; doc/design/compile-artifacts.md)
@@ -585,6 +612,10 @@ _health_mesh_devices = 1
 #: surfaced: bodies of daemons that never compute them are unchanged.
 _health_demand: dict | None = None
 _health_autopilot: dict | None = None
+#: Mesh degradation-ladder state (guardrails/mesh.py) — None until a
+#: mesh-enabled scheduler publishes; single-device daemons serve an
+#: unchanged body.
+_health_mesh: dict | None = None
 #: Per-SCOPE health registry (multi-scheduler-per-process): a live
 #: scheduler driven under a bound scope (kube_batch_tpu/scope.py —
 #: the cell name) publishes here instead of stomping the process-
@@ -761,13 +792,30 @@ def set_autopilot_state(state: dict | None,
             _health_autopilot = dict(state) if state else None
 
 
+def set_mesh_state(state: dict | None, scope: str | None = None) -> None:
+    """Publish the mesh degradation ladder's live state (guardrails/
+    mesh.py — configured devices, live rung + its device count, rung
+    transitions) to /healthz + /debug/fleet — the "mesh shrank, why?"
+    runbook's first read (doc/design/daemon-operations.md).  Keys
+    appear only once published: a single-device daemon serves an
+    unchanged body."""
+    global _health_mesh
+    s = _resolve_scope(scope)
+    with _health_lock:
+        if s is not None:
+            _scope_entry(s)["mesh"] = dict(state or {})
+        else:
+            _health_mesh = dict(state) if state else None
+
+
 def reset_health_scopes() -> None:
     """Drop every per-scope health entry (test / engine teardown)."""
-    global _health_demand, _health_autopilot
+    global _health_demand, _health_autopilot, _health_mesh
     with _health_lock:
         _health_scopes.clear()
         _health_demand = None
         _health_autopilot = None
+        _health_mesh = None
 
 
 def health_snapshot() -> dict[str, dict]:
@@ -793,6 +841,8 @@ def health_snapshot() -> dict[str, dict]:
             out[""]["demand"] = dict(_health_demand)
         if _health_autopilot is not None:
             out[""]["autopilot"] = dict(_health_autopilot)
+        if _health_mesh is not None:
+            out[""]["mesh"] = dict(_health_mesh)
     out[""]["commit_queue_depth"] = int(commit_queue_depth.value())
     return out
 
@@ -873,6 +923,11 @@ def health_body() -> bytes:
             body["demand"] = dict(_health_demand)
         if _health_autopilot is not None:
             body["autopilot"] = dict(_health_autopilot)
+        # Mesh degradation-ladder entry (guardrails/mesh.py): appears
+        # only once a mesh-enabled scheduler publishes — a shrunken
+        # mesh is visible to probes without scraping /metrics.
+        if _health_mesh is not None:
+            body["mesh"] = dict(_health_mesh)
         if _health_scopes:
             body["cells"] = {
                 name: dict(entry)

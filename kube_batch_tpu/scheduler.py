@@ -116,6 +116,39 @@ class Scheduler:
         self.mesh_devices = self.mesh.devices
         metrics.set_mesh_devices(self.mesh_devices)
         self.packer = IncrementalPacker(cache, mesh=self.mesh)
+        # Device-loss degradation ladder (guardrails/mesh.py): device-
+        # classified solve failures walk a halving topology chain
+        # (8→4→2→1; 1 is the always-working inert path) with watchdog-
+        # style hysteresis, and clean solves at a degraded rung are the
+        # canary streak that heals it.  The mesh stays a LAYOUT choice
+        # under the ladder — a degraded cycle's decisions are bit-
+        # identical to the healthy mesh's (pinned by `make chaos`).
+        # Inert (single-rung chain, ladder disabled) at mesh_devices=1.
+        from kube_batch_tpu.guardrails.mesh import MeshLadder
+
+        self.configured_mesh_devices = self.mesh_devices
+        self.mesh_ladder = MeshLadder(self.mesh_devices)
+        #: Chaos/test seam: callable(scheduler) invoked right before
+        #: the solve dispatch — the device_loss fault family raises
+        #: DeviceLossError here (chaos/engine.py), BEFORE any device
+        #: state changes, so the ladder's retry replays the identical
+        #: cycle bit-for-bit.
+        self._mesh_fault_injector = None
+        #: Chaos/test seam: device count whose rung admission runs
+        #: under a 1-byte HBM ceiling — forces a deterministic
+        #: MeshRungRefused skip without a genuinely over-ceiling
+        #: program (the chaos hbm-refused-rung-skipped invariant).
+        self._mesh_hbm_clamp: int | None = None
+        #: (conf_digest, shapes, devices) already fallback-prewarmed:
+        #: bounds the next-rung-down prewarm to ONE program per
+        #: served bucket (see _maybe_prewarm_mesh_fallback).
+        self._mesh_fallback_warmed: set[tuple] = set()
+        if self.mesh_ladder.enabled:
+            # /healthz `mesh` entry only — the mesh_rung GAUGE is set
+            # at registration and on transitions/restores, never here
+            # (a second in-process Scheduler must not stomp a live
+            # daemon's rung).
+            self._publish_mesh_state()
         mode = pack_mode or _os.environ.get(
             "KB_TPU_PACK_MODE", "incremental"
         )
@@ -369,11 +402,22 @@ class Scheduler:
             key, exe = compiled
             self._compiled_shapes[key] = exe
 
-    @staticmethod
-    def _shape_key(cycle, snap) -> tuple:
+    def _shape_key(self, cycle, snap, mesh_devices: int | None = None
+                   ) -> tuple:
+        """Program identity for the compiled-shapes table.  Element 0
+        carries BOTH the cycle identity and the mesh topology the
+        program was lowered at: the degradation ladder
+        (guardrails/mesh.py) re-lowers the same shapes at a different
+        device count, and a topology-blind key would let a background
+        compile staged at the OLD topology publish a program whose
+        dispatch XLA then refuses ("called with mesh N, compiled with
+        mesh M") at the new rung.  key[1:] stays the pure shape tail
+        (bank keys + refusal pins consume it unchanged)."""
         import dataclasses as _dc
 
-        return (id(cycle),) + tuple(
+        if mesh_devices is None:
+            mesh_devices = self.mesh_devices
+        return ((id(cycle), int(mesh_devices)),) + tuple(
             (f.name, tuple(getattr(snap, f.name).shape))
             for f in _dc.fields(snap)
         )
@@ -432,7 +476,7 @@ class Scheduler:
                         "compile-start", where="conf-prewarm",
                         cycle=req_cycle,
                     )
-                    key = Scheduler._shape_key(cycle, snap)
+                    key = self._shape_key(cycle, snap, mesh.devices)
                     with trace.span("compile", cycle=req_cycle,
                                     where="conf-prewarm"), \
                             mesh.scan_scope():
@@ -1437,16 +1481,367 @@ class Scheduler:
         refused program: the solve pauses for this cycle instead.
         COMPILE_PENDING means the needed bucket is still compiling in
         the background: the cycle serves the last compiled bucket with
-        overflow rows held Pending (doc/design/compile-artifacts.md)."""
-        exe = self._ensure_compiled(ssn.snap, ssn.state)
-        if exe is None:
-            self._hbm_blocked_cycle(ssn)
+        overflow rows held Pending (doc/design/compile-artifacts.md).
+
+        This is also the run_once solve seam of the mesh degradation
+        ladder (guardrails/mesh.py): a device-classified dispatch
+        failure RETRIES within the same cycle — at the same topology
+        while the failure streak is inside the hysteresis, at the
+        fallback rung after a shift — so no cycle is lost to a dead
+        device; data errors re-raise unchanged.  A fallback rung whose
+        program the per-device HBM admission refuses (each shard GREW)
+        is skipped loudly (MeshRungRefused) instead of OOMed, down to
+        the hbm-blocked pause when no admitted rung remains."""
+        attempts = 0
+        walking = False   # the ladder moved/retried within THIS cycle
+        placed_mesh = self.mesh  # the mesh ssn.snap/state were placed under
+        while True:
+            if self.mesh is not placed_mesh:
+                # A rung shift landed inside THIS cycle: the session's
+                # arrays still carry the old topology's shardings, and
+                # XLA refuses cross-topology args against the new
+                # rung's program.  Re-land them under the live mesh
+                # before compiling/dispatching (the NEXT cycle's pack
+                # rebuilds fresh — mark_full — so this is a one-shot
+                # mid-walk cost).
+                self._replace_mesh_placement(ssn)
+                placed_mesh = self.mesh
+            clamp = (
+                self._mesh_hbm_clamp is not None
+                and self.mesh_devices == self._mesh_hbm_clamp
+            )
+            if clamp:
+                prev_ceiling = self.guardrails.hbm.ceiling_bytes
+                self.guardrails.hbm.ceiling_bytes = 1
+            try:
+                exe = self._ensure_compiled(ssn.snap, ssn.state)
+            finally:
+                if clamp:
+                    self.guardrails.hbm.ceiling_bytes = prev_ceiling
+            if exe is None:
+                if walking and self._refuse_mesh_rung(ssn):
+                    continue
+                self._hbm_blocked_cycle(ssn)
+                return
+            if exe is COMPILE_PENDING:
+                self._compile_pending_cycle(ssn)
+                return
+            self.guardrails.note_hbm_block(False)
+            try:
+                self._run_exe(ssn, exe, ssn.snap, ssn.state)
+            except Exception as exc:  # noqa: BLE001 — classified below;
+                # data errors re-raise
+                attempts += 1
+                if not self._mesh_solve_failed(exc, attempts):
+                    raise
+                walking = True
+                continue
+            self._mesh_solve_ok()
             return
-        if exe is COMPILE_PENDING:
-            self._compile_pending_cycle(ssn)
+
+    # -- mesh degradation ladder (guardrails/mesh.py) -------------------
+    def _mesh_solve_failed(self, exc: BaseException, attempts: int) -> bool:
+        """Classify one solve-seam failure.  Device errors feed the
+        degradation ladder and return True — the cycle retries.  Data
+        errors (a program/pack bug that fails identically at every
+        topology), a disabled ladder, and a floor that keeps failing
+        (a wedged runtime, not a lost device) return False and the
+        error surfaces unchanged."""
+        from kube_batch_tpu.guardrails.mesh import classify_solve_error
+
+        ladder = self.mesh_ladder
+        kind = classify_solve_error(exc)
+        metrics.mesh_solve_failures.inc(kind)
+        if kind != "device" or not ladder.enabled:
+            return False
+        if attempts > len(ladder.chain) * (ladder.engage_after + 1):
+            logging.error(
+                "sharded solve still failing at the ladder floor "
+                "after %d attempts — not a recoverable device loss; "
+                "surfacing the error", attempts,
+            )
+            return False
+        logging.error(
+            "sharded solve FAILED with a device-classified error at "
+            "%d device(s) (%s: %s) — mesh ladder retries the cycle",
+            self.mesh_devices, type(exc).__name__, exc,
+        )
+        shift = ladder.observe_failure()
+        if shift is not None:
+            self._mesh_degraded(shift)
+        return True
+
+    def _mesh_solve_ok(self) -> None:
+        """One clean solve: at a degraded rung this is the canary
+        streak — after recover_after of them the ladder climbs and the
+        NEXT cycle serves at the restored topology (its program comes
+        from the topology-keyed artifact bank when banked)."""
+        ladder = self.mesh_ladder
+        if not ladder.enabled:
             return
-        self.guardrails.note_hbm_block(False)
-        self._run_exe(ssn, exe, ssn.snap, ssn.state)
+        shift = ladder.observe_healthy()
+        if shift is None:
+            return
+        old, new = shift
+        self._apply_mesh_rung(new)
+        logging.info(
+            "mesh HEALED %d → %d device(s) after %d consecutive clean "
+            "solves at the degraded rung", old, new,
+            ladder.recover_after,
+        )
+        self.cache.record_event(
+            "Scheduler", "mesh-ladder", "MeshHealed",
+            f"sharded solve healed {old} → {new} device(s) after a "
+            f"clean canary streak",
+        )
+        trace.note_transition(
+            "mesh-healed", devices_from=old, devices_to=new,
+            rung=ladder.rung,
+        )
+
+    def _mesh_degraded(self, shift: tuple[int, int]) -> None:
+        """Apply one rung-down shift, loudly.  `mesh-degraded` is a
+        flight-recorder TRIGGER: the failing cycles auto-dump the
+        moment the topology shrinks."""
+        old, new = shift
+        self._apply_mesh_rung(new)
+        logging.error(
+            "mesh DEGRADED %d → %d device(s) after consecutive device "
+            "failures — the fallback-topology program is adopted from "
+            "the artifact bank when banked (else compiled through the "
+            "ordinary ladder); decisions stay bit-identical (the mesh "
+            "is a layout choice, doc/design/multichip-shard.md)",
+            old, new,
+        )
+        self.cache.record_event(
+            "Scheduler", "mesh-ladder", "MeshDegraded",
+            f"sharded solve degraded {old} → {new} device(s) after "
+            "consecutive device failures; decisions unchanged "
+            "(layout-only shift)",
+        )
+        trace.note_transition(
+            "mesh-degraded", devices_from=old, devices_to=new,
+            rung=self.mesh_ladder.rung,
+        )
+
+    def _refuse_mesh_rung(self, ssn: Session) -> bool:
+        """Mid-walk HBM refusal: _ensure_compiled measured the
+        fallback rung's program over the ceiling (halving the mesh
+        DOUBLES each shard).  Skip the rung — loudly, as a
+        MeshRungRefused — and keep walking; returns False when no
+        admitted rung remains (the caller falls through to the
+        standard hbm-blocked pause)."""
+        from kube_batch_tpu.guardrails.mesh import MeshRungRefused
+
+        refused = self.mesh_devices
+        key = self._shape_key(self._cycle, ssn.snap)
+        label, projected = self._growth_refused.get(
+            key, ("program", 0.0)
+        )
+        err = MeshRungRefused(refused, label=str(label))
+        shift = self.mesh_ladder.refuse_current()
+        if shift is None:
+            logging.error(
+                "%s — solve pauses under the hbm-blocked discipline "
+                "(placed work keeps running, pending rows wait)", err,
+            )
+            return False
+        old, new = shift
+        self._apply_mesh_rung(new)
+        logging.error(
+            "%s — rung SKIPPED, degrading %d → %d device(s) instead "
+            "of executing a program the ceiling refused", err, old, new,
+        )
+        self.cache.record_event(
+            "Scheduler", "mesh-ladder", "MeshRungRefused",
+            f"rung at {refused} device(s) refused by per-device HBM "
+            f"admission ({label} projected "
+            f"{(projected or 0) / 1e6:.1f} MB per device); skipped to "
+            f"{new} device(s)",
+        )
+        trace.note_transition(
+            "mesh-rung-refused", devices=refused, devices_to=new,
+        )
+        return True
+
+    def _apply_mesh_rung(self, devices: int) -> None:
+        """Point every topology-keyed surface at the new rung: rebuild
+        the MeshContext, re-aim the packer and the artifact bank, and
+        drop programs compiled for the old topology.  The shape key
+        carries the topology in its identity element (_shape_key), so
+        a background compile still in flight for the old topology may
+        publish its program but can never be looked up at the new
+        rung — XLA refuses cross-topology args, and the key makes the
+        mismatch unreachable instead of merely self-correcting.  The
+        artifact bank IS topology-keyed (compile_cache.mesh_topology),
+        so the fallback program is looked up there first and a rung
+        shift never pays the compile cliff blind."""
+        from kube_batch_tpu.parallel.mesh import MeshContext
+
+        self.mesh = MeshContext(devices)
+        self.mesh_devices = self.mesh.devices
+        self.packer.mesh = self.mesh
+        # A sharded pack carries rung-specific layouts: force a full
+        # rebuild under the new topology.
+        self.packer._dirty.mark_full("mesh-rung")
+        self._compiled_shapes.clear()
+        self._serving_key = None
+        # Projections and compile failures measured at the OLD
+        # partitioning prove nothing at this one (and the topology-
+        # blind shape key would let a stale refusal pin block the new
+        # rung's legitimately-admitted program).
+        self._growth_refused.clear()
+        self._growth_failed.clear()
+        if self.compile_bank is not None:
+            self.compile_bank.retarget_mesh(self.mesh_devices)
+        self._publish_mesh_state()
+
+    def _replace_mesh_placement(self, ssn: Session) -> None:
+        """Re-land the session's already-packed snapshot + assignment
+        state under the CURRENT mesh (mid-cycle rung shift only): the
+        arrays were placed at pack time under the topology that just
+        lost devices, and the fallback rung's program was lowered at
+        the new one — XLA refuses the cross-topology args.  One
+        batched device_put per pytree; values are bit-identical either
+        way (the mesh is a layout choice), so decisions cannot move."""
+        import dataclasses as _dc
+
+        import jax
+
+        n = int(ssn.snap.node_cap.shape[0])
+
+        def _replace(obj):
+            updates = {}
+            for f in _dc.fields(obj):
+                v = getattr(obj, f.name)
+                if not hasattr(v, "shape"):
+                    continue
+                sh = self.mesh.sharding_for(f.name, v, n)
+                updates[f.name] = (
+                    jax.device_put(v, sh) if sh is not None
+                    else jax.device_put(np.asarray(v))
+                )
+            return _dc.replace(obj, **updates) if updates else obj
+
+        ssn.snap = _replace(ssn.snap)
+        ssn.state = _replace(ssn.state)
+
+    def _publish_mesh_state(self) -> None:
+        """Mirror the ladder into /healthz + /debug/fleet (`mesh`
+        entry: configured devices, live rung + devices, transitions).
+        The mesh_rung GAUGE itself is set only inside ladder
+        transitions and restores — registration initializes it, and a
+        second in-process Scheduler must never stomp a live daemon's
+        rung (PR-2 gauge discipline)."""
+        ladder = self.mesh_ladder
+        metrics.set_mesh_devices(self.mesh_devices)
+        metrics.set_mesh_state({
+            "configured_devices": ladder.configured_devices,
+            "devices": ladder.devices,
+            "rung": ladder.rung,
+            "transitions": ladder.transitions,
+        })
+
+    def export_mesh_state(self) -> dict:
+        """The ladder's persistable rung (statestore glue)."""
+        return self.mesh_ladder.export_state()
+
+    def restore_mesh_state(self, state: dict) -> dict:
+        """Warm-restart adoption of a persisted mesh rung: a daemon
+        that crashed while degraded restarts degraded — blindly
+        retrying the dead mesh would re-fail engage_after cycles to
+        re-learn what its predecessor already knew — and walks back up
+        through the normal canary streaks.  Malformed fields degrade
+        to rung 0 (the caller wraps this in the start-blind try)."""
+        ladder = self.mesh_ladder
+        raw = state.get("rung", 0)
+        rung = int(raw) if isinstance(raw, (int, float)) \
+            and not isinstance(raw, bool) else 0
+        ladder.restore(rung)
+        if ladder.devices != self.mesh_devices:
+            self._apply_mesh_rung(ladder.devices)
+        metrics.mesh_rung.set(float(ladder.rung))
+        if ladder.enabled:
+            self._publish_mesh_state()
+        return {"rung": ladder.rung, "devices": ladder.devices}
+
+    def _maybe_prewarm_mesh_fallback(self, ssn: Session) -> None:
+        """Pre-bank the NEXT RUNG DOWN's program for the currently-
+        served bucket (bounded: one fallback program per served
+        bucket), so the first device-loss event ADOPTS from the
+        topology-keyed artifact bank instead of degrading through an
+        inline compile.  Follows the growth prewarm's arming and
+        ladder-pause discipline; no-ops without a bank (nothing would
+        be adoptable later) and on an already-degraded mesh (the bank
+        already holds every rung walked through)."""
+        import dataclasses as _dc
+
+        ladder = self.mesh_ladder
+        if (
+            not self._growth_armed
+            or self._cycle is None
+            or self.compile_bank is None
+            or self._conf_digest is None
+            or not ladder.enabled
+            or ladder.rung != 0
+            or len(ladder.chain) < 2
+            or self.guardrails.pause_prewarm()
+        ):
+            return
+        next_devices = ladder.chain[1]
+        shapes = tuple(
+            (f.name, tuple(getattr(ssn.snap, f.name).shape))
+            for f in _dc.fields(ssn.snap)
+        )
+        token = (self._conf_digest, shapes, next_devices)
+        if token in self._mesh_fallback_warmed:
+            return
+        self._mesh_fallback_warmed.add(token)
+        snap, cycle, digest = ssn.snap, self._cycle, self._conf_digest
+        bank = self.compile_bank
+
+        def _warm() -> None:
+            try:
+                import jax
+
+                from kube_batch_tpu.compile_cache import ArtifactBank
+                from kube_batch_tpu.ops.assignment import init_state
+                from kube_batch_tpu.parallel.mesh import MeshContext
+
+                fb_mesh = MeshContext(next_devices)
+                n = int(snap.node_cap.shape[0])
+                with trace.span("compile", where="mesh-fallback"), \
+                        fb_mesh.scan_scope():
+                    exe = cycle.lower(
+                        fb_mesh.shard_avals(snap, n),
+                        fb_mesh.shard_avals(
+                            jax.eval_shape(init_state, snap), n
+                        ),
+                    ).compile()
+                # A sibling bank over the SAME root, keyed at the
+                # fallback topology (the live bank's key must keep
+                # following the live rung; retargeting it from this
+                # thread would race the cycle thread's puts).
+                fb_bank = ArtifactBank(
+                    bank.root, mesh_devices=next_devices
+                )
+                fb_bank.mirror_sink = bank.mirror_sink
+                if fb_bank.put(digest, shapes, exe):
+                    self.compile_stats["banked"] += 1
+                    logging.info(
+                        "mesh-fallback prewarm: banked the %d-device "
+                        "program for the serving bucket — first "
+                        "device loss adopts instead of compiling",
+                        next_devices,
+                    )
+            except Exception:  # noqa: BLE001 — best-effort, like every
+                # prewarm: a failed fallback warm degrades the first
+                # device loss to an inline compile, never a cycle.
+                logging.exception("mesh-fallback prewarm failed")
+
+        threading.Thread(
+            target=_warm, name="mesh-fallback-prewarm", daemon=True,
+        ).start()
 
     def _run_exe(self, ssn: Session, exe, snap, state, pad=None) -> None:
         """Dispatch one compiled cycle over (snap, state) and land its
@@ -1462,6 +1857,13 @@ class Scheduler:
 
         with metrics.action_latency.time("fused"), \
                 trace.span("solve", mesh_devices=self.mesh_devices):
+            inject = self._mesh_fault_injector
+            if inject is not None:
+                # Chaos device-loss seam (chaos/engine.py): raises
+                # DeviceLossError here, BEFORE the dispatch — no
+                # device state has changed yet, so the mesh ladder's
+                # retry replays the identical cycle bit-for-bit.
+                inject(self)
             with metrics.cycle_phase_latency.time("dispatch"):
                 state, evict_payload, job_ready, diag = exe(snap, state)
             ssn.state = state
@@ -1724,6 +2126,11 @@ class Scheduler:
                 "compile_wait_ms": round(
                     self._last_compile_wait_s * 1e3, 3
                 ),
+                # Mesh degradation ladder (guardrails/mesh.py): the
+                # rung + live device count each cycle served at — a
+                # post-mortem's "ticks" ring shows the outage's shape.
+                "mesh_rung": self.mesh_ladder.rung,
+                "mesh_devices": self.mesh_devices,
             }
             if ssn is not None:
                 summary["pending"] = int(np.sum(
@@ -1833,6 +2240,7 @@ class Scheduler:
             # The pack drained the journal; idle-refresh marks restart.
             self._idle_refreshed_version = 0
             self._maybe_prewarm_growth(ssn)
+            self._maybe_prewarm_mesh_fallback(ssn)
             # Gang-atomic migration off cordoned nodes (budget-limited;
             # health/drain.py), at END of cycle: the evictions settle
             # over the wire (watch echoes ingest between cycles) and

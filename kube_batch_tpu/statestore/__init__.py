@@ -403,6 +403,13 @@ def collect_state(scheduler) -> dict:
         # must not wake up eager, and one that died CLAIMING must not
         # double-claim (the restore degrades that rung to cooldown).
         state["autopilot"] = autopilot.export_state()
+    mesh_ladder = getattr(scheduler, "mesh_ladder", None)
+    if mesh_ladder is not None and mesh_ladder.enabled:
+        # The mesh degradation ladder's rung (guardrails/mesh.py): a
+        # restarted daemon must not blindly retry a dead mesh — it
+        # resumes at the degraded topology and heals through the
+        # normal canary streaks.
+        state["mesh"] = scheduler.export_mesh_state()
     return state
 
 
@@ -456,6 +463,13 @@ def restore_state(
             summary["autopilot"] = autopilot.restore_state(ap_state)
         except Exception:  # noqa: BLE001 — start blind, never crash
             log.exception("malformed autopilot state; starting blind")
+    mesh_state = state.get("mesh")
+    if scheduler is not None and isinstance(mesh_state, dict) \
+            and hasattr(scheduler, "restore_mesh_state"):
+        try:
+            summary["mesh"] = scheduler.restore_mesh_state(mesh_state)
+        except Exception:  # noqa: BLE001 — start blind, never crash
+            log.exception("malformed mesh state; starting blind")
     metrics.state_adopted.inc(source)
     log.info("operational state adopted from %s: %s", source, summary)
     return summary

@@ -53,6 +53,10 @@ TRIGGERS = frozenset({
     "quarantine-cordon",
     "statestore-corrupt",
     "slo-burn",
+    # A mesh-ladder rung-down (guardrails/mesh.py): device loss is an
+    # anomaly like a breaker trip — the operator wants the failing
+    # cycles on disk the moment the solve topology shrinks.
+    "mesh-degraded",
 })
 #: Per-kind dump rate limit (cycles): a storm of StaleEpoch rejections
 #: during one failover window produces ONE post-mortem, not hundreds.

@@ -43,7 +43,8 @@ ACTION_VARIANTS: tuple[tuple[str, ...], ...] = (
 
 def warm_one(config_n: int, actions: tuple[str, ...],
              conf_path: str | None,
-             artifacts_dir: str | None = None) -> dict:
+             artifacts_dir: str | None = None,
+             mesh_devices: int | None = None) -> dict:
     """Child-process body: build the world + policy, AOT-compile the
     fused cycle (writing the persistent cache), report timing.
 
@@ -55,13 +56,34 @@ def warm_one(config_n: int, actions: tuple[str, ...],
     Caveat: only a FRESH compile is bankable — an executable replayed
     from the persistent XLA cache loses its AOT symbol table on the
     load path, so a re-warm over a warm cache banks nothing (the
-    bank.put self-check refuses the unserializable blob and says so)."""
+    bank.put self-check refuses the unserializable blob and says so).
+
+    With `mesh_devices > 1` (or KB_TPU_MESH_DEVICES) the program is
+    lowered SHARDED at that topology (the same SPMD program the
+    sharded daemon serves, doc/design/multichip-shard.md) and banked
+    under the topology-keyed entry — plus ONE fallback program at the
+    next rung down (mesh_devices // 2), so a daemon that loses
+    devices adopts its degraded-topology program from the bank
+    instead of paying an inline compile mid-outage
+    (guardrails/mesh.py)."""
     import os
 
     if artifacts_dir is None:
         artifacts_dir = os.environ.get(
             "KB_TPU_COMPILE_ARTIFACTS_DIR"
         ) or None
+    from kube_batch_tpu.parallel.mesh import (
+        arm_virtual_devices,
+        resolve_mesh_devices,
+    )
+
+    mesh_devices = resolve_mesh_devices(mesh_devices)
+    if mesh_devices > 1 and not os.environ.get("JAX_PLATFORMS", "") \
+            .startswith("tpu"):
+        # Virtual CPU mesh for sharded warms: must land before the
+        # first backend init (this is a fresh child process, so it
+        # does).
+        arm_virtual_devices(mesh_devices)
     from kube_batch_tpu.compile_cache import enable_compile_cache
 
     cache_dir = enable_compile_cache()
@@ -101,14 +123,28 @@ def warm_one(config_n: int, actions: tuple[str, ...],
         policy, conf.actions, compact_wire=compact, joint=joint
     ))
     state = init_state(snap)
+    from kube_batch_tpu.guardrails.mesh import topology_chain
+    from kube_batch_tpu.parallel.mesh import MeshContext
+
+    n_nodes = int(snap.node_cap.shape[0])
+
+    def _compile_at(devices: int):
+        mesh = MeshContext(devices)
+        with mesh.scan_scope():
+            return cycle.lower(
+                mesh.shard_avals(snap, n_nodes),
+                mesh.shard_avals(state, n_nodes),
+            ).compile()
+
     t0 = time.monotonic()
-    exe = cycle.lower(snap, state).compile()
+    exe = _compile_at(mesh_devices)
     out = {
         "config": config_n,
         "actions": list(actions),
         "compile_s": round(time.monotonic() - t0, 1),
         "cache_dir": cache_dir,
         "device": jax.devices()[0].platform,
+        "mesh_devices": mesh_devices,
     }
     if artifacts_dir:
         from kube_batch_tpu.compile_cache import ArtifactBank, conf_digest
@@ -117,11 +153,20 @@ def warm_one(config_n: int, actions: tuple[str, ...],
             (f.name, tuple(getattr(snap, f.name).shape))
             for f in dataclasses.fields(snap)
         )
-        bank = ArtifactBank(artifacts_dir)
-        out["banked"] = bank.put(
-            conf_digest(conf, compact, joint=joint), shapes, exe
-        )
+        digest = conf_digest(conf, compact, joint=joint)
+        bank = ArtifactBank(artifacts_dir, mesh_devices=mesh_devices)
+        out["banked"] = bank.put(digest, shapes, exe)
         out["artifacts_dir"] = bank.dir
+        if mesh_devices > 1:
+            # ONE fallback program at the next rung down (bounded, per
+            # the growth-prewarm discipline): the mesh degradation
+            # ladder's first rung shift adopts it from the bank
+            # instead of compiling inline mid-outage.
+            fallback = topology_chain(mesh_devices)[1]
+            fb_exe = _compile_at(fallback)
+            fb_bank = ArtifactBank(artifacts_dir, mesh_devices=fallback)
+            out["banked_fallback"] = fb_bank.put(digest, shapes, fb_exe)
+            out["fallback_devices"] = fallback
     return out
 
 
@@ -149,6 +194,13 @@ def main(argv: list[str] | None = None) -> int:
                         "failover (default: env "
                         "KB_TPU_COMPILE_ARTIFACTS_DIR; unset = "
                         "persistent XLA cache only)")
+    p.add_argument("--mesh-devices", default=None,
+                   help="lower every program SHARDED over this many "
+                        "devices (doc/design/multichip-shard.md) and "
+                        "bank one fallback program at the next rung "
+                        "down for the mesh degradation ladder "
+                        "(default: env KB_TPU_MESH_DEVICES; unset/1 = "
+                        "single-device)")
     p.add_argument("--_one", default=None, help=argparse.SUPPRESS)
     args = p.parse_args(argv)
 
@@ -157,7 +209,8 @@ def main(argv: list[str] | None = None) -> int:
         try:
             out = warm_one(spec["config"], tuple(spec["actions"]),
                            spec.get("conf"),
-                           spec.get("artifacts_dir"))
+                           spec.get("artifacts_dir"),
+                           spec.get("mesh_devices"))
         except Exception as exc:  # noqa: BLE001 — report, don't crash
             out = {"error": f"{type(exc).__name__}: {exc}"}
         print(json.dumps(out))
@@ -176,6 +229,8 @@ def main(argv: list[str] | None = None) -> int:
                 "config": n, "actions": list(actions),
                 "conf": args.scheduler_conf,
                 "artifacts_dir": artifacts_dir,
+                "mesh_devices": (int(args.mesh_devices)
+                                 if args.mesh_devices else None),
             })
             label = f"config {n} × {','.join(actions)}"
             print(f"[warm] {label}: compiling (subprocess, "

@@ -69,6 +69,12 @@ def main() -> int:
     assert shard.get("parity_mismatches", 1) == 0, shard
     assert shard.get("boundary_refused_1dev") is True, shard
     assert shard.get("big_admitted_8dev") is True, shard
+    # Mesh degradation ladder (guardrails/mesh.py): every artifact
+    # must RECORD the fallback rung's solve timing next to the full
+    # mesh's, and the degraded rung's decisions stay bit-identical.
+    assert shard.get("degraded_devices", 0) > 1, shard
+    assert shard.get("degraded_solve_ms", 0) > 0, shard
+    assert shard.get("degraded_parity_mismatches", 1) == 0, shard
 
     # Presence + sanity only: the >=1.5x steady-p99 gate lives in
     # scripts/check_joint_bench.py (make verify); the smoke pins that

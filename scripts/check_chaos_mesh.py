@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+"""Assert the device-loss mesh-degradation chaos acceptance criteria
+(make chaos; guardrails/mesh.py) over two same-seed fault-on runs plus
+a fault-off baseline:
+
+* both fault-on runs completed with zero invariant violations and
+  converged (the engine already asserted ladder-engaged,
+  no-cycle-lost-while-degraded, hbm-refused-rung-skipped and
+  heal-after-restore per run — a clean `ok` carries them);
+* the ladder actually walked: >= 1 down-shift and >= 1 up-shift, the
+  device-loss window fired and healed, and every window tick served
+  (0 lost cycles);
+* the refusal leg fired: the clamped rung shows in the refused census;
+* the run ended healed (rung 0, full topology restored);
+* same seed => same trace hash across the two fault-on runs — the
+  degrade/refuse/heal walk is deterministic;
+* decision invisibility: the fault-off baseline (same seed, no
+  injected outage, full mesh throughout) produced the IDENTICAL
+  decision hash — a degraded cycle's decisions are bit-identical to
+  the healthy mesh's (the mesh is a layout choice,
+  doc/design/multichip-shard.md), so the outage is invisible in
+  everything but latency and rung metrics.  The full trace hashes
+  legitimately differ (the fault schedule rides the trace); the
+  decision log is the contract.
+"""
+
+import json
+import sys
+
+
+def main(path_a: str, path_b: str, path_off: str) -> int:
+    with open(path_a, encoding="utf-8") as f:
+        a = json.load(f)
+    with open(path_b, encoding="utf-8") as f:
+        b = json.load(f)
+    with open(path_off, encoding="utf-8") as f:
+        off = json.load(f)
+    for name, run in (("run1", a), ("run2", b)):
+        assert run["ok"], f"{name} violations: {run['violations']}"
+        assert run["converged_after_drain_ticks"] is not None, \
+            f"{name}: never converged"
+        assert run["faults"].get("device-loss", 0) >= 1, \
+            f"{name}: the device-loss window never fired: {run['faults']}"
+        assert run["recoveries"].get("device-healed", 0) >= 1, \
+            f"{name}: the device-loss window never healed: " \
+            f"{run['recoveries']}"
+        mesh = run.get("mesh") or {}
+        assert mesh.get("devices", 1) > 1 and mesh.get("active"), \
+            f"{name}: no active mesh — the ladder had nothing to " \
+            f"walk: {mesh}"
+        lad = mesh.get("ladder") or {}
+        assert lad, f"{name}: no ladder evidence in the summary: {mesh}"
+        assert lad["max_rung_seen"] >= 1 and lad["shifts_down"] >= 1, \
+            f"{name}: the ladder never degraded: {lad}"
+        assert lad["shifts_up"] >= 1, \
+            f"{name}: the ladder never climbed back: {lad}"
+        assert lad["window_served"] == lad["window_ticks"], \
+            f"{name}: cycles lost during the outage " \
+            f"({lad['window_served']}/{lad['window_ticks']} served): " \
+            f"{lad}"
+        assert lad["window_degraded"] >= 1, \
+            f"{name}: no window tick ended degraded: {lad}"
+        assert lad["refused_rungs"], \
+            f"{name}: the clamped rung was never HBM-refused: {lad}"
+        assert lad["rung"] == 0 and \
+            lad["live_devices"] == lad["chain"][0], \
+            f"{name}: run ended still degraded: {lad}"
+        assert lad["solve_failures_device"] >= 1, \
+            f"{name}: no device-classified solve failure was " \
+            f"counted: {lad}"
+    assert a["trace_hash"] == b["trace_hash"], (
+        f"same-seed device-loss runs diverged: "
+        f"{a['trace_hash']} != {b['trace_hash']}"
+    )
+    # Decision invisibility vs the healthy-mesh baseline.
+    assert off["ok"], f"fault-off baseline violations: {off['violations']}"
+    off_mesh = off.get("mesh") or {}
+    assert off_mesh.get("devices", 1) > 1 and off_mesh.get("active"), (
+        "fault-off baseline did not run sharded — the parity check "
+        f"is vacuous: {off_mesh}"
+    )
+    assert "ladder" not in off_mesh, (
+        "fault-off baseline carries ladder evidence — it was not "
+        f"actually fault-free: {off_mesh}"
+    )
+    assert a["decisions_hash"] and \
+        a["decisions_hash"] == off["decisions_hash"], (
+        "degraded-mesh decisions diverged from the healthy-mesh "
+        f"baseline: {a['decisions_hash']} != {off['decisions_hash']} "
+        "— the ladder changed a scheduling decision"
+    )
+    lad = a["mesh"]["ladder"]
+    print(
+        "chaos mesh ladder: ok — same-seed hash "
+        f"{a['trace_hash'][:16]}… reproduced; degraded "
+        f"{lad['chain'][0]} → {min(s for s in lad['chain'][:lad['max_rung_seen'] + 1])} "
+        f"device(s) ({lad['shifts_down']:.0f} down / "
+        f"{lad['shifts_up']:.0f} up shift(s), rung(s) "
+        f"{lad['refused_rungs']} HBM-refused and skipped), served "
+        f"{lad['window_served']}/{lad['window_ticks']} outage "
+        "cycle(s), healed to full topology, and decisions hash "
+        "IDENTICAL to the fault-off healthy-mesh baseline"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1], sys.argv[2], sys.argv[3]))

@@ -113,6 +113,27 @@ def measure_shard(shapes: dict | None = None) -> dict:
     out8 = jax.block_until_ready(exe8_parity(snap_ps, state_ps))
     _assert_sharded("out.node_future", out8.node_future)
 
+    # -- degraded rung (mesh degradation ladder, guardrails/mesh.py) ---
+    # The first fallback rung (DEVICES // 2) is what a device-loss
+    # outage actually serves at; time one solve there so the bench
+    # artifact carries the degraded-topology figure next to the full
+    # mesh's, and pin that its decisions stay bit-identical (the rung
+    # is a layout choice, never a decision input).  Compiled here, in
+    # the sharded-first section, for the same layout reason as above.
+    import time as _time
+
+    deg_devices = DEVICES // 2
+    mesh_deg = make_mesh(deg_devices)
+    snap_pd, state_pd = shard_cycle_inputs(snap_p, state_p, mesh_deg)
+    with shard_local_scan():
+        exe_deg = jax.jit(fn_p).lower(snap_pd, state_pd).compile()
+    out_deg = jax.block_until_ready(exe_deg(snap_pd, state_pd))
+    deg_ms = float("inf")
+    for _ in range(3):
+        t0 = _time.perf_counter()
+        jax.block_until_ready(exe_deg(snap_pd, state_pd))
+        deg_ms = min(deg_ms, (_time.perf_counter() - t0) * 1e3)
+
     policy_g, snap_g, state_g = g._build_world(n_nodes=gn, n_tasks=gt)
     fn_g = g._pipeline_fn(policy_g)
     snap_gs, state_gs = shard_cycle_inputs(snap_g, state_g, mesh)
@@ -149,6 +170,11 @@ def measure_shard(shapes: dict | None = None) -> dict:
         for a, b in zip(jax.tree_util.tree_leaves(out1),
                         jax.tree_util.tree_leaves(out8))
     )
+    deg_mismatches = sum(
+        0 if np.array_equal(np.asarray(a), np.asarray(b)) else 1
+        for a, b in zip(jax.tree_util.tree_leaves(out1),
+                        jax.tree_util.tree_leaves(out_deg))
+    )
 
     return {
         "devices": DEVICES,
@@ -165,6 +191,9 @@ def measure_shard(shapes: dict | None = None) -> dict:
         "peak_ratio": round(peak8_big / peak1_big, 3),
         "solved_big_transitions": placed_big,
         "parity_mismatches": mismatches,
+        "degraded_devices": deg_devices,
+        "degraded_solve_ms": round(deg_ms, 2),
+        "degraded_parity_mismatches": deg_mismatches,
     }
 
 
@@ -185,6 +214,7 @@ def main(argv: list[str] | None = None) -> int:
         and result["peak_ratio"] <= PEAK_RATIO_GATE
         and result["solved_big_transitions"] > 0
         and result["parity_mismatches"] == 0
+        and result["degraded_parity_mismatches"] == 0
     )
     if ok:
         print(
@@ -198,7 +228,9 @@ def main(argv: list[str] | None = None) -> int:
             f"{result['peak_mb_per_device_8dev']} MB = "
             f"{result['peak_ratio']}x of 1-device "
             f"{result['peak_mb_1dev']} MB (gate <={PEAK_RATIO_GATE}); "
-            "sharded solve bit-identical"
+            "sharded solve bit-identical; degraded rung "
+            f"({result['degraded_devices']} devices) solved in "
+            f"{result['degraded_solve_ms']} ms, bit-identical"
         )
         return 0
     print(f"shard bench: FAIL — {result}", file=sys.stderr)

@@ -115,6 +115,8 @@ def test_replayed_trace_meta_restores_guardrail_fault_spec():
             "crash_restart_at": 0, "crash_restarts": 1,
             "crash_restart_every": 8, "hbm_pin_at": 0,
             "compile_bank": 0,
+            "device_loss_at": 0, "device_loss_ticks": 10,
+            "device_loss_devices": 2, "device_loss_refuse_devices": 0,
             "storm_at": 0, "storm_ticks": 6, "storm_events": 60}
     eng = ChaosEngine(seed=11, ticks=32, events=[meta])
     for field in _META_FAULT_FIELDS:

@@ -648,6 +648,42 @@ def test_mesh_entry_names_disjoint_but_single_device_unchanged():
     assert eight != legacy
 
 
+def test_rung_shift_retarget_never_loads_wrong_topology(banked_world,
+                                                        tmp_path):
+    """The degradation ladder's rung shift (guardrails/mesh.py;
+    scheduler._apply_mesh_rung → bank.retarget_mesh): after banking
+    ONLY at the full topology N, a get() at the fallback rung N/2
+    must NEVER hand back the full-mesh executable — a clean topology-
+    keyed miss when nothing sits at the rung's filename, and a
+    counted `mesh` rejection when a wrong-topology blob does (a peer
+    writing across topologies) — so the rung compiles fresh instead
+    of mis-sharding every input."""
+    root, digest, shapes, _s, _b = banked_world
+    copy = _copy_bank(root, str(tmp_path))
+    path1 = _entry_path(ArtifactBank(copy))
+    bank = ArtifactBank(copy, mesh_devices=8)
+    # Re-home the lone entry at the 8-device key — the world that
+    # banked ONLY at the full topology.
+    path8 = bank._path(digest, shapes)
+    os.rename(path1, path8)
+    _rewrite_header(
+        path8, mesh={"devices": 8, "platform": bank.mesh["platform"]},
+    )
+    # Rung shift: the live bank re-keys at the fallback topology.
+    bank.retarget_mesh(4)
+    before = metrics.compile_artifact_rejected.value("mesh")
+    assert bank.get(digest, shapes) is None   # clean miss → fresh compile
+    assert bank.rejects == {}                 # a miss, not a rejection
+    # A wrong-topology blob AT the rung's filename is the loud case.
+    shutil.copy(path8, bank._path(digest, shapes))
+    assert bank.get(digest, shapes) is None
+    assert bank.rejects == {"mesh": 1}
+    assert metrics.compile_artifact_rejected.value("mesh") == before + 1
+    # Healing re-targets back: the full-mesh entry keeps hitting.
+    bank.retarget_mesh(8)
+    assert bank.get(digest, shapes) is not None
+
+
 def test_bank_header_records_local_mesh(tmp_path):
     """A mesh-armed bank stamps its topology into every header it
     writes, and a differently-sized bank refuses to look where that

@@ -211,3 +211,32 @@ def test_healthz_carries_backlog_pressure(server):
         # Process-global /healthz state: leave it clean.
         metrics.set_ingest_lag(0.0)
         metrics.commit_queue_depth.set(0.0)
+
+
+def test_healthz_carries_mesh_ladder_entry(server):
+    """/healthz gains a `mesh` entry (configured devices, live rung,
+    rung transitions) once a mesh-enabled scheduler publishes — a
+    shrunken mesh is visible to probes without scraping /metrics
+    (guardrails/mesh.py).  Single-device daemons serve a byte-
+    unchanged body (no `mesh` key)."""
+    status, body = _get(server, "/healthz")
+    assert status == 200
+    assert "mesh" not in body  # nothing published: unchanged body
+    metrics.set_mesh_state({
+        "configured_devices": 8,
+        "devices": 2,
+        "rung": 2,
+        "transitions": 3,
+    })
+    try:
+        status, body = _get(server, "/healthz")
+        assert status == 200
+        assert body["mesh"] == {
+            "configured_devices": 8,
+            "devices": 2,
+            "rung": 2,
+            "transitions": 3,
+        }
+    finally:
+        # Process-global /healthz state: leave it clean.
+        metrics.set_mesh_state(None)

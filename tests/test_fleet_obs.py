@@ -448,6 +448,30 @@ def test_fleet_pane_merges_scopes_and_rolls_up(tmp_path):
     assert [b["cell"] for b in roll["burning"]] == ["cell-b"]
 
 
+def test_fleet_pane_carries_mesh_ladder_entry(tmp_path):
+    """A cell serving on a degraded mesh (guardrails/mesh.py) shows
+    its `mesh` entry in the /debug/fleet pane — the fleet-wide
+    "which cell shrank its mesh?" look; cells that never published
+    (single-device) carry no `mesh` key."""
+    for cell in ("cell-a", "cell-b"):
+        trace.enable(dump_dir=str(tmp_path), scope=cell)
+    metrics.set_health_state("ok", scope="cell-a")
+    metrics.set_health_state("ok", scope="cell-b")
+    metrics.set_mesh_state({
+        "configured_devices": 8,
+        "devices": 4,
+        "rung": 1,
+        "transitions": 1,
+    }, scope="cell-b")
+    status, body = trace.debug_http("/debug/fleet")
+    assert status == 200
+    cells = body["cells"]
+    assert cells["cell-b"]["mesh"]["devices"] == 4
+    assert cells["cell-b"]["mesh"]["rung"] == 1
+    assert cells["cell-b"]["mesh"]["configured_devices"] == 8
+    assert "mesh" not in cells["cell-a"]
+
+
 def test_fleet_pane_fetches_peers_with_staleness(tmp_path):
     """A live peer's /healthz + /debug/slo merge in; a dead peer
     degrades to an error row with stale=True — never a raise."""

@@ -979,3 +979,126 @@ def test_crossing_a_refused_boundary_pauses_the_solve(tmp_path):
     assert resumed is not None
     assert len(resumed.bound) == 1           # the survivor binds
     assert metrics.health_state() == "ok"
+
+
+# -- mesh degradation ladder (guardrails/mesh.py) -----------------------
+
+def test_mesh_topology_chain_halves_to_the_floor():
+    from kube_batch_tpu.guardrails.mesh import MeshLadder, topology_chain
+
+    assert topology_chain(8) == (8, 4, 2, 1)
+    assert topology_chain(4) == (4, 2, 1)
+    assert topology_chain(1) == (1,)
+    assert MeshLadder(8).enabled
+    assert not MeshLadder(1).enabled          # single-rung chain
+    assert not MeshLadder(8, engage_after=0).enabled
+
+
+def test_mesh_ladder_engages_after_consecutive_failures_only():
+    from kube_batch_tpu.guardrails.mesh import MeshLadder
+
+    lad = MeshLadder(8, engage_after=2, recover_after=4)
+    assert lad.observe_failure() is None      # streak of 1: hold
+    assert lad.observe_failure() == (8, 4)    # streak of 2: rung down
+    assert lad.rung == 1 and lad.devices == 4
+    # An interleaved clean solve resets the failure streak: a flaky
+    # device that alternates cannot walk the ladder.
+    assert lad.observe_failure() is None
+    assert lad.observe_healthy() is None
+    assert lad.observe_failure() is None      # streak restarted at 1
+    assert lad.observe_failure() == (4, 2)
+
+
+def test_mesh_ladder_recovery_is_slower_and_stepwise():
+    from kube_batch_tpu.guardrails.mesh import MeshLadder
+
+    lad = MeshLadder(8, engage_after=2, recover_after=4)
+    for _ in range(2):
+        lad.observe_failure()
+    for _ in range(2):
+        lad.observe_failure()
+    assert lad.rung == 2 and lad.devices == 2
+    # Canary streak: 3 clean solves hold, the 4th climbs ONE rung.
+    for _ in range(3):
+        assert lad.observe_healthy() is None
+    assert lad.observe_healthy() == (2, 4)
+    assert lad.rung == 1
+    # A failure mid-streak resets the canary evidence.
+    for _ in range(3):
+        lad.observe_healthy()
+    assert lad.observe_failure() is None
+    for _ in range(3):
+        assert lad.observe_healthy() is None
+    assert lad.observe_healthy() == (4, 8)
+    assert lad.rung == 0
+    # At the full topology clean solves are a no-op, never a shift.
+    assert lad.observe_healthy() is None
+    assert lad.max_rung_seen == 2 and lad.transitions == 4
+
+
+def test_mesh_ladder_floor_holds_and_refusals_skip_both_ways():
+    from kube_batch_tpu.guardrails.mesh import MeshLadder
+
+    lad = MeshLadder(4, engage_after=1, recover_after=2)
+    assert lad.observe_failure() == (4, 2)
+    # HBM admission refuses the live rung: immediate skip, no
+    # hysteresis (the projection is a pure function of the program).
+    assert lad.refuse_current() == (2, 1)
+    assert lad.rung == 2 and lad.devices == 1
+    # At the floor, further failures hold (nothing below to walk to).
+    assert lad.observe_failure() is None
+    assert lad.rung == 2
+    # The refused rung is skipped on the way back UP too: 1 → 4.
+    assert lad.observe_healthy() is None
+    assert lad.observe_healthy() == (1, 4)
+    assert lad.rung == 0
+    # A full heal retires the refusal verdict: the next walk down may
+    # re-measure the once-refused rung against the new world.
+    assert lad.observe_failure() == (4, 2)
+
+
+def test_mesh_ladder_refuse_with_no_admitted_rung_below():
+    from kube_batch_tpu.guardrails.mesh import (
+        MeshLadder,
+        MeshRungRefused,
+    )
+
+    lad = MeshLadder(2, engage_after=1, recover_after=2)
+    assert lad.observe_failure() == (2, 1)
+    assert lad.refuse_current() is None       # floor refused: no shift
+    err = MeshRungRefused(1, label="T=32xN=8")
+    assert err.devices == 1 and "T=32xN=8" in str(err)
+
+
+def test_mesh_ladder_restore_resumes_degraded():
+    from kube_batch_tpu.guardrails.mesh import MeshLadder
+
+    lad = MeshLadder(8)
+    lad.restore(2)
+    assert lad.rung == 2 and lad.devices == 2
+    assert lad.max_rung_seen == 2
+    lad.restore(99)                           # malformed: clamp to floor
+    assert lad.rung == len(lad.chain) - 1
+    lad.restore(-3)
+    assert lad.rung == 0
+
+
+def test_mesh_classify_solve_error():
+    from kube_batch_tpu.guardrails.mesh import (
+        DeviceLossError,
+        classify_solve_error,
+    )
+
+    assert classify_solve_error(DeviceLossError("gone")) == "device"
+    assert classify_solve_error(RuntimeError("wedged")) == "device"
+    assert classify_solve_error(OSError("io")) == "device"
+
+    class XlaRuntimeError(Exception):
+        pass
+
+    assert classify_solve_error(XlaRuntimeError("dead")) == "device"
+    # Deterministic program/pack bugs re-raise: degrading the mesh
+    # for them would hide the bug without fixing anything.
+    assert classify_solve_error(ValueError("sharding")) == "data"
+    assert classify_solve_error(KeyError("field")) == "data"
+    assert classify_solve_error(Exception("unknown")) == "data"

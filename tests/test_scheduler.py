@@ -564,7 +564,7 @@ def test_ensure_compiled_joins_inflight_growth_compile():
     s._reload_conf()
     snap, _meta = pack_snapshot(cache.snapshot())
     state = init_state(snap)
-    key = Scheduler._shape_key(s._cycle, snap)
+    key = s._shape_key(s._cycle, snap)
 
     sentinel = object()  # stands in for the warm's executable
     done = threading.Event()
@@ -604,7 +604,7 @@ def test_ensure_compiled_steals_queued_growth_entry():
     s._reload_conf()
     snap, _meta = pack_snapshot(cache.snapshot())
     state = init_state(snap)
-    key = Scheduler._shape_key(s._cycle, snap)
+    key = s._shape_key(s._cycle, snap)
     s._growth_queue.append((key, snap, s._cycle, {"T": 1}))
 
     exe = s._ensure_compiled(snap, state)
